@@ -1,0 +1,26 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A from-scratch reimplementation of the capability surface of Kubernetes'
+kube-scheduler (reference: kubernetes/kubernetes, surveyed in SURVEY.md), designed
+TPU-first: the host side (Python, with C++ hot paths) owns API objects, watch/event
+ingest, the scheduling queue, profiles/config, preemption, and binding; the compute
+side lifts the Scheduling Framework's PreFilter/Filter/Score phases into batched
+JAX/XLA programs over dense ``[pods, nodes]`` tensors, with Pallas kernels for top-k
+and batch assignment, and ``jax.sharding`` meshes + ICI collectives for scale.
+
+Layout (host control plane mirrors reference layers from SURVEY.md §1):
+  api/        — object model (v1.Pod, v1.Node, selectors, quantities)
+  state/      — dictionary encoding, struct-of-arrays snapshots, scheduler cache
+  framework/  — batched plugin API + runtime (extension points, CycleState, events)
+  plugins/    — vectorized default plugin set (reference: pkg/scheduler/framework/plugins)
+  queueing/   — 3-queue PriorityQueue with event-driven requeue
+  ops/        — device kernels: top-k, assignment, segment-sums (Pallas)
+  parallel/   — device mesh, node-axis sharding, ICI collectives
+  config/     — KubeSchedulerConfiguration-compatible componentconfig
+  sim/        — in-process apiserver/store + hollow-node cluster simulation
+  metrics/    — prometheus-name-compatible metrics
+  perf/       — scheduler_perf-style benchmark harness
+  models/     — the flagship jittable scheduling program (score + assign)
+"""
+
+__version__ = "0.1.0"

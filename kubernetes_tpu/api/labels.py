@@ -1,0 +1,106 @@
+"""Host-side label/selector evaluation.
+
+Reference semantics: apimachinery ``labels.Selector`` / ``metav1.LabelSelectorAsSelector``
+and core v1 ``NodeSelectorRequirement`` matching (component-helpers
+scheduling/corev1/nodeaffinity). These host-side evaluators are the parity oracle for
+the compiled tensor versions in ``state/selectors.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from .objects import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    LabelSelector,
+    NodeSelector,
+    NodeSelectorTerm,
+    Node,
+)
+
+
+def match_label_selector(
+    selector: Optional[LabelSelector], labels: Mapping[str, str]
+) -> bool:
+    """metav1 LabelSelector match: None → matches nothing; empty → everything."""
+    if selector is None:
+        return False
+    for k, v in selector.match_labels.items():
+        if labels.get(k) != v:
+            return False
+    for req in selector.match_expressions:
+        has = req.key in labels
+        val = labels.get(req.key)
+        if req.operator == OP_IN:
+            if not has or val not in req.values:
+                return False
+        elif req.operator == OP_NOT_IN:
+            if has and val in req.values:
+                return False
+        elif req.operator == OP_EXISTS:
+            if not has:
+                return False
+        elif req.operator == OP_DOES_NOT_EXIST:
+            if has:
+                return False
+        else:
+            return False
+    return True
+
+
+def _match_node_selector_requirement(req, labels: Mapping[str, str]) -> bool:
+    has = req.key in labels
+    val = labels.get(req.key)
+    if req.operator == OP_IN:
+        return has and val in req.values
+    if req.operator == OP_NOT_IN:
+        # apimachinery labels.Requirement.Matches: NotIn matches when the key is
+        # absent (reference: labels/selector.go Matches, selection.NotIn case).
+        return (not has) or val not in req.values
+    if req.operator == OP_EXISTS:
+        return has
+    if req.operator == OP_DOES_NOT_EXIST:
+        return not has
+    if req.operator in (OP_GT, OP_LT):
+        # Reference: nodeaffinity.go — both label value and the single requirement
+        # value must parse as integers.
+        if not has or len(req.values) != 1:
+            return False
+        try:
+            lhs = int(val)
+            rhs = int(req.values[0])
+        except (TypeError, ValueError):
+            return False
+        return lhs > rhs if req.operator == OP_GT else lhs < rhs
+    return False
+
+
+def match_node_selector_term(
+    term: NodeSelectorTerm, node: Node
+) -> bool:
+    """All expressions AND all fields must match (empty term matches nothing)."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    for req in term.match_expressions:
+        if not _match_node_selector_requirement(req, node.metadata.labels):
+            return False
+    for req in term.match_fields:
+        # Only metadata.name is a valid field selector (reference nodeaffinity.go).
+        fields = {"metadata.name": node.metadata.name}
+        if not _match_node_selector_requirement(req, fields):
+            return False
+    return True
+
+
+def match_node_selector(selector: Optional[NodeSelector], node: Node) -> bool:
+    """Terms OR together; nil selector matches everything, empty terms list nothing."""
+    if selector is None:
+        return True
+    return any(
+        match_node_selector_term(t, node) for t in selector.node_selector_terms
+    )

@@ -1,0 +1,724 @@
+"""API object model — the subset of v1.Pod / v1.Node (+ friends) the scheduler reads.
+
+Reference: staging/src/k8s.io/api/core/v1/types.go. Python dataclasses with
+k8s-manifest-compatible ``from_dict`` constructors (camelCase keys), so workloads and
+componentconfig written for the reference load unchanged. Only fields the scheduling
+path consumes are modeled; unknown manifest fields are ignored rather than rejected.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional
+
+_uid_counter = itertools.count(1)
+
+
+def _new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+def _parse_time(v, default=None) -> Optional[float]:
+    """Accept epoch numbers or RFC3339 strings ('2026-01-01T00:00:00Z') → epoch float."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return float(v)
+    from datetime import datetime
+
+    s = str(v).replace("Z", "+00:00")
+    return datetime.fromisoformat(s).timestamp()
+
+
+# --- metadata ---------------------------------------------------------------
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    resource_version: int = 0
+    owner_references: List["OwnerReference"] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid") or _new_uid(),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            creation_timestamp=_parse_time(d.get("creationTimestamp"), time.time()),
+            owner_references=[
+                OwnerReference.from_dict(o) for o in d.get("ownerReferences") or []
+            ],
+            deletion_timestamp=_parse_time(d.get("deletionTimestamp")),
+        )
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = "v1"
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "OwnerReference":
+        return cls(
+            api_version=d.get("apiVersion", "v1"),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=bool(d.get("controller", False)),
+        )
+
+
+# --- selectors --------------------------------------------------------------
+
+# LabelSelector operators (apimachinery metav1.LabelSelectorOperator).
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+# NodeSelector-only operators (core v1.NodeSelectorOperator).
+OP_GT = "Gt"
+OP_LT = "Lt"
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str = ""
+    operator: str = OP_EXISTS
+    values: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "LabelSelectorRequirement":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", OP_EXISTS),
+            values=[str(v) for v in d.get("values") or []],
+        )
+
+
+@dataclass
+class LabelSelector:
+    """metav1.LabelSelector: AND of match_labels and match_expressions.
+
+    An empty selector matches everything; None (absent) matches nothing.
+    """
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["LabelSelector"]:
+        if d is None:
+            return None
+        return cls(
+            match_labels={k: str(v) for k, v in (d.get("matchLabels") or {}).items()},
+            match_expressions=[
+                LabelSelectorRequirement.from_dict(e)
+                for e in d.get("matchExpressions") or []
+            ],
+        )
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = OP_EXISTS
+    values: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "NodeSelectorRequirement":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", OP_EXISTS),
+            values=[str(v) for v in d.get("values") or []],
+        )
+
+
+@dataclass
+class NodeSelectorTerm:
+    """OR-ed term; inside a term, expressions AND together (v1.NodeSelectorTerm)."""
+
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+    match_fields: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "NodeSelectorTerm":
+        return cls(
+            match_expressions=[
+                NodeSelectorRequirement.from_dict(e)
+                for e in d.get("matchExpressions") or []
+            ],
+            match_fields=[
+                NodeSelectorRequirement.from_dict(e)
+                for e in d.get("matchFields") or []
+            ],
+        )
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: List[NodeSelectorTerm] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["NodeSelector"]:
+        if d is None:
+            return None
+        return cls(
+            node_selector_terms=[
+                NodeSelectorTerm.from_dict(t)
+                for t in d.get("nodeSelectorTerms") or []
+            ]
+        )
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PreferredSchedulingTerm":
+        return cls(
+            weight=int(d.get("weight", 1)),
+            preference=NodeSelectorTerm.from_dict(d.get("preference") or {}),
+        )
+
+
+# --- affinity ---------------------------------------------------------------
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None  # requiredDuringSchedulingIgnoredDuringExecution
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["NodeAffinity"]:
+        if d is None:
+            return None
+        return cls(
+            required=NodeSelector.from_dict(
+                d.get("requiredDuringSchedulingIgnoredDuringExecution")
+            ),
+            preferred=[
+                PreferredSchedulingTerm.from_dict(t)
+                for t in d.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+            ],
+        )
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: List[str] = field(default_factory=list)
+    topology_key: str = ""
+    namespace_selector: Optional[LabelSelector] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodAffinityTerm":
+        return cls(
+            label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+            namespaces=[str(n) for n in d.get("namespaces") or []],
+            topology_key=d.get("topologyKey", ""),
+            namespace_selector=LabelSelector.from_dict(d.get("namespaceSelector")),
+        )
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    pod_affinity_term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "WeightedPodAffinityTerm":
+        return cls(
+            weight=int(d.get("weight", 1)),
+            pod_affinity_term=PodAffinityTerm.from_dict(d.get("podAffinityTerm") or {}),
+        )
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["PodAffinity"]:
+        if d is None:
+            return None
+        return cls(
+            required=[
+                PodAffinityTerm.from_dict(t)
+                for t in d.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+            ],
+            preferred=[
+                WeightedPodAffinityTerm.from_dict(t)
+                for t in d.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+            ],
+        )
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAffinity] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> Optional["Affinity"]:
+        if d is None:
+            return None
+        return cls(
+            node_affinity=NodeAffinity.from_dict(d.get("nodeAffinity")),
+            pod_affinity=PodAffinity.from_dict(d.get("podAffinity")),
+            pod_anti_affinity=PodAffinity.from_dict(d.get("podAntiAffinity")),
+        )
+
+
+# --- taints & tolerations ---------------------------------------------------
+
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = TAINT_NO_SCHEDULE
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Taint":
+        return cls(
+            key=d.get("key", ""),
+            value=str(d.get("value", "")),
+            effect=d.get("effect", TAINT_NO_SCHEDULE),
+        )
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Toleration":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", TOLERATION_OP_EQUAL),
+            value=str(d.get("value", "")),
+            effect=d.get("effect", ""),
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference: component-helpers scheduling/corev1 Toleration.ToleratesTaint."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == TOLERATION_OP_EXISTS:
+            return True
+        # Equal (default): empty key with Exists already handled; empty key+Equal
+        # matches only empty taint key (handled by key check above).
+        return self.value == taint.value
+
+
+# --- topology spread --------------------------------------------------------
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "TopologySpreadConstraint":
+        return cls(
+            max_skew=int(d.get("maxSkew", 1)),
+            topology_key=d.get("topologyKey", ""),
+            when_unsatisfiable=d.get("whenUnsatisfiable", DO_NOT_SCHEDULE),
+            label_selector=LabelSelector.from_dict(d.get("labelSelector")),
+            min_domains=d.get("minDomains"),
+        )
+
+
+# --- pod --------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    host_ip: str = ""
+    protocol: str = "TCP"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ContainerPort":
+        return cls(
+            container_port=int(d.get("containerPort", 0)),
+            host_port=int(d.get("hostPort", 0)),
+            host_ip=d.get("hostIP", ""),
+            protocol=d.get("protocol", "TCP"),
+        )
+
+
+@dataclass
+class ResourceRequirements:
+    requests: Dict[str, object] = field(default_factory=dict)
+    limits: Dict[str, object] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "ResourceRequirements":
+        d = d or {}
+        return cls(
+            requests=dict(d.get("requests") or {}),
+            limits=dict(d.get("limits") or {}),
+        )
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Container":
+        return cls(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            resources=ResourceRequirements.from_dict(d.get("resources")),
+            ports=[ContainerPort.from_dict(p) for p in d.get("ports") or []],
+        )
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    pvc_name: Optional[str] = None  # persistentVolumeClaim.claimName
+    host_path: Optional[str] = None
+    gce_pd_name: Optional[str] = None
+    aws_ebs_volume_id: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Volume":
+        pvc = d.get("persistentVolumeClaim") or {}
+        hp = d.get("hostPath") or {}
+        gce = d.get("gcePersistentDisk") or {}
+        ebs = d.get("awsElasticBlockStore") or {}
+        return cls(
+            name=d.get("name", ""),
+            pvc_name=pvc.get("claimName"),
+            host_path=hp.get("path"),
+            gce_pd_name=gce.get("pdName"),
+            aws_ebs_volume_id=ebs.get("volumeID"),
+        )
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    priority: int = 0
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(
+        default_factory=list
+    )
+    overhead: Dict[str, object] = field(default_factory=dict)
+    volumes: List[Volume] = field(default_factory=list)
+    host_network: bool = False
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodSpec":
+        return cls(
+            containers=[Container.from_dict(c) for c in d.get("containers") or []],
+            init_containers=[
+                Container.from_dict(c) for c in d.get("initContainers") or []
+            ],
+            node_name=d.get("nodeName", ""),
+            node_selector={
+                k: str(v) for k, v in (d.get("nodeSelector") or {}).items()
+            },
+            affinity=Affinity.from_dict(d.get("affinity")),
+            tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
+            priority=int(d.get("priority", 0)),
+            priority_class_name=d.get("priorityClassName", ""),
+            scheduler_name=d.get("schedulerName", "default-scheduler"),
+            topology_spread_constraints=[
+                TopologySpreadConstraint.from_dict(t)
+                for t in d.get("topologySpreadConstraints") or []
+            ],
+            overhead=dict(d.get("overhead") or {}),
+            volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],
+            host_network=bool(d.get("hostNetwork", False)),
+            preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"),
+        )
+
+
+POD_PENDING = "Pending"
+POD_RUNNING = "Running"
+POD_SUCCEEDED = "Succeeded"
+POD_FAILED = "Failed"
+
+
+@dataclass
+class PodStatus:
+    phase: str = POD_PENDING
+    nominated_node_name: str = ""
+    conditions: List[Dict] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
+        d = d or {}
+        return cls(
+            phase=d.get("phase", POD_PENDING),
+            nominated_node_name=d.get("nominatedNodeName", ""),
+            conditions=list(d.get("conditions") or []),
+        )
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Pod":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+            status=PodStatus.from_dict(d.get("status")),
+        )
+
+
+# --- node -------------------------------------------------------------------
+
+
+@dataclass
+class ContainerImage:
+    names: List[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ContainerImage":
+        return cls(
+            names=[str(n) for n in d.get("names") or []],
+            size_bytes=int(d.get("sizeBytes", 0)),
+        )
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+    pod_cidr: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "NodeSpec":
+        d = d or {}
+        return cls(
+            unschedulable=bool(d.get("unschedulable", False)),
+            taints=[Taint.from_dict(t) for t in d.get("taints") or []],
+            pod_cidr=d.get("podCIDR", ""),
+        )
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, object] = field(default_factory=dict)
+    allocatable: Dict[str, object] = field(default_factory=dict)
+    images: List[ContainerImage] = field(default_factory=list)
+    conditions: List[Dict] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "NodeStatus":
+        d = d or {}
+        cap = dict(d.get("capacity") or {})
+        alloc = dict(d.get("allocatable") or cap)
+        return cls(
+            capacity=cap,
+            allocatable=alloc,
+            images=[ContainerImage.from_dict(i) for i in d.get("images") or []],
+            conditions=list(d.get("conditions") or []),
+        )
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Node":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NodeSpec.from_dict(d.get("spec")),
+            status=NodeStatus.from_dict(d.get("status")),
+        )
+
+
+# --- policy / misc objects the scheduler consumes ---------------------------
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PDB — the fields preemption reads (disruptionsAllowed, selector)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+
+    kind = "PodDisruptionBudget"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodDisruptionBudget":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            disruptions_allowed=int(status.get("disruptionsAllowed", 0)),
+        )
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_name: str = ""
+    storage_class_name: Optional[str] = None
+    phase: str = "Pending"  # Bound once volume_name set
+
+    kind = "PersistentVolumeClaim"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PersistentVolumeClaim":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            volume_name=spec.get("volumeName", ""),
+            storage_class_name=spec.get("storageClassName"),
+            phase=status.get("phase", "Pending"),
+        )
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: Dict[str, object] = field(default_factory=dict)
+    node_affinity: Optional[NodeSelector] = None
+    storage_class_name: str = ""
+
+    kind = "PersistentVolume"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PersistentVolume":
+        spec = d.get("spec") or {}
+        na = (spec.get("nodeAffinity") or {}).get("required")
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            capacity=dict(spec.get("capacity") or {}),
+            node_affinity=NodeSelector.from_dict(na),
+            storage_class_name=spec.get("storageClassName", ""),
+        )
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+
+    kind = "Service"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Service":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector={k: str(v) for k, v in (spec.get("selector") or {}).items()},
+        )
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+
+    kind = "ReplicaSet"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ReplicaSet":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            replicas=int(spec.get("replicas", 1)),
+        )
+
+
+def is_pod_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def is_pod_terminal(pod: Pod) -> bool:
+    return pod.status.phase in (POD_SUCCEEDED, POD_FAILED)

@@ -1,0 +1,15 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh (xla_force_host_platform_device_count), mirroring how the driver
+dry-runs the multi-chip path.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"

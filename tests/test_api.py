@@ -1,0 +1,192 @@
+"""API object model tests: quantities, resource vectors, selectors, builders."""
+
+from kubernetes_tpu.api import (
+    Resource,
+    Toleration,
+    Taint,
+    compute_pod_resource_request,
+    compute_pod_resource_request_non_zero,
+    match_label_selector,
+    match_node_selector,
+    parse_quantity,
+    quantity_to_int,
+    quantity_to_milli,
+)
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def test_parse_quantity():
+    assert parse_quantity("100m") == 0.1
+    assert parse_quantity("1") == 1.0
+    assert parse_quantity("2Gi") == 2 * 1024**3
+    assert parse_quantity("1.5Gi") == 1.5 * 1024**3
+    assert parse_quantity("500M") == 5e8
+    assert parse_quantity("2e3") == 2000.0
+    assert parse_quantity("0.5") == 0.5
+    assert parse_quantity(4) == 4.0
+
+
+def test_quantity_milli_ceil():
+    assert quantity_to_milli("100m") == 100
+    assert quantity_to_milli("1") == 1000
+    assert quantity_to_milli("0.1") == 100
+    # 1m of a 3-way split rounds up
+    assert quantity_to_milli("0.3333") == 334  # ceil(333.3)
+    assert quantity_to_int("1.5Gi") == int(1.5 * 1024**3)
+
+
+def test_resource_from_resource_list():
+    r = Resource.from_resource_list(
+        {"cpu": "500m", "memory": "1Gi", "pods": "10", "nvidia.com/gpu": "2"}
+    )
+    assert r.milli_cpu == 500
+    assert r.memory == 1024**3
+    assert r.allowed_pod_number == 10
+    assert r.scalar_resources["nvidia.com/gpu"] == 2
+
+
+def test_pod_request_max_of_init_containers():
+    # reference: fit.go:162-178 — max(sum(containers), each init container) + overhead
+    pod = (
+        make_pod()
+        .name("p")
+        .req({"cpu": "1", "memory": "1Gi"})
+        .container_req({"cpu": "500m"})
+        .init_req({"cpu": "2", "memory": "512Mi"})
+        .overhead({"cpu": "100m"})
+        .obj()
+    )
+    r = compute_pod_resource_request(pod)
+    assert r.milli_cpu == 2000 + 100  # init container dominates cpu; +overhead
+    assert r.memory == 1024**3  # sum of containers dominates memory
+
+
+def test_nonzero_request_defaults():
+    pod = make_pod().name("p").obj()  # no requests
+    r = compute_pod_resource_request_non_zero(pod)
+    assert r.milli_cpu == 100
+    assert r.memory == 200 * 1024 * 1024
+
+
+def test_label_selector():
+    sel = v1.LabelSelector(
+        match_labels={"app": "web"},
+        match_expressions=[
+            v1.LabelSelectorRequirement(key="tier", operator=v1.OP_IN, values=["fe", "be"]),
+            v1.LabelSelectorRequirement(key="legacy", operator=v1.OP_DOES_NOT_EXIST),
+        ],
+    )
+    assert match_label_selector(sel, {"app": "web", "tier": "fe"})
+    assert not match_label_selector(sel, {"app": "web", "tier": "db"})
+    assert not match_label_selector(sel, {"app": "web", "tier": "fe", "legacy": "y"})
+    assert not match_label_selector(None, {"app": "web"})
+    assert match_label_selector(v1.LabelSelector(), {"anything": "x"})
+
+
+def test_node_selector_gt_lt():
+    node = make_node().name("n1").label("zone", "a").label("cores", "16").obj()
+    sel = v1.NodeSelector(
+        node_selector_terms=[
+            v1.NodeSelectorTerm(
+                match_expressions=[
+                    v1.NodeSelectorRequirement(key="cores", operator=v1.OP_GT, values=["8"])
+                ]
+            )
+        ]
+    )
+    assert match_node_selector(sel, node)
+    sel.node_selector_terms[0].match_expressions[0].values = ["32"]
+    assert not match_node_selector(sel, node)
+    # nil selector matches everything
+    assert match_node_selector(None, node)
+
+
+def test_node_selector_terms_or_and_fields():
+    node = make_node().name("n1").label("zone", "a").obj()
+    sel = v1.NodeSelector(
+        node_selector_terms=[
+            v1.NodeSelectorTerm(
+                match_expressions=[
+                    v1.NodeSelectorRequirement(key="zone", operator=v1.OP_IN, values=["b"])
+                ]
+            ),
+            v1.NodeSelectorTerm(
+                match_fields=[
+                    v1.NodeSelectorRequirement(
+                        key="metadata.name", operator=v1.OP_IN, values=["n1"]
+                    )
+                ]
+            ),
+        ]
+    )
+    assert match_node_selector(sel, node)  # second term matches by field
+
+
+def test_tolerations():
+    t_noschedule = Taint(key="k", value="v", effect="NoSchedule")
+    assert Toleration(key="k", operator="Equal", value="v").tolerates(t_noschedule)
+    assert Toleration(key="k", operator="Exists").tolerates(t_noschedule)
+    assert Toleration(operator="Exists").tolerates(t_noschedule)  # empty key+Exists: all
+    assert not Toleration(key="k", operator="Equal", value="x").tolerates(t_noschedule)
+    assert not Toleration(
+        key="k", operator="Equal", value="v", effect="NoExecute"
+    ).tolerates(t_noschedule)
+
+
+def test_from_dict_roundtrip():
+    pod = v1.Pod.from_dict(
+        {
+            "metadata": {"name": "web-1", "namespace": "prod", "labels": {"app": "web"}},
+            "spec": {
+                "schedulerName": "default-scheduler",
+                "priority": 10,
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {"requests": {"cpu": "250m", "memory": "64Mi"}},
+                        "ports": [{"containerPort": 80, "hostPort": 8080}],
+                    }
+                ],
+                "nodeSelector": {"disk": "ssd"},
+                "tolerations": [{"key": "gpu", "operator": "Exists", "effect": "NoSchedule"}],
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "web"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    }
+                },
+                "topologySpreadConstraints": [
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "web"}},
+                    }
+                ],
+            },
+        }
+    )
+    assert pod.key() == "prod/web-1"
+    assert pod.spec.priority == 10
+    assert pod.spec.containers[0].ports[0].host_port == 8080
+    assert pod.spec.affinity.pod_anti_affinity.required[0].topology_key == "kubernetes.io/hostname"
+    assert pod.spec.topology_spread_constraints[0].max_skew == 1
+
+    node = v1.Node.from_dict(
+        {
+            "metadata": {"name": "n1", "labels": {"zone": "us-a"}},
+            "spec": {"taints": [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]},
+            "status": {
+                "capacity": {"cpu": "32", "memory": "128Gi", "pods": "110"},
+                "images": [{"names": ["nginx:1.21"], "sizeBytes": 100000000}],
+            },
+        }
+    )
+    assert node.name == "n1"
+    assert node.spec.taints[0].effect == "NoSchedule"
+    assert node.status.allocatable["cpu"] == "32"
