@@ -1,0 +1,62 @@
+"""String interning: host strings ↔ int32 ids for device tensor programs.
+
+Every string the device path compares (label keys/values, taint keys/values,
+namespaces, node names, image names, topology values, resource names) is interned
+once host-side; device programs only see int32 ids. A parallel float32 side-table
+holds the numeric value of ids whose string parses as an integer, enabling the
+NodeSelector Gt/Lt operators as tensor compares.
+
+Id space: ids start at 0; -1 is the universal "absent / padding" sentinel in all
+encoded arrays (never a valid id).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import numpy as np
+
+MISSING = -1
+
+
+class Dictionary:
+    """Append-only string interner. Thread-compatible with the scheduler's single
+    event-ingest thread (mirrors the single-writer discipline of the reference's
+    scheduler cache, internal/cache/cache.go:62)."""
+
+    def __init__(self):
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+        self._numeric: List[float] = []
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is not None:
+            return i
+        i = len(self._to_str)
+        self._to_id[s] = i
+        self._to_str.append(s)
+        try:
+            self._numeric.append(float(int(s)))
+        except ValueError:
+            self._numeric.append(math.nan)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Id of s, or MISSING if never interned (read-only: does not grow)."""
+        return self._to_id.get(s, MISSING)
+
+    def string(self, i: int) -> str:
+        return self._to_str[i]
+
+    def numeric_table(self, min_size: int = 1) -> np.ndarray:
+        """float32[num_ids] — numeric value per id (NaN when non-integer)."""
+        n = max(len(self._numeric), min_size)
+        t = np.full((n,), np.nan, dtype=np.float32)
+        if self._numeric:
+            t[: len(self._numeric)] = np.asarray(self._numeric, dtype=np.float32)
+        return t

@@ -1,0 +1,437 @@
+"""Struct-of-arrays encoding of cluster state for the device compute path.
+
+The reference's per-cycle inputs are Go structs walked by 16 goroutines
+(scheduler.go:983-1023). Here the snapshot is mirrored into padded, fixed-shape
+int32/float32 arrays (host numpy), incrementally updated from the cache's
+changed-node list (the analog of cache.go:197-276 generation snapshotting), and
+uploaded to device either as whole buffers or as row-scatter updates — so a 100k-node
+cluster does not re-upload per cycle.
+
+Shape discipline (XLA static shapes): capacities are rounded up to powers of two and
+grown by doubling, so recompilation happens O(log n) times over a cluster's life.
+
+Encoded semantic notes:
+- node "metadata.name" and "kubernetes.io/hostname" are injected as labels so
+  matchFields and hostname topology work uniformly.
+- host ports are encoded as proto*2^16+port; the device filter treats equal
+  (proto, port) as a conflict regardless of hostIP (conservative vs the reference's
+  HostPortInfo wildcard rules — exact IP semantics stay on the host oracle path).
+- taint effects: NoSchedule=0, PreferNoSchedule=1, NoExecute=2.
+- resource units per state/units.py; requests ceil, allocatable floor; a pod's
+  "pods" dimension request is always 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import objects as v1
+from ..api.resource import (
+    Resource,
+    compute_pod_resource_request,
+    compute_pod_resource_request_non_zero,
+)
+from .cache import Snapshot
+from .dictionary import MISSING, Dictionary
+from .node_info import NodeInfo
+from . import units
+
+EFFECT_CODE = {
+    v1.TAINT_NO_SCHEDULE: 0,
+    v1.TAINT_PREFER_NO_SCHEDULE: 1,
+    v1.TAINT_NO_EXECUTE: 2,
+}
+_PROTO_CODE = {"TCP": 0, "UDP": 1, "SCTP": 2}
+
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+
+def _pow2(n: int, minimum: int = 8) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class EncodingConfig:
+    min_nodes: int = 64
+    min_pods: int = 256
+    label_cap: int = 16
+    pod_label_cap: int = 8
+    taint_cap: int = 8
+    port_cap: int = 8
+    image_cap: int = 8
+    extended_resource_cap: int = 4  # spare scalar-resource dims beyond the base 4
+
+    @property
+    def num_resource_dims(self) -> int:
+        return units.NUM_BASE_DIMS + self.extended_resource_cap
+
+
+class EncodingCapacityError(Exception):
+    """A per-object cap (labels/taints/ports/images/extended resources) overflowed.
+
+    Raise rather than truncate: silent truncation would corrupt filter semantics.
+    Callers raise the cap in EncodingConfig.
+    """
+
+
+@dataclass
+class DeviceSnapshot:
+    """The jnp view handed to plugin tensor programs (all shapes static)."""
+
+    # nodes
+    node_valid: jnp.ndarray  # bool[N]
+    allocatable: jnp.ndarray  # i32[N, R]
+    requested: jnp.ndarray  # i32[N, R]
+    non_zero_requested: jnp.ndarray  # i32[N, 2] (cpu milli, mem KiB)
+    node_label_keys: jnp.ndarray  # i32[N, L]
+    node_label_vals: jnp.ndarray  # i32[N, L]
+    taint_keys: jnp.ndarray  # i32[N, T]
+    taint_vals: jnp.ndarray  # i32[N, T]
+    taint_effects: jnp.ndarray  # i32[N, T] (-1 pad)
+    ports: jnp.ndarray  # i32[N, P] (proto<<16 | port, -1 pad)
+    image_ids: jnp.ndarray  # i32[N, I]
+    image_sizes: jnp.ndarray  # f32[N, I] bytes
+    unschedulable: jnp.ndarray  # bool[N]
+    # scheduled pods
+    pod_valid: jnp.ndarray  # bool[P]
+    pod_node: jnp.ndarray  # i32[P] (-1 when unknown)
+    pod_ns: jnp.ndarray  # i32[P]
+    pod_label_keys: jnp.ndarray  # i32[P, PL]
+    pod_label_vals: jnp.ndarray  # i32[P, PL]
+    pod_priority: jnp.ndarray  # i32[P]
+    pod_request: jnp.ndarray  # i32[P, R]
+    pod_non_zero: jnp.ndarray  # i32[P, 2]
+    # dictionary numeric side-table
+    numeric: jnp.ndarray  # f32[num_ids]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_valid.shape[0]
+
+    @property
+    def num_pods(self) -> int:
+        return self.pod_valid.shape[0]
+
+
+class ClusterEncoder:
+    """Maintains host numpy mirrors + device buffers; applies incremental updates."""
+
+    def __init__(self, dic: Optional[Dictionary] = None, cfg: Optional[EncodingConfig] = None):
+        self.dic = dic or Dictionary()
+        self.cfg = cfg or EncodingConfig()
+        self.extended_index: Dict[str, int] = {}
+        self.node_rows: Dict[str, int] = {}
+        self._free_node_rows: List[int] = []
+        self.pod_rows: Dict[str, int] = {}  # pod uid -> row
+        self._free_pod_rows: List[int] = []
+        self._pods_by_node: Dict[str, List[str]] = {}  # node name -> pod uids
+        self._n = self.cfg.min_nodes
+        self._p = self.cfg.min_pods
+        self._alloc_arrays()
+        self._device: Optional[DeviceSnapshot] = None
+        self._dirty_node_rows: set = set()
+        self._dirty_pod_rows: set = set()
+        self._shape_changed = True
+
+    # --- allocation ---------------------------------------------------------
+
+    def _alloc_arrays(self):
+        n, p, cfg = self._n, self._p, self.cfg
+        r = cfg.num_resource_dims
+        self.node_valid = np.zeros(n, dtype=bool)
+        self.allocatable = np.zeros((n, r), dtype=np.int32)
+        self.requested = np.zeros((n, r), dtype=np.int32)
+        self.non_zero_requested = np.zeros((n, 2), dtype=np.int32)
+        self.node_label_keys = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
+        self.node_label_vals = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
+        self.taint_keys = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
+        self.taint_vals = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
+        self.taint_effects = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
+        self.ports = np.full((n, cfg.port_cap), MISSING, dtype=np.int32)
+        self.image_ids = np.full((n, cfg.image_cap), MISSING, dtype=np.int32)
+        self.image_sizes = np.zeros((n, cfg.image_cap), dtype=np.float32)
+        self.unschedulable = np.zeros(n, dtype=bool)
+        self.pod_valid = np.zeros(p, dtype=bool)
+        self.pod_node = np.full(p, MISSING, dtype=np.int32)
+        self.pod_ns = np.full(p, MISSING, dtype=np.int32)
+        self.pod_label_keys = np.full((p, cfg.pod_label_cap), MISSING, dtype=np.int32)
+        self.pod_label_vals = np.full((p, cfg.pod_label_cap), MISSING, dtype=np.int32)
+        self.pod_priority = np.zeros(p, dtype=np.int32)
+        self.pod_request = np.zeros((p, r), dtype=np.int32)
+        self.pod_non_zero = np.zeros((p, 2), dtype=np.int32)
+
+    def _grow_nodes(self, need: int):
+        old = {k: getattr(self, k).copy() for k in _NODE_ARRAYS}
+        self._n = _pow2(need, self._n * 2)
+        p_save = {k: getattr(self, k) for k in _POD_ARRAYS}
+        self._alloc_arrays()
+        for k, v in old.items():
+            getattr(self, k)[: v.shape[0]] = v
+        for k, v in p_save.items():
+            setattr(self, k, v)
+        self._shape_changed = True
+
+    def _grow_pods(self, need: int):
+        old = {k: getattr(self, k).copy() for k in _POD_ARRAYS}
+        self._p = _pow2(need, self._p * 2)
+        n_save = {k: getattr(self, k) for k in _NODE_ARRAYS}
+        self._alloc_arrays()
+        for k, v in old.items():
+            getattr(self, k)[: v.shape[0]] = v
+        for k, v in n_save.items():
+            setattr(self, k, v)
+        self._shape_changed = True
+
+    # --- resource helpers ----------------------------------------------------
+
+    def _resource_units(self, r: Resource, ceil: bool) -> List[int]:
+        for name in r.scalar_resources:
+            if name not in self.extended_index:
+                idx = units.NUM_BASE_DIMS + len(self.extended_index)
+                if idx >= self.cfg.num_resource_dims:
+                    raise EncodingCapacityError(
+                        f"too many extended resources (cap "
+                        f"{self.cfg.extended_resource_cap}): {name}"
+                    )
+                self.extended_index[name] = idx
+        return units.resource_to_units(
+            r, self.cfg.num_resource_dims, self.extended_index, ceil=ceil
+        )
+
+    def pod_request_units(self, pod: v1.Pod) -> np.ndarray:
+        """i32[R] request vector for a pod (pods dim = 1)."""
+        r = compute_pod_resource_request(pod)
+        vec = self._resource_units(r, ceil=True)
+        vec[units.DIM_PODS] = 1
+        return np.asarray(vec, dtype=np.int32)
+
+    def pod_non_zero_units(self, pod: v1.Pod) -> np.ndarray:
+        r = compute_pod_resource_request_non_zero(pod)
+        vec = self._resource_units(r, ceil=True)
+        return np.asarray([vec[units.DIM_CPU], vec[units.DIM_MEMORY]], dtype=np.int32)
+
+    # --- label encoding ------------------------------------------------------
+
+    def _encode_labels(self, labels: Dict[str, str], cap: int, what: str):
+        if len(labels) > cap:
+            raise EncodingCapacityError(
+                f"{what} has {len(labels)} labels > cap {cap}; raise EncodingConfig"
+            )
+        keys = np.full(cap, MISSING, dtype=np.int32)
+        vals = np.full(cap, MISSING, dtype=np.int32)
+        for i, (k, val) in enumerate(labels.items()):
+            keys[i] = self.dic.intern(k)
+            vals[i] = self.dic.intern(val)
+        return keys, vals
+
+    # --- node encoding -------------------------------------------------------
+
+    def encode_node(self, info: NodeInfo) -> int:
+        """(Re-)encode one NodeInfo into its row; returns the row index."""
+        name = info.node_name
+        row = self.node_rows.get(name)
+        if row is None:
+            if self._free_node_rows:
+                row = self._free_node_rows.pop()
+            else:
+                row = len(self.node_rows)
+                if row >= self._n:
+                    self._grow_nodes(row + 1)
+            self.node_rows[name] = row
+        node = info.node
+        cfg = self.cfg
+        labels = dict(node.metadata.labels)
+        labels.setdefault(HOSTNAME_LABEL, name)
+        labels["metadata.name"] = name
+        lk, lv = self._encode_labels(labels, cfg.label_cap, f"node {name}")
+        self.node_label_keys[row] = lk
+        self.node_label_vals[row] = lv
+
+        self.node_valid[row] = True
+        self.unschedulable[row] = node.spec.unschedulable
+        self.allocatable[row] = self._resource_units(info.allocatable, ceil=False)
+        self.requested[row] = self._resource_units(info.requested, ceil=True)
+        # pods dimension of "requested" = live pod count
+        self.requested[row, units.DIM_PODS] = len(info.pods)
+        nz = self._resource_units(info.non_zero_requested, ceil=True)
+        self.non_zero_requested[row] = (nz[units.DIM_CPU], nz[units.DIM_MEMORY])
+
+        if len(node.spec.taints) > cfg.taint_cap:
+            raise EncodingCapacityError(f"node {name}: too many taints")
+        self.taint_keys[row] = MISSING
+        self.taint_vals[row] = MISSING
+        self.taint_effects[row] = MISSING
+        for i, t in enumerate(node.spec.taints):
+            self.taint_keys[row, i] = self.dic.intern(t.key)
+            self.taint_vals[row, i] = self.dic.intern(t.value)
+            self.taint_effects[row, i] = EFFECT_CODE.get(t.effect, 0)
+
+        ports = sorted(
+            {_PROTO_CODE.get(proto, 0) * 65536 + port for (_ip, proto, port) in info.used_ports}
+        )
+        if len(ports) > cfg.port_cap:
+            raise EncodingCapacityError(f"node {name}: too many host ports")
+        self.ports[row] = MISSING
+        self.ports[row, : len(ports)] = ports
+
+        self.image_ids[row] = MISSING
+        self.image_sizes[row] = 0.0
+        img_items = list(info.image_states.items())
+        if len(img_items) > cfg.image_cap:
+            # images beyond the cap only weaken ImageLocality scoring; keep largest
+            img_items.sort(key=lambda kv: -kv[1])
+            img_items = img_items[: cfg.image_cap]
+        for i, (img, size) in enumerate(img_items):
+            self.image_ids[row, i] = self.dic.intern(img)
+            self.image_sizes[row, i] = float(size)
+
+        self._dirty_node_rows.add(row)
+        return row
+
+    def remove_node(self, name: str):
+        row = self.node_rows.pop(name, None)
+        if row is None:
+            return
+        self.node_valid[row] = False
+        self._free_node_rows.append(row)
+        self._dirty_node_rows.add(row)
+        for uid in self._pods_by_node.pop(name, []):
+            self._remove_pod_row(uid)
+
+    # --- scheduled-pod encoding ---------------------------------------------
+
+    def _encode_pod(self, pod: v1.Pod, node_row: int) -> int:
+        uid = pod.uid
+        row = self.pod_rows.get(uid)
+        if row is None:
+            if self._free_pod_rows:
+                row = self._free_pod_rows.pop()
+            else:
+                row = len(self.pod_rows)
+                if row >= self._p:
+                    self._grow_pods(row + 1)
+            self.pod_rows[uid] = row
+        cfg = self.cfg
+        lk, lv = self._encode_labels(
+            pod.metadata.labels, cfg.pod_label_cap, f"pod {pod.key()}"
+        )
+        self.pod_label_keys[row] = lk
+        self.pod_label_vals[row] = lv
+        self.pod_valid[row] = True
+        self.pod_node[row] = node_row
+        self.pod_ns[row] = self.dic.intern(pod.namespace)
+        self.pod_priority[row] = pod.spec.priority
+        self.pod_request[row] = self.pod_request_units(pod)
+        self.pod_non_zero[row] = self.pod_non_zero_units(pod)
+        self._dirty_pod_rows.add(row)
+        return row
+
+    def _remove_pod_row(self, uid: str):
+        row = self.pod_rows.pop(uid, None)
+        if row is None:
+            return
+        self.pod_valid[row] = False
+        self._free_pod_rows.append(row)
+        self._dirty_pod_rows.add(row)
+
+    # --- snapshot sync -------------------------------------------------------
+
+    def sync(self, snapshot: Snapshot, changed_nodes: Sequence[str]):
+        """Apply a cache snapshot refresh: re-encode changed nodes + their pods."""
+        for name in changed_nodes:
+            info = snapshot.node_info_map.get(name)
+            if info is None:
+                self.remove_node(name)
+                continue
+            row = self.encode_node(info)
+            new_uids = {pi.pod.uid for pi in info.pods}
+            for uid in self._pods_by_node.get(name, []):
+                if uid not in new_uids:
+                    self._remove_pod_row(uid)
+            for pi in info.pods:
+                self._encode_pod(pi.pod, row)
+            self._pods_by_node[name] = list(new_uids)
+
+    def full_sync(self, snapshot: Snapshot):
+        self.sync(snapshot, [n.node_name for n in snapshot.node_info_list])
+
+    # --- device upload -------------------------------------------------------
+
+    def to_device(self, sharding=None) -> DeviceSnapshot:
+        """Upload: full device_put when shapes changed or dirt is large, else
+        row-scatter updates into the existing buffers (double-buffering is XLA's
+        job via donated args in the jitted updater)."""
+        import jax
+
+        numeric = self.dic.numeric_table(min_size=1024)
+        n_num = _pow2(numeric.shape[0], 1024)
+        numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
+
+        dirty_frac = (
+            (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
+            / max(self._n + self._p, 1)
+        )
+        use_scatter = (
+            self._device is not None
+            and not self._shape_changed
+            and self._device.numeric.shape[0] == n_num
+            and dirty_frac < 0.5
+        )
+        if not use_scatter:
+            put = (lambda x: jax.device_put(x, sharding)) if sharding else jnp.asarray
+            self._device = DeviceSnapshot(
+                **{k: put(getattr(self, k)) for k in _NODE_ARRAYS + _POD_ARRAYS},
+                numeric=jnp.asarray(numeric),
+            )
+        else:
+            d = self._device
+            if self._dirty_node_rows:
+                rows = np.asarray(sorted(self._dirty_node_rows), dtype=np.int32)
+                upd = {
+                    k: getattr(d, k).at[rows].set(getattr(self, k)[rows])
+                    for k in _NODE_ARRAYS
+                }
+            else:
+                upd = {k: getattr(d, k) for k in _NODE_ARRAYS}
+            if self._dirty_pod_rows:
+                prows = np.asarray(sorted(self._dirty_pod_rows), dtype=np.int32)
+                upd.update(
+                    {
+                        k: getattr(d, k).at[prows].set(getattr(self, k)[prows])
+                        for k in _POD_ARRAYS
+                    }
+                )
+            else:
+                upd.update({k: getattr(d, k) for k in _POD_ARRAYS})
+            self._device = DeviceSnapshot(**upd, numeric=d.numeric)
+        self._dirty_node_rows.clear()
+        self._dirty_pod_rows.clear()
+        self._shape_changed = False
+        return self._device
+
+    def node_name_of_row(self, row: int) -> Optional[str]:
+        for name, r in self.node_rows.items():
+            if r == row:
+                return name
+        return None
+
+    def row_to_name(self) -> Dict[int, str]:
+        return {r: name for name, r in self.node_rows.items()}
+
+
+_NODE_ARRAYS = [
+    "node_valid", "allocatable", "requested", "non_zero_requested",
+    "node_label_keys", "node_label_vals", "taint_keys", "taint_vals",
+    "taint_effects", "ports", "image_ids", "image_sizes", "unschedulable",
+]
+_POD_ARRAYS = [
+    "pod_valid", "pod_node", "pod_ns", "pod_label_keys", "pod_label_vals",
+    "pod_priority", "pod_request", "pod_non_zero",
+]

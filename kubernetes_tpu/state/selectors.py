@@ -1,0 +1,267 @@
+"""Selector compilation: label/node selectors → int32 tensor programs.
+
+The reference evaluates selectors per (pod, node/pod) pair in Go
+(apimachinery labels.Selector; component-helpers nodeaffinity). Here a batch of
+selectors is *compiled once* host-side into padded int32 arrays, and evaluation is a
+pure jnp function over dictionary-encoded label arrays — vmap/jit-able along both
+the selector batch and the node/pod axes, so a whole ``[pods, nodes]`` or
+``[terms, pods]`` match matrix is one fused device program.
+
+Encoding (MISSING = -1 is the universal pad):
+  requirement ops: IN=0 NOT_IN=1 EXISTS=2 DOES_NOT_EXIST=3 GT=4 LT=5, PAD=-1
+  a padded requirement row is the AND-identity (always true)
+  a LabelSelector with match_none=True matches nothing (the None selector)
+  a NodeSelector with match_all=True matches everything (the nil selector);
+  otherwise OR over valid terms, AND over each term's requirements
+  matchFields(metadata.name) is handled by interning the node name as a
+  pseudo-label under the key "metadata.name" at node-encoding time.
+
+Conservative-capacity note: S (requirements/term), V (values/requirement) and T
+(terms) are sized to the max present in the compiled batch, rounded up to powers of
+two to bound XLA recompiles; nothing is silently truncated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import objects as v1
+from .dictionary import MISSING, Dictionary
+
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_DOES_NOT_EXIST = 3
+OP_GT = 4
+OP_LT = 5
+OP_PAD = -1
+
+_OP_CODE = {
+    v1.OP_IN: OP_IN,
+    v1.OP_NOT_IN: OP_NOT_IN,
+    v1.OP_EXISTS: OP_EXISTS,
+    v1.OP_DOES_NOT_EXIST: OP_DOES_NOT_EXIST,
+    v1.OP_GT: OP_GT,
+    v1.OP_LT: OP_LT,
+}
+
+
+def _round_up(n: int, minimum: int) -> int:
+    n = max(n, minimum)
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class CompiledLabelSelectors:
+    """Batch of B compiled metav1.LabelSelectors.
+
+    req_key  i32[B, S]; req_op i32[B, S]; req_vals i32[B, S, V]
+    req_num  f32[B, S]  — numeric RHS for Gt/Lt (NaN when unparseable)
+    match_none bool[B]  — True for the None selector (matches nothing)
+    """
+
+    req_key: np.ndarray
+    req_op: np.ndarray
+    req_vals: np.ndarray
+    req_num: np.ndarray
+    match_none: np.ndarray
+
+    def __len__(self):
+        return self.req_key.shape[0]
+
+
+@dataclass
+class CompiledNodeSelectors:
+    """Batch of B compiled v1.NodeSelectors (terms OR, requirements AND).
+
+    req_key i32[B, T, S]; req_op i32[B, T, S]; req_vals i32[B, T, S, V]
+    req_num f32[B, T, S]; term_valid bool[B, T]; match_all bool[B]
+    """
+
+    req_key: np.ndarray
+    req_op: np.ndarray
+    req_vals: np.ndarray
+    req_num: np.ndarray
+    term_valid: np.ndarray
+    match_all: np.ndarray
+
+    def __len__(self):
+        return self.req_key.shape[0]
+
+
+def _selector_requirements(sel: v1.LabelSelector):
+    """Flatten matchLabels + matchExpressions into (key, op, values) triples."""
+    reqs = []
+    for k, val in sorted(sel.match_labels.items()):
+        reqs.append((k, v1.OP_IN, [val]))
+    for e in sel.match_expressions:
+        reqs.append((e.key, e.operator, list(e.values)))
+    return reqs
+
+
+def compile_label_selectors(
+    selectors: Sequence[Optional[v1.LabelSelector]],
+    dic: Dictionary,
+    min_s: int = 4,
+    min_v: int = 4,
+) -> CompiledLabelSelectors:
+    b = max(len(selectors), 1)
+    req_lists = [
+        _selector_requirements(s) if s is not None else [] for s in selectors
+    ]
+    s_cap = _round_up(max((len(r) for r in req_lists), default=0), min_s)
+    v_cap = _round_up(
+        max((len(vals) for reqs in req_lists for (_, _, vals) in reqs), default=0),
+        min_v,
+    )
+    req_key = np.full((b, s_cap), MISSING, dtype=np.int32)
+    req_op = np.full((b, s_cap), OP_PAD, dtype=np.int32)
+    req_vals = np.full((b, s_cap, v_cap), MISSING, dtype=np.int32)
+    req_num = np.full((b, s_cap), np.nan, dtype=np.float32)
+    match_none = np.zeros((b,), dtype=bool)
+    for i, sel in enumerate(selectors):
+        if sel is None:
+            match_none[i] = True
+            continue
+        for j, (key, op, vals) in enumerate(req_lists[i]):
+            req_key[i, j] = dic.intern(key)
+            req_op[i, j] = _OP_CODE[op]
+            for k, val in enumerate(vals):
+                req_vals[i, j, k] = dic.intern(val)
+            if vals:
+                try:
+                    req_num[i, j] = float(int(vals[0]))
+                except ValueError:
+                    pass
+    return CompiledLabelSelectors(req_key, req_op, req_vals, req_num, match_none)
+
+
+def compile_node_selectors(
+    selectors: Sequence[Optional[v1.NodeSelector]],
+    dic: Dictionary,
+    min_t: int = 2,
+    min_s: int = 4,
+    min_v: int = 4,
+) -> CompiledNodeSelectors:
+    b = max(len(selectors), 1)
+    all_terms: List[List[List]] = []
+    for s in selectors:
+        terms = []
+        if s is not None:
+            for t in s.node_selector_terms:
+                reqs = [(e.key, e.operator, list(e.values)) for e in t.match_expressions]
+                reqs += [
+                    ("metadata.name" if e.key in ("metadata.name", "name") else e.key,
+                     e.operator, list(e.values))
+                    for e in t.match_fields
+                ]
+                terms.append(reqs)
+        all_terms.append(terms)
+    t_cap = _round_up(max((len(t) for t in all_terms), default=0), min_t)
+    s_cap = _round_up(
+        max((len(r) for terms in all_terms for r in terms), default=0), min_s
+    )
+    v_cap = _round_up(
+        max(
+            (len(vals) for terms in all_terms for reqs in terms for (_, _, vals) in reqs),
+            default=0,
+        ),
+        min_v,
+    )
+    req_key = np.full((b, t_cap, s_cap), MISSING, dtype=np.int32)
+    req_op = np.full((b, t_cap, s_cap), OP_PAD, dtype=np.int32)
+    req_vals = np.full((b, t_cap, s_cap, v_cap), MISSING, dtype=np.int32)
+    req_num = np.full((b, t_cap, s_cap), np.nan, dtype=np.float32)
+    term_valid = np.zeros((b, t_cap), dtype=bool)
+    match_all = np.zeros((b,), dtype=bool)
+    for i, sel in enumerate(selectors):
+        if sel is None:
+            match_all[i] = True
+            continue
+        for ti, reqs in enumerate(all_terms[i]):
+            # Reference: an empty term matches nothing → leave term_valid False
+            # only for terms with no requirements at all.
+            term_valid[i, ti] = len(reqs) > 0
+            for j, (key, op, vals) in enumerate(reqs):
+                req_key[i, ti, j] = dic.intern(key)
+                req_op[i, ti, j] = _OP_CODE[op]
+                for k, val in enumerate(vals):
+                    req_vals[i, ti, j, k] = dic.intern(val)
+                if vals:
+                    try:
+                        req_num[i, ti, j] = float(int(vals[0]))
+                    except ValueError:
+                        pass
+    return CompiledNodeSelectors(
+        req_key, req_op, req_vals, req_num, term_valid, match_all
+    )
+
+
+# --- device evaluation (pure jnp; jit/vmap-compatible) ----------------------
+
+
+def eval_requirements(req_key, req_op, req_vals, req_num, keys, vals, numeric):
+    """AND of one selector's requirements against one label set.
+
+    req_key/req_op [S], req_vals [S, V], req_num [S]; keys/vals [L] (-1 padded);
+    numeric f32[num_ids] — dictionary numeric side-table. Returns scalar bool.
+    Broadcasts cleanly under vmap along both selector and label-set axes.
+    """
+    key_match = (keys[None, :] == req_key[:, None]) & (req_key[:, None] >= 0)  # [S, L]
+    present = jnp.any(key_match, axis=1)
+    # Label keys are unique per object → at most one column matches.
+    val = jnp.max(jnp.where(key_match, vals[None, :], MISSING), axis=1)  # [S]
+    in_vals = jnp.any((req_vals == val[:, None]) & (val[:, None] >= 0), axis=1)
+    safe_val = jnp.clip(val, 0, numeric.shape[0] - 1)
+    val_num = numeric[safe_val]
+    gt = present & (val_num > req_num)  # NaN compares → False
+    lt = present & (val_num < req_num)
+    results = jnp.stack(
+        [
+            present & in_vals,  # IN
+            (~present) | (~in_vals),  # NOT_IN (absent key matches)
+            present,  # EXISTS
+            ~present,  # DOES_NOT_EXIST
+            gt,  # GT
+            lt,  # LT
+        ],
+        axis=0,
+    )  # [6, S]
+    op = jnp.clip(req_op, 0, 5)
+    picked = jnp.take_along_axis(results, op[None, :], axis=0)[0]  # [S]
+    ok = jnp.where(req_op == OP_PAD, True, picked)
+    return jnp.all(ok)
+
+
+def eval_label_selector(sel: CompiledLabelSelectors, i, keys, vals, numeric):
+    """Selector i of the batch vs one label set → bool (use under vmap/jit).
+
+    Arrays go through jnp.asarray so i may be a tracer (vmap over the batch axis).
+    """
+    return (~jnp.asarray(sel.match_none)[i]) & eval_requirements(
+        jnp.asarray(sel.req_key)[i],
+        jnp.asarray(sel.req_op)[i],
+        jnp.asarray(sel.req_vals)[i],
+        jnp.asarray(sel.req_num)[i],
+        keys, vals, numeric,
+    )
+
+
+def eval_node_selector_arrays(
+    req_key, req_op, req_vals, req_num, term_valid, match_all, keys, vals, numeric
+):
+    """One compiled NodeSelector (term arrays [T, S, ...]) vs one label set → bool."""
+    import jax
+
+    per_term = jax.vmap(
+        lambda rk, ro, rv, rn: eval_requirements(rk, ro, rv, rn, keys, vals, numeric)
+    )(req_key, req_op, req_vals, req_num)  # [T]
+    any_term = jnp.any(per_term & term_valid)
+    return match_all | any_term

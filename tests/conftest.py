@@ -13,3 +13,5 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon TPU-tunnel environment pins JAX_PLATFORMS; JAX_PLATFORM_NAME still wins.
+os.environ["JAX_PLATFORM_NAME"] = "cpu"

@@ -1,0 +1,182 @@
+"""Compiled-selector tensor programs vs the host-side oracle (labels.py)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.labels import match_label_selector, match_node_selector
+from kubernetes_tpu.state.dictionary import MISSING, Dictionary
+from kubernetes_tpu.state import selectors as sel
+from kubernetes_tpu.testutil import make_node
+
+
+def encode_labels(labels, dic, cap=8):
+    keys = np.full((cap,), MISSING, dtype=np.int32)
+    vals = np.full((cap,), MISSING, dtype=np.int32)
+    for i, (k, v) in enumerate(labels.items()):
+        keys[i] = dic.intern(k)
+        vals[i] = dic.intern(v)
+    return keys, vals
+
+
+def random_label_selector(rng, keys, values):
+    kind = rng.randrange(4)
+    if kind == 0:
+        return None
+    s = v1.LabelSelector()
+    for _ in range(rng.randrange(3)):
+        s.match_labels[rng.choice(keys)] = rng.choice(values)
+    for _ in range(rng.randrange(3)):
+        op = rng.choice([v1.OP_IN, v1.OP_NOT_IN, v1.OP_EXISTS, v1.OP_DOES_NOT_EXIST])
+        s.match_expressions.append(
+            v1.LabelSelectorRequirement(
+                key=rng.choice(keys),
+                operator=op,
+                values=[rng.choice(values) for _ in range(rng.randrange(1, 3))]
+                if op in (v1.OP_IN, v1.OP_NOT_IN)
+                else [],
+            )
+        )
+    return s
+
+
+def test_label_selector_matrix_vs_oracle():
+    rng = random.Random(7)
+    keys = ["app", "tier", "env", "team"]
+    values = ["a", "b", "c", "d"]
+    selectors = [random_label_selector(rng, keys, values) for _ in range(40)]
+    label_sets = [
+        {k: rng.choice(values) for k in rng.sample(keys, rng.randrange(len(keys) + 1))}
+        for _ in range(25)
+    ]
+    dic = Dictionary()
+    compiled = sel.compile_label_selectors(selectors, dic)
+    enc = [encode_labels(ls, dic) for ls in label_sets]
+    keys_arr = jnp.asarray(np.stack([e[0] for e in enc]))
+    vals_arr = jnp.asarray(np.stack([e[1] for e in enc]))
+    numeric = jnp.asarray(dic.numeric_table())
+
+    # full [selectors, label_sets] matrix in one jitted program
+    @jax.jit
+    def matrix(keys_arr, vals_arr, numeric):
+        def one_sel(i):
+            return jax.vmap(
+                lambda k, vv: sel.eval_label_selector(compiled, i, k, vv, numeric)
+            )(keys_arr, vals_arr)
+
+        return jax.vmap(one_sel)(jnp.arange(len(selectors)))
+
+    got = np.asarray(matrix(keys_arr, vals_arr, numeric))
+    for i, s in enumerate(selectors):
+        for j, ls in enumerate(label_sets):
+            want = match_label_selector(s, ls)
+            assert got[i, j] == want, (i, j, s, ls)
+
+
+def random_node_selector(rng, keys, values):
+    kind = rng.randrange(5)
+    if kind == 0:
+        return None
+    ns = v1.NodeSelector()
+    for _ in range(rng.randrange(3)):
+        term = v1.NodeSelectorTerm()
+        for _ in range(rng.randrange(3)):
+            op = rng.choice(
+                [v1.OP_IN, v1.OP_NOT_IN, v1.OP_EXISTS, v1.OP_DOES_NOT_EXIST, v1.OP_GT, v1.OP_LT]
+            )
+            if op in (v1.OP_GT, v1.OP_LT):
+                vals = [str(rng.randrange(20))]
+                key = "num"
+            else:
+                vals = (
+                    [rng.choice(values) for _ in range(rng.randrange(1, 3))]
+                    if op in (v1.OP_IN, v1.OP_NOT_IN)
+                    else []
+                )
+                key = rng.choice(keys)
+            term.match_expressions.append(
+                v1.NodeSelectorRequirement(key=key, operator=op, values=vals)
+            )
+        ns.node_selector_terms.append(term)
+    return ns
+
+
+def test_node_selector_matrix_vs_oracle():
+    rng = random.Random(11)
+    keys = ["zone", "disk", "arch"]
+    values = ["a", "b", "ssd", "arm"]
+    selectors = [random_node_selector(rng, keys, values) for _ in range(40)]
+    nodes = []
+    for i in range(20):
+        n = make_node().name(f"n{i}").obj()
+        for k in rng.sample(keys, rng.randrange(len(keys) + 1)):
+            n.metadata.labels[k] = rng.choice(values)
+        if rng.random() < 0.7:
+            n.metadata.labels["num"] = str(rng.randrange(20))
+        nodes.append(n)
+
+    dic = Dictionary()
+    compiled = sel.compile_node_selectors(selectors, dic)
+    c_req_key = jnp.asarray(compiled.req_key)
+    c_req_op = jnp.asarray(compiled.req_op)
+    c_req_vals = jnp.asarray(compiled.req_vals)
+    c_req_num = jnp.asarray(compiled.req_num)
+    c_term_valid = jnp.asarray(compiled.term_valid)
+    c_match_all = jnp.asarray(compiled.match_all)
+    # node name as pseudo-label supports matchFields
+    enc = []
+    for n in nodes:
+        labels = dict(n.metadata.labels)
+        labels["metadata.name"] = n.metadata.name
+        enc.append(encode_labels(labels, dic))
+    keys_arr = jnp.asarray(np.stack([e[0] for e in enc]))
+    vals_arr = jnp.asarray(np.stack([e[1] for e in enc]))
+    numeric = jnp.asarray(dic.numeric_table())
+
+    @jax.jit
+    def matrix(keys_arr, vals_arr, numeric):
+        def one_sel(i):
+            return jax.vmap(
+                lambda k, vv: sel.eval_node_selector_arrays(
+                    c_req_key[i], c_req_op[i], c_req_vals[i],
+                    c_req_num[i], c_term_valid[i], c_match_all[i],
+                    k, vv, numeric,
+                )
+            )(keys_arr, vals_arr)
+
+        return jax.vmap(one_sel)(jnp.arange(len(selectors)))
+
+    got = np.asarray(matrix(keys_arr, vals_arr, numeric))
+    for i, s in enumerate(selectors):
+        for j, n in enumerate(nodes):
+            want = match_node_selector(s, n)
+            assert got[i, j] == want, (i, j, s, n.metadata.labels)
+
+
+def test_match_fields_compiles():
+    dic = Dictionary()
+    ns = v1.NodeSelector(
+        node_selector_terms=[
+            v1.NodeSelectorTerm(
+                match_fields=[
+                    v1.NodeSelectorRequirement(
+                        key="metadata.name", operator=v1.OP_IN, values=["n1"]
+                    )
+                ]
+            )
+        ]
+    )
+    compiled = sel.compile_node_selectors([ns], dic)
+    n1 = make_node().name("n1").obj()
+    labels = {"metadata.name": "n1"}
+    keys, vals = encode_labels(labels, dic)
+    numeric = jnp.asarray(dic.numeric_table())
+    got = sel.eval_node_selector_arrays(
+        compiled.req_key[0], compiled.req_op[0], compiled.req_vals[0],
+        compiled.req_num[0], compiled.term_valid[0], compiled.match_all[0],
+        jnp.asarray(keys), jnp.asarray(vals), numeric,
+    )
+    assert bool(got) == match_node_selector(ns, n1) == True  # noqa: E712

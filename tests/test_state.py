@@ -1,0 +1,151 @@
+"""Cache (assume lifecycle, O(changed) snapshot) and device encoding tests."""
+
+import numpy as np
+
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.state.encoding import ClusterEncoder, EncodingConfig
+from kubernetes_tpu.state import units
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def _cluster(n=4):
+    cache = Cache()
+    for i in range(n):
+        cache.add_node(
+            make_node().name(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": "110"})
+            .label("zone", f"z{i % 2}").obj()
+        )
+    return cache
+
+
+def test_snapshot_incremental():
+    cache = _cluster(4)
+    snap = Snapshot()
+    changed = cache.update_snapshot(snap)
+    assert sorted(changed) == ["n0", "n1", "n2", "n3"]
+    assert snap.num_nodes() == 4
+
+    # no changes -> no churn
+    assert cache.update_snapshot(snap) == []
+
+    # add a pod to n1 only -> only n1 changes
+    p = make_pod().name("p1").uid("u1").req({"cpu": "2"}).obj()
+    cache.assume_pod(p, "n1")
+    assert cache.update_snapshot(snap) == ["n1"]
+    assert snap.get("n1").requested.milli_cpu == 2000
+
+    # remove node
+    cache.remove_node("n3")
+    changed = cache.update_snapshot(snap)
+    assert "n3" in changed and snap.num_nodes() == 3
+
+
+def test_assume_forget_expire():
+    cache = _cluster(1)
+    p = make_pod().name("p").uid("up").req({"cpu": "1"}).obj()
+    cache.assume_pod(p, "n0")
+    assert cache.is_assumed(p)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    assert snap.get("n0").requested.milli_cpu == 1000
+
+    cache.forget_pod(p)
+    cache.update_snapshot(snap)
+    assert snap.get("n0").requested.milli_cpu == 0
+
+    # assume again, finish binding, then expire
+    now = [100.0]
+    cache2 = Cache(ttl=10.0, clock=lambda: now[0])
+    cache2.add_node(make_node().name("n0").obj())
+    p2 = make_pod().name("p2").uid("up2").req({"cpu": "1"}).obj()
+    cache2.assume_pod(p2, "n0")
+    cache2.finish_binding(p2)
+    assert cache2.cleanup_expired() == []
+    now[0] = 111.0
+    assert [q.uid for q in cache2.cleanup_expired()] == ["up2"]
+    snap2 = Snapshot()
+    cache2.update_snapshot(snap2)
+    assert snap2.get("n0").requested.milli_cpu == 0
+
+
+def test_add_confirms_assumed():
+    cache = _cluster(2)
+    p = make_pod().name("p").uid("u").req({"cpu": "1"}).obj()
+    cache.assume_pod(p, "n0")
+    # watch event confirms on a different node (another scheduler instance won)
+    import copy
+
+    confirmed = copy.deepcopy(p)
+    confirmed.spec.node_name = "n1"
+    cache.add_pod(confirmed)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    assert snap.get("n0").requested.milli_cpu == 0
+    assert snap.get("n1").requested.milli_cpu == 1000
+    assert not cache.is_assumed(p)
+
+
+def test_encoding_units_and_incremental_sync():
+    cache = _cluster(3)
+    p = (
+        make_pod().name("p").uid("u").namespace("prod")
+        .req({"cpu": "1500m", "memory": "1Gi", "nvidia.com/gpu": "2"})
+        .label("app", "web")
+        .obj()
+    )
+    cache.assume_pod(p, "n1")
+    snap = Snapshot()
+    changed = cache.update_snapshot(snap)
+    enc = ClusterEncoder(cfg=EncodingConfig(min_nodes=8, min_pods=8))
+    enc.sync(snap, changed)
+    dev = enc.to_device()
+
+    row = enc.node_rows["n1"]
+    assert bool(dev.node_valid[row])
+    np.testing.assert_array_equal(
+        np.asarray(dev.allocatable[row])[: units.NUM_BASE_DIMS],
+        [8000, 16 * 1024 * 1024, 0, 110],  # cpu milli, mem KiB, eph MiB, pods
+    )
+    gpu_dim = enc.extended_index["nvidia.com/gpu"]
+    assert int(dev.requested[row, units.DIM_CPU]) == 1500
+    assert int(dev.requested[row, units.DIM_MEMORY]) == 1024 * 1024
+    assert int(dev.requested[row, units.DIM_PODS]) == 1
+    assert int(dev.requested[row, gpu_dim]) == 2
+
+    # pod row encoded
+    prow = enc.pod_rows["u"]
+    assert bool(dev.pod_valid[prow])
+    assert int(dev.pod_node[prow]) == row
+    assert int(dev.pod_request[prow, units.DIM_CPU]) == 1500
+
+    # incremental: second pod on n2; only n2's row is dirty
+    p2 = make_pod().name("p2").uid("u2").req({"cpu": "250m"}).obj()
+    cache.assume_pod(p2, "n2")
+    changed = cache.update_snapshot(snap)
+    assert changed == ["n2"]
+    enc.sync(snap, changed)
+    dev2 = enc.to_device()
+    assert int(dev2.requested[enc.node_rows["n2"], units.DIM_CPU]) == 250
+    # n1 untouched
+    assert int(dev2.requested[row, units.DIM_CPU]) == 1500
+
+    # remove the pod: row freed
+    cache.remove_pod(p)
+    changed = cache.update_snapshot(snap)
+    enc.sync(snap, changed)
+    dev3 = enc.to_device()
+    assert not bool(dev3.pod_valid[prow])
+    assert int(dev3.requested[row, units.DIM_CPU]) == 0
+
+
+def test_encoder_growth():
+    cache = Cache()
+    enc = ClusterEncoder(cfg=EncodingConfig(min_nodes=8, min_pods=8))
+    snap = Snapshot()
+    for i in range(20):  # > min_nodes
+        cache.add_node(make_node().name(f"n{i}").obj())
+    changed = cache.update_snapshot(snap)
+    enc.sync(snap, changed)
+    dev = enc.to_device()
+    assert dev.num_nodes >= 20
+    assert int(np.asarray(dev.node_valid).sum()) == 20
