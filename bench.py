@@ -1,0 +1,133 @@
+"""Benchmark: batched device scheduling vs sequential reference-semantics oracle.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: scheduling throughput (pods/s) of the device path on a synthetic
+cluster (default 1024 nodes, 2k running pods, batches of 128 pending pods with
+mixed constraints).  vs_baseline: speedup over the host oracle — a faithful
+sequential reimplementation of the reference's per-(pod,node) algorithm
+(kubernetes_tpu/oracle.py) measured on the same cluster, i.e. the
+single-process stand-in for the default scheduler's scheduling-algorithm cost
+(scheduler_scheduling_algorithm_duration, metrics.go:70).
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+import numpy as np
+
+
+def build(n_nodes, n_sched, n_pending, seed=0):
+    from kubernetes_tpu.testutil import make_node, make_pod
+    from kubernetes_tpu.state.cache import Cache, Snapshot
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+    from kubernetes_tpu.framework.runtime import BatchedFramework, initial_dynamic_state
+    from kubernetes_tpu.scheduler import default_plugins
+
+    rng = np.random.default_rng(seed)
+    cache = Cache()
+    for i in range(n_nodes):
+        cache.add_node(
+            make_node().name(f"n{i:05d}")
+            .capacity({"cpu": "64", "memory": "256Gi", "pods": "256"})
+            .label("topology.kubernetes.io/zone", f"z{i % 16}")
+            .label("disk", "ssd" if i % 2 else "hdd")
+            .obj()
+        )
+    for i in range(n_sched):
+        cache.add_pod(
+            make_pod().name(f"sp{i}").uid(f"sp{i}").namespace("default")
+            .label("app", ["web", "db", "cache"][i % 3])
+            .req({"cpu": "1", "memory": "1Gi"})
+            .node(f"n{int(rng.integers(n_nodes)):05d}")
+            .obj()
+        )
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    comp = PodBatchCompiler(enc)
+    pods = []
+    for i in range(n_pending):
+        w = (make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+             .req({"cpu": "1", "memory": "2Gi"}).label("app", "web"))
+        if i % 4 == 1:
+            w = w.topology_spread(2, "topology.kubernetes.io/zone", labels={"app": "web"})
+        if i % 4 == 2:
+            w = w.preferred_node_affinity(10, "disk", ["ssd"])
+        if i % 4 == 3:
+            w = w.toleration("flaky", "", "")
+        pods.append(w.obj())
+    batch = comp.compile(pods)
+    enc.full_sync(snap)
+    fw = BatchedFramework(default_plugins(enc.domain_cap))
+    host_auxes = fw.host_prepare(batch, snap, enc)
+    dsnap = enc.to_device()
+    dyn = initial_dynamic_state(dsnap)
+    return fw, batch, snap, dsnap, dyn, host_auxes, pods
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from kubernetes_tpu.oracle import Oracle
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 1024))
+    n_sched = int(os.environ.get("BENCH_SCHEDULED", 2048))
+    n_pending = int(os.environ.get("BENCH_PENDING", 128))
+    oracle_sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", 8))
+
+    fw, batch, snap, dsnap, dyn, host_auxes, pods = build(n_nodes, n_sched, n_pending)
+
+    def full_step(batch, dsnap, dyn, host_auxes, order):
+        auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+        return fw.greedy_assign(batch, dsnap, dyn, auxes, order)
+
+    step = jax.jit(full_step)
+    order = jnp.arange(batch.size)
+    res = step(batch, dsnap, dyn, host_auxes, order)  # compile
+    jax.block_until_ready(res.node_row)
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = step(batch, dsnap, dyn, host_auxes, order)
+        jax.block_until_ready(res.node_row)
+    device_s = (time.perf_counter() - t0) / reps
+    assigned = int((np.asarray(res.node_row) >= 0).sum())
+    pods_per_s = n_pending / device_s
+
+    # oracle baseline: sequential reference semantics on the same cluster
+    oracle = Oracle()
+    infos = [ni.clone() for ni in snap.node_info_list]
+    import copy
+
+    sample = [copy.deepcopy(p) for p in pods[:oracle_sample]]
+    t0 = time.perf_counter()
+    oracle.schedule_batch(sample, infos)
+    oracle_per_pod = (time.perf_counter() - t0) / max(len(sample), 1)
+    device_per_pod = device_s / n_pending
+    speedup = oracle_per_pod / device_per_pod if device_per_pod > 0 else 0.0
+
+    print(json.dumps({
+        "metric": "scheduling_throughput",
+        "value": round(pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(speedup, 1),
+        "detail": {
+            "nodes": n_nodes, "scheduled_pods": n_sched, "batch": n_pending,
+            "assigned": assigned,
+            "device_batch_ms": round(device_s * 1000, 2),
+            "device_per_pod_us": round(device_per_pod * 1e6, 1),
+            "oracle_per_pod_ms": round(oracle_per_pod * 1000, 2),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,6 +97,34 @@ def match_node_selector_term(
     return True
 
 
+def affinity_term_matches(
+    term,
+    owner_pod,
+    target_pod,
+    namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None,
+) -> bool:
+    """framework.AffinityTerm.Matches semantics (framework/types.go):
+
+    target matches when (target.ns ∈ term.namespaces — defaulted to owner's ns when
+    both namespaces and namespaceSelector are unset — OR namespaceSelector matches
+    the target namespace's labels) AND labelSelector matches target's labels.
+    An empty-but-set namespaceSelector selects every namespace.
+    """
+    ns_ok = False
+    if term.namespaces:
+        ns_ok = target_pod.namespace in term.namespaces
+    elif term.namespace_selector is None:
+        ns_ok = target_pod.namespace == owner_pod.namespace
+    if not ns_ok and term.namespace_selector is not None:
+        # an empty-but-set selector matches every namespace — match_label_selector
+        # already returns True for the empty non-None selector
+        labels = (namespace_labels or {}).get(target_pod.namespace, {})
+        ns_ok = match_label_selector(term.namespace_selector, labels)
+    if not ns_ok:
+        return False
+    return match_label_selector(term.label_selector, target_pod.metadata.labels)
+
+
 def match_node_selector(selector: Optional[NodeSelector], node: Node) -> bool:
     """Terms OR together; nil selector matches everything, empty terms list nothing."""
     if selector is None:

@@ -1,0 +1,9 @@
+"""ComponentConfig (reference: pkg/scheduler/apis/config)."""
+
+from .componentconfig import (  # noqa: F401
+    KubeSchedulerConfiguration,
+    KubeSchedulerProfile,
+    PluginSet,
+    load_config,
+    build_plugins_for_profile,
+)

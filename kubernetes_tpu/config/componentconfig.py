@@ -1,0 +1,217 @@
+"""KubeSchedulerConfiguration: v1beta3-schema-compatible componentconfig.
+
+Reference: pkg/scheduler/apis/config/types.go:41-196 (KubeSchedulerConfiguration
+:41, Parallelism :53, PercentageOfNodesToScore :70, Profiles :102, Plugins :129,
+PluginSet :171, PluginConfig :191), defaulting in v1beta3/default_plugins.go:32-51
+and v1beta3/defaults.go, typed args in types_pluginargs.go.
+
+Scope: the subset that shapes scheduling behavior on the device path — profiles,
+plugin enable/disable with weights, and the typed args of the vectorized plugin
+set.  Accepts the same YAML documents an unmodified kube-scheduler takes
+(apiVersion kubescheduler.config.k8s.io/v1beta2|v1beta3); structural knobs that
+do not apply to the dense device path (parallelism, percentageOfNodesToScore)
+are parsed and retained for compatibility but not used to degrade coverage.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..framework.interface import PluginWithWeight
+from .. import plugins as P
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# default enablement + weights: apis/config/v1beta3/default_plugins.go:32-51
+DEFAULT_PLUGIN_ORDER = [
+    ("NodeUnschedulable", 0),
+    ("NodeName", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+]
+
+
+@dataclass
+class PluginEnable:
+    name: str
+    weight: Optional[int] = None
+
+
+@dataclass
+class PluginSet:
+    enabled: List[PluginEnable] = field(default_factory=list)
+    disabled: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "PluginSet":
+        if not d:
+            return cls()
+        return cls(
+            enabled=[
+                PluginEnable(e["name"], e.get("weight")) for e in d.get("enabled") or []
+            ],
+            disabled=[e["name"] for e in d.get("disabled") or []],
+        )
+
+
+@dataclass
+class KubeSchedulerProfile:
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    plugins: Dict[str, PluginSet] = field(default_factory=dict)  # per extension point
+    plugin_config: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "KubeSchedulerProfile":
+        plugins = {
+            point: PluginSet.from_dict(ps)
+            for point, ps in (d.get("plugins") or {}).items()
+        }
+        plugin_config = {
+            pc["name"]: pc.get("args") or {} for pc in d.get("pluginConfig") or []
+        }
+        return cls(
+            scheduler_name=d.get("schedulerName", DEFAULT_SCHEDULER_NAME),
+            plugins=plugins,
+            plugin_config=plugin_config,
+        )
+
+    def effective_plugins(self) -> List[PluginEnable]:
+        """Default set, minus disabled, plus explicitly enabled (with weights).
+
+        Mirrors the multipoint merge of v1beta3 defaulting: "*" in disabled wipes
+        the defaults; explicit enables append/override.
+        """
+        multi = self.plugins.get("multiPoint", PluginSet())
+        score = self.plugins.get("score", PluginSet())
+        disabled = set(multi.disabled) | set(score.disabled)
+        out: List[PluginEnable] = []
+        if "*" not in disabled:
+            for name, weight in DEFAULT_PLUGIN_ORDER:
+                if name not in disabled:
+                    out.append(PluginEnable(name, weight))
+        for e in list(multi.enabled) + list(score.enabled):
+            existing = next((x for x in out if x.name == e.name), None)
+            if existing is None:
+                out.append(PluginEnable(e.name, e.weight))
+            elif e.weight is not None:
+                existing.weight = e.weight
+        return out
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    profiles: List[KubeSchedulerProfile] = field(default_factory=list)
+    parallelism: int = 16  # types.go:53 (compat only — device path is dense)
+    percentage_of_nodes_to_score: int = 0  # types.go:70 (compat only)
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "KubeSchedulerConfiguration":
+        api = d.get("apiVersion", "")
+        if api and not api.startswith("kubescheduler.config.k8s.io/"):
+            raise ValueError(f"unsupported apiVersion {api}")
+        profiles = [
+            KubeSchedulerProfile.from_dict(p) for p in d.get("profiles") or []
+        ]
+        if not profiles:
+            profiles = [KubeSchedulerProfile()]
+        return cls(
+            profiles=profiles,
+            parallelism=int(d.get("parallelism", 16)),
+            percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
+            pod_initial_backoff_seconds=float(d.get("podInitialBackoffSeconds", 1)),
+            pod_max_backoff_seconds=float(d.get("podMaxBackoffSeconds", 10)),
+        )
+
+    def profile(self, scheduler_name: str = DEFAULT_SCHEDULER_NAME) -> KubeSchedulerProfile:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        return self.profiles[0]
+
+
+def load_config(source) -> KubeSchedulerConfiguration:
+    """Accepts a dict, YAML string, or file path."""
+    if isinstance(source, Mapping):
+        return KubeSchedulerConfiguration.from_dict(source)
+    text = source
+    if isinstance(source, str) and "\n" not in source and source.endswith((".yaml", ".yml", ".json")):
+        with open(source) as f:
+            text = f.read()
+    try:
+        import yaml  # type: ignore
+
+        data = yaml.safe_load(text)
+    except ImportError:  # yaml not available → JSON fallback
+        import json
+
+        data = json.loads(text)
+    return KubeSchedulerConfiguration.from_dict(data or {})
+
+
+def build_plugins_for_profile(
+    profile: KubeSchedulerProfile, domain_cap: int, extended_index=None,
+    num_resource_dims: int = 8,
+) -> List[PluginWithWeight]:
+    """Instantiate the vectorized plugin set per profile + typed args
+    (types_pluginargs.go analog)."""
+    out: List[PluginWithWeight] = []
+    for e in profile.effective_plugins():
+        args = profile.plugin_config.get(e.name, {})
+        plugin = _construct(e.name, args, domain_cap, extended_index, num_resource_dims)
+        if plugin is None:
+            continue
+        default_w = dict(DEFAULT_PLUGIN_ORDER).get(e.name, 1)
+        out.append(PluginWithWeight(plugin, e.weight if e.weight is not None else default_w))
+    return out
+
+
+def _construct(name, args, domain_cap, extended_index, num_dims):
+    if name == "NodeResourcesFit":
+        strat = (args.get("scoringStrategy") or {})
+        resources = {
+            r["name"]: r.get("weight", 1)
+            for r in strat.get("resources") or [{"name": "cpu", "weight": 1},
+                                                {"name": "memory", "weight": 1}]
+        }
+        return P.FitPlugin(
+            strategy=strat.get("type", "LeastAllocated"),
+            resources=resources,
+            num_resource_dims=num_dims,
+            extended_index=extended_index,
+        )
+    if name == "NodeResourcesBalancedAllocation":
+        resources = {
+            r["name"]: r.get("weight", 1)
+            for r in args.get("resources") or [{"name": "cpu", "weight": 1},
+                                               {"name": "memory", "weight": 1}]
+        }
+        return P.BalancedAllocationPlugin(
+            resources=resources, num_resource_dims=num_dims,
+            extended_index=extended_index,
+        )
+    if name == "InterPodAffinity":
+        return P.InterPodAffinityPlugin(
+            domain_cap=domain_cap,
+            hard_pod_affinity_weight=args.get("hardPodAffinityWeight", 1),
+        )
+    if name == "PodTopologySpread":
+        return P.PodTopologySpreadPlugin(domain_cap=domain_cap)
+    simple = {
+        "TaintToleration": P.TaintTolerationPlugin,
+        "NodeAffinity": P.NodeAffinityPlugin,
+        "NodeName": P.NodeNamePlugin,
+        "NodePorts": P.NodePortsPlugin,
+        "NodeUnschedulable": P.NodeUnschedulablePlugin,
+        "ImageLocality": P.ImageLocalityPlugin,
+    }
+    ctor = simple.get(name)
+    return ctor() if ctor else None

@@ -1,0 +1,22 @@
+"""Batched Scheduling Framework (reference: pkg/scheduler/framework).
+
+The reference defines 11 extension points as Go interfaces evaluated per (pod, node)
+by goroutine fan-out (framework/interface.go:305-495, runtime/framework.go). Here the
+same extension points are *batched tensor programs*: a plugin's Filter produces a
+``bool[B, N]`` feasibility mask and its Score a ``float32[B, N]`` plane for a whole
+``PodBatch`` against a ``DeviceSnapshot`` in one fused XLA computation; the runtime's
+per-plugin weight application (runtime/framework.go:925-940) becomes a single
+contraction over the stacked ``[plugins, B, N]`` tensor.
+"""
+
+from .interface import (  # noqa: F401
+    Code,
+    Status,
+    CycleState,
+    Plugin,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+    MAX_TOTAL_SCORE,
+)
+from .events import ClusterEvent, ActionType, EventResource  # noqa: F401
+from .podbatch import PodBatch, PodBatchCompiler  # noqa: F401

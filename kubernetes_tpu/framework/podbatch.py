@@ -1,0 +1,408 @@
+"""PodBatch: a batch of pending pods compiled into padded device arrays.
+
+The reference walks one pod's Go spec per cycle (scheduler.go:496 scheduleOne);
+plugins re-parse it per node visit.  Here a whole batch of B pending pods is
+compiled ONCE host-side into fixed-shape int32/float32 arrays, and every plugin's
+Filter/Score reads only these arrays — so the full ``[B, N]`` feasibility/score
+planes are pure jnp programs.
+
+Compiled per pod (MISSING = -1 pads everywhere):
+  requests        — i32[B, R] scaled units (fit.go:162-178 semantics, incl. overhead)
+  tolerations     — key/val/op/effect/valid [B, TT] (Toleration.ToleratesTaint)
+  node selector   — pod.spec.nodeSelector as a matchLabels-only selector (AND)
+  node affinity   — requiredDuringScheduling terms (OR of ANDed reqs) + weighted
+                    preferred terms (nodeaffinity/node_affinity.go)
+  topology spread — per-constraint key/maxSkew/whenUnsatisfiable/minDomains +
+                    compiled label selector (podtopologyspread/common.go);
+                    topology keys become encoder topo slots (compact domain ids)
+  pod (anti)affinity — 4 term groups, each: topology key, compiled selector,
+                    resolved namespace id list (namespaces ∪ namespaceSelector
+                    resolved host-side, mirroring PreFilter's namespace resolution)
+  ports, labels, namespace, priority, nodeName
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..api import objects as v1
+from ..api.labels import match_label_selector
+from ..state.dictionary import MISSING, Dictionary
+from ..state.encoding import EFFECT_CODE, _PROTO_CODE, ClusterEncoder, EncodingCapacityError
+from ..state import selectors as sel
+from ..state.selectors import (
+    CompiledLabelSelectors,
+    CompiledNodeSelectors,
+    compile_label_selectors,
+    compile_node_selectors,
+)
+
+TOL_OP_EQUAL = 0
+TOL_OP_EXISTS = 1
+
+WHEN_DO_NOT_SCHEDULE = 0
+WHEN_SCHEDULE_ANYWAY = 1
+
+
+from ..state.units import pow2_round_up as _pow2
+
+
+@dataclass
+class AffinityTermGroup:
+    """One group of pod-affinity terms for the whole batch ([B, T] padded).
+
+    selectors are flattened row-major: term (i, t) -> flat index i*T + t.
+    """
+
+    valid: np.ndarray  # bool[B, T]
+    topo_key: np.ndarray  # i32[B, T]
+    weight: np.ndarray  # f32[B, T]  (1.0 for required terms)
+    ns_ids: np.ndarray  # i32[B, T, NS]
+    all_namespaces: np.ndarray  # bool[B, T]  (empty-but-non-nil namespaceSelector)
+    selectors: CompiledLabelSelectors  # batch size B*T
+
+    @property
+    def terms_per_pod(self) -> int:
+        return self.valid.shape[1]
+
+
+@dataclass
+class PodBatch:
+    pods: List[v1.Pod]
+    valid: np.ndarray  # bool[B]
+    request: np.ndarray  # i32[B, R]
+    non_zero: np.ndarray  # i32[B, 2]
+    ns: np.ndarray  # i32[B]
+    label_keys: np.ndarray  # i32[B, PL]
+    label_vals: np.ndarray  # i32[B, PL]
+    priority: np.ndarray  # i32[B]
+    node_name_id: np.ndarray  # i32[B] (MISSING when spec.nodeName unset)
+    ports: np.ndarray  # i32[B, PP]
+    image_ids: np.ndarray  # i32[B, CI] (container images, for ImageLocality)
+    # tolerations
+    tol_valid: np.ndarray  # bool[B, TT]
+    tol_key: np.ndarray  # i32[B, TT] (MISSING = empty key → any)
+    tol_val: np.ndarray  # i32[B, TT]
+    tol_op: np.ndarray  # i32[B, TT]
+    tol_effect: np.ndarray  # i32[B, TT] (-1 = all effects)
+    # node selection
+    node_selector: CompiledLabelSelectors  # B (pod.spec.nodeSelector)
+    node_affinity: CompiledNodeSelectors  # B (required terms)
+    pref_valid: np.ndarray  # bool[B, PT] preferred node-affinity terms
+    pref_weight: np.ndarray  # f32[B, PT]
+    pref_req_key: np.ndarray  # i32[B, PT, S]
+    pref_req_op: np.ndarray
+    pref_req_vals: np.ndarray  # i32[B, PT, S, V]
+    pref_req_num: np.ndarray  # f32[B, PT, S]
+    # topology spread
+    tsc_valid: np.ndarray  # bool[B, C]
+    tsc_key: np.ndarray  # i32[B, C]
+    tsc_max_skew: np.ndarray  # i32[B, C]
+    tsc_when: np.ndarray  # i32[B, C]
+    tsc_min_domains: np.ndarray  # i32[B, C] (0 = unset)
+    tsc_selectors: CompiledLabelSelectors  # B*C
+    # pod (anti)affinity term groups
+    req_affinity: AffinityTermGroup
+    req_anti_affinity: AffinityTermGroup
+    pref_affinity: AffinityTermGroup
+    pref_anti_affinity: AffinityTermGroup
+
+    def __len__(self) -> int:
+        return len(self.pods)
+
+    @property
+    def size(self) -> int:
+        return self.valid.shape[0]
+
+    def has_pod_affinity(self) -> bool:
+        return bool(
+            self.req_affinity.valid.any()
+            or self.req_anti_affinity.valid.any()
+            or self.pref_affinity.valid.any()
+            or self.pref_anti_affinity.valid.any()
+        )
+
+    def has_topology_spread(self) -> bool:
+        return bool(self.tsc_valid.any())
+
+
+from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
+
+_reg(AffinityTermGroup)
+_reg(PodBatch, skip=("pods",))
+
+
+class PodBatchCompiler:
+    """Compiles pods → PodBatch against a ClusterEncoder's dictionary/resource dims.
+
+    namespace_labels: ns name → labels, used to resolve PodAffinityTerm
+    namespaceSelector host-side (the reference resolves it in PreFilter via a
+    namespace lister — interpodaffinity/plugin.go GetNamespaceLabelsSnapshot).
+    """
+
+    def __init__(
+        self,
+        encoder: ClusterEncoder,
+        namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None,
+    ):
+        self.enc = encoder
+        self.dic: Dictionary = encoder.dic
+        self.namespace_labels = namespace_labels or {}
+
+    def compile(self, pods: Sequence[v1.Pod], pad_to: Optional[int] = None) -> PodBatch:
+        b_real = len(pods)
+        b = pad_to if pad_to is not None else _pow2(b_real, 1)
+        if b < b_real:
+            raise ValueError(f"pad_to {b} < batch size {b_real}")
+        enc, dic = self.enc, self.dic
+        cfg = enc.cfg
+        r = cfg.num_resource_dims
+
+        valid = np.zeros(b, dtype=bool)
+        request = np.zeros((b, r), dtype=np.int32)
+        non_zero = np.zeros((b, 2), dtype=np.int32)
+        ns = np.full(b, MISSING, dtype=np.int32)
+        priority = np.zeros(b, dtype=np.int32)
+        node_name_id = np.full(b, MISSING, dtype=np.int32)
+
+        pl_cap = _pow2(max((len(p.metadata.labels) for p in pods), default=0), 4)
+        label_keys = np.full((b, pl_cap), MISSING, dtype=np.int32)
+        label_vals = np.full((b, pl_cap), MISSING, dtype=np.int32)
+
+        port_lists = [sorted(
+            {_PROTO_CODE.get(proto, 0) * 65536 + port
+             for (_ip, proto, port) in _pod_host_ports(p)}
+        ) for p in pods]
+        pp_cap = _pow2(max((len(pl) for pl in port_lists), default=0), 2)
+        ports = np.full((b, pp_cap), MISSING, dtype=np.int32)
+
+        ci_cap = _pow2(max((len(p.spec.containers) for p in pods), default=0), 2)
+        image_ids = np.full((b, ci_cap), MISSING, dtype=np.int32)
+
+        tt_cap = _pow2(max((len(p.spec.tolerations) for p in pods), default=0), 2)
+        tol_valid = np.zeros((b, tt_cap), dtype=bool)
+        tol_key = np.full((b, tt_cap), MISSING, dtype=np.int32)
+        tol_val = np.full((b, tt_cap), MISSING, dtype=np.int32)
+        tol_op = np.zeros((b, tt_cap), dtype=np.int32)
+        tol_effect = np.full((b, tt_cap), -1, dtype=np.int32)
+
+        node_selectors: List[Optional[v1.LabelSelector]] = []
+        node_affinities: List[Optional[v1.NodeSelector]] = []
+        pref_terms: List[List[v1.PreferredSchedulingTerm]] = []
+        tsc_lists: List[List[v1.TopologySpreadConstraint]] = []
+
+        for i, pod in enumerate(pods):
+            valid[i] = True
+            request[i] = enc.pod_request_units(pod)
+            non_zero[i] = enc.pod_non_zero_units(pod)
+            ns[i] = dic.intern(pod.namespace)
+            priority[i] = pod.spec.priority
+            if pod.spec.node_name:
+                node_name_id[i] = dic.intern(pod.spec.node_name)
+            for j, (k, val) in enumerate(pod.metadata.labels.items()):
+                label_keys[i, j] = dic.intern(k)
+                label_vals[i, j] = dic.intern(val)
+            ports[i, : len(port_lists[i])] = port_lists[i]
+            for j, c in enumerate(pod.spec.containers):
+                if c.image:
+                    image_ids[i, j] = dic.intern(c.image)
+            for j, t in enumerate(pod.spec.tolerations):
+                tol_valid[i, j] = True
+                tol_key[i, j] = dic.intern(t.key) if t.key else MISSING
+                tol_val[i, j] = dic.intern(t.value)
+                tol_op[i, j] = (
+                    TOL_OP_EXISTS if t.operator == v1.TOLERATION_OP_EXISTS else TOL_OP_EQUAL
+                )
+                tol_effect[i, j] = EFFECT_CODE.get(t.effect, -1) if t.effect else -1
+
+            # nodeSelector: empty selector matches everything (matchLabels AND)
+            node_selectors.append(
+                v1.LabelSelector(match_labels=dict(pod.spec.node_selector))
+            )
+            aff = pod.spec.affinity
+            na = aff.node_affinity if aff else None
+            node_affinities.append(na.required if na else None)
+            pref_terms.append(list(na.preferred) if na else [])
+            tsc_lists.append(list(pod.spec.topology_spread_constraints))
+
+        # pad rows: invalid pods get empty node selector (matches everything) so
+        # padded rows never constrain anything; valid[] gates all results anyway.
+        node_selectors += [v1.LabelSelector()] * (b - b_real)
+        node_affinities += [None] * (b - b_real)
+        pref_terms += [[]] * (b - b_real)
+        tsc_lists += [[]] * (b - b_real)
+
+        compiled_ns = compile_label_selectors(node_selectors, dic)
+        compiled_na = compile_node_selectors(node_affinities, dic)
+
+        # preferred node-affinity terms
+        pt_cap = _pow2(max((len(t) for t in pref_terms), default=0), 1)
+        s_cap = _pow2(
+            max(
+                (len(t.preference.match_expressions) + len(t.preference.match_fields)
+                 for terms in pref_terms for t in terms),
+                default=0,
+            ),
+            2,
+        )
+        v_cap = _pow2(
+            max(
+                (len(e.values)
+                 for terms in pref_terms for t in terms
+                 for e in list(t.preference.match_expressions) + list(t.preference.match_fields)),
+                default=0,
+            ),
+            2,
+        )
+        pref_valid = np.zeros((b, pt_cap), dtype=bool)
+        pref_weight = np.zeros((b, pt_cap), dtype=np.float32)
+        pref_req_key = np.full((b, pt_cap, s_cap), MISSING, dtype=np.int32)
+        pref_req_op = np.full((b, pt_cap, s_cap), sel.OP_PAD, dtype=np.int32)
+        pref_req_vals = np.full((b, pt_cap, s_cap, v_cap), MISSING, dtype=np.int32)
+        pref_req_num = np.full((b, pt_cap, s_cap), np.nan, dtype=np.float32)
+        for i, terms in enumerate(pref_terms):
+            for ti, term in enumerate(terms):
+                reqs = list(term.preference.match_expressions)
+                fields = [
+                    v1.NodeSelectorRequirement(
+                        key="metadata.name" if e.key in ("metadata.name", "name") else e.key,
+                        operator=e.operator,
+                        values=list(e.values),
+                    )
+                    for e in term.preference.match_fields
+                ]
+                reqs = reqs + fields
+                # a preferred term with no requirements matches nothing (reference:
+                # empty NodeSelectorTerm matches no objects)
+                pref_valid[i, ti] = len(reqs) > 0
+                pref_weight[i, ti] = float(term.weight)
+                for j, e in enumerate(reqs):
+                    pref_req_key[i, ti, j] = dic.intern(e.key)
+                    pref_req_op[i, ti, j] = sel._OP_CODE[e.operator]
+                    for k, val in enumerate(e.values):
+                        pref_req_vals[i, ti, j, k] = dic.intern(val)
+                    if e.values:
+                        try:
+                            pref_req_num[i, ti, j] = float(int(e.values[0]))
+                        except ValueError:
+                            pass
+
+        # topology spread constraints
+        c_cap = _pow2(max((len(t) for t in tsc_lists), default=0), 1)
+        tsc_valid = np.zeros((b, c_cap), dtype=bool)
+        tsc_key = np.full((b, c_cap), MISSING, dtype=np.int32)
+        tsc_max_skew = np.ones((b, c_cap), dtype=np.int32)
+        tsc_when = np.full((b, c_cap), -1, dtype=np.int32)
+        tsc_min_domains = np.zeros((b, c_cap), dtype=np.int32)
+        tsc_sel_list: List[Optional[v1.LabelSelector]] = [None] * (b * c_cap)
+        for i, constraints in enumerate(tsc_lists):
+            for ci, c in enumerate(constraints):
+                tsc_valid[i, ci] = True
+                tsc_key[i, ci] = self.enc.topo_slot(c.topology_key)
+                tsc_max_skew[i, ci] = c.max_skew
+                tsc_when[i, ci] = (
+                    WHEN_DO_NOT_SCHEDULE
+                    if c.when_unsatisfiable == v1.DO_NOT_SCHEDULE
+                    else WHEN_SCHEDULE_ANYWAY
+                )
+                tsc_min_domains[i, ci] = c.min_domains or 0
+                tsc_sel_list[i * c_cap + ci] = c.label_selector
+        tsc_selectors = compile_label_selectors(tsc_sel_list, dic)
+
+        groups = {}
+        for gname in ("req_affinity", "req_anti_affinity", "pref_affinity", "pref_anti_affinity"):
+            groups[gname] = self._compile_affinity_group(pods, b, gname)
+
+        return PodBatch(
+            pods=list(pods),
+            valid=valid, request=request, non_zero=non_zero, ns=ns,
+            label_keys=label_keys, label_vals=label_vals, priority=priority,
+            node_name_id=node_name_id, ports=ports, image_ids=image_ids,
+            tol_valid=tol_valid, tol_key=tol_key, tol_val=tol_val,
+            tol_op=tol_op, tol_effect=tol_effect,
+            node_selector=compiled_ns, node_affinity=compiled_na,
+            pref_valid=pref_valid, pref_weight=pref_weight,
+            pref_req_key=pref_req_key, pref_req_op=pref_req_op,
+            pref_req_vals=pref_req_vals, pref_req_num=pref_req_num,
+            tsc_valid=tsc_valid, tsc_key=tsc_key, tsc_max_skew=tsc_max_skew,
+            tsc_when=tsc_when, tsc_min_domains=tsc_min_domains,
+            tsc_selectors=tsc_selectors,
+            **groups,
+        )
+
+    # --- pod affinity ---------------------------------------------------------
+
+    def _terms_of(self, pod: v1.Pod, group: str):
+        aff = pod.spec.affinity
+        if aff is None:
+            return []
+        pa = aff.pod_affinity if "anti" not in group else aff.pod_anti_affinity
+        if pa is None:
+            return []
+        if group.startswith("req"):
+            return [(t, 1.0) for t in pa.required]
+        return [(wt.pod_affinity_term, float(wt.weight)) for wt in pa.preferred]
+
+    def _resolve_namespaces(self, pod: v1.Pod, term: v1.PodAffinityTerm):
+        """→ (ns_names, all_namespaces). Mirrors PreFilter namespace resolution:
+        namespaces ∪ namespaceSelector matches; neither set → pod's own namespace;
+        empty-but-set namespaceSelector selects every namespace."""
+        names = set(term.namespaces)
+        all_ns = False
+        if term.namespace_selector is not None:
+            if not term.namespace_selector.match_labels and not term.namespace_selector.match_expressions:
+                all_ns = True
+            else:
+                for ns_name, labels in self.namespace_labels.items():
+                    if match_label_selector(term.namespace_selector, labels):
+                        names.add(ns_name)
+        if not names and not all_ns:
+            names = {pod.namespace}
+        return sorted(names), all_ns
+
+    def _compile_affinity_group(
+        self, pods: Sequence[v1.Pod], b: int, group: str
+    ) -> AffinityTermGroup:
+        dic = self.dic
+        term_lists = [self._terms_of(p, group) for p in pods]
+        t_cap = _pow2(max((len(t) for t in term_lists), default=0), 1)
+        resolved = [
+            [self._resolve_namespaces(p, term) for (term, _w) in terms]
+            for p, terms in zip(pods, term_lists)
+        ]
+        ns_cap = _pow2(
+            max((len(names) for rl in resolved for (names, _a) in rl), default=0), 1
+        )
+        valid = np.zeros((b, t_cap), dtype=bool)
+        topo_key = np.full((b, t_cap), MISSING, dtype=np.int32)
+        weight = np.zeros((b, t_cap), dtype=np.float32)
+        ns_ids = np.full((b, t_cap, ns_cap), MISSING, dtype=np.int32)
+        all_namespaces = np.zeros((b, t_cap), dtype=bool)
+        sel_list: List[Optional[v1.LabelSelector]] = [None] * (b * t_cap)
+        for i, terms in enumerate(term_lists):
+            for ti, (term, w) in enumerate(terms):
+                valid[i, ti] = True
+                topo_key[i, ti] = self.enc.topo_slot(term.topology_key)
+                weight[i, ti] = w
+                names, all_ns = resolved[i][ti]
+                all_namespaces[i, ti] = all_ns
+                for k, name in enumerate(names):
+                    ns_ids[i, ti, k] = dic.intern(name)
+                sel_list[i * t_cap + ti] = term.label_selector
+        return AffinityTermGroup(
+            valid=valid, topo_key=topo_key, weight=weight, ns_ids=ns_ids,
+            all_namespaces=all_namespaces,
+            selectors=compile_label_selectors(sel_list, dic),
+        )
+
+
+def _pod_host_ports(pod: v1.Pod):
+    out = set()
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                out.add((p.host_ip or "0.0.0.0", p.protocol or "TCP", p.host_port))
+    return out

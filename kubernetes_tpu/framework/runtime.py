@@ -1,0 +1,174 @@
+"""Batched framework runtime: plugin composition + greedy-scan assignment.
+
+Reference: pkg/scheduler/framework/runtime/framework.go —
+  RunFilterPlugins (goroutine fan-out per node, scheduler.go:983-1023) → here ONE
+  fused program producing the whole ``bool[B, N]`` mask;
+  RunScorePlugins :874-946 (parallel per node, NormalizeScore :907, weight apply
+  :925) → stacked score planes + one weighted contraction;
+  scheduleOne's sequential assume loop (scheduler.go:496,571) → a ``lax.scan``
+  over the pod batch whose carry holds the dynamic cluster arrays, so a whole
+  pending batch is scheduled in ONE device program with exact greedy-sequential
+  semantics.
+
+select_host parity: the reference reservoir-samples among max-score ties
+(scheduler.go:827-848); here ties break by lowest node row (deterministic) or by
+a caller-provided PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .interface import DynamicState, Plugin, PluginWithWeight
+from ..state import units
+
+
+class AssignResult(NamedTuple):
+    node_row: jnp.ndarray  # i32[B] assigned node row, -1 = unschedulable
+    feasible_count: jnp.ndarray  # i32[B] number of feasible nodes seen
+    dyn: DynamicState  # final dynamic state after all assignments
+
+
+class BatchedFramework:
+    """Drives a fixed plugin list as fused tensor programs.
+
+    The public entry points are pure functions of (batch, snap, dyn, auxes) and
+    are safe to wrap in jax.jit (callers own the jit boundary so they can attach
+    donate/sharding policies).
+    """
+
+    def __init__(self, plugins: Sequence[PluginWithWeight]):
+        self.plugins = list(plugins)
+        self.filter_plugins = [p for p in self.plugins if hasattr(p.plugin, "filter")]
+        self.score_plugins = [p for p in self.plugins if hasattr(p.plugin, "score")]
+
+    # --- host-side precompute (eager, before jit) ----------------------------
+
+    def host_prepare(self, batch, snapshot, encoder, namespace_labels=None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for pw in self.plugins:
+            fn = getattr(pw.plugin, "host_prepare", None)
+            if fn is not None:
+                out[pw.plugin.name] = fn(
+                    batch, snapshot, encoder, namespace_labels=namespace_labels
+                )
+        return out
+
+    # --- device-side prepare (traceable) -------------------------------------
+
+    def prepare(self, batch, snap, dyn, host_auxes: Optional[Dict[str, Any]] = None):
+        host_auxes = host_auxes or {}
+        auxes = []
+        for pw in self.plugins:
+            fn = getattr(pw.plugin, "prepare", None)
+            if fn is None:
+                auxes.append(None)
+            else:
+                auxes.append(fn(batch, snap, dyn, host_auxes.get(pw.plugin.name)))
+        return tuple(auxes)
+
+    # --- filter + score ------------------------------------------------------
+
+    def run_filters(self, batch, snap, dyn, auxes):
+        mask = snap.node_valid[None, :] & batch.valid[:, None]
+        for pw, aux in zip(self.plugins, auxes):
+            if hasattr(pw.plugin, "filter"):
+                mask = mask & pw.plugin.filter(batch, snap, dyn, aux)
+        return mask
+
+    def run_scores(self, batch, snap, dyn, auxes, mask):
+        """Weighted sum of normalized per-plugin planes
+        (runtime/framework.go:874-946 as one contraction)."""
+        total = jnp.zeros(mask.shape, jnp.float32)
+        for pw, aux in zip(self.plugins, auxes):
+            if not hasattr(pw.plugin, "score"):
+                continue
+            raw = pw.plugin.score(batch, snap, dyn, aux, mask=mask)
+            norm = pw.plugin.normalize(raw, mask)
+            # reference converts each plugin score to int64 (truncation) before
+            # applying the weight — floor keeps integer parity for ≥0 scores
+            total = total + pw.weight * jnp.floor(norm)
+        return jnp.where(mask, total, -jnp.inf)
+
+    def compute(self, batch, snap, dyn, auxes):
+        mask = self.run_filters(batch, snap, dyn, auxes)
+        scores = self.run_scores(batch, snap, dyn, auxes, mask)
+        return mask, scores
+
+    # --- host selection (parity with scheduler.go:827-848) -------------------
+
+    @staticmethod
+    def select_host(row_scores, row_mask, key=None):
+        """Argmax with tie handling: deterministic lowest-row, or uniform among
+        ties when a PRNG key is given (reservoir-sampling parity)."""
+        masked = jnp.where(row_mask, row_scores, -jnp.inf)
+        best = jnp.max(masked)
+        ties = masked == best
+        if key is None:
+            return jnp.argmax(masked)
+        noise = jax.random.uniform(key, masked.shape)
+        return jnp.argmax(jnp.where(ties, noise, -1.0))
+
+    # --- greedy batch assignment (lax.scan) ----------------------------------
+
+    def apply_assignment(self, dyn: DynamicState, auxes, i, node_row, batch, snap):
+        """assume: consume resources + run plugin in-scan updates."""
+        req = batch.request[i]
+        requested = dyn.requested.at[node_row].add(req)
+        nz = dyn.non_zero.at[node_row].add(batch.non_zero[i])
+        new_dyn = DynamicState(requested=requested, non_zero=nz)
+        new_auxes = []
+        for pw, aux in zip(self.plugins, auxes):
+            fn = getattr(pw.plugin, "update", None)
+            if fn is None or aux is None:
+                new_auxes.append(aux)
+            else:
+                new_auxes.append(fn(aux, i, node_row, batch, snap))
+        return new_dyn, tuple(new_auxes)
+
+    def greedy_assign(self, batch, snap, dyn, auxes, order, key=None) -> AssignResult:
+        """Schedule the batch pod-by-pod in ``order`` inside one lax.scan.
+
+        Exact greedy-sequential semantics: each step filters+scores against the
+        carry state (resources consumed by earlier assignments, plugin tables
+        updated), matching a sequence of reference scheduling cycles with
+        instantaneous assume.
+        """
+        b = batch.valid.shape[0]
+
+        def step(carry, inp):
+            dyn, auxes = carry
+            i = inp["i"]
+            mask, scores = self.compute(batch, snap, dyn, auxes)
+            row_mask = mask[i]
+            row_scores = scores[i]
+            feasible_n = jnp.sum(row_mask)
+            feasible = feasible_n > 0
+            node = self.select_host(row_scores, row_mask, inp.get("key"))
+            node = jnp.where(feasible, node, 0)
+
+            def do_assign(args):
+                dyn, auxes = args
+                return self.apply_assignment(dyn, auxes, i, node, batch, snap)
+
+            dyn, auxes = jax.lax.cond(
+                feasible & batch.valid[i], do_assign, lambda a: a, (dyn, auxes)
+            )
+            out_node = jnp.where(feasible & batch.valid[i], node, -1)
+            return (dyn, auxes), {"i": i, "node": out_node, "feasible_n": feasible_n}
+
+        inputs = {"i": order.astype(jnp.int32)}
+        if key is not None:
+            inputs["key"] = jax.random.split(key, b)
+        (dyn, auxes), outs = jax.lax.scan(step, (dyn, auxes), inputs)
+        # scatter back into pod-index order
+        node_row = jnp.full((b,), -1, jnp.int32).at[outs["i"]].set(outs["node"])
+        feasible_count = jnp.zeros((b,), jnp.int32).at[outs["i"]].set(outs["feasible_n"])
+        return AssignResult(node_row=node_row, feasible_count=feasible_count, dyn=dyn)
+
+
+def initial_dynamic_state(snap) -> DynamicState:
+    return DynamicState(requested=snap.requested, non_zero=snap.non_zero_requested)

@@ -1,0 +1,4 @@
+"""Prometheus-name-compatible metrics (reference: pkg/scheduler/metrics)."""
+
+from .registry import Histogram, Counter, Gauge, Registry, default_registry  # noqa: F401
+from . import scheduler_metrics  # noqa: F401

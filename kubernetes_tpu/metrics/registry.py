@@ -1,0 +1,115 @@
+"""Minimal metrics registry with Prometheus-compatible series naming.
+
+Reference: staging/src/k8s.io/component-base/metrics (counter/gauge/histogram
+wrappers over prometheus) + pkg/scheduler/metrics/metrics.go.  Quantile
+extraction mirrors test/integration/scheduler_perf/util.go:238-276
+(histogramQuantile over bucket counts).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    return [start * (factor ** i) for i in range(count)]
+
+
+class Metric:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+
+
+class Counter(Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._v: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, labels: Tuple = (), by: float = 1.0):
+        with self._lock:
+            self._v[labels] = self._v.get(labels, 0.0) + by
+
+    def value(self, labels: Tuple = ()) -> float:
+        return self._v.get(labels, 0.0)
+
+
+class Gauge(Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._v: Dict[Tuple, float] = {}
+
+    def set(self, value: float, labels: Tuple = ()):
+        self._v[labels] = value
+
+    def value(self, labels: Tuple = ()) -> float:
+        return self._v.get(labels, 0.0)
+
+
+class Histogram(Metric):
+    def __init__(self, name, buckets: List[float], help_=""):
+        super().__init__(name, help_)
+        self.buckets = list(buckets)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sum: Dict[Tuple, float] = {}
+        self._n: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, v: float, labels: Tuple = ()):
+        with self._lock:
+            c = self._counts.setdefault(labels, [0] * (len(self.buckets) + 1))
+            c[bisect.bisect_left(self.buckets, v)] += 1
+            self._sum[labels] = self._sum.get(labels, 0.0) + v
+            self._n[labels] = self._n.get(labels, 0) + 1
+
+    def count(self, labels: Tuple = ()) -> int:
+        return self._n.get(labels, 0)
+
+    def sum(self, labels: Tuple = ()) -> float:
+        return self._sum.get(labels, 0.0)
+
+    def quantile(self, q: float, labels: Tuple = ()) -> float:
+        """Linear-interpolated bucket quantile (scheduler_perf util.go:238-276)."""
+        counts = self._counts.get(labels)
+        if not counts:
+            return 0.0
+        total = sum(counts)
+        target = q * total
+        acc = 0.0
+        lo = 0.0
+        for i, c in enumerate(counts):
+            hi = self.buckets[i] if i < len(self.buckets) else float("inf")
+            if acc + c >= target and c > 0:
+                frac = (target - acc) / c
+                if hi == float("inf"):
+                    return lo
+                return lo + (hi - lo) * frac
+            acc += c
+            lo = hi
+        return lo
+
+
+class Registry:
+    def __init__(self):
+        self.metrics: Dict[str, Metric] = {}
+
+    def register(self, m: Metric) -> Metric:
+        self.metrics[m.name] = m
+        return m
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self.metrics.get(name)
+
+    def reset(self):
+        for name, m in list(self.metrics.items()):
+            if isinstance(m, Histogram):
+                self.metrics[name] = Histogram(m.name, m.buckets, m.help)
+            else:
+                self.metrics[name] = type(m)(m.name, m.help)
+
+
+default_registry = Registry()

@@ -1,0 +1,60 @@
+"""Scheduler metric series — same names/buckets as the reference.
+
+Reference: pkg/scheduler/metrics/metrics.go:45-180.
+"""
+
+from .registry import Counter, Gauge, Histogram, default_registry, exponential_buckets
+
+# :62-66 — THE baseline metric: exp buckets 1ms·2^k, 15 buckets
+scheduling_attempt_duration = default_registry.register(
+    Histogram(
+        "scheduler_scheduling_attempt_duration_seconds",
+        exponential_buckets(0.001, 2, 15),
+        "Scheduling attempt latency (scheduling algorithm + binding)",
+    )
+)
+scheduling_algorithm_duration = default_registry.register(
+    Histogram(
+        "scheduler_scheduling_algorithm_duration_seconds",
+        exponential_buckets(0.001, 2, 15),
+    )
+)
+e2e_scheduling_duration = default_registry.register(
+    Histogram(
+        "scheduler_e2e_scheduling_duration_seconds",
+        exponential_buckets(0.001, 2, 15),
+    )
+)
+pod_scheduling_duration = default_registry.register(
+    Histogram(
+        "scheduler_pod_scheduling_duration_seconds",
+        exponential_buckets(0.01, 2, 20),  # :110-116
+    )
+)
+framework_extension_point_duration = default_registry.register(
+    Histogram(
+        "scheduler_framework_extension_point_duration_seconds",
+        exponential_buckets(0.0001, 2, 12),  # :130
+    )
+)
+schedule_attempts = default_registry.register(
+    Counter("scheduler_schedule_attempts_total")  # labels: (result,)
+)
+pending_pods = default_registry.register(
+    Gauge("scheduler_pending_pods")  # labels: (queue,)
+)
+pod_scheduling_attempts = default_registry.register(
+    Histogram("scheduler_pod_scheduling_attempts", [1, 2, 4, 8, 16])
+)
+preemption_attempts = default_registry.register(
+    Counter("scheduler_preemption_attempts_total")
+)
+preemption_victims = default_registry.register(
+    Histogram("scheduler_preemption_victims", [1, 2, 4, 8, 16, 32, 64])
+)
+queue_incoming_pods = default_registry.register(
+    Counter("scheduler_queue_incoming_pods_total")  # labels: (queue, event)
+)
+scheduler_cache_size = default_registry.register(
+    Gauge("scheduler_scheduler_cache_size")  # labels: (type,)
+)

@@ -1,0 +1,634 @@
+"""Sequential host oracle: reference-exact plugin semantics in plain Python.
+
+This is the parity baseline the batched device path is tested against
+(SURVEY.md §4 testing lesson, §7 step 4).  Every function mirrors the cited
+reference code with exact integer arithmetic (int64 semantics), one (pod, node)
+at a time, using host NodeInfo state — the straight-line reimplementation of
+what the Go scheduler computes with 16 goroutines.
+
+Known, documented deviations of the DEVICE path vs this oracle (not bugs here):
+  - resource unit quantization (KiB/MiB rounding, state/units.py)
+  - host-port hostIP wildcard rules (device is conservative, encoding.py note)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from .api import objects as v1
+from .api.labels import (
+    affinity_term_matches,
+    match_label_selector,
+    match_node_selector,
+)
+from .api.resource import (
+    Resource,
+    compute_pod_resource_request,
+    compute_pod_resource_request_non_zero,
+)
+from .state.node_info import NodeInfo, PodInfo, _pod_host_ports, host_ports_conflict
+
+MAX_NODE_SCORE = 100
+UNSCHEDULABLE_TAINT = "node.kubernetes.io/unschedulable"
+MIN_IMG = 23 * 1024 * 1024
+MAX_IMG_PER_CONTAINER = 1000 * 1024 * 1024
+
+
+@dataclass
+class OracleConfig:
+    """Default plugin set + weights (apis/config/v1beta3/default_plugins.go:32-51)."""
+
+    fit_strategy: str = "LeastAllocated"
+    fit_resources: Dict[str, int] = field(default_factory=lambda: {"cpu": 1, "memory": 1})
+    hard_pod_affinity_weight: int = 1
+    weights: Dict[str, int] = field(
+        default_factory=lambda: {
+            "TaintToleration": 3,
+            "NodeAffinity": 2,
+            "PodTopologySpread": 2,
+            "InterPodAffinity": 2,
+            "NodeResourcesFit": 1,
+            "NodeResourcesBalancedAllocation": 1,
+            "ImageLocality": 1,
+        }
+    )
+    enable_min_domains: bool = True
+
+
+# --- individual plugin semantics (filter) ------------------------------------
+
+
+def fits_resources(pod: v1.Pod, info: NodeInfo) -> bool:
+    """fit.go:255-328 fitsRequest."""
+    req = compute_pod_resource_request(pod)
+    alloc, used = info.allocatable, info.requested
+    if len(info.pods) + 1 > alloc.allowed_pod_number:
+        return False
+    checks = [
+        (req.milli_cpu, alloc.milli_cpu - used.milli_cpu),
+        (req.memory, alloc.memory - used.memory),
+        (req.ephemeral_storage, alloc.ephemeral_storage - used.ephemeral_storage),
+    ]
+    for want, free in checks:
+        if want > 0 and want > free:
+            return False
+    for name, want in req.scalar_resources.items():
+        if want > 0 and want > alloc.scalar_resources.get(name, 0) - used.scalar_resources.get(name, 0):
+            return False
+    return True
+
+
+def tolerates_all_hard_taints(pod: v1.Pod, node: v1.Node) -> bool:
+    """taint_toleration.go:64-82 (NoSchedule/NoExecute only)."""
+    for taint in node.spec.taints:
+        if taint.effect == v1.TAINT_PREFER_NO_SCHEDULE:
+            continue
+        if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+            return False
+    return True
+
+
+def node_affinity_fits(pod: v1.Pod, node: v1.Node) -> bool:
+    """nodeaffinity Filter: nodeSelector AND requiredDuringScheduling."""
+    if pod.spec.node_selector:
+        for k, want in pod.spec.node_selector.items():
+            if node.metadata.labels.get(k) != want:
+                return False
+    aff = pod.spec.affinity
+    if aff and aff.node_affinity and aff.node_affinity.required is not None:
+        if not match_node_selector(aff.node_affinity.required, node):
+            return False
+    return True
+
+
+def node_name_fits(pod: v1.Pod, node: v1.Node) -> bool:
+    return not pod.spec.node_name or pod.spec.node_name == node.metadata.name
+
+
+def node_ports_fit(pod: v1.Pod, info: NodeInfo) -> bool:
+    return not host_ports_conflict(_pod_host_ports(pod), info.used_ports)
+
+
+def node_schedulable(pod: v1.Pod, node: v1.Node) -> bool:
+    if not node.spec.unschedulable:
+        return True
+    fake = v1.Taint(key=UNSCHEDULABLE_TAINT, effect=v1.TAINT_NO_SCHEDULE)
+    return any(t.tolerates(fake) for t in pod.spec.tolerations)
+
+
+# --- topology spread ----------------------------------------------------------
+
+
+def _spread_constraints(pod: v1.Pod, when: str) -> List[v1.TopologySpreadConstraint]:
+    return [c for c in pod.spec.topology_spread_constraints if c.when_unsatisfiable == when]
+
+
+def _count_matching(info: NodeInfo, selector, ns: str) -> int:
+    """countPodsMatchSelector: same namespace, non-terminating."""
+    n = 0
+    for pi in info.pods:
+        p = pi.pod
+        if p.namespace != ns or p.metadata.deletion_timestamp is not None:
+            continue
+        if selector is not None and match_label_selector(selector, p.metadata.labels):
+            n += 1
+    return n
+
+
+def _spread_counts(
+    pod: v1.Pod, node_infos: List[NodeInfo], constraints
+) -> Tuple[Dict[Tuple[str, str], int], Dict[str, int]]:
+    """TpPairToMatchNum over affinity-eligible nodes holding all keys
+    (filtering.go:256-289); also per-key domain counts."""
+    pair_counts: Dict[Tuple[str, str], int] = {}
+    domains: Dict[str, int] = {}
+    for info in node_infos:
+        node = info.node
+        if node is None or not node_affinity_fits(pod, node):
+            continue
+        if any(c.topology_key not in node.metadata.labels for c in constraints):
+            continue
+        for c in constraints:
+            pair = (c.topology_key, node.metadata.labels[c.topology_key])
+            if pair not in pair_counts:
+                pair_counts[pair] = 0
+                domains[c.topology_key] = domains.get(c.topology_key, 0) + 1
+            pair_counts[pair] += _count_matching(info, c.label_selector, pod.namespace)
+    return pair_counts, domains
+
+
+def topology_spread_fits(
+    pod: v1.Pod, info: NodeInfo, node_infos: List[NodeInfo],
+    enable_min_domains: bool = True,
+    prefilter=None,
+) -> bool:
+    """filtering.go:343-358. ``prefilter`` carries the per-pod counts computed
+    once per cycle (PreFilter), mirroring the reference's CycleState reuse."""
+    constraints = _spread_constraints(pod, v1.DO_NOT_SCHEDULE)
+    if not constraints:
+        return True
+    node = info.node
+    if prefilter is None:
+        prefilter = _spread_counts(pod, node_infos, constraints)
+    pair_counts, domains = prefilter
+    for c in constraints:
+        if c.topology_key not in node.metadata.labels:
+            return False
+        self_match = 1 if (
+            c.label_selector is not None
+            and match_label_selector(c.label_selector, pod.metadata.labels)
+        ) else 0
+        key_counts = [v for (k, _), v in pair_counts.items() if k == c.topology_key]
+        min_match = min(key_counts) if key_counts else (1 << 31)
+        if enable_min_domains and c.min_domains:
+            if domains.get(c.topology_key, 0) < c.min_domains:
+                min_match = 0
+        match_num = pair_counts.get(
+            (c.topology_key, node.metadata.labels[c.topology_key]), 0
+        )
+        if match_num + self_match - min_match > c.max_skew:
+            return False
+    return True
+
+
+def topology_spread_scores(
+    pod: v1.Pod, feasible: List[NodeInfo], node_infos: List[NodeInfo]
+) -> Dict[str, int]:
+    """scoring.go PreScore+Score+NormalizeScore over the feasible set."""
+    constraints = _spread_constraints(pod, v1.SCHEDULE_ANYWAY)
+    if not constraints:
+        # NormalizeScore still runs on the all-zero plane: maxScore == 0 → every
+        # node gets MaxNodeScore (scoring.go:245-248) — uniform, rank-neutral
+        return {ni.node_name: MAX_NODE_SCORE for ni in feasible}
+    # init: pairs among feasible nodes having all keys; ignored nodes
+    ignored = set()
+    pair_counts: Dict[Tuple[str, str], int] = {}
+    topo_size = {c.topology_key: 0 for c in constraints}
+    for info in feasible:
+        labels = info.node.metadata.labels
+        if any(c.topology_key not in labels for c in constraints):
+            ignored.add(info.node_name)
+            continue
+        for c in constraints:
+            pair = (c.topology_key, labels[c.topology_key])
+            if pair not in pair_counts:
+                pair_counts[pair] = 0
+                topo_size[c.topology_key] += 1
+    # count over all affinity-eligible nodes, restricted to known pairs
+    for info in node_infos:
+        node = info.node
+        if node is None or not node_affinity_fits(pod, node):
+            continue
+        labels = node.metadata.labels
+        if any(c.topology_key not in labels for c in constraints):
+            continue
+        for c in constraints:
+            pair = (c.topology_key, labels[c.topology_key])
+            if pair in pair_counts:
+                pair_counts[pair] += _count_matching(info, c.label_selector, pod.namespace)
+    weights = {
+        key: math.log(sz + 2) for key, sz in topo_size.items()
+    }
+    raw: Dict[str, Optional[int]] = {}
+    for info in feasible:
+        name = info.node_name
+        if name in ignored:
+            raw[name] = None
+            continue
+        score = 0.0
+        labels = info.node.metadata.labels
+        for c in constraints:
+            if c.topology_key in labels:
+                cnt = pair_counts.get((c.topology_key, labels[c.topology_key]), 0)
+                score += cnt * weights[c.topology_key] + (c.max_skew - 1)
+        raw[name] = int(round(score))
+    vals = [s for s in raw.values() if s is not None]
+    if not vals:
+        return {n: 0 for n in raw}
+    mx, mn = max(vals), min(vals)
+    out = {}
+    for name, s in raw.items():
+        if s is None:
+            out[name] = 0
+        elif mx == 0:
+            out[name] = MAX_NODE_SCORE
+        else:
+            out[name] = MAX_NODE_SCORE * (mx + mn - s) // mx
+    return out
+
+
+# --- inter-pod affinity -------------------------------------------------------
+
+
+def _term_matches_all(terms, owner: v1.Pod, target: v1.Pod, ns_labels) -> bool:
+    if not terms:
+        return False
+    return all(affinity_term_matches(t, owner, target, ns_labels) for t in terms)
+
+
+@dataclass
+class InterPodPreFilterState:
+    """preFilterState (filtering.go:44-55): the three topologyPair→count maps
+    plus the incoming pod's parsed terms, built ONCE per cycle."""
+
+    pod_info: PodInfo
+    exist_anti_pairs: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    aff_counts: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    anti_counts: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    self_match_all: bool = False
+
+
+def interpod_prefilter(
+    pod: v1.Pod, node_infos: List[NodeInfo],
+    namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None,
+) -> InterPodPreFilterState:
+    pi = PodInfo.of(pod)
+    s = InterPodPreFilterState(pod_info=pi)
+    # existing pods' required anti-affinity vs incoming (getExistingAntiAffinityCounts)
+    for other in node_infos:
+        if other.node is None:
+            continue
+        olabels = other.node.metadata.labels
+        for epi in other.pods_with_required_anti_affinity:
+            for term in epi.required_anti_affinity_terms:
+                if affinity_term_matches(term, epi.pod, pod, namespace_labels):
+                    tv = olabels.get(term.topology_key)
+                    if tv is not None:
+                        key = (term.topology_key, tv)
+                        s.exist_anti_pairs[key] = s.exist_anti_pairs.get(key, 0) + 1
+        # incoming's maps (getIncomingAffinityAntiAffinityCounts)
+        if pi.required_affinity_terms or pi.required_anti_affinity_terms:
+            for epi in other.pods:
+                if pi.required_affinity_terms and _term_matches_all(
+                    pi.required_affinity_terms, pod, epi.pod, namespace_labels
+                ):
+                    for term in pi.required_affinity_terms:
+                        tv = olabels.get(term.topology_key)
+                        if tv is not None:
+                            key = (term.topology_key, tv)
+                            s.aff_counts[key] = s.aff_counts.get(key, 0) + 1
+                for term in pi.required_anti_affinity_terms:
+                    if affinity_term_matches(term, pod, epi.pod, namespace_labels):
+                        tv = olabels.get(term.topology_key)
+                        if tv is not None:
+                            key = (term.topology_key, tv)
+                            s.anti_counts[key] = s.anti_counts.get(key, 0) + 1
+    s.self_match_all = _term_matches_all(
+        pi.required_affinity_terms, pod, pod, namespace_labels
+    )
+    return s
+
+
+def interpod_affinity_fits(
+    pod: v1.Pod, info: NodeInfo, node_infos: List[NodeInfo],
+    namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None,
+    prefilter: Optional[InterPodPreFilterState] = None,
+) -> bool:
+    """filtering.go:308-360 (three satisfy* checks) against the prefilter maps."""
+    s = prefilter or interpod_prefilter(pod, node_infos, namespace_labels)
+    pi = s.pod_info
+    labels = info.node.metadata.labels
+
+    # satisfyExistingPodsAntiAffinity (:308-320)
+    if s.exist_anti_pairs:
+        for key, value in labels.items():
+            if s.exist_anti_pairs.get((key, value), 0) > 0:
+                return False
+
+    # satisfyPodAntiAffinity (:323-335)
+    for term in pi.required_anti_affinity_terms:
+        tv = labels.get(term.topology_key)
+        if tv is not None and s.anti_counts.get((term.topology_key, tv), 0) > 0:
+            return False
+
+    # satisfyPodAffinity (:338-360)
+    if pi.required_affinity_terms:
+        pods_exist = True
+        for term in pi.required_affinity_terms:
+            tv = labels.get(term.topology_key)
+            if tv is None:
+                return False
+            if s.aff_counts.get((term.topology_key, tv), 0) <= 0:
+                pods_exist = False
+        if not pods_exist:
+            return bool(not s.aff_counts and s.self_match_all)
+    return True
+
+
+def interpod_affinity_scores(
+    pod: v1.Pod, feasible: List[NodeInfo], node_infos: List[NodeInfo],
+    hard_weight: int = 1,
+    namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None,
+) -> Dict[str, int]:
+    """scoring.go PreScore/Score/NormalizeScore."""
+    pi = PodInfo.of(pod)
+    has_pref = bool(pi.preferred_affinity_terms or pi.preferred_anti_affinity_terms)
+    pair_score: Dict[Tuple[str, str], float] = {}
+
+    def bump(term, w, node):
+        tv = node.metadata.labels.get(term.topology_key)
+        if tv is not None:
+            pair = (term.topology_key, tv)
+            pair_score[pair] = pair_score.get(pair, 0.0) + w
+
+    for other in node_infos:
+        node = other.node
+        if node is None or not node.metadata.labels:
+            continue
+        pods = other.pods if has_pref else other.pods_with_affinity
+        for epi in pods:
+            # incoming pod's preferred terms vs existing pod
+            for wt in pi.preferred_affinity_terms:
+                if affinity_term_matches(wt.pod_affinity_term, pod, epi.pod, namespace_labels):
+                    bump(wt.pod_affinity_term, wt.weight, node)
+            for wt in pi.preferred_anti_affinity_terms:
+                if affinity_term_matches(wt.pod_affinity_term, pod, epi.pod, namespace_labels):
+                    bump(wt.pod_affinity_term, -wt.weight, node)
+            # existing pod's hard affinity (symmetric weight)
+            if hard_weight > 0:
+                for term in epi.required_affinity_terms:
+                    if affinity_term_matches(term, epi.pod, pod, namespace_labels):
+                        bump(term, hard_weight, node)
+            # existing pod's preferred terms vs incoming
+            for wt in epi.preferred_affinity_terms:
+                if affinity_term_matches(wt.pod_affinity_term, epi.pod, pod, namespace_labels):
+                    bump(wt.pod_affinity_term, wt.weight, node)
+            for wt in epi.preferred_anti_affinity_terms:
+                if affinity_term_matches(wt.pod_affinity_term, epi.pod, pod, namespace_labels):
+                    bump(wt.pod_affinity_term, -wt.weight, node)
+
+    raw = {}
+    for info in feasible:
+        labels = info.node.metadata.labels
+        s = 0.0
+        for (key, val), w in pair_score.items():
+            if labels.get(key) == val:
+                s += w
+        raw[info.node_name] = int(s)
+    if not pair_score:
+        return {n: 0 for n in raw}
+    mx, mn = max(raw.values()), min(raw.values())
+    diff = mx - mn
+    return {
+        n: int(MAX_NODE_SCORE * (s - mn) / diff) if diff > 0 else 0
+        for n, s in raw.items()
+    }
+
+
+# --- scoring (simple plugins) -------------------------------------------------
+
+
+def least_allocated_score(pod: v1.Pod, info: NodeInfo, resources: Dict[str, int]) -> int:
+    req = compute_pod_resource_request_non_zero(pod)
+    score = 0
+    wsum = 0
+    for name, w in resources.items():
+        alloc = info.allocatable.get(name)
+        if alloc == 0:
+            continue
+        used = info.non_zero_requested.get(name) + req.get(name)
+        if name not in ("cpu", "memory", "ephemeral-storage") and req.get(name) == 0:
+            continue
+        r = 0 if used > alloc else (alloc - used) * MAX_NODE_SCORE // alloc
+        score += r * w
+        wsum += w
+    return score // wsum if wsum else 0
+
+
+def balanced_allocation_score(pod: v1.Pod, info: NodeInfo, resources: Dict[str, int]) -> int:
+    req = compute_pod_resource_request(pod)
+    fractions = []
+    for name in resources:
+        alloc = info.allocatable.get(name)
+        if alloc == 0:
+            continue
+        if name not in ("cpu", "memory", "ephemeral-storage") and req.get(name) == 0:
+            continue
+        f = (info.requested.get(name) + req.get(name)) / alloc
+        fractions.append(min(f, 1.0))
+    if not fractions:
+        return 0
+    if len(fractions) == 2:
+        std = abs(fractions[0] - fractions[1]) / 2
+    elif len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    else:
+        std = 0.0
+    return int((1 - std) * MAX_NODE_SCORE)
+
+
+def taint_toleration_score(pod: v1.Pod, node: v1.Node) -> int:
+    """Count of intolerable PreferNoSchedule taints (raw, pre-normalize)."""
+    tols = [
+        t for t in pod.spec.tolerations
+        if not t.effect or t.effect == v1.TAINT_PREFER_NO_SCHEDULE
+    ]
+    n = 0
+    for taint in node.spec.taints:
+        if taint.effect != v1.TAINT_PREFER_NO_SCHEDULE:
+            continue
+        if not any(t.tolerates(taint) for t in tols):
+            n += 1
+    return n
+
+
+def node_affinity_score(pod: v1.Pod, node: v1.Node) -> int:
+    aff = pod.spec.affinity
+    if not aff or not aff.node_affinity:
+        return 0
+    from .api.labels import match_node_selector_term
+
+    s = 0
+    for term in aff.node_affinity.preferred:
+        if match_node_selector_term(term.preference, node):
+            s += term.weight
+    return s
+
+
+def image_locality_score(pod: v1.Pod, info: NodeInfo, node_infos: List[NodeInfo]) -> int:
+    total = sum(1 for ni in node_infos if ni.node is not None)
+    spread: Dict[str, int] = {}
+    for ni in node_infos:
+        for img in ni.image_states:
+            spread[img] = spread.get(img, 0) + 1
+    s = 0
+    for c in pod.spec.containers:
+        if c.image in info.image_states:
+            s += int(info.image_states[c.image] * spread.get(c.image, 0) / max(total, 1))
+    n_containers = max(len(pod.spec.containers), 1)
+    max_t = MAX_IMG_PER_CONTAINER * n_containers
+    s = min(max(s, MIN_IMG), max_t)
+    return MAX_NODE_SCORE * (s - MIN_IMG) // (max_t - MIN_IMG)
+
+
+def default_normalize(raw: Dict[str, int], reverse: bool = False) -> Dict[str, int]:
+    mx = max(raw.values(), default=0)
+    if mx == 0:
+        return {k: (MAX_NODE_SCORE if reverse else 0) for k in raw}
+    out = {}
+    for k, s in raw.items():
+        v = s * MAX_NODE_SCORE // mx
+        out[k] = MAX_NODE_SCORE - v if reverse else v
+    return out
+
+
+# --- the oracle scheduler -----------------------------------------------------
+
+
+class Oracle:
+    """One-pod-at-a-time reference scheduler over host NodeInfos."""
+
+    def __init__(self, cfg: Optional[OracleConfig] = None,
+                 namespace_labels: Optional[Mapping[str, Mapping[str, str]]] = None):
+        self.cfg = cfg or OracleConfig()
+        self.namespace_labels = namespace_labels
+
+    def feasible_nodes(self, pod: v1.Pod, node_infos: List[NodeInfo]) -> List[NodeInfo]:
+        # PreFilter once per pod (the reference's CycleState), Filter per node
+        hard_constraints = _spread_constraints(pod, v1.DO_NOT_SCHEDULE)
+        spread_state = (
+            _spread_counts(pod, node_infos, hard_constraints)
+            if hard_constraints else None
+        )
+        ipa_state = interpod_prefilter(pod, node_infos, self.namespace_labels)
+        out = []
+        for info in node_infos:
+            node = info.node
+            if node is None:
+                continue
+            if not node_name_fits(pod, node):
+                continue
+            if not node_schedulable(pod, node):
+                continue
+            if not node_affinity_fits(pod, node):
+                continue
+            if not tolerates_all_hard_taints(pod, node):
+                continue
+            if not node_ports_fit(pod, info):
+                continue
+            if not fits_resources(pod, info):
+                continue
+            if not topology_spread_fits(
+                pod, info, node_infos, self.cfg.enable_min_domains,
+                prefilter=spread_state,
+            ):
+                continue
+            if not interpod_affinity_fits(
+                pod, info, node_infos, self.namespace_labels, prefilter=ipa_state
+            ):
+                continue
+            out.append(info)
+        return out
+
+    def score_nodes(
+        self, pod: v1.Pod, feasible: List[NodeInfo], node_infos: List[NodeInfo]
+    ) -> Dict[str, int]:
+        cfg = self.cfg
+        w = cfg.weights
+        totals = {ni.node_name: 0 for ni in feasible}
+
+        fit_raw = {
+            ni.node_name: least_allocated_score(pod, ni, cfg.fit_resources)
+            for ni in feasible
+        }
+        bal_raw = {
+            ni.node_name: balanced_allocation_score(pod, ni, cfg.fit_resources)
+            for ni in feasible
+        }
+        taint_raw = default_normalize(
+            {ni.node_name: taint_toleration_score(pod, ni.node) for ni in feasible},
+            reverse=True,
+        )
+        na_raw = default_normalize(
+            {ni.node_name: node_affinity_score(pod, ni.node) for ni in feasible}
+        )
+        img_raw = {
+            ni.node_name: image_locality_score(pod, ni, node_infos) for ni in feasible
+        }
+        ts = topology_spread_scores(pod, feasible, node_infos)
+        ipa = interpod_affinity_scores(
+            pod, feasible, node_infos, cfg.hard_pod_affinity_weight,
+            self.namespace_labels,
+        )
+        for name in totals:
+            totals[name] = (
+                w.get("NodeResourcesFit", 1) * fit_raw[name]
+                + w.get("NodeResourcesBalancedAllocation", 1) * bal_raw[name]
+                + w.get("TaintToleration", 3) * taint_raw[name]
+                + w.get("NodeAffinity", 2) * na_raw[name]
+                + w.get("ImageLocality", 1) * img_raw[name]
+                + w.get("PodTopologySpread", 2) * ts.get(name, 0)
+                + w.get("InterPodAffinity", 2) * ipa.get(name, 0)
+            )
+        return totals
+
+    def schedule_one(self, pod: v1.Pod, node_infos: List[NodeInfo]) -> Optional[str]:
+        """Filter + score + first-max selection (deterministic tie-break by node
+        order, matching the device path's lowest-row argmax)."""
+        feasible = self.feasible_nodes(pod, node_infos)
+        if not feasible:
+            return None
+        scores = self.score_nodes(pod, feasible, node_infos)
+        best, best_score = None, None
+        for info in node_infos:  # node order = snapshot order for tie parity
+            name = info.node_name
+            if name in scores and (best_score is None or scores[name] > best_score):
+                best, best_score = name, scores[name]
+        return best
+
+    def schedule_batch(
+        self, pods: List[v1.Pod], node_infos: List[NodeInfo]
+    ) -> List[Optional[str]]:
+        """Sequential schedule-and-assume over a pod list (mutates node_infos)."""
+        out = []
+        by_name = {ni.node_name: ni for ni in node_infos}
+        for pod in pods:
+            target = self.schedule_one(pod, node_infos)
+            out.append(target)
+            if target is not None:
+                pod.spec.node_name = target
+                by_name[target].add_pod(pod)
+        return out

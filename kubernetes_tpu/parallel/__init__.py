@@ -1,0 +1,8 @@
+"""Device-mesh sharding of the scheduling computation."""
+
+from .mesh import (  # noqa: F401
+    node_sharded_mesh,
+    shard_snapshot,
+    replicate,
+    NODE_AXIS,
+)

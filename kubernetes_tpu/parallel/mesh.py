@@ -1,0 +1,66 @@
+"""Node-axis sharding over a jax.sharding.Mesh.
+
+SURVEY §2.5: the reference parallelizes Filter/Score by fanning goroutines over
+the node dimension (parallelize/parallelism.go, 16 workers).  Here the same axis
+becomes a *mesh axis*: every per-node array of the DeviceSnapshot is sharded on
+dim 0 across chips, so the ``[B, N]`` feasibility/score planes are computed
+shard-local and the few cross-node reductions (row max/min in normalize,
+argmax in select_host, domain scatter-adds) lower to XLA collectives over ICI.
+This is the structural analog of sequence parallelism with "sequence" = nodes
+(SURVEY §5 long-context note): a 100k-node cluster is scored densely in one
+shot instead of sampled (scheduler.go:852-872).
+
+GSPMD does the partitioning: we annotate inputs (shard_snapshot) and jit the
+unchanged runtime program; XLA inserts all-reduce / all-gather where the
+reductions cross the node axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def node_sharded_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def replicate(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def _node_spec(ndim: int) -> P:
+    return P(NODE_AXIS, *([None] * (ndim - 1)))
+
+
+def shard_snapshot(snap, mesh: Mesh):
+    """device_put every per-node array with dim-0 node sharding; the pod tables
+    and the dictionary side-table are replicated (they are small and read by
+    every shard)."""
+    from ..state.encoding import _NODE_ARRAYS
+
+    node_fields = set(_NODE_ARRAYS)
+    out = {}
+    for name in snap.__dataclass_fields__:
+        arr = getattr(snap, name)
+        if name in node_fields:
+            sharding = NamedSharding(mesh, _node_spec(arr.ndim))
+        else:
+            sharding = replicate(mesh)
+        out[name] = jax.device_put(arr, sharding)
+    return type(snap)(**out)
+
+
+def shard_dynamic_state(dyn, mesh: Mesh):
+    from ..framework.interface import DynamicState
+
+    return DynamicState(
+        requested=jax.device_put(dyn.requested, NamedSharding(mesh, _node_spec(2))),
+        non_zero=jax.device_put(dyn.non_zero, NamedSharding(mesh, _node_spec(2))),
+    )

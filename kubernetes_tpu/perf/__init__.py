@@ -1,0 +1,3 @@
+"""Benchmark harness (reference: test/integration/scheduler_perf)."""
+
+from .harness import Workload, Op, run_workload, DataItem  # noqa: F401

@@ -1,0 +1,158 @@
+"""Config-driven workload DSL + metric collection.
+
+Reference: test/integration/scheduler_perf —
+  opcodes createNodes/createPods/createNamespaces/churn/barrier
+    (scheduler_perf_test.go:60-71)
+  throughput collector: scheduled-pods/s sampled at 1s
+    (util.go:278-345, label SchedulingThroughput)
+  histogram quantiles p50/p90/p95/p99 from the in-process registry
+    (util.go:238-276), emitted as perf-dashboard DataItems (util.go:165)
+
+The workload runs against the in-process sim store + TPUScheduler — the analog
+of the reference's in-proc apiserver+etcd with API-object-only nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..api import objects as v1
+from ..metrics import scheduler_metrics as m
+from ..scheduler import TPUScheduler
+from ..sim.store import ObjectStore
+from ..testutil import make_node, make_pod
+
+
+@dataclass
+class Op:
+    """One opcode. kinds: createNodes | createPods | barrier | churn."""
+
+    opcode: str
+    count: int = 0
+    node_template: Optional[Callable[[int], v1.Node]] = None
+    pod_template: Optional[Callable[[int], v1.Pod]] = None
+    collect_metrics: bool = False
+    churn_deletes: int = 0
+
+
+@dataclass
+class Workload:
+    name: str
+    ops: List[Op] = field(default_factory=list)
+    batch_size: int = 64
+
+
+@dataclass
+class DataItem:
+    labels: Dict[str, str]
+    data: Dict[str, float]
+    unit: str
+
+    def to_dict(self):
+        return {"labels": self.labels, "data": self.data, "unit": self.unit}
+
+
+def default_node(i: int) -> v1.Node:
+    return (
+        make_node().name(f"node-{i:06d}")
+        .capacity({"cpu": "32", "memory": "64Gi", "pods": "110"})
+        .label("topology.kubernetes.io/zone", f"zone-{i % 16}")
+        .obj()
+    )
+
+
+def default_pod(i: int) -> v1.Pod:
+    return (
+        make_pod().name(f"pod-{i:06d}").uid(f"pod-{i:06d}").namespace("default")
+        .label("app", f"app-{i % 10}")
+        .req({"cpu": "1", "memory": "2Gi"})
+        .obj()
+    )
+
+
+def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
+    from ..metrics.registry import default_registry
+
+    default_registry.reset()
+    # re-bind module-level metric objects after reset
+    import importlib
+    importlib.reload(m)
+
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=w.batch_size)
+    items: List[DataItem] = []
+    node_idx = 0
+    pod_idx = 0
+    for op in w.ops:
+        if op.opcode == "createNodes":
+            tmpl = op.node_template or default_node
+            for _ in range(op.count):
+                store.create("Node", tmpl(node_idx))
+                node_idx += 1
+        elif op.opcode == "createPods":
+            tmpl = op.pod_template or default_pod
+            created = []
+            for _ in range(op.count):
+                p = tmpl(pod_idx)
+                store.create("Pod", p)
+                created.append(p)
+                pod_idx += 1
+            if op.collect_metrics:
+                scheduled_counts = []
+                t0 = clock()
+                last = 0
+                while True:
+                    stats = sched.schedule_cycle()
+                    done = sum(
+                        1 for p in created
+                        if (store.get("Pod", p.namespace, p.metadata.name) or p).spec.node_name
+                    )
+                    scheduled_counts.append((clock() - t0, done))
+                    if stats.attempted == 0 or done == len(created):
+                        break
+                total_s = clock() - t0
+                n_done = scheduled_counts[-1][1]
+                throughput = n_done / total_s if total_s > 0 else 0.0
+                items.append(DataItem(
+                    labels={"Name": w.name, "Metric": "SchedulingThroughput"},
+                    data={"Average": round(throughput, 1)},
+                    unit="pods/s",
+                ))
+                hist = m.scheduling_attempt_duration
+                items.append(DataItem(
+                    labels={
+                        "Name": w.name,
+                        "Metric": "scheduler_scheduling_attempt_duration_seconds",
+                    },
+                    data={
+                        "Perc50": hist.quantile(0.50),
+                        "Perc90": hist.quantile(0.90),
+                        "Perc95": hist.quantile(0.95),
+                        "Perc99": hist.quantile(0.99),
+                        "Average": hist.sum() / max(hist.count(), 1),
+                    },
+                    unit="s",
+                ))
+            else:
+                sched.run_until_idle()
+        elif op.opcode == "barrier":
+            sched.run_until_idle()
+        elif op.opcode == "churn":
+            pods, _ = store.list("Pod")
+            rng = np.random.default_rng(0)
+            for p in rng.choice(pods, size=min(op.churn_deletes, len(pods)), replace=False):
+                store.delete("Pod", p.namespace, p.metadata.name)
+            sched.run_until_idle()
+        else:
+            raise ValueError(f"unknown opcode {op.opcode}")
+    return items
+
+
+def data_items_to_json(items: List[DataItem]) -> str:
+    """Perf-dashboard JSON shape (util.go:165 dataItems2JSONFile)."""
+    return json.dumps({"version": "v1", "dataItems": [i.to_dict() for i in items]})

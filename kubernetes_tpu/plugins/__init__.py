@@ -1,0 +1,29 @@
+"""Vectorized default plugin set.
+
+Reference: pkg/scheduler/framework/plugins/ (registry.go:47-81).  Each plugin here
+is a batched tensor program: Filter returns ``bool[B, N]``, Score ``float32[B, N]``,
+computed for the whole PodBatch × DeviceSnapshot plane in one fused XLA program.
+"""
+
+from .noderesources import FitPlugin, BalancedAllocationPlugin  # noqa: F401
+from .tainttoleration import TaintTolerationPlugin  # noqa: F401
+from .nodeaffinity import NodeAffinityPlugin  # noqa: F401
+from .trivial import (  # noqa: F401
+    NodeNamePlugin,
+    NodePortsPlugin,
+    NodeUnschedulablePlugin,
+    ImageLocalityPlugin,
+)
+from .podtopologyspread import PodTopologySpreadPlugin  # noqa: F401
+from .interpodaffinity import InterPodAffinityPlugin  # noqa: F401
+
+DEFAULT_PLUGIN_WEIGHTS = {
+    # apis/config/v1beta3/default_plugins.go:32-51
+    "TaintToleration": 3,
+    "NodeAffinity": 2,
+    "PodTopologySpread": 2,
+    "InterPodAffinity": 2,
+    "NodeResourcesFit": 1,
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+}

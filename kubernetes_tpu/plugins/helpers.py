@@ -1,0 +1,86 @@
+"""Shared device helpers for plugin tensor programs."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.interface import MAX_NODE_SCORE
+from ..state.selectors import eval_label_selector, eval_requirements
+
+
+def label_selector_matrix(cs, node_keys, node_vals, numeric):
+    """CompiledLabelSelectors (batch B) × node label sets [N, L] → bool[B, N]."""
+    b = cs.req_key.shape[0]
+
+    def one_sel(i):
+        return jax.vmap(
+            lambda keys, vals: eval_label_selector(cs, i, keys, vals, numeric)
+        )(node_keys, node_vals)
+
+    return jax.vmap(one_sel)(jnp.arange(b))
+
+
+def node_selector_matrix(cns, node_keys, node_vals, numeric):
+    """CompiledNodeSelectors (batch B) × node label sets [N, L] → bool[B, N].
+
+    OR over valid terms, AND over each term's requirements; match_all rows → True.
+    """
+    rk = jnp.asarray(cns.req_key)      # [B, T, S]
+    ro = jnp.asarray(cns.req_op)
+    rv = jnp.asarray(cns.req_vals)     # [B, T, S, V]
+    rn = jnp.asarray(cns.req_num)
+    tv = jnp.asarray(cns.term_valid)   # [B, T]
+    ma = jnp.asarray(cns.match_all)    # [B]
+
+    def one_node(keys, vals):
+        per_term = jax.vmap(
+            jax.vmap(lambda k, o, v, n: eval_requirements(k, o, v, n, keys, vals, numeric))
+        )(rk, ro, rv, rn)  # [B, T]
+        return ma | jnp.any(per_term & tv, axis=-1)  # [B]
+
+    return jax.vmap(one_node, out_axes=1)(node_keys, node_vals)  # [B, N]
+
+
+def weighted_term_matrix(req_key, req_op, req_vals, req_num, term_valid, weight,
+                         node_keys, node_vals, numeric):
+    """Preferred-term arrays [B, T, ...] × nodes [N, L] → f32[B, N] summed weights
+    of matching terms (nodeaffinity/node_affinity.go Score)."""
+
+    def one_node(keys, vals):
+        match = jax.vmap(
+            jax.vmap(lambda k, o, v, n: eval_requirements(k, o, v, n, keys, vals, numeric))
+        )(jnp.asarray(req_key), jnp.asarray(req_op),
+          jnp.asarray(req_vals), jnp.asarray(req_num))  # [B, T]
+        return jnp.sum(jnp.where(match & term_valid, weight, 0.0), axis=-1)  # [B]
+
+    return jax.vmap(one_node, out_axes=1)(node_keys, node_vals)  # [B, N]
+
+
+def flat_selector_matrix(cs, b, t, keys, vals, numeric):
+    """Flattened CompiledLabelSelectors (batch b·t, row-major) × label sets
+    [P, L] → bool[b, t, P]."""
+
+    def one_sel(fi):
+        return jax.vmap(
+            lambda k, v: eval_label_selector(cs, fi, k, v, numeric)
+        )(keys, vals)
+
+    return jax.vmap(one_sel)(jnp.arange(b * t)).reshape(b, t, -1)
+
+
+def default_normalize(scores, mask, reverse: bool = False):
+    """framework.DefaultNormalizeScore: scale per-pod row to [0, MaxNodeScore] by
+    the row max over feasible nodes; reverse flips (max - score)."""
+    neg = jnp.where(mask, scores, -jnp.inf)
+    row_max = jnp.max(neg, axis=-1, keepdims=True)  # [B, 1]
+    row_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    zero_max = row_max == 0
+    # floor before the reverse: the reference computes score*max/maxCount with
+    # int64 division, then maxPriority − score
+    scaled = jnp.floor(scores * MAX_NODE_SCORE / jnp.where(zero_max, 1.0, row_max))
+    scaled = jnp.where(
+        zero_max, jnp.where(reverse, float(MAX_NODE_SCORE), 0.0),
+        jnp.where(reverse, MAX_NODE_SCORE - scaled, scaled),
+    )
+    return scaled

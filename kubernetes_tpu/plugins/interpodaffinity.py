@@ -1,0 +1,356 @@
+"""InterPodAffinity as a batched tensor program with in-scan updates.
+
+Reference: pkg/scheduler/framework/plugins/interpodaffinity/
+  filtering.go:44-55,187-266 — PreFilter builds 3 topologyPair→count maps:
+      existingAntiAffinityCounts (existing pods' req anti terms vs incoming pod),
+      affinityCounts (existing pods matching ALL of incoming's req affinity terms),
+      antiAffinityCounts (incoming's req anti terms vs existing pods, per term)
+  filtering.go:308-360 — Filter: the three satisfy* checks, incl. the
+      "first pod in a series" escape (affinityCounts empty + self-match)
+  scoring.go:49-123   — PreScore accumulates weighted pair scores from 4 term
+      sources (incoming pref ±, existing req×HardPodAffinityWeight, existing pref ±)
+  scoring.go:255+     — NormalizeScore: 100·(s−min)/(max−min)
+
+Device design: the *incoming* batch's term groups are compiled arrays, so the
+incoming-vs-existing maps are matmuls + domain scatter-adds; the sparse
+*existing-pods'-own-terms* contributions (exist-anti blocks, symmetric score
+terms) are precomputed host-side over HavePodsWith(Required)AffinityList —
+mirroring exactly which pods the reference walks (scoring.go:149-159).
+In-scan, cross-match tensors between pending pods update the tables/planes in
+O(B·N) per placement — the device analog of preFilterState.updateWithPod
+(filtering.go:74-85).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.labels import affinity_term_matches
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import MAX_NODE_SCORE, Plugin
+from ..state.dictionary import MISSING
+from .helpers import flat_selector_matrix
+
+DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1  # apis/config InterPodAffinityArgs default
+
+
+class IPAAux(NamedTuple):
+    # domain index of each node under each term's topology key; D = trash slot
+    dom_aff: jnp.ndarray  # i32[B, T1, N]
+    dom_anti: jnp.ndarray  # i32[B, T2, N]
+    dom_paff: jnp.ndarray  # i32[B, T3, N]
+    dom_panti: jnp.ndarray  # i32[B, T4, N]
+    # count tables (trash slot at D absorbs missing keys)
+    aff_counts: jnp.ndarray  # i32[B, T1, D+1]
+    anti_counts: jnp.ndarray  # i32[B, T2, D+1]
+    paff_counts: jnp.ndarray  # i32[B, T3, D+1]
+    panti_counts: jnp.ndarray  # i32[B, T4, D+1]
+    aff_total: jnp.ndarray  # i32[B] Σ affinityCounts (len()==0 test)
+    self_match_all: jnp.ndarray  # bool[B]
+    # host-precomputed static planes
+    exist_anti_block: jnp.ndarray  # bool[B, N]
+    score_static: jnp.ndarray  # f32[B, N]
+    # cross-match tensors between pending pods (for in-scan updates)
+    aff_term_cross: jnp.ndarray  # bool[B, T1, B] term t of pod b matches pod j
+    aff_cross_all: jnp.ndarray  # bool[B, B] pod j matches ALL req-aff terms of b
+    anti_cross: jnp.ndarray  # bool[B, T2, B]
+    paff_cross: jnp.ndarray  # bool[B, T3, B]
+    panti_cross: jnp.ndarray  # bool[B, T4, B]
+    # dynamic planes accumulated during the scan
+    block_dyn: jnp.ndarray  # bool[B, N]
+    score_dyn: jnp.ndarray  # f32[B, N]
+
+
+class InterPodAffinityPlugin(Plugin):
+    name = "InterPodAffinity"
+
+    def __init__(self, domain_cap: int = 256,
+                 hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+        self.domain_cap = domain_cap
+        self.hard_weight = float(hard_pod_affinity_weight)
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.POD, ActionType.ALL),
+            ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+    # --- host precompute ------------------------------------------------------
+
+    def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
+        """Existing pods' own (anti)affinity terms → static block/score planes.
+
+        Walks only HavePodsWithRequiredAntiAffinityList / HavePodsWithAffinityList
+        (sparse), like the reference.
+        """
+        b = batch.size
+        n = encoder._n
+        block = np.zeros((b, n), dtype=bool)
+        score = np.zeros((b, n), dtype=np.float32)
+        node_topo = encoder.node_topo
+
+        def domain_nodes(key: str, node_name: str):
+            slot = encoder.topo_slot(key)
+            row = encoder.node_rows.get(node_name)
+            if row is None:
+                return None
+            val = node_topo[row, slot]
+            if val == MISSING:
+                return None
+            return node_topo[:, slot] == val
+
+        def apply(pi, terms, sign_weights, target_score):
+            info_node = pi.pod.spec.node_name
+            for term, w in zip(terms, sign_weights):
+                nmask = domain_nodes(term.topology_key, info_node)
+                if nmask is None:
+                    continue
+                for i, pod in enumerate(batch.pods):
+                    if affinity_term_matches(term, pi.pod, pod, namespace_labels):
+                        target_score[i][nmask] += w
+
+        for info in snapshot.have_pods_with_required_anti_affinity_list:
+            for pi in info.pods_with_required_anti_affinity:
+                for term in pi.required_anti_affinity_terms:
+                    nmask = domain_nodes(term.topology_key, pi.pod.spec.node_name)
+                    if nmask is None:
+                        continue
+                    for i, pod in enumerate(batch.pods):
+                        if affinity_term_matches(term, pi.pod, pod, namespace_labels):
+                            block[i][nmask] = True
+
+        for info in snapshot.have_pods_with_affinity_list:
+            for pi in info.pods_with_affinity:
+                if self.hard_weight > 0:
+                    apply(pi, pi.required_affinity_terms,
+                          [self.hard_weight] * len(pi.required_affinity_terms), score)
+                apply(pi, [wt.pod_affinity_term for wt in pi.preferred_affinity_terms],
+                      [float(wt.weight) for wt in pi.preferred_affinity_terms], score)
+                apply(pi, [wt.pod_affinity_term for wt in pi.preferred_anti_affinity_terms],
+                      [-float(wt.weight) for wt in pi.preferred_anti_affinity_terms], score)
+
+        return {"exist_anti_block": block, "score_static": score}
+
+    # --- device prepare -------------------------------------------------------
+
+    def _group_arrays(self, group, snap):
+        """dom [B, T, N] with trash slot, plus validity."""
+        d = self.domain_cap
+        key = jnp.clip(group.topo_key, 0, snap.node_topo.shape[1] - 1)
+        dom = jnp.transpose(snap.node_topo[:, key], (1, 2, 0))  # [B, T, N]
+        has = (dom != MISSING) & jnp.asarray(group.valid)[:, :, None]
+        return jnp.where(has, jnp.clip(dom, 0, d - 1), d)
+
+    def _match_vs(self, group, keys, vals, ns, numeric):
+        """Term (b, t) matches target pods → bool[B, T, P] (validity + ns + selector)."""
+        b, t = group.valid.shape
+        m = flat_selector_matrix(group.selectors, b, t, keys, vals, numeric)
+        ns_ok = jnp.asarray(group.all_namespaces)[:, :, None] | jnp.any(
+            jnp.asarray(group.ns_ids)[:, :, :, None] == ns[None, None, None, :],
+            axis=2,
+        )
+        return m & ns_ok & jnp.asarray(group.valid)[:, :, None]
+
+    def _counts(self, match, dom, pod_node, pod_valid):
+        """Scatter per-term matches of scheduled pods into domain tables."""
+        d = self.domain_cap
+        b, t, _p = match.shape
+        n = dom.shape[-1]
+        prow = jnp.clip(pod_node, 0, n - 1)
+        pod_dom = jnp.take_along_axis(
+            dom, jnp.broadcast_to(prow[None, None, :], match.shape), axis=-1
+        )  # [B, T, P] domain of each pod's node under term key
+        ok = match & pod_valid[None, None, :] & (pod_node >= 0)[None, None, :]
+        tbl = jnp.zeros((b, t, d + 1), jnp.int32)
+        return tbl.at[
+            jnp.arange(b)[:, None, None],
+            jnp.arange(t)[None, :, None],
+            jnp.where(ok, pod_dom, d),
+        ].add(ok.astype(jnp.int32))
+
+    def prepare(self, batch, snap, dyn, host_aux=None) -> IPAAux:
+        d = self.domain_cap
+        b = batch.valid.shape[0]
+        n = snap.num_nodes
+        g_aff, g_anti = batch.req_affinity, batch.req_anti_affinity
+        g_paff, g_panti = batch.pref_affinity, batch.pref_anti_affinity
+
+        dom_aff = self._group_arrays(g_aff, snap)
+        dom_anti = self._group_arrays(g_anti, snap)
+        dom_paff = self._group_arrays(g_paff, snap)
+        dom_panti = self._group_arrays(g_panti, snap)
+
+        num = snap.numeric
+        m_aff = self._match_vs(g_aff, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
+        m_anti = self._match_vs(g_anti, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
+        m_paff = self._match_vs(g_paff, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
+        m_panti = self._match_vs(g_panti, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
+
+        # affinityCounts: pods matching ALL req-affinity terms, bumped per term
+        has_terms = jnp.any(jnp.asarray(g_aff.valid), axis=1)  # [B]
+        all_match = (
+            jnp.all(m_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
+            & has_terms[:, None]
+        )  # [B, P]
+        m_aff_all = jnp.broadcast_to(all_match[:, None, :], m_aff.shape) & jnp.asarray(
+            g_aff.valid
+        )[:, :, None]
+
+        aff_counts = self._counts(m_aff_all, dom_aff, snap.pod_node, snap.pod_valid)
+        anti_counts = self._counts(m_anti, dom_anti, snap.pod_node, snap.pod_valid)
+        paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid)
+        panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid)
+        aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
+
+        # cross tensors vs pending pods
+        x_aff = self._match_vs(g_aff, batch.label_keys, batch.label_vals, batch.ns, num)
+        x_anti = self._match_vs(g_anti, batch.label_keys, batch.label_vals, batch.ns, num)
+        x_paff = self._match_vs(g_paff, batch.label_keys, batch.label_vals, batch.ns, num)
+        x_panti = self._match_vs(g_panti, batch.label_keys, batch.label_vals, batch.ns, num)
+        x_aff_all = (
+            jnp.all(x_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
+            & has_terms[:, None]
+            & batch.valid[None, :]
+        )  # [B, B]
+        diag = jnp.arange(b)
+        self_match_all = x_aff_all[diag, diag]
+
+        if host_aux is None:
+            host_aux = {
+                "exist_anti_block": jnp.zeros((b, n), bool),
+                "score_static": jnp.zeros((b, n), jnp.float32),
+            }
+        return IPAAux(
+            dom_aff=dom_aff, dom_anti=dom_anti, dom_paff=dom_paff, dom_panti=dom_panti,
+            aff_counts=aff_counts, anti_counts=anti_counts,
+            paff_counts=paff_counts, panti_counts=panti_counts,
+            aff_total=aff_total, self_match_all=self_match_all,
+            exist_anti_block=jnp.asarray(host_aux["exist_anti_block"]),
+            score_static=jnp.asarray(host_aux["score_static"]),
+            aff_term_cross=x_aff, aff_cross_all=x_aff_all, anti_cross=x_anti,
+            paff_cross=x_paff, panti_cross=x_panti,
+            block_dyn=jnp.zeros((b, n), bool),
+            score_dyn=jnp.zeros((b, n), jnp.float32),
+        )
+
+    # --- filter ---------------------------------------------------------------
+
+    def filter(self, batch, snap, dyn, aux: IPAAux):
+        d = self.domain_cap
+        g_aff_valid = jnp.asarray(batch.req_affinity.valid)  # [B, T1]
+        g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
+
+        # incoming required affinity (satisfyPodAffinity, filtering.go:338-360)
+        cnt = jnp.take_along_axis(aux.aff_counts, aux.dom_aff, axis=-1)  # [B, T1, N]
+        key_ok = aux.dom_aff < d
+        keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)  # [B, N]
+        pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
+        first_pod = (aux.aff_total == 0) & aux.self_match_all  # [B]
+        aff_ok = keys_all & (pods_exist | first_pod[:, None])
+
+        # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
+        acnt = jnp.take_along_axis(aux.anti_counts, aux.dom_anti, axis=-1)
+        anti_bad = jnp.any(
+            g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0), axis=1
+        )
+
+        return aff_ok & ~anti_bad & ~aux.exist_anti_block & ~aux.block_dyn
+
+    # --- score ----------------------------------------------------------------
+
+    def score(self, batch, snap, dyn, aux: IPAAux, mask=None):
+        d = self.domain_cap
+        w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
+        w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
+        c_paff = jnp.take_along_axis(aux.paff_counts, aux.dom_paff, axis=-1)  # [B,T3,N]
+        c_panti = jnp.take_along_axis(aux.panti_counts, aux.dom_panti, axis=-1)
+        own = (
+            jnp.sum(jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0), axis=1)
+            - jnp.sum(jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0), axis=1)
+        )
+        return own + aux.score_static + aux.score_dyn
+
+    def normalize(self, scores, mask):
+        """100·(s−min)/(max−min) over feasible nodes (scoring.go:255+)."""
+        big = jnp.where(mask, scores, -jnp.inf)
+        small = jnp.where(mask, scores, jnp.inf)
+        mx = jnp.max(big, axis=-1, keepdims=True)
+        mn = jnp.min(small, axis=-1, keepdims=True)
+        diff = mx - mn
+        ok = jnp.isfinite(diff) & (diff > 0)
+        return jnp.where(
+            ok & mask, MAX_NODE_SCORE * (scores - jnp.where(ok, mn, 0.0))
+            / jnp.where(ok, diff, 1.0), 0.0
+        )
+
+    # --- in-scan update -------------------------------------------------------
+
+    def update(self, aux: IPAAux, i, node_row, batch, snap):
+        """Pod i placed on node_row — the device analog of updateWithPod."""
+        d = self.domain_cap
+        b = aux.aff_cross_all.shape[0]
+        t1 = aux.dom_aff.shape[1]
+        t2 = aux.dom_anti.shape[1]
+
+        # 1) pending pods' affinityCounts: j gains where i matches ALL j's terms
+        dom_at_aff = aux.dom_aff[:, :, node_row]  # [B, T1]
+        inc_aff = (
+            aux.aff_cross_all[:, i][:, None]
+            & jnp.asarray(batch.req_affinity.valid)
+            & (dom_at_aff < d)
+        ).astype(jnp.int32)
+        aff_counts = aux.aff_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(t1)[None, :], dom_at_aff
+        ].add(inc_aff)
+        aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
+
+        # 2) pending pods' antiAffinityCounts (their own terms vs placed pod i)
+        dom_at_anti = aux.dom_anti[:, :, node_row]
+        inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
+        anti_counts = aux.anti_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(t2)[None, :], dom_at_anti
+        ].add(inc_anti)
+
+        # 3) placed pod i's own req-anti terms block domains for matching pods j
+        #    (anti_cross[i] is [T2, B]: term t of pod i vs pending pod j)
+        same_anti = (aux.dom_anti[i] == aux.dom_anti[i, :, node_row][:, None]) & (
+            aux.dom_anti[i] < d
+        )  # [T2, N]
+        block_dyn = aux.block_dyn | jnp.any(
+            aux.anti_cross[i][:, :, None] & same_anti[:, None, :], axis=0
+        )  # [B, N]
+
+        # 4) pending pods' own pref tables gain from placed pod i
+        t3 = aux.dom_paff.shape[1]
+        t4 = aux.dom_panti.shape[1]
+        dom_at_paff = aux.dom_paff[:, :, node_row]
+        paff_counts = aux.paff_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(t3)[None, :], dom_at_paff
+        ].add((aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32))
+        dom_at_panti = aux.dom_panti[:, :, node_row]
+        panti_counts = aux.panti_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(t4)[None, :], dom_at_panti
+        ].add((aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32))
+
+        # 5) placed pod i's own terms add symmetric score for matching pods j:
+        #    req-aff × hardWeight, pref-aff +w, pref-anti −w over i's term domains
+        def plane(cross_i, dom_i, w_i):
+            # cross_i [T, B], dom_i [T, N], w_i [T] → f32[B, N]
+            same = ((dom_i == dom_i[:, node_row][:, None]) & (dom_i < d)).astype(jnp.float32)
+            return jnp.einsum("tj,tn->jn", cross_i.astype(jnp.float32) * w_i[:, None], same)
+
+        w1 = jnp.full((t1,), self.hard_weight, jnp.float32)
+        score_dyn = aux.score_dyn + plane(aux.aff_term_cross[i], aux.dom_aff[i], w1)
+        w3 = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
+        score_dyn = score_dyn + plane(aux.paff_cross[i], aux.dom_paff[i], w3)
+        w4 = jnp.asarray(batch.pref_anti_affinity.weight)[i]
+        score_dyn = score_dyn - plane(aux.panti_cross[i], aux.dom_panti[i], w4)
+
+        return aux._replace(
+            aff_counts=aff_counts, aff_total=aff_total, anti_counts=anti_counts,
+            block_dyn=block_dyn, paff_counts=paff_counts, panti_counts=panti_counts,
+            score_dyn=score_dyn,
+        )

@@ -1,0 +1,168 @@
+"""NodeResourcesFit + BalancedAllocation as batched tensor programs.
+
+Reference: pkg/scheduler/framework/plugins/noderesources/
+  fit.go:255-328      fitsRequest — per-dim ``request ≤ allocatable − requested``
+  least_allocated.go:29-57   Σ_r w_r·(cap−req)·100/cap / Σw     (non-zero requests)
+  most_allocated.go          Σ_r w_r·req·100/cap / Σw
+  requested_to_capacity_ratio.go   piecewise-linear shape over utilization
+  balanced_allocation.go:90-140    (1 − std(fractions)) · 100   (true requests)
+  resource_allocation.go:49-110    per-resource alloc/req gathering
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import MAX_NODE_SCORE, DynamicState, Plugin
+from ..state import units
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+
+def fit_filter(batch, snap, dyn: DynamicState):
+    """bool[B, N] — per-dim fit incl. extended resources (fit.go:255-328).
+
+    A zero request always fits (the reference skips zero-valued resources even on
+    overcommitted nodes).
+    """
+    free = snap.allocatable[None, :, :] - dyn.requested[None, :, :]  # [1, N, R]
+    req = batch.request[:, None, :]  # [B, 1, R]
+    return jnp.all((req == 0) | (req <= free), axis=-1)  # [B, N]
+
+
+class FitPlugin(Plugin):
+    name = "NodeResourcesFit"
+
+    def __init__(
+        self,
+        strategy: str = LEAST_ALLOCATED,
+        resources: Optional[Dict[str, int]] = None,
+        num_resource_dims: int = 8,
+        extended_index: Optional[Dict[str, int]] = None,
+        shape: Optional[Sequence[Tuple[int, int]]] = None,
+    ):
+        """resources: resource name → weight (default {"cpu": 1, "memory": 1}).
+        shape: RequestedToCapacityRatio (utilization%, score) points."""
+        self.strategy = strategy
+        resources = resources or {"cpu": 1, "memory": 1}
+        w = np.zeros(num_resource_dims, dtype=np.float32)
+        base = {"cpu": units.DIM_CPU, "memory": units.DIM_MEMORY,
+                "ephemeral-storage": units.DIM_EPHEMERAL, "pods": units.DIM_PODS}
+        for name, weight in resources.items():
+            if name in base:
+                w[base[name]] = weight
+            elif extended_index and name in extended_index:
+                w[extended_index[name]] = weight
+        self.weights = w
+        if shape is None:
+            # defaults for RequestedToCapacityRatio (utilization 0 → score 0,
+            # utilization 100 → score 10 — apis/config defaults)
+            shape = [(0, 0), (100, 10)]
+        self.shape_x = np.asarray([p[0] for p in shape], dtype=np.float32)
+        self.shape_y = np.asarray(
+            [p[1] * (MAX_NODE_SCORE // 10) for p in shape], dtype=np.float32
+        )
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.POD, ActionType.DELETE),
+            ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_ALLOCATABLE),
+        ]
+
+    def filter(self, batch, snap, dyn: DynamicState, aux=None):
+        return fit_filter(batch, snap, dyn)
+
+    def score(self, batch, snap, dyn: DynamicState, aux=None, mask=None):
+        w = jnp.asarray(self.weights)  # [R]
+        alloc = snap.allocatable.astype(jnp.float32)  # [N, R]
+        # LeastAllocated/MostAllocated use *non-zero* requests for cpu/memory
+        # (resource_allocation.go useRequested=false → NonZeroRequested).
+        req = dyn.requested.astype(jnp.float32)
+        nz_req = req.at[:, units.DIM_CPU].set(dyn.non_zero[:, 0].astype(jnp.float32))
+        nz_req = nz_req.at[:, units.DIM_MEMORY].set(dyn.non_zero[:, 1].astype(jnp.float32))
+        pod_req = batch.request.astype(jnp.float32)
+        pod_nz = pod_req.at[:, units.DIM_CPU].set(batch.non_zero[:, 0].astype(jnp.float32))
+        pod_nz = pod_nz.at[:, units.DIM_MEMORY].set(batch.non_zero[:, 1].astype(jnp.float32))
+
+        # floor mirrors the reference's per-resource int64 division
+        # (leastRequestedScore / mostRequestedScore)
+        if self.strategy == LEAST_ALLOCATED:
+            total = nz_req[None, :, :] + pod_nz[:, None, :]  # [B, N, R]
+            per_dim = jnp.where(
+                (alloc[None] == 0) | (total > alloc[None]),
+                0.0,
+                jnp.floor((alloc[None] - total) * MAX_NODE_SCORE / jnp.maximum(alloc[None], 1.0)),
+            )
+        elif self.strategy == MOST_ALLOCATED:
+            total = nz_req[None, :, :] + pod_nz[:, None, :]
+            per_dim = jnp.where(
+                (alloc[None] == 0) | (total > alloc[None]),
+                0.0,
+                jnp.floor(total * MAX_NODE_SCORE / jnp.maximum(alloc[None], 1.0)),
+            )
+        else:  # RequestedToCapacityRatio: piecewise-linear over utilization %
+            total = nz_req[None, :, :] + pod_nz[:, None, :]
+            util = jnp.where(
+                alloc[None] == 0, 100.0,
+                jnp.minimum(total / jnp.maximum(alloc[None], 1.0), 1.0) * 100.0,
+            )
+            per_dim = jnp.interp(util, jnp.asarray(self.shape_x), jnp.asarray(self.shape_y))
+        # include a dim iff weighted and allocatable non-zero; extended dims also
+        # require the pod to request them (resource_allocation.go:84-95)
+        included = (w[None, None, :] > 0) & (alloc[None] > 0)
+        is_ext = jnp.arange(alloc.shape[-1]) >= units.NUM_BASE_DIMS
+        included &= ~is_ext[None, None, :] | (pod_req[:, None, :] > 0)
+        wsum = jnp.sum(jnp.where(included, w[None, None, :], 0.0), axis=-1)  # [B, N]
+        total_score = jnp.sum(jnp.where(included, per_dim * w[None, None, :], 0.0), axis=-1)
+        return jnp.where(
+            wsum == 0, 0.0, jnp.floor(total_score / jnp.maximum(wsum, 1.0))
+        )
+
+    def normalize(self, scores, mask):
+        return scores  # already 0..100
+
+
+class BalancedAllocationPlugin(Plugin):
+    name = "NodeResourcesBalancedAllocation"
+
+    def __init__(self, resources: Optional[Dict[str, int]] = None,
+                 num_resource_dims: int = 8,
+                 extended_index: Optional[Dict[str, int]] = None):
+        resources = resources or {"cpu": 1, "memory": 1}
+        sel = np.zeros(num_resource_dims, dtype=bool)
+        base = {"cpu": units.DIM_CPU, "memory": units.DIM_MEMORY,
+                "ephemeral-storage": units.DIM_EPHEMERAL, "pods": units.DIM_PODS}
+        for name in resources:
+            if name in base:
+                sel[base[name]] = True
+            elif extended_index and name in extended_index:
+                sel[extended_index[name]] = True
+        self.sel = sel
+
+    def score(self, batch, snap, dyn: DynamicState, aux=None, mask=None):
+        """(1 − std(utilization fractions)) · 100 (balanced_allocation.go:90-140;
+        uses TRUE requests, useRequested=true)."""
+        sel = jnp.asarray(self.sel)
+        alloc = snap.allocatable.astype(jnp.float32)  # [N, R]
+        total = (dyn.requested[None, :, :] + batch.request[:, None, :]).astype(jnp.float32)
+        # include dims: selected, alloc > 0; extended dims only when pod requests
+        is_ext = jnp.arange(alloc.shape[-1]) >= units.NUM_BASE_DIMS
+        included = sel[None, None, :] & (alloc[None] > 0)
+        included &= ~is_ext[None, None, :] | (batch.request[:, None, :] > 0)
+        frac = jnp.minimum(total / jnp.maximum(alloc[None], 1.0), 1.0)  # [B, N, R]
+        n_inc = jnp.sum(included, axis=-1)  # [B, N]
+        mean = jnp.sum(jnp.where(included, frac, 0.0), axis=-1) / jnp.maximum(n_inc, 1)
+        # the reference's 2-resource |f1−f2|/2 fast path equals this std formula
+        var = jnp.sum(jnp.where(included, (frac - mean[..., None]) ** 2, 0.0), axis=-1)
+        std = jnp.sqrt(var / jnp.maximum(n_inc, 1))
+        score = (1.0 - std) * MAX_NODE_SCORE
+        return jnp.where(n_inc == 0, 0.0, score)
+
+    def normalize(self, scores, mask):
+        return scores

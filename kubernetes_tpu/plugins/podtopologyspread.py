@@ -1,0 +1,263 @@
+"""PodTopologySpread as a batched tensor program with in-scan updates.
+
+Reference: pkg/scheduler/framework/plugins/podtopologyspread/
+  filtering.go:256-289 — PreFilter counts matching pods per (topologyKey, value)
+      over nodes passing the pod's nodeSelector/affinity that carry ALL hard keys
+  filtering.go:343-358 — Filter: matchNum + selfMatch − globalMin > maxSkew;
+      node missing a key → UnschedulableAndUnresolvable
+  scoring.go:108-175  — PreScore counts per pair over affinity-eligible nodes,
+      restricted to pairs present among feasible nodes
+  scoring.go:180-213  — Score: Σ_c cnt·log(topoSize+2) + (maxSkew−1)
+  scoring.go:216+     — NormalizeScore: 100·(max+min−s)/max, ignored nodes → 0
+
+Device design: topology keys are encoder slots; label values under a key are
+compact domain indices (state/encoding.py topo registry).  Counts live in dense
+``[B, C, D+1]`` tables (last slot = trash for MISSING), built by one
+pods×nodes matmul + scatter-add, and updated in O(B·C) inside the greedy
+assignment scan when a pending pod is placed (the device analog of ``assume``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import MAX_NODE_SCORE, Plugin
+from ..framework.podbatch import WHEN_DO_NOT_SCHEDULE, WHEN_SCHEDULE_ANYWAY
+from ..state.dictionary import MISSING
+from ..state.selectors import eval_label_selector
+from .helpers import label_selector_matrix, node_selector_matrix
+
+BIG = jnp.asarray(2**30, dtype=jnp.int32)
+
+
+class TSAux(NamedTuple):
+    hard_valid: jnp.ndarray  # bool[B, C]
+    soft_valid: jnp.ndarray  # bool[B, C]
+    max_skew: jnp.ndarray  # i32[B, C]
+    min_domains: jnp.ndarray  # i32[B, C]
+    self_match: jnp.ndarray  # bool[B, C]
+    dom_val: jnp.ndarray  # i32[B, C, N] (domain index of node under c's key; D=trash)
+    has_key: jnp.ndarray  # bool[B, C, N]
+    counted_hard: jnp.ndarray  # bool[B, N] nodes counted for hard constraints
+    counted_soft: jnp.ndarray  # bool[B, N]
+    hard_counts: jnp.ndarray  # i32[B, C, D+1]
+    soft_counts: jnp.ndarray  # i32[B, C, D+1]
+    hard_present: jnp.ndarray  # bool[B, C, D+1] domains with ≥1 counted node
+    match_pending: jnp.ndarray  # bool[B, C, B] — selector (b,c) matches pending pod j
+
+
+class PodTopologySpreadPlugin(Plugin):
+    name = "PodTopologySpread"
+
+    def __init__(self, domain_cap: int = 256, enable_min_domains: bool = True):
+        self.domain_cap = domain_cap  # static D; runtime refreshes on growth
+        self.enable_min_domains = enable_min_domains
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.POD, ActionType.ALL),
+            ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+    # --- prepare (PreFilter + the static part of PreScore) -------------------
+
+    def prepare(self, batch, snap, dyn, host_aux=None) -> TSAux:
+        d = self.domain_cap
+        b, c_cap = batch.tsc_valid.shape
+        n = snap.num_nodes
+
+        hard_valid = batch.tsc_valid & (batch.tsc_when == WHEN_DO_NOT_SCHEDULE)
+        soft_valid = batch.tsc_valid & (batch.tsc_when == WHEN_SCHEDULE_ANYWAY)
+
+        key = jnp.clip(batch.tsc_key, 0, snap.node_topo.shape[1] - 1)  # [B, C]
+        dom_val = snap.node_topo[:, key]  # [N, B, C] → transpose
+        dom_val = jnp.transpose(dom_val, (1, 2, 0))  # [B, C, N]
+        has_key = dom_val != MISSING
+        dom_val = jnp.where(has_key, jnp.clip(dom_val, 0, d - 1), d)  # trash slot D
+
+        # nodes eligible for counting: pass pod's nodeSelector + required affinity
+        sel_ok = label_selector_matrix(
+            batch.node_selector, snap.node_label_keys, snap.node_label_vals, snap.numeric
+        )
+        aff_ok = node_selector_matrix(
+            batch.node_affinity, snap.node_label_keys, snap.node_label_vals, snap.numeric
+        )
+        affinity_ok = sel_ok & aff_ok & snap.node_valid[None, :]  # [B, N]
+        has_all_hard = jnp.all(~hard_valid[:, :, None] | has_key, axis=1)  # [B, N]
+        has_all_soft = jnp.all(~soft_valid[:, :, None] | has_key, axis=1)
+        counted_hard = affinity_ok & has_all_hard
+        counted_soft = affinity_ok & has_all_soft
+
+        # selector (b,c) vs scheduled pods (same namespace only) → [B, C, P]
+        match_sched = self._selector_vs_pods(
+            batch, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, snap.numeric
+        )
+        match_sched = match_sched & snap.pod_valid[None, None, :]
+        # per-node match count via one matmul [B*C, P] × [P, N]
+        pod_node = jnp.clip(snap.pod_node, 0, n - 1)
+        onehot = (
+            (pod_node[:, None] == jnp.arange(n)[None, :]) & (snap.pod_node >= 0)[:, None]
+        ).astype(jnp.float32)  # [P, N]
+        count_node = (
+            match_sched.reshape(b * c_cap, -1).astype(jnp.float32) @ onehot
+        ).reshape(b, c_cap, n).astype(jnp.int32)  # [B, C, N]
+
+        def scatter(count_mask, node_mask):
+            vals = jnp.where(node_mask[:, None, :], count_mask, 0)  # [B, C, N]
+            tbl = jnp.zeros((b, c_cap, d + 1), jnp.int32)
+            tbl = tbl.at[
+                jnp.arange(b)[:, None, None],
+                jnp.arange(c_cap)[None, :, None],
+                dom_val,
+            ].add(jnp.where(node_mask[:, None, :], vals, 0))
+            return tbl
+
+        hard_counts = scatter(count_node, counted_hard)
+        soft_counts = scatter(count_node, counted_soft)
+        hard_present = (
+            jnp.zeros((b, c_cap, d + 1), bool)
+            .at[
+                jnp.arange(b)[:, None, None],
+                jnp.arange(c_cap)[None, :, None],
+                dom_val,
+            ]
+            .max(counted_hard[:, None, :] & (dom_val < d))
+        )
+
+        # constraint selectors vs PENDING pods (same-namespace check applies both
+        # to in-scan counting and to the diagonal selfMatchNum, where ns is equal)
+        self_match = self._selector_vs_pods(
+            batch, batch.label_keys, batch.label_vals, batch.ns, snap.numeric,
+        )  # [B, C, B] — diagonal is selfMatch
+        diag = jnp.arange(b)
+        match_pending = self_match & batch.valid[None, None, :]
+        self_diag = match_pending[diag, :, diag]  # [B, C]
+
+        return TSAux(
+            hard_valid=hard_valid, soft_valid=soft_valid,
+            max_skew=batch.tsc_max_skew, min_domains=batch.tsc_min_domains,
+            self_match=self_diag, dom_val=dom_val, has_key=has_key,
+            counted_hard=counted_hard, counted_soft=counted_soft,
+            hard_counts=hard_counts, soft_counts=soft_counts,
+            hard_present=hard_present, match_pending=match_pending,
+        )
+
+    def _selector_vs_pods(self, batch, pl_keys, pl_vals, p_ns, numeric, same_ns=True):
+        """Constraint selectors [B, C] vs pod label sets [P, L] → bool[B, C, P]."""
+        b, c_cap = batch.tsc_valid.shape
+        flat_idx = jnp.arange(b * c_cap)
+
+        def one_sel(fi):
+            return jax.vmap(
+                lambda keys, vals: eval_label_selector(
+                    batch.tsc_selectors, fi, keys, vals, numeric
+                )
+            )(pl_keys, pl_vals)
+
+        m = jax.vmap(one_sel)(flat_idx).reshape(b, c_cap, -1)  # [B, C, P]
+        if same_ns:
+            m = m & (batch.ns[:, None, None] == p_ns[None, None, :])
+        return m
+
+    # --- filter ---------------------------------------------------------------
+
+    def filter(self, batch, snap, dyn, aux: TSAux = None):
+        d = self.domain_cap
+        # global min over present domains (criticalPaths); empty → +BIG (pass)
+        min_match = jnp.min(
+            jnp.where(aux.hard_present, aux.hard_counts, BIG), axis=-1
+        )  # [B, C]
+        if self.enable_min_domains:
+            num_domains = jnp.sum(aux.hard_present, axis=-1)  # [B, C]
+            min_match = jnp.where(
+                (aux.min_domains > 0) & (num_domains < aux.min_domains), 0, min_match
+            )
+        match_num = jnp.take_along_axis(
+            aux.hard_counts, aux.dom_val, axis=-1
+        )  # [B, C, N]
+        skew = match_num + aux.self_match[:, :, None].astype(jnp.int32) - min_match[:, :, None]
+        ok_c = skew <= aux.max_skew[:, :, None]
+        ok = jnp.all(~aux.hard_valid[:, :, None] | (ok_c & aux.has_key), axis=1)
+        return ok  # [B, N]
+
+    # --- score ----------------------------------------------------------------
+
+    def score(self, batch, snap, dyn, aux: TSAux, mask=None):
+        """Raw score; NaN marks ignored nodes (handled in normalize)."""
+        d = self.domain_cap
+        # pairs present among feasible (mask) non-ignored nodes restrict counting
+        if mask is None:
+            mask = jnp.ones(aux.counted_soft.shape, bool)
+        ignored = ~jnp.all(~aux.soft_valid[:, :, None] | aux.has_key, axis=1)  # [B,N]
+        scored = mask & ~ignored  # [B, N]
+        b, c_cap, _ = aux.dom_val.shape
+        soft_present = (
+            jnp.zeros(aux.soft_counts.shape, bool)
+            .at[
+                jnp.arange(b)[:, None, None],
+                jnp.arange(c_cap)[None, :, None],
+                aux.dom_val,
+            ]
+            .max(scored[:, None, :] & (aux.dom_val < d))
+        )
+        topo_size = jnp.sum(soft_present[..., :d], axis=-1)  # [B, C]
+        tp_weight = jnp.log(topo_size.astype(jnp.float32) + 2.0)
+        counts = jnp.take_along_axis(aux.soft_counts, aux.dom_val, axis=-1)  # [B,C,N]
+        in_present = jnp.take_along_axis(soft_present, aux.dom_val, axis=-1)
+        per_c = (
+            counts.astype(jnp.float32) * tp_weight[:, :, None]
+            + (aux.max_skew[:, :, None].astype(jnp.float32) - 1.0)
+        )
+        raw = jnp.round(jnp.sum(
+            jnp.where(aux.soft_valid[:, :, None] & aux.has_key & in_present, per_c, 0.0),
+            axis=1,
+        ))  # [B, N] — int64(math.Round(score)) parity (scoring.go:213)
+        has_soft = jnp.any(aux.soft_valid, axis=1)  # [B]
+        return jnp.where(
+            has_soft[:, None] & ~scored, jnp.nan, jnp.where(has_soft[:, None], raw, 0.0)
+        )
+
+    def normalize(self, scores, mask):
+        """100·(max+min−s)/max over scored nodes; NaN (ignored) → 0
+        (scoring.go NormalizeScore)."""
+        valid = mask & ~jnp.isnan(scores)
+        big = jnp.where(valid, scores, -jnp.inf)
+        small = jnp.where(valid, scores, jnp.inf)
+        mx = jnp.max(big, axis=-1, keepdims=True)
+        mn = jnp.min(small, axis=-1, keepdims=True)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+        out = jnp.where(
+            mx == 0,
+            float(MAX_NODE_SCORE),
+            MAX_NODE_SCORE * (mx + mn - scores) / jnp.where(mx == 0, 1.0, mx),
+        )
+        return jnp.where(valid, out, 0.0)
+
+    # --- in-scan update -------------------------------------------------------
+
+    def update(self, aux: TSAux, i, node_row, batch, snap):
+        """Pod i was placed on node_row: bump (j, c) tables where pod i matches
+        pending pod j's constraint selectors and the node is counted for j."""
+        d = self.domain_cap
+        b, c_cap, _ = aux.dom_val.shape
+        dom_at = aux.dom_val[:, :, node_row]  # [B, C]
+        inc = (
+            aux.match_pending[:, :, i]
+            & aux.counted_hard[:, node_row][:, None]
+        ).astype(jnp.int32)  # [B, C]
+        hard_counts = aux.hard_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(c_cap)[None, :], dom_at
+        ].add(inc)
+        inc_soft = (
+            aux.match_pending[:, :, i]
+            & aux.counted_soft[:, node_row][:, None]
+        ).astype(jnp.int32)
+        soft_counts = aux.soft_counts.at[
+            jnp.arange(b)[:, None], jnp.arange(c_cap)[None, :], dom_at
+        ].add(inc_soft)
+        return aux._replace(hard_counts=hard_counts, soft_counts=soft_counts)

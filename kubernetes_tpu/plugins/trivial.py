@@ -1,0 +1,110 @@
+"""NodeName, NodePorts, NodeUnschedulable, ImageLocality — small batched plugins.
+
+Reference: pkg/scheduler/framework/plugins/{nodename,nodeports,nodeunschedulable,
+imagelocality}/.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import MAX_NODE_SCORE, Plugin
+from ..framework.podbatch import TOL_OP_EXISTS
+from ..state.dictionary import ID_UNSCHEDULABLE_TAINT, MISSING
+
+_MB = 1024 * 1024
+MIN_THRESHOLD = 23 * _MB  # imagelocality/image_locality.go:34
+MAX_CONTAINER_THRESHOLD = 1000 * _MB  # :35
+
+
+class NodeNamePlugin(Plugin):
+    """pod.Spec.NodeName == node.Name (nodename/node_name.go)."""
+
+    name = "NodeName"
+
+    def filter(self, batch, snap, dyn, aux=None):
+        unset = batch.node_name_id == MISSING  # [B]
+        return unset[:, None] | (batch.node_name_id[:, None] == snap.node_name_ids[None, :])
+
+
+class NodePortsPlugin(Plugin):
+    """hostPort conflicts vs NodeInfo.UsedPorts (nodeports/node_ports.go).
+
+    Ports are (proto<<16 | port) codes; equal codes conflict regardless of hostIP
+    (conservative vs the reference's HostPortInfo IP-wildcard rules — exact
+    per-IP semantics live on the host oracle path, state/encoding.py note).
+    """
+
+    name = "NodePorts"
+
+    def events_to_register(self):
+        return [ClusterEvent(EventResource.POD, ActionType.DELETE)]
+
+    def filter(self, batch, snap, dyn, aux=None):
+        pod_ports = batch.ports[:, None, :, None]  # [B, 1, PP, 1]
+        node_ports = snap.ports[None, :, None, :]  # [1, N, 1, NP]
+        conflict = jnp.any(
+            (pod_ports == node_ports) & (pod_ports != MISSING), axis=(-2, -1)
+        )
+        return ~conflict
+
+
+class NodeUnschedulablePlugin(Plugin):
+    """node.Spec.Unschedulable, escapable by tolerating the
+    node.kubernetes.io/unschedulable:NoSchedule taint
+    (nodeunschedulable/node_unschedulable.go)."""
+
+    name = "NodeUnschedulable"
+
+    def events_to_register(self):
+        return [ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_TAINT)]
+
+    def filter(self, batch, snap, dyn, aux=None):
+        # tolerates synthetic taint {key: unschedulable, value: "", effect NoSchedule}
+        key_ok = (batch.tol_key == MISSING) | (batch.tol_key == ID_UNSCHEDULABLE_TAINT)
+        effect_ok = (batch.tol_effect == -1) | (batch.tol_effect == 0)
+        value_ok = (batch.tol_op == TOL_OP_EXISTS)  # Equal would need value ""
+        tolerates = jnp.any(batch.tol_valid & key_ok & effect_ok & value_ok, axis=-1)  # [B]
+        return ~snap.unschedulable[None, :] | tolerates[:, None]
+
+
+class ImageLocalityPlugin(Plugin):
+    """Scaled sum of present-image sizes × spread ratio
+    (imagelocality/image_locality.go:84-117)."""
+
+    name = "ImageLocality"
+
+    def score(self, batch, snap, dyn, aux=None, mask=None):
+        # per-image-id spread counts and sizes via scatter-add over dictionary ids
+        # (replaces the reference's per-node ImageStates map walk)
+        img = snap.image_ids  # [N, I]
+        valid_img = (img != MISSING) & snap.node_valid[:, None]
+        num_ids = snap.numeric.shape[0]
+        flat = jnp.clip(img, 0, num_ids - 1).reshape(-1)
+        w = valid_img.reshape(-1).astype(jnp.float32)
+        counts_by_id = jnp.zeros(num_ids, jnp.float32).at[flat].add(w)
+        size_by_id = jnp.zeros(num_ids, jnp.float32).at[flat].max(
+            jnp.where(valid_img, snap.image_sizes, 0.0).reshape(-1)
+        )
+        n_nodes = jnp.maximum(jnp.sum(snap.node_valid), 1)
+        scaled_by_id = size_by_id * (counts_by_id / n_nodes)  # spread-scaled size
+        pod_img = jnp.clip(batch.image_ids, 0, num_ids - 1)  # [B, CI]
+        pod_scaled = jnp.where(batch.image_ids != MISSING, scaled_by_id[pod_img], 0.0)
+        present = jnp.any(
+            (batch.image_ids[:, None, :, None] == img[None, :, None, :])
+            & valid_img[None, :, None, :],
+            axis=-1,
+        )  # [B, N, CI]
+        sum_scores = jnp.sum(pod_scaled[:, None, :] * present, axis=-1)  # [B, N]
+        num_containers = jnp.sum(batch.image_ids != MISSING, axis=-1)  # [B]
+        max_threshold = (MAX_CONTAINER_THRESHOLD * jnp.maximum(num_containers, 1)).astype(jnp.float32)
+        clamped = jnp.clip(sum_scores, MIN_THRESHOLD, max_threshold[:, None])
+        return (
+            MAX_NODE_SCORE
+            * (clamped - MIN_THRESHOLD)
+            / (max_threshold[:, None] - MIN_THRESHOLD)
+        )
+
+    def normalize(self, scores, mask):
+        return scores

@@ -1,0 +1,3 @@
+"""Scheduling queue (reference: pkg/scheduler/internal/queue)."""
+
+from .priority_queue import PriorityQueue, QueuedPodInfo  # noqa: F401

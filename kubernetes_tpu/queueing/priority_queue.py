@@ -1,0 +1,237 @@
+"""Three-queue PriorityQueue with event-driven requeue.
+
+Reference: pkg/scheduler/internal/queue/scheduling_queue.go —
+  PriorityQueue :129-170 (activeQ heap by queue-sort less-fn, podBackoffQ heap by
+  backoff expiry, unschedulableQ map), Pop :478, AddUnschedulableIfNotPresent
+  :387, MoveAllToActiveOrBackoffQueue :608, podMatchesEvent :963,
+  flushBackoffQCompleted :426, flushUnschedulableQLeftover :457,
+  backoff 1s→10s :54-64, unschedulableQ max stay 60s, Activate :318.
+
+Differences from the reference: batched Pop (``pop_batch``) drains up to K ready
+pods in one call — the unit the device path schedules per cycle; no goroutines —
+callers drive ``flush()`` from their loop (tests inject a fake clock).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api import objects as v1
+from ..framework.events import ClusterEvent
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0  # :54-64
+DEFAULT_POD_MAX_BACKOFF = 10.0
+DEFAULT_UNSCHEDULABLE_TIME_LIMIT = 60.0  # flushUnschedulableQLeftover
+
+
+@dataclass
+class QueuedPodInfo:
+    """Reference framework.QueuedPodInfo."""
+
+    pod: v1.Pod
+    timestamp: float = 0.0  # when added to the queue
+    initial_attempt_timestamp: float = 0.0
+    attempts: int = 0
+    unschedulable_plugins: Set[str] = field(default_factory=set)
+
+
+def default_less(a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+    """PrioritySort (queuesort/priority_sort.go): priority desc, then older first."""
+    pa, pb = a.pod.spec.priority, b.pod.spec.priority
+    if pa != pb:
+        return pa > pb
+    return a.initial_attempt_timestamp < b.initial_attempt_timestamp
+
+
+class PriorityQueue:
+    def __init__(
+        self,
+        less: Callable[[QueuedPodInfo, QueuedPodInfo], bool] = default_less,
+        clock: Callable[[], float] = time.monotonic,
+        pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        unschedulable_time_limit: float = DEFAULT_UNSCHEDULABLE_TIME_LIMIT,
+        cluster_event_map: Optional[Dict[ClusterEvent, Set[str]]] = None,
+    ):
+        self._less = less
+        self._clock = clock
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+        self._unschedulable_limit = unschedulable_time_limit
+        # ClusterEvent → plugin names that registered it (scheduler.go:347-362)
+        self._cluster_event_map = cluster_event_map or {}
+        self._seq = itertools.count()
+        self._active: List[Tuple[object, int, QueuedPodInfo]] = []  # heap
+        self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []  # heap by expiry
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}  # uid → info
+        self._in_active: Set[str] = set()
+        self._in_backoff: Set[str] = set()
+        self._moves: int = 0  # moveRequestCycle analog
+
+    # --- sort key ------------------------------------------------------------
+
+    class _Key:
+        __slots__ = ("info", "less")
+
+        def __init__(self, info, less):
+            self.info, self.less = info, less
+
+        def __lt__(self, other):
+            return self.less(self.info, other.info)
+
+    def _push_active(self, info: QueuedPodInfo):
+        uid = info.pod.uid
+        if uid in self._in_active:
+            return
+        heapq.heappush(
+            self._active, (self._Key(info, self._less), next(self._seq), info)
+        )
+        self._in_active.add(uid)
+
+    # --- public API ----------------------------------------------------------
+
+    def add(self, pod: v1.Pod) -> None:
+        now = self._clock()
+        info = QueuedPodInfo(
+            pod=pod, timestamp=now, initial_attempt_timestamp=now
+        )
+        self._push_active(info)
+
+    def __len__(self) -> int:
+        self.flush()
+        return len(self._active)
+
+    def pending_count(self) -> Tuple[int, int, int]:
+        return len(self._active), len(self._backoff), len(self._unschedulable)
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        self.flush()
+        while self._active:
+            _, _, info = heapq.heappop(self._active)
+            uid = info.pod.uid
+            if uid in self._in_active:
+                self._in_active.discard(uid)
+                info.attempts += 1
+                return info
+        return None
+
+    def pop_batch(self, max_size: int) -> List[QueuedPodInfo]:
+        """Drain up to max_size ready pods — the device batch unit."""
+        out = []
+        while len(out) < max_size:
+            info = self.pop()
+            if info is None:
+                break
+            out.append(info)
+        return out
+
+    def add_unschedulable(self, info: QueuedPodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:
+        """AddUnschedulableIfNotPresent (:387): a move since the cycle started
+        sends the pod to backoff instead of unschedulableQ."""
+        uid = info.pod.uid
+        if uid in self._in_active or uid in self._in_backoff or uid in self._unschedulable:
+            return
+        info.timestamp = self._clock()
+        if pod_scheduling_cycle is not None and self._moves > pod_scheduling_cycle:
+            self._push_backoff(info)
+        else:
+            self._unschedulable[uid] = info
+
+    def scheduling_cycle(self) -> int:
+        return self._moves
+
+    def _backoff_time(self, info: QueuedPodInfo) -> float:
+        d = self._initial_backoff * (2 ** max(info.attempts - 1, 0))
+        return info.timestamp + min(d, self._max_backoff)
+
+    def _push_backoff(self, info: QueuedPodInfo):
+        uid = info.pod.uid
+        if uid in self._in_backoff:
+            return
+        heapq.heappush(
+            self._backoff, (self._backoff_time(info), next(self._seq), info)
+        )
+        self._in_backoff.add(uid)
+
+    def activate(self, pods: Sequence[v1.Pod]) -> None:
+        """Activate (:318): force named pods from backoff/unschedulable to active."""
+        uids = {p.uid for p in pods}
+        self._remove_from_backoff(uids, to_active=True)
+        for uid in list(self._unschedulable):
+            if uid in uids:
+                self._push_active(self._unschedulable.pop(uid))
+
+    def _remove_from_backoff(self, uids: Set[str], to_active: bool):
+        kept = []
+        for expiry, seq, info in self._backoff:
+            if info.pod.uid in uids and info.pod.uid in self._in_backoff:
+                self._in_backoff.discard(info.pod.uid)
+                if to_active:
+                    self._push_active(info)
+            else:
+                kept.append((expiry, seq, info))
+        heapq.heapify(kept)
+        self._backoff = kept
+
+    def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
+        """MoveAllToActiveOrBackoffQueue (:608) + podMatchesEvent (:963)."""
+        self._moves += 1
+        moved = []
+        for uid, info in list(self._unschedulable.items()):
+            if self._pod_matches_event(info, event):
+                moved.append(uid)
+        for uid in moved:
+            info = self._unschedulable.pop(uid)
+            if self._clock() < self._backoff_time(info):
+                self._push_backoff(info)
+            else:
+                self._push_active(info)
+
+    def _pod_matches_event(self, info: QueuedPodInfo, event: ClusterEvent) -> bool:
+        if event.is_wildcard():
+            return True
+        if not info.unschedulable_plugins:
+            return True  # no diagnosis recorded — be permissive
+        for registered, plugins in self._cluster_event_map.items():
+            if registered.match(event) and (plugins & info.unschedulable_plugins):
+                return True
+        return False
+
+    def update(self, old: v1.Pod, new: v1.Pod) -> None:
+        """Pod spec update may make it schedulable: move out of unschedulableQ."""
+        info = self._unschedulable.pop(new.uid, None)
+        if info is not None:
+            info.pod = new
+            if self._clock() < self._backoff_time(info):
+                self._push_backoff(info)
+            else:
+                self._push_active(info)
+
+    def delete(self, pod: v1.Pod) -> None:
+        self._in_active.discard(pod.uid)
+        self._in_backoff.discard(pod.uid)
+        self._unschedulable.pop(pod.uid, None)
+
+    # --- flush loops (reference: goroutines at 1s / 30s) ----------------------
+
+    def flush(self) -> None:
+        now = self._clock()
+        while self._backoff:
+            expiry, _, info = self._backoff[0]
+            if expiry > now:
+                break
+            heapq.heappop(self._backoff)
+            if info.pod.uid in self._in_backoff:
+                self._in_backoff.discard(info.pod.uid)
+                self._push_active(info)
+        for uid, info in list(self._unschedulable.items()):
+            if now - info.timestamp > self._unschedulable_limit:
+                del self._unschedulable[uid]
+                if now < self._backoff_time(info):
+                    self._push_backoff(info)
+                else:
+                    self._push_active(info)

@@ -1,0 +1,9 @@
+"""In-process control plane for tests/benchmarks.
+
+Reference analog: test/integration runs a real apiserver+etcd in-process
+(test/integration/framework/etcd.go, util.go:56 StartApiserver); nodes are plain
+API objects, no kubelet.  Here a plain object store with watch fan-out plays the
+apiserver role for the scheduler harness (SURVEY §7 step 2).
+"""
+
+from .store import ObjectStore, WatchEvent  # noqa: F401

@@ -1,0 +1,119 @@
+"""Object store + watch fan-out (the etcd/apiserver stand-in).
+
+Reference behaviors mirrored:
+  - monotonically increasing resourceVersion per write (etcd3 store semantics)
+  - LIST returns a consistent snapshot + the rv to start WATCH from
+  - WATCH delivers ordered Added/Modified/Deleted events from a given rv
+    (storage/etcd3/watcher.go:118; watch cache cacher.go)
+  - binding subresource: POST pods/{name}/binding → sets spec.nodeName
+    (plugins/defaultbinder)
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..api import objects as v1
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    kind: str
+    obj: object
+    resource_version: int
+
+
+class ObjectStore:
+    """Thread-safe store; watchers receive events synchronously in rv order."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._objects: Dict[Tuple[str, str, str], object] = {}
+        self._log: List[WatchEvent] = []  # full event history (bounded use: sim)
+        self._watchers: List[Callable[[WatchEvent], None]] = []
+
+    # --- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, obj) -> Tuple[str, str, str]:
+        meta = obj.metadata
+        return (kind, getattr(meta, "namespace", ""), meta.name)
+
+    def _emit(self, ev: WatchEvent):
+        self._log.append(ev)
+        for w in list(self._watchers):
+            w(ev)
+
+    # --- CRUD ----------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> int:
+        with self._lock:
+            key = self._key(kind, obj)
+            if key in self._objects:
+                raise ValueError(f"{key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[key] = obj
+            self._emit(WatchEvent(ADDED, kind, obj, self._rv))
+            return self._rv
+
+    def update(self, kind: str, obj) -> int:
+        with self._lock:
+            key = self._key(kind, obj)
+            if key not in self._objects:
+                raise KeyError(key)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[key] = obj
+            self._emit(WatchEvent(MODIFIED, kind, obj, self._rv))
+            return self._rv
+
+    def delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        with self._lock:
+            obj = self._objects.pop((kind, namespace, name), None)
+            if obj is None:
+                return None
+            self._rv += 1
+            self._emit(WatchEvent(DELETED, kind, obj, self._rv))
+            return obj
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        with self._lock:
+            return self._objects.get((kind, namespace, name))
+
+    def list(self, kind: str) -> Tuple[List[object], int]:
+        with self._lock:
+            objs = [o for (k, _, _), o in self._objects.items() if k == kind]
+            return objs, self._rv
+
+    # --- watch ---------------------------------------------------------------
+
+    def watch(self, handler: Callable[[WatchEvent], None], since_rv: int = 0):
+        """Replays history after since_rv, then subscribes (list+watch contract)."""
+        with self._lock:
+            for ev in self._log:
+                if ev.resource_version > since_rv:
+                    handler(ev)
+            self._watchers.append(handler)
+            return lambda: self._watchers.remove(handler)
+
+    # --- binding subresource --------------------------------------------------
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
+        with self._lock:
+            pod = self.get("Pod", namespace, name)
+            if pod is None:
+                return False
+            pod.spec.node_name = node_name
+            self._rv += 1
+            pod.metadata.resource_version = self._rv
+            self._emit(WatchEvent(MODIFIED, "Pod", pod, self._rv))
+            return True

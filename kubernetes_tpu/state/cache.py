@@ -14,6 +14,7 @@ Reference: pkg/scheduler/internal/cache/cache.go (cacheImpl :56-75, UpdateSnapsh
 
 from __future__ import annotations
 
+import copy
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
@@ -95,9 +96,13 @@ class Cache:
         uid = pod.uid
         if uid in self._pod_states:
             raise SchedulerCacheError(f"pod {pod.key()} already assumed/added")
-        pod.spec.node_name = node_name
-        self._add_pod_to_node(pod)
-        self._pod_states[uid] = _PodState(pod=pod)
+        # assume on a COPY: the caller's (queued) pod must keep NodeName empty so
+        # a failed bind can be retried anywhere (the reference assumes on a
+        # deep-copied pod, scheduler.go:566-581)
+        assumed = copy.deepcopy(pod)
+        assumed.spec.node_name = node_name
+        self._add_pod_to_node(assumed)
+        self._pod_states[uid] = _PodState(pod=assumed)
         self._assumed_pods.add(uid)
 
     def finish_binding(self, pod: v1.Pod) -> None:

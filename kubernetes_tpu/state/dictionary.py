@@ -19,6 +19,23 @@ import numpy as np
 
 MISSING = -1
 
+# Well-known strings interned at Dictionary construction so their ids are
+# compile-time constants usable inside jitted plugin programs.
+WELL_KNOWN = (
+    "",
+    "metadata.name",
+    "kubernetes.io/hostname",
+    "node.kubernetes.io/unschedulable",
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+)
+ID_EMPTY = 0
+ID_META_NAME = 1
+ID_HOSTNAME = 2
+ID_UNSCHEDULABLE_TAINT = 3
+ID_ZONE = 4
+ID_REGION = 5
+
 
 class Dictionary:
     """Append-only string interner. Thread-compatible with the scheduler's single
@@ -29,6 +46,8 @@ class Dictionary:
         self._to_id: Dict[str, int] = {}
         self._to_str: List[str] = []
         self._numeric: List[float] = []
+        for s in WELL_KNOWN:
+            self.intern(s)
 
     def __len__(self) -> int:
         return len(self._to_str)

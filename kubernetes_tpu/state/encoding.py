@@ -51,10 +51,7 @@ HOSTNAME_LABEL = "kubernetes.io/hostname"
 
 
 def _pow2(n: int, minimum: int = 8) -> int:
-    p = minimum
-    while p < n:
-        p *= 2
-    return p
+    return units.pow2_round_up(n, minimum)
 
 
 @dataclass
@@ -67,6 +64,7 @@ class EncodingConfig:
     port_cap: int = 8
     image_cap: int = 8
     extended_resource_cap: int = 4  # spare scalar-resource dims beyond the base 4
+    topo_key_cap: int = 8  # registered topology keys (zone/hostname/region/…)
 
     @property
     def num_resource_dims(self) -> int:
@@ -87,11 +85,13 @@ class DeviceSnapshot:
 
     # nodes
     node_valid: jnp.ndarray  # bool[N]
+    node_name_ids: jnp.ndarray  # i32[N] (interned node name; MISSING for free rows)
     allocatable: jnp.ndarray  # i32[N, R]
     requested: jnp.ndarray  # i32[N, R]
     non_zero_requested: jnp.ndarray  # i32[N, 2] (cpu milli, mem KiB)
     node_label_keys: jnp.ndarray  # i32[N, L]
     node_label_vals: jnp.ndarray  # i32[N, L]
+    node_topo: jnp.ndarray  # i32[N, K] compact domain index per registered topo key
     taint_keys: jnp.ndarray  # i32[N, T]
     taint_vals: jnp.ndarray  # i32[N, T]
     taint_effects: jnp.ndarray  # i32[N, T] (-1 pad)
@@ -120,6 +120,11 @@ class DeviceSnapshot:
         return self.pod_valid.shape[0]
 
 
+from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
+
+_reg(DeviceSnapshot)
+
+
 class ClusterEncoder:
     """Maintains host numpy mirrors + device buffers; applies incremental updates."""
 
@@ -127,15 +132,24 @@ class ClusterEncoder:
         self.dic = dic or Dictionary()
         self.cfg = cfg or EncodingConfig()
         self.extended_index: Dict[str, int] = {}
+        # Topology registry: constraint topology keys get a compact slot k, and
+        # each distinct label value under that key gets a compact domain index —
+        # so domain segment-sums scatter into small dense tables instead of the
+        # unbounded dictionary id space (SURVEY §5 long-context note).
+        self.topo_key_strings: List[str] = []
+        self._topo_slots: Dict[str, int] = {}
+        self.topo_value_maps: List[Dict[str, int]] = []
         self.node_rows: Dict[str, int] = {}
         self._free_node_rows: List[int] = []
         self.pod_rows: Dict[str, int] = {}  # pod uid -> row
         self._free_pod_rows: List[int] = []
         self._pods_by_node: Dict[str, List[str]] = {}  # node name -> pod uids
+        self._pod_owner: Dict[str, str] = {}  # pod uid -> owning node name
         self._n = self.cfg.min_nodes
         self._p = self.cfg.min_pods
         self._alloc_arrays()
         self._device: Optional[DeviceSnapshot] = None
+        self._uploaded_numeric_len = -1
         self._dirty_node_rows: set = set()
         self._dirty_pod_rows: set = set()
         self._shape_changed = True
@@ -146,11 +160,13 @@ class ClusterEncoder:
         n, p, cfg = self._n, self._p, self.cfg
         r = cfg.num_resource_dims
         self.node_valid = np.zeros(n, dtype=bool)
+        self.node_name_ids = np.full(n, MISSING, dtype=np.int32)
         self.allocatable = np.zeros((n, r), dtype=np.int32)
         self.requested = np.zeros((n, r), dtype=np.int32)
         self.non_zero_requested = np.zeros((n, 2), dtype=np.int32)
         self.node_label_keys = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
         self.node_label_vals = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
+        self.node_topo = np.full((n, cfg.topo_key_cap), MISSING, dtype=np.int32)
         self.taint_keys = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
         self.taint_vals = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
         self.taint_effects = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
@@ -253,8 +269,14 @@ class ClusterEncoder:
         lk, lv = self._encode_labels(labels, cfg.label_cap, f"node {name}")
         self.node_label_keys[row] = lk
         self.node_label_vals[row] = lv
+        for k, key in enumerate(self.topo_key_strings):
+            val = labels.get(key)
+            self.node_topo[row, k] = (
+                MISSING if val is None else self._domain_index(k, val)
+            )
 
         self.node_valid[row] = True
+        self.node_name_ids[row] = self.dic.intern(name)
         self.unschedulable[row] = node.spec.unschedulable
         self.allocatable[row] = self._resource_units(info.allocatable, ceil=False)
         self.requested[row] = self._resource_units(info.requested, ceil=True)
@@ -295,6 +317,49 @@ class ClusterEncoder:
         self._dirty_node_rows.add(row)
         return row
 
+    # --- topology registry ---------------------------------------------------
+
+    def _domain_index(self, slot: int, value: str) -> int:
+        m = self.topo_value_maps[slot]
+        idx = m.get(value)
+        if idx is None:
+            idx = len(m)
+            m[value] = idx
+        return idx
+
+    def topo_slot(self, key: str) -> int:
+        """Slot of topology key, registering (and backfilling all nodes) on first
+        use. Called at PodBatch compile time for spread/affinity topology keys."""
+        slot = self._topo_slots.get(key)
+        if slot is not None:
+            return slot
+        slot = len(self.topo_key_strings)
+        if slot >= self.cfg.topo_key_cap:
+            raise EncodingCapacityError(
+                f"too many topology keys (cap {self.cfg.topo_key_cap}): {key}"
+            )
+        self._topo_slots[key] = slot
+        self.topo_key_strings.append(key)
+        self.topo_value_maps.append({})
+        key_id = self.dic.lookup(key)
+        for name, row in self.node_rows.items():
+            val_id = MISSING
+            if key_id != MISSING:
+                hit = np.where(self.node_label_keys[row] == key_id)[0]
+                if hit.size:
+                    val_id = int(self.node_label_vals[row, hit[0]])
+            self.node_topo[row, slot] = (
+                MISSING if val_id == MISSING
+                else self._domain_index(slot, self.dic.string(val_id))
+            )
+            self._dirty_node_rows.add(row)
+        return slot
+
+    @property
+    def domain_cap(self) -> int:
+        """Power-of-two bound on compact domain indices across all topo keys."""
+        return _pow2(max((len(m) for m in self.topo_value_maps), default=1), 8)
+
     def remove_node(self, name: str):
         row = self.node_rows.pop(name, None)
         if row is None:
@@ -303,7 +368,8 @@ class ClusterEncoder:
         self._free_node_rows.append(row)
         self._dirty_node_rows.add(row)
         for uid in self._pods_by_node.pop(name, []):
-            self._remove_pod_row(uid)
+            if self._pod_owner.get(uid) == name:
+                self._remove_pod_row(uid)
 
     # --- scheduled-pod encoding ---------------------------------------------
 
@@ -335,6 +401,7 @@ class ClusterEncoder:
 
     def _remove_pod_row(self, uid: str):
         row = self.pod_rows.pop(uid, None)
+        self._pod_owner.pop(uid, None)
         if row is None:
             return
         self.pod_valid[row] = False
@@ -344,7 +411,12 @@ class ClusterEncoder:
     # --- snapshot sync -------------------------------------------------------
 
     def sync(self, snapshot: Snapshot, changed_nodes: Sequence[str]):
-        """Apply a cache snapshot refresh: re-encode changed nodes + their pods."""
+        """Apply a cache snapshot refresh: re-encode changed nodes + their pods.
+
+        Removal is ownership-gated: a pod that MOVED between two changed nodes
+        may be re-encoded under its new node before or after its old node is
+        processed; only the current owner may free the row.
+        """
         for name in changed_nodes:
             info = snapshot.node_info_map.get(name)
             if info is None:
@@ -353,10 +425,11 @@ class ClusterEncoder:
             row = self.encode_node(info)
             new_uids = {pi.pod.uid for pi in info.pods}
             for uid in self._pods_by_node.get(name, []):
-                if uid not in new_uids:
+                if uid not in new_uids and self._pod_owner.get(uid) == name:
                     self._remove_pod_row(uid)
             for pi in info.pods:
                 self._encode_pod(pi.pod, row)
+                self._pod_owner[pi.pod.uid] = name
             self._pods_by_node[name] = list(new_uids)
 
     def full_sync(self, snapshot: Snapshot):
@@ -378,6 +451,7 @@ class ClusterEncoder:
             (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
             / max(self._n + self._p, 1)
         )
+        numeric_stale = len(self.dic) != self._uploaded_numeric_len
         use_scatter = (
             self._device is not None
             and not self._shape_changed
@@ -410,25 +484,23 @@ class ClusterEncoder:
                 )
             else:
                 upd.update({k: getattr(d, k) for k in _POD_ARRAYS})
-            self._device = DeviceSnapshot(**upd, numeric=d.numeric)
+            # ids interned since the last upload need a fresh numeric side-table
+            # (same padded size ⇒ same shapes; the table is small)
+            num = jnp.asarray(numeric) if numeric_stale else d.numeric
+            self._device = DeviceSnapshot(**upd, numeric=num)
+        self._uploaded_numeric_len = len(self.dic)
         self._dirty_node_rows.clear()
         self._dirty_pod_rows.clear()
         self._shape_changed = False
         return self._device
-
-    def node_name_of_row(self, row: int) -> Optional[str]:
-        for name, r in self.node_rows.items():
-            if r == row:
-                return name
-        return None
 
     def row_to_name(self) -> Dict[int, str]:
         return {r: name for name, r in self.node_rows.items()}
 
 
 _NODE_ARRAYS = [
-    "node_valid", "allocatable", "requested", "non_zero_requested",
-    "node_label_keys", "node_label_vals", "taint_keys", "taint_vals",
+    "node_valid", "node_name_ids", "allocatable", "requested", "non_zero_requested",
+    "node_label_keys", "node_label_vals", "node_topo", "taint_keys", "taint_vals",
     "taint_effects", "ports", "image_ids", "image_sizes", "unschedulable",
 ]
 _POD_ARRAYS = [

@@ -50,12 +50,7 @@ _OP_CODE = {
 }
 
 
-def _round_up(n: int, minimum: int) -> int:
-    n = max(n, minimum)
-    p = minimum
-    while p < n:
-        p *= 2
-    return p
+from .units import pow2_round_up as _round_up  # shared shape discipline
 
 
 @dataclass
@@ -94,6 +89,12 @@ class CompiledNodeSelectors:
 
     def __len__(self):
         return self.req_key.shape[0]
+
+
+from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
+
+_reg(CompiledLabelSelectors)
+_reg(CompiledNodeSelectors)
 
 
 def _selector_requirements(sel: v1.LabelSelector):

@@ -37,6 +37,15 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def pow2_round_up(n: int, minimum: int = 1) -> int:
+    """Shared device-shape discipline: capacities grow by doubling so XLA
+    recompiles O(log n) times (used by encoding, selector compilation, batches)."""
+    p = max(minimum, 1)
+    while p < n:
+        p *= 2
+    return p
+
+
 def resource_to_units(r: res.Resource, num_dims: int, extended_index, ceil: bool):
     """Resource → list[int] of length num_dims in scaled units.
 

@@ -1,0 +1,3 @@
+"""Shared utilities (pytree registration, clocks, heaps)."""
+
+from .pytrees import register_pytree_dataclass  # noqa: F401

@@ -1,0 +1,33 @@
+"""Register host dataclasses as JAX pytrees.
+
+PodBatch / DeviceSnapshot / compiled-selector batches are dataclasses whose fields
+are numpy/jnp arrays; registering them as pytrees lets the whole structure be passed
+straight into ``jax.jit`` so the entire filter→score→assign pipeline is ONE traced
+program.  Non-array fields (e.g. the host-side ``pods`` list) are dropped at
+flatten time and restored as empty defaults — device code never reads them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+def register_pytree_dataclass(cls, skip=(), skip_default=None):
+    """Register dataclass ``cls`` as a pytree; ``skip`` fields are dropped (rebuilt
+    as ``skip_default()`` or their type default on unflatten)."""
+    names = [f.name for f in dataclasses.fields(cls) if f.name not in skip]
+    skip_names = tuple(skip)
+
+    def flatten(obj):
+        return tuple(getattr(obj, n) for n in names), None
+
+    def unflatten(_aux, children):
+        kwargs = dict(zip(names, children))
+        for s in skip_names:
+            kwargs[s] = skip_default() if skip_default is not None else []
+        return cls(**kwargs)
+
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+    return cls

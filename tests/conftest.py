@@ -15,3 +15,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 # The axon TPU-tunnel environment pins JAX_PLATFORMS; JAX_PLATFORM_NAME still wins.
 os.environ["JAX_PLATFORM_NAME"] = "cpu"
+
+# Persistent compile cache: identical programs (same shapes across tests/runs)
+# compile once per machine, not once per test.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

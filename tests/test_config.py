@@ -1,0 +1,67 @@
+"""ComponentConfig parsing + profile plugin construction."""
+
+from kubernetes_tpu.config import load_config, build_plugins_for_profile
+
+
+YAML_DOC = """
+apiVersion: kubescheduler.config.k8s.io/v1beta3
+kind: KubeSchedulerConfiguration
+parallelism: 8
+podInitialBackoffSeconds: 2
+profiles:
+  - schedulerName: default-scheduler
+    pluginConfig:
+      - name: InterPodAffinity
+        args:
+          hardPodAffinityWeight: 5
+      - name: NodeResourcesFit
+        args:
+          scoringStrategy:
+            type: MostAllocated
+            resources:
+              - name: cpu
+                weight: 2
+              - name: memory
+                weight: 1
+  - schedulerName: spread-scheduler
+    plugins:
+      score:
+        disabled:
+          - name: ImageLocality
+        enabled:
+          - name: PodTopologySpread
+            weight: 5
+"""
+
+
+def test_load_yaml_defaults():
+    cfg = load_config(YAML_DOC)
+    assert cfg.parallelism == 8
+    assert cfg.pod_initial_backoff_seconds == 2
+    assert len(cfg.profiles) == 2
+    prof = cfg.profile("default-scheduler")
+    plugins = build_plugins_for_profile(prof, domain_cap=8)
+    by_name = {pw.plugin.name: pw for pw in plugins}
+    assert by_name["InterPodAffinity"].plugin.hard_weight == 5.0
+    assert by_name["NodeResourcesFit"].plugin.strategy == "MostAllocated"
+    assert by_name["TaintToleration"].weight == 3  # default weight kept
+
+
+def test_profile_disable_and_weight_override():
+    cfg = load_config(YAML_DOC)
+    prof = cfg.profile("spread-scheduler")
+    plugins = build_plugins_for_profile(prof, domain_cap=8)
+    names = {pw.plugin.name for pw in plugins}
+    assert "ImageLocality" not in names
+    by_name = {pw.plugin.name: pw for pw in plugins}
+    assert by_name["PodTopologySpread"].weight == 5
+
+
+def test_empty_config_gets_default_profile():
+    cfg = load_config({})
+    assert len(cfg.profiles) == 1
+    plugins = build_plugins_for_profile(cfg.profiles[0], domain_cap=8)
+    assert {pw.plugin.name for pw in plugins} >= {
+        "NodeResourcesFit", "TaintToleration", "NodeAffinity",
+        "PodTopologySpread", "InterPodAffinity",
+    }

@@ -1,0 +1,184 @@
+"""Device-path vs host-oracle parity (SURVEY §4 testing lesson, §7 step 4).
+
+Builds randomized clusters, runs the batched device pipeline and the sequential
+Python oracle over the same state, and asserts identical feasibility masks,
+scores, and (greedy) bindings.  Test data sticks to unit-exact quantities
+(whole cores / Mi) so encoder quantization cannot cause divergence.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu import oracle as okl
+from kubernetes_tpu import plugins as P
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.framework.interface import PluginWithWeight as PW
+from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+from kubernetes_tpu.framework.runtime import BatchedFramework, initial_dynamic_state
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.state.encoding import ClusterEncoder
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def build_cluster(rng, n_nodes=12, n_sched=8):
+    cache = Cache()
+    for i in range(n_nodes):
+        w = make_node().name(f"n{i:02d}").capacity(
+            {"cpu": f"{int(rng.choice([4, 8, 16]))}",
+             "memory": f"{int(rng.choice([8, 16, 32]))}Gi", "pods": "110"}
+        ).label("zone", f"z{i % 3}").label("disk", rng.choice(["ssd", "hdd"]))
+        if rng.random() < 0.2:
+            w = w.taint("dedicated", "gpu", v1.TAINT_NO_SCHEDULE)
+        if rng.random() < 0.2:
+            w = w.taint("flaky", "", v1.TAINT_PREFER_NO_SCHEDULE)
+        cache.add_node(w.obj())
+    for i in range(n_sched):
+        w = (make_pod().name(f"sp{i}").uid(f"sp{i}").namespace("default")
+             .label("app", rng.choice(["web", "db"]))
+             .req({"cpu": f"{int(rng.choice([1, 2]))}",
+                   "memory": f"{int(rng.choice([1, 2]))}Gi"})
+             .node(f"n{int(rng.integers(n_nodes)):02d}"))
+        cache.add_pod(w.obj())
+    return cache
+
+
+def pending_pods(rng, k=8):
+    pods = []
+    for i in range(k):
+        w = (make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+             .req({"cpu": "1", "memory": "1Gi"}).label("app", "web"))
+        kind = i % 8
+        if kind == 1:
+            w = w.node_selector({"disk": "ssd"})
+        elif kind == 2:
+            w = w.toleration("dedicated", "gpu", v1.TAINT_NO_SCHEDULE)
+        elif kind == 3:
+            w = w.node_affinity_in("zone", ["z0", "z1"])
+        elif kind == 4:
+            w = w.preferred_node_affinity(10, "disk", ["ssd"])
+        elif kind == 5:
+            w = w.topology_spread(1, "zone", labels={"app": "web"})
+        elif kind == 6:
+            w = w.pod_affinity("zone", {"app": "web"})
+        elif kind == 7:
+            w = w.pod_affinity("zone", {"app": "db"}, anti=True)
+        pods.append(w.obj())
+    return pods
+
+
+_FW_CACHE = {}
+
+
+def default_framework(enc):
+    """One framework (and thus one set of jitted programs) per domain_cap —
+    tests with equal shapes share compiles."""
+    d = enc.domain_cap
+    if d in _FW_CACHE:
+        return _FW_CACHE[d]
+    fw = _make_framework(d)
+    fw.jit_compute = jax.jit(fw.compute)
+    fw.jit_greedy = jax.jit(fw.greedy_assign)
+    _FW_CACHE[d] = fw
+    return fw
+
+
+def _make_framework(d):
+    return BatchedFramework([
+        PW(P.NodeUnschedulablePlugin(), 0),
+        PW(P.NodeNamePlugin(), 0),
+        PW(P.TaintTolerationPlugin(), 3),
+        PW(P.NodeAffinityPlugin(), 2),
+        PW(P.NodePortsPlugin(), 0),
+        PW(P.FitPlugin(), 1),
+        PW(P.PodTopologySpreadPlugin(domain_cap=d), 2),
+        PW(P.InterPodAffinityPlugin(domain_cap=d), 2),
+        PW(P.BalancedAllocationPlugin(), 1),
+        PW(P.ImageLocalityPlugin(), 1),
+    ])
+
+
+def device_pipeline(cache, pods):
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    comp = PodBatchCompiler(enc)
+    batch = comp.compile(pods)
+    enc.full_sync(snap)
+    fw = default_framework(enc)
+    host_auxes = fw.host_prepare(batch, snap, enc)
+    dsnap = enc.to_device()
+    dyn = initial_dynamic_state(dsnap)
+    auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+    return fw, batch, snap, enc, dsnap, dyn, auxes
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_filter_and_score_parity(seed):
+    rng = np.random.default_rng(seed)
+    cache = build_cluster(rng)
+    pods = pending_pods(rng)
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    mask, scores = fw.jit_compute(batch, dsnap, dyn, auxes)
+    mask = np.asarray(mask)
+    scores = np.asarray(scores)
+
+    oracle = okl.Oracle()
+    infos = snap.node_info_list
+    row_of = {name: r for name, r in enc.node_rows.items()}
+    for i, pod in enumerate(pods):
+        feasible = oracle.feasible_nodes(pod, infos)
+        feas_names = {ni.node_name for ni in feasible}
+        dev_names = {
+            name for name, r in row_of.items() if mask[i, r]
+        }
+        assert dev_names == feas_names, (
+            f"pod {pod.metadata.name} filter mismatch: "
+            f"device-only={dev_names - feas_names} oracle-only={feas_names - dev_names}"
+        )
+        o_scores = oracle.score_nodes(pod, feasible, infos)
+        for name in feas_names:
+            dv = scores[i, row_of[name]]
+            assert dv == pytest.approx(o_scores[name], abs=1.001), (
+                f"pod {pod.metadata.name} node {name}: device {dv} oracle {o_scores[name]}"
+            )
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_greedy_assign_parity(seed):
+    """Batched lax.scan assignment == sequential oracle schedule-and-assume."""
+    rng = np.random.default_rng(seed)
+    cache = build_cluster(rng)
+    pods = pending_pods(rng, k=6)
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    res = fw.jit_greedy(batch, dsnap, dyn, auxes, jnp.arange(batch.size), None)
+    node_row = np.asarray(res.node_row)
+    name_of = {r: name for name, r in enc.node_rows.items()}
+    device_assign = [
+        name_of.get(int(node_row[i]), None) if node_row[i] >= 0 else None
+        for i in range(len(pods))
+    ]
+
+    oracle = okl.Oracle()
+    infos = [ni.clone() for ni in snap.node_info_list]
+    import copy
+    oracle_assign = oracle.schedule_batch([copy.deepcopy(p) for p in pods], infos)
+    assert device_assign == oracle_assign
+
+
+def test_taint_score_prefer_no_schedule():
+    cache = Cache()
+    cache.add_node(make_node().name("clean").obj())
+    cache.add_node(
+        make_node().name("tainted").taint("a", "", v1.TAINT_PREFER_NO_SCHEDULE)
+        .taint("b", "", v1.TAINT_PREFER_NO_SCHEDULE).obj()
+    )
+    pod = make_pod().name("p").uid("p").req({"cpu": "1"}).obj()
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, [pod])
+    mask, scores = fw.jit_compute(batch, dsnap, dyn, auxes)
+    r = {name: row for name, row in enc.node_rows.items()}
+    # both feasible; clean strictly preferred
+    assert np.asarray(mask)[0, r["clean"]] and np.asarray(mask)[0, r["tainted"]]
+    assert np.asarray(scores)[0, r["clean"]] > np.asarray(scores)[0, r["tainted"]]

@@ -1,0 +1,72 @@
+"""Preemption engine (reference: defaultpreemption tests' scenarios)."""
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.preemption import Evaluator, pods_with_pdb_violation
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def snapshot_of(cache):
+    s = Snapshot()
+    cache.update_snapshot(s)
+    return s
+
+
+def test_select_victims_minimal_set():
+    cache = Cache()
+    cache.add_node(make_node().name("n0")
+                   .capacity({"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+    # three low-priority 1-cpu pods fill to 3/4; preemptor wants 2 cpu
+    for i in range(3):
+        cache.add_pod(make_pod().name(f"v{i}").uid(f"v{i}").namespace("default")
+                      .priority(i)  # distinct priorities 0,1,2
+                      .req({"cpu": "1"}).node("n0").obj())
+    snap = snapshot_of(cache)
+    preemptor = make_pod().name("hi").uid("hi").namespace("default").priority(100).req({"cpu": "2"}).obj()
+
+    ev = Evaluator()
+    c = ev.select_victims_on_node(preemptor, snap.node_info_list[0], snap.node_info_list)
+    assert c is not None
+    # needs only 1 cpu freed → exactly one victim, the least important (prio 0)
+    assert [p.metadata.name for p in c.victims] == ["v0"]
+
+
+def test_pick_node_fewest_pdb_violations_then_lowest_priority():
+    from kubernetes_tpu.preemption import Candidate
+
+    a = Candidate("a", [make_pod().name("x").priority(5).obj()], num_pdb_violations=1)
+    b = Candidate("b", [make_pod().name("y").priority(9).obj()], num_pdb_violations=0)
+    c = Candidate("c", [make_pod().name("z").priority(3).obj()], num_pdb_violations=0)
+    ev = Evaluator()
+    assert ev.pick_one_node([a, b, c]).node_name == "c"
+
+
+def test_pdb_violation_filter():
+    pdb = v1.PodDisruptionBudget(
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        disruptions_allowed=0,
+    )
+    pdb.metadata.namespace = "default"
+    protected = make_pod().name("a").namespace("default").label("app", "web").obj()
+    free = make_pod().name("b").namespace("default").label("app", "db").obj()
+    violating, ok = pods_with_pdb_violation([protected, free], [pdb])
+    assert [p.metadata.name for p in violating] == ["a"]
+    assert [p.metadata.name for p in ok] == ["b"]
+
+
+def test_preempt_end_to_end_pick():
+    cache = Cache()
+    for name, cpu in [("n0", "2"), ("n1", "2")]:
+        cache.add_node(make_node().name(name)
+                       .capacity({"cpu": cpu, "memory": "4Gi", "pods": "10"}).obj())
+    # n0 holds a high-priority victim, n1 a low-priority one → prefer n1
+    cache.add_pod(make_pod().name("imp").uid("imp").namespace("default")
+                  .priority(50).req({"cpu": "2"}).node("n0").obj())
+    cache.add_pod(make_pod().name("cheap").uid("cheap").namespace("default")
+                  .priority(1).req({"cpu": "2"}).node("n1").obj())
+    snap = snapshot_of(cache)
+    preemptor = make_pod().name("hi").uid("hi").namespace("default").priority(100).req({"cpu": "2"}).obj()
+    ev = Evaluator()
+    c = ev.preempt(preemptor, snap, ["n0", "n1"])
+    assert c is not None and c.node_name == "n1"
+    assert [p.metadata.name for p in c.victims] == ["cheap"]

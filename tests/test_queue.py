@@ -1,0 +1,113 @@
+"""PriorityQueue semantics (reference: scheduling_queue_test.go patterns)."""
+
+from kubernetes_tpu.framework import events as ev
+from kubernetes_tpu.framework.events import ActionType, ClusterEvent, EventResource
+from kubernetes_tpu.queueing import PriorityQueue
+from kubernetes_tpu.testutil import make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_priority_ordering():
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("low").uid("low").priority(1).obj())
+    q.add(make_pod().name("high").uid("high").priority(10).obj())
+    q.add(make_pod().name("mid").uid("mid").priority(5).obj())
+    assert [q.pop().pod.metadata.name for _ in range(3)] == ["high", "mid", "low"]
+    assert q.pop() is None
+
+
+def test_fifo_within_priority():
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("a").uid("a").obj())
+    clock.advance(1)
+    q.add(make_pod().name("b").uid("b").obj())
+    assert q.pop().pod.metadata.name == "a"
+    assert q.pop().pod.metadata.name == "b"
+
+
+def test_unschedulable_backoff_and_event_requeue():
+    clock = FakeClock()
+    event_map = {
+        ClusterEvent(EventResource.NODE, ActionType.ADD): {"NodeResourcesFit"},
+    }
+    q = PriorityQueue(clock=clock, cluster_event_map=event_map)
+    q.add(make_pod().name("p").uid("p").obj())
+    info = q.pop()
+    info.unschedulable_plugins = {"NodeResourcesFit"}
+    q.add_unschedulable(info, q.scheduling_cycle())
+    assert q.pop() is None
+    assert q.pending_count() == (0, 0, 1)
+
+    # an event NOT registered by the failing plugin does not requeue
+    q.move_all_to_active_or_backoff(
+        ClusterEvent(EventResource.PVC, ActionType.ADD)
+    )
+    assert q.pending_count()[2] == 1
+
+    # a registered event moves it to backoff (still within backoff window)
+    q.move_all_to_active_or_backoff(ev.NODE_ADD)
+    assert q.pending_count() == (0, 1, 0)
+    assert q.pop() is None  # backoff not expired
+    clock.advance(2.0)  # initial backoff 1s
+    assert q.pop().pod.metadata.name == "p"
+
+
+def test_backoff_grows_exponentially():
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("p").uid("p").obj())
+    for attempt in range(1, 4):
+        info = q.pop()
+        assert info is not None and info.attempts == attempt
+        q.add_unschedulable(info, q.scheduling_cycle())
+        q.move_all_to_active_or_backoff(ev.WILDCARD_EVENT)
+        # backoff = initial * 2^(attempts-1)
+        expected = min(1.0 * 2 ** (attempt - 1), 10.0)
+        clock.advance(expected - 0.01)
+        assert q.pop() is None
+        clock.advance(0.02)
+
+
+def test_unschedulable_flush_after_limit():
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("p").uid("p").obj())
+    info = q.pop()
+    q.add_unschedulable(info, q.scheduling_cycle())
+    clock.advance(61.0)  # DEFAULT_UNSCHEDULABLE_TIME_LIMIT
+    assert q.pop().pod.metadata.name == "p"
+
+
+def test_move_during_cycle_goes_to_backoff():
+    """AddUnschedulableIfNotPresent: a move since the pod's cycle → backoffQ."""
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("p").uid("p").obj())
+    cycle = q.scheduling_cycle()
+    info = q.pop()
+    q.move_all_to_active_or_backoff(ev.NODE_ADD)  # move happens mid-cycle
+    q.add_unschedulable(info, cycle)
+    assert q.pending_count() == (0, 1, 0)  # backoff, not unschedulable
+
+
+def test_activate():
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    pod = make_pod().name("p").uid("p").obj()
+    q.add(pod)
+    info = q.pop()
+    q.add_unschedulable(info, q.scheduling_cycle())
+    q.activate([pod])
+    assert q.pop().pod.metadata.name == "p"
