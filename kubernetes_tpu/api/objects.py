@@ -646,6 +646,8 @@ class PersistentVolumeClaim:
     volume_name: str = ""
     storage_class_name: Optional[str] = None
     phase: str = "Pending"  # Bound once volume_name set
+    requested_storage: object = 0  # spec.resources.requests.storage quantity
+    access_modes: List[str] = field(default_factory=list)
 
     kind = "PersistentVolumeClaim"
 
@@ -658,6 +660,8 @@ class PersistentVolumeClaim:
             volume_name=spec.get("volumeName", ""),
             storage_class_name=spec.get("storageClassName"),
             phase=status.get("phase", "Pending"),
+            requested_storage=((spec.get("resources") or {}).get("requests") or {}).get("storage", 0),
+            access_modes=[str(x) for x in spec.get("accessModes") or []],
         )
 
 
@@ -667,6 +671,8 @@ class PersistentVolume:
     capacity: Dict[str, object] = field(default_factory=dict)
     node_affinity: Optional[NodeSelector] = None
     storage_class_name: str = ""
+    claim_ref: Optional[str] = None  # "namespace/name" of the bound PVC
+    access_modes: List[str] = field(default_factory=list)
 
     kind = "PersistentVolume"
 
@@ -674,12 +680,59 @@ class PersistentVolume:
     def from_dict(cls, d: Mapping) -> "PersistentVolume":
         spec = d.get("spec") or {}
         na = (spec.get("nodeAffinity") or {}).get("required")
+        cr = spec.get("claimRef") or {}
         return cls(
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             capacity=dict(spec.get("capacity") or {}),
             node_affinity=NodeSelector.from_dict(na),
             storage_class_name=spec.get("storageClassName", ""),
+            claim_ref=(
+                f"{cr.get('namespace', '')}/{cr.get('name', '')}" if cr else None
+            ),
+            access_modes=[str(x) for x in spec.get("accessModes") or []],
         )
+
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+    provisioner: str = ""
+
+    kind = "StorageClass"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "StorageClass":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            volume_binding_mode=d.get("volumeBindingMode", VOLUME_BINDING_IMMEDIATE),
+            provisioner=d.get("provisioner", ""),
+        )
+
+
+@dataclass
+class CSINode:
+    """storage.k8s.io/v1 CSINode — per-driver attach limits the scheduler reads."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    driver_limits: Dict[str, int] = field(default_factory=dict)  # driver → count
+
+    kind = "CSINode"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CSINode":
+        spec = d.get("spec") or {}
+        limits = {}
+        for drv in spec.get("drivers") or []:
+            alloc = drv.get("allocatable") or {}
+            if "count" in alloc:
+                limits[drv.get("name", "")] = int(alloc["count"])
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   driver_limits=limits)
 
 
 @dataclass
@@ -699,10 +752,30 @@ class Service:
 
 
 @dataclass
+class PodTemplateSpec:
+    """spec.template of workload controllers."""
+
+    labels: Dict[str, str] = field(default_factory=dict)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Mapping]) -> "PodTemplateSpec":
+        d = d or {}
+        meta = d.get("metadata") or {}
+        return cls(
+            labels=dict(meta.get("labels") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+        )
+
+
+@dataclass
 class ReplicaSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
     replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_replicas: int = 0
+    status_ready_replicas: int = 0
 
     kind = "ReplicaSet"
 
@@ -713,6 +786,51 @@ class ReplicaSet:
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             selector=LabelSelector.from_dict(spec.get("selector")),
             replicas=int(spec.get("replicas", 1)),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
+        )
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_updated_replicas: int = 0
+
+    kind = "Deployment"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Deployment":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            replicas=int(spec.get("replicas", 1)),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
+        )
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    completions: int = 1
+    parallelism: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_succeeded: int = 0
+    status_active: int = 0
+    completed: bool = False
+
+    kind = "Job"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Job":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            completions=int(spec.get("completions", 1)),
+            parallelism=int(spec.get("parallelism", 1)),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
         )
 
 

@@ -1,0 +1,6 @@
+"""Client machinery (reference L3: staging/src/k8s.io/client-go)."""
+
+from .informer import Reflector, SharedInformer, InformerFactory  # noqa: F401
+from .workqueue import RateLimitingQueue  # noqa: F401
+from .leaderelection import LeaderElector, LeaseLock  # noqa: F401
+from .events import EventRecorder, Event  # noqa: F401

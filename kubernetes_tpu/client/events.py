@@ -1,0 +1,63 @@
+"""Event recorder (reference: client-go tools/record + tools/events).
+
+The scheduler emits FailedScheduling/Scheduled events (scheduler.go:386,488);
+events are aggregated by (object, reason) with a count, like the reference's
+correlator.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from ..api.objects import ObjectMeta
+from ..sim.store import ObjectStore
+
+
+@dataclass
+class Event:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: str = ""  # "Kind/namespace/name"
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # or Warning
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+    kind = "Event"
+
+
+class EventRecorder:
+    def __init__(self, store: ObjectStore, source: str = "tpu-scheduler",
+                 clock=time.time):
+        self.store = store
+        self.source = source
+        self.clock = clock
+        self._index: Dict[Tuple[str, str], Event] = {}
+
+    def eventf(self, obj, event_type: str, reason: str, message: str) -> Event:
+        ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"
+        key = (ref, reason)
+        now = self.clock()
+        ev = self._index.get(key)
+        if ev is not None:
+            ev.count += 1
+            ev.last_timestamp = now
+            ev.message = message
+            self.store.update("Event", ev)
+            return ev
+        ev = Event(
+            involved_object=ref, reason=reason, message=message, type=event_type,
+            first_timestamp=now, last_timestamp=now,
+        )
+        ev.metadata.namespace = obj.metadata.namespace or "default"
+        ev.metadata.name = f"{obj.metadata.name}.{int(now * 1e6):x}"
+        self._index[key] = ev
+        self.store.create("Event", ev)
+        return ev
+
+    def events_for(self, obj) -> List[Event]:
+        ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"
+        return [e for (r, _), e in self._index.items() if r == ref]

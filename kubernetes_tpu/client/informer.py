@@ -1,0 +1,122 @@
+"""Reflector + SharedInformer over the sim store.
+
+Reference: client-go tools/cache — Reflector.ListAndWatch (reflector.go:49,254):
+LIST returns a consistent snapshot + resourceVersion; WATCH resumes from that rv;
+on restart the reflector relists (the stateless-recovery property SURVEY §5
+"checkpoint/resume" relies on).  SharedInformer fans one watch out to many
+handlers with add/update/delete callbacks and a synced() barrier.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..sim.store import ADDED, DELETED, MODIFIED, ObjectStore, WatchEvent
+
+
+class Reflector:
+    """ListAndWatch one kind into a local store dict."""
+
+    def __init__(self, store: ObjectStore, kind: str):
+        self.store = store
+        self.kind = kind
+        self.items: Dict[Tuple[str, str], object] = {}
+        self.last_rv = 0
+        self._handlers: List[Callable[[str, object, Optional[object]], None]] = []
+        self._unwatch = None
+        self._synced = False
+
+    def add_handler(self, fn: Callable[[str, object, Optional[object]], None]):
+        """fn(event_type, obj, old_obj)."""
+        self._handlers.append(fn)
+
+    def _key(self, obj) -> Tuple[str, str]:
+        ns = (
+            "" if self.kind in ObjectStore.CLUSTER_SCOPED
+            else getattr(obj.metadata, "namespace", "")
+        )
+        return (ns, obj.metadata.name)
+
+    def run(self):
+        """LIST (snapshot + rv), deliver synthetic ADDs, then WATCH from rv."""
+        objs, rv = self.store.list(self.kind)
+        for o in objs:
+            self.items[self._key(o)] = o
+            for h in self._handlers:
+                h(ADDED, o, None)
+        self.last_rv = rv
+        self._unwatch = self.store.watch(self._on_event, since_rv=rv)
+        self._synced = True
+
+    def stop(self):
+        if self._unwatch:
+            self._unwatch()
+            self._unwatch = None
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    def _on_event(self, ev: WatchEvent):
+        if ev.kind != self.kind:
+            return
+        self.last_rv = ev.resource_version
+        key = self._key(ev.obj)
+        old = self.items.get(key)
+        if ev.type == DELETED:
+            self.items.pop(key, None)
+        else:
+            self.items[key] = ev.obj
+        for h in self._handlers:
+            h(ev.type, ev.obj, old)
+
+
+class SharedInformer:
+    """One reflector, many handlers; exposes a lister over the local cache."""
+
+    def __init__(self, store: ObjectStore, kind: str):
+        self.reflector = Reflector(store, kind)
+
+    def add_event_handler(self, on_add=None, on_update=None, on_delete=None):
+        def h(ev_type, obj, old):
+            if ev_type == ADDED and on_add:
+                on_add(obj)
+            elif ev_type == MODIFIED and on_update:
+                on_update(old, obj)
+            elif ev_type == DELETED and on_delete:
+                on_delete(obj)
+
+        self.reflector.add_handler(h)
+
+    def run(self):
+        self.reflector.run()
+
+    def has_synced(self) -> bool:
+        return self.reflector.has_synced()
+
+    def list(self) -> List[object]:
+        return list(self.reflector.items.values())
+
+    def get(self, namespace: str, name: str) -> Optional[object]:
+        return self.reflector.items.get((namespace, name))
+
+
+class InformerFactory:
+    """SharedInformerFactory: one informer per kind, started together."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._informers: Dict[str, SharedInformer] = {}
+
+    def informer(self, kind: str) -> SharedInformer:
+        if kind not in self._informers:
+            self._informers[kind] = SharedInformer(self.store, kind)
+        return self._informers[kind]
+
+    def start(self):
+        for inf in self._informers.values():
+            if not inf.has_synced():
+                inf.run()
+
+    def wait_for_cache_sync(self) -> bool:
+        return all(i.has_synced() for i in self._informers.values())

@@ -1,0 +1,102 @@
+"""Leader election on Lease objects.
+
+Reference: client-go tools/leaderelection/leaderelection.go:112-150 — acquire a
+Lease by CAS on holderIdentity/renewTime; renew every RetryPeriod; a candidate
+steals the lease when renewTime is older than LeaseDuration.  The scheduler
+exits when it loses the lease (cmd/kube-scheduler/app/server.go:204-215) —
+active/passive replication for the control plane (SURVEY §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api.objects import ObjectMeta
+from ..sim.store import ObjectStore
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    renew_time: float = 0.0
+
+    kind = "Lease"
+
+
+class LeaseLock:
+    def __init__(self, store: ObjectStore, namespace: str, name: str):
+        self.store = store
+        self.namespace = namespace
+        self.name = name
+
+    def get(self) -> Optional[Lease]:
+        return self.store.get("Lease", self.namespace, self.name)
+
+    def create(self, lease: Lease) -> None:
+        lease.metadata.namespace = self.namespace
+        lease.metadata.name = self.name
+        self.store.create("Lease", lease)
+
+    def update(self, lease: Lease) -> None:
+        self.store.update("Lease", lease)
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lock: LeaseLock,
+        identity: str,
+        lease_duration: float = 15.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.lock = lock
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.clock = clock
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def try_acquire_or_renew(self) -> bool:
+        """One tick of the acquire/renew loop; returns current leadership."""
+        now = self.clock()
+        lease = self.lock.get()
+        if lease is None:
+            lease = Lease(
+                holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                renew_time=now,
+            )
+            self.lock.create(lease)
+            self._set_leading(True)
+            return True
+        expired = now - lease.renew_time > lease.lease_duration_seconds
+        if lease.holder_identity == self.identity:
+            lease.renew_time = now
+            self.lock.update(lease)
+            self._set_leading(True)
+            return True
+        if expired:
+            lease.holder_identity = self.identity
+            lease.renew_time = now
+            self.lock.update(lease)
+            self._set_leading(True)
+            return True
+        self._set_leading(False)
+        return False
+
+    def _set_leading(self, leading: bool):
+        if leading and not self._leading and self.on_started_leading:
+            self.on_started_leading()
+        if not leading and self._leading and self.on_stopped_leading:
+            self.on_stopped_leading()
+        self._leading = leading

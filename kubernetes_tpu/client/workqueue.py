@@ -1,0 +1,73 @@
+"""Rate-limited work queue (reference: client-go util/workqueue).
+
+Dedup semantics: an item added while queued coalesces; an item added while
+being processed is re-queued after Done (the "dirty" set).  Rate limiting is
+per-item exponential (5ms·2^failures, capped) like DefaultControllerRateLimiter.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from typing import Callable, Dict, Hashable, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._base = base_delay
+        self._max = max_delay
+        self._queue: List[Hashable] = []
+        self._queued: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._dirty: Set[Hashable] = set()
+        self._failures: Dict[Hashable, int] = {}
+        self._delayed: List[Tuple[float, int, Hashable]] = []
+        self._seq = itertools.count()
+
+    def add(self, item: Hashable) -> None:
+        if item in self._processing:
+            self._dirty.add(item)
+            return
+        if item in self._queued:
+            return
+        self._queued.add(item)
+        self._queue.append(item)
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        heapq.heappush(self._delayed, (self._clock() + delay, next(self._seq), item))
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        n = self._failures.get(item, 0)
+        self._failures[item] = n + 1
+        self.add_after(item, min(self._base * (2 ** n), self._max))
+
+    def forget(self, item: Hashable) -> None:
+        self._failures.pop(item, None)
+
+    def _drain_delayed(self):
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            self.add(item)
+
+    def get(self) -> Optional[Hashable]:
+        self._drain_delayed()
+        if not self._queue:
+            return None
+        item = self._queue.pop(0)
+        self._queued.discard(item)
+        self._processing.add(item)
+        return item
+
+    def done(self, item: Hashable) -> None:
+        self._processing.discard(item)
+        if item in self._dirty:
+            self._dirty.discard(item)
+            self.add(item)
+
+    def __len__(self) -> int:
+        self._drain_delayed()
+        return len(self._queue)

@@ -1,0 +1,24 @@
+"""configz: live config introspection (reference: component-base/configz;
+scheduler registers its effective componentconfig, server.go:146-150)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict
+
+
+class Configz:
+    def __init__(self):
+        self._sections: Dict[str, Any] = {}
+
+    def install(self, name: str, config: Any) -> None:
+        self._sections[name] = config
+
+    def dump(self) -> str:
+        def default(o):
+            if dataclasses.is_dataclass(o):
+                return dataclasses.asdict(o)
+            return str(o)
+
+        return json.dumps(self._sections, default=default, sort_keys=True)

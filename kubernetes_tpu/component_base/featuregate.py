@@ -1,0 +1,71 @@
+"""Feature gates (reference: component-base/featuregate + the 114 gates of
+pkg/features/kube_features.go).
+
+Gates relevant to the scheduling capability surface are pre-registered with
+their ~v1.24 default states; unknown gates can be registered at runtime.
+``--feature-gates``-style strings parse via set_from_string.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+ALPHA, BETA, GA, DEPRECATED = "ALPHA", "BETA", "GA", "DEPRECATED"
+
+
+@dataclass
+class FeatureSpec:
+    default: bool
+    stage: str = ALPHA
+    lock_to_default: bool = False
+
+
+class FeatureGate:
+    def __init__(self):
+        self._specs: Dict[str, FeatureSpec] = {}
+        self._enabled: Dict[str, bool] = {}
+
+    def register(self, name: str, spec: FeatureSpec) -> None:
+        self._specs[name] = spec
+
+    def enabled(self, name: str) -> bool:
+        if name in self._enabled:
+            return self._enabled[name]
+        spec = self._specs.get(name)
+        return spec.default if spec else False
+
+    def set(self, name: str, value: bool) -> None:
+        spec = self._specs.get(name)
+        if spec is not None and spec.lock_to_default and value != spec.default:
+            raise ValueError(f"feature {name} is locked to {spec.default}")
+        self._enabled[name] = value
+
+    def set_from_string(self, s: str) -> None:
+        """'Foo=true,Bar=false' (the --feature-gates flag format)."""
+        for part in filter(None, (p.strip() for p in s.split(","))):
+            name, _, val = part.partition("=")
+            self.set(name, val.strip().lower() in ("true", "1", "t"))
+
+    def known(self) -> Dict[str, FeatureSpec]:
+        return dict(self._specs)
+
+
+default_feature_gate = FeatureGate()
+
+# scheduling-relevant gates @ ~v1.24 defaults (pkg/features/kube_features.go)
+for _name, _spec in {
+    "DefaultPodTopologySpread": FeatureSpec(True, GA),
+    "MinDomainsInPodTopologySpread": FeatureSpec(False, ALPHA),
+    "NodeAffinityLabelSelector": FeatureSpec(True, GA),
+    "PodAffinityNamespaceSelector": FeatureSpec(True, BETA),
+    "PodOverhead": FeatureSpec(True, BETA),
+    "PodDisruptionBudget": FeatureSpec(True, GA, lock_to_default=True),
+    "PreferNominatedNode": FeatureSpec(True, GA),
+    "VolumeCapacityPriority": FeatureSpec(False, ALPHA),
+    "CSIStorageCapacity": FeatureSpec(True, BETA),
+    "LocalStorageCapacityIsolation": FeatureSpec(True, BETA),
+    "NonPreemptingPriority": FeatureSpec(True, GA),
+    "TaintBasedEvictions": FeatureSpec(True, GA),
+}.items():
+    default_feature_gate.register(_name, _spec)

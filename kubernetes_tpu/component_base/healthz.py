@@ -1,0 +1,23 @@
+"""healthz checks (reference: apiserver/pkg/server/healthz; every binary serves
+/healthz with named checks)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+
+class Healthz:
+    def __init__(self):
+        self._checks: Dict[str, Callable[[], bool]] = {"ping": lambda: True}
+
+    def add_check(self, name: str, fn: Callable[[], bool]) -> None:
+        self._checks[name] = fn
+
+    def check(self) -> Tuple[bool, Dict[str, bool]]:
+        results = {}
+        for name, fn in self._checks.items():
+            try:
+                results[name] = bool(fn())
+            except Exception:
+                results[name] = False
+        return all(results.values()), results

@@ -1,0 +1,60 @@
+"""Step tracing (reference: k8s.io/utils/trace as used in the scheduling hot
+path — schedulePod creates a trace and logs if >100ms, scheduler.go:775-816;
+plus a hook into the JAX profiler as the OTel analog)."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+log = logging.getLogger("kubernetes_tpu.trace")
+
+
+@dataclass
+class Step:
+    name: str
+    at: float
+
+
+class Trace:
+    def __init__(self, name: str, clock=time.perf_counter, **fields):
+        self.name = name
+        self.fields = fields
+        self.clock = clock
+        self.start = clock()
+        self.steps: List[Step] = []
+
+    def step(self, name: str) -> None:
+        self.steps.append(Step(name, self.clock()))
+
+    def total_seconds(self) -> float:
+        return self.clock() - self.start
+
+    def log_if_long(self, threshold: float = 0.1) -> Optional[str]:
+        """utiltrace semantics: dump all steps when total exceeds threshold."""
+        total = self.total_seconds()
+        if total < threshold:
+            return None
+        parts = [f'trace "{self.name}" {self.fields} total={total * 1000:.1f}ms']
+        prev = self.start
+        for s in self.steps:
+            parts.append(f"  step {s.name}: +{(s.at - prev) * 1000:.1f}ms")
+            prev = s.at
+        msg = "\n".join(parts)
+        log.info(msg)
+        return msg
+
+
+@contextlib.contextmanager
+def device_profile(path: str):
+    """JAX profiler session (the OTel-exporter analog for device work)."""
+    import jax
+
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

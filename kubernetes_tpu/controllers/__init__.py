@@ -1,0 +1,9 @@
+"""Controller loops (reference L4a: pkg/controller, registered in
+cmd/kube-controller-manager/app/controllermanager.go:402-449)."""
+
+from .manager import ControllerManager  # noqa: F401
+from .replicaset import ReplicaSetController  # noqa: F401
+from .deployment import DeploymentController  # noqa: F401
+from .job import JobController  # noqa: F401
+from .nodelifecycle import NodeLifecycleController  # noqa: F401
+from .garbagecollector import GarbageCollector  # noqa: F401

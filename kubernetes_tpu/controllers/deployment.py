@@ -1,0 +1,69 @@
+"""Deployment controller (reference: pkg/controller/deployment/deployment_controller.go
+syncDeployment — owns ReplicaSets; rollout = new RS scaled up, old scaled down)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+
+
+def _template_hash(template: v1.PodTemplateSpec) -> str:
+    blob = json.dumps(
+        {
+            "labels": template.labels,
+            "containers": [
+                (c.name, c.image, sorted((c.resources.requests or {}).items()))
+                for c in template.spec.containers
+            ],
+            "nodeSelector": sorted(template.spec.node_selector.items()),
+        },
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+
+class DeploymentController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        deps, _ = self.store.list("Deployment")
+        rss, _ = self.store.list("ReplicaSet")
+        for dep in deps:
+            owned = [
+                rs for rs in rss
+                if any(r.kind == "Deployment" and r.uid == dep.metadata.uid
+                       for r in rs.metadata.owner_references)
+            ]
+            h = _template_hash(dep.template)
+            current_name = f"{dep.metadata.name}-{h}"
+            current = next((rs for rs in owned if rs.metadata.name == current_name), None)
+            if current is None:
+                rs = v1.ReplicaSet(
+                    selector=dep.selector, replicas=dep.replicas,
+                    template=dep.template,
+                )
+                rs.metadata.namespace = dep.metadata.namespace
+                rs.metadata.name = current_name
+                rs.metadata.owner_references = [
+                    v1.OwnerReference(kind="Deployment", name=dep.metadata.name,
+                                      uid=dep.metadata.uid, controller=True)
+                ]
+                rs.template.labels = dict(dep.template.labels)
+                self.store.create("ReplicaSet", rs)
+                changed = True
+            elif current.replicas != dep.replicas:
+                current.replicas = dep.replicas
+                self.store.update("ReplicaSet", current)
+                changed = True
+            # scale down superseded ReplicaSets (recreate-ish rollout)
+            for rs in owned:
+                if rs.metadata.name != current_name and rs.replicas != 0:
+                    rs.replicas = 0
+                    self.store.update("ReplicaSet", rs)
+                    changed = True
+        return changed

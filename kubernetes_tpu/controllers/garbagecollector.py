@@ -1,0 +1,36 @@
+"""Garbage collector (reference: pkg/controller/garbagecollector — delete
+objects whose controller ownerReference no longer exists; cascade)."""
+
+from __future__ import annotations
+
+from ..sim.store import ObjectStore
+
+OWNABLE_KINDS = ("Pod", "ReplicaSet")
+OWNER_KINDS = {"ReplicaSet", "Deployment", "Job"}
+
+
+class GarbageCollector:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _owner_exists(self, ref, namespace: str) -> bool:
+        if ref.kind not in OWNER_KINDS:
+            return True  # unknown owner kinds are left alone
+        objs, _ = self.store.list(ref.kind)
+        return any(
+            o.metadata.uid == ref.uid and o.metadata.namespace == namespace
+            for o in objs
+        )
+
+    def sync_once(self) -> bool:
+        changed = False
+        for kind in OWNABLE_KINDS:
+            objs, _ = self.store.list(kind)
+            for o in objs:
+                refs = [r for r in o.metadata.owner_references if r.controller]
+                if not refs:
+                    continue
+                if not any(self._owner_exists(r, o.metadata.namespace) for r in refs):
+                    self.store.delete(kind, o.metadata.namespace, o.metadata.name)
+                    changed = True
+        return changed

@@ -1,0 +1,46 @@
+"""Job controller (reference: pkg/controller/job/job_controller.go syncJob —
+keep ≤ parallelism active pods until completions succeed)."""
+
+from __future__ import annotations
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+from .replicaset import _owned_pods, make_pod_from_template
+
+
+class JobController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        jobs, _ = self.store.list("Job")
+        for job in jobs:
+            if job.completed:
+                continue
+            pods = _owned_pods(self.store, "Job", job)
+            succeeded = sum(1 for p in pods if p.status.phase == v1.POD_SUCCEEDED)
+            active = [
+                p for p in pods
+                if p.status.phase in (v1.POD_PENDING, v1.POD_RUNNING)
+                and p.metadata.deletion_timestamp is None
+            ]
+            want_active = min(job.parallelism, job.completions - succeeded)
+            if succeeded >= job.completions:
+                job.completed = True
+                job.status_succeeded = succeeded
+                job.status_active = 0
+                self.store.update("Job", job)
+                changed = True
+                continue
+            for _ in range(max(0, want_active - len(active))):
+                self.store.create(
+                    "Pod", make_pod_from_template("Job", job, job.template)
+                )
+                changed = True
+            if job.status_succeeded != succeeded or job.status_active != len(active):
+                job.status_succeeded = succeeded
+                job.status_active = len(active)
+                self.store.update("Job", job)
+                changed = True
+        return changed

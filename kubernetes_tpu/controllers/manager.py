@@ -1,0 +1,48 @@
+"""Controller manager: registers loops, drives reconciliation.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:174 (Run) and
+the NewControllerInitializers map :402-449.  No goroutines — callers (tests,
+sim harness) drive sync_all(); each controller keeps its own workqueue.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..sim.store import ObjectStore
+
+
+class ControllerManager:
+    def __init__(self, store: ObjectStore, clock=None):
+        import time
+
+        self.store = store
+        self.clock = clock or time.monotonic
+        self.controllers: List[object] = []
+
+    def register(self, controller) -> "ControllerManager":
+        self.controllers.append(controller)
+        return self
+
+    def register_defaults(self) -> "ControllerManager":
+        from .deployment import DeploymentController
+        from .garbagecollector import GarbageCollector
+        from .job import JobController
+        from .nodelifecycle import NodeLifecycleController
+        from .replicaset import ReplicaSetController
+
+        self.register(DeploymentController(self.store))
+        self.register(ReplicaSetController(self.store))
+        self.register(JobController(self.store))
+        self.register(NodeLifecycleController(self.store, clock=self.clock))
+        self.register(GarbageCollector(self.store))
+        return self
+
+    def sync_all(self, rounds: int = 3) -> None:
+        """Run every controller's reconcile until quiescent (bounded)."""
+        for _ in range(rounds):
+            changed = False
+            for c in self.controllers:
+                changed = bool(c.sync_once()) or changed
+            if not changed:
+                break

@@ -1,0 +1,79 @@
+"""Node lifecycle controller: failure detection + elastic rescheduling.
+
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go:351 —
+monitors node Lease heartbeats (kubelet renews every ¼ lease duration,
+pkg/kubelet/kubelet.go:809-810); a node whose lease is stale past the grace
+period is marked NotReady and gets the NoExecute taint
+node.kubernetes.io/unreachable; its pods are evicted (deleted) so workload
+controllers recreate them and the scheduler places them elsewhere — the
+elastic-recovery loop of SURVEY §5.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+
+UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+DEFAULT_GRACE_PERIOD = 40.0  # node-monitor-grace-period default
+
+
+def _set_condition(node: v1.Node, cond_type: str, status: str):
+    for c in node.status.conditions:
+        if c.get("type") == cond_type:
+            c["status"] = status
+            return
+    node.status.conditions.append({"type": cond_type, "status": status})
+
+
+class NodeLifecycleController:
+    def __init__(self, store: ObjectStore, grace_period: float = DEFAULT_GRACE_PERIOD,
+                 clock=time.monotonic):
+        self.store = store
+        self.grace = grace_period
+        self.clock = clock
+
+    def sync_once(self) -> bool:
+        changed = False
+        now = self.clock()
+        nodes, _ = self.store.list("Node")
+        for node in nodes:
+            lease = self.store.get("Lease", "kube-node-lease", node.metadata.name)
+            stale = lease is None or (now - lease.renew_time) > self.grace
+            tainted = any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+            if stale and lease is not None and not tainted:
+                node.spec.taints.append(
+                    v1.Taint(key=UNREACHABLE_TAINT, effect=v1.TAINT_NO_EXECUTE)
+                )
+                _set_condition(node, "Ready", "Unknown")
+                self.store.update("Node", node)
+                self._evict_pods(node.metadata.name)
+                changed = True
+            elif not stale and tainted:
+                node.spec.taints = [
+                    t for t in node.spec.taints if t.key != UNREACHABLE_TAINT
+                ]
+                _set_condition(node, "Ready", "True")
+                self.store.update("Node", node)
+                changed = True
+        return changed
+
+    def _evict_pods(self, node_name: str):
+        """NoExecute taint-manager eviction: pods without a matching toleration
+        are deleted; controllers recreate them → rescheduled elsewhere."""
+        pods, _ = self.store.list("Pod")
+        for p in pods:
+            if p.spec.node_name != node_name:
+                continue
+            tolerated = any(
+                t.key in (UNREACHABLE_TAINT, "") and (
+                    t.operator == v1.TOLERATION_OP_EXISTS or not t.key
+                ) and t.toleration_seconds is None
+                for t in p.spec.tolerations
+            )
+            if not tolerated:
+                self.store.delete("Pod", p.namespace, p.metadata.name)

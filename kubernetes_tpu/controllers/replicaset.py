@@ -1,0 +1,80 @@
+"""ReplicaSet controller (reference: pkg/controller/replicaset/replica_set.go
+syncReplicaSet — create/delete pods to match .spec.replicas)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import List
+
+from ..api import objects as v1
+from ..api.labels import match_label_selector
+from ..sim.store import ObjectStore
+
+_suffix = itertools.count()
+
+
+def _owned_pods(store: ObjectStore, owner_kind: str, owner) -> List[v1.Pod]:
+    pods, _ = store.list("Pod")
+    out = []
+    for p in pods:
+        if p.namespace != owner.metadata.namespace:
+            continue
+        for ref in p.metadata.owner_references:
+            if ref.kind == owner_kind and ref.uid == owner.metadata.uid:
+                out.append(p)
+                break
+    return out
+
+
+def make_pod_from_template(owner_kind: str, owner, template: v1.PodTemplateSpec) -> v1.Pod:
+    import copy
+
+    pod = v1.Pod()
+    pod.metadata.namespace = owner.metadata.namespace
+    pod.metadata.name = f"{owner.metadata.name}-{next(_suffix):05x}"
+    pod.metadata.labels = dict(template.labels)
+    pod.metadata.owner_references = [
+        v1.OwnerReference(
+            kind=owner_kind, name=owner.metadata.name, uid=owner.metadata.uid,
+            controller=True,
+        )
+    ]
+    pod.spec = copy.deepcopy(template.spec)
+    if not pod.spec.containers:
+        pod.spec.containers = [v1.Container(name="c0", image="pause")]
+    return pod
+
+
+class ReplicaSetController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        rss, _ = self.store.list("ReplicaSet")
+        for rs in rss:
+            pods = [
+                p for p in _owned_pods(self.store, "ReplicaSet", rs)
+                if p.status.phase not in (v1.POD_SUCCEEDED, v1.POD_FAILED)
+                and p.metadata.deletion_timestamp is None
+            ]
+            diff = rs.replicas - len(pods)
+            if diff > 0:
+                for _ in range(diff):
+                    self.store.create(
+                        "Pod", make_pod_from_template("ReplicaSet", rs, rs.template)
+                    )
+                changed = True
+            elif diff < 0:
+                # prefer deleting unscheduled pods first (controller_utils
+                # ActivePodsWithRanks ordering, simplified)
+                pods.sort(key=lambda p: (bool(p.spec.node_name),))
+                for p in pods[: -diff]:
+                    self.store.delete("Pod", p.namespace, p.metadata.name)
+                changed = True
+            ready = sum(1 for p in pods if p.status.phase == v1.POD_RUNNING)
+            if rs.status_replicas != len(pods) or rs.status_ready_replicas != ready:
+                rs.status_replicas = len(pods)
+                rs.status_ready_replicas = ready
+                self.store.update("ReplicaSet", rs)
+        return changed

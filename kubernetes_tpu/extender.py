@@ -1,0 +1,199 @@
+"""Scheduler extender: HTTP/JSON callouts + the TPUScore extender server.
+
+Reference: pkg/scheduler/extender.go — HTTPExtender.Filter :277, .Prioritize
+:347, .Bind :389, .send :416; config in apis/config/types.go:246-286
+(urlPrefix, filterVerb/prioritizeVerb/bindVerb, weight, nodeCacheCapable,
+ignorable, managedResources).
+
+Two halves:
+  - ``HTTPExtender``: the CLIENT the TPU scheduler uses to call out-of-process
+    extenders at Filter/Prioritize/Bind, merging weighted extender scores into
+    the device-computed totals (scheduler.go:1146-1185).
+  - ``TPUScoreExtenderServer``: the SERVER that exposes THIS framework's batched
+    device scorer over the same protocol, so an *unmodified* kube-scheduler can
+    opt in per profile via its extenders config — the sanctioned out-of-process
+    integration boundary (SURVEY §2.1 extender row, §7 step 8).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from .api import objects as v1
+
+
+@dataclass
+class ExtenderConfig:
+    """apis/config/types.go:246-286 subset."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    http_timeout: float = 5.0
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    def __init__(self, cfg: ExtenderConfig):
+        self.cfg = cfg
+
+    @property
+    def is_ignorable(self) -> bool:
+        return self.cfg.ignorable
+
+    def _send(self, verb: str, payload: dict) -> dict:
+        url = f"{self.cfg.url_prefix.rstrip('/')}/{verb}"
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.cfg.http_timeout) as resp:
+            return json.loads(resp.read().decode())
+
+    def filter(
+        self, pod: v1.Pod, node_names: List[str]
+    ) -> Tuple[List[str], Dict[str, str]]:
+        """→ (feasible node names, failed node → reason). ExtenderArgs uses
+        nodenames when nodeCacheCapable (extender.go:277-345)."""
+        if not self.cfg.filter_verb:
+            return node_names, {}
+        args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
+        try:
+            result = self._send(self.cfg.filter_verb, args)
+        except Exception as e:
+            if self.cfg.ignorable:
+                return node_names, {}
+            raise ExtenderError(str(e)) from e
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        return list(result.get("nodenames") or []), dict(result.get("failedNodes") or {})
+
+    def prioritize(
+        self, pod: v1.Pod, node_names: List[str]
+    ) -> Dict[str, float]:
+        """→ node → weighted score contribution (HostPriorityList × weight,
+        scheduler.go:1146-1185)."""
+        if not self.cfg.prioritize_verb:
+            return {}
+        args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
+        try:
+            result = self._send(self.cfg.prioritize_verb, args)
+        except Exception as e:
+            if self.cfg.ignorable:
+                return {}
+            raise ExtenderError(str(e)) from e
+        return {
+            hp["host"]: hp["score"] * self.cfg.weight
+            for hp in result or []
+        }
+
+    def bind(self, pod: v1.Pod, node_name: str) -> bool:
+        if not self.cfg.bind_verb:
+            return False
+        result = self._send(self.cfg.bind_verb, {
+            "podNamespace": pod.namespace, "podName": pod.metadata.name,
+            "podUID": pod.uid, "node": node_name,
+        })
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        return True
+
+
+def _pod_to_dict(pod: v1.Pod) -> dict:
+    return {
+        "metadata": {
+            "name": pod.metadata.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid,
+            "labels": dict(pod.metadata.labels),
+        },
+        "spec": {
+            "schedulerName": pod.spec.scheduler_name,
+            "priority": pod.spec.priority,
+            "nodeName": pod.spec.node_name,
+            "containers": [
+                {"name": c.name, "image": c.image,
+                 "resources": {"requests": dict(c.resources.requests or {})}}
+                for c in pod.spec.containers
+            ],
+            "nodeSelector": dict(pod.spec.node_selector),
+            "tolerations": [
+                {"key": t.key, "operator": t.operator, "value": t.value,
+                 "effect": t.effect}
+                for t in pod.spec.tolerations
+            ],
+        },
+    }
+
+
+class TPUScoreExtenderServer:
+    """Serves this framework's device scorer over the extender protocol.
+
+    Endpoints: POST /filter and /prioritize with ExtenderArgs
+    (nodeCacheCapable: node names only).  Backed by a callable
+    ``score_fn(pod_dict, node_names) -> (feasible names, {name: score})`` —
+    typically TPUScheduler-owned state compiled per request batch.
+    """
+
+    def __init__(self, score_fn, host: str = "127.0.0.1", port: int = 0):
+        self.score_fn = score_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                args = json.loads(self.rfile.read(length) or b"{}")
+                pod = args.get("pod") or {}
+                names = list(args.get("nodenames") or [])
+                try:
+                    feasible, scores = outer.score_fn(pod, names)
+                except Exception as e:  # extender protocol error field
+                    body = {"error": str(e)}
+                    self._reply(body)
+                    return
+                if self.path.rstrip("/").endswith("filter"):
+                    failed = {n: "TPUScore: infeasible" for n in names if n not in feasible}
+                    self._reply({"nodenames": list(feasible), "failedNodes": failed})
+                else:  # prioritize
+                    self._reply([
+                        {"host": n, "score": int(scores.get(n, 0))} for n in names
+                    ])
+
+            def _reply(self, body):
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -80,6 +80,7 @@ class PodBatch:
     label_vals: np.ndarray  # i32[B, PL]
     priority: np.ndarray  # i32[B]
     node_name_id: np.ndarray  # i32[B] (MISSING when spec.nodeName unset)
+    nominated_row: np.ndarray  # i32[B] node row from status.nominatedNodeName (-1 none)
     ports: np.ndarray  # i32[B, PP]
     image_ids: np.ndarray  # i32[B, CI] (container images, for ImageLocality)
     # tolerations
@@ -167,6 +168,7 @@ class PodBatchCompiler:
         ns = np.full(b, MISSING, dtype=np.int32)
         priority = np.zeros(b, dtype=np.int32)
         node_name_id = np.full(b, MISSING, dtype=np.int32)
+        nominated_row = np.full(b, -1, dtype=np.int32)
 
         pl_cap = _pow2(max((len(p.metadata.labels) for p in pods), default=0), 4)
         label_keys = np.full((b, pl_cap), MISSING, dtype=np.int32)
@@ -202,6 +204,10 @@ class PodBatchCompiler:
             priority[i] = pod.spec.priority
             if pod.spec.node_name:
                 node_name_id[i] = dic.intern(pod.spec.node_name)
+            if pod.status.nominated_node_name:
+                nominated_row[i] = enc.node_rows.get(
+                    pod.status.nominated_node_name, -1
+                )
             for j, (k, val) in enumerate(pod.metadata.labels.items()):
                 label_keys[i, j] = dic.intern(k)
                 label_vals[i, j] = dic.intern(val)
@@ -320,7 +326,8 @@ class PodBatchCompiler:
             pods=list(pods),
             valid=valid, request=request, non_zero=non_zero, ns=ns,
             label_keys=label_keys, label_vals=label_vals, priority=priority,
-            node_name_id=node_name_id, ports=ports, image_ids=image_ids,
+            node_name_id=node_name_id, nominated_row=nominated_row,
+            ports=ports, image_ids=image_ids,
             tol_valid=tol_valid, tol_key=tol_key, tol_val=tol_val,
             tol_op=tol_op, tol_effect=tol_effect,
             node_selector=compiled_ns, node_affinity=compiled_na,

@@ -148,6 +148,11 @@ class BatchedFramework:
             feasible_n = jnp.sum(row_mask)
             feasible = feasible_n > 0
             node = self.select_host(row_scores, row_mask, inp.get("key"))
+            # nominated-node fast path (scheduler.go:926-935): a pod nominated
+            # after preemption takes its nominated node when still feasible
+            nom = batch.nominated_row[i]
+            nom_ok = (nom >= 0) & row_mask[jnp.clip(nom, 0, row_mask.shape[0] - 1)]
+            node = jnp.where(nom_ok, jnp.clip(nom, 0, row_mask.shape[0] - 1), node)
             node = jnp.where(feasible, node, 0)
 
             def do_assign(args):

@@ -1,0 +1,397 @@
+"""Volume plugins: VolumeBinding, VolumeZone, NodeVolumeLimits, VolumeRestrictions.
+
+Reference: pkg/scheduler/framework/plugins/
+  volumebinding/ (binder.go FindPodVolumes/AssumePodVolumes/BindPodVolumes,
+    assume_cache.go; volume_binding.go PreFilter/Filter/Reserve/PreBind)
+  volumezone/volume_zone.go    — bound-PV zone/region labels must match node
+  nodevolumelimits/{csi,non_csi}.go — per-node attachable-volume counts vs limit
+  volumerestrictions/volume_restrictions.go — same-volume read-write conflicts
+
+Design: volume feasibility is *data-dependent on API objects* (PVCs/PVs/classes)
+rather than on dense per-node numeric state, and volumes are sparse in practice
+— so these plugins compute their ``[B, N]`` masks host-side at host_prepare time
+(the PreFilter analog) from the listers, and the device program just ANDs the
+uploaded mask.  Binding decisions (WaitForFirstConsumer) are assumed at Reserve
+and written at PreBind, exactly the reference's extension-point split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import objects as v1
+from ..api.labels import match_node_selector
+from ..api.resource import parse_quantity
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import Plugin, Status
+
+ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+)
+REGION_LABELS = (
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/region",
+)
+DEFAULT_EBS_LIMIT = 39  # nodevolumelimits defaults
+DEFAULT_GCE_PD_LIMIT = 16
+
+
+class StoreVolumeListers:
+    """Listers over the sim ObjectStore (client-go lister analog)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def pvc(self, namespace: str, name: str) -> Optional[v1.PersistentVolumeClaim]:
+        return self.store.get("PersistentVolumeClaim", namespace, name)
+
+    def pv(self, name: str) -> Optional[v1.PersistentVolume]:
+        return self.store.get("PersistentVolume", "", name)
+
+    def pvs(self) -> List[v1.PersistentVolume]:
+        return self.store.list("PersistentVolume")[0]
+
+    def storage_class(self, name: str) -> Optional[v1.StorageClass]:
+        return self.store.get("StorageClass", "", name)
+
+    def csinode(self, node_name: str) -> Optional[v1.CSINode]:
+        return self.store.get("CSINode", "", node_name)
+
+
+class _HostMaskPlugin(Plugin):
+    """Base: host_prepare computes a bool[B, N] mask; filter returns it."""
+
+    def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
+        mask = np.ones((batch.size, encoder._n), dtype=bool)
+        self._fill(mask, batch, snapshot, encoder)
+        return {"mask": mask}
+
+    def prepare(self, batch, snap, dyn, host_aux=None):
+        import jax.numpy as jnp
+
+        if host_aux is None:
+            return None
+        return jnp.asarray(host_aux["mask"])
+
+    def filter(self, batch, snap, dyn, aux=None):
+        import jax.numpy as jnp
+
+        if aux is None:
+            return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
+        return aux
+
+    def _fill(self, mask, batch, snapshot, encoder):  # pragma: no cover
+        raise NotImplementedError
+
+
+def _pod_pvcs(pod: v1.Pod):
+    return [v.pvc_name for v in pod.spec.volumes if v.pvc_name]
+
+
+class VolumeBindingPlugin(_HostMaskPlugin):
+    name = "VolumeBinding"
+
+    def __init__(self, listers: Optional[StoreVolumeListers] = None):
+        self.listers = listers
+        # assume cache: pv name → claimed "ns/name" (assume_cache.go analog)
+        self._assumed_pv: Dict[str, str] = {}
+        self._decisions: Dict[str, List[Tuple[str, v1.PersistentVolume]]] = {}
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.PVC, ActionType.ALL),
+            ClusterEvent(EventResource.PV, ActionType.ALL),
+            ClusterEvent(EventResource.STORAGE_CLASS, ActionType.ALL),
+            ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+    # --- PreFilter/Filter -----------------------------------------------------
+
+    def _pv_available(self, pv: v1.PersistentVolume, claim_key: str) -> bool:
+        owner = self._assumed_pv.get(pv.metadata.name)
+        if owner is not None and owner != claim_key:
+            return False
+        return pv.claim_ref is None or pv.claim_ref == claim_key
+
+    def _pv_matches(self, pv: v1.PersistentVolume, pvc: v1.PersistentVolumeClaim) -> bool:
+        if (pv.storage_class_name or "") != (pvc.storage_class_name or ""):
+            return False
+        cap = parse_quantity(pv.capacity.get("storage", 0))
+        want = parse_quantity(pvc.requested_storage or 0)
+        if cap < want:
+            return False
+        if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes or pvc.access_modes):
+            return False
+        return True
+
+    def _fill(self, mask, batch, snapshot, encoder):
+        if self.listers is None:
+            return
+        rows = encoder.node_rows
+        for i, pod in enumerate(batch.pods):
+            for claim in _pod_pvcs(pod):
+                pvc = self.listers.pvc(pod.namespace, claim)
+                if pvc is None:
+                    mask[i, :] = False  # UnschedulableAndUnresolvable
+                    break
+                claim_key = f"{pod.namespace}/{claim}"
+                if pvc.volume_name:  # bound: PV node affinity gates nodes
+                    pv = self.listers.pv(pvc.volume_name)
+                    if pv is None:
+                        mask[i, :] = False
+                        break
+                    if pv.node_affinity is not None:
+                        for info in snapshot.node_info_list:
+                            r = rows.get(info.node_name)
+                            if r is not None and not match_node_selector(
+                                pv.node_affinity, info.node
+                            ):
+                                mask[i, r] = False
+                    continue
+                sc = self.listers.storage_class(pvc.storage_class_name or "")
+                if sc is None or sc.volume_binding_mode != v1.VOLUME_BINDING_WAIT:
+                    # unbound immediate-binding PVC → wait for the PV controller
+                    # (volume_binding.go PreFilter: UnschedulableAndUnresolvable)
+                    mask[i, :] = False
+                    break
+                # WaitForFirstConsumer: node must have a matching available PV,
+                # or the class must be provisionable (dynamic provisioning)
+                if sc.provisioner:
+                    continue  # any node OK; provisioning happens at PreBind
+                candidates = [
+                    pv for pv in self.listers.pvs()
+                    if self._pv_available(pv, claim_key) and self._pv_matches(pv, pvc)
+                ]
+                for info in snapshot.node_info_list:
+                    r = rows.get(info.node_name)
+                    if r is None:
+                        continue
+                    ok = any(
+                        pv.node_affinity is None
+                        or match_node_selector(pv.node_affinity, info.node)
+                        for pv in candidates
+                    )
+                    if not ok:
+                        mask[i, r] = False
+
+    # --- Reserve / Unreserve / PreBind ---------------------------------------
+
+    def reserve(self, state, pod: v1.Pod, node_name: str) -> Status:
+        """AssumePodVolumes: pick a PV per unbound WaitForFirstConsumer PVC."""
+        if self.listers is None:
+            return Status.success()
+        node = None
+        decisions: List[Tuple[str, v1.PersistentVolume]] = []
+        for claim in _pod_pvcs(pod):
+            pvc = self.listers.pvc(pod.namespace, claim)
+            if pvc is None:
+                return Status.unschedulable(f"PVC {claim} not found", plugin=self.name)
+            if pvc.volume_name:
+                continue
+            claim_key = f"{pod.namespace}/{claim}"
+            sc = self.listers.storage_class(pvc.storage_class_name or "")
+            if sc is not None and sc.provisioner:
+                continue  # dynamically provisioned at PreBind
+            chosen = None
+            for pv in self.listers.pvs():
+                if not (self._pv_available(pv, claim_key) and self._pv_matches(pv, pvc)):
+                    continue
+                if pv.node_affinity is not None:
+                    if node is None:
+                        node = self._node_of(node_name)
+                    if node is None or not match_node_selector(pv.node_affinity, node):
+                        continue
+                chosen = pv
+                break
+            if chosen is None:
+                return Status.unschedulable(
+                    f"no PersistentVolume fits PVC {claim} on {node_name}",
+                    plugin=self.name,
+                )
+            self._assumed_pv[chosen.metadata.name] = claim_key
+            decisions.append((claim_key, chosen))
+        if decisions:
+            self._decisions[pod.uid] = decisions
+        return Status.success()
+
+    def unreserve(self, state, pod: v1.Pod, node_name: str) -> None:
+        for _claim_key, pv in self._decisions.pop(pod.uid, []):
+            self._assumed_pv.pop(pv.metadata.name, None)
+
+    def pre_bind(self, state, pod: v1.Pod, node_name: str) -> Status:
+        """BindPodVolumes: persist PV.claimRef + PVC.volumeName (the fake PV
+        controller of the perf harness folded into PreBind)."""
+        if self.listers is None:
+            return Status.success()
+        store = self.listers.store
+        for claim_key, pv in self._decisions.pop(pod.uid, []):
+            ns, name = claim_key.split("/", 1)
+            pv.claim_ref = claim_key
+            store.update("PersistentVolume", pv)
+            pvc = self.listers.pvc(ns, name)
+            if pvc is not None:
+                pvc.volume_name = pv.metadata.name
+                pvc.phase = "Bound"
+                store.update("PersistentVolumeClaim", pvc)
+            self._assumed_pv.pop(pv.metadata.name, None)
+        # dynamic provisioning for provisioner-backed classes
+        for claim in _pod_pvcs(pod):
+            pvc = self.listers.pvc(pod.namespace, claim)
+            if pvc is None or pvc.volume_name:
+                continue
+            sc = self.listers.storage_class(pvc.storage_class_name or "")
+            if sc is not None and sc.provisioner:
+                pv = v1.PersistentVolume(
+                    capacity={"storage": pvc.requested_storage or "1Gi"},
+                    storage_class_name=pvc.storage_class_name or "",
+                    claim_ref=f"{pod.namespace}/{claim}",
+                )
+                pv.metadata.name = f"pvc-{pvc.metadata.uid or claim}"
+                store.create("PersistentVolume", pv)
+                pvc.volume_name = pv.metadata.name
+                pvc.phase = "Bound"
+                store.update("PersistentVolumeClaim", pvc)
+        return Status.success()
+
+    def _node_of(self, node_name: str) -> Optional[v1.Node]:
+        return self.listers.store.get("Node", "", node_name)
+
+
+class VolumeZonePlugin(_HostMaskPlugin):
+    """Bound-PV zone/region labels must match the node (volumezone/)."""
+
+    name = "VolumeZone"
+
+    def __init__(self, listers: Optional[StoreVolumeListers] = None):
+        self.listers = listers
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.PVC, ActionType.ALL),
+            ClusterEvent(EventResource.PV, ActionType.ALL),
+            ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_LABEL),
+        ]
+
+    def _fill(self, mask, batch, snapshot, encoder):
+        if self.listers is None:
+            return
+        rows = encoder.node_rows
+        for i, pod in enumerate(batch.pods):
+            for claim in _pod_pvcs(pod):
+                pvc = self.listers.pvc(pod.namespace, claim)
+                if pvc is None or not pvc.volume_name:
+                    continue
+                pv = self.listers.pv(pvc.volume_name)
+                if pv is None:
+                    continue
+                for label_set in (ZONE_LABELS, REGION_LABELS):
+                    pv_vals = None
+                    for lbl in label_set:
+                        if lbl in pv.metadata.labels:
+                            # reference: value may be a __-separated set
+                            pv_vals = set(pv.metadata.labels[lbl].split("__"))
+                            break
+                    if pv_vals is None:
+                        continue
+                    for info in snapshot.node_info_list:
+                        r = rows.get(info.node_name)
+                        if r is None:
+                            continue
+                        node_val = None
+                        for lbl in label_set:
+                            node_val = info.node.metadata.labels.get(lbl) or node_val
+                        if node_val is None or node_val not in pv_vals:
+                            mask[i, r] = False
+
+
+class NodeVolumeLimitsPlugin(_HostMaskPlugin):
+    """Attachable-volume count limits (nodevolumelimits/{csi,non_csi}.go)."""
+
+    name = "NodeVolumeLimits"
+
+    def __init__(self, listers: Optional[StoreVolumeListers] = None,
+                 ebs_limit: int = DEFAULT_EBS_LIMIT,
+                 gce_limit: int = DEFAULT_GCE_PD_LIMIT):
+        self.listers = listers
+        self.ebs_limit = ebs_limit
+        self.gce_limit = gce_limit
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.CSI_NODE, ActionType.ALL),
+            ClusterEvent(EventResource.POD, ActionType.DELETE),
+        ]
+
+    @staticmethod
+    def _counts(pod: v1.Pod) -> Tuple[int, int]:
+        ebs = sum(1 for vol in pod.spec.volumes if vol.aws_ebs_volume_id)
+        gce = sum(1 for vol in pod.spec.volumes if vol.gce_pd_name)
+        return ebs, gce
+
+    def _fill(self, mask, batch, snapshot, encoder):
+        rows = encoder.node_rows
+        pod_counts = [self._counts(p) for p in batch.pods]
+        if not any(e or g for e, g in pod_counts):
+            return
+        for info in snapshot.node_info_list:
+            r = rows.get(info.node_name)
+            if r is None:
+                continue
+            used_ebs = used_gce = 0
+            for pi in info.pods:
+                e, g = self._counts(pi.pod)
+                used_ebs += e
+                used_gce += g
+            ebs_limit, gce_limit = self.ebs_limit, self.gce_limit
+            if self.listers is not None:
+                csin = self.listers.csinode(info.node_name)
+                if csin is not None:
+                    ebs_limit = csin.driver_limits.get("ebs.csi.aws.com", ebs_limit)
+                    gce_limit = csin.driver_limits.get(
+                        "pd.csi.storage.gke.io", gce_limit
+                    )
+            for i, (e, g) in enumerate(pod_counts):
+                if (e and used_ebs + e > ebs_limit) or (g and used_gce + g > gce_limit):
+                    mask[i, r] = False
+
+
+class VolumeRestrictionsPlugin(_HostMaskPlugin):
+    """Same-volume conflicts: a GCE PD / AWS EBS volume may only be attached by
+    one pod per node (read-write) — volumerestrictions/volume_restrictions.go."""
+
+    name = "VolumeRestrictions"
+
+    def events_to_register(self):
+        return [ClusterEvent(EventResource.POD, ActionType.DELETE)]
+
+    @staticmethod
+    def _exclusive_ids(pod: v1.Pod):
+        out = set()
+        for vol in pod.spec.volumes:
+            if vol.gce_pd_name:
+                out.add(("gce", vol.gce_pd_name))
+            if vol.aws_ebs_volume_id:
+                out.add(("ebs", vol.aws_ebs_volume_id))
+        return out
+
+    def _fill(self, mask, batch, snapshot, encoder):
+        rows = encoder.node_rows
+        pod_ids = [self._exclusive_ids(p) for p in batch.pods]
+        if not any(pod_ids):
+            return
+        for info in snapshot.node_info_list:
+            r = rows.get(info.node_name)
+            if r is None:
+                continue
+            node_ids = set()
+            for pi in info.pods:
+                node_ids |= self._exclusive_ids(pi.pod)
+            if not node_ids:
+                continue
+            for i, ids in enumerate(pod_ids):
+                if ids & node_ids:
+                    mask[i, r] = False

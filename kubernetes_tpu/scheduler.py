@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,7 @@ from .framework.interface import PluginWithWeight
 from .framework.podbatch import PodBatchCompiler
 from .framework.runtime import BatchedFramework, initial_dynamic_state
 from .metrics import scheduler_metrics as m
+from .preemption import Evaluator, candidate_mask_device
 from .queueing import PriorityQueue
 from .queueing.priority_queue import QueuedPodInfo
 from .sim.store import ADDED, DELETED, MODIFIED, ObjectStore, WatchEvent
@@ -39,8 +40,15 @@ from .state.cache import Cache, Snapshot
 from .state.encoding import ClusterEncoder
 
 
-def default_plugins(domain_cap: int) -> List[PluginWithWeight]:
+def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
     """Default plugin set + weights (apis/config/v1beta3/default_plugins.go:32-51)."""
+    from .plugins.volumes import (
+        NodeVolumeLimitsPlugin,
+        VolumeBindingPlugin,
+        VolumeRestrictionsPlugin,
+        VolumeZonePlugin,
+    )
+
     PW = PluginWithWeight
     return [
         PW(P.NodeUnschedulablePlugin(), 0),
@@ -49,6 +57,10 @@ def default_plugins(domain_cap: int) -> List[PluginWithWeight]:
         PW(P.NodeAffinityPlugin(), 2),
         PW(P.NodePortsPlugin(), 0),
         PW(P.FitPlugin(), 1),
+        PW(VolumeRestrictionsPlugin(), 0),
+        PW(NodeVolumeLimitsPlugin(listers), 0),
+        PW(VolumeBindingPlugin(listers), 0),
+        PW(VolumeZonePlugin(listers), 0),
         PW(P.PodTopologySpreadPlugin(domain_cap=domain_cap), 2),
         PW(P.InterPodAffinityPlugin(domain_cap=domain_cap), 2),
         PW(P.BalancedAllocationPlugin(), 1),
@@ -73,6 +85,7 @@ class TPUScheduler:
         clock=time.monotonic,
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         rng_key=None,
+        extenders: Optional[List] = None,
     ):
         self.store = store
         self.clock = clock
@@ -82,31 +95,60 @@ class TPUScheduler:
         self.encoder = ClusterEncoder()
         self.namespace_labels = namespace_labels or {}
         self.compiler = PodBatchCompiler(self.encoder, self.namespace_labels)
-        self._plugins_factory = plugins_factory
+        from .plugins.volumes import StoreVolumeListers
+
+        listers = StoreVolumeListers(store)
+        if plugins_factory is default_plugins:
+            self._plugins_factory = lambda d: default_plugins(d, listers)
+        else:
+            self._plugins_factory = plugins_factory
         self._fw: Optional[BatchedFramework] = None
         self._fw_domain_cap = -1
         self._jitted = {}
         self.rng_key = rng_key
         # build event map from a probe framework (scheduler.go:347-362)
-        probe = plugins_factory(8)
+        probe = self._plugins_factory(8)
         event_map: Dict[ClusterEvent, Set[str]] = {}
         for pw in probe:
             for ev in pw.plugin.events_to_register():
                 event_map.setdefault(ev, set()).add(pw.plugin.name)
         self.queue = PriorityQueue(clock=clock, cluster_event_map=event_map)
+        self.preemption = Evaluator()
+        self.extenders = list(extenders or [])
+        # nominator: uid → (node_name, request vector) for pods holding a
+        # nominated node across cycles (their reservation is added to the
+        # dynamic state so other pods don't steal the spot —
+        # RunFilterPluginsWithNominatedPods analog)
+        self._nominated: Dict[str, Tuple[str, np.ndarray]] = {}
         self._unwatch = store.watch(self._on_event)
 
     # --- event handlers (eventhandlers.go:251+) ------------------------------
+
+    _KIND_RESOURCE = {
+        "PersistentVolumeClaim": EventResource.PVC,
+        "PersistentVolume": EventResource.PV,
+        "StorageClass": EventResource.STORAGE_CLASS,
+        "CSINode": EventResource.CSI_NODE,
+        "Service": EventResource.SERVICE,
+    }
+
+    # kinds that never unblock scheduling (avoid wildcard requeue storms)
+    _IGNORED_KINDS = {"Lease", "Event", "ReplicaSet", "Deployment", "Job"}
 
     def _on_event(self, ev: WatchEvent):
         if ev.kind == "Node":
             self._on_node_event(ev)
         elif ev.kind == "Pod":
             self._on_pod_event(ev)
+        elif ev.kind in self._IGNORED_KINDS:
+            return
         else:
-            self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(EventResource.WILDCARD, ActionType.ALL)
-            )
+            resource = self._KIND_RESOURCE.get(ev.kind, EventResource.WILDCARD)
+            action = {ADDED: ActionType.ADD, MODIFIED: ActionType.UPDATE,
+                      DELETED: ActionType.DELETE}.get(ev.type, ActionType.ALL)
+            if resource == EventResource.WILDCARD:
+                action = ActionType.ALL
+            self.queue.move_all_to_active_or_backoff(ClusterEvent(resource, action))
 
     def _node_update_action(self, old: Optional[v1.Node], new: v1.Node) -> ActionType:
         if old is None:
@@ -156,6 +198,7 @@ class TPUScheduler:
             else:
                 self.queue.update(pod, pod)
         elif ev.type == DELETED:
+            self._nominated.pop(pod.uid, None)
             if assigned or pod.uid in self.cache._pod_states:
                 self.cache.remove_pod(pod)
                 self.queue.move_all_to_active_or_backoff(fwk_events.POD_DELETE)
@@ -199,11 +242,15 @@ class TPUScheduler:
         )
         dsnap = self.encoder.to_device()
         dyn = initial_dynamic_state(dsnap)
+        dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
         auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
-        res = self._jitted["greedy"](
-            batch, dsnap, dyn, auxes, jnp.arange(batch.size), self.rng_key
-        )
-        node_row = np.asarray(res.node_row)
+        if self.extenders:
+            node_row = self._assign_with_extenders(batch, dsnap, dyn, auxes, pods)
+        else:
+            res = self._jitted["greedy"](
+                batch, dsnap, dyn, auxes, jnp.arange(batch.size), self.rng_key
+            )
+            node_row = np.asarray(res.node_row)
         algo_s = self.clock() - t0
         m.scheduling_algorithm_duration.observe(algo_s)
 
@@ -212,8 +259,9 @@ class TPUScheduler:
             row = int(node_row[i])
             if row >= 0:
                 node_name = name_of[row]
+                self._nominated.pop(qi.pod.uid, None)
                 self.cache.assume_pod(qi.pod, node_name)
-                ok = self.store.bind_pod(qi.pod.namespace, qi.pod.metadata.name, node_name)
+                ok = self._run_reserve_and_bind(qi.pod, node_name)
                 if ok:
                     self.cache.finish_binding(qi.pod)
                     stats.scheduled += 1
@@ -222,13 +270,14 @@ class TPUScheduler:
                     m.pod_scheduling_duration.observe(
                         self.clock() - qi.initial_attempt_timestamp
                     )
-                else:  # binding failed — roll back (scheduler.go:676-689)
+                else:  # reserve/bind failed — roll back (scheduler.go:676-689)
                     self.cache.forget_pod(qi.pod)
                     self.queue.add_unschedulable(qi, cycle)
             else:
                 stats.unschedulable += 1
                 m.schedule_attempts.inc(("unschedulable",))
                 qi.unschedulable_plugins = self._diagnose(batch, dsnap, dyn, auxes, i)
+                self._run_post_filter(qi, batch, dsnap, dyn, auxes, i)
                 self.queue.add_unschedulable(qi, cycle)
         stats.batch_seconds = self.clock() - t0
         # per-attempt latency: the batch amortizes over its pods
@@ -240,6 +289,130 @@ class TPUScheduler:
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
         return stats
+
+    def _assign_with_extenders(self, batch, dsnap, dyn, auxes, pods) -> np.ndarray:
+        """Sequential per-pod cycles with HTTP extender callouts between the
+        device compute and selection (findNodesThatPassExtenders
+        scheduler.go:1035 + extender prioritize merge :1146-1185)."""
+        from .extender import ExtenderError
+
+        fw = self._fw
+        b = batch.valid.shape[0]
+        out = np.full(b, -1, dtype=np.int32)
+        name_of = {r: n for n, r in self.encoder.node_rows.items()}
+        row_of = self.encoder.node_rows
+        for i, pod in enumerate(pods):
+            mask, scores = self._jitted["compute"](batch, dsnap, dyn, auxes)
+            row_mask = np.asarray(mask[i])
+            row_scores = np.asarray(scores[i])
+            names = [name_of[r] for r in np.where(row_mask)[0] if r in name_of]
+            try:
+                for ext in self.extenders:
+                    names, _failed = ext.filter(pod, names)
+                    if not names:
+                        break
+            except ExtenderError:
+                continue  # non-ignorable extender failure → pod unschedulable
+            if not names:
+                continue
+            merged = {n: float(row_scores[row_of[n]]) for n in names}
+            for ext in self.extenders:
+                for n, s in ext.prioritize(pod, names).items():
+                    if n in merged:
+                        merged[n] += s
+            best = max(names, key=lambda n: merged[n])
+            row = row_of[best]
+            out[i] = row
+            dyn, auxes = fw.apply_assignment(dyn, auxes, i, row, batch, dsnap)
+        return out
+
+    def _run_reserve_and_bind(self, pod: v1.Pod, node_name: str) -> bool:
+        """Reserve → PreBind → Bind → PostBind (scheduler.go:584-698, host side).
+
+        On any failure, already-reserved plugins are unreserved in reverse order.
+        """
+        fw = self._fw
+        reserved = []
+        for pw in fw.plugins:
+            fn = getattr(pw.plugin, "reserve", None)
+            if fn is None:
+                continue
+            status = fn(None, pod, node_name)
+            if status is not None and not status.is_success():
+                for done in reversed(reserved):
+                    un = getattr(done.plugin, "unreserve", None)
+                    if un is not None:
+                        un(None, pod, node_name)
+                return False
+            reserved.append(pw)
+        for pw in fw.plugins:
+            fn = getattr(pw.plugin, "pre_bind", None)
+            if fn is None:
+                continue
+            status = fn(None, pod, node_name)
+            if status is not None and not status.is_success():
+                for done in reversed(reserved):
+                    un = getattr(done.plugin, "unreserve", None)
+                    if un is not None:
+                        un(None, pod, node_name)
+                return False
+        ok = self.store.bind_pod(pod.namespace, pod.metadata.name, node_name)
+        if ok:
+            for pw in fw.plugins:
+                fn = getattr(pw.plugin, "post_bind", None)
+                if fn is not None:
+                    fn(None, pod, node_name)
+        return ok
+
+    def _reserve_nominated(self, dyn, batch_uids: Set[str]):
+        """Virtually consume resources of nominated-but-pending pods not in this
+        batch, so the cycle can't steal their reserved spot."""
+        import jax.numpy as jnp
+
+        for uid, (node_name, req) in list(self._nominated.items()):
+            if uid in batch_uids:
+                continue
+            row = self.encoder.node_rows.get(node_name)
+            if row is None:
+                del self._nominated[uid]
+                continue
+            dyn = dyn._replace(
+                requested=dyn.requested.at[row].add(jnp.asarray(req))
+            )
+        return dyn
+
+    # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
+    _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
+
+    def _run_post_filter(self, qi: QueuedPodInfo, batch, dsnap, dyn, auxes, i: int):
+        """DefaultPreemption PostFilter (scheduler.go:533-552 → preemption.go:138)."""
+        pod = qi.pod
+        if pod.spec.preemption_policy == "Never":
+            return
+        fw = self._fw
+        m.preemption_attempts.inc()
+        static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+        for pw, aux in zip(fw.plugins, auxes):
+            if pw.plugin.name in self._STATIC_PLUGINS and hasattr(pw.plugin, "filter"):
+                static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
+        cand_mask = candidate_mask_device(batch, dsnap, dyn, static_ok)
+        rows = np.where(np.asarray(cand_mask[i]))[0]
+        if rows.size == 0:
+            return
+        name_of = {r: n for n, r in self.encoder.node_rows.items()}
+        names = [name_of[int(r)] for r in rows if int(r) in name_of]
+        pdbs, _ = self.store.list("PodDisruptionBudget")
+        cand = self.preemption.preempt(pod, self.snapshot, names, pdbs)
+        if cand is None:
+            return
+        for victim in cand.victims:
+            self.store.delete("Pod", victim.namespace, victim.metadata.name)
+        m.preemption_victims.observe(len(cand.victims))
+        pod.status.nominated_node_name = cand.node_name
+        self._nominated[pod.uid] = (
+            cand.node_name, np.asarray(self.encoder.pod_request_units(pod))
+        )
+        self.store.update("Pod", pod)
 
     def _diagnose(self, batch, dsnap, dyn, auxes, i: int) -> Set[str]:
         """Which plugins reject pod i everywhere (FitError.Diagnosis analog)."""

@@ -1,0 +1,118 @@
+"""Hollow node: a kubemark-style fake kubelet.
+
+Reference: pkg/kubemark/hollow_kubelet.go:65,95 — REAL node-agent behaviors
+(registration, lease heartbeat, pod lifecycle acks) with a FAKE runtime; this is
+how 5k-node clusters are simulated without machines (test/kubemark/).
+
+Behaviors:
+  - register(): creates the Node object (capacity, labels, hostname label)
+  - heartbeat(): renews the node Lease (kubelet.go:809-810: every ¼ duration)
+  - sync(): bound pods transition Pending→Running (fake runtime start);
+    pods of terminal Jobs can be driven to Succeeded via complete_pod()
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..api import objects as v1
+from ..client.leaderelection import Lease
+from ..sim.store import ObjectStore
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+class HollowNode:
+    def __init__(self, store: ObjectStore, name: str,
+                 capacity: Optional[Dict[str, object]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 lease_duration: float = 40.0,
+                 clock=time.monotonic):
+        self.store = store
+        self.name = name
+        self.capacity = capacity or {"cpu": "32", "memory": "64Gi", "pods": "110"}
+        self.labels = labels or {}
+        self.lease_duration = lease_duration
+        self.clock = clock
+        self.alive = True
+
+    # --- registration + heartbeat --------------------------------------------
+
+    def register(self) -> v1.Node:
+        node = v1.Node()
+        node.metadata.name = self.name
+        node.metadata.labels = dict(self.labels)
+        node.metadata.labels.setdefault("kubernetes.io/hostname", self.name)
+        node.status.capacity = dict(self.capacity)
+        node.status.allocatable = dict(self.capacity)
+        node.status.conditions.append({"type": "Ready", "status": "True"})
+        self.store.create("Node", node)
+        self.heartbeat()
+        return node
+
+    def heartbeat(self) -> None:
+        if not self.alive:
+            return
+        lease = self.store.get("Lease", LEASE_NAMESPACE, self.name)
+        if lease is None:
+            lease = Lease(
+                holder_identity=self.name,
+                lease_duration_seconds=self.lease_duration,
+                renew_time=self.clock(),
+            )
+            lease.metadata.namespace = LEASE_NAMESPACE
+            lease.metadata.name = self.name
+            self.store.create("Lease", lease)
+        else:
+            lease.renew_time = self.clock()
+            self.store.update("Lease", lease)
+
+    def fail(self) -> None:
+        """Stop heartbeating (simulated node death — chaos hook)."""
+        self.alive = False
+
+    # --- fake pod lifecycle ---------------------------------------------------
+
+    def my_pods(self) -> List[v1.Pod]:
+        pods, _ = self.store.list("Pod")
+        return [p for p in pods if p.spec.node_name == self.name]
+
+    def sync(self) -> int:
+        """Start (fake) any bound pods still Pending. Returns #started."""
+        started = 0
+        if not self.alive:
+            return 0
+        for p in self.my_pods():
+            if p.status.phase == v1.POD_PENDING:
+                p.status.phase = v1.POD_RUNNING
+                self.store.update("Pod", p)
+                started += 1
+        return started
+
+    def complete_pod(self, pod: v1.Pod) -> None:
+        pod.status.phase = v1.POD_SUCCEEDED
+        self.store.update("Pod", pod)
+
+
+class HollowCluster:
+    """N hollow nodes driven together (test/kubemark/start-kubemark.sh analog)."""
+
+    def __init__(self, store: ObjectStore, n: int, clock=time.monotonic,
+                 zones: int = 16, **node_kwargs):
+        self.nodes = []
+        for i in range(n):
+            hn = HollowNode(
+                store, f"hollow-{i:05d}",
+                labels={"topology.kubernetes.io/zone": f"zone-{i % zones}"},
+                clock=clock, **node_kwargs,
+            )
+            hn.register()
+            self.nodes.append(hn)
+
+    def heartbeat_all(self):
+        for n in self.nodes:
+            n.heartbeat()
+
+    def sync_all(self) -> int:
+        return sum(n.sync() for n in self.nodes)

@@ -42,10 +42,14 @@ class ObjectStore:
 
     # --- helpers -------------------------------------------------------------
 
-    @staticmethod
-    def _key(kind: str, obj) -> Tuple[str, str, str]:
+    CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
+                      "PriorityClass"}
+
+    @classmethod
+    def _key(cls, kind: str, obj) -> Tuple[str, str, str]:
         meta = obj.metadata
-        return (kind, getattr(meta, "namespace", ""), meta.name)
+        ns = "" if kind in cls.CLUSTER_SCOPED else getattr(meta, "namespace", "")
+        return (kind, ns, meta.name)
 
     def _emit(self, ev: WatchEvent):
         self._log.append(ev)
@@ -77,6 +81,8 @@ class ObjectStore:
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        if kind in self.CLUSTER_SCOPED:
+            namespace = ""
         with self._lock:
             obj = self._objects.pop((kind, namespace, name), None)
             if obj is None:
@@ -86,6 +92,8 @@ class ObjectStore:
             return obj
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        if kind in self.CLUSTER_SCOPED:
+            namespace = ""
         with self._lock:
             return self._objects.get((kind, namespace, name))
 

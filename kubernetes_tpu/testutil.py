@@ -228,6 +228,8 @@ class NodeWrapper:
 
     def name(self, n: str) -> "NodeWrapper":
         self._node.metadata.name = n
+        # the kubelet labels every node with its hostname on registration
+        self._node.metadata.labels.setdefault("kubernetes.io/hostname", n)
         return self
 
     def label(self, k: str, v: str) -> "NodeWrapper":

@@ -1,0 +1,110 @@
+"""Client machinery: informer, workqueue, leader election, events."""
+
+from kubernetes_tpu.client import (
+    EventRecorder,
+    InformerFactory,
+    LeaderElector,
+    LeaseLock,
+    RateLimitingQueue,
+)
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_informer_list_then_watch():
+    store = ObjectStore()
+    store.create("Node", make_node().name("pre").obj())
+    factory = InformerFactory(store)
+    inf = factory.informer("Node")
+    added = []
+    inf.add_event_handler(on_add=lambda o: added.append(o.metadata.name))
+    factory.start()
+    assert factory.wait_for_cache_sync()
+    assert added == ["pre"]  # LIST replay
+    store.create("Node", make_node().name("post").obj())
+    assert added == ["pre", "post"]  # WATCH
+    assert inf.get("", "post") is not None
+    assert len(inf.list()) == 2
+
+
+def test_informer_restart_relists():
+    """Stateless recovery: a fresh informer rebuilds state from LIST+WATCH."""
+    store = ObjectStore()
+    store.create("Node", make_node().name("a").obj())
+    f1 = InformerFactory(store)
+    inf1 = f1.informer("Node")
+    f1.start()
+    inf1.reflector.stop()  # "crash"
+    store.create("Node", make_node().name("b").obj())
+    f2 = InformerFactory(store)
+    inf2 = f2.informer("Node")
+    f2.start()
+    assert {o.metadata.name for o in inf2.list()} == {"a", "b"}
+
+
+def test_workqueue_dedup_and_reprocess():
+    clock = FakeClock()
+    q = RateLimitingQueue(clock=clock)
+    q.add("x")
+    q.add("x")
+    assert len(q) == 1
+    item = q.get()
+    q.add("x")  # added while processing → dirty
+    q.done("x")
+    assert q.get() == "x"
+    q.done("x")
+    assert q.get() is None
+
+
+def test_workqueue_rate_limited_backoff():
+    clock = FakeClock()
+    q = RateLimitingQueue(base_delay=0.01, clock=clock)
+    q.add_rate_limited("x")
+    assert q.get() is None  # not due yet
+    clock.advance(0.02)
+    assert q.get() == "x"
+    q.done("x")
+    q.add_rate_limited("x")  # second failure → 0.02 delay
+    clock.advance(0.011)
+    assert q.get() is None
+    clock.advance(0.02)
+    assert q.get() == "x"
+
+
+def test_leader_election_acquire_and_steal():
+    store = ObjectStore()
+    clock = FakeClock()
+    lock = LeaseLock(store, "kube-system", "tpu-scheduler")
+    a = LeaderElector(lock, "a", lease_duration=15, clock=clock)
+    b = LeaderElector(lock, "b", lease_duration=15, clock=clock)
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()
+    clock.advance(10)
+    assert a.try_acquire_or_renew()  # renewed
+    clock.advance(10)
+    assert not b.try_acquire_or_renew()  # lease still fresh (renewed at t=10)
+    clock.advance(16)
+    assert b.try_acquire_or_renew()  # stale → stolen
+    assert not a.is_leader() or not a.try_acquire_or_renew()
+
+
+def test_event_recorder_aggregates():
+    store = ObjectStore()
+    rec = EventRecorder(store)
+    pod = make_pod().name("p").uid("p").obj()
+    rec.eventf(pod, "Warning", "FailedScheduling", "0/3 nodes available")
+    rec.eventf(pod, "Warning", "FailedScheduling", "0/4 nodes available")
+    evs = rec.events_for(pod)
+    assert len(evs) == 1 and evs[0].count == 2
+    assert len(store.list("Event")[0]) == 1

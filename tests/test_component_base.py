@@ -1,0 +1,61 @@
+"""Feature gates, healthz, configz, trace."""
+
+import pytest
+
+from kubernetes_tpu.component_base import Configz, FeatureGate, Healthz, Trace
+from kubernetes_tpu.component_base.featuregate import FeatureSpec, default_feature_gate
+
+
+def test_feature_gate_defaults_and_flag_parse():
+    fg = FeatureGate()
+    fg.register("Foo", FeatureSpec(default=True))
+    fg.register("Bar", FeatureSpec(default=False))
+    assert fg.enabled("Foo") and not fg.enabled("Bar")
+    fg.set_from_string("Foo=false, Bar=true")
+    assert not fg.enabled("Foo") and fg.enabled("Bar")
+
+
+def test_feature_gate_locked():
+    fg = FeatureGate()
+    fg.register("Locked", FeatureSpec(default=True, lock_to_default=True))
+    with pytest.raises(ValueError):
+        fg.set("Locked", False)
+
+
+def test_default_gates_registered():
+    assert default_feature_gate.enabled("PodOverhead")
+    assert not default_feature_gate.enabled("MinDomainsInPodTopologySpread")
+    assert len(default_feature_gate.known()) >= 10
+
+
+def test_healthz():
+    h = Healthz()
+    h.add_check("cache-synced", lambda: True)
+    ok, results = h.check()
+    assert ok and results == {"ping": True, "cache-synced": True}
+    h.add_check("boom", lambda: 1 / 0)
+    ok, results = h.check()
+    assert not ok and results["boom"] is False
+
+
+def test_configz_dump():
+    c = Configz()
+    c.install("kubescheduler.config.k8s.io", {"parallelism": 16})
+    assert "parallelism" in c.dump()
+
+
+def test_trace_logs_when_slow():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    tr = Trace("schedulePod", clock=clock, pod="default/p")
+    t[0] = 0.05
+    tr.step("filter")
+    t[0] = 0.2
+    tr.step("score")
+    msg = tr.log_if_long(0.1)
+    assert msg and "filter" in msg and "score" in msg
+    fast = Trace("fast", clock=clock)
+    assert fast.log_if_long(0.1) is None

@@ -1,0 +1,68 @@
+"""Extender protocol: client ↔ TPUScore server round-trip
+(reference: test/integration/scheduler/extender_test.go pattern)."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.extender import (
+    ExtenderConfig,
+    ExtenderError,
+    HTTPExtender,
+    TPUScoreExtenderServer,
+)
+from kubernetes_tpu.testutil import make_pod
+
+
+@pytest.fixture
+def server():
+    def score_fn(pod_dict, names):
+        # toy device-scorer stand-in: nodes ending in odd digits are infeasible,
+        # score = index
+        feasible = [n for n in names if int(n[-1]) % 2 == 0]
+        return feasible, {n: i * 10 for i, n in enumerate(names)}
+
+    srv = TPUScoreExtenderServer(score_fn)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def client(srv, **kw):
+    return HTTPExtender(ExtenderConfig(
+        url_prefix=srv.url, filter_verb="filter", prioritize_verb="prioritize",
+        node_cache_capable=True, **kw,
+    ))
+
+
+def test_filter_round_trip(server):
+    ext = client(server)
+    pod = make_pod().name("p").uid("p").obj()
+    feasible, failed = ext.filter(pod, ["n0", "n1", "n2", "n3"])
+    assert feasible == ["n0", "n2"]
+    assert set(failed) == {"n1", "n3"}
+
+
+def test_prioritize_weighted(server):
+    ext = client(server, weight=3)
+    pod = make_pod().name("p").uid("p").obj()
+    scores = ext.prioritize(pod, ["n0", "n2"])
+    assert scores == {"n0": 0, "n2": 30}
+
+
+def test_ignorable_extender_swallows_errors():
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter", ignorable=True,
+        http_timeout=0.2,
+    ))
+    pod = make_pod().name("p").uid("p").obj()
+    feasible, failed = ext.filter(pod, ["n0"])
+    assert feasible == ["n0"] and not failed
+
+
+def test_non_ignorable_extender_raises():
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter", http_timeout=0.2,
+    ))
+    pod = make_pod().name("p").uid("p").obj()
+    with pytest.raises(ExtenderError):
+        ext.filter(pod, ["n0"])

@@ -1,0 +1,31 @@
+"""Extender integrated with the TPUScheduler (per-pod callout path)."""
+
+from kubernetes_tpu.extender import ExtenderConfig, HTTPExtender, TPUScoreExtenderServer
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def test_extender_filter_steers_placement():
+    # extender that only allows nodes whose name ends with "1"
+    def score_fn(pod_dict, names):
+        feasible = [n for n in names if n.endswith("1")]
+        return feasible, {n: 0 for n in names}
+
+    srv = TPUScoreExtenderServer(score_fn)
+    srv.start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=srv.url, filter_verb="filter", node_cache_capable=True,
+        ))
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=4, extenders=[ext])
+        store.create("Node", make_node().name("n0").obj())
+        store.create("Node", make_node().name("n1").obj())
+        store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                     .req({"cpu": "1"}).obj())
+        stats = sched.run_until_idle()
+        assert stats.scheduled == 1
+        assert store.get("Pod", "default", "p").spec.node_name == "n1"
+    finally:
+        srv.stop()

@@ -1,0 +1,59 @@
+"""Preemption through the full scheduler loop (PostFilter → victims deleted →
+nominatedNodeName → rescheduled)."""
+
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_preemption_end_to_end():
+    store = ObjectStore()
+    clock = FakeClock()
+    sched = TPUScheduler(store, batch_size=4, clock=clock)
+    store.create("Node", make_node().name("only")
+                 .capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+    store.create("Pod", make_pod().name("low").uid("low").namespace("default")
+                 .priority(1).req({"cpu": "2"}).obj())
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "low").spec.node_name == "only"
+
+    # high-priority pod arrives; node is full → preempt the low-priority pod
+    store.create("Pod", make_pod().name("high").uid("high").namespace("default")
+                 .priority(100).req({"cpu": "2"}).obj())
+    clock.advance(3.0)
+    sched.run_until_idle()
+    high = store.get("Pod", "default", "high")
+    assert high.status.nominated_node_name == "only"
+    assert store.get("Pod", "default", "low") is None  # victim deleted
+    clock.advance(3.0)
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "high").spec.node_name == "only"
+
+
+def test_no_preemption_for_never_policy():
+    store = ObjectStore()
+    clock = FakeClock()
+    sched = TPUScheduler(store, batch_size=4, clock=clock)
+    store.create("Node", make_node().name("only")
+                 .capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+    store.create("Pod", make_pod().name("low").uid("low").namespace("default")
+                 .priority(1).req({"cpu": "2"}).obj())
+    sched.run_until_idle()
+    p = make_pod().name("high").uid("high").namespace("default").priority(100).req({"cpu": "2"}).obj()
+    p.spec.preemption_policy = "Never"
+    store.create("Pod", p)
+    clock.advance(3.0)
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "low") is not None  # untouched
+    assert not store.get("Pod", "default", "high").spec.node_name

@@ -1,0 +1,129 @@
+"""Volume plugins through the full scheduler (reference scenarios from
+volumebinding/volumezone/nodevolumelimits/volumerestrictions tests)."""
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def mk_pv(name, storage="10Gi", sc="", node_values=None, labels=None):
+    pv = v1.PersistentVolume(capacity={"storage": storage}, storage_class_name=sc)
+    pv.metadata.name = name
+    pv.metadata.labels = dict(labels or {})
+    if node_values:
+        pv.node_affinity = v1.NodeSelector(node_selector_terms=[
+            v1.NodeSelectorTerm(match_expressions=[
+                v1.NodeSelectorRequirement(
+                    key="kubernetes.io/hostname", operator=v1.OP_IN,
+                    values=list(node_values),
+                )
+            ])
+        ])
+    return pv
+
+
+def mk_pvc(name, ns="default", sc="", volume_name="", storage="5Gi"):
+    pvc = v1.PersistentVolumeClaim(
+        volume_name=volume_name, storage_class_name=sc, requested_storage=storage
+    )
+    pvc.metadata.name = name
+    pvc.metadata.namespace = ns
+    return pvc
+
+
+def test_wait_for_first_consumer_binding():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    sc = v1.StorageClass(volume_binding_mode=v1.VOLUME_BINDING_WAIT)
+    sc.metadata.name = "local"
+    store.create("StorageClass", sc)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Node", make_node().name("n1").obj())
+    # a local PV only available on n1
+    store.create("PersistentVolume", mk_pv("pv1", sc="local", node_values=["n1"]))
+    store.create("PersistentVolumeClaim", mk_pvc("data", sc="local"))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).pvc("data").obj())
+    stats = sched.run_until_idle()
+    assert stats.scheduled == 1
+    assert store.get("Pod", "default", "p").spec.node_name == "n1"
+    # binding persisted at PreBind
+    assert store.get("PersistentVolumeClaim", "default", "data").volume_name == "pv1"
+    assert store.get("PersistentVolume", "", "pv1").claim_ref == "default/data"
+
+
+def test_unbound_immediate_pvc_unschedulable():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("PersistentVolumeClaim", mk_pvc("data"))  # no class → immediate
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).pvc("data").obj())
+    stats = sched.run_until_idle()
+    assert stats.unschedulable == 1
+
+
+def test_bound_pv_node_affinity_gates_nodes():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Node", make_node().name("n1").obj())
+    pv = mk_pv("pv1", node_values=["n0"])
+    pv.claim_ref = "default/data"
+    store.create("PersistentVolume", pv)
+    store.create("PersistentVolumeClaim", mk_pvc("data", volume_name="pv1"))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).pvc("data").obj())
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "p").spec.node_name == "n0"
+
+
+def test_volume_zone_filter():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("east")
+                 .label("topology.kubernetes.io/zone", "us-east-1a").obj())
+    store.create("Node", make_node().name("west")
+                 .label("topology.kubernetes.io/zone", "us-west-1a").obj())
+    pv = mk_pv("pv1", labels={"topology.kubernetes.io/zone": "us-east-1a"})
+    pv.claim_ref = "default/data"
+    store.create("PersistentVolume", pv)
+    store.create("PersistentVolumeClaim", mk_pvc("data", volume_name="pv1"))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).pvc("data").obj())
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "p").spec.node_name == "east"
+
+
+def test_volume_restrictions_same_gce_pd():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Node", make_node().name("n1").obj())
+    running = make_pod().name("holder").uid("holder").namespace("default").req({"cpu": "1"}).node("n0").obj()
+    running.spec.volumes.append(v1.Volume(name="d", gce_pd_name="disk-1"))
+    store.create("Pod", running)
+    p = make_pod().name("p").uid("p").namespace("default").req({"cpu": "1"}).obj()
+    p.spec.volumes.append(v1.Volume(name="d", gce_pd_name="disk-1"))
+    store.create("Pod", p)
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "p").spec.node_name == "n1"
+
+
+def test_node_volume_limits():
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("full").obj())
+    store.create("Node", make_node().name("free").obj())
+    csin = v1.CSINode(driver_limits={"ebs.csi.aws.com": 1})
+    csin.metadata.name = "full"
+    store.create("CSINode", csin)
+    holder = make_pod().name("holder").uid("holder").namespace("default").req({"cpu": "1"}).node("full").obj()
+    holder.spec.volumes.append(v1.Volume(name="v", aws_ebs_volume_id="vol-1"))
+    store.create("Pod", holder)
+    p = make_pod().name("p").uid("p").namespace("default").req({"cpu": "1"}).obj()
+    p.spec.volumes.append(v1.Volume(name="v", aws_ebs_volume_id="vol-2"))
+    store.create("Pod", p)
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "p").spec.node_name == "free"
