@@ -122,6 +122,9 @@ class Plugin:
     """
 
     name: str = "Plugin"
+    # dynamic plugins read DynamicState / scan-updated aux; static plugins are
+    # precomputed once per batch outside the assignment scan
+    dynamic: bool = False
 
     # feature-detection helpers used by the runtime registry
     def has(self, method: str) -> bool:

@@ -132,11 +132,95 @@ class BatchedFramework:
     def greedy_assign(self, batch, snap, dyn, auxes, order, key=None) -> AssignResult:
         """Schedule the batch pod-by-pod in ``order`` inside one lax.scan.
 
-        Exact greedy-sequential semantics: each step filters+scores against the
-        carry state (resources consumed by earlier assignments, plugin tables
-        updated), matching a sequence of reference scheduling cycles with
-        instantaneous assume.
+        Exact greedy-sequential semantics with a ROW-SLICED fast path: the
+        static plugin planes (selector matches, taints, image locality, volume
+        masks, …) are computed ONCE for the whole ``[B, N]`` batch before the
+        scan; each scan step computes only pod i's ``[N]`` row for the four
+        dynamic plugins (Fit, BalancedAllocation, PodTopologySpread,
+        InterPodAffinity) against the carried state — O(N) per step instead of
+        O(B·N).  Normalization is row-local in the reference too, so results
+        are bit-identical to the dense recompute (test_fast_scan_parity).
         """
+        b = batch.valid.shape[0]
+
+        # --- static precompute (outside the scan) ----------------------------
+        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_raw: List = []  # (pw, raw_plane or None)
+        for pw, aux in zip(self.plugins, auxes):
+            p = pw.plugin
+            if not p.dynamic and hasattr(p, "filter"):
+                static_mask = static_mask & p.filter(batch, snap, dyn, aux)
+            if hasattr(p, "score") and not p.dynamic:
+                static_raw.append((pw, p.score(batch, snap, dyn, aux)))
+
+        dyn_plugins = [
+            (pw, idx) for idx, pw in enumerate(self.plugins) if pw.plugin.dynamic
+        ]
+        dyn_auxes = tuple(auxes[idx] for _, idx in dyn_plugins)
+
+        def step(carry, inp):
+            dyn, dauxes = carry
+            i = inp["i"]
+            row_mask = static_mask[i]
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
+                if hasattr(pw.plugin, "filter_row"):
+                    row_mask = row_mask & pw.plugin.filter_row(batch, snap, dyn, aux, i)
+            total = jnp.zeros(row_mask.shape, jnp.float32)
+            for pw, plane in static_raw:
+                norm = pw.plugin.normalize(plane[i][None, :], row_mask[None, :])[0]
+                total = total + pw.weight * jnp.floor(norm)
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
+                if not hasattr(pw.plugin, "score_row"):
+                    continue
+                raw = pw.plugin.score_row(batch, snap, dyn, aux, i, mask_row=row_mask)
+                norm = pw.plugin.normalize(raw[None, :], row_mask[None, :])[0]
+                total = total + pw.weight * jnp.floor(norm)
+            row_scores = jnp.where(row_mask, total, -jnp.inf)
+
+            feasible_n = jnp.sum(row_mask)
+            feasible = feasible_n > 0
+            node = self.select_host(row_scores, row_mask, inp.get("key"))
+            # nominated-node fast path (scheduler.go:926-935)
+            nom = batch.nominated_row[i]
+            nom_ok = (nom >= 0) & row_mask[jnp.clip(nom, 0, row_mask.shape[0] - 1)]
+            node = jnp.where(nom_ok, jnp.clip(nom, 0, row_mask.shape[0] - 1), node)
+            node = jnp.where(feasible, node, 0)
+
+            def do_assign(args):
+                dyn, dauxes = args
+                return self._apply_dynamic(dyn, dauxes, dyn_plugins, i, node, batch, snap)
+
+            dyn, dauxes = jax.lax.cond(
+                feasible & batch.valid[i], do_assign, lambda a: a, (dyn, dauxes)
+            )
+            out_node = jnp.where(feasible & batch.valid[i], node, -1)
+            return (dyn, dauxes), {"i": i, "node": out_node, "feasible_n": feasible_n}
+
+        inputs = {"i": order.astype(jnp.int32)}
+        if key is not None:
+            inputs["key"] = jax.random.split(key, b)
+        (dyn, _), outs = jax.lax.scan(step, (dyn, dyn_auxes), inputs)
+        node_row = jnp.full((b,), -1, jnp.int32).at[outs["i"]].set(outs["node"])
+        feasible_count = jnp.zeros((b,), jnp.int32).at[outs["i"]].set(outs["feasible_n"])
+        return AssignResult(node_row=node_row, feasible_count=feasible_count, dyn=dyn)
+
+    def _apply_dynamic(self, dyn, dauxes, dyn_plugins, i, node_row, batch, snap):
+        req = batch.request[i]
+        requested = dyn.requested.at[node_row].add(req)
+        nz = dyn.non_zero.at[node_row].add(batch.non_zero[i])
+        new_dyn = DynamicState(requested=requested, non_zero=nz)
+        new_auxes = []
+        for (pw, _), aux in zip(dyn_plugins, dauxes):
+            fn = getattr(pw.plugin, "update", None)
+            if fn is None or aux is None:
+                new_auxes.append(aux)
+            else:
+                new_auxes.append(fn(aux, i, node_row, batch, snap))
+        return new_dyn, tuple(new_auxes)
+
+    def greedy_assign_dense(self, batch, snap, dyn, auxes, order, key=None) -> AssignResult:
+        """Reference implementation: full [B, N] recompute per step (used by the
+        fast-path parity test)."""
         b = batch.valid.shape[0]
 
         def step(carry, inp):
@@ -148,8 +232,6 @@ class BatchedFramework:
             feasible_n = jnp.sum(row_mask)
             feasible = feasible_n > 0
             node = self.select_host(row_scores, row_mask, inp.get("key"))
-            # nominated-node fast path (scheduler.go:926-935): a pod nominated
-            # after preemption takes its nominated node when still feasible
             nom = batch.nominated_row[i]
             nom_ok = (nom >= 0) & row_mask[jnp.clip(nom, 0, row_mask.shape[0] - 1)]
             node = jnp.where(nom_ok, jnp.clip(nom, 0, row_mask.shape[0] - 1), node)
@@ -169,7 +251,6 @@ class BatchedFramework:
         if key is not None:
             inputs["key"] = jax.random.split(key, b)
         (dyn, auxes), outs = jax.lax.scan(step, (dyn, auxes), inputs)
-        # scatter back into pod-index order
         node_row = jnp.full((b,), -1, jnp.int32).at[outs["i"]].set(outs["node"])
         feasible_count = jnp.zeros((b,), jnp.int32).at[outs["i"]].set(outs["feasible_n"])
         return AssignResult(node_row=node_row, feasible_count=feasible_count, dyn=dyn)

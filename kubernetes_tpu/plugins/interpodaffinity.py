@@ -66,6 +66,7 @@ class IPAAux(NamedTuple):
 
 class InterPodAffinityPlugin(Plugin):
     name = "InterPodAffinity"
+    dynamic = True
 
     def __init__(self, domain_cap: int = 256,
                  hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
@@ -285,6 +286,36 @@ class InterPodAffinityPlugin(Plugin):
             ok & mask, MAX_NODE_SCORE * (scores - jnp.where(ok, mn, 0.0))
             / jnp.where(ok, diff, 1.0), 0.0
         )
+
+    # --- row-sliced variants for the fast assignment scan ---------------------
+
+    def filter_row(self, batch, snap, dyn, aux: IPAAux, i):
+        d = self.domain_cap
+        aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
+        anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
+        cnt = jnp.take_along_axis(aux.aff_counts[i], aux.dom_aff[i], axis=-1)  # [T1, N]
+        key_ok = aux.dom_aff[i] < d
+        keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
+        pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
+        first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
+        aff_ok = keys_all & (pods_exist | first_pod)
+        acnt = jnp.take_along_axis(aux.anti_counts[i], aux.dom_anti[i], axis=-1)
+        anti_bad = jnp.any(
+            anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0), axis=0
+        )
+        return aff_ok & ~anti_bad & ~aux.exist_anti_block[i] & ~aux.block_dyn[i]
+
+    def score_row(self, batch, snap, dyn, aux: IPAAux, i, mask_row=None):
+        d = self.domain_cap
+        w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
+        w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
+        c_paff = jnp.take_along_axis(aux.paff_counts[i], aux.dom_paff[i], axis=-1)
+        c_panti = jnp.take_along_axis(aux.panti_counts[i], aux.dom_panti[i], axis=-1)
+        own = (
+            jnp.sum(jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0), axis=0)
+            - jnp.sum(jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0), axis=0)
+        )
+        return own + aux.score_static[i] + aux.score_dyn[i]
 
     # --- in-scan update -------------------------------------------------------
 

@@ -38,6 +38,7 @@ def fit_filter(batch, snap, dyn: DynamicState):
 
 class FitPlugin(Plugin):
     name = "NodeResourcesFit"
+    dynamic = True
 
     def __init__(
         self,
@@ -127,9 +128,29 @@ class FitPlugin(Plugin):
     def normalize(self, scores, mask):
         return scores  # already 0..100
 
+    # --- row-sliced variants for the fast assignment scan --------------------
+
+    def filter_row(self, batch, snap, dyn, aux, i):
+        import jax
+
+        free = snap.allocatable - dyn.requested  # [N, R]
+        req = jax.lax.dynamic_slice_in_dim(batch.request, i, 1, 0)  # [1, R]
+        return jnp.all((req == 0) | (req <= free), axis=-1)  # [N]
+
+    def score_row(self, batch, snap, dyn, aux, i, mask_row=None):
+        import jax
+        from types import SimpleNamespace
+
+        sub = SimpleNamespace(
+            request=jax.lax.dynamic_slice_in_dim(batch.request, i, 1, 0),
+            non_zero=jax.lax.dynamic_slice_in_dim(batch.non_zero, i, 1, 0),
+        )
+        return self.score(sub, snap, dyn)[0]
+
 
 class BalancedAllocationPlugin(Plugin):
     name = "NodeResourcesBalancedAllocation"
+    dynamic = True
 
     def __init__(self, resources: Optional[Dict[str, int]] = None,
                  num_resource_dims: int = 8,
@@ -166,3 +187,12 @@ class BalancedAllocationPlugin(Plugin):
 
     def normalize(self, scores, mask):
         return scores
+
+    def score_row(self, batch, snap, dyn, aux, i, mask_row=None):
+        import jax
+        from types import SimpleNamespace
+
+        sub = SimpleNamespace(
+            request=jax.lax.dynamic_slice_in_dim(batch.request, i, 1, 0),
+        )
+        return self.score(sub, snap, dyn)[0]

@@ -52,6 +52,7 @@ class TSAux(NamedTuple):
 
 class PodTopologySpreadPlugin(Plugin):
     name = "PodTopologySpread"
+    dynamic = True
 
     def __init__(self, domain_cap: int = 256, enable_min_domains: bool = True):
         self.domain_cap = domain_cap  # static D; runtime refreshes on growth
@@ -237,6 +238,57 @@ class PodTopologySpreadPlugin(Plugin):
             MAX_NODE_SCORE * (mx + mn - scores) / jnp.where(mx == 0, 1.0, mx),
         )
         return jnp.where(valid, out, 0.0)
+
+    # --- row-sliced variants for the fast assignment scan ---------------------
+
+    def filter_row(self, batch, snap, dyn, aux: TSAux, i):
+        counts = aux.hard_counts[i]  # [C, D+1]
+        present = aux.hard_present[i]
+        dom = aux.dom_val[i]  # [C, N]
+        min_match = jnp.min(jnp.where(present, counts, BIG), axis=-1)  # [C]
+        if self.enable_min_domains:
+            ndom = jnp.sum(present, axis=-1)
+            md = aux.min_domains[i]
+            min_match = jnp.where((md > 0) & (ndom < md), 0, min_match)
+        match_num = jnp.take_along_axis(counts, dom, axis=-1)  # [C, N]
+        skew = (
+            match_num + aux.self_match[i][:, None].astype(jnp.int32)
+            - min_match[:, None]
+        )
+        ok_c = (skew <= aux.max_skew[i][:, None]) & aux.has_key[i]
+        return jnp.all(~aux.hard_valid[i][:, None] | ok_c, axis=0)  # [N]
+
+    def score_row(self, batch, snap, dyn, aux: TSAux, i, mask_row=None):
+        d = self.domain_cap
+        soft_valid = aux.soft_valid[i]  # [C]
+        has_key = aux.has_key[i]  # [C, N]
+        dom = aux.dom_val[i]
+        counts = aux.soft_counts[i]  # [C, D+1]
+        if mask_row is None:
+            mask_row = jnp.ones(dom.shape[-1], bool)
+        ignored = ~jnp.all(~soft_valid[:, None] | has_key, axis=0)  # [N]
+        scored = mask_row & ~ignored
+        c_cap = dom.shape[0]
+        soft_present = (
+            jnp.zeros(counts.shape, bool)
+            .at[jnp.arange(c_cap)[:, None], dom]
+            .max(scored[None, :] & (dom < d))
+        )
+        topo_size = jnp.sum(soft_present[:, :d], axis=-1)  # [C]
+        tp_weight = jnp.log(topo_size.astype(jnp.float32) + 2.0)
+        cnt = jnp.take_along_axis(counts, dom, axis=-1)  # [C, N]
+        in_present = jnp.take_along_axis(soft_present, dom, axis=-1)
+        per_c = (
+            cnt.astype(jnp.float32) * tp_weight[:, None]
+            + (aux.max_skew[i][:, None].astype(jnp.float32) - 1.0)
+        )
+        raw = jnp.round(jnp.sum(
+            jnp.where(soft_valid[:, None] & has_key & in_present, per_c, 0.0), axis=0
+        ))
+        has_soft = jnp.any(soft_valid)
+        return jnp.where(
+            has_soft & ~scored, jnp.nan, jnp.where(has_soft, raw, 0.0)
+        )
 
     # --- in-scan update -------------------------------------------------------
 

@@ -1,0 +1,24 @@
+"""Fast (row-sliced) scan must match the dense full-recompute scan bit-for-bit."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tests.test_parity import build_cluster, default_framework, device_pipeline, pending_pods
+
+
+def test_fast_scan_matches_dense():
+    rng = np.random.default_rng(7)
+    cache = build_cluster(rng)
+    pods = pending_pods(rng, k=8)
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    order = jnp.arange(batch.size)
+    fast = jax.jit(fw.greedy_assign)(batch, dsnap, dyn, auxes, order, None)
+    dense = jax.jit(fw.greedy_assign_dense)(batch, dsnap, dyn, auxes, order, None)
+    assert np.array_equal(np.asarray(fast.node_row), np.asarray(dense.node_row))
+    assert np.array_equal(
+        np.asarray(fast.feasible_count), np.asarray(dense.feasible_count)
+    )
+    assert np.array_equal(
+        np.asarray(fast.dyn.requested), np.asarray(dense.dyn.requested)
+    )
