@@ -693,6 +693,28 @@ class PersistentVolume:
         )
 
 
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass — resolved into pod.spec.priority at
+    admission (the reference's Priority admission plugin)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"
+
+    kind = "PriorityClass"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PriorityClass":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            value=int(d.get("value", 0)),
+            global_default=bool(d.get("globalDefault", False)),
+            preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"),
+        )
+
+
 VOLUME_BINDING_IMMEDIATE = "Immediate"
 VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
 

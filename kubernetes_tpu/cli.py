@@ -1,0 +1,202 @@
+"""kubectl-style CLI verbs over the object store (reference L7:
+staging/src/k8s.io/kubectl).
+
+In-process client: ``Kubectl(store)`` exposes the core verb set (get, describe,
+apply -f, delete, scale, cordon/uncordon, taint, drain) against the sim control
+plane; ``main()`` wires argparse for shell use against a state file.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+from .api import objects as v1
+from .sim.store import ObjectStore
+
+KIND_ALIASES = {
+    "po": "Pod", "pod": "Pod", "pods": "Pod",
+    "no": "Node", "node": "Node", "nodes": "Node",
+    "rs": "ReplicaSet", "replicaset": "ReplicaSet", "replicasets": "ReplicaSet",
+    "deploy": "Deployment", "deployment": "Deployment", "deployments": "Deployment",
+    "job": "Job", "jobs": "Job",
+    "svc": "Service", "service": "Service", "services": "Service",
+    "pv": "PersistentVolume", "pvc": "PersistentVolumeClaim",
+    "sc": "StorageClass", "pdb": "PodDisruptionBudget",
+    "pc": "PriorityClass", "priorityclass": "PriorityClass",
+    "ev": "Event", "events": "Event",
+}
+
+FROM_DICT = {
+    "Pod": v1.Pod, "Node": v1.Node, "ReplicaSet": v1.ReplicaSet,
+    "Deployment": v1.Deployment, "Job": v1.Job, "Service": v1.Service,
+    "PersistentVolume": v1.PersistentVolume,
+    "PersistentVolumeClaim": v1.PersistentVolumeClaim,
+    "StorageClass": v1.StorageClass, "PodDisruptionBudget": v1.PodDisruptionBudget,
+    "PriorityClass": v1.PriorityClass, "CSINode": v1.CSINode,
+}
+
+
+class Kubectl:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    # --- get / describe -------------------------------------------------------
+
+    def get(self, kind: str, namespace: Optional[str] = None) -> str:
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        objs, _ = self.store.list(kind)
+        if namespace:
+            objs = [o for o in objs if getattr(o.metadata, "namespace", "") == namespace]
+        rows = [self._row(kind, o) for o in sorted(objs, key=lambda o: o.metadata.name)]
+        header = self._header(kind)
+        widths = [max(len(r[c]) for r in [header] + rows) for c in range(len(header))]
+        return "\n".join(
+            "  ".join(cell.ljust(w) for cell, w in zip(r, widths))
+            for r in [header] + rows
+        )
+
+    def _header(self, kind: str) -> List[str]:
+        return {
+            "Pod": ["NAME", "STATUS", "NODE", "PRIORITY"],
+            "Node": ["NAME", "READY", "TAINTS", "CPU", "MEMORY"],
+            "ReplicaSet": ["NAME", "DESIRED", "CURRENT", "READY"],
+            "Deployment": ["NAME", "REPLICAS"],
+            "Job": ["NAME", "COMPLETIONS", "SUCCEEDED", "DONE"],
+        }.get(kind, ["NAME"])
+
+    def _row(self, kind: str, o) -> List[str]:
+        if kind == "Pod":
+            return [o.metadata.name, o.status.phase, o.spec.node_name or "<none>",
+                    str(o.spec.priority)]
+        if kind == "Node":
+            ready = next(
+                (c.get("status", "?") for c in o.status.conditions
+                 if c.get("type") == "Ready"), "?",
+            )
+            return [o.metadata.name, ready,
+                    ",".join(f"{t.key}:{t.effect}" for t in o.spec.taints) or "<none>",
+                    str(o.status.allocatable.get("cpu", "?")),
+                    str(o.status.allocatable.get("memory", "?"))]
+        if kind == "ReplicaSet":
+            return [o.metadata.name, str(o.replicas), str(o.status_replicas),
+                    str(o.status_ready_replicas)]
+        if kind == "Deployment":
+            return [o.metadata.name, str(o.replicas)]
+        if kind == "Job":
+            return [o.metadata.name, str(o.completions), str(o.status_succeeded),
+                    str(o.completed)]
+        return [o.metadata.name]
+
+    def describe(self, kind: str, namespace: str, name: str) -> str:
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None:
+            return f"{kind} {namespace}/{name} not found"
+        import dataclasses, json
+
+        return json.dumps(dataclasses.asdict(o), default=str, indent=2)
+
+    # --- apply / delete / scale ----------------------------------------------
+
+    def apply(self, yaml_text: str) -> List[str]:
+        try:
+            import yaml as _yaml
+
+            docs = list(_yaml.safe_load_all(yaml_text))
+        except ImportError:
+            import json
+
+            docs = [json.loads(yaml_text)]
+        out = []
+        for doc in docs:
+            if not doc:
+                continue
+            kind = doc.get("kind")
+            ctor = FROM_DICT.get(kind)
+            if ctor is None:
+                out.append(f"skipped unknown kind {kind}")
+                continue
+            obj = ctor.from_dict(doc)
+            ns = getattr(obj.metadata, "namespace", "")
+            if self.store.get(kind, ns, obj.metadata.name) is not None:
+                self.store.update(kind, obj)
+                out.append(f"{kind.lower()}/{obj.metadata.name} configured")
+            else:
+                self.store.create(kind, obj)
+                out.append(f"{kind.lower()}/{obj.metadata.name} created")
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> str:
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        obj = self.store.delete(kind, namespace, name)
+        return (
+            f"{kind.lower()}/{name} deleted" if obj is not None
+            else f"{kind} {namespace}/{name} not found"
+        )
+
+    def scale(self, kind: str, namespace: str, name: str, replicas: int) -> str:
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None or not hasattr(o, "replicas"):
+            return f"cannot scale {kind} {namespace}/{name}"
+        o.replicas = replicas
+        self.store.update(kind, o)
+        return f"{kind.lower()}/{name} scaled to {replicas}"
+
+    # --- node ops -------------------------------------------------------------
+
+    def cordon(self, name: str, on: bool = True) -> str:
+        node = self.store.get("Node", "", name)
+        if node is None:
+            return f"node {name} not found"
+        node.spec.unschedulable = on
+        self.store.update("Node", node)
+        return f"node/{name} {'cordoned' if on else 'uncordoned'}"
+
+    def taint(self, name: str, key: str, value: str = "",
+              effect: str = v1.TAINT_NO_SCHEDULE, remove: bool = False) -> str:
+        node = self.store.get("Node", "", name)
+        if node is None:
+            return f"node {name} not found"
+        node.spec.taints = [t for t in node.spec.taints if t.key != key]
+        if not remove:
+            node.spec.taints.append(v1.Taint(key=key, value=value, effect=effect))
+        self.store.update("Node", node)
+        return f"node/{name} tainted"
+
+    def drain(self, name: str) -> str:
+        self.cordon(name, True)
+        pods, _ = self.store.list("Pod")
+        n = 0
+        for p in pods:
+            if p.spec.node_name == name:
+                self.store.delete("Pod", p.namespace, p.metadata.name)
+                n += 1
+        return f"node/{name} drained ({n} pods evicted)"
+
+
+def main(argv=None):  # pragma: no cover - thin shell wrapper
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="ktpu")
+    sub = ap.add_subparsers(dest="verb", required=True)
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("-n", "--namespace")
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+    args = ap.parse_args(argv)
+    store = ObjectStore()
+    k = Kubectl(store)
+    if args.verb == "get":
+        print(k.get(args.kind, args.namespace))
+    elif args.verb == "apply":
+        with open(args.filename) as f:
+            for line in k.apply(f.read()):
+                print(line)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

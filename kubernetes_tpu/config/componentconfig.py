@@ -212,6 +212,16 @@ def _construct(name, args, domain_cap, extended_index, num_dims):
         "NodePorts": P.NodePortsPlugin,
         "NodeUnschedulable": P.NodeUnschedulablePlugin,
         "ImageLocality": P.ImageLocalityPlugin,
+        "SelectorSpread": P.SelectorSpreadPlugin,
+        "VolumeBinding": P.VolumeBindingPlugin,
+        "VolumeZone": P.VolumeZonePlugin,
+        "VolumeRestrictions": P.VolumeRestrictionsPlugin,
+        "NodeVolumeLimits": P.NodeVolumeLimitsPlugin,
+        # reference cloud-specific limit plugins all map onto the generic
+        # NodeVolumeLimits implementation (nodevolumelimits/non_csi.go)
+        "EBSLimits": P.NodeVolumeLimitsPlugin,
+        "GCEPDLimits": P.NodeVolumeLimitsPlugin,
+        "AzureDiskLimits": P.NodeVolumeLimitsPlugin,
     }
     ctor = simple.get(name)
     return ctor() if ctor else None

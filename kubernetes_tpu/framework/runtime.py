@@ -142,6 +142,8 @@ class BatchedFramework:
         are bit-identical to the dense recompute (test_fast_scan_parity).
         """
         b = batch.valid.shape[0]
+        # device-ify all leaves so traced indexing works in eager calls too
+        batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
 
         # --- static precompute (outside the scan) ----------------------------
         static_mask = snap.node_valid[None, :] & batch.valid[:, None]
@@ -222,6 +224,7 @@ class BatchedFramework:
         """Reference implementation: full [B, N] recompute per step (used by the
         fast-path parity test)."""
         b = batch.valid.shape[0]
+        batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
 
         def step(carry, inp):
             dyn, auxes = carry

@@ -16,6 +16,13 @@ from .trivial import (  # noqa: F401
 )
 from .podtopologyspread import PodTopologySpreadPlugin  # noqa: F401
 from .interpodaffinity import InterPodAffinityPlugin  # noqa: F401
+from .selectorspread import SelectorSpreadPlugin  # noqa: F401
+from .volumes import (  # noqa: F401
+    NodeVolumeLimitsPlugin,
+    VolumeBindingPlugin,
+    VolumeRestrictionsPlugin,
+    VolumeZonePlugin,
+)
 
 DEFAULT_PLUGIN_WEIGHTS = {
     # apis/config/v1beta3/default_plugins.go:32-51

@@ -1,0 +1,148 @@
+"""SelectorSpread (non-default in v1.24): spread pods of the same
+Service/ReplicaSet/StatefulSet across nodes and zones.
+
+Reference: pkg/scheduler/framework/plugins/selectorspread/selector_spread.go —
+PreScore merges the selectors of every Service/RC/RS/SS owning the pod
+(helper.DefaultSelector: requirements AND together); Score = count of matching
+pods on the node; NormalizeScore inverts against the max and blends a zone
+score with weight 2/3 when zones exist.
+
+Counts are host-computed per batch over the snapshot (the listers are API-object
+lookups); the ``[B, N]`` planes ride to device as aux and the final invert/blend
+is row-local at scan time (mask-dependent maxima).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..api import objects as v1
+from ..api.labels import match_label_selector
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import MAX_NODE_SCORE, Plugin
+
+ZONE_WEIGHTING = 2.0 / 3.0  # selector_spread.go zoneWeighting
+
+
+class SelectorSpreadPlugin(Plugin):
+    name = "SelectorSpread"
+    dynamic = True  # mask-dependent normalize at scan time (no carried state)
+
+    def __init__(self, store=None):
+        self.store = store
+
+    def events_to_register(self):
+        return [
+            ClusterEvent(EventResource.POD, ActionType.ALL),
+            ClusterEvent(EventResource.SERVICE, ActionType.ALL),
+        ]
+
+    def _selectors_for(self, pod: v1.Pod):
+        """helper.DefaultSelector: label selectors of every owning object."""
+        sels = []
+        if self.store is None:
+            return sels
+        for svc in self.store.list("Service")[0]:
+            if svc.metadata.namespace != pod.namespace or not svc.selector:
+                continue
+            if all(pod.metadata.labels.get(k) == val for k, val in svc.selector.items()):
+                sels.append(
+                    v1.LabelSelector(match_labels=dict(svc.selector))
+                )
+        for rs in self.store.list("ReplicaSet")[0]:
+            if rs.metadata.namespace != pod.namespace or rs.selector is None:
+                continue
+            if match_label_selector(rs.selector, pod.metadata.labels):
+                sels.append(rs.selector)
+        return sels
+
+    def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
+        b, n = batch.size, encoder._n
+        counts = np.zeros((b, n), dtype=np.float32)
+        zone_counts = np.zeros((b, n), dtype=np.float32)
+        has_zone = np.zeros(n, dtype=bool)
+        zone_of = {}
+        for info in snapshot.node_info_list:
+            r = encoder.node_rows.get(info.node_name)
+            if r is None:
+                continue
+            z = info.node.metadata.labels.get("topology.kubernetes.io/zone") or \
+                info.node.metadata.labels.get("failure-domain.beta.kubernetes.io/zone")
+            zone_of[r] = z
+            has_zone[r] = z is not None
+        for i, pod in enumerate(batch.pods):
+            sels = self._selectors_for(pod)
+            if not sels:
+                continue
+            for info in snapshot.node_info_list:
+                r = encoder.node_rows.get(info.node_name)
+                if r is None:
+                    continue
+                c = 0
+                for pi in info.pods:
+                    p = pi.pod
+                    if p.namespace != pod.namespace or p.metadata.deletion_timestamp:
+                        continue
+                    if all(match_label_selector(s, p.metadata.labels) for s in sels):
+                        c += 1
+                counts[i, r] = c
+            by_zone = {}
+            for r, z in zone_of.items():
+                if z is not None:
+                    by_zone[z] = by_zone.get(z, 0.0) + counts[i, r]
+            for r, z in zone_of.items():
+                if z is not None:
+                    zone_counts[i, r] = by_zone[z]
+        return {"counts": counts, "zone_counts": zone_counts, "has_zone": has_zone}
+
+    def prepare(self, batch, snap, dyn, host_aux=None):
+        import jax.numpy as jnp
+
+        if host_aux is None:
+            z = jnp.zeros((batch.valid.shape[0], snap.num_nodes), jnp.float32)
+            return {"counts": z, "zone_counts": z,
+                    "has_zone": jnp.zeros(snap.num_nodes, bool)}
+        return {k: jnp.asarray(v) for k, v in host_aux.items()}
+
+    def score_row(self, batch, snap, dyn, aux, i, mask_row=None):
+        import jax.numpy as jnp
+
+        counts = aux["counts"][i]
+        zcounts = aux["zone_counts"][i]
+        has_zone = aux["has_zone"]
+        if mask_row is None:
+            mask_row = jnp.ones(counts.shape, bool)
+        max_c = jnp.max(jnp.where(mask_row, counts, 0.0))
+        max_z = jnp.max(jnp.where(mask_row, zcounts, 0.0))
+        node_score = jnp.where(
+            max_c > 0, (max_c - counts) * MAX_NODE_SCORE / jnp.maximum(max_c, 1.0),
+            float(MAX_NODE_SCORE),
+        )
+        zone_score = jnp.where(
+            max_z > 0, (max_z - zcounts) * MAX_NODE_SCORE / jnp.maximum(max_z, 1.0),
+            float(MAX_NODE_SCORE),
+        )
+        blended = jnp.where(
+            has_zone & (max_z > 0),
+            (1.0 - ZONE_WEIGHTING) * node_score + ZONE_WEIGHTING * zone_score,
+            node_score,
+        )
+        return jnp.floor(blended)
+
+    def score(self, batch, snap, dyn, aux=None, mask=None):
+        """Batched variant for the dense/compute path."""
+        import jax
+
+        b = batch.valid.shape[0]
+        if mask is None:
+            import jax.numpy as jnp
+
+            mask = jnp.ones((b, snap.num_nodes), bool)
+        return jax.vmap(
+            lambda i, m: self.score_row(batch, snap, dyn, aux, i, m)
+        )(jax.numpy.arange(b), mask)
+
+    def normalize(self, scores, mask):
+        return scores

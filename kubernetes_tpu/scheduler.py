@@ -115,6 +115,9 @@ class TPUScheduler:
         self.queue = PriorityQueue(clock=clock, cluster_event_map=event_map)
         self.preemption = Evaluator()
         self.extenders = list(extenders or [])
+        from .framework.waiting_pods import WaitingPodsMap
+
+        self.waiting_pods = WaitingPodsMap(clock=clock)
         # nominator: uid → (node_name, request vector) for pods holding a
         # nominated node across cycles (their reservation is added to the
         # dynamic state so other pods don't steal the spot —
@@ -331,20 +334,46 @@ class TPUScheduler:
 
         On any failure, already-reserved plugins are unreserved in reverse order.
         """
+        from .framework.interface import Code
+
         fw = self._fw
         reserved = []
+
+        def rollback():
+            # the waiting-pod entry dies with its binding cycle
+            # (runtime/framework.go removes it from waitingPods either way)
+            self.waiting_pods.remove(pod.uid)
+            for done in reversed(reserved):
+                un = getattr(done.plugin, "unreserve", None)
+                if un is not None:
+                    un(None, pod, node_name)
+
         for pw in fw.plugins:
             fn = getattr(pw.plugin, "reserve", None)
             if fn is None:
                 continue
             status = fn(None, pod, node_name)
             if status is not None and not status.is_success():
-                for done in reversed(reserved):
-                    un = getattr(done.plugin, "unreserve", None)
-                    if un is not None:
-                        un(None, pod, node_name)
+                rollback()
                 return False
             reserved.append(pw)
+        # Permit: plugins may Wait with a timeout (waiting_pods_map analog);
+        # in the synchronous sim an unallowed Wait fails the cycle and the pod
+        # retries after backoff (WaitOnPermit, runtime/framework.go)
+        for pw in fw.plugins:
+            fn = getattr(pw.plugin, "permit", None)
+            if fn is None:
+                continue
+            status, timeout = fn(None, pod, node_name)
+            if status is not None and status.code == Code.WAIT:
+                self.waiting_pods.add(pod, pw.plugin.name, timeout)
+            elif status is not None and not status.is_success():
+                rollback()
+                return False
+        reason = self.waiting_pods.wait_on_permit(pod)
+        if reason is not None:
+            rollback()
+            return False
         for pw in fw.plugins:
             fn = getattr(pw.plugin, "pre_bind", None)
             if fn is None:

@@ -60,6 +60,8 @@ class ObjectStore:
 
     def create(self, kind: str, obj) -> int:
         with self._lock:
+            if kind == "Pod":
+                self._admit_pod(obj)
             key = self._key(kind, obj)
             if key in self._objects:
                 raise ValueError(f"{key} already exists")
@@ -112,6 +114,26 @@ class ObjectStore:
                     handler(ev)
             self._watchers.append(handler)
             return lambda: self._watchers.remove(handler)
+
+    def _admit_pod(self, pod) -> None:
+        """Priority admission: resolve priorityClassName → spec.priority
+        (reference: plugin/pkg/admission/priority)."""
+        spec = pod.spec
+        if spec.priority:
+            return
+        name = spec.priority_class_name
+        pc = None
+        if name:
+            pc = self._objects.get(("PriorityClass", "", name))
+        else:
+            pc = next(
+                (o for (k, _, _), o in self._objects.items()
+                 if k == "PriorityClass" and o.global_default),
+                None,
+            )
+        if pc is not None:
+            spec.priority = pc.value
+            spec.preemption_policy = pc.preemption_policy
 
     # --- binding subresource --------------------------------------------------
 

@@ -23,3 +23,13 @@ import jax  # noqa: E402
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+# The axon sitecustomize imports jax BEFORE this conftest runs, so the env vars
+# above are too late for jax.config's import-time reads — force via config.
+# (XLA_FLAGS is still read lazily at CPU-client creation, so the device count
+# takes effect as long as no backend has initialized yet.)
+for _name, _val in (("jax_platforms", "cpu"), ("jax_platform_name", "cpu")):
+    try:
+        jax.config.update(_name, _val)
+    except Exception:
+        pass

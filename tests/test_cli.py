@@ -1,0 +1,78 @@
+"""kubectl-style CLI verbs + PriorityClass admission."""
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def test_get_and_describe():
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Pod", make_pod().name("p").uid("p").node("n0").obj())
+    k = Kubectl(store)
+    out = k.get("pods")
+    assert "NAME" in out and "p" in out and "n0" in out
+    assert '"name": "p"' in k.describe("pod", "default", "p")
+
+
+def test_apply_yaml_and_scale():
+    store = ObjectStore()
+    k = Kubectl(store)
+    msgs = k.apply("""
+apiVersion: apps/v1
+kind: ReplicaSet
+metadata:
+  name: web
+  namespace: default
+spec:
+  replicas: 2
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+        - name: c0
+          image: pause
+---
+apiVersion: v1
+kind: Node
+metadata:
+  name: n0
+""")
+    assert msgs == ["replicaset/web created", "node/n0 created"]
+    assert "scaled to 5" in k.scale("rs", "default", "web", 5)
+    assert store.get("ReplicaSet", "default", "web").replicas == 5
+
+
+def test_cordon_taint_drain():
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Pod", make_pod().name("p").uid("p").node("n0").obj())
+    k = Kubectl(store)
+    k.cordon("n0")
+    assert store.get("Node", "", "n0").spec.unschedulable
+    k.taint("n0", "maintenance", effect=v1.TAINT_NO_EXECUTE)
+    assert any(t.key == "maintenance" for t in store.get("Node", "", "n0").spec.taints)
+    out = k.drain("n0")
+    assert "1 pods evicted" in out
+    assert store.get("Pod", "default", "p") is None
+
+
+def test_priority_class_admission():
+    store = ObjectStore()
+    pc = v1.PriorityClass(value=1000)
+    pc.metadata.name = "high"
+    store.create("PriorityClass", pc)
+    default_pc = v1.PriorityClass(value=7, global_default=True)
+    default_pc.metadata.name = "default-pc"
+    store.create("PriorityClass", default_pc)
+
+    p1 = make_pod().name("p1").uid("p1").obj()
+    p1.spec.priority_class_name = "high"
+    store.create("Pod", p1)
+    assert p1.spec.priority == 1000
+
+    p2 = make_pod().name("p2").uid("p2").obj()
+    store.create("Pod", p2)
+    assert p2.spec.priority == 7  # global default applied

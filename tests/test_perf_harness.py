@@ -1,0 +1,41 @@
+"""scheduler_perf-style harness smoke: opcodes, throughput + quantile items."""
+
+import json
+
+from kubernetes_tpu.perf import Op, Workload, run_workload
+from kubernetes_tpu.perf.harness import data_items_to_json
+
+
+def test_workload_basic_with_metrics():
+    w = Workload(
+        name="SchedulingBasicSmall",
+        batch_size=16,
+        ops=[
+            Op("createNodes", count=8),
+            Op("createPods", count=16),  # warmup (uncollected)
+            Op("barrier"),
+            Op("createPods", count=16, collect_metrics=True),
+        ],
+    )
+    items = run_workload(w)
+    by_metric = {i.labels["Metric"]: i for i in items}
+    assert by_metric["SchedulingThroughput"].data["Average"] > 0
+    hist = by_metric["scheduler_scheduling_attempt_duration_seconds"]
+    assert hist.data["Perc99"] >= hist.data["Perc50"] >= 0
+    doc = json.loads(data_items_to_json(items))
+    assert doc["version"] == "v1" and len(doc["dataItems"]) == 2
+
+
+def test_workload_churn():
+    w = Workload(
+        name="Churn",
+        batch_size=16,
+        ops=[
+            Op("createNodes", count=4),
+            Op("createPods", count=8),
+            Op("churn", churn_deletes=4),
+            Op("createPods", count=8, collect_metrics=True),
+        ],
+    )
+    items = run_workload(w)
+    assert any(i.labels["Metric"] == "SchedulingThroughput" for i in items)

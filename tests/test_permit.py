@@ -1,0 +1,65 @@
+"""Permit extension point + waiting pods map."""
+
+from kubernetes_tpu.framework.interface import Code, Plugin, PluginWithWeight, Status
+from kubernetes_tpu.framework.waiting_pods import WaitingPodsMap
+from kubernetes_tpu.scheduler import TPUScheduler, default_plugins
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_waiting_pods_allow_and_timeout():
+    clock = FakeClock()
+    wp = WaitingPodsMap(clock=clock)
+    pod = make_pod().name("p").uid("p").obj()
+    wp.add(pod, "gate", timeout=10.0)
+    assert "gate" in wp.wait_on_permit(pod)  # still waiting
+    wp.get("p").allow("gate")
+    assert wp.wait_on_permit(pod) is None  # allowed and removed
+    wp.add(pod, "gate", timeout=10.0)
+    clock.advance(11.0)
+    assert "timed out" in wp.wait_on_permit(pod)
+
+
+class GatePlugin(Plugin):
+    name = "Gate"
+
+    def __init__(self):
+        self.open = False
+
+    def permit(self, state, pod, node_name):
+        if self.open:
+            return Status.success(), 0.0
+        return Status(code=Code.WAIT), 30.0
+
+
+def test_permit_gate_blocks_then_allows():
+    store = ObjectStore()
+    clock = FakeClock()
+    gate = GatePlugin()
+
+    def factory(d, _gate=gate):
+        return default_plugins(d) + [PluginWithWeight(_gate, 0)]
+
+    sched = TPUScheduler(store, plugins_factory=factory, batch_size=4, clock=clock)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).obj())
+    stats = sched.run_until_idle()
+    assert stats.scheduled == 0  # gated
+    assert not store.get("Pod", "default", "p").spec.node_name
+    gate.open = True
+    clock.advance(61.0)  # permit-blocked pods re-enter via unschedulableQ flush
+    stats = sched.run_until_idle()
+    assert stats.scheduled == 1
+    assert store.get("Pod", "default", "p").spec.node_name == "n0"

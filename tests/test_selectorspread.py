@@ -1,0 +1,48 @@
+"""SelectorSpread: pods of a Service spread across nodes/zones."""
+
+from kubernetes_tpu import plugins as P
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.framework.interface import PluginWithWeight as PW
+from kubernetes_tpu.framework.runtime import BatchedFramework, initial_dynamic_state
+from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.state.encoding import ClusterEncoder
+from kubernetes_tpu.testutil import make_node, make_pod
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_selector_spread_prefers_empty_node():
+    store = ObjectStore()
+    svc = v1.Service(selector={"app": "web"})
+    svc.metadata.name = "web"
+    store.create("Service", svc)
+
+    cache = Cache()
+    for i in range(3):
+        cache.add_node(make_node().name(f"n{i}")
+                       .label("topology.kubernetes.io/zone", f"z{i % 2}").obj())
+    # two service pods already on n0
+    for i in range(2):
+        cache.add_pod(make_pod().name(f"sp{i}").uid(f"sp{i}").namespace("default")
+                      .label("app", "web").req({"cpu": "1"}).node("n0").obj())
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    comp = PodBatchCompiler(enc)
+    pod = make_pod().name("p").uid("p").namespace("default").label("app", "web").req({"cpu": "1"}).obj()
+    batch = comp.compile([pod])
+    enc.full_sync(snap)
+
+    plugin = P.SelectorSpreadPlugin(store)
+    fw = BatchedFramework([PW(P.FitPlugin(), 1), PW(plugin, 1)])
+    host_auxes = fw.host_prepare(batch, snap, enc)
+    dsnap = enc.to_device()
+    dyn = initial_dynamic_state(dsnap)
+    auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+    res = fw.greedy_assign(batch, dsnap, dyn, auxes, jnp.arange(batch.size))
+    name_of = {r: n for n, r in enc.node_rows.items()}
+    # n0 is crowded (2 service pods, zone z0); n1 shares zone z1 alone → best
+    assert name_of[int(np.asarray(res.node_row)[0])] == "n1"

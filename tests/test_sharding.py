@@ -1,0 +1,60 @@
+"""Node-axis mesh sharding: sharded compute must equal unsharded
+(parallel/mesh.py; conftest provides 8 virtual CPU devices)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.parallel import node_sharded_mesh, shard_snapshot
+from kubernetes_tpu.parallel.mesh import shard_dynamic_state
+
+from tests.test_parity import build_cluster, default_framework, device_pipeline, pending_pods
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_compute_matches_unsharded():
+    rng = np.random.default_rng(11)
+    cache = build_cluster(rng, n_nodes=16)
+    pods = pending_pods(rng, k=8)
+    fw, batch, snap, enc, dsnap, dyn, _ = device_pipeline(cache, pods)
+
+    # host_auxes=None on BOTH paths so the planes being compared are identical
+    auxes = jax.jit(fw.prepare)(batch, dsnap, dyn, None)
+    mask0, scores0 = fw.jit_compute(batch, dsnap, dyn, auxes)
+
+    mesh = node_sharded_mesh(jax.devices()[:8])
+    sh_snap = shard_snapshot(dsnap, mesh)
+    sh_dyn = shard_dynamic_state(dyn, mesh)
+    with mesh:
+        auxes_sh = jax.jit(fw.prepare)(batch, sh_snap, sh_dyn, None)
+        mask1, scores1 = jax.jit(fw.compute)(batch, sh_snap, sh_dyn, auxes_sh)
+
+    # aux host planes (volume masks, IPA static) default to zeros without
+    # host_prepare in both paths, so results must agree exactly
+    assert np.array_equal(np.asarray(mask0), np.asarray(mask1))
+    np.testing.assert_allclose(
+        np.where(np.asarray(mask0), np.asarray(scores0), 0),
+        np.where(np.asarray(mask1), np.asarray(scores1), 0),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_greedy_assign_runs():
+    rng = np.random.default_rng(12)
+    cache = build_cluster(rng, n_nodes=16)
+    pods = pending_pods(rng, k=4)
+    fw, batch, snap, enc, dsnap, dyn, _ = device_pipeline(cache, pods)
+    auxes = jax.jit(fw.prepare)(batch, dsnap, dyn, None)
+    res0 = fw.jit_greedy(batch, dsnap, dyn, auxes, jnp.arange(batch.size), None)
+
+    mesh = node_sharded_mesh(jax.devices()[:8])
+    sh_snap = shard_snapshot(dsnap, mesh)
+    sh_dyn = shard_dynamic_state(dyn, mesh)
+    with mesh:
+        auxes_sh = jax.jit(fw.prepare)(batch, sh_snap, sh_dyn, None)
+        res1 = jax.jit(fw.greedy_assign)(
+            batch, sh_snap, sh_dyn, auxes_sh, jnp.arange(batch.size), None
+        )
+    assert np.array_equal(np.asarray(res0.node_row), np.asarray(res1.node_row))
