@@ -1,0 +1,121 @@
+"""Native (C++) host hot paths, loaded via ctypes with a Python fallback.
+
+``load_interner()`` compiles interner.cpp with g++ on first use (cached .so next
+to the source) and returns the ctypes handle module, or None when no toolchain
+is available — callers (state/dictionary.py) fall back to pure Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "interner.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_interner.so")
+
+
+def load_interner() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.ktpu_interner_new.restype = ctypes.c_void_p
+            lib.ktpu_interner_free.argtypes = [ctypes.c_void_p]
+            lib.ktpu_interner_size.argtypes = [ctypes.c_void_p]
+            lib.ktpu_interner_size.restype = ctypes.c_int64
+            lib.ktpu_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+            lib.ktpu_intern.restype = ctypes.c_int32
+            lib.ktpu_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+            lib.ktpu_lookup.restype = ctypes.c_int32
+            lib.ktpu_intern_many.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.ktpu_intern_many.restype = ctypes.c_int64
+            lib.ktpu_numeric_table.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ]
+            lib.ktpu_string.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.ktpu_string.restype = ctypes.c_int64
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+class NativeInterner:
+    """Drop-in for state.dictionary.Dictionary backed by the C++ interner."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.ktpu_interner_new())
+
+    def __del__(self):
+        try:
+            self._lib.ktpu_interner_free(self._h)
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.ktpu_interner_size(self._h))
+
+    def intern(self, s: str) -> int:
+        b = s.encode()
+        return int(self._lib.ktpu_intern(self._h, b, len(b)))
+
+    def lookup(self, s: str) -> int:
+        b = s.encode()
+        return int(self._lib.ktpu_lookup(self._h, b, len(b)))
+
+    def intern_many(self, strings) -> "list[int]":
+        import numpy as np
+
+        n = len(strings)
+        if n == 0:
+            return []
+        flat = b"\0".join(s.encode() for s in strings) + b"\0"
+        out = np.empty(n, dtype=np.int32)
+        self._lib.ktpu_intern_many(
+            self._h, flat, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        return out.tolist()
+
+    def string(self, i: int) -> str:
+        buf = ctypes.create_string_buffer(256)
+        full = self._lib.ktpu_string(self._h, i, buf, 256)
+        if full < 0:
+            raise IndexError(i)
+        if full < 256:
+            return buf.value.decode()
+        big = ctypes.create_string_buffer(int(full) + 1)
+        self._lib.ktpu_string(self._h, i, big, full + 1)
+        return big.value.decode()
+
+    def numeric_table(self, min_size: int = 1):
+        import numpy as np
+
+        n = max(len(self), min_size)
+        out = np.empty(n, dtype=np.float32)
+        self._lib.ktpu_numeric_table(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n
+        )
+        return out

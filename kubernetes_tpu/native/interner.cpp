@@ -1,0 +1,108 @@
+// Native host hot path: string interning + numeric side-table.
+//
+// Role (SURVEY.md §2.4): the reference's Go hot paths around snapshotting
+// (cache.go UpdateSnapshot) become, in this framework, the per-event host work
+// of dictionary-encoding every label/taint/name string into int32 ids before
+// device upload (state/dictionary.py).  That interning is the innermost host
+// loop — this C++ implementation replaces the Python dict path, exposed
+// through a minimal C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Build: g++ -O2 -shared -fPIC -o _interner.so interner.cpp
+//
+// Concurrency: single-writer like the Python Dictionary (the scheduler's
+// event-ingest thread) — no locking on the hot path.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Interner {
+    std::unordered_map<std::string, int32_t> to_id;
+    std::vector<std::string> to_str;
+    std::vector<float> numeric;  // NaN when the string is not an integer
+
+    int32_t intern(const char* s, int64_t len) {
+        std::string key(s, static_cast<size_t>(len));
+        auto it = to_id.find(key);
+        if (it != to_id.end()) return it->second;
+        int32_t id = static_cast<int32_t>(to_str.size());
+        to_id.emplace(key, id);
+        to_str.push_back(key);
+        numeric.push_back(parse_numeric(key));
+        return id;
+    }
+
+    static float parse_numeric(const std::string& s) {
+        if (s.empty()) return nanf("");
+        char* end = nullptr;
+        errno = 0;
+        long long v = strtoll(s.c_str(), &end, 10);
+        if (errno != 0 || end != s.c_str() + s.size()) return nanf("");
+        return static_cast<float>(v);
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ktpu_interner_new() { return new Interner(); }
+
+void ktpu_interner_free(void* h) { delete static_cast<Interner*>(h); }
+
+int64_t ktpu_interner_size(void* h) {
+    return static_cast<int64_t>(static_cast<Interner*>(h)->to_str.size());
+}
+
+int32_t ktpu_intern(void* h, const char* s, int64_t len) {
+    return static_cast<Interner*>(h)->intern(s, len);
+}
+
+// Read-only lookup: -1 when never interned.
+int32_t ktpu_lookup(void* h, const char* s, int64_t len) {
+    auto* in = static_cast<Interner*>(h);
+    auto it = in->to_id.find(std::string(s, static_cast<size_t>(len)));
+    return it == in->to_id.end() ? -1 : it->second;
+}
+
+// Batch interning: `flat` holds n zero-terminated strings back to back;
+// ids are written to out[n]. Returns n (convenience).
+int64_t ktpu_intern_many(void* h, const char* flat, int64_t n, int32_t* out) {
+    auto* in = static_cast<Interner*>(h);
+    const char* p = flat;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t len = static_cast<int64_t>(strlen(p));
+        out[i] = in->intern(p, len);
+        p += len + 1;
+    }
+    return n;
+}
+
+// Copy the numeric side-table (float32) into out[cap]; pads with NaN.
+void ktpu_numeric_table(void* h, float* out, int64_t cap) {
+    auto* in = static_cast<Interner*>(h);
+    int64_t n = static_cast<int64_t>(in->numeric.size());
+    int64_t m = n < cap ? n : cap;
+    memcpy(out, in->numeric.data(), static_cast<size_t>(m) * sizeof(float));
+    for (int64_t i = m; i < cap; ++i) out[i] = nanf("");
+}
+
+// String of an id into out (truncated to cap-1, NUL-terminated);
+// returns full length or -1 for a bad id.
+int64_t ktpu_string(void* h, int32_t id, char* out, int64_t cap) {
+    auto* in = static_cast<Interner*>(h);
+    if (id < 0 || static_cast<size_t>(id) >= in->to_str.size()) return -1;
+    const std::string& s = in->to_str[static_cast<size_t>(id)];
+    int64_t m = static_cast<int64_t>(s.size()) < cap - 1
+                    ? static_cast<int64_t>(s.size()) : cap - 1;
+    memcpy(out, s.data(), static_cast<size_t>(m));
+    out[m] = '\0';
+    return static_cast<int64_t>(s.size());
+}
+
+}  // extern "C"
