@@ -165,14 +165,20 @@ class Evaluator:
         if len(pool) > 1:
             pool = _argmin(pool, lambda c: len(c.victims))
         if len(pool) > 1:
-            # latest highest-priority-victim start time wins (so the victim that
-            # started most recently is preempted)
-            pool = _argmin(
-                pool,
-                lambda c: -max(
-                    (p.metadata.creation_timestamp or 0) for p in c.victims
-                ),
-            )
+            # latest "earliest start time among the highest-priority victims"
+            # wins (preemption.go:492-509 via util.GetEarliestPodStartTime):
+            # prefer the node whose most-important victims are youngest.
+            def earliest_high_priority_start(c: Candidate) -> int:
+                # victims are sorted by descending priority (see sort above),
+                # same invariant the criterion-2 tiebreak relies on
+                top = c.victims[0].spec.priority
+                return min(
+                    (p.metadata.creation_timestamp or 0)
+                    for p in c.victims
+                    if p.spec.priority == top
+                )
+
+            pool = _argmin(pool, lambda c: -earliest_high_priority_start(c))
         return pool[0]
 
     def preempt(

@@ -248,17 +248,24 @@ class TPUScheduler:
         dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
         auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
         if self.extenders:
-            node_row = self._assign_with_extenders(batch, dsnap, dyn, auxes, pods)
+            # sequential per-pod cycles: each pod's decision lands at its own
+            # time, so per-attempt latency must not absorb later pods' cycles
+            node_row, algo_lat = self._assign_with_extenders(
+                batch, dsnap, dyn, auxes, pods, t0
+            )
         else:
             res = self._jitted["greedy"](
                 batch, dsnap, dyn, auxes, jnp.arange(batch.size), self.rng_key
             )
             node_row = np.asarray(res.node_row)
-        algo_s = self.clock() - t0
-        m.scheduling_algorithm_duration.observe(algo_s)
+            algo_lat = np.full(len(infos), self.clock() - t0)
+            # one algorithm invocation for the whole batch → one sample
+            # (the extender path samples per-pod cycles itself)
+            m.scheduling_algorithm_duration.observe(self.clock() - t0)
 
         name_of = {r: n for n, r in self.encoder.node_rows.items()}
         for i, qi in enumerate(infos):
+            t_pod = self.clock()
             row = int(node_row[i])
             if row >= 0:
                 node_name = name_of[row]
@@ -282,52 +289,73 @@ class TPUScheduler:
                 qi.unschedulable_plugins = self._diagnose(batch, dsnap, dyn, auxes, i)
                 self._run_post_filter(qi, batch, dsnap, dyn, auxes, i)
                 self.queue.add_unschedulable(qi, cycle)
+            # True per-attempt latency (scheduler_perf util.go:238-276): the
+            # pod's decision is unavailable until its device program returns
+            # (whole batch in the fused path, its own cycle in the extender
+            # path), so its attempt spans that algorithm time plus its own
+            # host reserve/permit/bind segment — not a batch average.
+            m.scheduling_attempt_duration.observe(
+                float(algo_lat[i]) + (self.clock() - t_pod)
+            )
         stats.batch_seconds = self.clock() - t0
-        # per-attempt latency: the batch amortizes over its pods
-        per_pod = stats.batch_seconds / max(stats.attempted, 1)
-        for _ in range(stats.attempted):
-            m.scheduling_attempt_duration.observe(per_pod)
         a, b, u = self.queue.pending_count()
         m.pending_pods.set(a, ("active",))
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
         return stats
 
-    def _assign_with_extenders(self, batch, dsnap, dyn, auxes, pods) -> np.ndarray:
+    def _assign_with_extenders(
+        self, batch, dsnap, dyn, auxes, pods, t0: float
+    ) -> Tuple[np.ndarray, np.ndarray]:
         """Sequential per-pod cycles with HTTP extender callouts between the
         device compute and selection (findNodesThatPassExtenders
-        scheduler.go:1035 + extender prioritize merge :1146-1185)."""
+        scheduler.go:1035 + extender prioritize merge :1146-1185).
+
+        Returns (node_row, per-pod algorithm latency measured from t0 to the
+        pod's own decision)."""
         from .extender import ExtenderError
 
         fw = self._fw
         b = batch.valid.shape[0]
         out = np.full(b, -1, dtype=np.int32)
+        algo_lat = np.zeros(b)
         name_of = {r: n for n, r in self.encoder.node_rows.items()}
         row_of = self.encoder.node_rows
+        t_prev = self.clock()
         for i, pod in enumerate(pods):
-            mask, scores = self._jitted["compute"](batch, dsnap, dyn, auxes)
-            row_mask = np.asarray(mask[i])
-            row_scores = np.asarray(scores[i])
-            names = [name_of[r] for r in np.where(row_mask)[0] if r in name_of]
             try:
+                mask, scores = self._jitted["compute"](batch, dsnap, dyn, auxes)
+                row_mask = np.asarray(mask[i])
+                row_scores = np.asarray(scores[i])
+                names = [name_of[r] for r in np.where(row_mask)[0] if r in name_of]
+                try:
+                    for ext in self.extenders:
+                        names, _failed = ext.filter(pod, names)
+                        if not names:
+                            break
+                except ExtenderError:
+                    continue  # non-ignorable filter failure → pod unschedulable
+                if not names:
+                    continue
+                merged = {n: float(row_scores[row_of[n]]) for n in names}
                 for ext in self.extenders:
-                    names, _failed = ext.filter(pod, names)
-                    if not names:
-                        break
-            except ExtenderError:
-                continue  # non-ignorable extender failure → pod unschedulable
-            if not names:
-                continue
-            merged = {n: float(row_scores[row_of[n]]) for n in names}
-            for ext in self.extenders:
-                for n, s in ext.prioritize(pod, names).items():
-                    if n in merged:
-                        merged[n] += s
-            best = max(names, key=lambda n: merged[n])
-            row = row_of[best]
-            out[i] = row
-            dyn, auxes = fw.apply_assignment(dyn, auxes, i, row, batch, dsnap)
-        return out
+                    try:
+                        ranked = ext.prioritize(pod, names)
+                    except ExtenderError:
+                        continue  # prioritize errors are ignored (scheduler.go:1152)
+                    for n, s in ranked.items():
+                        if n in merged:
+                            merged[n] += s
+                best = max(names, key=lambda n: merged[n])
+                row = row_of[best]
+                out[i] = row
+                dyn, auxes = fw.apply_assignment(dyn, auxes, i, row, batch, dsnap)
+            finally:
+                algo_lat[i] = self.clock() - t0
+                now = self.clock()
+                m.scheduling_algorithm_duration.observe(now - t_prev)
+                t_prev = now
+        return out, algo_lat
 
     def _run_reserve_and_bind(self, pod: v1.Pod, node_name: str) -> bool:
         """Reserve → PreBind → Bind → PostBind (scheduler.go:584-698, host side).
@@ -380,18 +408,19 @@ class TPUScheduler:
                 continue
             status = fn(None, pod, node_name)
             if status is not None and not status.is_success():
-                for done in reversed(reserved):
-                    un = getattr(done.plugin, "unreserve", None)
-                    if un is not None:
-                        un(None, pod, node_name)
+                rollback()
                 return False
         ok = self.store.bind_pod(pod.namespace, pod.metadata.name, node_name)
-        if ok:
-            for pw in fw.plugins:
-                fn = getattr(pw.plugin, "post_bind", None)
-                if fn is not None:
-                    fn(None, pod, node_name)
-        return ok
+        if not ok:
+            # binding-cycle error (e.g. pod deleted mid-cycle) unreserves too,
+            # else VolumeBinding assume-state leaks (scheduler.go:676-689)
+            rollback()
+            return False
+        for pw in fw.plugins:
+            fn = getattr(pw.plugin, "post_bind", None)
+            if fn is not None:
+                fn(None, pod, node_name)
+        return True
 
     def _reserve_nominated(self, dyn, batch_uids: Set[str]):
         """Virtually consume resources of nominated-but-pending pods not in this
