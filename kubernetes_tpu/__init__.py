@@ -1,26 +1,36 @@
 """kubernetes_tpu — a TPU-native cluster-scheduling framework.
 
 A from-scratch reimplementation of the capability surface of Kubernetes'
-kube-scheduler (reference: kubernetes/kubernetes, surveyed in SURVEY.md), designed
-TPU-first: the host side (Python, with C++ hot paths) owns API objects, watch/event
-ingest, the scheduling queue, profiles/config, preemption, and binding; the compute
-side lifts the Scheduling Framework's PreFilter/Filter/Score phases into batched
-JAX/XLA programs over dense ``[pods, nodes]`` tensors, with Pallas kernels for top-k
-and batch assignment, and ``jax.sharding`` meshes + ICI collectives for scale.
+kube-scheduler (reference: kubernetes/kubernetes, surveyed in SURVEY.md),
+designed TPU-first: the host side (Python) owns API objects, watch/event
+ingest, the scheduling queue, profiles/config, preemption, and binding; the
+compute side lifts the Scheduling Framework's PreFilter/Filter/Score phases
+into batched JAX/XLA programs over dense ``[pods, nodes]`` tensors, with the
+hot domain-table ops as one-hot MXU contractions (``ops/``), a parallel
+auction assignment engine plus an exact greedy-scan oracle
+(``framework/runtime.py``), and ``jax.sharding`` meshes + ICI collectives
+for scale (``parallel/``).
 
 Layout (host control plane mirrors reference layers from SURVEY.md §1):
-  api/        — object model (v1.Pod, v1.Node, selectors, quantities)
-  state/      — dictionary encoding, struct-of-arrays snapshots, scheduler cache
-  framework/  — batched plugin API + runtime (extension points, CycleState, events)
-  plugins/    — vectorized default plugin set (reference: pkg/scheduler/framework/plugins)
-  queueing/   — 3-queue PriorityQueue with event-driven requeue
-  ops/        — device kernels: top-k, assignment, segment-sums (Pallas)
-  parallel/   — device mesh, node-axis sharding, ICI collectives
-  config/     — KubeSchedulerConfiguration-compatible componentconfig
-  sim/        — in-process apiserver/store + hollow-node cluster simulation
-  metrics/    — prometheus-name-compatible metrics
-  perf/       — scheduler_perf-style benchmark harness
-  models/     — the flagship jittable scheduling program (score + assign)
+  api/            — object model (v1.Pod, v1.Node, selectors, quantities)
+  state/          — dictionary encoding, struct-of-arrays snapshots, cache
+  framework/      — batched plugin API + runtime (extension points, events,
+                    greedy-scan and auction batch assignment)
+  plugins/        — vectorized default plugin set (reference:
+                    pkg/scheduler/framework/plugins)
+  queueing/       — 3-queue PriorityQueue with event-driven requeue
+  ops/            — device kernels: domain segment-sum/gather as einsum
+                    contractions (XLA gathers serialize on TPU; measured in
+                    tests/test_ops.py)
+  parallel/       — device mesh, node-axis sharding, ICI collectives
+  config/         — KubeSchedulerConfiguration-compatible componentconfig
+  sim/            — in-process apiserver/store + hollow-node simulation
+  metrics/        — prometheus-name-compatible metrics
+  perf/           — scheduler_perf-style benchmark harness
+  controllers/    — control loops (ReplicaSet, Deployment, Job, GC,
+                    NodeLifecycle, …)
+  client/         — reflector/informer, workqueue, leader election, events
+  component_base/ — feature gates, healthz, configz, tracing
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

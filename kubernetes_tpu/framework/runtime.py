@@ -32,6 +32,40 @@ class AssignResult(NamedTuple):
     dyn: DynamicState  # final dynamic state after all assignments
 
 
+class CouplingFlags(NamedTuple):
+    """Host-computed batch coupling for the parallel assignment engine.
+
+    reads[b] — pod b's filter/score planes read cross-pod tables that other
+        batch commits write (own topology-spread constraints or pod
+        (anti)affinity terms): such a pod may only commit when no earlier
+        commit happened in its round, so it always sees exact greedy state.
+    solo[b]  — pod b has REQUIRED anti-affinity terms; its commit writes the
+        existing-anti-affinity table every other pod's filter reads
+        (interpodaffinity/filtering.go:44-55), so the commit prefix stops
+        right after it.
+    """
+
+    reads: jnp.ndarray  # bool[B]
+    solo: jnp.ndarray  # bool[B]
+
+
+def coupling_flags(batch) -> CouplingFlags:
+    """Derive CouplingFlags from a compiled PodBatch (host-side, numpy)."""
+    import numpy as np
+
+    reads = (
+        batch.tsc_valid.any(axis=1)
+        | batch.req_affinity.valid.any(axis=1)
+        | batch.req_anti_affinity.valid.any(axis=1)
+        | batch.pref_affinity.valid.any(axis=1)
+        | batch.pref_anti_affinity.valid.any(axis=1)
+    )
+    solo = batch.req_anti_affinity.valid.any(axis=1)
+    return CouplingFlags(
+        reads=np.asarray(reads, dtype=bool), solo=np.asarray(solo, dtype=bool)
+    )
+
+
 class BatchedFramework:
     """Drives a fixed plugin list as fused tensor programs.
 
@@ -219,6 +253,258 @@ class BatchedFramework:
             else:
                 new_auxes.append(fn(aux, i, node_row, batch, snap))
         return new_dyn, tuple(new_auxes)
+
+    # --- parallel batch assignment (round-based prefix commits) ---------------
+
+    def batch_assign(
+        self, batch, snap, dyn, auxes, order, coupling: CouplingFlags, key=None
+    ) -> AssignResult:
+        """Whole-batch parallel assignment replacing the serial scan.
+
+        The serialized assume loop the reference runs one pod at a time
+        (pkg/scheduler/scheduler.go:496,571) becomes rounds of ONE dense
+        ``[B, N]`` filter+score program — the MXU-friendly shape — followed by
+        an O(B) prefix-commit scan:
+
+          round: ONE dense program computes every unresolved pod's
+          feasibility mask and score plane under the committed state; then an
+          O(B) auction scan walks the pod order, each pod bidding for its
+          BEST STILL-UNUSED feasible node by its own plane:
+            (a) at most one pod per node per round — node-local filters
+                (Fit, NodePorts, volumes…) checked against the round-start
+                state stay valid under the final state; a pod whose feasible
+                nodes are all taken skips and re-bids next round;
+            (b) a pod with cross-pod reads (CouplingFlags.reads) commits only
+                when nothing committed before it this round — and then the
+                unused-set is empty, so it takes its true argmax under exact
+                greedy state; otherwise it waits;
+            (c) a required-anti-affinity pod (CouplingFlags.solo) ends the
+                round, since its commit rewrites the existing-anti-affinity
+                table every later filter row would need.
+
+        Progress: the first unresolved pod in order always commits or is
+        marked unschedulable each round, so at most B rounds run; an
+        uncoupled batch usually resolves in ONE round (ranked choices stand
+        in for the score updates that spread pods in the serial loop).
+
+        Parity contract (SURVEY §7.6): on conflict-free batches (pairwise
+        distinct argmaxes, no cross-pod reads) the result is identical to
+        greedy_assign.  Under contention placements remain filter-valid under
+        the final committed state, but score-derived choices may differ from
+        the serial order: the one-pod-per-node-per-round rule approximates
+        the spreading that LeastAllocated-style scoring produces serially and
+        intentionally diverges from bin-packing (MostAllocated) stacking —
+        configure assign_mode="scan" for exact serial semantics there.
+        Heavily coupled batches should use the scan (see TPUScheduler's
+        dispatch heuristic).
+        """
+        b = batch.valid.shape[0]
+        batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
+        reads = jnp.asarray(coupling.reads)
+        solo = jnp.asarray(coupling.solo)
+        order = order.astype(jnp.int32)
+
+        # static planes once, as in greedy_assign's fast path
+        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_raw: List = []
+        for pw, aux in zip(self.plugins, auxes):
+            p = pw.plugin
+            if not p.dynamic and hasattr(p, "filter"):
+                static_mask = static_mask & p.filter(batch, snap, dyn, aux)
+            if hasattr(p, "score") and not p.dynamic:
+                static_raw.append((pw, p.score(batch, snap, dyn, aux)))
+        dyn_plugins = [
+            (pw, idx) for idx, pw in enumerate(self.plugins) if pw.plugin.dynamic
+        ]
+        dyn_auxes = tuple(auxes[idx] for _, idx in dyn_plugins)
+
+        # tie-break noise: uniform-among-ties like the reference's reservoir
+        # sampling (scheduler.go:827-848).  Plugin totals are integer-valued
+        # (each term is weight × floor), so sub-1 noise randomizes ties
+        # without reordering distinct scores.  key=None → deterministic
+        # first-max, the same rule select_host uses.
+        n_nodes_cap = snap.node_valid.shape[0]
+        tie_noise = None
+        if key is not None:
+            tie_noise = jax.random.uniform(key, (b, n_nodes_cap)) * 0.5
+
+        def dense_rows(dyn, dauxes):
+            mask = static_mask
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
+                if hasattr(pw.plugin, "filter"):
+                    mask = mask & pw.plugin.filter(batch, snap, dyn, aux)
+            total = jnp.zeros(mask.shape, jnp.float32)
+            for pw, plane in static_raw:
+                total = total + pw.weight * jnp.floor(pw.plugin.normalize(plane, mask))
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
+                if not hasattr(pw.plugin, "score"):
+                    continue
+                raw = pw.plugin.score(batch, snap, dyn, aux, mask=mask)
+                total = total + pw.weight * jnp.floor(pw.plugin.normalize(raw, mask))
+            return mask, jnp.where(mask, total, -jnp.inf)
+
+        n_cap = snap.node_valid.shape[0]
+
+        # pod → its position in `order` (the serial priority)
+        pos_of = jnp.zeros(b, jnp.int32).at[order].set(jnp.arange(b, dtype=jnp.int32))
+
+        def auction_commits(active, feasible, mask, scores):
+            """Parallel propose/resolve auction → (commit, choice, unsched).
+
+            Every non-reader bids for its best still-unused feasible node;
+            contested nodes go to the earliest pod in `order`; losers re-bid.
+            Earliest-position-wins makes the fixpoint identical to the serial
+            best-unused walk (serial dictatorship), but each sub-round is a
+            handful of [B, N] vector ops instead of B sequential steps.
+            Readers commit only as the FIRST active pod of a round (exact
+            state); a solo commit ends the round."""
+            eff = jnp.where(mask, scores, -jnp.inf)
+            if tie_noise is not None:
+                eff = jnp.where(mask, eff + tie_noise, -jnp.inf)
+            nom = jnp.clip(batch.nominated_row, 0, n_cap - 1)
+            nom_ok = (batch.nominated_row >= 0) & mask[jnp.arange(b), nom]
+            cols = jnp.arange(n_cap)
+
+            # --- first active pod: the only slot a reader may commit in ------
+            act_pos = jnp.where(active, pos_of, b)
+            first_pos = jnp.min(act_pos)
+            any_active = first_pos < b
+            first_pod = order[jnp.clip(first_pos, 0, b - 1)]
+            first_is_reader = any_active & reads[first_pod]
+            f_row = eff[first_pod]
+            f_choice = jnp.argmax(f_row).astype(jnp.int32)
+            f_choice = jnp.where(nom_ok[first_pod], nom[first_pod], f_choice)
+            f_commit = first_is_reader & feasible[first_pod]
+            f_unsched = first_is_reader & ~feasible[first_pod]
+            round_open = ~(f_commit & solo[first_pod])
+
+            # --- parallel phase: all active non-readers -----------------------
+            unresolved0 = active & ~reads & feasible & round_open
+            used0 = (cols == f_choice) & f_commit
+            commit0 = jnp.zeros(b, bool).at[first_pod].set(f_commit)
+            choice0 = jnp.zeros(b, jnp.int32).at[first_pod].set(
+                jnp.where(f_commit, f_choice, 0)
+            )
+
+            def pcond(c):
+                unresolved, _, _, _ = c
+                return jnp.any(unresolved)
+
+            def pbody(c):
+                unresolved, used, commit, choice = c
+                effm = jnp.where(used[None, :], -jnp.inf, eff)
+                prop = jnp.argmax(effm, axis=1).astype(jnp.int32)
+                take_nom = nom_ok & ~used[nom]
+                prop = jnp.where(take_nom, nom, prop)
+                has_bid = effm[jnp.arange(b), prop] > -jnp.inf
+                bidder = unresolved & has_bid
+                prop_oh = (prop[:, None] == cols[None, :]) & bidder[:, None]
+                minpos = jnp.min(
+                    jnp.where(prop_oh, pos_of[:, None], b), axis=0
+                )  # [N]
+                winpos = jnp.min(jnp.where(prop_oh, minpos[None, :], b), axis=1)
+                win = bidder & (winpos == pos_of)
+                commit = commit | win
+                choice = jnp.where(win, prop, choice)
+                used = used | jnp.any(prop_oh & win[:, None], axis=0)
+                # pods with no feasible unused node left drop out of the round
+                return unresolved & ~win & has_bid, used, commit, choice
+
+            _, _, commit, choice = jax.lax.while_loop(
+                pcond, pbody, (unresolved0, used0, commit0, choice0)
+            )
+            # non-readers that are infeasible resolve as unschedulable any
+            # round (their filters only shrink); readers only at first slot
+            # with exact state
+            unsched = (active & ~reads & ~feasible) | (
+                jnp.zeros(b, bool).at[first_pod].set(f_unsched)
+            )
+            return commit, choice, unsched
+
+        def apply_commits(dyn, dauxes, commit, choice):
+            """One batched state update for all of a round's commits.
+
+            Commutative per-pod contributions (resource adds, domain-table
+            bumps) sum over the committed set, so the whole round applies as
+            a few einsums against the commit-weighted node one-hot `u` —
+            no per-pod loop.  Plugins expose `update_batch`; any dynamic
+            plugin without one falls back to its serial `update` under a
+            fori_loop."""
+            u = (
+                (choice[:, None] == jnp.arange(n_cap)[None, :]) & commit[:, None]
+            ).astype(jnp.float32)  # [B, N]
+            req_add = jnp.einsum(
+                "bn,br->nr", u, batch.request.astype(jnp.float32)
+            )
+            nz_add = jnp.einsum(
+                "bn,br->nr", u, batch.non_zero.astype(jnp.float32)
+            )
+            new_dyn = DynamicState(
+                requested=dyn.requested + req_add.astype(dyn.requested.dtype),
+                non_zero=dyn.non_zero + nz_add.astype(dyn.non_zero.dtype),
+            )
+
+            new_auxes = []
+            slow = []  # plugins needing the serial fallback
+            for k, ((pw, _), aux) in enumerate(zip(dyn_plugins, dauxes)):
+                bfn = getattr(pw.plugin, "update_batch", None)
+                if bfn is not None and aux is not None:
+                    new_auxes.append(bfn(aux, commit, choice, u, batch, snap))
+                else:
+                    new_auxes.append(aux)
+                    if aux is not None and hasattr(pw.plugin, "update"):
+                        slow.append(k)
+            dauxes = tuple(new_auxes)
+            if slow:
+                def upd(j, dauxes):
+                    i = order[j]
+
+                    def app(dauxes):
+                        out = list(dauxes)
+                        for k in slow:
+                            pw, _ = dyn_plugins[k]
+                            out[k] = pw.plugin.update(
+                                dauxes[k], i, choice[i], batch, snap
+                            )
+                        return tuple(out)
+
+                    return jax.lax.cond(commit[i], app, lambda d: d, dauxes)
+
+                dauxes = jax.lax.fori_loop(0, b, upd, dauxes)
+            return new_dyn, dauxes
+
+        def cond(state):
+            _, _, _, active, _, _, rounds = state
+            return jnp.any(active) & (rounds <= b)
+
+        def body(state):
+            dyn, dauxes, assigned, active, unsched, feas_n, rounds = state
+            mask, scores = dense_rows(dyn, dauxes)
+            feasible = jnp.any(mask, axis=1)
+            commit, choice, new_unsched = auction_commits(
+                active, feasible, mask, scores
+            )
+            dyn, dauxes = apply_commits(dyn, dauxes, commit, choice)
+            resolved = commit | new_unsched
+            feas_n = jnp.where(
+                resolved & active, jnp.sum(mask, axis=1).astype(jnp.int32), feas_n
+            )
+            assigned = jnp.where(commit, choice, assigned)
+            active = active & ~resolved
+            unsched = unsched | new_unsched
+            return dyn, dauxes, assigned, active, unsched, feas_n, rounds + 1
+
+        init = (
+            dyn,
+            dyn_auxes,
+            jnp.full(b, -1, jnp.int32),
+            batch.valid,
+            jnp.zeros(b, bool),
+            jnp.zeros(b, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+        )
+        dyn, _, assigned, _, _, feas_n, _ = jax.lax.while_loop(cond, body, init)
+        return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn)
 
     def greedy_assign_dense(self, batch, snap, dyn, auxes, order, key=None) -> AssignResult:
         """Reference implementation: full [B, N] recompute per step (used by the

@@ -92,7 +92,9 @@ class NativeInterner:
         n = len(strings)
         if n == 0:
             return []
-        flat = b"\0".join(s.encode() for s in strings) + b"\0"
+        # single join+encode: marshalling cost would otherwise dominate the
+        # C++ win (strings are k8s names/labels — never contain NUL)
+        flat = ("\0".join(strings) + "\0").encode()
         out = np.empty(n, dtype=np.int32)
         self._lib.ktpu_intern_many(
             self._h, flat, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))

@@ -12,12 +12,16 @@
 // Concurrency: single-writer like the Python Dictionary (the scheduler's
 // event-ingest thread) — no locking on the hot path.
 
+#include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
 #include <string>
 #include <unordered_map>
 #include <vector>
+
+using std::nanf;
 
 namespace {
 
@@ -37,10 +41,17 @@ struct Interner {
         return id;
     }
 
+    // Mirrors state/dictionary.py _parse_numeric (Go strconv.Atoi shape):
+    // optional sign + ASCII digits only, int64 range. strtoll alone would
+    // also accept leading whitespace, which Python's regex rejects.
     static float parse_numeric(const std::string& s) {
         if (s.empty()) return nanf("");
-        char* end = nullptr;
+        size_t i = (s[0] == '+' || s[0] == '-') ? 1 : 0;
+        if (i == s.size()) return nanf("");
+        for (size_t j = i; j < s.size(); ++j)
+            if (s[j] < '0' || s[j] > '9') return nanf("");
         errno = 0;
+        char* end = nullptr;
         long long v = strtoll(s.c_str(), &end, 10);
         if (errno != 0 || end != s.c_str() + s.size()) return nanf("");
         return static_cast<float>(v);

@@ -1,0 +1,61 @@
+"""Segment/domain kernels as one-hot einsum contractions.
+
+The scheduling tensor programs keep per-domain count tables ``[..., D]``
+(domain = a (topologyKey, value) pair compacted by the encoder) and need two
+primitives over them:
+
+  * gather:  ``out[..., n] = table[..., dom[..., n]]``   (counts per node)
+  * scatter: ``table[..., dom[..., n]] += vals[..., n]`` (counts per domain)
+
+``jnp.take_along_axis`` / ``.at[].add`` express these directly but XLA lowers
+minor-axis element gathers/scatters to serial loops on TPU (~0.4 µs/element —
+100 ms for a [128, 2, 1024] lookup).  Contracting against a one-hot of the
+domain index instead runs on the MXU: the one-hot is [..., N, D] f32
+materialized on the fly (bandwidth-bound, ~bytes/800GB/s), and the lookup is
+a batched matvec.  Counts stay exact in f32 up to 2^24.
+
+These are the "segment-sum over dictionary-encoded topology keys" kernels
+SURVEY §2.5/§7.6 calls for; a hand-written Pallas version buys nothing over
+the single fused einsum XLA already emits, so this is the shipped form.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def domain_onehot(dom, depth: int, dtype=jnp.float32):
+    """``oh[..., n, d] = (dom[..., n] == d)`` — [..., N, D]."""
+    return (dom[..., None] == jnp.arange(depth)).astype(dtype)
+
+
+def domain_gather(table, dom, depth: int | None = None):
+    """``out[..., n] = table[..., dom[..., n]]`` without a TPU gather.
+
+    table: [..., D] (int or float); dom: int[..., N] with values in [0, D).
+    Returns f32[..., N] (exact for integer tables < 2^24).
+    """
+    d = depth if depth is not None else table.shape[-1]
+    oh = domain_onehot(dom, d)
+    return jnp.einsum("...d,...nd->...n", table.astype(jnp.float32), oh)
+
+
+def domain_scatter_add(vals, dom, depth: int):
+    """``out[..., d] = Σ_n vals[..., n] · (dom[..., n] == d)`` — [..., D]."""
+    oh = domain_onehot(dom, depth)
+    return jnp.einsum("...n,...nd->...d", vals.astype(jnp.float32), oh)
+
+
+def domain_any(mask, dom, depth: int):
+    """``out[..., d] = any_n(mask[..., n] & dom[..., n] == d)`` — bool[..., D]."""
+    return domain_scatter_add(mask, dom, depth) > 0.5
+
+
+def point_scatter_add(table, dom_at, inc):
+    """``table[..., dom_at[...]] += inc[...]`` for scalar-per-row indices.
+
+    table: [..., D]; dom_at: int[...]; inc: [...] — the in-scan table bump
+    (one placed pod touches one domain per constraint row).
+    """
+    oh = domain_onehot(dom_at[..., None], table.shape[-1])[..., 0, :]
+    return table + (inc[..., None] * oh).astype(table.dtype)

@@ -30,6 +30,7 @@ import numpy as np
 
 from ..api.labels import affinity_term_matches
 from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..ops import domain_gather, domain_scatter_add, point_scatter_add
 from ..framework.interface import MAX_NODE_SCORE, Plugin
 from ..state.dictionary import MISSING
 from .helpers import flat_selector_matrix
@@ -156,21 +157,21 @@ class InterPodAffinityPlugin(Plugin):
         return m & ns_ok & jnp.asarray(group.valid)[:, :, None]
 
     def _counts(self, match, dom, pod_node, pod_valid):
-        """Scatter per-term matches of scheduled pods into domain tables."""
+        """Per-term matches of scheduled pods → domain tables, as two
+        contractions: matches×(pod→node one-hot) gives per-node counts, then
+        a domain scatter-add folds nodes into domains (both MXU-friendly —
+        the per-(pod,term) gather this replaces serializes on TPU)."""
         d = self.domain_cap
         b, t, _p = match.shape
         n = dom.shape[-1]
         prow = jnp.clip(pod_node, 0, n - 1)
-        pod_dom = jnp.take_along_axis(
-            dom, jnp.broadcast_to(prow[None, None, :], match.shape), axis=-1
-        )  # [B, T, P] domain of each pod's node under term key
         ok = match & pod_valid[None, None, :] & (pod_node >= 0)[None, None, :]
-        tbl = jnp.zeros((b, t, d + 1), jnp.int32)
-        return tbl.at[
-            jnp.arange(b)[:, None, None],
-            jnp.arange(t)[None, :, None],
-            jnp.where(ok, pod_dom, d),
-        ].add(ok.astype(jnp.int32))
+        onehot = (
+            (prow[:, None] == jnp.arange(n)[None, :]) & (pod_node >= 0)[:, None]
+        ).astype(jnp.float32)  # [P, N]
+        count_node = jnp.einsum("btp,pn->btn", ok.astype(jnp.float32), onehot)
+        tbl = domain_scatter_add(count_node, dom, d + 1)  # trash slot at D absorbs
+        return tbl.astype(jnp.int32)
 
     def prepare(self, batch, snap, dyn, host_aux=None) -> IPAAux:
         d = self.domain_cap
@@ -245,7 +246,7 @@ class InterPodAffinityPlugin(Plugin):
         g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
 
         # incoming required affinity (satisfyPodAffinity, filtering.go:338-360)
-        cnt = jnp.take_along_axis(aux.aff_counts, aux.dom_aff, axis=-1)  # [B, T1, N]
+        cnt = domain_gather(aux.aff_counts, aux.dom_aff)  # [B, T1, N]
         key_ok = aux.dom_aff < d
         keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)  # [B, N]
         pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
@@ -253,7 +254,7 @@ class InterPodAffinityPlugin(Plugin):
         aff_ok = keys_all & (pods_exist | first_pod[:, None])
 
         # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
-        acnt = jnp.take_along_axis(aux.anti_counts, aux.dom_anti, axis=-1)
+        acnt = domain_gather(aux.anti_counts, aux.dom_anti)
         anti_bad = jnp.any(
             g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0), axis=1
         )
@@ -266,8 +267,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
-        c_paff = jnp.take_along_axis(aux.paff_counts, aux.dom_paff, axis=-1)  # [B,T3,N]
-        c_panti = jnp.take_along_axis(aux.panti_counts, aux.dom_panti, axis=-1)
+        c_paff = domain_gather(aux.paff_counts, aux.dom_paff)  # [B,T3,N]
+        c_panti = domain_gather(aux.panti_counts, aux.dom_panti)
         own = (
             jnp.sum(jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0), axis=1)
             - jnp.sum(jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0), axis=1)
@@ -293,13 +294,13 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
         anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
-        cnt = jnp.take_along_axis(aux.aff_counts[i], aux.dom_aff[i], axis=-1)  # [T1, N]
+        cnt = domain_gather(aux.aff_counts[i], aux.dom_aff[i])  # [T1, N]
         key_ok = aux.dom_aff[i] < d
         keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
         pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
         first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
         aff_ok = keys_all & (pods_exist | first_pod)
-        acnt = jnp.take_along_axis(aux.anti_counts[i], aux.dom_anti[i], axis=-1)
+        acnt = domain_gather(aux.anti_counts[i], aux.dom_anti[i])
         anti_bad = jnp.any(
             anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0), axis=0
         )
@@ -309,8 +310,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
-        c_paff = jnp.take_along_axis(aux.paff_counts[i], aux.dom_paff[i], axis=-1)
-        c_panti = jnp.take_along_axis(aux.panti_counts[i], aux.dom_panti[i], axis=-1)
+        c_paff = domain_gather(aux.paff_counts[i], aux.dom_paff[i])
+        c_panti = domain_gather(aux.panti_counts[i], aux.dom_panti[i])
         own = (
             jnp.sum(jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0), axis=0)
             - jnp.sum(jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0), axis=0)
@@ -333,17 +334,13 @@ class InterPodAffinityPlugin(Plugin):
             & jnp.asarray(batch.req_affinity.valid)
             & (dom_at_aff < d)
         ).astype(jnp.int32)
-        aff_counts = aux.aff_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(t1)[None, :], dom_at_aff
-        ].add(inc_aff)
+        aff_counts = point_scatter_add(aux.aff_counts, dom_at_aff, inc_aff)
         aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
 
         # 2) pending pods' antiAffinityCounts (their own terms vs placed pod i)
         dom_at_anti = aux.dom_anti[:, :, node_row]
         inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
-        anti_counts = aux.anti_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(t2)[None, :], dom_at_anti
-        ].add(inc_anti)
+        anti_counts = point_scatter_add(aux.anti_counts, dom_at_anti, inc_anti)
 
         # 3) placed pod i's own req-anti terms block domains for matching pods j
         #    (anti_cross[i] is [T2, B]: term t of pod i vs pending pod j)
@@ -358,13 +355,15 @@ class InterPodAffinityPlugin(Plugin):
         t3 = aux.dom_paff.shape[1]
         t4 = aux.dom_panti.shape[1]
         dom_at_paff = aux.dom_paff[:, :, node_row]
-        paff_counts = aux.paff_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(t3)[None, :], dom_at_paff
-        ].add((aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32))
+        paff_counts = point_scatter_add(
+            aux.paff_counts, dom_at_paff,
+            (aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32),
+        )
         dom_at_panti = aux.dom_panti[:, :, node_row]
-        panti_counts = aux.panti_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(t4)[None, :], dom_at_panti
-        ].add((aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32))
+        panti_counts = point_scatter_add(
+            aux.panti_counts, dom_at_panti,
+            (aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32),
+        )
 
         # 5) placed pod i's own terms add symmetric score for matching pods j:
         #    req-aff × hardWeight, pref-aff +w, pref-anti −w over i's term domains
@@ -379,6 +378,82 @@ class InterPodAffinityPlugin(Plugin):
         score_dyn = score_dyn + plane(aux.paff_cross[i], aux.dom_paff[i], w3)
         w4 = jnp.asarray(batch.pref_anti_affinity.weight)[i]
         score_dyn = score_dyn - plane(aux.panti_cross[i], aux.dom_panti[i], w4)
+
+        return aux._replace(
+            aff_counts=aff_counts, aff_total=aff_total, anti_counts=anti_counts,
+            block_dyn=block_dyn, paff_counts=paff_counts, panti_counts=panti_counts,
+            score_dyn=score_dyn,
+        )
+
+    def update_batch(self, aux: IPAAux, commit, choice, u, batch, snap):
+        """All of a round's placements at once (batch_assign): every per-pod
+        contribution in `update` is a commutative add/OR, so the whole round
+        folds into einsum contractions against the commit one-hot ``u``
+        [B, N] (placed pod i → its node)."""
+        d = self.domain_cap
+
+        def count_inc(cross, dom):
+            """cross [B, T, B] (term (b,t) vs pending pod i) → table bump
+            [B, T, D+1] from all committed pods, trash column zeroed (the
+            serial path never bumps trash)."""
+            contrib = jnp.einsum("bti,in->btn", cross.astype(jnp.float32), u)
+            tbl = domain_scatter_add(contrib, dom, d + 1)
+            return tbl * (jnp.arange(d + 1) < d)
+
+        g_aff_valid = jnp.asarray(batch.req_affinity.valid)
+        aff_cross = (
+            aux.aff_cross_all[:, None, :] & g_aff_valid[:, :, None]
+        )  # [B, T1, B]
+        aff_inc = count_inc(aff_cross, aux.dom_aff)
+        aff_counts = aux.aff_counts + aff_inc.astype(jnp.int32)
+        aff_total = aux.aff_total + jnp.sum(aff_inc, axis=(1, 2)).astype(jnp.int32)
+        anti_counts = aux.anti_counts + count_inc(
+            aux.anti_cross, aux.dom_anti
+        ).astype(jnp.int32)
+        paff_counts = aux.paff_counts + count_inc(
+            aux.paff_cross, aux.dom_paff
+        ).astype(jnp.int32)
+        panti_counts = aux.panti_counts + count_inc(
+            aux.panti_cross, aux.dom_panti
+        ).astype(jnp.int32)
+
+        def same_domains(dom):
+            """same[i, t, n] — node n shares committed pod i's domain under
+            i's term t (zero rows for uncommitted pods since u is zero)."""
+            dom_at = jnp.einsum("itn,in->it", dom.astype(jnp.float32), u)
+            return (
+                (dom.astype(jnp.float32) == dom_at[:, :, None])
+                & (dom < d)
+                & commit[:, None, None]
+            )
+
+        # placed pods' own req-anti terms block matching pods over their domains
+        same_anti = same_domains(aux.dom_anti)
+        block_add = (
+            jnp.einsum(
+                "itj,itn->jn",
+                aux.anti_cross.astype(jnp.float32),
+                same_anti.astype(jnp.float32),
+            )
+            > 0.5
+        )
+        block_dyn = aux.block_dyn | block_add
+
+        # symmetric score: placed pods' own terms credit matching pods
+        def plane(cross, dom, w):
+            same = same_domains(dom).astype(jnp.float32)
+            return jnp.einsum(
+                "itj,itn->jn", cross.astype(jnp.float32) * w, same
+            )
+
+        w1 = jnp.full(aux.dom_aff.shape[:2], self.hard_weight, jnp.float32)[
+            :, :, None
+        ]
+        score_dyn = aux.score_dyn + plane(aux.aff_term_cross, aux.dom_aff, w1)
+        w3 = jnp.asarray(batch.pref_affinity.weight)[:, :, None]
+        score_dyn = score_dyn + plane(aux.paff_cross, aux.dom_paff, w3)
+        w4 = jnp.asarray(batch.pref_anti_affinity.weight)[:, :, None]
+        score_dyn = score_dyn - plane(aux.panti_cross, aux.dom_panti, w4)
 
         return aux._replace(
             aff_counts=aff_counts, aff_total=aff_total, anti_counts=anti_counts,

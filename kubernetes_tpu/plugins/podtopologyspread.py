@@ -27,11 +27,16 @@ import jax.numpy as jnp
 from ..framework.events import ActionType, ClusterEvent, EventResource
 from ..framework.interface import MAX_NODE_SCORE, Plugin
 from ..framework.podbatch import WHEN_DO_NOT_SCHEDULE, WHEN_SCHEDULE_ANYWAY
+from ..ops import domain_any, domain_gather, domain_scatter_add, point_scatter_add
 from ..state.dictionary import MISSING
 from ..state.selectors import eval_label_selector
 from .helpers import label_selector_matrix, node_selector_matrix
 
-BIG = jnp.asarray(2**30, dtype=jnp.int32)
+# plain Python int, NOT a module-level device array: a concrete jax.Array
+# captured as a jit closure constant permanently degrades every subsequent
+# host sync to ~100 ms through the axon TPU tunnel (measured; see
+# tests/test_ops.py microbench + memory note axon-closure-constant-poison)
+BIG = 2**30
 
 
 class TSAux(NamedTuple):
@@ -109,24 +114,12 @@ class PodTopologySpreadPlugin(Plugin):
 
         def scatter(count_mask, node_mask):
             vals = jnp.where(node_mask[:, None, :], count_mask, 0)  # [B, C, N]
-            tbl = jnp.zeros((b, c_cap, d + 1), jnp.int32)
-            tbl = tbl.at[
-                jnp.arange(b)[:, None, None],
-                jnp.arange(c_cap)[None, :, None],
-                dom_val,
-            ].add(jnp.where(node_mask[:, None, :], vals, 0))
-            return tbl
+            return domain_scatter_add(vals, dom_val, d + 1).astype(jnp.int32)
 
         hard_counts = scatter(count_node, counted_hard)
         soft_counts = scatter(count_node, counted_soft)
-        hard_present = (
-            jnp.zeros((b, c_cap, d + 1), bool)
-            .at[
-                jnp.arange(b)[:, None, None],
-                jnp.arange(c_cap)[None, :, None],
-                dom_val,
-            ]
-            .max(counted_hard[:, None, :] & (dom_val < d))
+        hard_present = domain_any(
+            counted_hard[:, None, :] & (dom_val < d), dom_val, d + 1
         )
 
         # constraint selectors vs PENDING pods (same-namespace check applies both
@@ -177,9 +170,7 @@ class PodTopologySpreadPlugin(Plugin):
             min_match = jnp.where(
                 (aux.min_domains > 0) & (num_domains < aux.min_domains), 0, min_match
             )
-        match_num = jnp.take_along_axis(
-            aux.hard_counts, aux.dom_val, axis=-1
-        )  # [B, C, N]
+        match_num = domain_gather(aux.hard_counts, aux.dom_val).astype(jnp.int32)  # [B, C, N]
         skew = match_num + aux.self_match[:, :, None].astype(jnp.int32) - min_match[:, :, None]
         ok_c = skew <= aux.max_skew[:, :, None]
         ok = jnp.all(~aux.hard_valid[:, :, None] | (ok_c & aux.has_key), axis=1)
@@ -196,19 +187,13 @@ class PodTopologySpreadPlugin(Plugin):
         ignored = ~jnp.all(~aux.soft_valid[:, :, None] | aux.has_key, axis=1)  # [B,N]
         scored = mask & ~ignored  # [B, N]
         b, c_cap, _ = aux.dom_val.shape
-        soft_present = (
-            jnp.zeros(aux.soft_counts.shape, bool)
-            .at[
-                jnp.arange(b)[:, None, None],
-                jnp.arange(c_cap)[None, :, None],
-                aux.dom_val,
-            ]
-            .max(scored[:, None, :] & (aux.dom_val < d))
+        soft_present = domain_any(
+            scored[:, None, :] & (aux.dom_val < d), aux.dom_val, d + 1
         )
         topo_size = jnp.sum(soft_present[..., :d], axis=-1)  # [B, C]
         tp_weight = jnp.log(topo_size.astype(jnp.float32) + 2.0)
-        counts = jnp.take_along_axis(aux.soft_counts, aux.dom_val, axis=-1)  # [B,C,N]
-        in_present = jnp.take_along_axis(soft_present, aux.dom_val, axis=-1)
+        counts = domain_gather(aux.soft_counts, aux.dom_val)  # [B,C,N]
+        in_present = domain_gather(soft_present, aux.dom_val) > 0.5
         per_c = (
             counts.astype(jnp.float32) * tp_weight[:, :, None]
             + (aux.max_skew[:, :, None].astype(jnp.float32) - 1.0)
@@ -250,7 +235,7 @@ class PodTopologySpreadPlugin(Plugin):
             ndom = jnp.sum(present, axis=-1)
             md = aux.min_domains[i]
             min_match = jnp.where((md > 0) & (ndom < md), 0, min_match)
-        match_num = jnp.take_along_axis(counts, dom, axis=-1)  # [C, N]
+        match_num = domain_gather(counts, dom).astype(jnp.int32)  # [C, N]
         skew = (
             match_num + aux.self_match[i][:, None].astype(jnp.int32)
             - min_match[:, None]
@@ -269,15 +254,11 @@ class PodTopologySpreadPlugin(Plugin):
         ignored = ~jnp.all(~soft_valid[:, None] | has_key, axis=0)  # [N]
         scored = mask_row & ~ignored
         c_cap = dom.shape[0]
-        soft_present = (
-            jnp.zeros(counts.shape, bool)
-            .at[jnp.arange(c_cap)[:, None], dom]
-            .max(scored[None, :] & (dom < d))
-        )
+        soft_present = domain_any(scored[None, :] & (dom < d), dom, counts.shape[-1])
         topo_size = jnp.sum(soft_present[:, :d], axis=-1)  # [C]
         tp_weight = jnp.log(topo_size.astype(jnp.float32) + 2.0)
-        cnt = jnp.take_along_axis(counts, dom, axis=-1)  # [C, N]
-        in_present = jnp.take_along_axis(soft_present, dom, axis=-1)
+        cnt = domain_gather(counts, dom)  # [C, N]
+        in_present = domain_gather(soft_present, dom) > 0.5
         per_c = (
             cnt.astype(jnp.float32) * tp_weight[:, None]
             + (aux.max_skew[i][:, None].astype(jnp.float32) - 1.0)
@@ -302,14 +283,32 @@ class PodTopologySpreadPlugin(Plugin):
             aux.match_pending[:, :, i]
             & aux.counted_hard[:, node_row][:, None]
         ).astype(jnp.int32)  # [B, C]
-        hard_counts = aux.hard_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(c_cap)[None, :], dom_at
-        ].add(inc)
+        hard_counts = point_scatter_add(aux.hard_counts, dom_at, inc)
         inc_soft = (
             aux.match_pending[:, :, i]
             & aux.counted_soft[:, node_row][:, None]
         ).astype(jnp.int32)
-        soft_counts = aux.soft_counts.at[
-            jnp.arange(b)[:, None], jnp.arange(c_cap)[None, :], dom_at
-        ].add(inc_soft)
+        soft_counts = point_scatter_add(aux.soft_counts, dom_at, inc_soft)
         return aux._replace(hard_counts=hard_counts, soft_counts=soft_counts)
+
+    def update_batch(self, aux: TSAux, commit, choice, u, batch, snap):
+        """All of a round's placements at once (batch_assign):
+        contributions are commutative scatter-adds, so the per-pod update
+        folds into two einsums against the commit one-hot ``u`` [B, N]."""
+        d = self.domain_cap
+        # pending-pod j's table (b, c) gains at the domain of each committed
+        # pod i's node, where i matches (b, c)'s selector and the node counts
+        contrib = jnp.einsum(
+            "bci,in->bcn", aux.match_pending.astype(jnp.float32), u
+        )  # [B, C, N]
+        hard_inc = domain_scatter_add(
+            contrib * aux.counted_hard[:, None, :], aux.dom_val, d + 1
+        )
+        soft_inc = domain_scatter_add(
+            contrib * aux.counted_soft[:, None, :], aux.dom_val, d + 1
+        )
+        return aux._replace(
+            hard_counts=aux.hard_counts + hard_inc.astype(jnp.int32),
+            soft_counts=aux.soft_counts + soft_inc.astype(jnp.int32),
+        )
+

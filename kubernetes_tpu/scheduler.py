@@ -86,7 +86,16 @@ class TPUScheduler:
         namespace_labels: Optional[Dict[str, Dict[str, str]]] = None,
         rng_key=None,
         extenders: Optional[List] = None,
+        assign_mode: str = "auto",
+        coupled_fraction_threshold: float = 0.25,
     ):
+        if assign_mode not in ("auto", "scan", "batch"):
+            raise ValueError(f"unknown assign_mode {assign_mode!r}")
+        # "scan" = exact greedy-sequential lax.scan; "batch" = round-based
+        # parallel prefix commits (framework/runtime.py batch_assign); "auto"
+        # uses batch unless the coupled fraction exceeds the threshold
+        self.assign_mode = assign_mode
+        self.coupled_fraction_threshold = coupled_fraction_threshold
         self.store = store
         self.clock = clock
         self.batch_size = batch_size
@@ -218,6 +227,7 @@ class TPUScheduler:
             self._jitted = {
                 "prepare": jax.jit(self._fw.prepare),
                 "greedy": jax.jit(self._fw.greedy_assign),
+                "batch": jax.jit(self._fw.batch_assign),
                 "compute": jax.jit(self._fw.compute),
             }
         return self._fw
@@ -254,9 +264,7 @@ class TPUScheduler:
                 batch, dsnap, dyn, auxes, pods, t0
             )
         else:
-            res = self._jitted["greedy"](
-                batch, dsnap, dyn, auxes, jnp.arange(batch.size), self.rng_key
-            )
+            res = self._run_assignment(batch, dsnap, dyn, auxes)
             node_row = np.asarray(res.node_row)
             algo_lat = np.full(len(infos), self.clock() - t0)
             # one algorithm invocation for the whole batch → one sample
@@ -303,6 +311,28 @@ class TPUScheduler:
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
         return stats
+
+    def _run_assignment(self, batch, dsnap, dyn, auxes):
+        """Dispatch between the parallel batch engine and the exact serial
+        scan (the parity oracle).  "auto" uses the batch engine unless too
+        much of the batch is cross-pod coupled — a mostly-anti-affinity batch
+        serializes into one commit per round there, and the row-sliced scan
+        is cheaper per step than the dense per-round recompute."""
+        from .framework.runtime import coupling_flags
+
+        order = jnp.arange(batch.size)
+        mode = self.assign_mode
+        if mode in ("auto", "batch"):
+            coupling = coupling_flags(batch)
+            n_valid = max(int(batch.valid.sum()), 1)
+            frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
+            if mode == "batch" or frac <= self.coupled_fraction_threshold:
+                return self._jitted["batch"](
+                    batch, dsnap, dyn, auxes, order, coupling, self.rng_key
+                )
+        return self._jitted["greedy"](
+            batch, dsnap, dyn, auxes, order, self.rng_key
+        )
 
     def _assign_with_extenders(
         self, batch, dsnap, dyn, auxes, pods, t0: float

@@ -36,8 +36,24 @@ ID_UNSCHEDULABLE_TAINT = 3
 ID_ZONE = 4
 ID_REGION = 5
 
+_INT_RE = __import__("re").compile(r"^[+-]?[0-9]+$")
+_INT64_MAX = 2**63 - 1
 
-class Dictionary:
+
+def _parse_numeric(s: str) -> float:
+    """Numeric side-table semantics = Go strconv.Atoi (the reference parses
+    Gt/Lt operands with it, nodeaffinity): ASCII digits with optional sign,
+    no underscores/whitespace, int64 range.  Keeps PyDictionary and the C++
+    interner (strtoll with the same checks) bit-identical across hosts."""
+    if not _INT_RE.match(s):
+        return math.nan
+    v = int(s)
+    if v > _INT64_MAX or v < -_INT64_MAX - 1:
+        return math.nan
+    return float(v)
+
+
+class PyDictionary:
     """Append-only string interner. Thread-compatible with the scheduler's single
     event-ingest thread (mirrors the single-writer discipline of the reference's
     scheduler cache, internal/cache/cache.go:62)."""
@@ -59,15 +75,15 @@ class Dictionary:
         i = len(self._to_str)
         self._to_id[s] = i
         self._to_str.append(s)
-        try:
-            self._numeric.append(float(int(s)))
-        except ValueError:
-            self._numeric.append(math.nan)
+        self._numeric.append(_parse_numeric(s))
         return i
 
     def lookup(self, s: str) -> int:
         """Id of s, or MISSING if never interned (read-only: does not grow)."""
         return self._to_id.get(s, MISSING)
+
+    def intern_many(self, strings) -> List[int]:
+        return [self.intern(s) for s in strings]
 
     def string(self, i: int) -> str:
         return self._to_str[i]
@@ -79,3 +95,64 @@ class Dictionary:
         if self._numeric:
             t[: len(self._numeric)] = np.asarray(self._numeric, dtype=np.float32)
         return t
+
+
+class NativeDictionary:
+    """Dictionary backed by the C++ interner (native/interner.cpp).
+
+    Same contract as PyDictionary — sequential int32 ids from 0, MISSING on
+    failed lookup, integer side-table — but the per-string hot loop runs in
+    C++ (SURVEY §2.4: the host feeder's innermost loop).  Constructed only
+    when the shared library is available; see the Dictionary() factory.
+    """
+
+    def __init__(self, native_interner):
+        self._impl = native_interner
+        for s in WELL_KNOWN:
+            self.intern(s)
+
+    def __len__(self) -> int:
+        return len(self._impl)
+
+    def intern(self, s: str) -> int:
+        return self._impl.intern(s)
+
+    def intern_many(self, strings) -> List[int]:
+        return self._impl.intern_many(strings)
+
+    def lookup(self, s: str) -> int:
+        i = self._impl.lookup(s)
+        return i if i >= 0 else MISSING
+
+    def string(self, i: int) -> str:
+        return self._impl.string(i)
+
+    def numeric_table(self, min_size: int = 1) -> np.ndarray:
+        return self._impl.numeric_table(min_size)
+
+
+def Dictionary(native: "bool | None" = None):
+    """Build an interner.  Default is the Python dict: measured on this
+    workload, per-call ctypes overhead makes single-string interning ~10x
+    slower through the C ABI than a dict hit, and the hot path interns one
+    string at a time; the C++ backend only wins on the batched
+    ``intern_many`` entry point (1.7x, tests/test_dictionary.py microbench).
+    Set KTPU_NATIVE_INTERNER=1 (or native=True, which raises if the build
+    fails) to opt in for ingest paths that batch their interning.
+    """
+    import os
+
+    if native is None:
+        native = os.environ.get("KTPU_NATIVE_INTERNER", "0") == "1"
+        forced = False
+    else:
+        forced = native
+    if native:
+        from ..native import NativeInterner, load_interner
+
+        lib = load_interner()
+        if lib is not None:
+            return NativeDictionary(NativeInterner(lib))
+        if forced:
+            raise RuntimeError("native interner requested but g++ build failed")
+    return PyDictionary()

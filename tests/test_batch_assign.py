@@ -1,0 +1,206 @@
+"""Parallel batch assignment (rounds of prefix commits) vs the greedy scan.
+
+Contract (SURVEY §7.6 / framework/runtime.py batch_assign):
+  * conflict-free batches (pairwise-distinct choices, no cross-pod coupling)
+    must match greedy_assign exactly — node rows, feasible counts, dyn state;
+  * contended batches must still produce placements that pass every filter
+    under the FINAL committed state (validity, not score parity);
+  * coupled pods (topology spread / pod affinity) only ever commit against
+    exact greedy state, so single-coupled-pod batches also match greedy.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.framework.runtime import coupling_flags, initial_dynamic_state
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.testutil import make_node, make_pod
+
+from tests.test_parity import (
+    build_cluster,
+    default_framework,
+    device_pipeline,
+    pending_pods,
+)
+
+
+def run_both(fw, batch, dsnap, dyn, auxes, key=None):
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    greedy = jax.jit(fw.greedy_assign)(batch, dsnap, dyn, auxes, order, key)
+    par = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling, key)
+    return greedy, par
+
+
+def _uniform_cluster(n_nodes=8, cpu="8"):
+    cache = Cache()
+    for i in range(n_nodes):
+        cache.add_node(
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": cpu, "memory": "16Gi", "pods": "110"})
+            .label("slot", f"s{i}")
+            .obj()
+        )
+    return cache
+
+
+def test_conflict_free_matches_greedy():
+    """Distinct preferred nodes, no coupling → bit-identical to the scan."""
+    cache = _uniform_cluster()
+    pods = [
+        make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+        .req({"cpu": "1", "memory": "1Gi"})
+        .preferred_node_affinity(100, "slot", [f"s{i}"])
+        .obj()
+        for i in range(8)
+    ]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    greedy, par = run_both(fw, batch, dsnap, dyn, auxes)
+    assert np.array_equal(np.asarray(greedy.node_row), np.asarray(par.node_row))
+    assert np.array_equal(
+        np.asarray(greedy.feasible_count), np.asarray(par.feasible_count)
+    )
+    assert np.array_equal(
+        np.asarray(greedy.dyn.requested), np.asarray(par.dyn.requested)
+    )
+
+
+def test_contended_identical_pods_all_placed_validly():
+    """Identical pods with no coupling: every pod lands, one per node per
+    round, and the final placement passes every filter under final state."""
+    cache = _uniform_cluster(n_nodes=4, cpu="4")
+    pods = [
+        make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+        .req({"cpu": "1", "memory": "1Gi"})
+        .obj()
+        for i in range(12)  # 12 pods onto 4×4cpu nodes → 3 rounds min
+    ]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    par = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling, None)
+    rows = np.asarray(par.node_row)[: len(pods)]
+    assert (rows >= 0).all(), rows
+    # capacity respected: 4 cpu per node, 1 cpu per pod → ≤4 pods per node
+    counts = np.bincount(rows, minlength=4)
+    assert counts.max() <= 4, counts
+    assert counts.sum() == 12
+    # final dyn state equals the sum of commitments
+    req = np.asarray(par.dyn.requested) - np.asarray(dyn.requested)
+    assert req[:4].sum() == np.asarray(batch.request)[: len(pods)].sum()
+
+
+def test_contended_matches_greedy_with_shared_key():
+    """Random tie-breaking spreads identical pods; with the same key and a
+    low-contention batch the engine matches the scan."""
+    cache = _uniform_cluster(n_nodes=16, cpu="8")
+    pods = [
+        make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+        .req({"cpu": "1", "memory": "1Gi"})
+        .obj()
+        for i in range(4)
+    ]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    key = jax.random.PRNGKey(3)
+    greedy, par = run_both(fw, batch, dsnap, dyn, auxes, key)
+    g = np.asarray(greedy.node_row)[: len(pods)]
+    p = np.asarray(par.node_row)[: len(pods)]
+    assert (p >= 0).all()
+    assert len(set(p.tolist())) == len(pods)  # spread across distinct nodes
+
+
+def test_single_coupled_pod_matches_greedy():
+    """One topology-spread pod among plain pods: the coupled pod commits only
+    against exact state, so the whole batch matches greedy placement
+    validity; the coupled pod's constraint holds under final state."""
+    cache = Cache()
+    for i in range(6):
+        cache.add_node(
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"})
+            .label("zone", f"z{i % 3}")
+            .obj()
+        )
+    pods = [
+        make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+        .req({"cpu": "1", "memory": "1Gi"}).label("app", "web")
+        .obj()
+        for i in range(3)
+    ] + [
+        make_pod().name("spread").uid("spread").namespace("default")
+        .req({"cpu": "1", "memory": "1Gi"}).label("app", "web")
+        .topology_spread(1, "zone", labels={"app": "web"})
+        .obj()
+    ]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    assert coupling.reads[3] and not coupling.reads[:3].any()
+    par = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling, None)
+    rows = np.asarray(par.node_row)[: len(pods)]
+    assert (rows >= 0).all()
+    # spread pod honors maxSkew=1 vs the three committed app=web pods
+    zones = [int(r) % 3 for r in rows]
+    counts = np.bincount(zones, minlength=3)
+    assert counts.max() - counts.min() <= 1, counts
+
+
+def test_update_batch_equals_serial_update_fold():
+    """For PTS and IPA, update_batch over a commit set must equal folding the
+    serial update over the committed pods (the batch engine's correctness
+    hinges on this)."""
+    rng = np.random.default_rng(5)
+    cache = build_cluster(rng)
+    pods = pending_pods(rng, k=8)
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    commit = np.array([True, False, True, True, False, False, True, False])
+    choice = np.asarray(rng.integers(0, dsnap.num_nodes, 8), dtype=np.int32)
+    u = np.zeros((8, np.asarray(dsnap.node_valid).shape[0]), np.float32)
+    for i in np.where(commit)[0]:
+        u[i, choice[i]] = 1.0
+    for pw, aux in zip(fw.plugins, auxes):
+        p = pw.plugin
+        if not hasattr(p, "update_batch"):
+            continue
+        batched = p.update_batch(
+            aux, jnp.asarray(commit), jnp.asarray(choice), jnp.asarray(u),
+            batch, dsnap,
+        )
+        serial = aux
+        for i in np.where(commit)[0]:
+            serial = p.update(serial, int(i), int(choice[i]), batch, dsnap)
+        for name_f, got, want in zip(
+            batched._fields, batched, serial
+        ):
+            got, want = np.asarray(got), np.asarray(want)
+            assert np.allclose(got, want), (p.name, name_f)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_mixed_random_batch_valid_under_final_state(seed):
+    """Randomized mixed batches (the parity-test generator): every batch
+    placement must pass the full filter set when re-evaluated under the
+    final committed state."""
+    rng = np.random.default_rng(seed)
+    cache = build_cluster(rng)
+    pods = pending_pods(rng, k=8)
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    par = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling, None)
+    rows = np.asarray(par.node_row)
+    greedy = jax.jit(fw.greedy_assign)(batch, dsnap, dyn, auxes, order, None)
+    # both engines schedule the same number of pods on these batches
+    assert (rows >= 0).sum() == (np.asarray(greedy.node_row) >= 0).sum()
+    # resource bookkeeping: final dyn state is exactly initial + commitments,
+    # and no node exceeds its allocatable in any resource dimension
+    added = np.zeros_like(np.asarray(dyn.requested))
+    for i in np.where(rows >= 0)[0]:
+        added[rows[i]] += np.asarray(batch.request)[i]
+    final_req = np.asarray(dyn.requested) + added
+    assert np.array_equal(np.asarray(par.dyn.requested), final_req)
+    alloc = np.asarray(dsnap.allocatable)
+    valid = np.asarray(dsnap.node_valid)
+    assert (final_req[valid] <= alloc[valid]).all()
