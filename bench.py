@@ -1,16 +1,31 @@
-"""Benchmark: batched device scheduling vs sequential reference-semantics oracle.
+"""North-star benchmark: per-attempt p99 scheduling latency at 5k nodes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Metric: scheduling throughput (pods/s) of the device path on a synthetic
-cluster (default 1024 nodes, 2k running pods, batches of 128 pending pods with
-mixed constraints).  vs_baseline: speedup over the host oracle — a faithful
-sequential reimplementation of the reference's per-(pod,node) algorithm
-(kubernetes_tpu/oracle.py) measured on the same cluster, i.e. the
-single-process stand-in for the default scheduler's scheduling-algorithm cost
-(scheduler_scheduling_algorithm_duration, metrics.go:70).
+Workload (BASELINE.md primary metric): the NorthStar config — 5000 nodes,
+2000 pre-scheduled pods, then 10000 pending pods scheduled to completion
+through the full scheduler (queue → snapshot sync → device filter/score →
+assignment → reserve/permit/bind), recording TRUE per-attempt
+`scheduler_scheduling_attempt_duration_seconds` (each pod's attempt spans
+its device program + its own host binding segment — not a batch average)
+and end-to-end SchedulingThroughput.
+
+Honest baseline framing: `vs_baseline` is the mean per-pod scheduling-
+algorithm time of kubernetes_tpu/oracle.py — a faithful *Python*
+reimplementation of the reference algorithm on the same cluster — divided
+by the device path's mean per-pod time.  It is NOT a measurement of the Go
+default scheduler (16-way parallel, adaptive sampling, compiled); treat it
+as "vs sequential reference semantics in this process", and compare the
+absolute p50/p99 against the reference's published envelope instead.
+
+Env knobs: BENCH_SUITE/BENCH_SIZE pick any named suite from
+kubernetes_tpu/perf/workloads.py (default NorthStar/5000Nodes/10000Pods);
+BENCH_SCALE shrinks it; BENCH_ORACLE_SAMPLE sets oracle sample size;
+BENCH_ALL=1 additionally runs the reference's 500-node suites and writes
+perf-dashboard JSON to perf_dashboard.json.
 """
 
+import copy
 import json
 import os
 import sys
@@ -18,115 +33,106 @@ import time
 
 os.environ.setdefault("XLA_FLAGS", "")
 
-import numpy as np
+
+def run_named(suite: str, size: str, scale: float):
+    from kubernetes_tpu.perf.harness import run_workload
+    from kubernetes_tpu.perf.workloads import build_workload
+
+    w = build_workload(suite, size, scale=scale)
+    t0 = time.perf_counter()
+    items = run_workload(w)
+    wall = time.perf_counter() - t0
+    return w, {i.labels["Metric"]: i.data for i in items}, wall
 
 
-def build(n_nodes, n_sched, n_pending, seed=0):
-    from kubernetes_tpu.testutil import make_node, make_pod
+def oracle_per_pod_ms(n_nodes: int, sample: int) -> float:
+    """Mean per-pod algorithm time of the sequential Python oracle on a
+    fresh same-shape cluster (cloned state, unit-exact quantities)."""
+    from kubernetes_tpu.oracle import Oracle
+    from kubernetes_tpu.perf.workloads import node_default, pod_default
     from kubernetes_tpu.state.cache import Cache, Snapshot
-    from kubernetes_tpu.state.encoding import ClusterEncoder
-    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
-    from kubernetes_tpu.framework.runtime import BatchedFramework, initial_dynamic_state
-    from kubernetes_tpu.scheduler import default_plugins
 
-    rng = np.random.default_rng(seed)
     cache = Cache()
     for i in range(n_nodes):
-        cache.add_node(
-            make_node().name(f"n{i:05d}")
-            .capacity({"cpu": "64", "memory": "256Gi", "pods": "256"})
-            .label("topology.kubernetes.io/zone", f"z{i % 16}")
-            .label("disk", "ssd" if i % 2 else "hdd")
-            .obj()
-        )
-    for i in range(n_sched):
-        cache.add_pod(
-            make_pod().name(f"sp{i}").uid(f"sp{i}").namespace("default")
-            .label("app", ["web", "db", "cache"][i % 3])
-            .req({"cpu": "1", "memory": "1Gi"})
-            .node(f"n{int(rng.integers(n_nodes)):05d}")
-            .obj()
-        )
+        cache.add_node(node_default(i))
     snap = Snapshot()
     cache.update_snapshot(snap)
-    enc = ClusterEncoder()
-    comp = PodBatchCompiler(enc)
-    pods = []
-    for i in range(n_pending):
-        w = (make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
-             .req({"cpu": "1", "memory": "2Gi"}).label("app", "web"))
-        if i % 4 == 1:
-            w = w.topology_spread(2, "topology.kubernetes.io/zone", labels={"app": "web"})
-        if i % 4 == 2:
-            w = w.preferred_node_affinity(10, "disk", ["ssd"])
-        if i % 4 == 3:
-            w = w.toleration("flaky", "", "")
-        pods.append(w.obj())
-    batch = comp.compile(pods)
-    enc.full_sync(snap)
-    fw = BatchedFramework(default_plugins(enc.domain_cap))
-    host_auxes = fw.host_prepare(batch, snap, enc)
-    dsnap = enc.to_device()
-    dyn = initial_dynamic_state(dsnap)
-    return fw, batch, snap, dsnap, dyn, host_auxes, pods
+    infos = [ni.clone() for ni in snap.node_info_list]
+    pods = [copy.deepcopy(pod_default(i)) for i in range(sample)]
+    o = Oracle()
+    t0 = time.perf_counter()
+    o.schedule_batch(pods, infos)
+    return (time.perf_counter() - t0) / max(sample, 1) * 1e3
 
 
 def main():
     import jax
-    import jax.numpy as jnp
-    from kubernetes_tpu.oracle import Oracle
 
-    n_nodes = int(os.environ.get("BENCH_NODES", 1024))
-    n_sched = int(os.environ.get("BENCH_SCHEDULED", 2048))
-    n_pending = int(os.environ.get("BENCH_PENDING", 128))
-    oracle_sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", 8))
+    suite = os.environ.get("BENCH_SUITE", "NorthStar")
+    size = os.environ.get("BENCH_SIZE", "5000Nodes/10000Pods")
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", "4"))
 
-    fw, batch, snap, dsnap, dyn, host_auxes, pods = build(n_nodes, n_sched, n_pending)
+    w, data, wall = run_named(suite, size, scale)
+    att = data["scheduler_scheduling_attempt_duration_seconds"]
+    thr = data["SchedulingThroughput"]["Average"]
 
-    def full_step(batch, dsnap, dyn, host_auxes, order):
-        auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-        return fw.greedy_assign(batch, dsnap, dyn, auxes, order)
+    from kubernetes_tpu.perf.workloads import SUITES
 
-    step = jax.jit(full_step)
-    order = jnp.arange(batch.size)
-    res = step(batch, dsnap, dyn, host_auxes, order)  # compile
-    jax.block_until_ready(res.node_row)
-
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        res = step(batch, dsnap, dyn, host_auxes, order)
-        jax.block_until_ready(res.node_row)
-    device_s = (time.perf_counter() - t0) / reps
-    assigned = int((np.asarray(res.node_row) >= 0).sum())
-    pods_per_s = n_pending / device_s
-
-    # oracle baseline: sequential reference semantics on the same cluster
-    oracle = Oracle()
-    infos = [ni.clone() for ni in snap.node_info_list]
-    import copy
-
-    sample = [copy.deepcopy(p) for p in pods[:oracle_sample]]
-    t0 = time.perf_counter()
-    oracle.schedule_batch(sample, infos)
-    oracle_per_pod = (time.perf_counter() - t0) / max(len(sample), 1)
-    device_per_pod = device_s / n_pending
-    speedup = oracle_per_pod / device_per_pod if device_per_pod > 0 else 0.0
+    n_nodes, _, mp = SUITES[suite].sizes[size]
+    n_nodes = max(4, int(n_nodes * scale))
+    mp = max(2, int(mp * scale))
+    o_ms = oracle_per_pod_ms(n_nodes, sample)
+    mean_s = att["Average"]
+    speedup = (o_ms / 1e3) / mean_s if mean_s > 0 else 0.0
 
     print(json.dumps({
-        "metric": "scheduling_throughput",
-        "value": round(pods_per_s, 1),
-        "unit": "pods/s",
+        "metric": "scheduling_attempt_p99",
+        "value": round(att["Perc99"] * 1e3, 3),
+        "unit": "ms",
         "vs_baseline": round(speedup, 1),
         "detail": {
-            "nodes": n_nodes, "scheduled_pods": n_sched, "batch": n_pending,
-            "assigned": assigned,
-            "device_batch_ms": round(device_s * 1000, 2),
-            "device_per_pod_us": round(device_per_pod * 1e6, 1),
-            "oracle_per_pod_ms": round(oracle_per_pod * 1000, 2),
+            "workload": w.name,
+            "nodes": n_nodes,
+            "measure_pods": mp,
+            "throughput_pods_per_s": thr,
+            "attempt_ms": {
+                "p50": round(att["Perc50"] * 1e3, 3),
+                "p90": round(att["Perc90"] * 1e3, 3),
+                "p99": round(att["Perc99"] * 1e3, 3),
+                "mean": round(att["Average"] * 1e3, 3),
+            },
+            "wall_s": round(wall, 1),
+            "baseline_note": (
+                "vs_baseline = mean per-pod algorithm time of the in-repo "
+                "sequential PYTHON oracle (reference semantics, not the Go "
+                "scheduler) / device-path mean per-attempt"
+            ),
+            "oracle_per_pod_ms": round(o_ms, 2),
             "backend": jax.default_backend(),
         },
     }))
+
+    if os.environ.get("BENCH_ALL") == "1":
+        from kubernetes_tpu.perf.harness import data_items_to_json, run_workload
+        from kubernetes_tpu.perf.workloads import build_workload
+
+        all_items = []
+        for s, sz in [
+            ("SchedulingBasic", "500Nodes"),
+            ("SchedulingPodAntiAffinity", "500Nodes"),
+            ("SchedulingPodAffinity", "500Nodes"),
+            ("TopologySpreading", "500Nodes"),
+            ("PreemptionBasic", "500Nodes"),
+            ("Unschedulable", "500Nodes/200InitPods"),
+            ("SchedulingWithMixedChurn", "1000Nodes"),
+        ]:
+            wl = build_workload(s, sz, scale=scale)
+            all_items.extend(run_workload(wl))
+        with open("perf_dashboard.json", "w") as f:
+            f.write(data_items_to_json(all_items))
+        print(f"wrote perf_dashboard.json ({len(all_items)} data items)",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -66,6 +66,14 @@ class Histogram(Metric):
             self._sum[labels] = self._sum.get(labels, 0.0) + v
             self._n[labels] = self._n.get(labels, 0) + 1
 
+    def reset(self):
+        """Clear observations in place (measured-window deltas,
+        scheduler_perf util.go:238-276 collects over a window)."""
+        with self._lock:
+            self._counts.clear()
+            self._sum.clear()
+            self._n.clear()
+
     def count(self, labels: Tuple = ()) -> int:
         return self._n.get(labels, 0)
 

@@ -38,6 +38,10 @@ class Op:
     pod_template: Optional[Callable[[int], v1.Pod]] = None
     collect_metrics: bool = False
     churn_deletes: int = 0
+    # createPods only: don't drive the scheduler to completion afterwards
+    # (scheduler_perf skipWaitToCompletion — e.g. permanently unschedulable
+    # filler pods)
+    skip_wait: bool = False
 
 
 @dataclass
@@ -45,6 +49,10 @@ class Workload:
     name: str
     ops: List[Op] = field(default_factory=list)
     batch_size: int = 64
+    # recreate-mode churn hook, called between scheduling cycles of the
+    # measured step with (store, cycle_index) — the synchronous analog of
+    # scheduler_perf's background churn goroutine
+    churn_between_cycles: Optional[Callable] = None
 
 
 @dataclass
@@ -96,6 +104,18 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 node_idx += 1
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
+            if op.collect_metrics:
+                # jit warmup BEFORE the measured pods exist: drive one
+                # disposable pod through the full cycle so a cold compile
+                # (tens of seconds) can't pollute the first measured
+                # attempts — the reference has no compile phase to exclude
+                warm = (
+                    make_pod().name("warmup-pod").uid("warmup-pod")
+                    .namespace("default").req({"cpu": "1m"}).obj()
+                )
+                store.create("Pod", warm)
+                sched.schedule_cycle()
+                store.delete("Pod", "default", "warmup-pod")
             created = []
             for _ in range(op.count):
                 p = tmpl(pod_idx)
@@ -103,20 +123,46 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 created.append(p)
                 pod_idx += 1
             if op.collect_metrics:
-                scheduled_counts = []
+                # measure only this step: drop attempts recorded while
+                # scheduling the init/warmup pods (scheduler_perf collects
+                # the metric delta over the measured window, util.go:238-276)
+                m.scheduling_attempt_duration.reset()
+                pending_names = {(p.namespace, p.metadata.name) for p in created}
+                done = 0
+
+                def on_bind(ev):
+                    nonlocal done
+                    if ev.kind != "Pod" or not ev.obj.spec.node_name:
+                        return
+                    key = (ev.obj.namespace, ev.obj.metadata.name)
+                    if key in pending_names:
+                        pending_names.discard(key)
+                        done += 1
+
+                unwatch = store.watch(on_bind)
                 t0 = clock()
-                last = 0
-                while True:
+                cycle = 0
+                stall = 0
+                max_cycles = max(64, 4 * (len(created) // max(w.batch_size, 1) + 1))
+                while done < len(created) and cycle < max_cycles:
+                    if w.churn_between_cycles is not None:
+                        w.churn_between_cycles(store, cycle)
                     stats = sched.schedule_cycle()
-                    done = sum(
-                        1 for p in created
-                        if (store.get("Pod", p.namespace, p.metadata.name) or p).spec.node_name
-                    )
-                    scheduled_counts.append((clock() - t0, done))
-                    if stats.attempted == 0 or done == len(created):
+                    cycle += 1
+                    if stats.scheduled == 0 and stats.attempted == 0:
                         break
+                    if stats.scheduled == 0:
+                        stall += 1
+                        # permanently unschedulable backlog (e.g. the
+                        # Unschedulable suite's 9-cpu fillers) — give up
+                        # once nothing progresses for a few cycles
+                        if stall >= 4:
+                            break
+                    else:
+                        stall = 0
                 total_s = clock() - t0
-                n_done = scheduled_counts[-1][1]
+                unwatch()
+                n_done = done
                 throughput = n_done / total_s if total_s > 0 else 0.0
                 items.append(DataItem(
                     labels={"Name": w.name, "Metric": "SchedulingThroughput"},
@@ -138,7 +184,7 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     },
                     unit="s",
                 ))
-            else:
+            elif not op.skip_wait:
                 sched.run_until_idle()
         elif op.opcode == "barrier":
             sched.run_until_idle()

@@ -1,0 +1,301 @@
+"""Named benchmark workloads ported from the reference's scheduler_perf suite.
+
+Reference: test/integration/scheduler_perf/config/performance-config.yaml
+(+ the pod/node templates it references).  Each suite mirrors the reference
+shape — node template (4 cpu / 32Gi / 110 pods, node-default.yaml), pod
+templates (100m/500Mi default pod, 900m low-priority, 3000m priority-10
+high-priority, 9-cpu unschedulable, color-selector affinity/spread pods) —
+scaled by (initNodes, initPods, measurePods) params.
+
+Sizes follow the reference's named workloads; `scale` lets tests run the
+same shapes tiny.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..api import objects as v1
+from ..testutil import make_node, make_pod
+from .harness import Op, Workload
+
+ZONES3 = ["moon-1", "moon-2", "moon-3"]
+
+
+def node_default(i: int) -> v1.Node:
+    return (
+        make_node().name(f"node-{i:06d}")
+        .capacity({"cpu": "4", "memory": "32Gi", "pods": "110"})
+        .obj()
+    )
+
+
+def node_unique_hostname(i: int) -> v1.Node:
+    return (
+        make_node().name(f"node-{i:06d}")
+        .capacity({"cpu": "4", "memory": "32Gi", "pods": "110"})
+        .label("kubernetes.io/hostname", f"node-{i:06d}")
+        .obj()
+    )
+
+
+def node_zoned(zones: List[str]) -> Callable[[int], v1.Node]:
+    def tmpl(i: int) -> v1.Node:
+        return (
+            make_node().name(f"node-{i:06d}")
+            .capacity({"cpu": "4", "memory": "32Gi", "pods": "110"})
+            .label("topology.kubernetes.io/zone", zones[i % len(zones)])
+            .obj()
+        )
+
+    return tmpl
+
+
+def _base_pod(i: int, prefix: str, ns: str = "default"):
+    return (
+        make_pod().name(f"{prefix}-{i:06d}").uid(f"{prefix}-{i:06d}")
+        .namespace(ns)
+    )
+
+
+def pod_default(i: int, ns: str = "default") -> v1.Pod:
+    return _base_pod(i, "pod", ns).req({"cpu": "100m", "memory": "500Mi"}).obj()
+
+
+def pod_low_priority(i: int) -> v1.Pod:
+    return _base_pod(i, "low", "default").req(
+        {"cpu": "900m", "memory": "500Mi"}
+    ).obj()
+
+
+def pod_high_priority(i: int) -> v1.Pod:
+    return (
+        _base_pod(i, "high", "default")
+        .req({"cpu": "3000m", "memory": "500Mi"})
+        .priority(10)
+        .obj()
+    )
+
+
+def pod_large_cpu(i: int) -> v1.Pod:
+    return _base_pod(i, "large", "default").req(
+        {"cpu": "9", "memory": "500Mi"}
+    ).obj()
+
+
+def pod_anti_affinity(ns: str) -> Callable[[int], v1.Pod]:
+    """pod-with-pod-anti-affinity.yaml: color=green, required anti-affinity
+    on kubernetes.io/hostname across sched-0/sched-1."""
+
+    def tmpl(i: int) -> v1.Pod:
+        return (
+            _base_pod(i, f"anti-{ns}", ns)
+            .req({"cpu": "100m", "memory": "500Mi"})
+            .label("color", "green")
+            .pod_affinity(
+                "kubernetes.io/hostname", {"color": "green"}, anti=True,
+                namespaces=["sched-0", "sched-1"],
+            )
+            .obj()
+        )
+
+    return tmpl
+
+
+def pod_affinity(ns: str) -> Callable[[int], v1.Pod]:
+    """pod-with-pod-affinity.yaml: color=blue, required affinity on zone."""
+
+    def tmpl(i: int) -> v1.Pod:
+        return (
+            _base_pod(i, f"aff-{ns}", ns)
+            .req({"cpu": "100m", "memory": "500Mi"})
+            .label("color", "blue")
+            .pod_affinity(
+                "topology.kubernetes.io/zone", {"color": "blue"},
+                namespaces=["sched-0", "sched-1"],
+            )
+            .obj()
+        )
+
+    return tmpl
+
+
+def pod_topology_spread(i: int) -> v1.Pod:
+    """pod-with-topology-spreading.yaml: maxSkew=5 DoNotSchedule on zone."""
+    return (
+        _base_pod(i, "spread", "default")
+        .req({"cpu": "100m", "memory": "500Mi"})
+        .label("color", "blue")
+        .topology_spread(
+            5, "topology.kubernetes.io/zone", labels={"color": "blue"}
+        )
+        .obj()
+    )
+
+
+@dataclass
+class Suite:
+    name: str
+    build: Callable[[int, int, int], Workload]  # (initNodes, initPods, measurePods)
+    sizes: Dict[str, tuple]  # workload name → (initNodes, initPods, measurePods)
+
+
+def _basic(n, p, mp) -> Workload:
+    return Workload(
+        name="SchedulingBasic",
+        ops=[
+            Op("createNodes", n, node_template=node_default),
+            Op("createPods", p, pod_template=pod_default),
+            Op("createPods", mp, pod_template=pod_default, collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _anti_affinity(n, p, mp) -> Workload:
+    return Workload(
+        name="SchedulingPodAntiAffinity",
+        ops=[
+            Op("createNodes", n, node_template=node_unique_hostname),
+            Op("createPods", p, pod_template=pod_anti_affinity("sched-0")),
+            Op("createPods", mp, pod_template=pod_anti_affinity("sched-1"),
+               collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _affinity(n, p, mp) -> Workload:
+    return Workload(
+        name="SchedulingPodAffinity",
+        ops=[
+            Op("createNodes", n, node_template=node_zoned(["zone1"])),
+            Op("createPods", p, pod_template=pod_affinity("sched-0")),
+            Op("createPods", mp, pod_template=pod_affinity("sched-1"),
+               collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _topology(n, p, mp) -> Workload:
+    return Workload(
+        name="TopologySpreading",
+        ops=[
+            Op("createNodes", n, node_template=node_zoned(ZONES3)),
+            Op("createPods", p, pod_template=pod_default),
+            Op("createPods", mp, pod_template=pod_topology_spread,
+               collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _preemption(n, p, mp) -> Workload:
+    return Workload(
+        name="PreemptionBasic",
+        ops=[
+            Op("createNodes", n, node_template=node_default),
+            Op("createPods", p, pod_template=pod_low_priority),
+            Op("createPods", mp, pod_template=pod_high_priority,
+               collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _unschedulable(n, p, mp) -> Workload:
+    return Workload(
+        name="Unschedulable",
+        ops=[
+            Op("createNodes", n, node_template=node_default),
+            # 9-cpu pods can never fit a 4-cpu node; they churn the
+            # unschedulable queue while the measured pods schedule
+            Op("createPods", p, pod_template=pod_large_cpu,
+               skip_wait=True),
+            Op("createPods", mp, pod_template=pod_default,
+               collect_metrics=True),
+        ],
+        batch_size=128,
+    )
+
+
+def _mixed_churn(n, p, mp) -> Workload:
+    def churn(store, cycle: int):
+        # recreate-mode churn (SchedulingWithMixedChurn): one node, one
+        # high-priority pod, one service recreated per interval
+        name = f"churn-node-{cycle % 8:03d}"
+        old = store.get("Node", "", name)
+        if old is not None:
+            store.delete("Node", "", name)
+        store.create(
+            "Node",
+            make_node().name(name)
+            .capacity({"cpu": "4", "memory": "32Gi", "pods": "110"}).obj(),
+        )
+        pname = f"churn-pod-{cycle % 8:03d}"
+        if store.get("Pod", "default", pname) is not None:
+            store.delete("Pod", "default", pname)
+        store.create(
+            "Pod",
+            make_pod().name(pname).uid(f"{pname}-{cycle}")
+            .namespace("default").priority(10)
+            .req({"cpu": "1", "memory": "500Mi"}).obj(),
+        )
+        svc = v1.Service(
+            metadata=v1.ObjectMeta(name=f"churn-svc-{cycle % 8:03d}",
+                                   namespace="default"),
+            selector={"app": "none"},
+        )
+        if store.get("Service", "default", svc.metadata.name) is not None:
+            store.delete("Service", "default", svc.metadata.name)
+        store.create("Service", svc)
+
+    return Workload(
+        name="SchedulingWithMixedChurn",
+        ops=[
+            Op("createNodes", n, node_template=node_default),
+            Op("createPods", mp, pod_template=pod_default,
+               collect_metrics=True),
+        ],
+        batch_size=128,
+        churn_between_cycles=churn,
+    )
+
+
+SUITES: Dict[str, Suite] = {
+    s.name: s
+    for s in [
+        Suite("SchedulingBasic", _basic,
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 1000, 1000)}),
+        Suite("SchedulingPodAntiAffinity", _anti_affinity,
+              {"500Nodes": (500, 100, 400), "5000Nodes": (5000, 1000, 1000)}),
+        Suite("SchedulingPodAffinity", _affinity,
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
+        Suite("TopologySpreading", _topology,
+              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
+        Suite("PreemptionBasic", _preemption,
+              {"500Nodes": (500, 2000, 500), "5000Nodes": (5000, 20000, 5000)}),
+        Suite("Unschedulable", _unschedulable,
+              {"500Nodes/200InitPods": (500, 200, 1000),
+               "5000Nodes/200InitPods": (5000, 200, 5000)}),
+        Suite("SchedulingWithMixedChurn", _mixed_churn,
+              {"1000Nodes": (1000, 0, 1000), "5000Nodes": (5000, 0, 2000)}),
+        # The north-star config (BASELINE.md): 5k nodes, 10k pending pods,
+        # measured per-attempt
+        Suite("NorthStar", _basic, {"5000Nodes/10000Pods": (5000, 2000, 10000)}),
+    ]
+}
+
+
+def build_workload(suite: str, size: str, scale: float = 1.0) -> Workload:
+    s = SUITES[suite]
+    n, p, mp = s.sizes[size]
+    if scale != 1.0:
+        n = max(4, int(n * scale))
+        p = max(0, int(p * scale))
+        mp = max(2, int(mp * scale))
+    w = s.build(n, p, mp)
+    w.name = f"{suite}/{size}"
+    return w

@@ -106,12 +106,12 @@ class Evaluator:
         info: NodeInfo,
         node_infos: List[NodeInfo],
         pdbs: Sequence[v1.PodDisruptionBudget] = (),
+        cluster_has_req_anti_affinity: bool = True,
     ) -> Optional[Candidate]:
         """SelectVictimsOnNode (default_preemption.go:139): remove all lower-
         priority pods, verify fit, then reprieve greedily (PDB-violating pods
         reprieved first, both groups by descending importance)."""
         sim = info.clone()
-        others = [ni for ni in node_infos if ni.node_name != info.node_name]
         potential = [
             pi.pod for pi in info.pods if pi.pod.spec.priority < pod.spec.priority
         ]
@@ -119,6 +119,26 @@ class Evaluator:
             return None
         for victim in potential:
             sim.remove_pod(victim)
+
+        # Cross-node context is only needed when the preemptor carries
+        # global constraints (topology-spread min counts, pod-affinity
+        # domain counts); plain resource/taint/selector feasibility is
+        # node-local, and evaluating just the simulated node keeps each
+        # dry run O(1) in cluster size (the reference likewise filters one
+        # node against preFilter state, default_preemption.go:139).
+        aff = pod.spec.affinity
+        needs_global = bool(
+            pod.spec.topology_spread_constraints
+            or (aff and (aff.pod_affinity or aff.pod_anti_affinity))
+            # existing pods' required anti-affinity can block the preemptor
+            # through a multi-node topology domain
+            or cluster_has_req_anti_affinity
+        )
+        others = (
+            [ni for ni in node_infos if ni.node_name != info.node_name]
+            if needs_global
+            else []
+        )
 
         def fits() -> bool:
             feas = self.oracle.feasible_nodes(pod, others + [sim])
@@ -197,13 +217,17 @@ class Evaluator:
         n = len(snapshot.node_info_list)
         cap = max_candidates or max(100, n // 10)
         node_infos = snapshot.node_info_list
+        has_anti = bool(snapshot.have_pods_with_required_anti_affinity_list)
         by_name = {ni.node_name: ni for ni in node_infos}
         candidates: List[Candidate] = []
         for name in list(candidate_nodes)[:cap]:
             info = by_name.get(name)
             if info is None:
                 continue
-            c = self.select_victims_on_node(pod, info, node_infos, pdbs)
+            c = self.select_victims_on_node(
+                pod, info, node_infos, pdbs,
+                cluster_has_req_anti_affinity=has_anti,
+            )
             if c is not None:
                 candidates.append(c)
         return self.pick_one_node(candidates)

@@ -248,7 +248,10 @@ class TPUScheduler:
         self.encoder.sync(self.snapshot, changed)
 
         pods = [qi.pod for qi in infos]
-        batch = self.compiler.compile(pods)
+        # fixed padding: every cycle compiles to ONE (batch_size, tier)
+        # program instead of one per pow-2 backlog size — partial batches
+        # reuse the warm executable (first compile is tens of seconds)
+        batch = self.compiler.compile(pods, pad_to=self.batch_size)
         fw = self._framework()
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels

@@ -115,13 +115,14 @@ class PodWrapper:
 
     def pod_affinity(
         self, topology_key: str, labels: Dict[str, str], anti: bool = False,
-        weight: Optional[int] = None,
+        weight: Optional[int] = None, namespaces: Optional[List[str]] = None,
     ) -> "PodWrapper":
         """Add a required (weight=None) or preferred pod (anti-)affinity exact-match term."""
         aff = self._ensure_affinity()
         term = v1.PodAffinityTerm(
             label_selector=v1.LabelSelector(match_labels=dict(labels)),
             topology_key=topology_key,
+            namespaces=list(namespaces or []),
         )
         target_attr = "pod_anti_affinity" if anti else "pod_affinity"
         pa = getattr(aff, target_attr)

@@ -1,0 +1,47 @@
+"""Named scheduler_perf workloads run end-to-end at tiny scale.
+
+Mirrors test/integration/scheduler_perf/config/performance-config.yaml
+suite shapes; bench.py runs the same suites at reference sizes on real
+hardware."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.perf.workloads import SUITES, build_workload
+from kubernetes_tpu.perf.harness import run_workload
+
+
+SMALL = {
+    # suite → (size name, scale) chosen so each finishes in seconds on CPU
+    "SchedulingBasic": ("500Nodes", 0.02),
+    "SchedulingPodAntiAffinity": ("500Nodes", 0.02),
+    "SchedulingPodAffinity": ("500Nodes", 0.01),
+    "TopologySpreading": ("500Nodes", 0.01),
+    "PreemptionBasic": ("500Nodes", 0.02),
+    "Unschedulable": ("500Nodes/200InitPods", 0.02),
+    "SchedulingWithMixedChurn": ("1000Nodes", 0.01),
+}
+
+
+@pytest.mark.parametrize("suite", sorted(SMALL))
+def test_suite_runs_and_collects_metrics(suite):
+    size, scale = SMALL[suite]
+    w = build_workload(suite, size, scale=scale)
+    w.batch_size = 8
+    items = run_workload(w)
+    by_metric = {i.labels["Metric"]: i for i in items}
+    assert "SchedulingThroughput" in by_metric
+    att = by_metric["scheduler_scheduling_attempt_duration_seconds"]
+    assert att.data["Perc99"] >= att.data["Perc50"] >= 0.0
+    thr = by_metric["SchedulingThroughput"].data["Average"]
+    if suite == "PreemptionBasic":
+        # preemptors must displace victims and land (some may wait a round)
+        assert thr > 0
+    else:
+        assert thr > 0
+
+
+def test_all_reference_sizes_listed():
+    # the two north-star-relevant entries exist with reference params
+    assert SUITES["SchedulingBasic"].sizes["5000Nodes"] == (5000, 1000, 1000)
+    assert SUITES["NorthStar"].sizes["5000Nodes/10000Pods"] == (5000, 2000, 10000)
