@@ -152,6 +152,43 @@ class PodBatchCompiler:
         self.enc = encoder
         self.dic: Dictionary = encoder.dic
         self.namespace_labels = namespace_labels or {}
+        # Sticky per-dimension caps: each inner dim (labels, tolerations,
+        # spread constraints, affinity terms, …) is a pow-2 HIGH-WATER MARK
+        # across all batches this compiler has seen, not the current batch's
+        # max.  Otherwise batches alternating between pod kinds (e.g. plain ↔
+        # anti-affinity in the mixed suites) flip shapes every cycle and each
+        # flip recompiles the whole program suite.  Padding is semantically
+        # inert (valid[] gates everything), so growing a cap never changes
+        # results — test_podbatch_sticky_caps.
+        self._caps: Dict[str, int] = {}
+
+    def _cap(self, name: str, need: int, minimum: int) -> int:
+        c = max(_pow2(need, minimum), self._caps.get(name, 0))
+        self._caps[name] = c
+        return c
+
+    def _compile_ls(self, name: str, sel_list) -> CompiledLabelSelectors:
+        """compile_label_selectors with sticky s/v caps (same rationale as _cap)."""
+        cs = compile_label_selectors(
+            sel_list, self.dic,
+            min_s=self._caps.get(f"{name}_s", 4),
+            min_v=self._caps.get(f"{name}_v", 4),
+        )
+        self._caps[f"{name}_s"] = cs.req_key.shape[-1]
+        self._caps[f"{name}_v"] = cs.req_vals.shape[-1]
+        return cs
+
+    def _compile_ns(self, name: str, sel_list) -> CompiledNodeSelectors:
+        cs = compile_node_selectors(
+            sel_list, self.dic,
+            min_t=self._caps.get(f"{name}_t", 2),
+            min_s=self._caps.get(f"{name}_s", 4),
+            min_v=self._caps.get(f"{name}_v", 4),
+        )
+        self._caps[f"{name}_t"] = cs.req_key.shape[1]
+        self._caps[f"{name}_s"] = cs.req_key.shape[2]
+        self._caps[f"{name}_v"] = cs.req_vals.shape[-1]
+        return cs
 
     def compile(self, pods: Sequence[v1.Pod], pad_to: Optional[int] = None) -> PodBatch:
         b_real = len(pods)
@@ -170,7 +207,7 @@ class PodBatchCompiler:
         node_name_id = np.full(b, MISSING, dtype=np.int32)
         nominated_row = np.full(b, -1, dtype=np.int32)
 
-        pl_cap = _pow2(max((len(p.metadata.labels) for p in pods), default=0), 4)
+        pl_cap = self._cap("pl", max((len(p.metadata.labels) for p in pods), default=0), 4)
         label_keys = np.full((b, pl_cap), MISSING, dtype=np.int32)
         label_vals = np.full((b, pl_cap), MISSING, dtype=np.int32)
 
@@ -178,13 +215,13 @@ class PodBatchCompiler:
             {_PROTO_CODE.get(proto, 0) * 65536 + port
              for (_ip, proto, port) in _pod_host_ports(p)}
         ) for p in pods]
-        pp_cap = _pow2(max((len(pl) for pl in port_lists), default=0), 2)
+        pp_cap = self._cap("pp", max((len(pl) for pl in port_lists), default=0), 2)
         ports = np.full((b, pp_cap), MISSING, dtype=np.int32)
 
-        ci_cap = _pow2(max((len(p.spec.containers) for p in pods), default=0), 2)
+        ci_cap = self._cap("ci", max((len(p.spec.containers) for p in pods), default=0), 2)
         image_ids = np.full((b, ci_cap), MISSING, dtype=np.int32)
 
-        tt_cap = _pow2(max((len(p.spec.tolerations) for p in pods), default=0), 2)
+        tt_cap = self._cap("tt", max((len(p.spec.tolerations) for p in pods), default=0), 2)
         tol_valid = np.zeros((b, tt_cap), dtype=bool)
         tol_key = np.full((b, tt_cap), MISSING, dtype=np.int32)
         tol_val = np.full((b, tt_cap), MISSING, dtype=np.int32)
@@ -241,12 +278,13 @@ class PodBatchCompiler:
         pref_terms += [[]] * (b - b_real)
         tsc_lists += [[]] * (b - b_real)
 
-        compiled_ns = compile_label_selectors(node_selectors, dic)
-        compiled_na = compile_node_selectors(node_affinities, dic)
+        compiled_ns = self._compile_ls("nodesel", node_selectors)
+        compiled_na = self._compile_ns("nodeaff", node_affinities)
 
         # preferred node-affinity terms
-        pt_cap = _pow2(max((len(t) for t in pref_terms), default=0), 1)
-        s_cap = _pow2(
+        pt_cap = self._cap("pt", max((len(t) for t in pref_terms), default=0), 1)
+        s_cap = self._cap(
+            "pt_s",
             max(
                 (len(t.preference.match_expressions) + len(t.preference.match_fields)
                  for terms in pref_terms for t in terms),
@@ -254,7 +292,8 @@ class PodBatchCompiler:
             ),
             2,
         )
-        v_cap = _pow2(
+        v_cap = self._cap(
+            "pt_v",
             max(
                 (len(e.values)
                  for terms in pref_terms for t in terms
@@ -297,7 +336,7 @@ class PodBatchCompiler:
                             pass
 
         # topology spread constraints
-        c_cap = _pow2(max((len(t) for t in tsc_lists), default=0), 1)
+        c_cap = self._cap("tsc", max((len(t) for t in tsc_lists), default=0), 1)
         tsc_valid = np.zeros((b, c_cap), dtype=bool)
         tsc_key = np.full((b, c_cap), MISSING, dtype=np.int32)
         tsc_max_skew = np.ones((b, c_cap), dtype=np.int32)
@@ -316,7 +355,7 @@ class PodBatchCompiler:
                 )
                 tsc_min_domains[i, ci] = c.min_domains or 0
                 tsc_sel_list[i * c_cap + ci] = c.label_selector
-        tsc_selectors = compile_label_selectors(tsc_sel_list, dic)
+        tsc_selectors = self._compile_ls("tsc_sel", tsc_sel_list)
 
         groups = {}
         for gname in ("req_affinity", "req_anti_affinity", "pref_affinity", "pref_anti_affinity"):
@@ -375,12 +414,15 @@ class PodBatchCompiler:
     ) -> AffinityTermGroup:
         dic = self.dic
         term_lists = [self._terms_of(p, group) for p in pods]
-        t_cap = _pow2(max((len(t) for t in term_lists), default=0), 1)
+        t_cap = self._cap(
+            f"{group}_t", max((len(t) for t in term_lists), default=0), 1
+        )
         resolved = [
             [self._resolve_namespaces(p, term) for (term, _w) in terms]
             for p, terms in zip(pods, term_lists)
         ]
-        ns_cap = _pow2(
+        ns_cap = self._cap(
+            f"{group}_ns",
             max((len(names) for rl in resolved for (names, _a) in rl), default=0), 1
         )
         valid = np.zeros((b, t_cap), dtype=bool)
@@ -402,7 +444,7 @@ class PodBatchCompiler:
         return AffinityTermGroup(
             valid=valid, topo_key=topo_key, weight=weight, ns_ids=ns_ids,
             all_namespaces=all_namespaces,
-            selectors=compile_label_selectors(sel_list, dic),
+            selectors=self._compile_ls(f"{group}_sel", sel_list),
         )
 
 

@@ -78,6 +78,14 @@ class BatchedFramework:
         self.plugins = list(plugins)
         self.filter_plugins = [p for p in self.plugins if hasattr(p.plugin, "filter")]
         self.score_plugins = [p for p in self.plugins if hasattr(p.plugin, "score")]
+        # Host binding-cycle hook lists, precomputed once: the per-pod bind
+        # segment must not walk 14 plugins × 4 hooks via getattr per pod
+        # (RunReservePluginsReserve etc. iterate registered-extension-point
+        # lists in the reference too, runtime/framework.go)
+        self.reserve_plugins = [p for p in self.plugins if hasattr(p.plugin, "reserve")]
+        self.permit_plugins = [p for p in self.plugins if hasattr(p.plugin, "permit")]
+        self.pre_bind_plugins = [p for p in self.plugins if hasattr(p.plugin, "pre_bind")]
+        self.post_bind_plugins = [p for p in self.plugins if hasattr(p.plugin, "post_bind")]
 
     # --- host-side precompute (eager, before jit) ----------------------------
 

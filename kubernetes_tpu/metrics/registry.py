@@ -57,6 +57,11 @@ class Histogram(Metric):
         self._counts: Dict[Tuple, List[int]] = {}
         self._sum: Dict[Tuple, float] = {}
         self._n: Dict[Tuple, int] = {}
+        # Exact samples alongside the buckets: prometheus histograms rail at
+        # the top bucket (round 2's headline p99 WAS the bucket ceiling, i.e.
+        # not a measurement), so perf windows also keep raw values and report
+        # exact quantiles next to the bucket-interpolated parity ones.
+        self._samples: Dict[Tuple, List[float]] = {}
         self._lock = threading.Lock()
 
     def observe(self, v: float, labels: Tuple = ()):
@@ -65,6 +70,7 @@ class Histogram(Metric):
             c[bisect.bisect_left(self.buckets, v)] += 1
             self._sum[labels] = self._sum.get(labels, 0.0) + v
             self._n[labels] = self._n.get(labels, 0) + 1
+            self._samples.setdefault(labels, []).append(v)
 
     def reset(self):
         """Clear observations in place (measured-window deltas,
@@ -73,6 +79,20 @@ class Histogram(Metric):
             self._counts.clear()
             self._sum.clear()
             self._n.clear()
+            self._samples.clear()
+
+    def samples(self, labels: Tuple = ()) -> List[float]:
+        with self._lock:
+            return list(self._samples.get(labels, ()))
+
+    def exact_quantile(self, q: float, labels: Tuple = ()) -> float:
+        """Quantile over the raw samples (never saturates at a bucket edge)."""
+        s = self.samples(labels)
+        if not s:
+            return 0.0
+        s.sort()
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[idx]
 
     def count(self, labels: Tuple = ()) -> int:
         return self._n.get(labels, 0)

@@ -93,6 +93,15 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
 
     store = ObjectStore()
     sched = TPUScheduler(store, batch_size=w.batch_size)
+    # Pre-size tiers to the run's full extent so no measured cycle pays a
+    # DeviceSnapshot shape change (= full program-suite recompile).
+    sched.presize(
+        sum(op.count for op in w.ops if op.opcode == "createNodes"),
+        sum(op.count for op in w.ops if op.opcode == "createPods"),
+    )
+    from ..utils.compilemon import monitor
+
+    monitor.install()
     items: List[DataItem] = []
     node_idx = 0
     pod_idx = 0
@@ -143,11 +152,21 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 t0 = clock()
                 cycle = 0
                 stall = 0
+                # steady-state split: attempts from cycles with ZERO backend
+                # compiles, so the bench can report what the scheduler costs
+                # once warm separately from compile-affected cycles
+                steady: List[float] = []
+                win_c0, win_s0 = monitor.snapshot()
+                hist = m.scheduling_attempt_duration
                 max_cycles = max(64, 4 * (len(created) // max(w.batch_size, 1) + 1))
                 while done < len(created) and cycle < max_cycles:
                     if w.churn_between_cycles is not None:
                         w.churn_between_cycles(store, cycle)
+                    n_samp = hist.count()
+                    c_pre = monitor.snapshot()[0]
                     stats = sched.schedule_cycle()
+                    if monitor.snapshot()[0] == c_pre:
+                        steady.extend(hist.samples()[n_samp:])
                     cycle += 1
                     if stats.scheduled == 0 and stats.attempted == 0:
                         break
@@ -161,6 +180,7 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     else:
                         stall = 0
                 total_s = clock() - t0
+                win_c1, win_s1 = monitor.snapshot()
                 unwatch()
                 n_done = done
                 throughput = n_done / total_s if total_s > 0 else 0.0
@@ -169,7 +189,13 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     data={"Average": round(throughput, 1)},
                     unit="pods/s",
                 ))
-                hist = m.scheduling_attempt_duration
+                samples = sorted(hist.samples())
+
+                def _exact(vals: List[float], q: float) -> float:
+                    if not vals:
+                        return 0.0
+                    return vals[min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))]
+
                 items.append(DataItem(
                     labels={
                         "Name": w.name,
@@ -181,8 +207,39 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         "Perc95": hist.quantile(0.95),
                         "Perc99": hist.quantile(0.99),
                         "Average": hist.sum() / max(hist.count(), 1),
+                        # exact quantiles from raw samples — the bucket ones
+                        # above saturate at the top bucket edge (round-2 p99
+                        # railed at 16.384s); these never do
+                        "ExactPerc50": _exact(samples, 0.50),
+                        "ExactPerc90": _exact(samples, 0.90),
+                        "ExactPerc99": _exact(samples, 0.99),
+                        "Max": samples[-1] if samples else 0.0,
                     },
                     unit="s",
+                ))
+                steady.sort()
+                items.append(DataItem(
+                    labels={
+                        "Name": w.name,
+                        "Metric": "attempt_duration_steady_state",
+                    },
+                    data={
+                        "Perc50": _exact(steady, 0.50),
+                        "Perc90": _exact(steady, 0.90),
+                        "Perc99": _exact(steady, 0.99),
+                        "Max": steady[-1] if steady else 0.0,
+                        "Count": float(len(steady)),
+                        "TotalCount": float(len(samples)),
+                    },
+                    unit="s",
+                ))
+                items.append(DataItem(
+                    labels={"Name": w.name, "Metric": "XLACompilesInWindow"},
+                    data={
+                        "Count": float(win_c1 - win_c0),
+                        "Seconds": round(win_s1 - win_s0, 3),
+                    },
+                    unit="compiles",
                 ))
             elif not op.skip_wait:
                 sched.run_until_idle()

@@ -92,6 +92,7 @@ class InterPodAffinityPlugin(Plugin):
         n = encoder._n
         block = np.zeros((b, n), dtype=bool)
         score = np.zeros((b, n), dtype=np.float32)
+        touched = False
         node_topo = encoder.node_topo
 
         def domain_nodes(key: str, node_name: str):
@@ -105,6 +106,7 @@ class InterPodAffinityPlugin(Plugin):
             return node_topo[:, slot] == val
 
         def apply(pi, terms, sign_weights, target_score):
+            nonlocal touched
             info_node = pi.pod.spec.node_name
             for term, w in zip(terms, sign_weights):
                 nmask = domain_nodes(term.topology_key, info_node)
@@ -113,6 +115,7 @@ class InterPodAffinityPlugin(Plugin):
                 for i, pod in enumerate(batch.pods):
                     if affinity_term_matches(term, pi.pod, pod, namespace_labels):
                         target_score[i][nmask] += w
+                        touched = True
 
         for info in snapshot.have_pods_with_required_anti_affinity_list:
             for pi in info.pods_with_required_anti_affinity:
@@ -123,6 +126,7 @@ class InterPodAffinityPlugin(Plugin):
                     for i, pod in enumerate(batch.pods):
                         if affinity_term_matches(term, pi.pod, pod, namespace_labels):
                             block[i][nmask] = True
+                            touched = True
 
         for info in snapshot.have_pods_with_affinity_list:
             for pi in info.pods_with_affinity:
@@ -134,6 +138,10 @@ class InterPodAffinityPlugin(Plugin):
                 apply(pi, [wt.pod_affinity_term for wt in pi.preferred_anti_affinity_terms],
                       [-float(wt.weight) for wt in pi.preferred_anti_affinity_terms], score)
 
+        if not touched:
+            # nothing in the cluster interacts with this batch — skip the
+            # [B, N] bool + f32 uploads; prepare() makes traced zeros instead
+            return None
         return {"exist_anti_block": block, "score_static": score}
 
     # --- device prepare -------------------------------------------------------

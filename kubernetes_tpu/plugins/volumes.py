@@ -68,6 +68,12 @@ class _HostMaskPlugin(Plugin):
     def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
         mask = np.ones((batch.size, encoder._n), dtype=bool)
         self._fill(mask, batch, snapshot, encoder)
+        if mask.all():
+            # Unconstrained (no PVCs in the batch, the common case): skip the
+            # [B, N] host→device upload entirely — at 5k nodes these masks are
+            # ~1 MB/plugin/cycle over the device link; filter() emits ones
+            # inside the traced program instead.
+            return None
         return {"mask": mask}
 
     def prepare(self, batch, snap, dyn, host_aux=None):

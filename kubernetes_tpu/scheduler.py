@@ -38,6 +38,7 @@ from .queueing.priority_queue import QueuedPodInfo
 from .sim.store import ADDED, DELETED, MODIFIED, ObjectStore, WatchEvent
 from .state.cache import Cache, Snapshot
 from .state.encoding import ClusterEncoder
+from .state.units import pow2_round_up as _pow2
 
 
 def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
@@ -217,18 +218,45 @@ class TPUScheduler:
             else:
                 self.queue.delete(pod)
 
+    def presize(self, n_nodes: int, n_pods: int):
+        """Pre-grow the encoder's node/pod tiers (see ClusterEncoder.reserve).
+
+        Mid-run tier growth changes DeviceSnapshot shapes, which recompiles
+        the whole prepare/assign program suite (~5-30s each) inside the
+        measured window — round 2's profile showed this was most of the
+        north-star bench's p99.  Callers that know the run's extent (the perf
+        harness, a real deployment's node inventory) call this once up front.
+        """
+        self.encoder.reserve(_pow2(n_nodes, 1), _pow2(n_pods, 1))
+
     # --- framework / jit management ------------------------------------------
 
     def _framework(self) -> BatchedFramework:
         d = self.encoder.domain_cap
         if self._fw is None or d != self._fw_domain_cap:
-            self._fw = BatchedFramework(self._plugins_factory(d))
+            fw = self._fw = BatchedFramework(self._plugins_factory(d))
             self._fw_domain_cap = d
+
+            # prepare fused INTO each engine: one device dispatch per cycle
+            # (each separate dispatch pays a host→device round trip, which
+            # dominates small-cluster cycles on a remote-attached TPU); the
+            # standalone prepare remains for the extender/diagnose path.
+            def fused_greedy(batch, dsnap, dyn, host_auxes, order, key):
+                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+                return fw.greedy_assign(batch, dsnap, dyn, auxes, order, key), auxes
+
+            def fused_batch(batch, dsnap, dyn, host_auxes, order, coupling, key):
+                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+                return (
+                    fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key),
+                    auxes,
+                )
+
             self._jitted = {
-                "prepare": jax.jit(self._fw.prepare),
-                "greedy": jax.jit(self._fw.greedy_assign),
-                "batch": jax.jit(self._fw.batch_assign),
-                "compute": jax.jit(self._fw.compute),
+                "prepare": jax.jit(fw.prepare),
+                "greedy": jax.jit(fused_greedy),
+                "batch": jax.jit(fused_batch),
+                "compute": jax.jit(fw.compute),
             }
         return self._fw
 
@@ -259,22 +287,22 @@ class TPUScheduler:
         dsnap = self.encoder.to_device()
         dyn = initial_dynamic_state(dsnap)
         dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
-        auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
         if self.extenders:
             # sequential per-pod cycles: each pod's decision lands at its own
             # time, so per-attempt latency must not absorb later pods' cycles
+            auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
             node_row, algo_lat = self._assign_with_extenders(
                 batch, dsnap, dyn, auxes, pods, t0
             )
         else:
-            res = self._run_assignment(batch, dsnap, dyn, auxes)
+            res, auxes = self._run_assignment(batch, dsnap, dyn, host_auxes)
             node_row = np.asarray(res.node_row)
             algo_lat = np.full(len(infos), self.clock() - t0)
             # one algorithm invocation for the whole batch → one sample
             # (the extender path samples per-pod cycles itself)
             m.scheduling_algorithm_duration.observe(self.clock() - t0)
 
-        name_of = {r: n for n, r in self.encoder.node_rows.items()}
+        name_of = self.encoder.row_to_name()
         for i, qi in enumerate(infos):
             t_pod = self.clock()
             row = int(node_row[i])
@@ -315,12 +343,14 @@ class TPUScheduler:
         m.pending_pods.set(u, ("unschedulable",))
         return stats
 
-    def _run_assignment(self, batch, dsnap, dyn, auxes):
+    def _run_assignment(self, batch, dsnap, dyn, host_auxes):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
         serializes into one commit per round there, and the row-sliced scan
-        is cheaper per step than the dense per-round recompute."""
+        is cheaper per step than the dense per-round recompute.
+
+        Returns (AssignResult, device auxes) from ONE fused dispatch."""
         from .framework.runtime import coupling_flags
 
         order = jnp.arange(batch.size)
@@ -331,10 +361,10 @@ class TPUScheduler:
             frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
             if mode == "batch" or frac <= self.coupled_fraction_threshold:
                 return self._jitted["batch"](
-                    batch, dsnap, dyn, auxes, order, coupling, self.rng_key
+                    batch, dsnap, dyn, host_auxes, order, coupling, self.rng_key
                 )
         return self._jitted["greedy"](
-            batch, dsnap, dyn, auxes, order, self.rng_key
+            batch, dsnap, dyn, host_auxes, order, self.rng_key
         )
 
     def _assign_with_extenders(
@@ -352,7 +382,7 @@ class TPUScheduler:
         b = batch.valid.shape[0]
         out = np.full(b, -1, dtype=np.int32)
         algo_lat = np.zeros(b)
-        name_of = {r: n for n, r in self.encoder.node_rows.items()}
+        name_of = self.encoder.row_to_name()
         row_of = self.encoder.node_rows
         t_prev = self.clock()
         for i, pod in enumerate(pods):
@@ -409,11 +439,8 @@ class TPUScheduler:
                 if un is not None:
                     un(None, pod, node_name)
 
-        for pw in fw.plugins:
-            fn = getattr(pw.plugin, "reserve", None)
-            if fn is None:
-                continue
-            status = fn(None, pod, node_name)
+        for pw in fw.reserve_plugins:
+            status = pw.plugin.reserve(None, pod, node_name)
             if status is not None and not status.is_success():
                 rollback()
                 return False
@@ -421,25 +448,20 @@ class TPUScheduler:
         # Permit: plugins may Wait with a timeout (waiting_pods_map analog);
         # in the synchronous sim an unallowed Wait fails the cycle and the pod
         # retries after backoff (WaitOnPermit, runtime/framework.go)
-        for pw in fw.plugins:
-            fn = getattr(pw.plugin, "permit", None)
-            if fn is None:
-                continue
-            status, timeout = fn(None, pod, node_name)
-            if status is not None and status.code == Code.WAIT:
-                self.waiting_pods.add(pod, pw.plugin.name, timeout)
-            elif status is not None and not status.is_success():
+        if fw.permit_plugins:
+            for pw in fw.permit_plugins:
+                status, timeout = pw.plugin.permit(None, pod, node_name)
+                if status is not None and status.code == Code.WAIT:
+                    self.waiting_pods.add(pod, pw.plugin.name, timeout)
+                elif status is not None and not status.is_success():
+                    rollback()
+                    return False
+            reason = self.waiting_pods.wait_on_permit(pod)
+            if reason is not None:
                 rollback()
                 return False
-        reason = self.waiting_pods.wait_on_permit(pod)
-        if reason is not None:
-            rollback()
-            return False
-        for pw in fw.plugins:
-            fn = getattr(pw.plugin, "pre_bind", None)
-            if fn is None:
-                continue
-            status = fn(None, pod, node_name)
+        for pw in fw.pre_bind_plugins:
+            status = pw.plugin.pre_bind(None, pod, node_name)
             if status is not None and not status.is_success():
                 rollback()
                 return False
@@ -449,10 +471,8 @@ class TPUScheduler:
             # else VolumeBinding assume-state leaks (scheduler.go:676-689)
             rollback()
             return False
-        for pw in fw.plugins:
-            fn = getattr(pw.plugin, "post_bind", None)
-            if fn is not None:
-                fn(None, pod, node_name)
+        for pw in fw.post_bind_plugins:
+            pw.plugin.post_bind(None, pod, node_name)
         return True
 
     def _reserve_nominated(self, dyn, batch_uids: Set[str]):
@@ -490,7 +510,7 @@ class TPUScheduler:
         rows = np.where(np.asarray(cand_mask[i]))[0]
         if rows.size == 0:
             return
-        name_of = {r: n for n, r in self.encoder.node_rows.items()}
+        name_of = self.encoder.row_to_name()
         names = [name_of[int(r)] for r in rows if int(r) in name_of]
         pdbs, _ = self.store.list("PodDisruptionBudget")
         cand = self.preemption.preempt(pod, self.snapshot, names, pdbs)

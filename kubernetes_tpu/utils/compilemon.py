@@ -1,0 +1,73 @@
+"""XLA compile accounting + persistent compilation cache.
+
+The round-2 profile showed ~90% of north-star bench wall time was XLA
+recompilation (77 backend compiles across 12 scheduling cycles), caused by
+per-cycle shape drift (dirty-row scatter lengths, pod-tier growth, batch cap
+thrash).  The shape fixes live in state/encoding.py and framework/podbatch.py;
+this module is the regression guard: a process-wide counter of backend
+compiles (count + seconds) that the perf harness samples around each measured
+window, so a reintroduced shape leak shows up in bench output as a nonzero
+steady-state compile count instead of silently eating wall time.
+
+The reference has no compile phase at all; its analog of "warmup" is Go
+runtime JIT-free startup.  Our contract is therefore: O(1) compiles after the
+first cycle at a given cluster tier, zero in steady state.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileMonitor:
+    """Counts XLA backend compiles via jax.monitoring (thread-safe)."""
+
+    def __init__(self):
+        self.count = 0
+        self.seconds = 0.0
+        self._lock = threading.Lock()
+        self._registered = False
+
+    def _listener(self, event: str, duration: float, **kw):
+        if event == _COMPILE_EVENT:
+            with self._lock:
+                self.count += 1
+                self.seconds += duration
+
+    def install(self):
+        if self._registered:
+            return self
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(self._listener)
+        self._registered = True
+        return self
+
+    def snapshot(self):
+        with self._lock:
+            return (self.count, self.seconds)
+
+
+monitor = CompileMonitor()
+
+
+def enable_persistent_cache(path: str | None = None):
+    """Point JAX's persistent compilation cache at a repo-local dir.
+
+    Idempotent; safe to call before or after first device use.  Makes bench
+    reruns (and the driver's repeated invocations) skip cold compiles.
+    """
+    import jax
+
+    path = path or os.environ.get(
+        "KTPU_JAX_CACHE", os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")
+    )
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return path

@@ -22,8 +22,14 @@ def test_workload_basic_with_metrics():
     assert by_metric["SchedulingThroughput"].data["Average"] > 0
     hist = by_metric["scheduler_scheduling_attempt_duration_seconds"]
     assert hist.data["Perc99"] >= hist.data["Perc50"] >= 0
+    # exact quantiles never rail at a bucket edge and track the bucket ones
+    assert hist.data["ExactPerc99"] >= hist.data["ExactPerc50"] > 0
+    assert hist.data["Max"] >= hist.data["ExactPerc99"]
+    steady = by_metric["attempt_duration_steady_state"]
+    assert steady.data["TotalCount"] >= steady.data["Count"] >= 0
+    assert by_metric["XLACompilesInWindow"].data["Count"] >= 0
     doc = json.loads(data_items_to_json(items))
-    assert doc["version"] == "v1" and len(doc["dataItems"]) == 2
+    assert doc["version"] == "v1" and len(doc["dataItems"]) == 4
 
 
 def test_workload_churn():
