@@ -168,14 +168,16 @@ class PodBatchCompiler:
         return c
 
     def _compile_ls(self, name: str, sel_list) -> CompiledLabelSelectors:
-        """compile_label_selectors with sticky s/v caps (same rationale as _cap)."""
+        """compile_label_selectors with sticky u/s/v caps (same rationale as _cap)."""
         cs = compile_label_selectors(
             sel_list, self.dic,
             min_s=self._caps.get(f"{name}_s", 4),
             min_v=self._caps.get(f"{name}_v", 4),
+            min_u=self._caps.get(f"{name}_u", 4),
         )
         self._caps[f"{name}_s"] = cs.req_key.shape[-1]
         self._caps[f"{name}_v"] = cs.req_vals.shape[-1]
+        self._caps[f"{name}_u"] = cs.req_key.shape[0]
         return cs
 
     def _compile_ns(self, name: str, sel_list) -> CompiledNodeSelectors:
@@ -184,10 +186,12 @@ class PodBatchCompiler:
             min_t=self._caps.get(f"{name}_t", 2),
             min_s=self._caps.get(f"{name}_s", 4),
             min_v=self._caps.get(f"{name}_v", 4),
+            min_u=self._caps.get(f"{name}_u", 2),
         )
         self._caps[f"{name}_t"] = cs.req_key.shape[1]
         self._caps[f"{name}_s"] = cs.req_key.shape[2]
         self._caps[f"{name}_v"] = cs.req_vals.shape[-1]
+        self._caps[f"{name}_u"] = cs.req_key.shape[0]
         return cs
 
     def compile(self, pods: Sequence[v1.Pod], pad_to: Optional[int] = None) -> PodBatch:

@@ -61,7 +61,13 @@ class Histogram(Metric):
         # the top bucket (round 2's headline p99 WAS the bucket ceiling, i.e.
         # not a measurement), so perf windows also keep raw values and report
         # exact quantiles next to the bucket-interpolated parity ones.
+        # Bounded (unlike the bucket counts, which are fixed-size anyway):
+        # outside a measured window nothing calls reset(), and an unbounded
+        # per-observation list would leak in a long-running scheduler.  Perf
+        # windows reset() first and observe far fewer than the cap.
         self._samples: Dict[Tuple, List[float]] = {}
+        self._samples_dropped: Dict[Tuple, int] = {}
+        self.max_samples = 200_000
         self._lock = threading.Lock()
 
     def observe(self, v: float, labels: Tuple = ()):
@@ -70,7 +76,11 @@ class Histogram(Metric):
             c[bisect.bisect_left(self.buckets, v)] += 1
             self._sum[labels] = self._sum.get(labels, 0.0) + v
             self._n[labels] = self._n.get(labels, 0) + 1
-            self._samples.setdefault(labels, []).append(v)
+            s = self._samples.setdefault(labels, [])
+            if len(s) < self.max_samples:
+                s.append(v)
+            else:
+                self._samples_dropped[labels] = self._samples_dropped.get(labels, 0) + 1
 
     def reset(self):
         """Clear observations in place (measured-window deltas,
@@ -80,6 +90,7 @@ class Histogram(Metric):
             self._sum.clear()
             self._n.clear()
             self._samples.clear()
+            self._samples_dropped.clear()
 
     def samples(self, labels: Tuple = ()) -> List[float]:
         with self._lock:

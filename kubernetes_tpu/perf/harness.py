@@ -92,7 +92,9 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
     importlib.reload(m)
 
     store = ObjectStore()
-    sched = TPUScheduler(store, batch_size=w.batch_size)
+    # pipeline: batch N's binding cycle overlaps batch N+1's device window
+    # (the reference's async binding goroutine, scheduler.go:623)
+    sched = TPUScheduler(store, batch_size=w.batch_size, pipeline=True)
     # Pre-size tiers to the run's full extent so no measured cycle pays a
     # DeviceSnapshot shape change (= full program-suite recompile).
     sched.presize(
@@ -150,8 +152,10 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
 
                 unwatch = store.watch(on_bind)
                 t0 = clock()
+                t_last_progress = t0
                 cycle = 0
                 stall = 0
+                waited = 0.0
                 # steady-state split: attempts from cycles with ZERO backend
                 # compiles, so the bench can report what the scheduler costs
                 # once warm separately from compile-affected cycles
@@ -167,19 +171,33 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     stats = sched.schedule_cycle()
                     if monitor.snapshot()[0] == c_pre:
                         steady.extend(hist.samples()[n_samp:])
+                    if stats.attempted == 0 and stats.in_flight == 0:
+                        # queue drained this instant, but pods may be waiting
+                        # out their backoff (1s→10s) or the unschedulableQ
+                        # flush — the reference's flush goroutines just tick;
+                        # spin-wait rather than misreading backoff as done.
+                        a, b, u = sched.queue.pending_count()
+                        if (b == 0 and u == 0) or waited > 30.0:
+                            break
+                        time.sleep(0.02)
+                        waited += 0.02
+                        continue
                     cycle += 1
-                    if stats.scheduled == 0 and stats.attempted == 0:
-                        break
                     if stats.scheduled == 0:
                         stall += 1
                         # permanently unschedulable backlog (e.g. the
                         # Unschedulable suite's 9-cpu fillers) — give up
                         # once nothing progresses for a few cycles
-                        if stall >= 4:
+                        if stall >= 8 and waited > 12.0:
                             break
                     else:
                         stall = 0
-                total_s = clock() - t0
+                        waited = 0.0
+                        t_last_progress = clock()
+                # throughput window ends at the LAST bind, not after any
+                # terminal backoff spin-wait — otherwise a tail of permanently
+                # unschedulable pods dilutes the number with sleep time
+                total_s = (t_last_progress if done else clock()) - t0
                 win_c1, win_s1 = monitor.snapshot()
                 unwatch()
                 n_done = done
@@ -192,6 +210,9 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 samples = sorted(hist.samples())
 
                 def _exact(vals: List[float], q: float) -> float:
+                    """Nearest-rank quantile of a pre-sorted plain list (the
+                    steady-state split below isn't a Histogram; the histogram
+                    path uses Histogram.exact_quantile — same definition)."""
                     if not vals:
                         return 0.0
                     return vals[min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))]
@@ -210,9 +231,9 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         # exact quantiles from raw samples — the bucket ones
                         # above saturate at the top bucket edge (round-2 p99
                         # railed at 16.384s); these never do
-                        "ExactPerc50": _exact(samples, 0.50),
-                        "ExactPerc90": _exact(samples, 0.90),
-                        "ExactPerc99": _exact(samples, 0.99),
+                        "ExactPerc50": hist.exact_quantile(0.50),
+                        "ExactPerc90": hist.exact_quantile(0.90),
+                        "ExactPerc99": hist.exact_quantile(0.99),
                         "Max": samples[-1] if samples else 0.0,
                     },
                     unit="s",

@@ -1,72 +1,58 @@
-"""Shared device helpers for plugin tensor programs."""
+"""Shared device helpers for plugin tensor programs.
+
+All selector-vs-object matrices go through the batched matrix evaluators in
+state/selectors.py (unique-selector dedup + broadcast compares, no per-element
+gathers); the vmap-of-scalar-eval forms they replace lowered to serial
+minor-axis gathers on TPU and dominated prepare at 5k nodes.
+"""
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.interface import MAX_NODE_SCORE
-from ..state.selectors import eval_label_selector, eval_requirements
+from ..state.selectors import (
+    label_match_matrix,
+    node_match_matrix,
+    requirements_match_matrix,
+)
 
 
-def label_selector_matrix(cs, node_keys, node_vals, numeric):
-    """CompiledLabelSelectors (batch B) × node label sets [N, L] → bool[B, N]."""
-    b = cs.req_key.shape[0]
-
-    def one_sel(i):
-        return jax.vmap(
-            lambda keys, vals: eval_label_selector(cs, i, keys, vals, numeric)
-        )(node_keys, node_vals)
-
-    return jax.vmap(one_sel)(jnp.arange(b))
+def label_selector_matrix(cs, node_keys, node_vals, numeric, vals_num=None):
+    """CompiledLabelSelectors (batch B) × label sets [N, L] → bool[B, N]."""
+    return label_match_matrix(cs, node_keys, node_vals, vals_num=vals_num, numeric=numeric)
 
 
-def node_selector_matrix(cns, node_keys, node_vals, numeric):
+def node_selector_matrix(cns, node_keys, node_vals, numeric, vals_num=None):
     """CompiledNodeSelectors (batch B) × node label sets [N, L] → bool[B, N].
 
     OR over valid terms, AND over each term's requirements; match_all rows → True.
     """
-    rk = jnp.asarray(cns.req_key)      # [B, T, S]
-    ro = jnp.asarray(cns.req_op)
-    rv = jnp.asarray(cns.req_vals)     # [B, T, S, V]
-    rn = jnp.asarray(cns.req_num)
-    tv = jnp.asarray(cns.term_valid)   # [B, T]
-    ma = jnp.asarray(cns.match_all)    # [B]
-
-    def one_node(keys, vals):
-        per_term = jax.vmap(
-            jax.vmap(lambda k, o, v, n: eval_requirements(k, o, v, n, keys, vals, numeric))
-        )(rk, ro, rv, rn)  # [B, T]
-        return ma | jnp.any(per_term & tv, axis=-1)  # [B]
-
-    return jax.vmap(one_node, out_axes=1)(node_keys, node_vals)  # [B, N]
+    return node_match_matrix(cns, node_keys, node_vals, vals_num=vals_num, numeric=numeric)
 
 
 def weighted_term_matrix(req_key, req_op, req_vals, req_num, term_valid, weight,
-                         node_keys, node_vals, numeric):
+                         node_keys, node_vals, numeric, vals_num=None):
     """Preferred-term arrays [B, T, ...] × nodes [N, L] → f32[B, N] summed weights
     of matching terms (nodeaffinity/node_affinity.go Score)."""
-
-    def one_node(keys, vals):
-        match = jax.vmap(
-            jax.vmap(lambda k, o, v, n: eval_requirements(k, o, v, n, keys, vals, numeric))
-        )(jnp.asarray(req_key), jnp.asarray(req_op),
-          jnp.asarray(req_vals), jnp.asarray(req_num))  # [B, T]
-        return jnp.sum(jnp.where(match & term_valid, weight, 0.0), axis=-1)  # [B]
-
-    return jax.vmap(one_node, out_axes=1)(node_keys, node_vals)  # [B, N]
+    b, t = np.shape(req_key)[0], np.shape(req_key)[1]
+    s = np.shape(req_key)[2]
+    match = requirements_match_matrix(
+        jnp.reshape(jnp.asarray(req_key), (b * t, s)),
+        jnp.reshape(jnp.asarray(req_op), (b * t, s)),
+        jnp.reshape(jnp.asarray(req_vals), (b * t, s, -1)),
+        jnp.reshape(jnp.asarray(req_num), (b * t, s)),
+        node_keys, node_vals, vals_num=vals_num, numeric=numeric,
+    ).reshape(b, t, -1)  # [B, T, N]
+    w = jnp.asarray(weight)[:, :, None]
+    return jnp.sum(jnp.where(match & jnp.asarray(term_valid)[:, :, None], w, 0.0), axis=1)
 
 
 def flat_selector_matrix(cs, b, t, keys, vals, numeric):
     """Flattened CompiledLabelSelectors (batch b·t, row-major) × label sets
     [P, L] → bool[b, t, P]."""
-
-    def one_sel(fi):
-        return jax.vmap(
-            lambda k, v: eval_label_selector(cs, fi, k, v, numeric)
-        )(keys, vals)
-
-    return jax.vmap(one_sel)(jnp.arange(b * t)).reshape(b, t, -1)
+    return label_match_matrix(cs, keys, vals, numeric=numeric).reshape(b, t, -1)
 
 
 def default_normalize(scores, mask, reverse: bool = False):

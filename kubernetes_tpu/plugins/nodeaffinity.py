@@ -32,10 +32,12 @@ class NodeAffinityPlugin(Plugin):
 
     def filter(self, batch, snap, dyn, aux=None):
         sel_ok = label_selector_matrix(
-            batch.node_selector, snap.node_label_keys, snap.node_label_vals, snap.numeric
+            batch.node_selector, snap.node_label_keys, snap.node_label_vals,
+            snap.numeric, vals_num=snap.node_label_num,
         )
         aff_ok = node_selector_matrix(
-            batch.node_affinity, snap.node_label_keys, snap.node_label_vals, snap.numeric
+            batch.node_affinity, snap.node_label_keys, snap.node_label_vals,
+            snap.numeric, vals_num=snap.node_label_num,
         )
         return sel_ok & aff_ok  # [B, N]
 
@@ -44,6 +46,7 @@ class NodeAffinityPlugin(Plugin):
             batch.pref_req_key, batch.pref_req_op, batch.pref_req_vals,
             batch.pref_req_num, batch.pref_valid, batch.pref_weight,
             snap.node_label_keys, snap.node_label_vals, snap.numeric,
+            vals_num=snap.node_label_num,
         )
 
     def normalize(self, scores, mask):

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import jax
 import jax.numpy as jnp
 
 from ..framework.events import ActionType, ClusterEvent, EventResource
@@ -29,7 +28,7 @@ from ..framework.interface import MAX_NODE_SCORE, Plugin
 from ..framework.podbatch import WHEN_DO_NOT_SCHEDULE, WHEN_SCHEDULE_ANYWAY
 from ..ops import domain_any, domain_gather, domain_scatter_add, point_scatter_add
 from ..state.dictionary import MISSING
-from ..state.selectors import eval_label_selector
+from ..state.selectors import label_match_matrix
 from .helpers import label_selector_matrix, node_selector_matrix
 
 # plain Python int, NOT a module-level device array: a concrete jax.Array
@@ -87,10 +86,12 @@ class PodTopologySpreadPlugin(Plugin):
 
         # nodes eligible for counting: pass pod's nodeSelector + required affinity
         sel_ok = label_selector_matrix(
-            batch.node_selector, snap.node_label_keys, snap.node_label_vals, snap.numeric
+            batch.node_selector, snap.node_label_keys, snap.node_label_vals,
+            snap.numeric, vals_num=snap.node_label_num,
         )
         aff_ok = node_selector_matrix(
-            batch.node_affinity, snap.node_label_keys, snap.node_label_vals, snap.numeric
+            batch.node_affinity, snap.node_label_keys, snap.node_label_vals,
+            snap.numeric, vals_num=snap.node_label_num,
         )
         affinity_ok = sel_ok & aff_ok & snap.node_valid[None, :]  # [B, N]
         has_all_hard = jnp.all(~hard_valid[:, :, None] | has_key, axis=1)  # [B, N]
@@ -143,16 +144,9 @@ class PodTopologySpreadPlugin(Plugin):
     def _selector_vs_pods(self, batch, pl_keys, pl_vals, p_ns, numeric, same_ns=True):
         """Constraint selectors [B, C] vs pod label sets [P, L] → bool[B, C, P]."""
         b, c_cap = batch.tsc_valid.shape
-        flat_idx = jnp.arange(b * c_cap)
-
-        def one_sel(fi):
-            return jax.vmap(
-                lambda keys, vals: eval_label_selector(
-                    batch.tsc_selectors, fi, keys, vals, numeric
-                )
-            )(pl_keys, pl_vals)
-
-        m = jax.vmap(one_sel)(flat_idx).reshape(b, c_cap, -1)  # [B, C, P]
+        m = label_match_matrix(
+            batch.tsc_selectors, pl_keys, pl_vals, numeric=numeric
+        ).reshape(b, c_cap, -1)  # [B, C, P] (evaluated at U unique selectors)
         if same_ns:
             m = m & (batch.ns[:, None, None] == p_ns[None, None, :])
         return m

@@ -107,10 +107,18 @@ class Evaluator:
         node_infos: List[NodeInfo],
         pdbs: Sequence[v1.PodDisruptionBudget] = (),
         cluster_has_req_anti_affinity: bool = True,
+        nominated: Optional[Dict[str, List[v1.Pod]]] = None,
     ) -> Optional[Candidate]:
         """SelectVictimsOnNode (default_preemption.go:139): remove all lower-
         priority pods, verify fit, then reprieve greedily (PDB-violating pods
-        reprieved first, both groups by descending importance)."""
+        reprieved first, both groups by descending importance).
+
+        ``nominated`` maps node name → pods already nominated there; equal-or-
+        higher-priority nominees are added to the simulated node before the fit
+        check (the reference's AddNominatedPods inside
+        RunFilterPluginsWithNominatedPods, runtime/framework.go:822-836) so a
+        burst of same-priority preemptors spreads across nodes instead of all
+        claiming the first viable one."""
         sim = info.clone()
         potential = [
             pi.pod for pi in info.pods if pi.pod.spec.priority < pod.spec.priority
@@ -119,6 +127,9 @@ class Evaluator:
             return None
         for victim in potential:
             sim.remove_pod(victim)
+        for nom in (nominated or {}).get(info.node_name, []):
+            if nom.uid != pod.uid and nom.spec.priority >= pod.spec.priority:
+                sim.add_pod(nom)
 
         # Cross-node context is only needed when the preemptor carries
         # global constraints (topology-spread min counts, pod-affinity
@@ -208,6 +219,7 @@ class Evaluator:
         candidate_nodes: Sequence[str],
         pdbs: Sequence[v1.PodDisruptionBudget] = (),
         max_candidates: Optional[int] = None,
+        nominated: Optional[Dict[str, List[v1.Pod]]] = None,
     ) -> Optional[Candidate]:
         """Evaluate candidates (already device-prefiltered), pick one.
 
@@ -227,6 +239,7 @@ class Evaluator:
             c = self.select_victims_on_node(
                 pod, info, node_infos, pdbs,
                 cluster_has_req_anti_affinity=has_anti,
+                nominated=nominated,
             )
             if c is not None:
                 candidates.append(c)

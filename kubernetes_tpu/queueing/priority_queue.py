@@ -71,6 +71,11 @@ class PriorityQueue:
         self._in_active: Set[str] = set()
         self._in_backoff: Set[str] = set()
         self._moves: int = 0  # moveRequestCycle analog
+        # Debounce: move_all_to_active_or_backoff only records the event; the
+        # O(unschedulable) match scan runs once per flush() over the deduped
+        # pending set.  A 128-pod bind burst otherwise triggers 128 full scans
+        # (each bind's watch event calls move_all — eventhandlers.go analog).
+        self._pending_events: List[ClusterEvent] = []
 
     # --- sort key ------------------------------------------------------------
 
@@ -106,6 +111,7 @@ class PriorityQueue:
         return len(self._active)
 
     def pending_count(self) -> Tuple[int, int, int]:
+        self._apply_pending_moves()
         return len(self._active), len(self._backoff), len(self._unschedulable)
 
     def pop(self) -> Optional[QueuedPodInfo]:
@@ -178,11 +184,29 @@ class PriorityQueue:
         self._backoff = kept
 
     def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
-        """MoveAllToActiveOrBackoffQueue (:608) + podMatchesEvent (:963)."""
+        """MoveAllToActiveOrBackoffQueue (:608) + podMatchesEvent (:963).
+
+        The move counter bumps immediately (AddUnschedulableIfNotPresent's
+        backoff-vs-unschedulable decision depends on it) but the scan is
+        deferred to flush(), which every pop() runs first — observable
+        behavior is unchanged, repeated events within one burst cost one scan."""
         self._moves += 1
+        self._pending_events.append(event)
+
+    def _apply_pending_moves(self) -> None:
+        if not self._pending_events:
+            return
+        events, self._pending_events = self._pending_events, []
+        seen = set()
+        deduped = []
+        for ev in events:
+            k = (ev.resource, ev.action_type)
+            if k not in seen:
+                seen.add(k)
+                deduped.append(ev)
         moved = []
-        for uid, info in list(self._unschedulable.items()):
-            if self._pod_matches_event(info, event):
+        for uid, info in self._unschedulable.items():
+            if any(self._pod_matches_event(info, ev) for ev in deduped):
                 moved.append(uid)
         for uid in moved:
             info = self._unschedulable.pop(uid)
@@ -219,6 +243,7 @@ class PriorityQueue:
     # --- flush loops (reference: goroutines at 1s / 30s) ----------------------
 
     def flush(self) -> None:
+        self._apply_pending_moves()
         now = self._clock()
         while self._backoff:
             expiry, _, info = self._backoff[0]

@@ -10,8 +10,17 @@ design:
     one-pod outer loop and the 16-goroutine node fan-out.
   - No adaptive node sampling (scheduler.go:852-872): all nodes are scored
     densely on device; percentageOfNodesToScore is accepted but ignored.
-  - Bindings are synchronous against the sim store (the reference's async
-    binding goroutine exists to hide apiserver latency, scheduler.go:623).
+  - Pipelined binding (``pipeline=True``): the reference splits assume
+    (synchronous cache write, scheduler.go:571) from the binding cycle (a
+    detached goroutine, scheduler.go:623) so store latency never blocks the
+    next scheduling cycle.  The device analog: batch N's decisions are
+    fetched asynchronously (copy_to_host_async), its pods are assumed in the
+    cache, batch N+1 is dispatched against a snapshot containing those
+    assumes, and only THEN batch N's reserve/permit/bind host work runs —
+    overlapping the device window.  A failed bind forgets the assume and
+    requeues exactly as the reference's binding-cycle error path
+    (scheduler.go:676-689).  Synchronous mode (default) runs both halves
+    back-to-back — same results, no overlap.
 """
 
 from __future__ import annotations
@@ -75,6 +84,23 @@ class CycleStats:
     scheduled: int = 0
     unschedulable: int = 0
     batch_seconds: float = 0.0
+    in_flight: int = 0  # pods dispatched to device, decision not yet bound
+
+
+@dataclass
+class _InFlight:
+    """One dispatched batch awaiting fetch/bind (the pipelined binding cycle)."""
+
+    infos: List[QueuedPodInfo]
+    batch: object
+    dsnap: object
+    dyn: object
+    auxes: object
+    node_row_dev: object  # device array, copy_to_host_async'd at dispatch
+    algo_lat: object  # np.ndarray once known, or None → filled at fetch
+    t0: float
+    cycle: int
+    node_names: Optional[List[Optional[str]]] = None  # resolved at _complete
 
 
 class TPUScheduler:
@@ -89,9 +115,17 @@ class TPUScheduler:
         extenders: Optional[List] = None,
         assign_mode: str = "auto",
         coupled_fraction_threshold: float = 0.25,
+        pipeline: bool = False,
     ):
         if assign_mode not in ("auto", "scan", "batch"):
             raise ValueError(f"unknown assign_mode {assign_mode!r}")
+        # pipeline=True defers batch N's reserve/bind host work until after
+        # batch N+1 is dispatched (assume feeds the snapshot in between) —
+        # the device analog of the reference's async binding goroutine
+        # (scheduler.go:623).  Default off: tests and interactive callers get
+        # the synchronous contract (schedule_cycle returns with pods bound).
+        self.pipeline = pipeline
+        self._inflight: Optional[_InFlight] = None
         # "scan" = exact greedy-sequential lax.scan; "batch" = round-based
         # parallel prefix commits (framework/runtime.py batch_assign); "auto"
         # uses batch unless the coupled fraction exceeds the threshold
@@ -128,11 +162,12 @@ class TPUScheduler:
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
-        # nominator: uid → (node_name, request vector) for pods holding a
+        # nominator: uid → (node_name, request vector, pod) for pods holding a
         # nominated node across cycles (their reservation is added to the
-        # dynamic state so other pods don't steal the spot —
+        # dynamic state so other pods don't steal the spot, and preemption
+        # dry-runs see them on their nominated node —
         # RunFilterPluginsWithNominatedPods analog)
-        self._nominated: Dict[str, Tuple[str, np.ndarray]] = {}
+        self._nominated: Dict[str, Tuple[str, np.ndarray, v1.Pod]] = {}
         self._unwatch = store.watch(self._on_event)
 
     # --- event handlers (eventhandlers.go:251+) ------------------------------
@@ -227,7 +262,13 @@ class TPUScheduler:
         north-star bench's p99.  Callers that know the run's extent (the perf
         harness, a real deployment's node inventory) call this once up front.
         """
-        self.encoder.reserve(_pow2(n_nodes, 1), _pow2(n_pods, 1))
+        # n_ids: rough dictionary-size bound (node names + labels + pod
+        # names/labels) so the numeric side-table never crosses a pow2 size
+        # (= a full fused-program recompile) mid-run
+        self.encoder.reserve(
+            _pow2(n_nodes, 1), _pow2(n_pods, 1),
+            n_ids=16 * n_nodes + 8 * n_pods,
+        )
 
     # --- framework / jit management ------------------------------------------
 
@@ -236,21 +277,35 @@ class TPUScheduler:
         if self._fw is None or d != self._fw_domain_cap:
             fw = self._fw = BatchedFramework(self._plugins_factory(d))
             self._fw_domain_cap = d
+            from .state.encoding import apply_scatter
 
-            # prepare fused INTO each engine: one device dispatch per cycle
-            # (each separate dispatch pays a host→device round trip, which
-            # dominates small-cluster cycles on a remote-attached TPU); the
-            # standalone prepare remains for the extender/diagnose path.
-            def fused_greedy(batch, dsnap, dyn, host_auxes, order, key):
-                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-                return fw.greedy_assign(batch, dsnap, dyn, auxes, order, key), auxes
-
-            def fused_batch(batch, dsnap, dyn, host_auxes, order, coupling, key):
-                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-                return (
-                    fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key),
-                    auxes,
+            # EVERYTHING fused into one program per cycle: the deferred
+            # snapshot row-scatter, the nominated-pod reservations, prepare,
+            # and the assignment engine.  Each separate device program on the
+            # tunnel-attached TPU pays a ~100ms pacing round, so the eager
+            # scatter/upload path tripled cycle latency.  The standalone
+            # prepare remains for the extender/diagnose path.
+            def reserve_nominated(dsnap, nom_rows, nom_req):
+                dyn = initial_dynamic_state(dsnap)
+                rows = jnp.clip(nom_rows, 0, dsnap.requested.shape[0] - 1)
+                add = jnp.where((nom_rows >= 0)[:, None], nom_req, 0)
+                return dyn._replace(
+                    requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
                 )
+
+            def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
+                dsnap = apply_scatter(dsnap, upd)
+                dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+                res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
+                return res, auxes, dsnap, dyn
+
+            def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
+                dsnap = apply_scatter(dsnap, upd)
+                dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+                res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
+                return res, auxes, dsnap, dyn
 
             self._jitted = {
                 "prepare": jax.jit(fw.prepare),
@@ -263,18 +318,43 @@ class TPUScheduler:
     # --- the batched scheduling cycle ----------------------------------------
 
     def schedule_cycle(self) -> CycleStats:
-        """Pop a batch, schedule it on device, bind, requeue failures."""
+        """One pipelined step: complete the in-flight batch (fetch + assume),
+        dispatch the next batch against the assumed snapshot, then run the
+        completed batch's binding cycle while the new batch computes on device.
+
+        Synchronous mode (pipeline=False) dispatches and completes the same
+        batch within the call — identical results, no overlap."""
+        prev = self._inflight
+        self._inflight = None
+        prev_rows = None
+        if prev is not None:
+            prev_rows = self._complete(prev)  # fetch decisions + assume in cache
+
         infos = self.queue.pop_batch(self.batch_size)
-        stats = CycleStats(attempted=len(infos))
-        if not infos:
-            return stats
+        nxt = self._dispatch_batch(infos) if infos else None
+
+        if prev is not None:
+            stats = self._bind_phase(prev, prev_rows)  # overlaps nxt's device window
+        else:
+            stats = CycleStats()
+
+        if nxt is not None:
+            if self.pipeline:
+                self._inflight = nxt
+                stats.in_flight = len(nxt.infos)
+            else:
+                rows = self._complete(nxt)
+                stats = self._bind_phase(nxt, rows)
+        self._observe_pending()
+        return stats
+
+    def _dispatch_batch(self, infos: List[QueuedPodInfo]) -> _InFlight:
+        """Snapshot → compile → ONE device dispatch; decisions fetched async."""
         t0 = self.clock()
         cycle = self.queue.scheduling_cycle()
-
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
-
         pods = [qi.pod for qi in infos]
         # fixed padding: every cycle compiles to ONE (batch_size, tier)
         # program instead of one per pow-2 backlog size — partial batches
@@ -284,32 +364,79 @@ class TPUScheduler:
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
-        dsnap = self.encoder.to_device()
-        dyn = initial_dynamic_state(dsnap)
-        dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
         if self.extenders:
             # sequential per-pod cycles: each pod's decision lands at its own
             # time, so per-attempt latency must not absorb later pods' cycles
+            dsnap = self.encoder.to_device()
+            dyn = initial_dynamic_state(dsnap)
+            dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
             auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
             node_row, algo_lat = self._assign_with_extenders(
                 batch, dsnap, dyn, auxes, pods, t0
             )
-        else:
-            res, auxes = self._run_assignment(batch, dsnap, dyn, host_auxes)
-            node_row = np.asarray(res.node_row)
-            algo_lat = np.full(len(infos), self.clock() - t0)
+            return _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat, t0, cycle)
+        dsnap, upd = self.encoder.to_device_deferred()
+        nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
+        res, auxes, dsnap_out, dyn_out = self._run_assignment(
+            batch, dsnap, upd, nom_rows, nom_req, host_auxes
+        )
+        self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
+        # start the device→host copy now; np.asarray at completion time is
+        # then (nearly) free — a BLOCKING fetch on this tunnel costs ~100ms
+        # per sync regardless of payload, so exactly one async fetch per
+        # cycle is the latency floor
+        if hasattr(res.node_row, "copy_to_host_async"):
+            res.node_row.copy_to_host_async()
+        return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row, None, t0, cycle)
+
+    def _complete(self, fl: _InFlight) -> np.ndarray:
+        """Fetch the batch's decisions and assume placements in the cache so
+        the NEXT dispatch's snapshot accounts for them (assume :571; the bind
+        happens later, exactly like the reference's binding goroutine)."""
+        # Poll readiness instead of a blocking wait: on the tunnel-attached
+        # TPU a blocking sync costs a ~100ms round regardless of payload,
+        # while an already-landed async copy materializes in ~1ms.
+        dev = fl.node_row_dev
+        if hasattr(dev, "is_ready"):
+            while not dev.is_ready():
+                time.sleep(0.002)
+        node_row = np.asarray(dev)
+        if fl.algo_lat is None:
+            algo = self.clock() - fl.t0
+            fl.algo_lat = np.full(len(fl.infos), algo)
             # one algorithm invocation for the whole batch → one sample
             # (the extender path samples per-pod cycles itself)
-            m.scheduling_algorithm_duration.observe(self.clock() - t0)
-
+            m.scheduling_algorithm_duration.observe(algo)
+        node_row = np.array(node_row)  # own copy — may be demoted below
         name_of = self.encoder.row_to_name()
-        for i, qi in enumerate(infos):
+        # Resolve rows → names NOW, before the next dispatch's encoder.sync
+        # can free/reuse rows of deleted nodes; the bind phase runs after
+        # that sync and must not re-resolve (it would bind to the wrong node).
+        fl.node_names = [None] * len(fl.infos)
+        for i, qi in enumerate(fl.infos):
+            row = int(node_row[i])
+            if row >= 0:
+                name = name_of.get(row)
+                if name is None:  # node deleted since dispatch — retry the pod
+                    node_row[i] = -1
+                    continue
+                fl.node_names[i] = name
+                self._nominated.pop(qi.pod.uid, None)
+                self.cache.assume_pod(qi.pod, name)
+        return node_row
+
+    def _bind_phase(self, fl: _InFlight, node_row: np.ndarray) -> CycleStats:
+        """The binding cycle for a completed batch: reserve → permit → bind
+        per scheduled pod, diagnosis + preemption per unschedulable pod."""
+        stats = CycleStats(attempted=len(fl.infos))
+        batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
+        for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
             row = int(node_row[i])
             if row >= 0:
-                node_name = name_of[row]
-                self._nominated.pop(qi.pod.uid, None)
-                self.cache.assume_pod(qi.pod, node_name)
+                # name resolved at completion time (see _complete) — the
+                # row→name map may have changed under the next dispatch's sync
+                node_name = fl.node_names[i]
                 ok = self._run_reserve_and_bind(qi.pod, node_name)
                 if ok:
                     self.cache.finish_binding(qi.pod)
@@ -321,39 +448,47 @@ class TPUScheduler:
                     )
                 else:  # reserve/bind failed — roll back (scheduler.go:676-689)
                     self.cache.forget_pod(qi.pod)
-                    self.queue.add_unschedulable(qi, cycle)
+                    # a pod deleted while in flight consumed its DELETE event
+                    # already — requeueing it would create a permanent ghost
+                    if self.store.get("Pod", qi.pod.namespace, qi.pod.metadata.name) is not None:
+                        self.queue.add_unschedulable(qi, fl.cycle)
             else:
                 stats.unschedulable += 1
                 m.schedule_attempts.inc(("unschedulable",))
                 qi.unschedulable_plugins = self._diagnose(batch, dsnap, dyn, auxes, i)
                 self._run_post_filter(qi, batch, dsnap, dyn, auxes, i)
-                self.queue.add_unschedulable(qi, cycle)
+                self.queue.add_unschedulable(qi, fl.cycle)
             # True per-attempt latency (scheduler_perf util.go:238-276): the
             # pod's decision is unavailable until its device program returns
             # (whole batch in the fused path, its own cycle in the extender
             # path), so its attempt spans that algorithm time plus its own
             # host reserve/permit/bind segment — not a batch average.
             m.scheduling_attempt_duration.observe(
-                float(algo_lat[i]) + (self.clock() - t_pod)
+                float(fl.algo_lat[i]) + (self.clock() - t_pod)
             )
-        stats.batch_seconds = self.clock() - t0
+        stats.batch_seconds = self.clock() - fl.t0
+        return stats
+
+    def _observe_pending(self):
         a, b, u = self.queue.pending_count()
         m.pending_pods.set(a, ("active",))
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
-        return stats
 
-    def _run_assignment(self, batch, dsnap, dyn, host_auxes):
+    def _run_assignment(self, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
         serializes into one commit per round there, and the row-sliced scan
         is cheaper per step than the dense per-round recompute.
 
-        Returns (AssignResult, device auxes) from ONE fused dispatch."""
+        Returns (AssignResult, auxes, updated dsnap, dyn) from ONE fused
+        dispatch (snapshot scatter + nominations + prepare + assign)."""
         from .framework.runtime import coupling_flags
 
-        order = jnp.arange(batch.size)
+        # numpy, NOT jnp.arange: an eager jnp op is its own device program,
+        # and each program execution on the tunnel pays a ~100ms pacing round
+        order = np.arange(batch.size, dtype=np.int32)
         mode = self.assign_mode
         if mode in ("auto", "batch"):
             coupling = coupling_flags(batch)
@@ -361,10 +496,11 @@ class TPUScheduler:
             frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
             if mode == "batch" or frac <= self.coupled_fraction_threshold:
                 return self._jitted["batch"](
-                    batch, dsnap, dyn, host_auxes, order, coupling, self.rng_key
+                    batch, dsnap, upd, nom_rows, nom_req, host_auxes,
+                    order, coupling, self.rng_key,
                 )
         return self._jitted["greedy"](
-            batch, dsnap, dyn, host_auxes, order, self.rng_key
+            batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, self.rng_key
         )
 
     def _assign_with_extenders(
@@ -475,12 +611,36 @@ class TPUScheduler:
             pw.plugin.post_bind(None, pod, node_name)
         return True
 
+    def _nominated_arrays(self, batch_uids: Set[str]):
+        """Nominated-but-pending pods (not in this batch) as fixed-shape
+        arrays for the fused program: rows i32[K] (-1 pad), reqs f32[K, R].
+        K is a sticky pow-2 cap so nomination churn never changes shapes."""
+        rows, reqs = [], []
+        for uid, (node_name, req, _pod) in list(self._nominated.items()):
+            if uid in batch_uids:
+                continue
+            row = self.encoder.node_rows.get(node_name)
+            if row is None:
+                del self._nominated[uid]
+                continue
+            rows.append(row)
+            reqs.append(req)
+        k = max(_pow2(len(rows), 4), getattr(self, "_nom_cap", 4))
+        self._nom_cap = k
+        r = self.encoder.cfg.num_resource_dims
+        out_rows = np.full(k, -1, dtype=np.int32)
+        out_reqs = np.zeros((k, r), dtype=np.float32)
+        if rows:
+            out_rows[: len(rows)] = rows
+            out_reqs[: len(rows)] = np.asarray(reqs, dtype=np.float32)
+        return out_rows, out_reqs
+
     def _reserve_nominated(self, dyn, batch_uids: Set[str]):
         """Virtually consume resources of nominated-but-pending pods not in this
         batch, so the cycle can't steal their reserved spot."""
         import jax.numpy as jnp
 
-        for uid, (node_name, req) in list(self._nominated.items()):
+        for uid, (node_name, req, _pod) in list(self._nominated.items()):
             if uid in batch_uids:
                 continue
             row = self.encoder.node_rows.get(node_name)
@@ -513,7 +673,12 @@ class TPUScheduler:
         name_of = self.encoder.row_to_name()
         names = [name_of[int(r)] for r in rows if int(r) in name_of]
         pdbs, _ = self.store.list("PodDisruptionBudget")
-        cand = self.preemption.preempt(pod, self.snapshot, names, pdbs)
+        nominated: Dict[str, List[v1.Pod]] = {}
+        for _uid, (nn, _req, npod) in self._nominated.items():
+            nominated.setdefault(nn, []).append(npod)
+        cand = self.preemption.preempt(
+            pod, self.snapshot, names, pdbs, nominated=nominated
+        )
         if cand is None:
             return
         for victim in cand.victims:
@@ -521,7 +686,7 @@ class TPUScheduler:
         m.preemption_victims.observe(len(cand.victims))
         pod.status.nominated_node_name = cand.node_name
         self._nominated[pod.uid] = (
-            cand.node_name, np.asarray(self.encoder.pod_request_units(pod))
+            cand.node_name, np.asarray(self.encoder.pod_request_units(pod)), pod
         )
         self.store.update("Pod", pod)
 
@@ -541,7 +706,7 @@ class TPUScheduler:
         total = CycleStats()
         for _ in range(max_cycles):
             s = self.schedule_cycle()
-            if s.attempted == 0:
+            if s.attempted == 0 and s.in_flight == 0:
                 break
             total.attempted += s.attempted
             total.scheduled += s.scheduled

@@ -98,8 +98,12 @@ class Cache:
             raise SchedulerCacheError(f"pod {pod.key()} already assumed/added")
         # assume on a COPY: the caller's (queued) pod must keep NodeName empty so
         # a failed bind can be retried anywhere (the reference assumes on a
-        # deep-copied pod, scheduler.go:566-581)
-        assumed = copy.deepcopy(pod)
+        # deep-copied pod, scheduler.go:566-581).  A pod+spec shallow copy is
+        # enough here: only spec.node_name diverges, and the shared sub-objects
+        # (metadata, containers) are treated as immutable by the cache — a full
+        # deepcopy measured ~1 ms/pod, 20% of a 128-pod batch's host budget.
+        assumed = copy.copy(pod)
+        assumed.spec = copy.copy(pod.spec)
         assumed.spec.node_name = node_name
         self._add_pod_to_node(assumed)
         self._pod_states[uid] = _PodState(pod=assumed)

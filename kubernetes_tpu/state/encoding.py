@@ -37,7 +37,7 @@ from ..api.resource import (
     compute_pod_resource_request_non_zero,
 )
 from .cache import Snapshot
-from .dictionary import MISSING, Dictionary
+from .dictionary import MISSING, Dictionary, _parse_numeric
 from .node_info import NodeInfo
 from . import units
 
@@ -92,6 +92,7 @@ class DeviceSnapshot:
     non_zero_requested: jnp.ndarray  # i32[N, 2] (cpu milli, mem KiB)
     node_label_keys: jnp.ndarray  # i32[N, L]
     node_label_vals: jnp.ndarray  # i32[N, L]
+    node_label_num: jnp.ndarray  # f32[N, L] Atoi parse of label values (NaN = not a number)
     node_topo: jnp.ndarray  # i32[N, K] compact domain index per registered topo key
     taint_keys: jnp.ndarray  # i32[N, T]
     taint_vals: jnp.ndarray  # i32[N, T]
@@ -126,6 +127,35 @@ from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 _reg(DeviceSnapshot)
 
 
+@dataclass
+class PendingScatter:
+    """Deferred row-scatter payload (see to_device_deferred): each group is
+    None or ``(rows i32[k], vals tuple)`` with k pow2-padded by repeating the
+    first row (idempotent for .set); numeric is a full replacement or None."""
+
+    node_rows: object = None
+    pod_rows: object = None
+    numeric: object = None
+
+
+_reg(PendingScatter)
+
+
+def apply_scatter(dsnap: DeviceSnapshot, upd: Optional[PendingScatter]) -> DeviceSnapshot:
+    """Apply a PendingScatter inside a jitted program (pure, traceable)."""
+    if upd is None:
+        return dsnap
+    out = {k: getattr(dsnap, k) for k in _NODE_ARRAYS + _POD_ARRAYS}
+    for names, group in ((_NODE_ARRAYS, upd.node_rows), (_POD_ARRAYS, upd.pod_rows)):
+        if group is None:
+            continue
+        rows, vals = group
+        for k, v in zip(names, vals):
+            out[k] = out[k].at[rows].set(v)
+    numeric = dsnap.numeric if upd.numeric is None else jnp.asarray(upd.numeric)
+    return DeviceSnapshot(**out, numeric=numeric)
+
+
 class ClusterEncoder:
     """Maintains host numpy mirrors + device buffers; applies incremental updates."""
 
@@ -154,6 +184,8 @@ class ClusterEncoder:
         self._uploaded_numeric_len = -1
         self._dirty_node_rows: set = set()
         self._dirty_pod_rows: set = set()
+        self._scatter_bucket: Dict[str, int] = {}
+        self._numeric_min = 1024  # floor for the numeric side-table pow2 size
         self._shape_changed = True
 
     # --- allocation ---------------------------------------------------------
@@ -168,6 +200,7 @@ class ClusterEncoder:
         self.non_zero_requested = np.zeros((n, 2), dtype=np.int32)
         self.node_label_keys = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
         self.node_label_vals = np.full((n, cfg.label_cap), MISSING, dtype=np.int32)
+        self.node_label_num = np.full((n, cfg.label_cap), np.nan, dtype=np.float32)
         self.node_topo = np.full((n, cfg.topo_key_cap), MISSING, dtype=np.int32)
         self.taint_keys = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
         self.taint_vals = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
@@ -207,7 +240,7 @@ class ClusterEncoder:
             setattr(self, k, v)
         self._shape_changed = True
 
-    def reserve(self, n_nodes: int = 0, n_pods: int = 0):
+    def reserve(self, n_nodes: int = 0, n_pods: int = 0, n_ids: int = 0):
         """Pre-size tiers so mid-run growth (a full recompile of every program
         over the snapshot) never lands inside a measured window.  Callers that
         know the run's extent (perf harness: sum of createNodes/createPods
@@ -216,6 +249,10 @@ class ClusterEncoder:
             self._grow_nodes(n_nodes)
         if n_pods > self._p:
             self._grow_pods(n_pods)
+        if n_ids:
+            # the numeric side-table's pow2 size is part of every fused
+            # program's shape: crossing a pow2 boundary mid-run recompiles
+            self._numeric_min = max(self._numeric_min, _pow2(n_ids, 1024))
 
     # --- resource helpers ----------------------------------------------------
 
@@ -259,6 +296,17 @@ class ClusterEncoder:
             vals[i] = self.dic.intern(val)
         return keys, vals
 
+    def _encode_label_nums(self, labels: Dict[str, str], cap: int) -> np.ndarray:
+        """f32[cap] Atoi-parity numeric parse of each label VALUE, NaN otherwise.
+
+        Precomputed per node so Gt/Lt selector evaluation is a broadcast
+        compare against this plane instead of a per-(selector, node, slot)
+        dictionary-table gather (serial on TPU)."""
+        nums = np.full(cap, np.nan, dtype=np.float32)
+        for i, val in enumerate(labels.values()):
+            nums[i] = _parse_numeric(val)
+        return nums
+
     # --- node encoding -------------------------------------------------------
 
     def encode_node(self, info: NodeInfo) -> int:
@@ -282,6 +330,7 @@ class ClusterEncoder:
         lk, lv = self._encode_labels(labels, cfg.label_cap, f"node {name}")
         self.node_label_keys[row] = lk
         self.node_label_vals[row] = lv
+        self.node_label_num[row] = self._encode_label_nums(labels, cfg.label_cap)
         for k, key in enumerate(self.topo_key_strings):
             val = labels.get(key)
             self.node_topo[row, k] = (
@@ -402,14 +451,32 @@ class ClusterEncoder:
         lk, lv = self._encode_labels(
             pod.metadata.labels, cfg.pod_label_cap, f"pod {pod.key()}"
         )
+        ns = self.dic.intern(pod.namespace)
+        req = self.pod_request_units(pod)
+        nz = self.pod_non_zero_units(pod)
+        # Skip the dirty mark when nothing changed: sync() re-encodes EVERY
+        # pod of a changed node, so without this a bind dirties all of the
+        # node's (unchanged) pods and the scatter bucket grows with cluster
+        # fill — each pow2 crossing recompiles the whole fused cycle program.
+        if (
+            self.pod_valid[row]
+            and self.pod_node[row] == node_row
+            and self.pod_ns[row] == ns
+            and self.pod_priority[row] == pod.spec.priority
+            and np.array_equal(self.pod_label_keys[row], lk)
+            and np.array_equal(self.pod_label_vals[row], lv)
+            and np.array_equal(self.pod_request[row], req)
+            and np.array_equal(self.pod_non_zero[row], nz)
+        ):
+            return row
         self.pod_label_keys[row] = lk
         self.pod_label_vals[row] = lv
         self.pod_valid[row] = True
         self.pod_node[row] = node_row
-        self.pod_ns[row] = self.dic.intern(pod.namespace)
+        self.pod_ns[row] = ns
         self.pod_priority[row] = pod.spec.priority
-        self.pod_request[row] = self.pod_request_units(pod)
-        self.pod_non_zero[row] = self.pod_non_zero_units(pod)
+        self.pod_request[row] = req
+        self.pod_non_zero[row] = nz
         self._dirty_pod_rows.add(row)
         return row
 
@@ -451,14 +518,79 @@ class ClusterEncoder:
 
     # --- device upload -------------------------------------------------------
 
+    def to_device_deferred(self):
+        """Like to_device, but returns the row-scatter payload instead of
+        executing it: ``(dsnap, upd)`` where ``upd`` is None (full upload
+        happened; dsnap is current) or a PendingScatter the caller applies
+        INSIDE its own jitted program via ``apply_scatter`` — so a steady
+        cycle issues ONE device program total.  On the tunnel-attached TPU
+        each separate program execution pays a ~100ms pacing round, which
+        made the eager two-scatter + numeric-upload path 3× slower than the
+        fused compute itself.  Caller MUST ``commit_device()`` the updated
+        DeviceSnapshot returned by its program (the arrays are async —
+        committing the futures immediately is safe)."""
+        numeric = self.dic.numeric_table(min_size=self._numeric_min)
+        n_num = _pow2(numeric.shape[0], self._numeric_min)
+        numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
+        dirty_frac = (
+            (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
+            / max(self._n + self._p, 1)
+        )
+        use_scatter = (
+            self._device is not None
+            and not self._shape_changed
+            and self._device.numeric.shape[0] == n_num
+            and dirty_frac < 0.5
+        )
+        if not use_scatter:
+            return self.to_device(), None
+        d = self._device
+        # Always emit BOTH groups and the numeric table: a None group or an
+        # elided numeric would be a different pytree structure → a fresh
+        # trace+compile of the whole fused program the first time it occurs
+        # (e.g. the first cycle where no node changed).  A no-op group writes
+        # row 0 with its own current values; numeric is ≤128KB.
+        upd = PendingScatter(
+            node_rows=self._gather_rows(_NODE_ARRAYS, self._dirty_node_rows),
+            pod_rows=self._gather_rows(_POD_ARRAYS, self._dirty_pod_rows),
+            numeric=numeric,
+        )
+        self._uploaded_numeric_len = len(self.dic)
+        self._dirty_node_rows.clear()
+        self._dirty_pod_rows.clear()
+        return d, upd
+
+    def _gather_rows(self, names: List[str], dirty: set):
+        """(padded row indices, per-array value rows) for one array group.
+
+        The pad length is a sticky pow-2 HIGH-WATER mark with a 256 floor:
+        the scatter is now traced into the caller's fused program, so a new
+        pad length recompiles the WHOLE cycle program (~10s) — the floor
+        makes the warmup cycle and every steady cycle share one shape, and
+        growth beyond it compiles O(log) times per run.  An empty dirty set
+        yields a no-op payload (scatter row 0 onto itself) at the same shape."""
+        rows = np.fromiter(dirty, dtype=np.int32, count=len(dirty))
+        rows.sort()
+        floor = self._scatter_bucket.get(names[0], 256)
+        k = max(_pow2(max(rows.shape[0], 1), 32), floor)
+        self._scatter_bucket[names[0]] = k
+        padded = np.full(k, rows[0] if rows.shape[0] else 0, dtype=np.int32)
+        padded[: rows.shape[0]] = rows
+        vals = tuple(getattr(self, k_)[padded] for k_ in names)
+        return (padded, vals)
+
+    def commit_device(self, dsnap: DeviceSnapshot):
+        """Adopt a program-updated DeviceSnapshot as the current device state."""
+        self._device = dsnap
+
     def to_device(self, sharding=None) -> DeviceSnapshot:
         """Upload: full device_put when shapes changed or dirt is large, else
         row-scatter updates into the existing buffers (double-buffering is XLA's
         job via donated args in the jitted updater)."""
         import jax
 
-        numeric = self.dic.numeric_table(min_size=1024)
-        n_num = _pow2(numeric.shape[0], 1024)
+        numeric = self.dic.numeric_table(min_size=self._numeric_min)
+        n_num = _pow2(numeric.shape[0], self._numeric_min)
         numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
 
         dirty_frac = (
@@ -522,15 +654,22 @@ class ClusterEncoder:
 from functools import partial as _partial
 
 
-@_partial(jax.jit, donate_argnums=(0,))
+@jax.jit
 def _scatter_rows(arrays, rows, vals):
-    """Fused row-scatter for a whole array group (donated: updates in place)."""
+    """Fused row-scatter for a whole array group.
+
+    NOT donated: the pipelined scheduler keeps the previous cycle's
+    DeviceSnapshot alive for its deferred binding cycle (diagnosis /
+    preemption read it), so the old buffers must survive this update.  The
+    full-copy cost this forgoes is ~50MB of HBM traffic (~0.06ms) per cycle.
+    """
     return tuple(a.at[rows].set(v) for a, v in zip(arrays, vals))
 
 
 _NODE_ARRAYS = [
     "node_valid", "node_name_ids", "allocatable", "requested", "non_zero_requested",
-    "node_label_keys", "node_label_vals", "node_topo", "taint_keys", "taint_vals",
+    "node_label_keys", "node_label_vals", "node_label_num", "node_topo",
+    "taint_keys", "taint_vals",
     "taint_effects", "ports", "image_ids", "image_sizes", "unschedulable",
 ]
 _POD_ARRAYS = [

@@ -55,11 +55,22 @@ from .units import pow2_round_up as _round_up  # shared shape discipline
 
 @dataclass
 class CompiledLabelSelectors:
-    """Batch of B compiled metav1.LabelSelectors.
+    """Batch of B compiled metav1.LabelSelectors, deduplicated to U unique rows.
 
-    req_key  i32[B, S]; req_op i32[B, S]; req_vals i32[B, S, V]
-    req_num  f32[B, S]  — numeric RHS for Gt/Lt (NaN when unparseable)
-    match_none bool[B]  — True for the None selector (matches nothing)
+    A scheduling batch's selectors repeat heavily (all pods of one deployment
+    share one selector), so evaluation arrays hold only the U unique selectors
+    and ``index`` i32[B] maps batch row → unique row.  Matrix evaluators run at
+    U then expand — at 5k nodes this turned the dominant prepare cost into
+    noise (the reference has no analog: it evaluates per (pod, node) pair in
+    Go, labels.Selector.Matches).
+
+    req_key  i32[U, S]; req_op i32[U, S]; req_vals i32[U, S, V]
+    req_num  f32[U, S]  — numeric RHS for Gt/Lt (NaN when unparseable)
+    match_none bool[U]  — True for the None selector (matches nothing)
+    index    i32[B]
+    has_numeric — STATIC (pytree aux): any Gt/Lt op present.  Gates the
+    numeric path at trace time so the common no-Gt/Lt case compiles without
+    the per-element dictionary-table gather (serial on TPU).
     """
 
     req_key: np.ndarray
@@ -67,17 +78,20 @@ class CompiledLabelSelectors:
     req_vals: np.ndarray
     req_num: np.ndarray
     match_none: np.ndarray
+    index: np.ndarray
+    has_numeric: bool = False
 
     def __len__(self):
-        return self.req_key.shape[0]
+        return self.index.shape[0]
 
 
 @dataclass
 class CompiledNodeSelectors:
-    """Batch of B compiled v1.NodeSelectors (terms OR, requirements AND).
+    """Batch of B compiled v1.NodeSelectors (terms OR, requirements AND),
+    deduplicated like CompiledLabelSelectors.
 
-    req_key i32[B, T, S]; req_op i32[B, T, S]; req_vals i32[B, T, S, V]
-    req_num f32[B, T, S]; term_valid bool[B, T]; match_all bool[B]
+    req_key i32[U, T, S]; req_op i32[U, T, S]; req_vals i32[U, T, S, V]
+    req_num f32[U, T, S]; term_valid bool[U, T]; match_all bool[U]; index i32[B]
     """
 
     req_key: np.ndarray
@@ -86,15 +100,17 @@ class CompiledNodeSelectors:
     req_num: np.ndarray
     term_valid: np.ndarray
     match_all: np.ndarray
+    index: np.ndarray
+    has_numeric: bool = False
 
     def __len__(self):
-        return self.req_key.shape[0]
+        return self.index.shape[0]
 
 
 from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 
-_reg(CompiledLabelSelectors)
-_reg(CompiledNodeSelectors)
+_reg(CompiledLabelSelectors, static=("has_numeric",))
+_reg(CompiledNodeSelectors, static=("has_numeric",))
 
 
 def _selector_requirements(sel: v1.LabelSelector):
@@ -112,28 +128,52 @@ def compile_label_selectors(
     dic: Dictionary,
     min_s: int = 4,
     min_v: int = 4,
+    min_u: int = 4,
 ) -> CompiledLabelSelectors:
     b = max(len(selectors), 1)
     req_lists = [
-        _selector_requirements(s) if s is not None else [] for s in selectors
+        _selector_requirements(s) if s is not None else None for s in selectors
     ]
-    s_cap = _round_up(max((len(r) for r in req_lists), default=0), min_s)
+    # dedup: canonical requirement tuple → unique row (order-insensitive AND)
+    keys = [
+        None if r is None
+        else tuple(sorted((k, op, tuple(vals)) for (k, op, vals) in r))
+        for r in req_lists
+    ]
+    uniq: dict = {}
+    index = np.zeros(b, dtype=np.int32)
+    for i, key in enumerate(keys):
+        uid = uniq.get(key)
+        if uid is None:
+            uid = uniq[key] = len(uniq)
+        index[i] = uid
+    uniq_reqs = [None] * len(uniq)
+    for i, key in enumerate(keys):
+        uniq_reqs[uniq[key]] = req_lists[i] if key is not None else None
+    u = _round_up(len(uniq), min_u)
+    s_cap = _round_up(
+        max((len(r) for r in uniq_reqs if r is not None), default=0), min_s
+    )
     v_cap = _round_up(
-        max((len(vals) for reqs in req_lists for (_, _, vals) in reqs), default=0),
+        max((len(vals) for r in uniq_reqs if r is not None for (_, _, vals) in r),
+            default=0),
         min_v,
     )
-    req_key = np.full((b, s_cap), MISSING, dtype=np.int32)
-    req_op = np.full((b, s_cap), OP_PAD, dtype=np.int32)
-    req_vals = np.full((b, s_cap, v_cap), MISSING, dtype=np.int32)
-    req_num = np.full((b, s_cap), np.nan, dtype=np.float32)
-    match_none = np.zeros((b,), dtype=bool)
-    for i, sel in enumerate(selectors):
-        if sel is None:
+    req_key = np.full((u, s_cap), MISSING, dtype=np.int32)
+    req_op = np.full((u, s_cap), OP_PAD, dtype=np.int32)
+    req_vals = np.full((u, s_cap, v_cap), MISSING, dtype=np.int32)
+    req_num = np.full((u, s_cap), np.nan, dtype=np.float32)
+    match_none = np.zeros((u,), dtype=bool)
+    match_none[len(uniq):] = True  # pad rows match nothing
+    has_numeric = False
+    for i, reqs in enumerate(uniq_reqs):
+        if reqs is None:
             match_none[i] = True
             continue
-        for j, (key, op, vals) in enumerate(req_lists[i]):
+        for j, (key, op, vals) in enumerate(reqs):
             req_key[i, j] = dic.intern(key)
             req_op[i, j] = _OP_CODE[op]
+            has_numeric = has_numeric or op in (v1.OP_GT, v1.OP_LT)
             for k, val in enumerate(vals):
                 req_vals[i, j, k] = dic.intern(val)
             if vals:
@@ -141,7 +181,9 @@ def compile_label_selectors(
                     req_num[i, j] = float(int(vals[0]))
                 except ValueError:
                     pass
-    return CompiledLabelSelectors(req_key, req_op, req_vals, req_num, match_none)
+    return CompiledLabelSelectors(
+        req_key, req_op, req_vals, req_num, match_none, index, has_numeric
+    )
 
 
 def compile_node_selectors(
@@ -150,6 +192,7 @@ def compile_node_selectors(
     min_t: int = 2,
     min_s: int = 4,
     min_v: int = 4,
+    min_u: int = 2,
 ) -> CompiledNodeSelectors:
     b = max(len(selectors), 1)
     all_terms: List[List[List]] = []
@@ -176,23 +219,47 @@ def compile_node_selectors(
         ),
         min_v,
     )
-    req_key = np.full((b, t_cap, s_cap), MISSING, dtype=np.int32)
-    req_op = np.full((b, t_cap, s_cap), OP_PAD, dtype=np.int32)
-    req_vals = np.full((b, t_cap, s_cap, v_cap), MISSING, dtype=np.int32)
-    req_num = np.full((b, t_cap, s_cap), np.nan, dtype=np.float32)
-    term_valid = np.zeros((b, t_cap), dtype=bool)
-    match_all = np.zeros((b,), dtype=bool)
-    for i, sel in enumerate(selectors):
-        if sel is None:
+    # dedup: canonical terms tuple → unique row (term order kept — OR of ANDs)
+    keys = [
+        None if selectors[i] is None
+        else tuple(
+            tuple(sorted((k, op, tuple(vals)) for (k, op, vals) in reqs))
+            for reqs in all_terms[i]
+        )
+        for i in range(len(selectors))
+    ]
+    if not keys:
+        keys = [None]
+    uniq: dict = {}
+    index = np.zeros(b, dtype=np.int32)
+    for i, key in enumerate(keys):
+        uid = uniq.get(key)
+        if uid is None:
+            uid = uniq[key] = len(uniq)
+        index[i] = uid
+    uniq_terms = [None] * len(uniq)
+    for i, key in enumerate(keys):
+        uniq_terms[uniq[key]] = all_terms[i] if key is not None else None
+    u = _round_up(len(uniq), min_u)
+    req_key = np.full((u, t_cap, s_cap), MISSING, dtype=np.int32)
+    req_op = np.full((u, t_cap, s_cap), OP_PAD, dtype=np.int32)
+    req_vals = np.full((u, t_cap, s_cap, v_cap), MISSING, dtype=np.int32)
+    req_num = np.full((u, t_cap, s_cap), np.nan, dtype=np.float32)
+    term_valid = np.zeros((u, t_cap), dtype=bool)
+    match_all = np.zeros((u,), dtype=bool)
+    has_numeric = False
+    for i, terms in enumerate(uniq_terms):
+        if terms is None:
             match_all[i] = True
             continue
-        for ti, reqs in enumerate(all_terms[i]):
+        for ti, reqs in enumerate(terms):
             # Reference: an empty term matches nothing → leave term_valid False
             # only for terms with no requirements at all.
             term_valid[i, ti] = len(reqs) > 0
             for j, (key, op, vals) in enumerate(reqs):
                 req_key[i, ti, j] = dic.intern(key)
                 req_op[i, ti, j] = _OP_CODE[op]
+                has_numeric = has_numeric or op in (v1.OP_GT, v1.OP_LT)
                 for k, val in enumerate(vals):
                     req_vals[i, ti, j, k] = dic.intern(val)
                 if vals:
@@ -201,11 +268,109 @@ def compile_node_selectors(
                     except ValueError:
                         pass
     return CompiledNodeSelectors(
-        req_key, req_op, req_vals, req_num, term_valid, match_all
+        req_key, req_op, req_vals, req_num, term_valid, match_all, index, has_numeric
     )
 
 
 # --- device evaluation (pure jnp; jit/vmap-compatible) ----------------------
+
+
+def _op_select(req_op, present, in_vals, gt, lt):
+    """Pick each requirement's result by op code via a where-chain.
+
+    (A take_along_axis over a stacked [6, ...] would lower to a minor-axis
+    element gather — serial on TPU; the chain is 6 fused VPU selects.)"""
+    picked = jnp.where(
+        req_op == OP_IN, present & in_vals,
+        jnp.where(
+            req_op == OP_NOT_IN, (~present) | (~in_vals),  # absent key matches
+            jnp.where(
+                req_op == OP_EXISTS, present,
+                jnp.where(
+                    req_op == OP_DOES_NOT_EXIST, ~present,
+                    jnp.where(req_op == OP_GT, gt, jnp.where(req_op == OP_LT, lt, True)),
+                ),
+            ),
+        ),
+    )
+    return jnp.where(req_op == OP_PAD, True, picked)
+
+
+def requirements_match_matrix(
+    req_key, req_op, req_vals, req_num, keys, vals,
+    vals_num=None, numeric=None, has_numeric: bool = True,
+):
+    """Batched requirement sets × batched label sets → bool match matrix.
+
+    req_key/req_op [U, S]; req_vals [U, S, V]; req_num [U, S];
+    keys/vals i32[O, L] (-1 padded); vals_num f32[O, L] — numeric parse of each
+    label value (NaN unparseable), used for Gt/Lt.  has_numeric is a TRACE-TIME
+    constant: when False the whole numeric path is elided from the program.
+    When True and vals_num is None, falls back to one [O, L] gather from the
+    dictionary numeric side-table (small — O·L elements once, NOT per pair).
+
+    Returns bool[U, O].  One fused program: every op is a broadcast compare /
+    masked reduce on the VPU; no per-element gathers (TPU lowers minor-axis
+    element gathers to ~0.4µs/element serial loops — the round-3 profile
+    showed this was most of the device program at 5k nodes).
+    """
+    rk = jnp.asarray(req_key)[:, :, None, None]      # [U, S, 1, 1]
+    km = (jnp.asarray(keys)[None, None, :, :] == rk) & (rk >= 0)  # [U, S, O, L]
+    present = jnp.any(km, axis=-1)                   # [U, S, O]
+    # Label keys are unique per object → at most one L column matches.
+    val = jnp.max(
+        jnp.where(km, jnp.asarray(vals)[None, None, :, :], MISSING), axis=-1
+    )  # [U, S, O]
+    in_vals = jnp.any(
+        (jnp.asarray(req_vals)[:, :, None, :] == val[:, :, :, None])
+        & (val[:, :, :, None] >= 0),
+        axis=-1,
+    )  # [U, S, O]
+    if has_numeric:
+        if vals_num is None:
+            safe = jnp.clip(jnp.asarray(vals), 0, numeric.shape[0] - 1)
+            vals_num = jnp.where(jnp.asarray(vals) >= 0, numeric[safe], jnp.nan)
+        vn = jnp.max(
+            jnp.where(km, jnp.asarray(vals_num)[None, None, :, :], -jnp.inf), axis=-1
+        )  # [U, S, O]; matched-but-unparseable → NaN (compares False)
+        rn = jnp.asarray(req_num)[:, :, None]
+        gt = present & (vn > rn)
+        lt = present & (vn < rn)
+    else:
+        gt = lt = jnp.zeros(present.shape, bool)
+    ok = _op_select(jnp.asarray(req_op)[:, :, None], present, in_vals, gt, lt)
+    return jnp.all(ok, axis=1)  # [U, O]
+
+
+def label_match_matrix(
+    cs: CompiledLabelSelectors, keys, vals, vals_num=None, numeric=None
+):
+    """Compiled selector batch (B rows, U unique) × label sets [O, L] → bool[B, O]."""
+    m_u = requirements_match_matrix(
+        cs.req_key, cs.req_op, cs.req_vals, cs.req_num, keys, vals,
+        vals_num=vals_num, numeric=numeric, has_numeric=cs.has_numeric,
+    )
+    m_u = m_u & ~jnp.asarray(cs.match_none)[:, None]
+    return m_u[jnp.asarray(cs.index)]  # [B, O] — major-axis gather, cheap
+
+
+def node_match_matrix(
+    cns: CompiledNodeSelectors, keys, vals, vals_num=None, numeric=None
+):
+    """Compiled NodeSelector batch (B rows, U unique) × label sets [O, L] →
+    bool[B, O].  OR over valid terms, AND within a term; match_all rows → True."""
+    u, t, s = cns.req_key.shape
+    per_term = requirements_match_matrix(
+        np.reshape(cns.req_key, (u * t, s)),
+        np.reshape(cns.req_op, (u * t, s)),
+        np.reshape(cns.req_vals, (u * t, s, -1)),
+        np.reshape(cns.req_num, (u * t, s)),
+        keys, vals, vals_num=vals_num, numeric=numeric,
+        has_numeric=cns.has_numeric,
+    ).reshape(u, t, -1)  # [U, T, O]
+    any_term = jnp.any(per_term & jnp.asarray(cns.term_valid)[:, :, None], axis=1)
+    m_u = jnp.asarray(cns.match_all)[:, None] | any_term
+    return m_u[jnp.asarray(cns.index)]
 
 
 def eval_requirements(req_key, req_op, req_vals, req_num, keys, vals, numeric):
@@ -213,7 +378,9 @@ def eval_requirements(req_key, req_op, req_vals, req_num, keys, vals, numeric):
 
     req_key/req_op [S], req_vals [S, V], req_num [S]; keys/vals [L] (-1 padded);
     numeric f32[num_ids] — dictionary numeric side-table. Returns scalar bool.
-    Broadcasts cleanly under vmap along both selector and label-set axes.
+    Broadcasts cleanly under vmap along both selector and label-set axes —
+    kept for the row-sliced scan paths; matrix paths use
+    requirements_match_matrix (no per-element gathers).
     """
     key_match = (keys[None, :] == req_key[:, None]) & (req_key[:, None] >= 0)  # [S, L]
     present = jnp.any(key_match, axis=1)
@@ -224,20 +391,7 @@ def eval_requirements(req_key, req_op, req_vals, req_num, keys, vals, numeric):
     val_num = numeric[safe_val]
     gt = present & (val_num > req_num)  # NaN compares → False
     lt = present & (val_num < req_num)
-    results = jnp.stack(
-        [
-            present & in_vals,  # IN
-            (~present) | (~in_vals),  # NOT_IN (absent key matches)
-            present,  # EXISTS
-            ~present,  # DOES_NOT_EXIST
-            gt,  # GT
-            lt,  # LT
-        ],
-        axis=0,
-    )  # [6, S]
-    op = jnp.clip(req_op, 0, 5)
-    picked = jnp.take_along_axis(results, op[None, :], axis=0)[0]  # [S]
-    ok = jnp.where(req_op == OP_PAD, True, picked)
+    ok = _op_select(req_op, present, in_vals, gt, lt)
     return jnp.all(ok)
 
 
@@ -246,11 +400,12 @@ def eval_label_selector(sel: CompiledLabelSelectors, i, keys, vals, numeric):
 
     Arrays go through jnp.asarray so i may be a tracer (vmap over the batch axis).
     """
-    return (~jnp.asarray(sel.match_none)[i]) & eval_requirements(
-        jnp.asarray(sel.req_key)[i],
-        jnp.asarray(sel.req_op)[i],
-        jnp.asarray(sel.req_vals)[i],
-        jnp.asarray(sel.req_num)[i],
+    u = jnp.asarray(sel.index)[i]
+    return (~jnp.asarray(sel.match_none)[u]) & eval_requirements(
+        jnp.asarray(sel.req_key)[u],
+        jnp.asarray(sel.req_op)[u],
+        jnp.asarray(sel.req_vals)[u],
+        jnp.asarray(sel.req_num)[u],
         keys, vals, numeric,
     )
 

@@ -14,17 +14,25 @@ import dataclasses
 import jax
 
 
-def register_pytree_dataclass(cls, skip=(), skip_default=None):
+def register_pytree_dataclass(cls, skip=(), skip_default=None, static=()):
     """Register dataclass ``cls`` as a pytree; ``skip`` fields are dropped (rebuilt
-    as ``skip_default()`` or their type default on unflatten)."""
-    names = [f.name for f in dataclasses.fields(cls) if f.name not in skip]
+    as ``skip_default()`` or their type default on unflatten); ``static`` fields
+    ride in aux_data — they survive flatten/unflatten and participate in jit
+    cache keys (trace-time constants, e.g. a has-numeric-ops flag)."""
+    names = [
+        f.name for f in dataclasses.fields(cls)
+        if f.name not in skip and f.name not in static
+    ]
     skip_names = tuple(skip)
+    static_names = tuple(static)
 
     def flatten(obj):
-        return tuple(getattr(obj, n) for n in names), None
+        aux = tuple(getattr(obj, n) for n in static_names)
+        return tuple(getattr(obj, n) for n in names), aux
 
-    def unflatten(_aux, children):
+    def unflatten(aux, children):
         kwargs = dict(zip(names, children))
+        kwargs.update(zip(static_names, aux))
         for s in skip_names:
             kwargs[s] = skip_default() if skip_default is not None else []
         return cls(**kwargs)

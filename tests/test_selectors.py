@@ -136,13 +136,16 @@ def test_node_selector_matrix_vs_oracle():
     vals_arr = jnp.asarray(np.stack([e[1] for e in enc]))
     numeric = jnp.asarray(dic.numeric_table())
 
+    c_index = jnp.asarray(compiled.index)
+
     @jax.jit
     def matrix(keys_arr, vals_arr, numeric):
         def one_sel(i):
+            u = c_index[i]  # dedup: batch row → unique selector row
             return jax.vmap(
                 lambda k, vv: sel.eval_node_selector_arrays(
-                    c_req_key[i], c_req_op[i], c_req_vals[i],
-                    c_req_num[i], c_term_valid[i], c_match_all[i],
+                    c_req_key[u], c_req_op[u], c_req_vals[u],
+                    c_req_num[u], c_term_valid[u], c_match_all[u],
                     k, vv, numeric,
                 )
             )(keys_arr, vals_arr)
@@ -150,6 +153,13 @@ def test_node_selector_matrix_vs_oracle():
         return jax.vmap(one_sel)(jnp.arange(len(selectors)))
 
     got = np.asarray(matrix(keys_arr, vals_arr, numeric))
+    # the batched matrix evaluator must agree with the scalar path
+    got2 = np.asarray(
+        jax.jit(lambda k, vv: sel.node_match_matrix(compiled, k, vv, numeric=numeric))(
+            keys_arr, vals_arr
+        )
+    )
+    np.testing.assert_array_equal(got, got2)
     for i, s in enumerate(selectors):
         for j, n in enumerate(nodes):
             want = match_node_selector(s, n)
