@@ -1,0 +1,65 @@
+"""Structured, verbosity-gated logging — the klog v2 analog.
+
+Reference: k8s.io/klog/v2 (klog.InfoS / klog.ErrorS / klog.V(n).InfoS used
+throughout the scheduler, e.g. verbosity-gated score dumps
+pkg/scheduler/scheduler.go:1127-1134).  Mirrors the structured form:
+a message plus key=value pairs, gated by a global verbosity level.
+
+Built on the stdlib logging module so output routing/formatting stays
+standard; the klog-ish surface is ``InfoS``/``ErrorS``/``V(n)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_logger = logging.getLogger("kubernetes_tpu")
+_verbosity = int(os.environ.get("TPU_SCHED_V", "0"))
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def verbosity() -> int:
+    return _verbosity
+
+
+def _fmt(msg: str, kv: dict) -> str:
+    if not kv:
+        return msg
+    parts = " ".join(f"{k}={v!r}" for k, v in kv.items())
+    return f"{msg} {parts}"
+
+
+def info_s(msg: str, **kv) -> None:
+    """klog.InfoS: structured info line."""
+    _logger.info(_fmt(msg, kv))
+
+
+def error_s(err, msg: str, **kv) -> None:
+    """klog.ErrorS: structured error line (err first, like the reference)."""
+    if err is not None:
+        kv = {"err": err, **kv}
+    _logger.error(_fmt(msg, kv))
+
+
+class _Verbose:
+    __slots__ = ("enabled",)
+
+    def __init__(self, enabled: bool):
+        self.enabled = enabled
+
+    def info_s(self, msg: str, **kv) -> None:
+        if self.enabled:
+            _logger.info(_fmt(msg, kv))
+
+    def __bool__(self):
+        return self.enabled
+
+
+def V(level: int) -> _Verbose:
+    """klog.V(n): returns a gate whose info_s only logs at verbosity ≥ n."""
+    return _Verbose(_verbosity >= level)

@@ -6,4 +6,5 @@ from .componentconfig import (  # noqa: F401
     PluginSet,
     load_config,
     build_plugins_for_profile,
+    scheduler_from_config,
 )

@@ -225,3 +225,23 @@ def _construct(name, args, domain_cap, extended_index, num_dims):
     }
     ctor = simple.get(name)
     return ctor() if ctor else None
+
+
+def scheduler_from_config(store, cfg: "KubeSchedulerConfiguration", **kwargs):
+    """Build a TPUScheduler from a KubeSchedulerConfiguration: every profile
+    becomes a framework keyed by its schedulerName (profile.NewMap analog,
+    profile/profile.go:48); queue backoff knobs carry over."""
+    from ..scheduler import TPUScheduler
+
+    profiles = {
+        p.scheduler_name: (
+            lambda d, _p=p: build_plugins_for_profile(_p, domain_cap=d)
+        )
+        for p in cfg.profiles
+    }
+    return TPUScheduler(
+        store, profiles=profiles,
+        pod_initial_backoff=cfg.pod_initial_backoff_seconds,
+        pod_max_backoff=cfg.pod_max_backoff_seconds,
+        **kwargs,
+    )

@@ -35,10 +35,15 @@ class ExtenderConfig:
     filter_verb: str = ""
     prioritize_verb: str = ""
     bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     node_cache_capable: bool = False
     ignorable: bool = False
     http_timeout: float = 5.0
+    # Resource names this extender manages (extender.go:444-471): when
+    # non-empty, the extender is only consulted for pods that request or
+    # limit at least one of them (IsInterested / hasManagedResources).
+    managed_resources: List[str] = field(default_factory=list)
 
 
 class ExtenderError(Exception):
@@ -52,6 +57,60 @@ class HTTPExtender:
     @property
     def is_ignorable(self) -> bool:
         return self.cfg.ignorable
+
+    @property
+    def supports_preemption(self) -> bool:
+        """ProcessPreemption is only attempted when preemptVerb is set
+        (extender.go SupportsPreemption)."""
+        return bool(self.cfg.preempt_verb)
+
+    def is_interested(self, pod: v1.Pod) -> bool:
+        """IsInterested (extender.go:444-471): no managed resources → all
+        pods; otherwise any container (incl. init) requesting/limiting one."""
+        if not self.cfg.managed_resources:
+            return True
+        managed = set(self.cfg.managed_resources)
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            res = c.resources
+            for table in (res.requests, res.limits):
+                if table and managed & set(table):
+                    return True
+        return False
+
+    def process_preemption(
+        self, pod: v1.Pod, node_name_to_victims: Dict[str, dict]
+    ) -> Dict[str, dict]:
+        """ProcessPreemption (extender.go:164-207): ships the candidate
+        victim map, receives the subset of nodes the extender accepts
+        (possibly with different victims).  Victims travel as metaVictims
+        (uids only — the nodeCacheCapable form); an error from an ignorable
+        extender keeps the original candidates."""
+        if not self.supports_preemption:
+            return node_name_to_victims
+        args = {
+            "pod": _pod_to_dict(pod),
+            "nodeNameToMetaVictims": {
+                node: {
+                    "pods": [{"uid": uid} for uid in entry["uids"]],
+                    "numPDBViolations": entry["numPDBViolations"],
+                }
+                for node, entry in node_name_to_victims.items()
+            },
+        }
+        try:
+            result = self._send(self.cfg.preempt_verb, args)
+        except Exception as e:
+            if self.cfg.ignorable:
+                return node_name_to_victims
+            raise ExtenderError(str(e)) from e
+        out = {}
+        for node, meta in (result.get("nodeNameToMetaVictims") or {}).items():
+            if node in node_name_to_victims:
+                out[node] = {
+                    "uids": [p["uid"] for p in (meta or {}).get("pods", [])],
+                    "numPDBViolations": (meta or {}).get("numPDBViolations", 0),
+                }
+        return out
 
     def _send(self, verb: str, payload: dict) -> dict:
         url = f"{self.cfg.url_prefix.rstrip('/')}/{verb}"

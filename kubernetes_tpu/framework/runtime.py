@@ -140,6 +140,70 @@ class BatchedFramework:
         scores = self.run_scores(batch, snap, dyn, auxes, mask)
         return mask, scores
 
+    @property
+    def filter_names(self):
+        """Names of plugins with a Filter, in plugin order (Diagnosis keys)."""
+        return [pw.plugin.name for pw in self.plugins if hasattr(pw.plugin, "filter")]
+
+    def diagnose_bits(self, batch, snap, dyn, auxes):
+        """bool[B, K]: does filter plugin k leave pod b ANY feasible node.
+
+        Computed inside the fused cycle program (XLA CSEs the filter planes
+        with the assignment engine's own), so diagnosing a failed batch costs
+        zero extra device round-trips — the eager per-plugin fallback paid a
+        ~100ms pacing round per plugin per batch (FitError.Diagnosis analog).
+        """
+        b = batch.valid.shape[0]
+        bits = []
+        for pw, aux in zip(self.plugins, auxes):
+            if hasattr(pw.plugin, "filter"):
+                mask = pw.plugin.filter(batch, snap, dyn, aux)
+                # plugins may return a broadcastable [1, N] plane
+                full = mask & snap.node_valid[None, :] & batch.valid[:, None]
+                bits.append(jnp.any(full, axis=1))
+        if not bits:
+            return jnp.ones((b, 0), bool)
+        return jnp.stack(bits, axis=1)
+
+    # --- row-sliced compute (the extender path's per-pod unit) ---------------
+
+    def compute_static(self, batch, snap, dyn, auxes):
+        """Static (dyn-independent) feasibility mask and raw score planes,
+        computed ONCE per batch: the extender path then evaluates each pod as
+        an O(N) row (compute_row) instead of recomputing the full [B, N]
+        planes per pod — O(B·N) total where it was O(B²·N)."""
+        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_raw = []
+        for pw, aux in zip(self.plugins, auxes):
+            p = pw.plugin
+            if not p.dynamic and hasattr(p, "filter"):
+                static_mask = static_mask & p.filter(batch, snap, dyn, aux)
+            if hasattr(p, "score") and not p.dynamic:
+                static_raw.append(p.score(batch, snap, dyn, aux))
+        return static_mask, tuple(static_raw)
+
+    def compute_row(self, batch, snap, dyn, auxes, static_mask, static_raw, i):
+        """Pod i's feasibility row and weighted total scores [N] against the
+        current dynamic state (same math as greedy_assign's scan step)."""
+        row_mask = static_mask[i]
+        for pw, aux in zip(self.plugins, auxes):
+            if pw.plugin.dynamic and hasattr(pw.plugin, "filter_row"):
+                row_mask = row_mask & pw.plugin.filter_row(batch, snap, dyn, aux, i)
+        total = jnp.zeros(row_mask.shape, jnp.float32)
+        k = 0
+        for pw, aux in zip(self.plugins, auxes):
+            p = pw.plugin
+            if hasattr(p, "score") and not p.dynamic:
+                plane = static_raw[k]
+                k += 1
+                norm = p.normalize(plane[i][None, :], row_mask[None, :])[0]
+                total = total + pw.weight * jnp.floor(norm)
+            elif p.dynamic and hasattr(p, "score_row"):
+                raw = p.score_row(batch, snap, dyn, aux, i, mask_row=row_mask)
+                norm = p.normalize(raw[None, :], row_mask[None, :])[0]
+                total = total + pw.weight * jnp.floor(norm)
+        return row_mask, jnp.where(row_mask, total, -jnp.inf)
+
     # --- host selection (parity with scheduler.go:827-848) -------------------
 
     @staticmethod

@@ -64,3 +64,31 @@ def shard_dynamic_state(dyn, mesh: Mesh):
         requested=jax.device_put(dyn.requested, NamedSharding(mesh, _node_spec(2))),
         non_zero=jax.device_put(dyn.non_zero, NamedSharding(mesh, _node_spec(2))),
     )
+
+
+def shard_host_auxes(host_auxes, mesh: Mesh, n_nodes: int):
+    """Shard host-prepared aux planes: any array whose LAST dim equals the
+    node tier (volume masks, IPA exist-anti-block / static-score planes, all
+    ``[B, N]``) gets node sharding on that axis; everything else replicates.
+
+    host_auxes is the dict host_prepare returns: plugin name → None | dict of
+    numpy arrays.
+    """
+    if host_auxes is None:
+        return None
+
+    def put(arr):
+        if hasattr(arr, "shape") and arr.ndim >= 1 and arr.shape[-1] == n_nodes:
+            spec = P(*([None] * (arr.ndim - 1) + [NODE_AXIS]))
+            return jax.device_put(arr, NamedSharding(mesh, spec))
+        return jax.device_put(arr, replicate(mesh))
+
+    out = {}
+    for name, aux in host_auxes.items():
+        if aux is None:
+            out[name] = None
+        elif isinstance(aux, dict):
+            out[name] = {k: put(v) for k, v in aux.items()}
+        else:
+            out[name] = put(aux)
+    return out

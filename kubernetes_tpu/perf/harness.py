@@ -116,17 +116,23 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
             if op.collect_metrics:
-                # jit warmup BEFORE the measured pods exist: drive one
-                # disposable pod through the full cycle so a cold compile
-                # (tens of seconds) can't pollute the first measured
-                # attempts — the reference has no compile phase to exclude
-                warm = (
-                    make_pod().name("warmup-pod").uid("warmup-pod")
-                    .namespace("default").req({"cpu": "1m"}).obj()
-                )
-                store.create("Pod", warm)
-                sched.schedule_cycle()
-                store.delete("Pod", "default", "warmup-pod")
+                # jit warmup BEFORE the measured pods exist: drive TWO
+                # disposable pods through back-to-back cycles so BOTH program
+                # variants compile pre-window — cycle 1 is the full-upload
+                # snapshot path, cycle 2 the steady-state scatter path (a
+                # different traced shape; compiling it mid-window cost the
+                # Unschedulable suite a 6s stall) — the reference has no
+                # compile phase to exclude
+                for wi in range(2):
+                    warm = (
+                        make_pod().name(f"warmup-pod{wi}").uid(f"warmup-pod{wi}")
+                        .namespace("default").req({"cpu": "1m"}).obj()
+                    )
+                    store.create("Pod", warm)
+                    sched.schedule_cycle()
+                    sched.schedule_cycle()  # pipeline: complete + bind it
+                for wi in range(2):
+                    store.delete("Pod", "default", f"warmup-pod{wi}")
             created = []
             for _ in range(op.count):
                 p = tmpl(pod_idx)
@@ -166,7 +172,9 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 while done < len(created) and cycle < max_cycles:
                     if w.churn_between_cycles is not None:
                         w.churn_between_cycles(store, cycle)
-                    n_samp = hist.count()
+                    # index into the CAPPED raw-sample list, not count():
+                    # they diverge once the histogram drops samples
+                    n_samp = len(hist.samples())
                     c_pre = monitor.snapshot()[0]
                     stats = sched.schedule_cycle()
                     if monitor.snapshot()[0] == c_pre:

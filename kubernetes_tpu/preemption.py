@@ -220,11 +220,17 @@ class Evaluator:
         pdbs: Sequence[v1.PodDisruptionBudget] = (),
         max_candidates: Optional[int] = None,
         nominated: Optional[Dict[str, List[v1.Pod]]] = None,
+        extenders: Sequence = (),
     ) -> Optional[Candidate]:
-        """Evaluate candidates (already device-prefiltered), pick one.
+        """Evaluate candidates (already device-prefiltered), consult
+        preemption-capable extenders, pick one.
 
         Candidate cap mirrors default_preemption.go:110-127:
-        max(100, 10%·n) unless overridden.
+        max(100, 10%·n) unless overridden.  Extender callout mirrors
+        preemption.go callExtenders → HTTPExtender.ProcessPreemption
+        (extender.go:164-207): each interested, preemption-capable extender
+        filters the candidate map in turn; a non-ignorable error aborts the
+        preemption attempt.
         """
         n = len(snapshot.node_info_list)
         cap = max_candidates or max(100, n // 10)
@@ -243,7 +249,41 @@ class Evaluator:
             )
             if c is not None:
                 candidates.append(c)
+        candidates = self._call_extenders(pod, candidates, extenders)
         return self.pick_one_node(candidates)
+
+    def _call_extenders(
+        self, pod: v1.Pod, candidates: List[Candidate], extenders: Sequence
+    ) -> List[Candidate]:
+        if not candidates:
+            return candidates
+        for ext in extenders:
+            if not getattr(ext, "supports_preemption", False):
+                continue
+            if not ext.is_interested(pod):
+                continue
+            victim_map = {
+                c.node_name: {
+                    "uids": [p.uid for p in c.victims],
+                    "numPDBViolations": c.num_pdb_violations,
+                }
+                for c in candidates
+            }
+            filtered = ext.process_preemption(pod, victim_map)
+            by_node = {c.node_name: c for c in candidates}
+            out = []
+            for node, entry in filtered.items():
+                c = by_node.get(node)
+                if c is None:
+                    continue
+                keep = set(entry["uids"])
+                victims = [p for p in c.victims if p.uid in keep]
+                if victims:
+                    out.append(Candidate(node, victims, entry["numPDBViolations"]))
+            candidates = out
+            if not candidates:
+                break
+        return candidates
 
 
 def _argmin(pool, key):

@@ -125,14 +125,32 @@ class PriorityQueue:
                 return info
         return None
 
-    def pop_batch(self, max_size: int) -> List[QueuedPodInfo]:
-        """Drain up to max_size ready pods — the device batch unit."""
+    def pop_batch(self, max_size: int, group_key=None) -> List[QueuedPodInfo]:
+        """Drain up to max_size ready pods — the device batch unit.
+
+        ``group_key(info)``: when given, the batch holds only pods sharing
+        the HEAD pod's key (e.g. schedulerName — one framework per dispatch,
+        profile/profile.go:45); non-matching pods are pushed back untouched."""
         out = []
-        while len(out) < max_size:
+        put_back = []
+        key = None
+        while len(out) < max_size and len(put_back) < max_size:
+            # the put_back bound keeps the scan O(batch) even when another
+            # profile dominates the queue (no full-heap drain per cycle)
             info = self.pop()
             if info is None:
                 break
+            if group_key is not None:
+                k = group_key(info)
+                if key is None:
+                    key = k
+                elif k != key:
+                    info.attempts -= 1  # pop() counted an attempt — undo
+                    put_back.append(info)
+                    continue
             out.append(info)
+        for info in put_back:
+            self._push_active(info)
         return out
 
     def add_unschedulable(self, info: QueuedPodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:

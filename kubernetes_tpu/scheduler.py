@@ -49,6 +49,8 @@ from .state.cache import Cache, Snapshot
 from .state.encoding import ClusterEncoder
 from .state.units import pow2_round_up as _pow2
 
+DEFAULT_SCHEDULER_NAME = "default-scheduler"  # apis/config v1.Pod default
+
 
 def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
     """Default plugin set + weights (apis/config/v1beta3/default_plugins.go:32-51)."""
@@ -101,6 +103,12 @@ class _InFlight:
     t0: float
     cycle: int
     node_names: Optional[List[Optional[str]]] = None  # resolved at _complete
+    profile: str = DEFAULT_SCHEDULER_NAME
+    # the framework the batch was dispatched with: _fws may be rebuilt (domain
+    # growth) between dispatch and the deferred bind, so the record owns it
+    fw: object = None
+    diag_dev: object = None  # bool[B, K] per-filter-plugin any-feasible bits
+    cand_dev: object = None  # bool[B, N] preemption candidate mask
 
 
 class TPUScheduler:
@@ -116,7 +124,15 @@ class TPUScheduler:
         assign_mode: str = "auto",
         coupled_fraction_threshold: float = 0.25,
         pipeline: bool = False,
+        profiles: Optional[Dict[str, object]] = None,
+        pod_initial_backoff: float = 1.0,
+        pod_max_backoff: float = 10.0,
     ):
+        """``profiles`` maps schedulerName → plugins factory (domain_cap →
+        [PluginWithWeight]); each profile gets its own framework + compiled
+        programs while sharing one queue/cache/encoder — profile.Map
+        (profile/profile.go:45) with frameworkForPod dispatch
+        (scheduler.go:719).  Default: one profile, ``plugins_factory``."""
         if assign_mode not in ("auto", "scan", "batch"):
             raise ValueError(f"unknown assign_mode {assign_mode!r}")
         # pipeline=True defers batch N's reserve/bind host work until after
@@ -146,17 +162,28 @@ class TPUScheduler:
             self._plugins_factory = lambda d: default_plugins(d, listers)
         else:
             self._plugins_factory = plugins_factory
-        self._fw: Optional[BatchedFramework] = None
+        # profile map: schedulerName → plugins factory; every profile gets its
+        # own BatchedFramework/jitted programs, all sharing this scheduler's
+        # queue/cache/encoder (profile.NewMap, QueueSort shared by contract)
+        self.profiles: Dict[str, object] = (
+            dict(profiles) if profiles else {DEFAULT_SCHEDULER_NAME: self._plugins_factory}
+        )
+        self._fws: Dict[str, BatchedFramework] = {}
+        self._jitted_by: Dict[str, dict] = {}
         self._fw_domain_cap = -1
-        self._jitted = {}
         self.rng_key = rng_key
-        # build event map from a probe framework (scheduler.go:347-362)
-        probe = self._plugins_factory(8)
+        # build event map from the UNION of all profiles' plugin registrations
+        # (scheduler.go:347-362 unions the per-profile maps)
         event_map: Dict[ClusterEvent, Set[str]] = {}
-        for pw in probe:
-            for ev in pw.plugin.events_to_register():
-                event_map.setdefault(ev, set()).add(pw.plugin.name)
-        self.queue = PriorityQueue(clock=clock, cluster_event_map=event_map)
+        for factory in self.profiles.values():
+            for pw in factory(8):
+                for ev in pw.plugin.events_to_register():
+                    event_map.setdefault(ev, set()).add(pw.plugin.name)
+        self.queue = PriorityQueue(
+            clock=clock, cluster_event_map=event_map,
+            pod_initial_backoff=pod_initial_backoff,
+            pod_max_backoff=pod_max_backoff,
+        )
         self.preemption = Evaluator()
         self.extenders = list(extenders or [])
         from .framework.waiting_pods import WaitingPodsMap
@@ -168,6 +195,11 @@ class TPUScheduler:
         # dry-runs see them on their nominated node —
         # RunFilterPluginsWithNominatedPods analog)
         self._nominated: Dict[str, Tuple[str, np.ndarray, v1.Pod]] = {}
+        from .client.events import EventRecorder
+
+        # Scheduled / FailedScheduling events through the store-backed
+        # recorder (scheduler.go:386,488)
+        self.recorder = EventRecorder(store)
         self._unwatch = store.watch(self._on_event)
 
     # --- event handlers (eventhandlers.go:251+) ------------------------------
@@ -230,6 +262,11 @@ class TPUScheduler:
     def _on_pod_event(self, ev: WatchEvent):
         pod: v1.Pod = ev.obj
         assigned = bool(pod.spec.node_name)
+        # responsibleForPod (eventhandlers.go:285+, scheduler.go:719): only
+        # pods naming one of this scheduler's profiles enter the queue;
+        # assigned pods always feed the cache (they occupy resources)
+        if not assigned and self._profile_of(pod) not in self.profiles:
+            return
         if ev.type == ADDED:
             if assigned:
                 self.cache.add_pod(pod)
@@ -272,11 +309,28 @@ class TPUScheduler:
 
     # --- framework / jit management ------------------------------------------
 
-    def _framework(self) -> BatchedFramework:
+    def _profile_of(self, pod: v1.Pod) -> str:
+        """frameworkForPod (scheduler.go:719): pod's schedulerName, falling
+        back to the default profile name when unset."""
+        return pod.spec.scheduler_name or DEFAULT_SCHEDULER_NAME
+
+    def _framework(self, profile: str = None) -> BatchedFramework:
+        profile = profile or next(iter(self.profiles))
         d = self.encoder.domain_cap
-        if self._fw is None or d != self._fw_domain_cap:
-            fw = self._fw = BatchedFramework(self._plugins_factory(d))
+        if d != self._fw_domain_cap:
+            # domain growth invalidates every profile's compiled programs
+            self._fws.clear()
+            self._jitted_by.clear()
             self._fw_domain_cap = d
+        if profile not in self._fws:
+            factory = self.profiles[profile]
+            fw = BatchedFramework(factory(d))
+            self._fws[profile] = fw
+            self._jitted_by[profile] = self._build_jitted(fw)
+        return self._fws[profile]
+
+    def _build_jitted(self, fw: BatchedFramework) -> dict:
+        if True:  # kept indentation for the fused definitions below
             from .state.encoding import apply_scatter
 
             # EVERYTHING fused into one program per cycle: the deferred
@@ -293,27 +347,44 @@ class TPUScheduler:
                     requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
                 )
 
+            def diagnostics(batch, dsnap, dyn, auxes):
+                # FitError diagnosis bits + preemption candidate mask, in the
+                # SAME program (XLA CSEs the filter planes) — the eager
+                # fallback paid a ~100ms pacing round per plugin per batch
+                diag = fw.diagnose_bits(batch, dsnap, dyn, auxes)
+                static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+                for pw, aux in zip(fw.plugins, auxes):
+                    if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
+                        pw.plugin, "filter"
+                    ):
+                        static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
+                cand = candidate_mask_device(batch, dsnap, dyn, static_ok)
+                return diag, cand
+
             def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
                 dsnap = apply_scatter(dsnap, upd)
                 dyn = reserve_nominated(dsnap, nom_rows, nom_req)
                 auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
                 res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
-                return res, auxes, dsnap, dyn
+                diag, cand = diagnostics(batch, dsnap, dyn, auxes)
+                return res, auxes, dsnap, dyn, diag, cand
 
             def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
                 dsnap = apply_scatter(dsnap, upd)
                 dyn = reserve_nominated(dsnap, nom_rows, nom_req)
                 auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
                 res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
-                return res, auxes, dsnap, dyn
+                diag, cand = diagnostics(batch, dsnap, dyn, auxes)
+                return res, auxes, dsnap, dyn, diag, cand
 
-            self._jitted = {
+            return {
                 "prepare": jax.jit(fw.prepare),
                 "greedy": jax.jit(fused_greedy),
                 "batch": jax.jit(fused_batch),
                 "compute": jax.jit(fw.compute),
+                "compute_static": jax.jit(fw.compute_static),
+                "compute_row": jax.jit(fw.compute_row),
             }
-        return self._fw
 
     # --- the batched scheduling cycle ----------------------------------------
 
@@ -330,7 +401,9 @@ class TPUScheduler:
         if prev is not None:
             prev_rows = self._complete(prev)  # fetch decisions + assume in cache
 
-        infos = self.queue.pop_batch(self.batch_size)
+        infos = self.queue.pop_batch(
+            self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
+        )
         nxt = self._dispatch_batch(infos) if infos else None
 
         if prev is not None:
@@ -350,17 +423,26 @@ class TPUScheduler:
 
     def _dispatch_batch(self, infos: List[QueuedPodInfo]) -> _InFlight:
         """Snapshot → compile → ONE device dispatch; decisions fetched async."""
+        from .component_base.trace import Trace
+
         t0 = self.clock()
+        # hot-path step trace, dumped when a dispatch exceeds 100ms
+        # (utiltrace in schedulePod, scheduler.go:775-791)
+        trace = Trace("Scheduling", pods=len(infos))
         cycle = self.queue.scheduling_cycle()
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
+        trace.step("Snapshot update")
         pods = [qi.pod for qi in infos]
         # fixed padding: every cycle compiles to ONE (batch_size, tier)
         # program instead of one per pow-2 backlog size — partial batches
         # reuse the warm executable (first compile is tens of seconds)
         batch = self.compiler.compile(pods, pad_to=self.batch_size)
-        fw = self._framework()
+        trace.step("Batch compile")
+        profile = self._profile_of(infos[0].pod)  # queue groups by profile
+        fw = self._framework(profile)
+        jt = self._jitted_by[profile]
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
@@ -370,15 +452,16 @@ class TPUScheduler:
             dsnap = self.encoder.to_device()
             dyn = initial_dynamic_state(dsnap)
             dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
-            auxes = self._jitted["prepare"](batch, dsnap, dyn, host_auxes)
+            auxes = jt["prepare"](batch, dsnap, dyn, host_auxes)
             node_row, algo_lat = self._assign_with_extenders(
-                batch, dsnap, dyn, auxes, pods, t0
+                fw, jt, batch, dsnap, dyn, auxes, pods, t0
             )
-            return _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat, t0, cycle)
+            return _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
+                             t0, cycle, profile=profile, fw=fw)
         dsnap, upd = self.encoder.to_device_deferred()
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
-        res, auxes, dsnap_out, dyn_out = self._run_assignment(
-            batch, dsnap, upd, nom_rows, nom_req, host_auxes
+        res, auxes, dsnap_out, dyn_out, diag, cand = self._run_assignment(
+            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         # start the device→host copy now; np.asarray at completion time is
@@ -387,7 +470,11 @@ class TPUScheduler:
         # cycle is the latency floor
         if hasattr(res.node_row, "copy_to_host_async"):
             res.node_row.copy_to_host_async()
-        return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row, None, t0, cycle)
+        trace.step("Device dispatch")
+        trace.log_if_long(0.1)
+        return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
+                         None, t0, cycle, profile=profile, fw=fw,
+                         diag_dev=diag, cand_dev=cand)
 
     def _complete(self, fl: _InFlight) -> np.ndarray:
         """Fetch the batch's decisions and assume placements in the cache so
@@ -429,7 +516,9 @@ class TPUScheduler:
         """The binding cycle for a completed batch: reserve → permit → bind
         per scheduled pod, diagnosis + preemption per unschedulable pod."""
         stats = CycleStats(attempted=len(fl.infos))
+        fw = fl.fw
         batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
+        diag_np = cand_np = None
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
             row = int(node_row[i])
@@ -437,7 +526,7 @@ class TPUScheduler:
                 # name resolved at completion time (see _complete) — the
                 # row→name map may have changed under the next dispatch's sync
                 node_name = fl.node_names[i]
-                ok = self._run_reserve_and_bind(qi.pod, node_name)
+                ok = self._run_reserve_and_bind(fw, qi.pod, node_name)
                 if ok:
                     self.cache.finish_binding(qi.pod)
                     stats.scheduled += 1
@@ -445,6 +534,12 @@ class TPUScheduler:
                     m.pod_scheduling_attempts.observe(qi.attempts)
                     m.pod_scheduling_duration.observe(
                         self.clock() - qi.initial_attempt_timestamp
+                    )
+                    # scheduler.go:488 (Normal/Scheduled on bind success)
+                    self.recorder.eventf(
+                        qi.pod, "Normal", "Scheduled",
+                        f"Successfully assigned {qi.pod.namespace}/"
+                        f"{qi.pod.metadata.name} to {node_name}",
                     )
                 else:  # reserve/bind failed — roll back (scheduler.go:676-689)
                     self.cache.forget_pod(qi.pod)
@@ -455,9 +550,25 @@ class TPUScheduler:
             else:
                 stats.unschedulable += 1
                 m.schedule_attempts.inc(("unschedulable",))
-                qi.unschedulable_plugins = self._diagnose(batch, dsnap, dyn, auxes, i)
-                self._run_post_filter(qi, batch, dsnap, dyn, auxes, i)
+                if diag_np is None and fl.diag_dev is not None:
+                    diag_np = np.asarray(fl.diag_dev)  # one sync per failing batch
+                    cand_np = np.asarray(fl.cand_dev)
+                qi.unschedulable_plugins = self._diagnose(
+                    fw, batch, dsnap, dyn, auxes, i,
+                    diag_row=None if diag_np is None else diag_np[i],
+                )
+                self._run_post_filter(
+                    fw, qi, batch, dsnap, dyn, auxes, i,
+                    cand_row=None if cand_np is None else cand_np[i],
+                )
                 self.queue.add_unschedulable(qi, fl.cycle)
+                # scheduler.go:386 (Warning/FailedScheduling with diagnosis)
+                failing = ", ".join(sorted(qi.unschedulable_plugins))
+                self.recorder.eventf(
+                    qi.pod, "Warning", "FailedScheduling",
+                    f"0/{len(self.snapshot.node_info_list)} nodes are "
+                    f"available: failed plugins: {failing}",
+                )
             # True per-attempt latency (scheduler_perf util.go:238-276): the
             # pod's decision is unavailable until its device program returns
             # (whole batch in the fused path, its own cycle in the extender
@@ -475,7 +586,7 @@ class TPUScheduler:
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
 
-    def _run_assignment(self, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
+    def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
@@ -495,16 +606,16 @@ class TPUScheduler:
             n_valid = max(int(batch.valid.sum()), 1)
             frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
             if mode == "batch" or frac <= self.coupled_fraction_threshold:
-                return self._jitted["batch"](
+                return jt["batch"](
                     batch, dsnap, upd, nom_rows, nom_req, host_auxes,
                     order, coupling, self.rng_key,
                 )
-        return self._jitted["greedy"](
+        return jt["greedy"](
             batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, self.rng_key
         )
 
     def _assign_with_extenders(
-        self, batch, dsnap, dyn, auxes, pods, t0: float
+        self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Sequential per-pod cycles with HTTP extender callouts between the
         device compute and selection (findNodesThatPassExtenders
@@ -514,21 +625,30 @@ class TPUScheduler:
         pod's own decision)."""
         from .extender import ExtenderError
 
-        fw = self._fw
         b = batch.valid.shape[0]
         out = np.full(b, -1, dtype=np.int32)
         algo_lat = np.zeros(b)
         name_of = self.encoder.row_to_name()
         row_of = self.encoder.node_rows
         t_prev = self.clock()
+        # static planes once per batch; each pod is then an O(N) row against
+        # the evolving dynamic state (was a full [B, N] recompute per pod)
+        static_mask, static_raw = jt["compute_static"](
+            batch, dsnap, dyn, auxes
+        )
         for i, pod in enumerate(pods):
             try:
-                mask, scores = self._jitted["compute"](batch, dsnap, dyn, auxes)
-                row_mask = np.asarray(mask[i])
-                row_scores = np.asarray(scores[i])
+                mask_row, score_row = jt["compute_row"](
+                    batch, dsnap, dyn, auxes, static_mask, static_raw, i
+                )
+                row_mask = np.asarray(mask_row)
+                row_scores = np.asarray(score_row)
                 names = [name_of[r] for r in np.where(row_mask)[0] if r in name_of]
+                # managed-resources gating (extender.go:444-471): extenders
+                # not interested in this pod are skipped entirely
+                exts = [e for e in self.extenders if e.is_interested(pod)]
                 try:
-                    for ext in self.extenders:
+                    for ext in exts:
                         names, _failed = ext.filter(pod, names)
                         if not names:
                             break
@@ -537,7 +657,7 @@ class TPUScheduler:
                 if not names:
                     continue
                 merged = {n: float(row_scores[row_of[n]]) for n in names}
-                for ext in self.extenders:
+                for ext in exts:
                     try:
                         ranked = ext.prioritize(pod, names)
                     except ExtenderError:
@@ -556,14 +676,13 @@ class TPUScheduler:
                 t_prev = now
         return out, algo_lat
 
-    def _run_reserve_and_bind(self, pod: v1.Pod, node_name: str) -> bool:
+    def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str) -> bool:
         """Reserve → PreBind → Bind → PostBind (scheduler.go:584-698, host side).
 
         On any failure, already-reserved plugins are unreserved in reverse order.
         """
         from .framework.interface import Code
 
-        fw = self._fw
         reserved = []
 
         def rollback():
@@ -655,19 +774,25 @@ class TPUScheduler:
     # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
     _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
 
-    def _run_post_filter(self, qi: QueuedPodInfo, batch, dsnap, dyn, auxes, i: int):
-        """DefaultPreemption PostFilter (scheduler.go:533-552 → preemption.go:138)."""
+    def _run_post_filter(self, fw, qi: QueuedPodInfo, batch, dsnap, dyn, auxes,
+                         i: int, cand_row=None):
+        """DefaultPreemption PostFilter (scheduler.go:533-552 → preemption.go:138).
+
+        ``cand_row`` (bool[N] from the fused program) skips the eager
+        candidate-mask computation; the eager path serves the extender mode.
+        """
         pod = qi.pod
         if pod.spec.preemption_policy == "Never":
             return
-        fw = self._fw
         m.preemption_attempts.inc()
-        static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
-        for pw, aux in zip(fw.plugins, auxes):
-            if pw.plugin.name in self._STATIC_PLUGINS and hasattr(pw.plugin, "filter"):
-                static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
-        cand_mask = candidate_mask_device(batch, dsnap, dyn, static_ok)
-        rows = np.where(np.asarray(cand_mask[i]))[0]
+        if cand_row is None:
+            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+            for pw, aux in zip(fw.plugins, auxes):
+                if pw.plugin.name in self._STATIC_PLUGINS and hasattr(pw.plugin, "filter"):
+                    static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
+            cand_mask = candidate_mask_device(batch, dsnap, dyn, static_ok)
+            cand_row = np.asarray(cand_mask[i])
+        rows = np.where(cand_row)[0]
         if rows.size == 0:
             return
         name_of = self.encoder.row_to_name()
@@ -676,9 +801,17 @@ class TPUScheduler:
         nominated: Dict[str, List[v1.Pod]] = {}
         for _uid, (nn, _req, npod) in self._nominated.items():
             nominated.setdefault(nn, []).append(npod)
-        cand = self.preemption.preempt(
-            pod, self.snapshot, names, pdbs, nominated=nominated
-        )
+        from .extender import ExtenderError
+
+        try:
+            cand = self.preemption.preempt(
+                pod, self.snapshot, names, pdbs, nominated=nominated,
+                extenders=self.extenders,
+            )
+        except ExtenderError:
+            # non-ignorable extender failure aborts this preemption attempt
+            # (preemption.go callExtenders error path); pod retries later
+            return
         if cand is None:
             return
         for victim in cand.victims:
@@ -690,9 +823,16 @@ class TPUScheduler:
         )
         self.store.update("Pod", pod)
 
-    def _diagnose(self, batch, dsnap, dyn, auxes, i: int) -> Set[str]:
-        """Which plugins reject pod i everywhere (FitError.Diagnosis analog)."""
-        fw = self._fw
+    def _diagnose(self, fw, batch, dsnap, dyn, auxes, i: int, diag_row=None) -> Set[str]:
+        """Which plugins reject pod i everywhere (FitError.Diagnosis analog).
+
+        ``diag_row`` (bool[K], from the fused program) answers without any
+        device work; the eager per-plugin loop remains for the extender path.
+        """
+        if diag_row is not None:
+            names = fw.filter_names
+            failing = {names[k] for k in range(len(names)) if not bool(diag_row[k])}
+            return failing or set(names)
         failing = set()
         for pw, aux in zip(fw.plugins, auxes):
             if not hasattr(pw.plugin, "filter"):

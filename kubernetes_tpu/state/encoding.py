@@ -529,19 +529,7 @@ class ClusterEncoder:
         fused compute itself.  Caller MUST ``commit_device()`` the updated
         DeviceSnapshot returned by its program (the arrays are async —
         committing the futures immediately is safe)."""
-        numeric = self.dic.numeric_table(min_size=self._numeric_min)
-        n_num = _pow2(numeric.shape[0], self._numeric_min)
-        numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
-        dirty_frac = (
-            (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
-            / max(self._n + self._p, 1)
-        )
-        use_scatter = (
-            self._device is not None
-            and not self._shape_changed
-            and self._device.numeric.shape[0] == n_num
-            and dirty_frac < 0.5
-        )
+        numeric, use_scatter = self._upload_gate()
         if not use_scatter:
             return self.to_device(), None
         d = self._device
@@ -559,6 +547,25 @@ class ClusterEncoder:
         self._dirty_node_rows.clear()
         self._dirty_pod_rows.clear()
         return d, upd
+
+    def _upload_gate(self):
+        """(padded numeric table, use_scatter) — the one place that decides
+        between a full upload and row-scatters, shared by both upload paths so
+        the threshold and padding rules can't drift apart."""
+        numeric = self.dic.numeric_table(min_size=self._numeric_min)
+        n_num = _pow2(numeric.shape[0], self._numeric_min)
+        numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
+        dirty_frac = (
+            (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
+            / max(self._n + self._p, 1)
+        )
+        use_scatter = (
+            self._device is not None
+            and not self._shape_changed
+            and self._device.numeric.shape[0] == n_num
+            and dirty_frac < 0.5
+        )
+        return numeric, use_scatter
 
     def _gather_rows(self, names: List[str], dirty: set):
         """(padded row indices, per-array value rows) for one array group.
@@ -589,21 +596,8 @@ class ClusterEncoder:
         job via donated args in the jitted updater)."""
         import jax
 
-        numeric = self.dic.numeric_table(min_size=self._numeric_min)
-        n_num = _pow2(numeric.shape[0], self._numeric_min)
-        numeric = np.pad(numeric, (0, n_num - numeric.shape[0]), constant_values=np.nan)
-
-        dirty_frac = (
-            (len(self._dirty_node_rows) + len(self._dirty_pod_rows))
-            / max(self._n + self._p, 1)
-        )
+        numeric, use_scatter = self._upload_gate()
         numeric_stale = len(self.dic) != self._uploaded_numeric_len
-        use_scatter = (
-            self._device is not None
-            and not self._shape_changed
-            and self._device.numeric.shape[0] == n_num
-            and dirty_frac < 0.5
-        )
         if not use_scatter:
             put = (lambda x: jax.device_put(x, sharding)) if sharding else jnp.asarray
             self._device = DeviceSnapshot(

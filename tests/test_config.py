@@ -65,3 +65,32 @@ def test_empty_config_gets_default_profile():
         "NodeResourcesFit", "TaintToleration", "NodeAffinity",
         "PodTopologySpread", "InterPodAffinity",
     }
+
+
+def test_scheduler_from_config_two_profiles():
+    from kubernetes_tpu.config import scheduler_from_config
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    cfg = load_config({
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta3",
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "no-spread",
+             "plugins": {"multiPoint": {"disabled": [
+                 {"name": "PodTopologySpread"}, {"name": "InterPodAffinity"}]}}},
+        ],
+        "podInitialBackoffSeconds": 2,
+    })
+    store = ObjectStore()
+    sched = scheduler_from_config(store, cfg, batch_size=4)
+    assert set(sched.profiles) == {"default-scheduler", "no-spread"}
+    assert sched.queue._initial_backoff == 2
+    store.create("Node", make_node().name("n0").obj())
+    p = make_pod().name("p").uid("p").namespace("default").req({"cpu": "1m"}).obj()
+    p.spec.scheduler_name = "no-spread"
+    store.create("Pod", p)
+    stats = sched.run_until_idle()
+    assert stats.scheduled == 1
+    names = {pw.plugin.name for pw in sched._fws["no-spread"].plugins}
+    assert "PodTopologySpread" not in names

@@ -29,3 +29,100 @@ def test_extender_filter_steers_placement():
         assert store.get("Pod", "default", "p").spec.node_name == "n1"
     finally:
         srv.stop()
+
+
+def test_managed_resources_gates_interest():
+    """IsInterested (extender.go:444-471): an extender with managedResources
+    is only consulted for pods requesting one of them."""
+    calls = []
+
+    def score_fn(pod_dict, names):
+        calls.append(pod_dict["metadata"]["name"])
+        return [n for n in names if n.endswith("1")], {n: 0 for n in names}
+
+    srv = TPUScoreExtenderServer(score_fn)
+    srv.start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=srv.url, filter_verb="filter", node_cache_capable=True,
+            managed_resources=["example.com/gpu"],
+        ))
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=4, extenders=[ext])
+        store.create("Node", make_node().name("n0").capacity(
+            {"cpu": "8", "memory": "8Gi", "pods": "10", "example.com/gpu": "4"}
+        ).obj())
+        store.create("Node", make_node().name("n1").capacity(
+            {"cpu": "8", "memory": "8Gi", "pods": "10", "example.com/gpu": "4"}
+        ).obj())
+        store.create("Pod", make_pod().name("plain").uid("plain")
+                     .namespace("default").req({"cpu": "1"}).obj())
+        store.create("Pod", make_pod().name("gpu").uid("gpu")
+                     .namespace("default")
+                     .req({"cpu": "1", "example.com/gpu": "1"}).obj())
+        stats = sched.run_until_idle()
+        assert stats.scheduled == 2
+        # only the gpu pod consulted the extender…
+        assert calls == ["gpu"]
+        # …and only it was steered to n1
+        assert store.get("Pod", "default", "gpu").spec.node_name == "n1"
+    finally:
+        srv.stop()
+
+
+def test_preemption_extender_callout():
+    """ProcessPreemption (extender.go:164-207): the extender filters the
+    candidate victim map; preemption lands on a node it accepts."""
+    import http.server
+    import json
+    import threading
+
+    seen = {}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            args = json.loads(self.rfile.read(length) or b"{}")
+            cand = args.get("nodeNameToMetaVictims") or {}
+            seen.update(cand)
+            # accept only node n1's candidates
+            out = {k: v for k, v in cand.items() if k == "n1"}
+            body = json.dumps({"nodeNameToMetaVictims": out}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=f"http://127.0.0.1:{port}", preempt_verb="preempt",
+        ))
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=4, extenders=[ext])
+        for n in ("n0", "n1"):
+            store.create("Node", make_node().name(n).capacity(
+                {"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+        # fill both nodes with low-priority pods
+        for i, n in enumerate(("n0", "n0", "n1", "n1")):
+            store.create("Pod", make_pod().name(f"low{i}").uid(f"low{i}")
+                         .namespace("default").req({"cpu": "1"})
+                         .priority(0).obj())
+        sched.run_until_idle()
+        # high-priority pod that needs a full node's cpu → must preempt
+        store.create("Pod", make_pod().name("high").uid("high")
+                     .namespace("default").req({"cpu": "2"})
+                     .priority(100).obj())
+        sched.schedule_cycle()
+        assert seen, "extender preempt verb was never called"
+        high = store.get("Pod", "default", "high")
+        assert high.status.nominated_node_name == "n1"
+    finally:
+        srv.shutdown()
+        srv.server_close()

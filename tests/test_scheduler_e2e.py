@@ -86,3 +86,28 @@ def test_node_selector_respected_e2e():
     stats = sched.run_until_idle()
     assert stats.scheduled == 1
     assert store.get("Pod", "default", "p").spec.node_name == "hdd"
+
+
+def test_scheduler_emits_events():
+    """Scheduled / FailedScheduling land in the store (scheduler.go:386,488)."""
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.scheduler import TPUScheduler
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("Node", make_node().name("n0").capacity(
+        {"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+    store.create("Pod", make_pod().name("ok").uid("ok").namespace("default")
+                 .req({"cpu": "1"}).obj())
+    store.create("Pod", make_pod().name("huge").uid("huge").namespace("default")
+                 .req({"cpu": "64"}).obj())
+    sched.schedule_cycle()
+    events, _ = store.list("Event")
+    by_reason = {e.reason: e for e in events}
+    assert "Scheduled" in by_reason
+    assert "Pod/default/ok" == by_reason["Scheduled"].involved_object
+    assert "n0" in by_reason["Scheduled"].message
+    assert "FailedScheduling" in by_reason
+    assert by_reason["FailedScheduling"].type == "Warning"
+    assert "NodeResourcesFit" in by_reason["FailedScheduling"].message
