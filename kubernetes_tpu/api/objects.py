@@ -621,11 +621,19 @@ class Node:
 
 @dataclass
 class PodDisruptionBudget:
-    """policy/v1 PDB — the fields preemption reads (disruptionsAllowed, selector)."""
+    """policy/v1 PDB: spec (minAvailable/maxUnavailable, int or percent) +
+    the status the disruption controller maintains and preemption reads
+    (pkg/controller/disruption/disruption.go)."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
+    min_available: Optional[object] = None  # int | "NN%" | None
+    max_unavailable: Optional[object] = None  # int | "NN%" | None
+    # status
     disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
 
     kind = "PodDisruptionBudget"
 
@@ -636,7 +644,12 @@ class PodDisruptionBudget:
         return cls(
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             selector=LabelSelector.from_dict(spec.get("selector")),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
             disruptions_allowed=int(status.get("disruptionsAllowed", 0)),
+            current_healthy=int(status.get("currentHealthy", 0)),
+            desired_healthy=int(status.get("desiredHealthy", 0)),
+            expected_pods=int(status.get("expectedPods", 0)),
         )
 
 
@@ -829,6 +842,53 @@ class Deployment:
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             selector=LabelSelector.from_dict(spec.get("selector")),
             replicas=int(spec.get("replicas", 1)),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
+        )
+
+
+@dataclass
+class StatefulSet:
+    """apps/v1 StatefulSet — ordered, stable-identity replicas
+    (pkg/controller/statefulset)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_replicas: int = 0
+    status_ready_replicas: int = 0
+
+    kind = "StatefulSet"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "StatefulSet":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
+            replicas=int(spec.get("replicas", 1)),
+            template=PodTemplateSpec.from_dict(spec.get("template")),
+        )
+
+
+@dataclass
+class DaemonSet:
+    """apps/v1 DaemonSet — one pod per (eligible) node (pkg/controller/daemon)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status_desired: int = 0
+    status_current: int = 0
+
+    kind = "DaemonSet"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "DaemonSet":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            selector=LabelSelector.from_dict(spec.get("selector")),
             template=PodTemplateSpec.from_dict(spec.get("template")),
         )
 

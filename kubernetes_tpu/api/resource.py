@@ -65,7 +65,18 @@ def parse_quantity_exact(s) -> Decimal:
         return Decimal(s)
     if isinstance(s, float):
         return Decimal(repr(s))
-    s = str(s).strip()
+    return _parse_quantity_str(str(s).strip())
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=8192)
+def _parse_quantity_str(s: str) -> Decimal:
+    """Cached string→Decimal core: quantity strings repeat massively ("1",
+    "2Gi", "100m"…) and preemption dry-runs re-derive pod requests per
+    candidate — this was 385k regex parses in one profiled cycle.  Decimal
+    is immutable, so sharing results is safe."""
     m = _QUANTITY_RE.match(s)
     if not m:
         raise ValueError(f"invalid quantity: {s!r}")
@@ -214,7 +225,24 @@ def compute_pod_resource_request(pod) -> Resource:
 
     Reference: pkg/scheduler/framework/plugins/noderesources/fit.go:162-178
     (computePodResourceRequest) and types.go CalculateResource.
+
+    Cached per pod object: NodeInfo add/remove/clone in preemption dry-runs
+    re-derive the same pod's vector hundreds of times per scheduling attempt.
+    Pod specs are treated as immutable after creation (the store replaces
+    whole objects on update), so the cache never goes stale.
     """
+    cached = getattr(pod, "_cached_resource_request", None)
+    if cached is not None:
+        return cached
+    r = _compute_pod_resource_request(pod)
+    try:
+        pod._cached_resource_request = r
+    except Exception:
+        pass
+    return r
+
+
+def _compute_pod_resource_request(pod) -> Resource:
     r = Resource()
     for c in pod.spec.containers:
         r.add_resource_list(c.resources.requests)
@@ -226,13 +254,26 @@ def compute_pod_resource_request(pod) -> Resource:
 
 
 def compute_pod_resource_request_non_zero(pod) -> Resource:
-    """Like compute_pod_resource_request but with cpu/memory floors for scoring.
+    """Like compute_pod_resource_request but with cpu/memory floors for scoring
+    (cached per pod object like compute_pod_resource_request).
 
     Reference: pkg/scheduler/util/pod_resources.go GetNonzeroRequests — pods with no
     request are treated as 100m CPU / 200MB memory so spreading still works — and
     pkg/scheduler/framework/types.go:738-746 (calculateResource adds pod overhead to
     the non-zero cpu/memory totals too).
     """
+    cached = getattr(pod, "_cached_resource_request_nz", None)
+    if cached is not None:
+        return cached
+    r = _compute_pod_resource_request_non_zero(pod)
+    try:
+        pod._cached_resource_request_nz = r
+    except Exception:
+        pass
+    return r
+
+
+def _compute_pod_resource_request_non_zero(pod) -> Resource:
     r = Resource()
     for c in pod.spec.containers:
         req = dict(c.resources.requests or {})

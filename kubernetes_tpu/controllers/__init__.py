@@ -7,3 +7,7 @@ from .deployment import DeploymentController  # noqa: F401
 from .job import JobController  # noqa: F401
 from .nodelifecycle import NodeLifecycleController  # noqa: F401
 from .garbagecollector import GarbageCollector  # noqa: F401
+from .disruption import DisruptionController  # noqa: F401
+from .statefulset import StatefulSetController  # noqa: F401
+from .daemonset import DaemonSetController  # noqa: F401
+from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401

@@ -1,0 +1,82 @@
+"""DaemonSet controller (reference: pkg/controller/daemon/daemon_controller.go).
+
+One pod per eligible node.  Like the reference since 1.12, daemon pods are
+NOT bound directly by the controller: each created pod carries a required
+node affinity pinning it to its target node via the metadata.name match field
+(daemon_controller.go util.ReplaceDaemonSetPodNodeNameNodeAffinity) and goes
+through the scheduler like any other pod — so taints/unschedulable/resource
+checks all apply through the normal plugin set.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+from .replicaset import _owned_pods
+
+
+class DaemonSetController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _make_pod(self, ds: v1.DaemonSet, node_name: str) -> v1.Pod:
+        pod = v1.Pod()
+        pod.metadata.namespace = ds.metadata.namespace
+        pod.metadata.name = f"{ds.metadata.name}-{node_name}"
+        pod.metadata.labels = dict(ds.template.labels)
+        pod.metadata.owner_references = [
+            v1.OwnerReference(
+                kind="DaemonSet", name=ds.metadata.name,
+                uid=ds.metadata.uid, controller=True,
+            )
+        ]
+        pod.spec = copy.deepcopy(ds.template.spec)
+        if not pod.spec.containers:
+            pod.spec.containers = [v1.Container(name="c0", image="pause")]
+        # pin to the node through the scheduler (not direct binding)
+        pod.spec.affinity = pod.spec.affinity or v1.Affinity()
+        pod.spec.affinity.node_affinity = v1.NodeAffinity(
+            required=v1.NodeSelector(node_selector_terms=[
+                v1.NodeSelectorTerm(match_fields=[
+                    v1.NodeSelectorRequirement(
+                        key="metadata.name", operator=v1.OP_IN, values=[node_name]
+                    )
+                ])
+            ])
+        )
+        return pod
+
+    def sync_once(self) -> bool:
+        changed = False
+        sets, _ = self.store.list("DaemonSet")
+        if not sets:
+            return False
+        nodes, _ = self.store.list("Node")
+        for ds in sets:
+            pods = _owned_pods(self.store, "DaemonSet", ds)
+            by_node = {}
+            for p in pods:
+                target = p.metadata.name[len(ds.metadata.name) + 1:]
+                by_node[target] = p
+            desired = 0
+            for node in nodes:
+                if node.spec.unschedulable:
+                    continue  # shouldSchedule=false for cordoned nodes
+                desired += 1
+                if node.metadata.name not in by_node:
+                    self.store.create("Pod", self._make_pod(ds, node.metadata.name))
+                    changed = True
+            # remove daemon pods for deleted nodes
+            live = {n.metadata.name for n in nodes}
+            for target, p in by_node.items():
+                if target not in live:
+                    self.store.delete("Pod", p.namespace, p.metadata.name)
+                    changed = True
+            current = sum(1 for p in by_node.values() if p.spec.node_name)
+            if (ds.status_desired, ds.status_current) != (desired, current):
+                ds.status_desired = desired
+                ds.status_current = current
+                self.store.update("DaemonSet", ds)
+        return changed

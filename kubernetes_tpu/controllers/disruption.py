@@ -1,0 +1,85 @@
+"""Disruption controller: maintains PDB.status.disruptionsAllowed.
+
+Reference: pkg/controller/disruption/disruption.go — trySync/updatePdbStatus:
+  expectedCount, desiredHealthy from spec.minAvailable / spec.maxUnavailable
+  (integer or percentage); currentHealthy = count of healthy matching pods;
+  disruptionsAllowed = max(0, currentHealthy - desiredHealthy).
+
+Round-2 VERDICT: preemption consumed budgets nothing ever updated — this loop
+closes that cycle: victims deleted by the scheduler reduce currentHealthy on
+the next sync, so budgets drain and replenish as replacements get scheduled.
+
+Deviation (documented): percentage forms scale against the count of matching
+pods rather than the owning controllers' .spec.replicas sum (the sim has no
+scale subresource); for the PDB suites both counts coincide once replacements
+are created.  "Healthy" in the sim = the pod is bound to a node (no kubelet
+Ready condition exists here).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from ..api import objects as v1
+from ..api.labels import match_label_selector
+from ..sim.store import ObjectStore
+
+
+def _parse_intstr(v, total: int) -> int:
+    """IntOrString: plain int, or "NN%" rounded UP (intstr.GetScaledValueFromIntOrPercent
+    with roundUp=true, as the disruption controller uses for minAvailable)."""
+    if v is None:
+        return 0
+    if isinstance(v, int):
+        return v
+    s = str(v).strip()
+    if s.endswith("%"):
+        return math.ceil(int(s[:-1]) * total / 100)
+    return int(s)
+
+
+def sync_pdbs(store: ObjectStore) -> int:
+    """One reconcile pass over every PDB; returns PDBs updated."""
+    pdbs, _ = store.list("PodDisruptionBudget")
+    pods, _ = store.list("Pod")
+    updated = 0
+    for pdb in pdbs:
+        matching: List[v1.Pod] = [
+            p for p in pods
+            if p.namespace == pdb.metadata.namespace
+            and pdb.selector is not None
+            and match_label_selector(pdb.selector, p.metadata.labels)
+        ]
+        expected = len(matching)
+        healthy = sum(1 for p in matching if p.spec.node_name)
+        if pdb.max_unavailable is not None:
+            # maxUnavailable: desiredHealthy = expected - scaled(maxUnavailable)
+            desired = expected - _parse_intstr(pdb.max_unavailable, expected)
+        elif pdb.min_available is not None:
+            desired = _parse_intstr(pdb.min_available, expected)
+        else:
+            desired = 0
+        desired = max(0, desired)
+        allowed = max(0, healthy - desired)
+        if (pdb.expected_pods, pdb.current_healthy, pdb.desired_healthy,
+                pdb.disruptions_allowed) != (expected, healthy, desired, allowed):
+            pdb.expected_pods = expected
+            pdb.current_healthy = healthy
+            pdb.desired_healthy = desired
+            pdb.disruptions_allowed = allowed
+            store.update("PodDisruptionBudget", pdb)
+            updated += 1
+    return updated
+
+
+class DisruptionController:
+    """Loop wrapper matching the other controllers' run-once interface."""
+
+    name = "disruption"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        return sync_pdbs(self.store) > 0

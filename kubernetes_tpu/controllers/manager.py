@@ -26,15 +26,23 @@ class ControllerManager:
 
     def register_defaults(self) -> "ControllerManager":
         from .deployment import DeploymentController
+        from .disruption import DisruptionController
         from .garbagecollector import GarbageCollector
         from .job import JobController
         from .nodelifecycle import NodeLifecycleController
         from .replicaset import ReplicaSetController
+        from .statefulset import StatefulSetController
+        from .daemonset import DaemonSetController
+        from .podautoscaler import HorizontalPodAutoscalerController
 
         self.register(DeploymentController(self.store))
         self.register(ReplicaSetController(self.store))
+        self.register(StatefulSetController(self.store))
+        self.register(DaemonSetController(self.store))
         self.register(JobController(self.store))
         self.register(NodeLifecycleController(self.store, clock=self.clock))
+        self.register(DisruptionController(self.store))
+        self.register(HorizontalPodAutoscalerController(self.store))
         self.register(GarbageCollector(self.store))
         return self
 

@@ -1,0 +1,121 @@
+"""Horizontal Pod Autoscaler (reference: pkg/controller/podautoscaler/horizontal.go).
+
+Core replica math kept exactly (horizontal.go calcPlainMetricReplicas /
+GetResourceReplicas):
+
+    usageRatio      = currentUtilization / targetUtilization
+    desiredReplicas = ceil(currentReplicas * usageRatio)
+
+bounded to [minReplicas, maxReplicas], with the reference's tolerance band
+(|ratio-1| <= 0.1 → no scale, horizontal.go defaultTolerance) and the
+scale-up limiter (max(2*current, 4), scaleUpLimit*).
+
+The sim has no metrics-server: a ``metrics_fn(pod) -> float`` supplies each
+pod's current utilization (percent of request), the seam where the resource
+metrics pipeline plugs in.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+
+TOLERANCE = 0.1  # horizontal.go defaultTolerance
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v2 HPA — the subset the controller reads."""
+
+    metadata: "v1.ObjectMeta" = field(default_factory=lambda: v1.ObjectMeta())
+    target_kind: str = "Deployment"
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_utilization: float = 80.0  # percent
+    status_desired: int = 0
+
+    kind = "HorizontalPodAutoscaler"
+
+    @classmethod
+    def from_dict(cls, d):
+        spec = d.get("spec") or {}
+        ref = spec.get("scaleTargetRef") or {}
+        metrics = spec.get("metrics") or []
+        target = 80.0
+        for mtr in metrics:
+            res = (mtr.get("resource") or {}).get("target") or {}
+            if "averageUtilization" in res:
+                target = float(res["averageUtilization"])
+        return cls(
+            metadata=v1.ObjectMeta.from_dict(d.get("metadata") or {}),
+            target_kind=ref.get("kind", "Deployment"),
+            target_name=ref.get("name", ""),
+            min_replicas=int(spec.get("minReplicas", 1)),
+            max_replicas=int(spec.get("maxReplicas", 10)),
+            target_utilization=target,
+        )
+
+
+def _scale_up_limit(current: int) -> int:
+    """horizontal.go scaleUpLimitFactor=2, scaleUpLimitMinimum=4."""
+    return max(2 * current, 4)
+
+
+class HorizontalPodAutoscalerController:
+    def __init__(self, store: ObjectStore,
+                 metrics_fn: Optional[Callable[[v1.Pod], float]] = None):
+        self.store = store
+        # no metrics source → no scaling decisions (the reference likewise
+        # holds when the metrics pipeline returns no samples,
+        # horizontal.go computeReplicasForMetrics error path)
+        self.metrics_fn = metrics_fn
+
+    def sync_once(self) -> bool:
+        changed = False
+        hpas, _ = self.store.list("HorizontalPodAutoscaler")
+        for hpa in hpas:
+            target = self.store.get(
+                hpa.target_kind, hpa.metadata.namespace, hpa.target_name
+            )
+            if target is None:
+                continue
+            from .replicaset import _owned_pods
+
+            # utilization over the target's RUNNING pods; scale math over the
+            # spec'd replica count (the reference uses the scale subresource)
+            pods = []
+            if hpa.target_kind == "Deployment":
+                # pods are owned by the deployment's replicasets
+                rss, _ = self.store.list("ReplicaSet")
+                for rs in rss:
+                    for ref in rs.metadata.owner_references:
+                        if ref.kind == "Deployment" and ref.uid == target.metadata.uid:
+                            pods.extend(_owned_pods(self.store, "ReplicaSet", rs))
+            else:
+                pods = _owned_pods(self.store, hpa.target_kind, target)
+            scheduled = [p for p in pods if p.spec.node_name]
+            current = target.replicas
+            if not scheduled or self.metrics_fn is None:
+                continue
+            utilization = sum(self.metrics_fn(p) for p in scheduled) / len(scheduled)
+            ratio = utilization / max(hpa.target_utilization, 1e-9)
+            if abs(ratio - 1.0) <= TOLERANCE:
+                desired = current  # within tolerance — no scale
+            else:
+                desired = math.ceil(current * ratio)
+            desired = min(desired, _scale_up_limit(current))
+            desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+            if desired != current:
+                target.replicas = desired
+                self.store.update(hpa.target_kind, target)
+                changed = True
+            if hpa.status_desired != desired:
+                hpa.status_desired = desired
+                self.store.update("HorizontalPodAutoscaler", hpa)
+                changed = True
+        return changed

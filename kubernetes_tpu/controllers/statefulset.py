@@ -1,0 +1,75 @@
+"""StatefulSet controller (reference: pkg/controller/statefulset/stateful_set.go
++ stateful_set_control.go UpdateStatefulSet).
+
+Semantics kept from the reference, sized to the sim:
+  - stable identity: pods are named ``<set>-<ordinal>`` for ordinals
+    0..replicas-1 (no random suffix);
+  - ORDERED bring-up: the controller creates the next ordinal only after
+    every lower ordinal exists AND is scheduled (OrderedReady pod management,
+    stateful_set_control.go monotonic path);
+  - scale-down removes the highest ordinal first.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+from .replicaset import _owned_pods
+
+
+class StatefulSetController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _make_pod(self, st: v1.StatefulSet, ordinal: int) -> v1.Pod:
+        pod = v1.Pod()
+        pod.metadata.namespace = st.metadata.namespace
+        pod.metadata.name = f"{st.metadata.name}-{ordinal}"
+        pod.metadata.labels = dict(st.template.labels)
+        pod.metadata.owner_references = [
+            v1.OwnerReference(
+                kind="StatefulSet", name=st.metadata.name,
+                uid=st.metadata.uid, controller=True,
+            )
+        ]
+        pod.spec = copy.deepcopy(st.template.spec)
+        if not pod.spec.containers:
+            pod.spec.containers = [v1.Container(name="c0", image="pause")]
+        return pod
+
+    def sync_once(self) -> bool:
+        changed = False
+        sets, _ = self.store.list("StatefulSet")
+        for st in sets:
+            pods = _owned_pods(self.store, "StatefulSet", st)
+            by_ordinal = {}
+            for p in pods:
+                m = re.match(rf"^{re.escape(st.metadata.name)}-(\d+)$", p.metadata.name)
+                if m:
+                    by_ordinal[int(m.group(1))] = p
+            # ordered bring-up: create the lowest missing ordinal once every
+            # smaller ordinal is present and scheduled
+            for i in range(st.replicas):
+                p = by_ordinal.get(i)
+                if p is None:
+                    self.store.create("Pod", self._make_pod(st, i))
+                    changed = True
+                    break
+                if not p.spec.node_name:
+                    break  # wait for it to schedule before advancing
+            # scale down: highest ordinal first
+            for i in sorted(by_ordinal, reverse=True):
+                if i >= st.replicas:
+                    self.store.delete(
+                        "Pod", st.metadata.namespace, by_ordinal[i].metadata.name
+                    )
+                    changed = True
+            ready = sum(1 for p in by_ordinal.values() if p.spec.node_name)
+            if (st.status_replicas, st.status_ready_replicas) != (len(by_ordinal), ready):
+                st.status_replicas = len(by_ordinal)
+                st.status_ready_replicas = ready
+                self.store.update("StatefulSet", st)
+        return changed

@@ -82,34 +82,58 @@ class HTTPExtender:
     ) -> Dict[str, dict]:
         """ProcessPreemption (extender.go:164-207): ships the candidate
         victim map, receives the subset of nodes the extender accepts
-        (possibly with different victims).  Victims travel as metaVictims
-        (uids only — the nodeCacheCapable form); an error from an ignorable
-        extender keeps the original candidates."""
+        (possibly with different victims).
+
+        Form follows nodeCacheCapable exactly as the reference client does
+        (extender.go convertToNodeNameToMetaVictims): capable extenders get
+        metaVictims (uids only), others get full pod objects under
+        nodeNameToVictims; both reply forms are parsed.  An error from an
+        ignorable extender keeps the original candidates.
+
+        Each ``node_name_to_victims`` entry: {"pods": [v1.Pod],
+        "numPDBViolations": int}."""
         if not self.supports_preemption:
             return node_name_to_victims
-        args = {
-            "pod": _pod_to_dict(pod),
-            "nodeNameToMetaVictims": {
+        if self.cfg.node_cache_capable:
+            victims_key = "nodeNameToMetaVictims"
+            victims = {
                 node: {
-                    "pods": [{"uid": uid} for uid in entry["uids"]],
+                    "pods": [{"uid": p.uid} for p in entry["pods"]],
                     "numPDBViolations": entry["numPDBViolations"],
                 }
                 for node, entry in node_name_to_victims.items()
-            },
-        }
+            }
+        else:
+            victims_key = "nodeNameToVictims"
+            victims = {
+                node: {
+                    "pods": [_pod_to_dict(p) for p in entry["pods"]],
+                    "numPDBViolations": entry["numPDBViolations"],
+                }
+                for node, entry in node_name_to_victims.items()
+            }
+        args = {"pod": _pod_to_dict(pod), victims_key: victims}
         try:
             result = self._send(self.cfg.preempt_verb, args)
         except Exception as e:
             if self.cfg.ignorable:
                 return node_name_to_victims
             raise ExtenderError(str(e)) from e
+        reply = result.get("nodeNameToMetaVictims") or result.get("nodeNameToVictims") or {}
         out = {}
-        for node, meta in (result.get("nodeNameToMetaVictims") or {}).items():
-            if node in node_name_to_victims:
-                out[node] = {
-                    "uids": [p["uid"] for p in (meta or {}).get("pods", [])],
-                    "numPDBViolations": (meta or {}).get("numPDBViolations", 0),
-                }
+        for node, meta in reply.items():
+            if node not in node_name_to_victims:
+                continue
+            uids = set()
+            for pd in (meta or {}).get("pods", []):
+                uid = pd.get("uid") or ((pd.get("metadata") or {}).get("uid"))
+                if uid:
+                    uids.add(uid)
+            by_uid = {p.uid: p for p in node_name_to_victims[node]["pods"]}
+            out[node] = {
+                "pods": [by_uid[u] for u in uids if u in by_uid],
+                "numPDBViolations": (meta or {}).get("numPDBViolations", 0),
+            }
         return out
 
     def _send(self, verb: str, payload: dict) -> dict:

@@ -110,6 +110,13 @@ class PodBatch:
     req_anti_affinity: AffinityTermGroup
     pref_affinity: AffinityTermGroup
     pref_anti_affinity: AffinityTermGroup
+    # STATIC (pytree aux) batch-content flags: trace-time constants that let
+    # the runtime compile constraint-free batches WITHOUT the topology-spread
+    # / inter-pod-affinity programs at all — their per-step domain ops are
+    # O(N·D) and dominate the greedy scan at 5k nodes even when every
+    # constraint row is invalid padding
+    has_spread: bool = False
+    has_affinity: bool = False
 
     def __len__(self) -> int:
         return len(self.pods)
@@ -133,7 +140,7 @@ class PodBatch:
 from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 
 _reg(AffinityTermGroup)
-_reg(PodBatch, skip=("pods",))
+_reg(PodBatch, skip=("pods",), static=("has_spread", "has_affinity"))
 
 
 class PodBatchCompiler:
@@ -364,6 +371,8 @@ class PodBatchCompiler:
         groups = {}
         for gname in ("req_affinity", "req_anti_affinity", "pref_affinity", "pref_anti_affinity"):
             groups[gname] = self._compile_affinity_group(pods, b, gname)
+        has_spread = bool(tsc_valid.any())
+        has_affinity = any(bool(g.valid.any()) for g in groups.values())
 
         return PodBatch(
             pods=list(pods),
@@ -380,6 +389,7 @@ class PodBatchCompiler:
             tsc_valid=tsc_valid, tsc_key=tsc_key, tsc_max_skew=tsc_max_skew,
             tsc_when=tsc_when, tsc_min_domains=tsc_min_domains,
             tsc_selectors=tsc_selectors,
+            has_spread=has_spread, has_affinity=has_affinity,
             **groups,
         )
 

@@ -116,22 +116,32 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
             if op.collect_metrics:
-                # jit warmup BEFORE the measured pods exist: drive TWO
-                # disposable pods through back-to-back cycles so BOTH program
+                # jit warmup BEFORE the measured pods exist: drive THREE
+                # disposable pods through back-to-back cycles so the program
                 # variants compile pre-window — cycle 1 is the full-upload
-                # snapshot path, cycle 2 the steady-state scatter path (a
-                # different traced shape; compiling it mid-window cost the
-                # Unschedulable suite a 6s stall) — the reference has no
-                # compile phase to exclude
-                for wi in range(2):
+                # snapshot path, cycle 2 the steady-state scatter path, pod 3
+                # carries anti-affinity to warm the coupled greedy-scan
+                # variant (each is a different traced shape; compiling one
+                # mid-window cost the Unschedulable suite a 6s stall) — the
+                # reference has no compile phase to exclude
+                for wi in range(3):
                     warm = (
                         make_pod().name(f"warmup-pod{wi}").uid(f"warmup-pod{wi}")
-                        .namespace("default").req({"cpu": "1m"}).obj()
+                        .namespace("default").req({"cpu": "1m"})
+                        .label("warmup", "1")
                     )
-                    store.create("Pod", warm)
+                    if wi == 2:
+                        # a cross-pod-coupled pod routes through the greedy
+                        # scan engine (fused_greedy) — a different program
+                        # that otherwise compiles on the first anti-affinity
+                        # batch inside the window
+                        warm = warm.pod_affinity(
+                            "kubernetes.io/hostname", {"warmup": "1"}, anti=True
+                        )
+                    store.create("Pod", warm.obj())
                     sched.schedule_cycle()
                     sched.schedule_cycle()  # pipeline: complete + bind it
-                for wi in range(2):
+                for wi in range(3):
                     store.delete("Pod", "default", f"warmup-pod{wi}")
             created = []
             for _ in range(op.count):

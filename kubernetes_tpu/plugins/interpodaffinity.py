@@ -181,7 +181,12 @@ class InterPodAffinityPlugin(Plugin):
         tbl = domain_scatter_add(count_node, dom, d + 1)  # trash slot at D absorbs
         return tbl.astype(jnp.int32)
 
-    def prepare(self, batch, snap, dyn, host_aux=None) -> IPAAux:
+    def prepare(self, batch, snap, dyn, host_aux=None):
+        # STATIC skip: no affinity terms in the batch AND no existing-pod
+        # anti-affinity/affinity host planes (host_aux is None) → this
+        # plugin's O(N·D) domain programs are compiled out entirely
+        if not getattr(batch, "has_affinity", True) and host_aux is None:
+            return None
         d = self.domain_cap
         b = batch.valid.shape[0]
         n = snap.num_nodes
@@ -249,6 +254,8 @@ class InterPodAffinityPlugin(Plugin):
     # --- filter ---------------------------------------------------------------
 
     def filter(self, batch, snap, dyn, aux: IPAAux):
+        if aux is None:
+            return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
         d = self.domain_cap
         g_aff_valid = jnp.asarray(batch.req_affinity.valid)  # [B, T1]
         g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
@@ -272,6 +279,8 @@ class InterPodAffinityPlugin(Plugin):
     # --- score ----------------------------------------------------------------
 
     def score(self, batch, snap, dyn, aux: IPAAux, mask=None):
+        if aux is None:
+            return jnp.zeros((batch.valid.shape[0], snap.num_nodes))
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
@@ -299,6 +308,8 @@ class InterPodAffinityPlugin(Plugin):
     # --- row-sliced variants for the fast assignment scan ---------------------
 
     def filter_row(self, batch, snap, dyn, aux: IPAAux, i):
+        if aux is None:
+            return jnp.ones(snap.num_nodes, bool)
         d = self.domain_cap
         aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
         anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
@@ -315,6 +326,8 @@ class InterPodAffinityPlugin(Plugin):
         return aff_ok & ~anti_bad & ~aux.exist_anti_block[i] & ~aux.block_dyn[i]
 
     def score_row(self, batch, snap, dyn, aux: IPAAux, i, mask_row=None):
+        if aux is None:
+            return jnp.zeros(snap.num_nodes)
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
@@ -329,6 +342,8 @@ class InterPodAffinityPlugin(Plugin):
     # --- in-scan update -------------------------------------------------------
 
     def update(self, aux: IPAAux, i, node_row, batch, snap):
+        if aux is None:
+            return None
         """Pod i placed on node_row — the device analog of updateWithPod."""
         d = self.domain_cap
         b = aux.aff_cross_all.shape[0]
@@ -394,6 +409,8 @@ class InterPodAffinityPlugin(Plugin):
         )
 
     def update_batch(self, aux: IPAAux, commit, choice, u, batch, snap):
+        if aux is None:
+            return None
         """All of a round's placements at once (batch_assign): every per-pod
         contribution in `update` is a commutative add/OR, so the whole round
         folds into einsum contractions against the commit one-hot ``u``

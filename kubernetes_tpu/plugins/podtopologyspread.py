@@ -70,7 +70,12 @@ class PodTopologySpreadPlugin(Plugin):
 
     # --- prepare (PreFilter + the static part of PreScore) -------------------
 
-    def prepare(self, batch, snap, dyn, host_aux=None) -> TSAux:
+    def prepare(self, batch, snap, dyn, host_aux=None):
+        # STATIC skip: a batch with no spread constraints compiles without
+        # any of this plugin's O(N·D) domain programs (batch.has_spread is
+        # trace-time constant pytree aux)
+        if not getattr(batch, "has_spread", True):
+            return None
         d = self.domain_cap
         b, c_cap = batch.tsc_valid.shape
         n = snap.num_nodes
@@ -154,6 +159,8 @@ class PodTopologySpreadPlugin(Plugin):
     # --- filter ---------------------------------------------------------------
 
     def filter(self, batch, snap, dyn, aux: TSAux = None):
+        if aux is None:
+            return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
         d = self.domain_cap
         # global min over present domains (criticalPaths); empty → +BIG (pass)
         min_match = jnp.min(
@@ -174,6 +181,8 @@ class PodTopologySpreadPlugin(Plugin):
 
     def score(self, batch, snap, dyn, aux: TSAux, mask=None):
         """Raw score; NaN marks ignored nodes (handled in normalize)."""
+        if aux is None:
+            return jnp.zeros((batch.valid.shape[0], snap.num_nodes))
         d = self.domain_cap
         # pairs present among feasible (mask) non-ignored nodes restrict counting
         if mask is None:
@@ -221,6 +230,8 @@ class PodTopologySpreadPlugin(Plugin):
     # --- row-sliced variants for the fast assignment scan ---------------------
 
     def filter_row(self, batch, snap, dyn, aux: TSAux, i):
+        if aux is None:
+            return jnp.ones(snap.num_nodes, bool)
         counts = aux.hard_counts[i]  # [C, D+1]
         present = aux.hard_present[i]
         dom = aux.dom_val[i]  # [C, N]
@@ -238,6 +249,8 @@ class PodTopologySpreadPlugin(Plugin):
         return jnp.all(~aux.hard_valid[i][:, None] | ok_c, axis=0)  # [N]
 
     def score_row(self, batch, snap, dyn, aux: TSAux, i, mask_row=None):
+        if aux is None:
+            return jnp.zeros(snap.num_nodes)
         d = self.domain_cap
         soft_valid = aux.soft_valid[i]  # [C]
         has_key = aux.has_key[i]  # [C, N]
@@ -270,6 +283,8 @@ class PodTopologySpreadPlugin(Plugin):
     def update(self, aux: TSAux, i, node_row, batch, snap):
         """Pod i was placed on node_row: bump (j, c) tables where pod i matches
         pending pod j's constraint selectors and the node is counted for j."""
+        if aux is None:
+            return None
         d = self.domain_cap
         b, c_cap, _ = aux.dom_val.shape
         dom_at = aux.dom_val[:, :, node_row]  # [B, C]
@@ -289,6 +304,8 @@ class PodTopologySpreadPlugin(Plugin):
         """All of a round's placements at once (batch_assign):
         contributions are commutative scatter-adds, so the per-pod update
         folds into two einsums against the commit one-hot ``u`` [B, N]."""
+        if aux is None:
+            return None
         d = self.domain_cap
         # pending-pod j's table (b, c) gains at the domain of each committed
         # pod i's node, where i matches (b, c)'s selector and the node counts

@@ -264,7 +264,7 @@ class Evaluator:
                 continue
             victim_map = {
                 c.node_name: {
-                    "uids": [p.uid for p in c.victims],
+                    "pods": list(c.victims),
                     "numPDBViolations": c.num_pdb_violations,
                 }
                 for c in candidates
@@ -276,7 +276,7 @@ class Evaluator:
                 c = by_node.get(node)
                 if c is None:
                     continue
-                keep = set(entry["uids"])
+                keep = {p.uid for p in entry["pods"]}
                 victims = [p for p in c.victims if p.uid in keep]
                 if victims:
                     out.append(Candidate(node, victims, entry["numPDBViolations"]))

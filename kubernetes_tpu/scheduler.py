@@ -40,6 +40,7 @@ from .framework.events import ActionType, ClusterEvent, EventResource
 from .framework.interface import PluginWithWeight
 from .framework.podbatch import PodBatchCompiler
 from .framework.runtime import BatchedFramework, initial_dynamic_state
+from .component_base import logging as klog
 from .metrics import scheduler_metrics as m
 from .preemption import Evaluator, candidate_mask_device
 from .queueing import PriorityQueue
@@ -213,7 +214,8 @@ class TPUScheduler:
     }
 
     # kinds that never unblock scheduling (avoid wildcard requeue storms)
-    _IGNORED_KINDS = {"Lease", "Event", "ReplicaSet", "Deployment", "Job"}
+    _IGNORED_KINDS = {"Lease", "Event", "ReplicaSet", "Deployment", "Job",
+                      "StatefulSet", "DaemonSet", "HorizontalPodAutoscaler"}
 
     def _on_event(self, ev: WatchEvent):
         if ev.kind == "Node":
@@ -306,6 +308,11 @@ class TPUScheduler:
             _pow2(n_nodes, 1), _pow2(n_pods, 1),
             n_ids=16 * n_nodes + 8 * n_pods,
         )
+        # scatter-payload floors scaled to batch churn: a preemption burst
+        # deletes up to batch_size × victims pod rows in one cycle, and each
+        # pow2 bucket crossing recompiles the fused cycle program
+        self.encoder._scatter_bucket.setdefault("node_valid", _pow2(4 * self.batch_size, 256))
+        self.encoder._scatter_bucket.setdefault("pod_valid", _pow2(8 * self.batch_size, 256))
 
     # --- framework / jit management ------------------------------------------
 
@@ -330,61 +337,59 @@ class TPUScheduler:
         return self._fws[profile]
 
     def _build_jitted(self, fw: BatchedFramework) -> dict:
-        if True:  # kept indentation for the fused definitions below
-            from .state.encoding import apply_scatter
+        from .state.encoding import apply_scatter
 
-            # EVERYTHING fused into one program per cycle: the deferred
-            # snapshot row-scatter, the nominated-pod reservations, prepare,
-            # and the assignment engine.  Each separate device program on the
-            # tunnel-attached TPU pays a ~100ms pacing round, so the eager
-            # scatter/upload path tripled cycle latency.  The standalone
-            # prepare remains for the extender/diagnose path.
-            def reserve_nominated(dsnap, nom_rows, nom_req):
-                dyn = initial_dynamic_state(dsnap)
-                rows = jnp.clip(nom_rows, 0, dsnap.requested.shape[0] - 1)
-                add = jnp.where((nom_rows >= 0)[:, None], nom_req, 0)
-                return dyn._replace(
-                    requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
-                )
+        # EVERYTHING fused into one program per cycle: the deferred
+        # snapshot row-scatter, the nominated-pod reservations, prepare,
+        # and the assignment engine.  Each separate device program on the
+        # tunnel-attached TPU pays a ~100ms pacing round, so the eager
+        # scatter/upload path tripled cycle latency.  The standalone
+        # prepare remains for the extender/diagnose path.
+        def reserve_nominated(dsnap, nom_rows, nom_req):
+            dyn = initial_dynamic_state(dsnap)
+            rows = jnp.clip(nom_rows, 0, dsnap.requested.shape[0] - 1)
+            add = jnp.where((nom_rows >= 0)[:, None], nom_req, 0)
+            return dyn._replace(
+                requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
+            )
 
-            def diagnostics(batch, dsnap, dyn, auxes):
-                # FitError diagnosis bits + preemption candidate mask, in the
-                # SAME program (XLA CSEs the filter planes) — the eager
-                # fallback paid a ~100ms pacing round per plugin per batch
-                diag = fw.diagnose_bits(batch, dsnap, dyn, auxes)
-                static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
-                for pw, aux in zip(fw.plugins, auxes):
-                    if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
-                        pw.plugin, "filter"
-                    ):
-                        static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
-                cand = candidate_mask_device(batch, dsnap, dyn, static_ok)
-                return diag, cand
+        def diagnostics(batch, dsnap, dyn, auxes):
+            # FitError diagnosis bits + preemption candidate mask, in the
+            # SAME program (XLA CSEs the filter planes) — the eager
+            # fallback paid a ~100ms pacing round per plugin per batch
+            diag = fw.diagnose_bits(batch, dsnap, dyn, auxes)
+            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+            for pw, aux in zip(fw.plugins, auxes):
+                if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
+                    pw.plugin, "filter"
+                ):
+                    static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
+            cand = candidate_mask_device(batch, dsnap, dyn, static_ok)
+            return diag, cand
 
-            def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
-                dsnap = apply_scatter(dsnap, upd)
-                dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-                res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
-                diag, cand = diagnostics(batch, dsnap, dyn, auxes)
-                return res, auxes, dsnap, dyn, diag, cand
+        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
+            dsnap = apply_scatter(dsnap, upd)
+            dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+            auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+            res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
+            diag, cand = diagnostics(batch, dsnap, dyn, auxes)
+            return res, auxes, dsnap, dyn, diag, cand
 
-            def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
-                dsnap = apply_scatter(dsnap, upd)
-                dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-                auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-                res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
-                diag, cand = diagnostics(batch, dsnap, dyn, auxes)
-                return res, auxes, dsnap, dyn, diag, cand
+        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
+            dsnap = apply_scatter(dsnap, upd)
+            dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+            auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+            res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
+            diag, cand = diagnostics(batch, dsnap, dyn, auxes)
+            return res, auxes, dsnap, dyn, diag, cand
 
-            return {
-                "prepare": jax.jit(fw.prepare),
-                "greedy": jax.jit(fused_greedy),
-                "batch": jax.jit(fused_batch),
-                "compute": jax.jit(fw.compute),
-                "compute_static": jax.jit(fw.compute_static),
-                "compute_row": jax.jit(fw.compute_row),
-            }
+        return {
+            "prepare": jax.jit(fw.prepare),
+            "greedy": jax.jit(fused_greedy),
+            "batch": jax.jit(fused_batch),
+            "compute_static": jax.jit(fw.compute_static),
+            "compute_row": jax.jit(fw.compute_row),
+        }
 
     # --- the batched scheduling cycle ----------------------------------------
 
@@ -535,6 +540,10 @@ class TPUScheduler:
                     m.pod_scheduling_duration.observe(
                         self.clock() - qi.initial_attempt_timestamp
                     )
+                    klog.V(4).info_s(
+                        "Scheduled", pod=qi.pod.key(), node=node_name,
+                        attempts=qi.attempts,
+                    )
                     # scheduler.go:488 (Normal/Scheduled on bind success)
                     self.recorder.eventf(
                         qi.pod, "Normal", "Scheduled",
@@ -578,6 +587,13 @@ class TPUScheduler:
                 float(fl.algo_lat[i]) + (self.clock() - t_pod)
             )
         stats.batch_seconds = self.clock() - fl.t0
+        if klog.V(2):
+            klog.V(2).info_s(
+                "Scheduling cycle complete", profile=fl.profile,
+                attempted=stats.attempted, scheduled=stats.scheduled,
+                unschedulable=stats.unschedulable,
+                seconds=round(stats.batch_seconds, 4),
+            )
         return stats
 
     def _observe_pending(self):
@@ -744,7 +760,9 @@ class TPUScheduler:
                 continue
             rows.append(row)
             reqs.append(req)
-        k = max(_pow2(len(rows), 4), getattr(self, "_nom_cap", 4))
+        # floor at 2×batch: a preemption burst nominates up to a whole batch
+        # at once, and each pow2 K crossing recompiles the fused program
+        k = max(_pow2(len(rows), 4), getattr(self, "_nom_cap", _pow2(2 * self.batch_size, 4)))
         self._nom_cap = k
         r = self.encoder.cfg.num_resource_dims
         out_rows = np.full(k, -1, dtype=np.int32)

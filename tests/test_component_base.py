@@ -59,3 +59,24 @@ def test_trace_logs_when_slow():
     assert msg and "filter" in msg and "score" in msg
     fast = Trace("fast", clock=clock)
     assert fast.log_if_long(0.1) is None
+
+
+def test_klog_verbosity_gating(caplog):
+    import logging as pylog
+
+    from kubernetes_tpu.component_base import logging as klog
+
+    klog.set_verbosity(0)
+    with caplog.at_level(pylog.INFO, logger="kubernetes_tpu"):
+        klog.V(2).info_s("hidden", a=1)
+        klog.info_s("shown", pod="default/p")
+        klog.error_s(ValueError("boom"), "failed", node="n0")
+    text = caplog.text
+    assert "hidden" not in text
+    assert "shown pod='default/p'" in text
+    assert "failed" in text and "boom" in text
+    klog.set_verbosity(2)
+    with caplog.at_level(pylog.INFO, logger="kubernetes_tpu"):
+        klog.V(2).info_s("now visible", n=3)
+    assert "now visible" in caplog.text
+    klog.set_verbosity(0)

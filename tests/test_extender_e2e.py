@@ -86,11 +86,14 @@ def test_preemption_extender_callout():
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
             args = json.loads(self.rfile.read(length) or b"{}")
-            cand = args.get("nodeNameToMetaVictims") or {}
+            # non-nodeCacheCapable form: full pod objects (extender.go
+            # contract); reply in kind
+            cand = args.get("nodeNameToVictims") or {}
+            assert "nodeNameToMetaVictims" not in args
             seen.update(cand)
             # accept only node n1's candidates
             out = {k: v for k, v in cand.items() if k == "n1"}
-            body = json.dumps({"nodeNameToMetaVictims": out}).encode()
+            body = json.dumps({"nodeNameToVictims": out}).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -123,6 +126,54 @@ def test_preemption_extender_callout():
         assert seen, "extender preempt verb was never called"
         high = store.get("Pod", "default", "high")
         assert high.status.nominated_node_name == "n1"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_preemption_extender_meta_victims_form():
+    """nodeCacheCapable=True preempt extenders speak metaVictims (uids)."""
+    import http.server
+    import json
+    import threading
+
+    forms = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            args = json.loads(self.rfile.read(length) or b"{}")
+            forms.append(sorted(k for k in args if k.startswith("nodeNameTo")))
+            cand = args.get("nodeNameToMetaVictims") or {}
+            body = json.dumps({"nodeNameToMetaVictims": cand}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix=f"http://127.0.0.1:{srv.server_address[1]}",
+            preempt_verb="preempt", node_cache_capable=True,
+        ))
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=4, extenders=[ext])
+        store.create("Node", make_node().name("n0").capacity(
+            {"cpu": "1", "memory": "2Gi", "pods": "10"}).obj())
+        store.create("Pod", make_pod().name("low").uid("low")
+                     .namespace("default").req({"cpu": "1"}).priority(0).obj())
+        sched.run_until_idle()
+        store.create("Pod", make_pod().name("high").uid("high")
+                     .namespace("default").req({"cpu": "1"}).priority(10).obj())
+        sched.schedule_cycle()
+        assert forms and forms[0] == ["nodeNameToMetaVictims"]
+        assert store.get("Pod", "default", "high").status.nominated_node_name == "n0"
     finally:
         srv.shutdown()
         srv.server_close()
