@@ -151,11 +151,30 @@ class Evaluator:
             else []
         )
 
-        def fits() -> bool:
+        # Resource-only fast path for the REPRIEVE loop: for a PLAIN
+        # preemptor (no global constraints, no host ports, no volumes) the
+        # only node predicates that change as reprieved victims come back are
+        # the resource/pod-count fits.  The INITIAL per-candidate check below
+        # always runs the full oracle against the current snapshot — static
+        # predicates (taints, cordon, selectors) may have changed since the
+        # device candidate mask was computed (pipelined dispatch), and direct
+        # Evaluator.preempt callers pass arbitrary candidates.  At 5k nodes
+        # the full-oracle fits() per REPRIEVE step was the dominant
+        # preemption cost (cap = n/10 = 500 dry-runs per pod).
+        plain = not needs_global and not _pod_host_ports(pod) and not _pod_volumes(pod)
+
+        def full_fits() -> bool:
             feas = self.oracle.feasible_nodes(pod, others + [sim])
             return any(ni is sim for ni in feas)
 
-        if not fits():
+        def fits() -> bool:
+            from .oracle import fits_resources
+
+            if plain:
+                return fits_resources(pod, sim)
+            return full_fits()
+
+        if not full_fits():
             return None
         victims: List[v1.Pod] = []
         num_violating = 0
@@ -289,3 +308,13 @@ class Evaluator:
 def _argmin(pool, key):
     best = min(key(c) for c in pool)
     return [c for c in pool if key(c) == best]
+
+
+def _pod_host_ports(pod: v1.Pod) -> bool:
+    return any(
+        p.host_port > 0 for c in pod.spec.containers for p in c.ports
+    )
+
+
+def _pod_volumes(pod: v1.Pod) -> bool:
+    return bool(getattr(pod.spec, "volumes", None))

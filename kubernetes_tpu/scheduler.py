@@ -109,7 +109,6 @@ class _InFlight:
     # growth) between dispatch and the deferred bind, so the record owns it
     fw: object = None
     diag_dev: object = None  # bool[B, K] per-filter-plugin any-feasible bits
-    cand_dev: object = None  # bool[B, N] preemption candidate mask
 
 
 class TPUScheduler:
@@ -308,11 +307,11 @@ class TPUScheduler:
             _pow2(n_nodes, 1), _pow2(n_pods, 1),
             n_ids=16 * n_nodes + 8 * n_pods,
         )
-        # scatter-payload floors scaled to batch churn: a preemption burst
-        # deletes up to batch_size × victims pod rows in one cycle, and each
-        # pow2 bucket crossing recompiles the fused cycle program
-        self.encoder._scatter_bucket.setdefault("node_valid", _pow2(4 * self.batch_size, 256))
-        self.encoder._scatter_bucket.setdefault("pod_valid", _pow2(8 * self.batch_size, 256))
+        # fixed scatter buckets: steady cycles fit in 256 rows per group;
+        # larger bursts (preemption victim storms) overflow to the full
+        # upload inside to_device_deferred instead of growing the bucket
+        self.encoder._scatter_bucket.setdefault("node_valid", max(256, _pow2(self.batch_size, 32)))
+        self.encoder._scatter_bucket.setdefault("pod_valid", max(256, _pow2(2 * self.batch_size, 32)))
 
     # --- framework / jit management ------------------------------------------
 
@@ -354,34 +353,38 @@ class TPUScheduler:
             )
 
         def diagnostics(batch, dsnap, dyn, auxes):
-            # FitError diagnosis bits + preemption candidate mask, in the
-            # SAME program (XLA CSEs the filter planes) — the eager
-            # fallback paid a ~100ms pacing round per plugin per batch
-            diag = fw.diagnose_bits(batch, dsnap, dyn, auxes)
-            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
-            for pw, aux in zip(fw.plugins, auxes):
-                if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
-                    pw.plugin, "filter"
-                ):
-                    static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
-            cand = candidate_mask_device(batch, dsnap, dyn, static_ok)
-            return diag, cand
+            # FitError diagnosis bits in the SAME program (XLA CSEs the
+            # filter planes) — the eager fallback paid a ~100ms pacing round
+            # per plugin per batch.  The preemption candidate mask
+            # deliberately does NOT ride here: its freed-resources einsum
+            # contracts the full pod tier (O(B·N·R·P) ≈ 200 TFLOP at
+            # 5k-node/16k-pod shapes, ~400ms/cycle) and belongs only on
+            # batches that actually have unschedulable pods — computed
+            # lazily in _candidate_mask.
+            return fw.diagnose_bits(batch, dsnap, dyn, auxes)
 
         def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
-            diag, cand = diagnostics(batch, dsnap, dyn, auxes)
-            return res, auxes, dsnap, dyn, diag, cand
+            return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
 
         def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
-            diag, cand = diagnostics(batch, dsnap, dyn, auxes)
-            return res, auxes, dsnap, dyn, diag, cand
+            return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
+
+        def cand_mask(batch, dsnap, dyn, auxes):
+            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+            for pw, aux in zip(fw.plugins, auxes):
+                if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
+                    pw.plugin, "filter"
+                ):
+                    static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
+            return candidate_mask_device(batch, dsnap, dyn, static_ok)
 
         return {
             "prepare": jax.jit(fw.prepare),
@@ -389,6 +392,9 @@ class TPUScheduler:
             "batch": jax.jit(fused_batch),
             "compute_static": jax.jit(fw.compute_static),
             "compute_row": jax.jit(fw.compute_row),
+            # one device round per FAILING batch (not fused into every cycle:
+            # its freed-resources einsum is ~200 TFLOP at 5k/16k shapes)
+            "cand": jax.jit(cand_mask),
         }
 
     # --- the batched scheduling cycle ----------------------------------------
@@ -465,7 +471,7 @@ class TPUScheduler:
                              t0, cycle, profile=profile, fw=fw)
         dsnap, upd = self.encoder.to_device_deferred()
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
-        res, auxes, dsnap_out, dyn_out, diag, cand = self._run_assignment(
+        res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
             jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
@@ -478,8 +484,7 @@ class TPUScheduler:
         trace.step("Device dispatch")
         trace.log_if_long(0.1)
         return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
-                         None, t0, cycle, profile=profile, fw=fw,
-                         diag_dev=diag, cand_dev=cand)
+                         None, t0, cycle, profile=profile, fw=fw, diag_dev=diag)
 
     def _complete(self, fl: _InFlight) -> np.ndarray:
         """Fetch the batch's decisions and assume placements in the cache so
@@ -524,6 +529,7 @@ class TPUScheduler:
         fw = fl.fw
         batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
         diag_np = cand_np = None
+        pf_ctx = None  # per-batch preemption context, built on first failure
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
             row = int(node_row[i])
@@ -561,14 +567,26 @@ class TPUScheduler:
                 m.schedule_attempts.inc(("unschedulable",))
                 if diag_np is None and fl.diag_dev is not None:
                     diag_np = np.asarray(fl.diag_dev)  # one sync per failing batch
-                    cand_np = np.asarray(fl.cand_dev)
                 qi.unschedulable_plugins = self._diagnose(
                     fw, batch, dsnap, dyn, auxes, i,
                     diag_row=None if diag_np is None else diag_np[i],
                 )
+                if pf_ctx is None:
+                    # hoisted per batch: PDB list + row→name map (the
+                    # preemptors in one batch share them; nominated map is
+                    # NOT hoisted — each preemption must see the previous
+                    # pods' nominations)
+                    pf_ctx = (self.store.list("PodDisruptionBudget")[0],
+                              self.encoder.row_to_name())
+                if cand_np is None:
+                    # lazy: the candidate mask's full-pod-tier einsum runs
+                    # once per batch that actually has unschedulable pods
+                    cand_np = np.asarray(
+                        self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
+                    )
                 self._run_post_filter(
                     fw, qi, batch, dsnap, dyn, auxes, i,
-                    cand_row=None if cand_np is None else cand_np[i],
+                    cand_row=cand_np[i], pf_ctx=pf_ctx,
                 )
                 self.queue.add_unschedulable(qi, fl.cycle)
                 # scheduler.go:386 (Warning/FailedScheduling with diagnosis)
@@ -792,30 +810,28 @@ class TPUScheduler:
     # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
     _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
 
+    def _candidate_mask(self, profile, batch, dsnap, dyn, auxes):
+        """Preemption candidate mask for a whole batch — the profile's jitted
+        program, ONE device round per failing batch (eager plugin.filter
+        calls would each pay a ~100ms pacing round on the tunnel)."""
+        return self._jitted_by[profile]["cand"](batch, dsnap, dyn, auxes)
+
     def _run_post_filter(self, fw, qi: QueuedPodInfo, batch, dsnap, dyn, auxes,
-                         i: int, cand_row=None):
+                         i: int, cand_row, pf_ctx):
         """DefaultPreemption PostFilter (scheduler.go:533-552 → preemption.go:138).
 
-        ``cand_row`` (bool[N] from the fused program) skips the eager
-        candidate-mask computation; the eager path serves the extender mode.
+        ``cand_row`` bool[N] comes from the per-batch jitted candidate mask;
+        ``pf_ctx`` is the batch-hoisted (PDB list, row→name map).
         """
         pod = qi.pod
         if pod.spec.preemption_policy == "Never":
             return
         m.preemption_attempts.inc()
-        if cand_row is None:
-            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
-            for pw, aux in zip(fw.plugins, auxes):
-                if pw.plugin.name in self._STATIC_PLUGINS and hasattr(pw.plugin, "filter"):
-                    static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
-            cand_mask = candidate_mask_device(batch, dsnap, dyn, static_ok)
-            cand_row = np.asarray(cand_mask[i])
         rows = np.where(cand_row)[0]
         if rows.size == 0:
             return
-        name_of = self.encoder.row_to_name()
+        pdbs, name_of = pf_ctx
         names = [name_of[int(r)] for r in rows if int(r) in name_of]
-        pdbs, _ = self.store.list("PodDisruptionBudget")
         nominated: Dict[str, List[v1.Pod]] = {}
         for _uid, (nn, _req, npod) in self._nominated.items():
             nominated.setdefault(nn, []).append(npod)

@@ -530,8 +530,22 @@ class ClusterEncoder:
         DeviceSnapshot returned by its program (the arrays are async —
         committing the futures immediately is safe)."""
         numeric, use_scatter = self._upload_gate()
-        if not use_scatter:
-            return self.to_device(), None
+        # A dirty burst past the scatter bucket (preemption victim storms)
+        # takes the FULL-upload path — already compiled — rather than
+        # growing the bucket: bucket growth would both recompile the whole
+        # fused program (~10s) AND bloat every later steady cycle's payload
+        # (a 1024-row floor measured ~130ms/cycle of upload on the tunnel).
+        bucket = self._scatter_bucket.get("node_valid", 256)
+        pbucket = self._scatter_bucket.get("pod_valid", 256)
+        force_full = (
+            len(self._dirty_node_rows) > bucket
+            or len(self._dirty_pod_rows) > pbucket
+        )
+        if not use_scatter or force_full:
+            # force_full bypasses to_device's own scatter gate: a burst must
+            # take the precompiled whole-buffer device_put, not grow a fresh
+            # scatter shape (a mid-run compile stall)
+            return self.to_device(force_full=force_full), None
         d = self._device
         # Always emit BOTH groups and the numeric table: a None group or an
         # elided numeric would be a different pytree structure → a fresh
@@ -590,13 +604,14 @@ class ClusterEncoder:
         """Adopt a program-updated DeviceSnapshot as the current device state."""
         self._device = dsnap
 
-    def to_device(self, sharding=None) -> DeviceSnapshot:
+    def to_device(self, sharding=None, force_full: bool = False) -> DeviceSnapshot:
         """Upload: full device_put when shapes changed or dirt is large, else
-        row-scatter updates into the existing buffers (double-buffering is XLA's
-        job via donated args in the jitted updater)."""
+        row-scatter updates into the existing buffers."""
         import jax
 
         numeric, use_scatter = self._upload_gate()
+        if force_full:
+            use_scatter = False
         numeric_stale = len(self.dic) != self._uploaded_numeric_len
         if not use_scatter:
             put = (lambda x: jax.device_put(x, sharding)) if sharding else jnp.asarray

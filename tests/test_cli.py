@@ -76,3 +76,25 @@ def test_priority_class_admission():
     p2 = make_pod().name("p2").uid("p2").obj()
     store.create("Pod", p2)
     assert p2.spec.priority == 7  # global default applied
+
+
+def test_scheduler_binary_entry():
+    """python -m kubernetes_tpu --sim-nodes/--sim-pods runs end to end
+    (cmd/kube-scheduler flag layer analog)."""
+    from kubernetes_tpu.__main__ import main
+
+    rc = main(["--sim-nodes", "8", "--sim-pods", "16", "--batch-size", "8"])
+    assert rc == 0
+
+
+def test_scheduler_binary_with_config(tmp_path):
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(
+        '{"apiVersion": "kubescheduler.config.k8s.io/v1beta3",'
+        ' "profiles": [{"schedulerName": "default-scheduler"}]}'
+    )
+    from kubernetes_tpu.__main__ import main
+
+    rc = main(["--config", str(cfg), "--sim-nodes", "4", "--sim-pods", "4",
+               "--batch-size", "4", "--leader-elect"])
+    assert rc == 0

@@ -1,0 +1,56 @@
+"""Deterministic replay: the single-writer + immutable-snapshot design's
+testable counterpart to the reference's -race CI default (SURVEY §5 race
+detection; hack/make-rules/test.sh:76).
+
+The scheduler has one writer (the event-driven loop) and pure device
+programs, so the same store history must produce bit-identical bindings —
+a data race, iteration-order leak, or nondeterministic device reduction
+would break this.
+"""
+
+import numpy as np
+
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def _run_once(pipeline: bool):
+    rng = np.random.default_rng(42)
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=16, pipeline=pipeline)
+    for i in range(24):
+        w = (make_node().name(f"n{i:03d}")
+             .capacity({"cpu": f"{int(rng.choice([4, 8]))}", "memory": "16Gi",
+                        "pods": "32"})
+             .label("zone", f"z{i % 3}"))
+        store.create("Node", w.obj())
+    for i in range(60):
+        w = (make_pod().name(f"p{i:03d}").uid(f"p{i:03d}").namespace("default")
+             .label("app", f"a{i % 4}")
+             .req({"cpu": "1", "memory": "1Gi"}))
+        if i % 5 == 1:
+            w = w.topology_spread(2, "zone", labels={"app": f"a{i % 4}"})
+        if i % 5 == 3:
+            w = w.pod_affinity("zone", {"app": "a0"})
+        store.create("Pod", w.obj())
+    while True:
+        s = sched.schedule_cycle()
+        if s.attempted == 0 and s.in_flight == 0:
+            break
+    pods, _ = store.list("Pod")
+    return {p.metadata.name: p.spec.node_name for p in pods}
+
+
+def test_identical_bindings_across_replays():
+    a = _run_once(pipeline=False)
+    b = _run_once(pipeline=False)
+    assert a == b
+
+
+def test_pipeline_matches_synchronous_bindings():
+    """The pipelined binding cycle reorders WORK, not decisions: the same
+    history must bind identically with and without overlap."""
+    a = _run_once(pipeline=False)
+    c = _run_once(pipeline=True)
+    assert a == c
