@@ -124,6 +124,7 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # variant (each is a different traced shape; compiling one
                 # mid-window cost the Unschedulable suite a 6s stall) — the
                 # reference has no compile phase to exclude
+                warm_keys = []  # (namespace, name) — suite templates may be namespaced
                 for wi in range(3):
                     warm = (
                         make_pod().name(f"warmup-pod{wi}").uid(f"warmup-pod{wi}")
@@ -138,11 +139,24 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         warm = warm.pod_affinity(
                             "kubernetes.io/hostname", {"warmup": "1"}, anti=True
                         )
-                    store.create("Pod", warm.obj())
+                    warm = warm.obj()
+                    warm_keys.append((warm.metadata.namespace, warm.metadata.name))
+                    store.create("Pod", warm)
                     sched.schedule_cycle()
                     sched.schedule_cycle()  # pipeline: complete + bind it
-                for wi in range(3):
-                    store.delete("Pod", "default", f"warmup-pod{wi}")
+                # …and two pods from the SUITE'S OWN template: its label /
+                # constraint shapes can differ from the synthetic warmups'
+                # sticky caps, and the first template batch would otherwise
+                # compile (or cache-load, seconds) its program variant
+                # inside the measured window
+                for wi in range(2):
+                    warm = tmpl(9_990_000 + wi)
+                    warm_keys.append((warm.metadata.namespace, warm.metadata.name))
+                    store.create("Pod", warm)
+                    sched.schedule_cycle()
+                    sched.schedule_cycle()
+                for ns, name in warm_keys:
+                    store.delete("Pod", ns, name)
             created = []
             for _ in range(op.count):
                 p = tmpl(pod_idx)

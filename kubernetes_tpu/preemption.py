@@ -311,9 +311,10 @@ def _argmin(pool, key):
 
 
 def _pod_host_ports(pod: v1.Pod) -> bool:
-    return any(
-        p.host_port > 0 for c in pod.spec.containers for p in c.ports
-    )
+    # single source of truth for host-port extraction (node_info shares it)
+    from .state.node_info import _pod_host_ports as _hp
+
+    return bool(_hp(pod))
 
 
 def _pod_volumes(pod: v1.Pod) -> bool:

@@ -571,23 +571,22 @@ class TPUScheduler:
                     fw, batch, dsnap, dyn, auxes, i,
                     diag_row=None if diag_np is None else diag_np[i],
                 )
-                if pf_ctx is None:
-                    # hoisted per batch: PDB list + row→name map (the
-                    # preemptors in one batch share them; nominated map is
-                    # NOT hoisted — each preemption must see the previous
-                    # pods' nominations)
-                    pf_ctx = (self.store.list("PodDisruptionBudget")[0],
-                              self.encoder.row_to_name())
-                if cand_np is None:
-                    # lazy: the candidate mask's full-pod-tier einsum runs
-                    # once per batch that actually has unschedulable pods
-                    cand_np = np.asarray(
-                        self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
+                if qi.pod.spec.preemption_policy != "Never":
+                    # the lazy context (PDB list, row→name, candidate-mask
+                    # program) is only built once a pod that CAN preempt
+                    # fails — its full-pod-tier einsum must not run for
+                    # Never-policy batches
+                    if pf_ctx is None:
+                        pf_ctx = (self.store.list("PodDisruptionBudget")[0],
+                                  self.encoder.row_to_name())
+                    if cand_np is None:
+                        cand_np = np.asarray(
+                            self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
+                        )
+                    self._run_post_filter(
+                        fw, qi, batch, dsnap, dyn, auxes, i,
+                        cand_row=cand_np[i], pf_ctx=pf_ctx,
                     )
-                self._run_post_filter(
-                    fw, qi, batch, dsnap, dyn, auxes, i,
-                    cand_row=cand_np[i], pf_ctx=pf_ctx,
-                )
                 self.queue.add_unschedulable(qi, fl.cycle)
                 # scheduler.go:386 (Warning/FailedScheduling with diagnosis)
                 failing = ", ".join(sorted(qi.unschedulable_plugins))
