@@ -151,6 +151,12 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # inside the measured window
                 for wi in range(2):
                     warm = tmpl(9_990_000 + wi)
+                    # warm pods must be NON-DISRUPTIVE: a high-priority suite
+                    # template (PreemptionBasic) would otherwise preempt init
+                    # pods that are never restored, corrupting the measured
+                    # window's declared initial state.  preemptionPolicy is
+                    # data, not shape — the program variant warms identically.
+                    warm.spec.preemption_policy = "Never"
                     warm_keys.append((warm.metadata.namespace, warm.metadata.name))
                     store.create("Pod", warm)
                     sched.schedule_cycle()

@@ -285,6 +285,11 @@ SUITES: Dict[str, Suite] = {
         # The north-star config (BASELINE.md): 5k nodes, 10k pending pods,
         # measured per-attempt
         Suite("NorthStar", _basic, {"5000Nodes/10000Pods": (5000, 2000, 10000)}),
+        # The reference's historic density target (scheduler_perf README:
+        # 30k pods on 1000 fake nodes; 3k pods on 100 nodes)
+        Suite("Density", _basic,
+              {"1000Nodes/30000Pods": (1000, 0, 30000),
+               "100Nodes/3000Pods": (100, 0, 3000)}),
     ]
 }
 

@@ -29,6 +29,7 @@ from .api.labels import match_label_selector
 from .oracle import Oracle
 from .state.cache import Snapshot
 from .state.node_info import NodeInfo
+from .state.node_info import _pod_host_ports as _node_info_host_ports
 
 
 @dataclass
@@ -311,10 +312,8 @@ def _argmin(pool, key):
 
 
 def _pod_host_ports(pod: v1.Pod) -> bool:
-    # single source of truth for host-port extraction (node_info shares it)
-    from .state.node_info import _pod_host_ports as _hp
-
-    return bool(_hp(pod))
+    # single source of truth for host-port extraction (node_info's helper)
+    return bool(_node_info_host_ports(pod))
 
 
 def _pod_volumes(pod: v1.Pod) -> bool:
