@@ -94,3 +94,25 @@ def test_scheduler_from_config_two_profiles():
     assert stats.scheduled == 1
     names = {pw.plugin.name for pw in sched._fws["no-spread"].plugins}
     assert "PodTopologySpread" not in names
+
+
+def test_v1beta2_config_accepted():
+    """Both served componentconfig versions load (apis/config v1beta2 +
+    v1beta3 share the internal type here; the scheme prefix is validated)."""
+    cfg = load_config({
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta2",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [
+            {"schedulerName": "default-scheduler",
+             "plugins": {"score": {"disabled": [{"name": "ImageLocality"}]}}},
+        ],
+        "percentageOfNodesToScore": 50,
+    })
+    prof = cfg.profile()
+    names = [e.name for e in prof.effective_plugins()]
+    assert "ImageLocality" not in names
+    assert "NodeResourcesFit" in names
+    import pytest
+
+    with pytest.raises(ValueError):
+        load_config({"apiVersion": "not.a.scheduler/v1"})
