@@ -54,3 +54,27 @@ def test_pipeline_matches_synchronous_bindings():
     a = _run_once(pipeline=False)
     c = _run_once(pipeline=True)
     assert a == c
+
+
+def test_pod_deleted_mid_flight_is_not_requeued():
+    """A pod deleted between dispatch and bind (pipeline mode) must be
+    dropped after the failed bind, not requeued forever — its DELETE event
+    was consumed while it was in flight (binding-cycle error path,
+    scheduler.go:676-689 + the ghost-pod guard)."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, pipeline=True)
+    store.create("Node", make_node().name("n0").obj())
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1m"}).obj())
+    s1 = sched.schedule_cycle()       # dispatched, in flight
+    assert s1.in_flight == 1
+    store.delete("Pod", "default", "p")   # deleted while in flight
+    s2 = sched.schedule_cycle()       # completes: assume + bind fails
+    assert s2.scheduled == 0
+    # queue must be empty — no ghost retries
+    a, b, u = sched.queue.pending_count()
+    assert (a, b, u) == (0, 0, 0)
+    s3 = sched.schedule_cycle()
+    assert s3.attempted == 0 and s3.in_flight == 0
+    # and the cache holds no leaked assumed pod
+    assert "p" not in sched.cache._pod_states
