@@ -44,11 +44,15 @@ class IPAAux(NamedTuple):
     dom_anti: jnp.ndarray  # i32[B, T2, N]
     dom_paff: jnp.ndarray  # i32[B, T3, N]
     dom_panti: jnp.ndarray  # i32[B, T4, N]
-    # count tables (trash slot at D absorbs missing keys)
-    aff_counts: jnp.ndarray  # i32[B, T1, D+1]
-    anti_counts: jnp.ndarray  # i32[B, T2, D+1]
-    paff_counts: jnp.ndarray  # i32[B, T3, D+1]
-    panti_counts: jnp.ndarray  # i32[B, T4, D+1]
+    # PER-NODE count planes: plane[b, t, n] = matching pods in node n's
+    # domain under term (b, t).  Equivalent to gather(table, dom) but carried
+    # in gathered form: the scan's per-step reads become O(N) instead of the
+    # O(N·D) one-hot domain gathers (with hostname topology D ≈ N, those were
+    # O(N²) per step and dominated the anti-affinity suites at 5k nodes).
+    aff_cnt: jnp.ndarray  # i32[B, T1, N]
+    anti_cnt: jnp.ndarray  # i32[B, T2, N]
+    paff_cnt: jnp.ndarray  # i32[B, T3, N]
+    panti_cnt: jnp.ndarray  # i32[B, T4, N]
     aff_total: jnp.ndarray  # i32[B] Σ affinityCounts (len()==0 test)
     self_match_all: jnp.ndarray  # bool[B]
     # host-precomputed static planes
@@ -219,6 +223,11 @@ class InterPodAffinityPlugin(Plugin):
         paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid)
         panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid)
         aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
+        # tables → per-node planes, gathered ONCE here (see IPAAux docstring)
+        aff_cnt = domain_gather(aff_counts, dom_aff).astype(jnp.int32)
+        anti_cnt = domain_gather(anti_counts, dom_anti).astype(jnp.int32)
+        paff_cnt = domain_gather(paff_counts, dom_paff).astype(jnp.int32)
+        panti_cnt = domain_gather(panti_counts, dom_panti).astype(jnp.int32)
 
         # cross tensors vs pending pods
         x_aff = self._match_vs(g_aff, batch.label_keys, batch.label_vals, batch.ns, num)
@@ -240,8 +249,8 @@ class InterPodAffinityPlugin(Plugin):
             }
         return IPAAux(
             dom_aff=dom_aff, dom_anti=dom_anti, dom_paff=dom_paff, dom_panti=dom_panti,
-            aff_counts=aff_counts, anti_counts=anti_counts,
-            paff_counts=paff_counts, panti_counts=panti_counts,
+            aff_cnt=aff_cnt, anti_cnt=anti_cnt,
+            paff_cnt=paff_cnt, panti_cnt=panti_cnt,
             aff_total=aff_total, self_match_all=self_match_all,
             exist_anti_block=jnp.asarray(host_aux["exist_anti_block"]),
             score_static=jnp.asarray(host_aux["score_static"]),
@@ -261,7 +270,7 @@ class InterPodAffinityPlugin(Plugin):
         g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
 
         # incoming required affinity (satisfyPodAffinity, filtering.go:338-360)
-        cnt = domain_gather(aux.aff_counts, aux.dom_aff)  # [B, T1, N]
+        cnt = aux.aff_cnt  # [B, T1, N] per-node plane
         key_ok = aux.dom_aff < d
         keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)  # [B, N]
         pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
@@ -269,7 +278,7 @@ class InterPodAffinityPlugin(Plugin):
         aff_ok = keys_all & (pods_exist | first_pod[:, None])
 
         # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
-        acnt = domain_gather(aux.anti_counts, aux.dom_anti)
+        acnt = aux.anti_cnt
         anti_bad = jnp.any(
             g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0), axis=1
         )
@@ -284,8 +293,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
-        c_paff = domain_gather(aux.paff_counts, aux.dom_paff)  # [B,T3,N]
-        c_panti = domain_gather(aux.panti_counts, aux.dom_panti)
+        c_paff = aux.paff_cnt  # [B,T3,N] per-node plane
+        c_panti = aux.panti_cnt
         own = (
             jnp.sum(jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0), axis=1)
             - jnp.sum(jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0), axis=1)
@@ -313,13 +322,13 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
         anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
-        cnt = domain_gather(aux.aff_counts[i], aux.dom_aff[i])  # [T1, N]
+        cnt = aux.aff_cnt[i]  # [T1, N]
         key_ok = aux.dom_aff[i] < d
         keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
         pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
         first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
         aff_ok = keys_all & (pods_exist | first_pod)
-        acnt = domain_gather(aux.anti_counts[i], aux.dom_anti[i])
+        acnt = aux.anti_cnt[i]
         anti_bad = jnp.any(
             anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0), axis=0
         )
@@ -331,8 +340,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
-        c_paff = domain_gather(aux.paff_counts[i], aux.dom_paff[i])
-        c_panti = domain_gather(aux.panti_counts[i], aux.dom_panti[i])
+        c_paff = aux.paff_cnt[i]
+        c_panti = aux.panti_cnt[i]
         own = (
             jnp.sum(jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0), axis=0)
             - jnp.sum(jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0), axis=0)
@@ -346,9 +355,15 @@ class InterPodAffinityPlugin(Plugin):
             return None
         """Pod i placed on node_row — the device analog of updateWithPod."""
         d = self.domain_cap
-        b = aux.aff_cross_all.shape[0]
         t1 = aux.dom_aff.shape[1]
-        t2 = aux.dom_anti.shape[1]
+
+        def plane_bump(plane, dom, inc):
+            # plane[b,t,n] += inc[b,t] for every node n sharing the committed
+            # node's domain under (b,t) — O(B·T·N) compare-add, no D factor
+            # (the table point-scatter this replaces was O(B·T·D))
+            dom_at = dom[:, :, node_row]  # [B, T]
+            same = dom == dom_at[:, :, None]
+            return plane + inc[:, :, None] * same.astype(plane.dtype)
 
         # 1) pending pods' affinityCounts: j gains where i matches ALL j's terms
         dom_at_aff = aux.dom_aff[:, :, node_row]  # [B, T1]
@@ -357,13 +372,13 @@ class InterPodAffinityPlugin(Plugin):
             & jnp.asarray(batch.req_affinity.valid)
             & (dom_at_aff < d)
         ).astype(jnp.int32)
-        aff_counts = point_scatter_add(aux.aff_counts, dom_at_aff, inc_aff)
+        aff_cnt = plane_bump(aux.aff_cnt, aux.dom_aff, inc_aff)
         aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
 
         # 2) pending pods' antiAffinityCounts (their own terms vs placed pod i)
         dom_at_anti = aux.dom_anti[:, :, node_row]
         inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
-        anti_counts = point_scatter_add(aux.anti_counts, dom_at_anti, inc_anti)
+        anti_cnt = plane_bump(aux.anti_cnt, aux.dom_anti, inc_anti)
 
         # 3) placed pod i's own req-anti terms block domains for matching pods j
         #    (anti_cross[i] is [T2, B]: term t of pod i vs pending pod j)
@@ -374,17 +389,15 @@ class InterPodAffinityPlugin(Plugin):
             aux.anti_cross[i][:, :, None] & same_anti[:, None, :], axis=0
         )  # [B, N]
 
-        # 4) pending pods' own pref tables gain from placed pod i
-        t3 = aux.dom_paff.shape[1]
-        t4 = aux.dom_panti.shape[1]
+        # 4) pending pods' own pref planes gain from placed pod i
         dom_at_paff = aux.dom_paff[:, :, node_row]
-        paff_counts = point_scatter_add(
-            aux.paff_counts, dom_at_paff,
+        paff_cnt = plane_bump(
+            aux.paff_cnt, aux.dom_paff,
             (aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32),
         )
         dom_at_panti = aux.dom_panti[:, :, node_row]
-        panti_counts = point_scatter_add(
-            aux.panti_counts, dom_at_panti,
+        panti_cnt = plane_bump(
+            aux.panti_cnt, aux.dom_panti,
             (aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32),
         )
 
@@ -403,8 +416,8 @@ class InterPodAffinityPlugin(Plugin):
         score_dyn = score_dyn - plane(aux.panti_cross[i], aux.dom_panti[i], w4)
 
         return aux._replace(
-            aff_counts=aff_counts, aff_total=aff_total, anti_counts=anti_counts,
-            block_dyn=block_dyn, paff_counts=paff_counts, panti_counts=panti_counts,
+            aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
+            block_dyn=block_dyn, paff_cnt=paff_cnt, panti_cnt=panti_cnt,
             score_dyn=score_dyn,
         )
 
@@ -418,29 +431,33 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
 
         def count_inc(cross, dom):
-            """cross [B, T, B] (term (b,t) vs pending pod i) → table bump
-            [B, T, D+1] from all committed pods, trash column zeroed (the
-            serial path never bumps trash)."""
+            """cross [B, T, B] (term (b,t) vs pending pod i) → (per-node plane
+            bump [B, T, N], table mass [B]) from all committed pods: scatter
+            to domains, zero the trash column (the serial path never bumps
+            trash), gather back — O(N·D) once per round, not per scan step."""
             contrib = jnp.einsum("bti,in->btn", cross.astype(jnp.float32), u)
             tbl = domain_scatter_add(contrib, dom, d + 1)
-            return tbl * (jnp.arange(d + 1) < d)
+            tbl = tbl * (jnp.arange(d + 1) < d)
+            return domain_gather(tbl, dom), jnp.sum(tbl, axis=(1, 2))
 
         g_aff_valid = jnp.asarray(batch.req_affinity.valid)
         aff_cross = (
             aux.aff_cross_all[:, None, :] & g_aff_valid[:, :, None]
         )  # [B, T1, B]
-        aff_inc = count_inc(aff_cross, aux.dom_aff)
-        aff_counts = aux.aff_counts + aff_inc.astype(jnp.int32)
-        aff_total = aux.aff_total + jnp.sum(aff_inc, axis=(1, 2)).astype(jnp.int32)
-        anti_counts = aux.anti_counts + count_inc(
+        aff_inc, aff_mass = count_inc(aff_cross, aux.dom_aff)
+        # aff_total adds the TABLE mass (one bump per domain), not the plane
+        # mass (which would multiply by domain size)
+        aff_total = aux.aff_total + aff_mass.astype(jnp.int32)
+        aff_cnt = aux.aff_cnt + aff_inc.astype(jnp.int32)
+        anti_cnt = aux.anti_cnt + count_inc(
             aux.anti_cross, aux.dom_anti
-        ).astype(jnp.int32)
-        paff_counts = aux.paff_counts + count_inc(
+        )[0].astype(jnp.int32)
+        paff_cnt = aux.paff_cnt + count_inc(
             aux.paff_cross, aux.dom_paff
-        ).astype(jnp.int32)
-        panti_counts = aux.panti_counts + count_inc(
+        )[0].astype(jnp.int32)
+        panti_cnt = aux.panti_cnt + count_inc(
             aux.panti_cross, aux.dom_panti
-        ).astype(jnp.int32)
+        )[0].astype(jnp.int32)
 
         def same_domains(dom):
             """same[i, t, n] — node n shares committed pod i's domain under
@@ -481,7 +498,7 @@ class InterPodAffinityPlugin(Plugin):
         score_dyn = score_dyn - plane(aux.panti_cross, aux.dom_panti, w4)
 
         return aux._replace(
-            aff_counts=aff_counts, aff_total=aff_total, anti_counts=anti_counts,
-            block_dyn=block_dyn, paff_counts=paff_counts, panti_counts=panti_counts,
+            aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
+            block_dyn=block_dyn, paff_cnt=paff_cnt, panti_cnt=panti_cnt,
             score_dyn=score_dyn,
         )
