@@ -304,12 +304,41 @@ class BatchedFramework:
             out_node = jnp.where(feasible & batch.valid[i], node, -1)
             return (dyn, dauxes), {"i": i, "node": out_node, "feasible_n": feasible_n}
 
-        inputs = {"i": order.astype(jnp.int32)}
-        if key is not None:
-            inputs["key"] = jax.random.split(key, b)
-        (dyn, _), outs = jax.lax.scan(step, (dyn, dyn_auxes), inputs)
-        node_row = jnp.full((b,), -1, jnp.int32).at[outs["i"]].set(outs["node"])
-        feasible_count = jnp.zeros((b,), jnp.int32).at[outs["i"]].set(outs["feasible_n"])
+        order_arr = order.astype(jnp.int32)
+        keys = jax.random.split(key, b) if key is not None else None
+        # while_loop with a DYNAMIC trip count instead of lax.scan over all b
+        # padded positions — a 10-pod backoff-retry batch runs 10 steps, not
+        # 128.  Padding pods were no-ops in the scan (valid gating) so results
+        # are identical.  The bound is the last ORDER position naming a valid
+        # pod (robust to any caller-supplied permutation, not just the
+        # end-padded identity order pop_batch produces).
+        n_valid = jnp.max(
+            jnp.where(
+                batch.valid[order_arr],
+                jnp.arange(b, dtype=jnp.int32) + 1,
+                0,
+            )
+        )
+        node_row0 = jnp.full((b,), -1, jnp.int32)
+        feasible0 = jnp.zeros((b,), jnp.int32)
+
+        def cond(state):
+            k, *_ = state
+            return k < n_valid
+
+        def body(state):
+            k, dyn, dauxes, node_row, feasible_count = state
+            inp = {"i": order_arr[k]}
+            if keys is not None:
+                inp["key"] = keys[k]
+            (dyn, dauxes), out = step((dyn, dauxes), inp)
+            node_row = node_row.at[out["i"]].set(out["node"])
+            feasible_count = feasible_count.at[out["i"]].set(out["feasible_n"])
+            return (k + 1, dyn, dauxes, node_row, feasible_count)
+
+        _, dyn, _, node_row, feasible_count = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), dyn, dyn_auxes, node_row0, feasible0)
+        )
         return AssignResult(node_row=node_row, feasible_count=feasible_count, dyn=dyn)
 
     def _apply_dynamic(self, dyn, dauxes, dyn_plugins, i, node_row, batch, snap):

@@ -44,15 +44,16 @@ class IPAAux(NamedTuple):
     dom_anti: jnp.ndarray  # i32[B, T2, N]
     dom_paff: jnp.ndarray  # i32[B, T3, N]
     dom_panti: jnp.ndarray  # i32[B, T4, N]
-    # PER-NODE count planes: plane[b, t, n] = matching pods in node n's
-    # domain under term (b, t).  Equivalent to gather(table, dom) but carried
-    # in gathered form: the scan's per-step reads become O(N) instead of the
-    # O(N·D) one-hot domain gathers (with hostname topology D ≈ N, those were
-    # O(N²) per step and dominated the anti-affinity suites at 5k nodes).
-    aff_cnt: jnp.ndarray  # i32[B, T1, N]
-    anti_cnt: jnp.ndarray  # i32[B, T2, N]
-    paff_cnt: jnp.ndarray  # i32[B, T3, N]
-    panti_cnt: jnp.ndarray  # i32[B, T4, N]
+    # Count state in one of two STATICALLY-chosen representations
+    # (InterPodAffinityPlugin._use_planes): per-node PLANES [B, T, N]
+    # (plane[b,t,n] = matching pods in node n's domain — O(N) step reads,
+    # no O(N·D) gathers; right when D ≈ N, i.e. hostname topology) or the
+    # original per-domain TABLES [B, T, D+1] (right when D ≪ N — carrying
+    # [B,T,N] planes would cost ~N/D more per scan step than the tables).
+    aff_cnt: jnp.ndarray  # i32[B, T1, N or D+1]
+    anti_cnt: jnp.ndarray  # i32[B, T2, N or D+1]
+    paff_cnt: jnp.ndarray  # i32[B, T3, N or D+1]
+    panti_cnt: jnp.ndarray  # i32[B, T4, N or D+1]
     aff_total: jnp.ndarray  # i32[B] Σ affinityCounts (len()==0 test)
     self_match_all: jnp.ndarray  # bool[B]
     # host-precomputed static planes
@@ -72,6 +73,22 @@ class IPAAux(NamedTuple):
 class InterPodAffinityPlugin(Plugin):
     name = "InterPodAffinity"
     dynamic = True
+
+    def _use_planes(self, snap) -> bool:
+        """Static (trace-time) representation choice for the count state:
+        per-node PLANES [B,T,N] when domains are dense (hostname topology,
+        D ≈ N — the per-step table gathers would be O(N²)); per-domain
+        TABLES [B,T,D+1] when D ≪ N (zone/rack topologies — carrying and
+        rewriting [B,T,N] planes per scan step would cost ~N/D more than
+        the tables they replace).  domain_cap and num_nodes are both static
+        shapes, so each regime compiles its own program."""
+        return self.domain_cap * 4 >= snap.num_nodes
+
+    def _read_cnt(self, snap, cnt, dom):
+        """cnt state → per-node counts [..., N] under either representation."""
+        if self._use_planes(snap):
+            return cnt
+        return domain_gather(cnt, dom)
 
     def __init__(self, domain_cap: int = 256,
                  hard_pod_affinity_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT):
@@ -223,11 +240,15 @@ class InterPodAffinityPlugin(Plugin):
         paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid)
         panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid)
         aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
-        # tables → per-node planes, gathered ONCE here (see IPAAux docstring)
-        aff_cnt = domain_gather(aff_counts, dom_aff).astype(jnp.int32)
-        anti_cnt = domain_gather(anti_counts, dom_anti).astype(jnp.int32)
-        paff_cnt = domain_gather(paff_counts, dom_paff).astype(jnp.int32)
-        panti_cnt = domain_gather(panti_counts, dom_panti).astype(jnp.int32)
+        if self._use_planes(snap):
+            # tables → per-node planes, gathered ONCE here (IPAAux docstring)
+            aff_cnt = domain_gather(aff_counts, dom_aff).astype(jnp.int32)
+            anti_cnt = domain_gather(anti_counts, dom_anti).astype(jnp.int32)
+            paff_cnt = domain_gather(paff_counts, dom_paff).astype(jnp.int32)
+            panti_cnt = domain_gather(panti_counts, dom_panti).astype(jnp.int32)
+        else:
+            aff_cnt, anti_cnt = aff_counts, anti_counts
+            paff_cnt, panti_cnt = paff_counts, panti_counts
 
         # cross tensors vs pending pods
         x_aff = self._match_vs(g_aff, batch.label_keys, batch.label_vals, batch.ns, num)
@@ -270,7 +291,7 @@ class InterPodAffinityPlugin(Plugin):
         g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
 
         # incoming required affinity (satisfyPodAffinity, filtering.go:338-360)
-        cnt = aux.aff_cnt  # [B, T1, N] per-node plane
+        cnt = self._read_cnt(snap, aux.aff_cnt, aux.dom_aff)  # [B, T1, N]
         key_ok = aux.dom_aff < d
         keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)  # [B, N]
         pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
@@ -278,7 +299,7 @@ class InterPodAffinityPlugin(Plugin):
         aff_ok = keys_all & (pods_exist | first_pod[:, None])
 
         # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
-        acnt = aux.anti_cnt
+        acnt = self._read_cnt(snap, aux.anti_cnt, aux.dom_anti)
         anti_bad = jnp.any(
             g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0), axis=1
         )
@@ -293,8 +314,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
-        c_paff = aux.paff_cnt  # [B,T3,N] per-node plane
-        c_panti = aux.panti_cnt
+        c_paff = self._read_cnt(snap, aux.paff_cnt, aux.dom_paff)  # [B,T3,N]
+        c_panti = self._read_cnt(snap, aux.panti_cnt, aux.dom_panti)
         own = (
             jnp.sum(jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0), axis=1)
             - jnp.sum(jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0), axis=1)
@@ -322,13 +343,13 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
         anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
-        cnt = aux.aff_cnt[i]  # [T1, N]
+        cnt = self._read_cnt(snap, aux.aff_cnt[i], aux.dom_aff[i])  # [T1, N]
         key_ok = aux.dom_aff[i] < d
         keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
         pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
         first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
         aff_ok = keys_all & (pods_exist | first_pod)
-        acnt = aux.anti_cnt[i]
+        acnt = self._read_cnt(snap, aux.anti_cnt[i], aux.dom_anti[i])
         anti_bad = jnp.any(
             anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0), axis=0
         )
@@ -340,8 +361,8 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
-        c_paff = aux.paff_cnt[i]
-        c_panti = aux.panti_cnt[i]
+        c_paff = self._read_cnt(snap, aux.paff_cnt[i], aux.dom_paff[i])
+        c_panti = self._read_cnt(snap, aux.panti_cnt[i], aux.dom_panti[i])
         own = (
             jnp.sum(jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0), axis=0)
             - jnp.sum(jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0), axis=0)
@@ -357,13 +378,16 @@ class InterPodAffinityPlugin(Plugin):
         d = self.domain_cap
         t1 = aux.dom_aff.shape[1]
 
-        def plane_bump(plane, dom, inc):
-            # plane[b,t,n] += inc[b,t] for every node n sharing the committed
-            # node's domain under (b,t) — O(B·T·N) compare-add, no D factor
-            # (the table point-scatter this replaces was O(B·T·D))
-            dom_at = dom[:, :, node_row]  # [B, T]
-            same = dom == dom_at[:, :, None]
-            return plane + inc[:, :, None] * same.astype(plane.dtype)
+        use_planes = self._use_planes(snap)
+
+        def bump(cnt, dom, dom_at, inc):
+            # inc[b,t] is already gated on (dom_at < d).  Planes: O(B·T·N)
+            # same-domain compare-add (no D factor — the win for hostname
+            # topology).  Tables: the original O(B·T·D) point scatter.
+            if use_planes:
+                same = dom == dom_at[:, :, None]
+                return cnt + inc[:, :, None] * same.astype(cnt.dtype)
+            return point_scatter_add(cnt, dom_at, inc)
 
         # 1) pending pods' affinityCounts: j gains where i matches ALL j's terms
         dom_at_aff = aux.dom_aff[:, :, node_row]  # [B, T1]
@@ -372,13 +396,13 @@ class InterPodAffinityPlugin(Plugin):
             & jnp.asarray(batch.req_affinity.valid)
             & (dom_at_aff < d)
         ).astype(jnp.int32)
-        aff_cnt = plane_bump(aux.aff_cnt, aux.dom_aff, inc_aff)
+        aff_cnt = bump(aux.aff_cnt, aux.dom_aff, dom_at_aff, inc_aff)
         aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
 
         # 2) pending pods' antiAffinityCounts (their own terms vs placed pod i)
         dom_at_anti = aux.dom_anti[:, :, node_row]
         inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
-        anti_cnt = plane_bump(aux.anti_cnt, aux.dom_anti, inc_anti)
+        anti_cnt = bump(aux.anti_cnt, aux.dom_anti, dom_at_anti, inc_anti)
 
         # 3) placed pod i's own req-anti terms block domains for matching pods j
         #    (anti_cross[i] is [T2, B]: term t of pod i vs pending pod j)
@@ -391,13 +415,13 @@ class InterPodAffinityPlugin(Plugin):
 
         # 4) pending pods' own pref planes gain from placed pod i
         dom_at_paff = aux.dom_paff[:, :, node_row]
-        paff_cnt = plane_bump(
-            aux.paff_cnt, aux.dom_paff,
+        paff_cnt = bump(
+            aux.paff_cnt, aux.dom_paff, dom_at_paff,
             (aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32),
         )
         dom_at_panti = aux.dom_panti[:, :, node_row]
-        panti_cnt = plane_bump(
-            aux.panti_cnt, aux.dom_panti,
+        panti_cnt = bump(
+            aux.panti_cnt, aux.dom_panti, dom_at_panti,
             (aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32),
         )
 
@@ -430,15 +454,19 @@ class InterPodAffinityPlugin(Plugin):
         [B, N] (placed pod i → its node)."""
         d = self.domain_cap
 
+        use_planes = self._use_planes(snap)
+
         def count_inc(cross, dom):
-            """cross [B, T, B] (term (b,t) vs pending pod i) → (per-node plane
-            bump [B, T, N], table mass [B]) from all committed pods: scatter
-            to domains, zero the trash column (the serial path never bumps
-            trash), gather back — O(N·D) once per round, not per scan step."""
+            """cross [B, T, B] (term (b,t) vs pending pod i) → (count-state
+            bump in the active representation, table mass [B]) from all
+            committed pods: scatter to domains, zero the trash column (the
+            serial path never bumps trash), then gather back when carrying
+            planes — O(N·D) once per round, not per scan step."""
             contrib = jnp.einsum("bti,in->btn", cross.astype(jnp.float32), u)
             tbl = domain_scatter_add(contrib, dom, d + 1)
             tbl = tbl * (jnp.arange(d + 1) < d)
-            return domain_gather(tbl, dom), jnp.sum(tbl, axis=(1, 2))
+            inc = domain_gather(tbl, dom) if use_planes else tbl
+            return inc, jnp.sum(tbl, axis=(1, 2))
 
         g_aff_valid = jnp.asarray(batch.req_affinity.valid)
         aff_cross = (
