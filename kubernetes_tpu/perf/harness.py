@@ -125,7 +125,7 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # mid-window cost the Unschedulable suite a 6s stall) — the
                 # reference has no compile phase to exclude
                 warm_keys = []  # (namespace, name) — suite templates may be namespaced
-                for wi in range(3):
+                for wi in range(4):
                     warm = (
                         make_pod().name(f"warmup-pod{wi}").uid(f"warmup-pod{wi}")
                         .namespace("default").req({"cpu": "1m"})
@@ -139,6 +139,14 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         warm = warm.pod_affinity(
                             "kubernetes.io/hostname", {"warmup": "1"}, anti=True
                         )
+                    if wi == 3:
+                        # an unschedulable pod warms the FAILURE path: the
+                        # diagnosis fetch and the jitted preemption
+                        # candidate-mask program (run per failing batch).
+                        # Default priority 0 → no pod ranks strictly lower,
+                        # so the warm preemption attempt finds no victims
+                        # and disturbs nothing.
+                        warm = warm.req({"cpu": "100000"})
                     warm = warm.obj()
                     warm_keys.append((warm.metadata.namespace, warm.metadata.name))
                     store.create("Pod", warm)
