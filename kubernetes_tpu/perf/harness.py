@@ -116,13 +116,14 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
             if op.collect_metrics:
-                # jit warmup BEFORE the measured pods exist: drive THREE
+                # jit warmup BEFORE the measured pods exist: drive FOUR
                 # disposable pods through back-to-back cycles so the program
-                # variants compile pre-window — cycle 1 is the full-upload
-                # snapshot path, cycle 2 the steady-state scatter path, pod 3
-                # carries anti-affinity to warm the coupled greedy-scan
-                # variant (each is a different traced shape; compiling one
-                # mid-window cost the Unschedulable suite a 6s stall) — the
+                # variants compile pre-window — pod 1 the full-upload
+                # snapshot path, pod 2 the steady-state scatter path, pod 3
+                # the coupled greedy-scan variant (anti-affinity), pod 4 the
+                # failure path (diagnosis fetch + jitted candidate mask);
+                # each is a different traced shape, and compiling one
+                # mid-window cost the Unschedulable suite a 6s stall — the
                 # reference has no compile phase to exclude
                 warm_keys = []  # (namespace, name) — suite templates may be namespaced
                 for wi in range(4):

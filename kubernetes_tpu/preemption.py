@@ -100,6 +100,13 @@ def more_important(a: v1.Pod, b: v1.Pod) -> bool:
 class Evaluator:
     def __init__(self, oracle: Optional[Oracle] = None):
         self.oracle = oracle or Oracle()
+        # rotating start offset into the candidate list (the reference draws
+        # rand.Intn(len(potentialNodes)) per attempt, preemption.go
+        # findCandidates/GetOffsetAndNumCandidates): without it every
+        # preemptor in a burst dry-runs the SAME first-cap nodes, later ones
+        # find them all claimed by earlier nominations, return no candidate,
+        # and burn a full retry cycle
+        self._offset = 0
 
     def select_victims_on_node(
         self,
@@ -258,7 +265,12 @@ class Evaluator:
         has_anti = bool(snapshot.have_pods_with_required_anti_affinity_list)
         by_name = {ni.node_name: ni for ni in node_infos}
         candidates: List[Candidate] = []
-        for name in list(candidate_nodes)[:cap]:
+        pool = list(candidate_nodes)
+        if len(pool) > cap:
+            start = self._offset % len(pool)
+            self._offset += cap
+            pool = pool[start:] + pool[:start]
+        for name in pool[:cap]:
             info = by_name.get(name)
             if info is None:
                 continue
