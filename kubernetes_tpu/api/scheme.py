@@ -1,0 +1,96 @@
+"""API scheme: (group/version, kind) → type registry with dispatch decode.
+
+Reference: staging/src/k8s.io/apimachinery/pkg/runtime (runtime.Scheme —
+AddKnownTypes, ObjectKinds, the decode path every component uses to turn
+manifests into typed objects).  This build's types carry their own
+``from_dict`` converters; the scheme adds what they lack alone:
+
+  - GVK dispatch: one ``decode(manifest)`` entry for any registered kind;
+  - apiVersion validation: a manifest claiming the wrong GROUP for its kind
+    is rejected (kind "Deployment" under "batch/v1" is an error, exactly as
+    the reference scheme would fail to find the GVK), while version drift
+    within the right group is tolerated the way the internal types here are
+    version-agnostic (one internal type per kind, like apimachinery's
+    internal versions);
+  - discoverability: ``recognized()`` lists every (apiVersion, kind).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Type
+
+from . import objects as v1
+
+
+class SchemeError(Exception):
+    pass
+
+
+class Scheme:
+    def __init__(self):
+        # kind → (group, canonical version, type)
+        self._kinds: Dict[str, Tuple[str, str, Type]] = {}
+
+    def add_known_type(self, group: str, version: str, typ: Type) -> "Scheme":
+        """AddKnownTypes analog; the type's ``kind`` attribute names it.
+        Duplicate kinds are rejected so a later registration cannot silently
+        shadow an earlier one."""
+        prev = self._kinds.get(typ.kind)
+        if prev is not None and prev[2] is not typ:
+            raise SchemeError(
+                f"kind {typ.kind!r} already registered for group "
+                f"{prev[0]!r} as {prev[2].__name__}"
+            )
+        self._kinds[typ.kind] = (group, version, typ)
+        return self
+
+    def recognized(self) -> List[str]:
+        return sorted(
+            f"{g + '/' if g else ''}{ver}:{kind}"
+            for kind, (g, ver, _t) in self._kinds.items()
+        )
+
+    def decode(self, manifest: dict):
+        """Typed object from a manifest dict, validating kind + apiVersion
+        group.  An absent apiVersion is tolerated (the internal types are
+        version-agnostic); a WRONG group is an error — that manifest would
+        not decode under the reference scheme either."""
+        kind = manifest.get("kind")
+        if not kind:
+            raise SchemeError("manifest has no kind")
+        entry = self._kinds.get(kind)
+        if entry is None:
+            raise SchemeError(
+                f"no kind {kind!r} is registered "
+                f"(known: {', '.join(sorted(self._kinds))})"
+            )
+        group, _version, typ = entry
+        api = manifest.get("apiVersion", "")
+        if api:
+            mgroup = api.split("/", 1)[0] if "/" in api else ""
+            if mgroup != group:
+                want = f"{group + '/' if group else ''}<version>"
+                raise SchemeError(
+                    f"kind {kind} belongs to group {want!r}, "
+                    f"manifest says apiVersion {api!r}"
+                )
+        return typ.from_dict(manifest)
+
+
+def default_scheme() -> Scheme:
+    """All served kinds (the analog of each API group's AddToScheme)."""
+    s = Scheme()
+    for typ in (v1.Pod, v1.Node, v1.Service, v1.PersistentVolume,
+                v1.PersistentVolumeClaim):
+        s.add_known_type("", "v1", typ)
+    s.add_known_type("storage.k8s.io", "v1", v1.StorageClass)
+    s.add_known_type("storage.k8s.io", "v1", v1.CSINode)
+    s.add_known_type("policy", "v1", v1.PodDisruptionBudget)
+    s.add_known_type("scheduling.k8s.io", "v1", v1.PriorityClass)
+    for typ in (v1.ReplicaSet, v1.Deployment, v1.StatefulSet, v1.DaemonSet):
+        s.add_known_type("apps", "v1", typ)
+    s.add_known_type("batch", "v1", v1.Job)
+    from ..controllers.podautoscaler import HorizontalPodAutoscaler
+
+    s.add_known_type("autoscaling", "v2", HorizontalPodAutoscaler)
+    return s

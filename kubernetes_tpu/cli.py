@@ -27,14 +27,17 @@ KIND_ALIASES = {
     "ev": "Event", "events": "Event",
 }
 
-FROM_DICT = {
-    "Pod": v1.Pod, "Node": v1.Node, "ReplicaSet": v1.ReplicaSet,
-    "Deployment": v1.Deployment, "Job": v1.Job, "Service": v1.Service,
-    "PersistentVolume": v1.PersistentVolume,
-    "PersistentVolumeClaim": v1.PersistentVolumeClaim,
-    "StorageClass": v1.StorageClass, "PodDisruptionBudget": v1.PodDisruptionBudget,
-    "PriorityClass": v1.PriorityClass, "CSINode": v1.CSINode,
-}
+from .api.scheme import SchemeError, default_scheme
+
+_scheme_cache = []
+
+
+def _scheme():
+    """Built lazily: default_scheme() pulls in the controllers package (for
+    the HPA type), which apply() needs but get/delete/scale never do."""
+    if not _scheme_cache:
+        _scheme_cache.append(default_scheme())
+    return _scheme_cache[0]
 
 
 class Kubectl:
@@ -113,11 +116,11 @@ class Kubectl:
             if not doc:
                 continue
             kind = doc.get("kind")
-            ctor = FROM_DICT.get(kind)
-            if ctor is None:
-                out.append(f"skipped unknown kind {kind}")
+            try:
+                obj = _scheme().decode(doc)
+            except SchemeError as e:
+                out.append(f"error: {e}")
                 continue
-            obj = ctor.from_dict(doc)
             ns = getattr(obj.metadata, "namespace", "")
             if self.store.get(kind, ns, obj.metadata.name) is not None:
                 self.store.update(kind, obj)

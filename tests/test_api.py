@@ -190,3 +190,38 @@ def test_from_dict_roundtrip():
     assert node.name == "n1"
     assert node.spec.taints[0].effect == "NoSchedule"
     assert node.status.allocatable["cpu"] == "32"
+
+
+def test_scheme_decode_and_validation():
+    """runtime.Scheme analog: GVK dispatch, group validation, discoverability
+    (api/scheme.py)."""
+    import pytest
+
+    from kubernetes_tpu.api.scheme import SchemeError, default_scheme
+
+    s = default_scheme()
+    pod = s.decode({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img"}]},
+    })
+    assert pod.kind == "Pod" and pod.metadata.name == "p"
+    dep = s.decode({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "d"}, "spec": {"replicas": 3},
+    })
+    assert dep.replicas == 3
+    hpa = s.decode({
+        "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "h"},
+        "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "d"},
+                 "maxReplicas": 7},
+    })
+    assert hpa.max_replicas == 7
+    # wrong group for the kind → rejected, like a scheme GVK miss
+    with pytest.raises(SchemeError):
+        s.decode({"apiVersion": "batch/v1", "kind": "Deployment",
+                  "metadata": {"name": "x"}})
+    with pytest.raises(SchemeError):
+        s.decode({"apiVersion": "v1", "kind": "NoSuchKind"})
+    assert "apps/v1:Deployment" in s.recognized()
