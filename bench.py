@@ -76,7 +76,7 @@ def main():
     suite = os.environ.get("BENCH_SUITE", "NorthStar")
     size = os.environ.get("BENCH_SIZE", "5000Nodes/10000Pods")
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
-    sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", "4"))
+    sample = int(os.environ.get("BENCH_ORACLE_SAMPLE", "32"))
 
     w, data, wall = run_named(suite, size, scale)
     att = data["scheduler_scheduling_attempt_duration_seconds"]
@@ -92,6 +92,28 @@ def main():
     o_ms = oracle_per_pod_ms(n_nodes, sample)
     mean_s = att["Average"]
     speedup = (o_ms / 1e3) / mean_s if mean_s > 0 else 0.0
+
+    # Go-envelope baseline (kubernetes_tpu/perf/go_envelope.py): an
+    # idealized vectorized model of the Go default scheduler's work profile
+    # — one pod at a time, adaptive sampling, default plugin math — whose
+    # measured times LOWER-BOUND the Go scheduler's (numpy SIMD ≥ 16
+    # goroutines of per-node calls; all fixed costs omitted).  Two variants:
+    # sampled = Go's actual trade (scores 10% of nodes at 5k);
+    # dense  = what one-at-a-time would cost at THIS repo's optimality
+    # (every node scored for every pod).
+    from kubernetes_tpu.perf.go_envelope import envelope_stats
+
+    env_pods = min(mp, 2000)  # the envelope is steady-state; 2k pods suffice
+    env_sampled = envelope_stats(n_nodes, env_pods)
+    env_dense = envelope_stats(n_nodes, env_pods, sample=False)
+    p99_s = att["ExactPerc99"]
+    vs_env_p99 = (env_sampled["attempt_ms"]["p99"] / 1e3) / p99_s if p99_s else 0.0
+    env_thr = env_sampled["throughput_pods_per_s"]
+    vs_env_thr = thr / env_thr if env_thr else 0.0
+    vs_env_dense_thr = (
+        thr / env_dense["throughput_pods_per_s"]
+        if env_dense["throughput_pods_per_s"] else 0.0
+    )
 
     print(json.dumps({
         "metric": "scheduling_attempt_p99",
@@ -126,9 +148,19 @@ def main():
             "baseline_note": (
                 "vs_baseline = mean per-pod algorithm time of the in-repo "
                 "sequential PYTHON oracle (reference semantics, not the Go "
-                "scheduler) / device-path mean per-attempt"
+                "scheduler) / device-path mean per-attempt; vs_go_envelope_* "
+                "compare against an idealized numpy model of the Go "
+                "scheduler's work profile that LOWER-BOUNDS its times (see "
+                "perf/go_envelope.py) — ratios <1 mean the envelope wins"
             ),
             "oracle_per_pod_ms": round(o_ms, 2),
+            "go_envelope": {
+                "sampled": env_sampled,
+                "dense_all_nodes": env_dense,
+                "vs_go_envelope_p99": round(vs_env_p99, 4),
+                "vs_go_envelope_throughput": round(vs_env_thr, 3),
+                "vs_go_envelope_dense_throughput": round(vs_env_dense_thr, 3),
+            },
             "backend": jax.default_backend(),
         },
     }))

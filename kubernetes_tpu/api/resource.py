@@ -228,18 +228,55 @@ def compute_pod_resource_request(pod) -> Resource:
 
     Cached per pod object: NodeInfo add/remove/clone in preemption dry-runs
     re-derive the same pod's vector hundreds of times per scheduling attempt.
-    Pod specs are treated as immutable after creation (the store replaces
-    whole objects on update), so the cache never goes stale.
+    The cache is keyed on a cheap fingerprint of the resource lists (not
+    object identity alone), so in-place mutation of container resources —
+    testutil builders and direct spec edits — invalidates it instead of
+    silently serving stale vectors.
     """
     cached = getattr(pod, "_cached_resource_request", None)
     if cached is not None:
-        return cached
+        # identity fast path: the request-dict objects themselves unchanged
+        # (the hot case — preemption dry-runs call this hundreds of times per
+        # attempt); fall back to the content fingerprint only on identity
+        # miss, so in-place dict mutation still invalidates
+        if cached[0] == _resource_identity(pod) or cached[1] == _resource_fingerprint(pod):
+            return cached[2]
+    fp = _resource_fingerprint(pod)
     r = _compute_pod_resource_request(pod)
     try:
-        pod._cached_resource_request = r
+        pod._cached_resource_request = (_resource_identity(pod), fp, r)
     except Exception:
         pass
     return r
+
+
+def _resource_identity(pod) -> tuple:
+    """Object identities of everything the request computation reads.  All
+    in-repo mutation paths REPLACE these dicts (testutil ``.req()`` assigns a
+    fresh dict; store updates replace whole objects), so an identity match
+    means unchanged content without paying the per-call fingerprint.  Code
+    that mutates a requests dict's VALUES in place must replace the dict (or
+    delete ``pod._cached_resource_request``) — same contract as the
+    reference's immutable-spec assumption, but enforced at dict granularity.
+    """
+    return (
+        tuple(id(c.resources.requests) for c in pod.spec.containers),
+        tuple(id(c.resources.requests) for c in pod.spec.init_containers),
+        id(pod.spec.overhead),
+    )
+
+
+def _resource_fingerprint(pod) -> tuple:
+    """Cheap content hash of everything _compute_pod_resource_request reads:
+    container/initContainer request lists + overhead.  One pass over small
+    dicts — far cheaper than re-parsing quantity strings."""
+    return (
+        tuple(tuple(sorted((c.resources.requests or {}).items()))
+              for c in pod.spec.containers),
+        tuple(tuple(sorted((c.resources.requests or {}).items()))
+              for c in pod.spec.init_containers),
+        tuple(sorted((pod.spec.overhead or {}).items())),
+    )
 
 
 def _compute_pod_resource_request(pod) -> Resource:
@@ -264,10 +301,13 @@ def compute_pod_resource_request_non_zero(pod) -> Resource:
     """
     cached = getattr(pod, "_cached_resource_request_nz", None)
     if cached is not None:
-        return cached
+        if cached[0] == _resource_identity(pod) or cached[1] == _resource_fingerprint(pod):
+            return cached[2]
     r = _compute_pod_resource_request_non_zero(pod)
     try:
-        pod._cached_resource_request_nz = r
+        pod._cached_resource_request_nz = (
+            _resource_identity(pod), _resource_fingerprint(pod), r
+        )
     except Exception:
         pass
     return r

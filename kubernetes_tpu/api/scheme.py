@@ -41,6 +41,15 @@ class Scheme:
                 f"kind {typ.kind!r} already registered for group "
                 f"{prev[0]!r} as {prev[2].__name__}"
             )
+        if prev is not None and prev[:2] != (group, version):
+            # one GVK per type: re-registering the same type under a different
+            # group/version would silently change which apiVersion decode()
+            # validates against
+            raise SchemeError(
+                f"type {typ.__name__} already registered as "
+                f"({prev[0]!r}, {prev[1]!r}); cannot re-register as "
+                f"({group!r}, {version!r})"
+            )
         self._kinds[typ.kind] = (group, version, typ)
         return self
 

@@ -236,7 +236,12 @@ class Evaluator:
 
         req = compute_pod_resource_request(pod)
         if req.scalar_resources:
-            return [None] * len(infos)  # caller falls back to the serial path
+            # an all-None return would alias "every candidate infeasible";
+            # callers must route scalar-resource preemptors to the serial path
+            raise ValueError(
+                "select_victims_vectorized does not support preemptors with "
+                "scalar (extended) resource requests; use select_victims_on_node"
+            )
 
         def statics_ok(info) -> bool:
             # the serial path's full-oracle initial check re-verifies static

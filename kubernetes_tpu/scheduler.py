@@ -14,7 +14,7 @@ design:
     (synchronous cache write, scheduler.go:571) from the binding cycle (a
     detached goroutine, scheduler.go:623) so store latency never blocks the
     next scheduling cycle.  The device analog: batch N's decisions are
-    fetched asynchronously (copy_to_host_async), its pods are assumed in the
+    fetched after its device window, its pods are assumed in the
     cache, batch N+1 is dispatched against a snapshot containing those
     assumes, and only THEN batch N's reserve/permit/bind host work runs —
     overlapping the device window.  A failed bind forgets the assume and
@@ -99,11 +99,16 @@ class _InFlight:
     dsnap: object
     dyn: object
     auxes: object
-    node_row_dev: object  # device array, copy_to_host_async'd at dispatch
+    node_row_dev: object  # device array, fetched (blocking) at _complete
     algo_lat: object  # np.ndarray once known, or None → filled at fetch
     t0: float
     cycle: int
     node_names: Optional[List[Optional[str]]] = None  # resolved at _complete
+    # row→name map captured at _complete (before the next dispatch's
+    # encoder.sync can reuse rows of deleted nodes); the bind-phase
+    # preemption path resolves candidate-mask rows with THIS map, for the
+    # same reason node_names are resolved early
+    name_of: Optional[Dict[int, str]] = None
     profile: str = DEFAULT_SCHEDULER_NAME
     # the framework the batch was dispatched with: _fws may be rebuilt (domain
     # growth) between dispatch and the deferred bind, so the record owns it
@@ -433,7 +438,8 @@ class TPUScheduler:
         return stats
 
     def _dispatch_batch(self, infos: List[QueuedPodInfo]) -> _InFlight:
-        """Snapshot → compile → ONE device dispatch; decisions fetched async."""
+        """Snapshot → compile → ONE device dispatch; decisions fetched
+        (blocking) at _complete."""
         from .component_base.trace import Trace
 
         t0 = self.clock()
@@ -475,12 +481,6 @@ class TPUScheduler:
             jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
-        # start the device→host copy now; np.asarray at completion time is
-        # then (nearly) free — a BLOCKING fetch on this tunnel costs ~100ms
-        # per sync regardless of payload, so exactly one async fetch per
-        # cycle is the latency floor
-        if hasattr(res.node_row, "copy_to_host_async"):
-            res.node_row.copy_to_host_async()
         trace.step("Device dispatch")
         trace.log_if_long(0.1)
         return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
@@ -490,13 +490,14 @@ class TPUScheduler:
         """Fetch the batch's decisions and assume placements in the cache so
         the NEXT dispatch's snapshot accounts for them (assume :571; the bind
         happens later, exactly like the reference's binding goroutine)."""
-        # Poll readiness instead of a blocking wait: on the tunnel-attached
-        # TPU a blocking sync costs a ~100ms round regardless of payload,
-        # while an already-landed async copy materializes in ~1ms.
+        # Plain blocking wait + fetch: measured on this tunnel (round 4,
+        # tools/bench_cycle.py), block_until_ready + np.asarray lands in
+        # ~1ms, while the round-3 copy_to_host_async + is_ready polling path
+        # cost 100-200ms per cycle — the async-copy scheduling itself stalls
+        # the stream.  (Round 3's measurement of the opposite predates the
+        # current backend.)
         dev = fl.node_row_dev
-        if hasattr(dev, "is_ready"):
-            while not dev.is_ready():
-                time.sleep(0.002)
+        jax.block_until_ready(dev)
         node_row = np.asarray(dev)
         if fl.algo_lat is None:
             algo = self.clock() - fl.t0
@@ -506,6 +507,9 @@ class TPUScheduler:
             m.scheduling_algorithm_duration.observe(algo)
         node_row = np.array(node_row)  # own copy — may be demoted below
         name_of = self.encoder.row_to_name()
+        # the bind phase (which runs AFTER the next dispatch's encoder.sync)
+        # must resolve candidate-mask rows with this pre-sync map too
+        fl.name_of = name_of
         # Resolve rows → names NOW, before the next dispatch's encoder.sync
         # can free/reuse rows of deleted nodes; the bind phase runs after
         # that sync and must not re-resolve (it would bind to the wrong node).
@@ -577,8 +581,14 @@ class TPUScheduler:
                     # fails — its full-pod-tier einsum must not run for
                     # Never-policy batches
                     if pf_ctx is None:
+                        # row→name from _complete (pre-sync): the next batch's
+                        # encoder.sync may have reused a deleted node's row,
+                        # and dispatch-time candidate rows must not resolve
+                        # through the post-sync map
+                        name_of = (fl.name_of if fl.name_of is not None
+                                   else self.encoder.row_to_name())
                         pf_ctx = (self.store.list("PodDisruptionBudget")[0],
-                                  self.encoder.row_to_name())
+                                  name_of)
                     if cand_np is None:
                         cand_np = np.asarray(
                             self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
@@ -875,12 +885,35 @@ class TPUScheduler:
                 failing.add(pw.plugin.name)
         return failing or {p.plugin.name for p in fw.plugins if hasattr(p.plugin, "filter")}
 
-    def run_until_idle(self, max_cycles: int = 1000) -> CycleStats:
+    def run_until_idle(self, max_cycles: int = 1000,
+                       backoff_wait: Optional[float] = None) -> CycleStats:
+        """Drive cycles until nothing is attempted, in flight, OR waiting out
+        backoff.  Pods in the 1s-10s backoff queue are not poppable at the
+        instant a cycle finds the activeQ empty — without the bounded spin
+        below, the scheduler binary would report them unschedulable even
+        though they'd schedule right after their backoff expires."""
+        if backoff_wait is None:
+            # outlast the longest configured per-pod backoff, with headroom
+            backoff_wait = 1.2 * self.queue._max_backoff
         total = CycleStats()
-        for _ in range(max_cycles):
+        waited = 0.0
+        cycles = 0
+        while cycles < max_cycles:
             s = self.schedule_cycle()
             if s.attempted == 0 and s.in_flight == 0:
-                break
+                _a, b, _u = self.queue.pending_count()
+                # only the BACKOFF queue is worth spinning on: its pods become
+                # poppable within pod_max_backoff.  UnschedulableQ pods need a
+                # cluster event or the 60s flush — callers wanting that drive
+                # cycles themselves (the perf harness does).
+                if b == 0 or waited >= backoff_wait:
+                    break
+                time.sleep(0.05)
+                waited += 0.05
+                continue
+            cycles += 1
+            if s.scheduled:
+                waited = 0.0
             total.attempted += s.attempted
             total.scheduled += s.scheduled
             total.unschedulable += s.unschedulable

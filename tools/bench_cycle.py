@@ -1,0 +1,171 @@
+"""Single-job timing of the fused cycle program for a suite's pod shape.
+
+Usage: python tools/bench_cycle.py SUITE N B S [reps]
+  SUITE in {anti, spread, basic}; N nodes; B batch; S pre-scheduled init pods.
+
+Prints dispatch→ready latency (block_until_ready) for the fused program, with
+NO other jobs sharing the TPU (run alone for trustworthy numbers).
+"""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.perf.workloads import (
+    node_unique_hostname, node_zoned, node_default, pod_anti_affinity,
+    pod_topology_spread, pod_default, ZONES3,
+)
+from kubernetes_tpu.framework.runtime import coupling_flags
+
+suite = sys.argv[1]
+N = int(sys.argv[2]); B = int(sys.argv[3]); S = int(sys.argv[4])
+reps = int(sys.argv[5]) if len(sys.argv) > 5 else 5
+
+node_tmpl = {"anti": node_unique_hostname, "spread": node_zoned(ZONES3),
+             "basic": node_default}[suite]
+pod_tmpl = {"anti": pod_anti_affinity("sched-0"), "spread": pod_topology_spread,
+            "basic": pod_default}[suite]
+
+store = ObjectStore()
+sched = TPUScheduler(store, batch_size=B)
+sched.presize(N, S + 4 * B)
+for i in range(N):
+    store.create("Node", node_tmpl(i))
+for i in range(S):
+    p = pod_tmpl(100000 + i)
+    p.spec.node_name = f"node-{i % N:06d}"
+    store.create("Pod", p)
+for i in range(B):
+    store.create("Pod", pod_tmpl(i))
+
+infos = sched.queue.pop_batch(B)
+changed = sched.cache.update_snapshot(sched.snapshot)
+sched.encoder.sync(sched.snapshot, changed)
+batch = sched.compiler.compile([qi.pod for qi in infos], pad_to=B)
+profile = "default-scheduler"
+fw = sched._framework(profile)
+jt = sched._jitted_by[profile]
+host_auxes = fw.host_prepare(batch, sched.snapshot, sched.encoder,
+                             namespace_labels=sched.namespace_labels)
+dsnap, upd = sched.encoder.to_device_deferred()
+nom_rows, nom_req = sched._nominated_arrays(set())
+order = np.arange(batch.size, dtype=np.int32)
+coupling = coupling_flags(batch)
+
+
+def once(which):
+    t0 = time.perf_counter()
+    if which == "greedy":
+        res, *_ = jt["greedy"](batch, dsnap, upd, nom_rows, nom_req,
+                               host_auxes, order, None)
+    else:
+        res, *_ = jt["batch"](batch, dsnap, upd, nom_rows, nom_req,
+                              host_auxes, order, coupling, None)
+    jax.block_until_ready(res.node_row)
+    return time.perf_counter() - t0
+
+
+for which in ("greedy", "batch"):
+    once(which)  # compile
+    xs = [once(which) for _ in range(reps)]
+    print(f"{suite} N={N} B={B} S={S} {which}: "
+          + " ".join(f"{1e3*x:.0f}" for x in xs) + " ms")
+
+import dataclasses
+
+def fresh_inputs():
+    b2 = dataclasses.replace(
+        batch, **{f.name: np.array(getattr(batch, f.name))
+                  for f in dataclasses.fields(batch)
+                  if isinstance(getattr(batch, f.name), np.ndarray)})
+    ha = {k: ({kk: np.array(vv) for kk, vv in v.items()} if isinstance(v, dict)
+              else v) for k, v in host_auxes.items()}
+    return b2, ha
+
+def once_fresh():
+    b2, ha = fresh_inputs()
+    t0 = time.perf_counter()
+    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    jax.block_until_ready(res.node_row)
+    return time.perf_counter() - t0
+
+once_fresh()
+print("greedy fresh-arrays+block:", " ".join(f"{1e3*once_fresh():.0f}" for _ in range(reps)), "ms")
+
+def once_poll():
+    b2, ha = fresh_inputs()
+    t0 = time.perf_counter()
+    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    d = res.node_row
+    if hasattr(d, "copy_to_host_async"):
+        d.copy_to_host_async()
+    while hasattr(d, "is_ready") and not d.is_ready():
+        time.sleep(0.002)
+    np.asarray(d)
+    return time.perf_counter() - t0
+
+once_poll()
+print("greedy fresh+async-poll  :", " ".join(f"{1e3*once_poll():.0f}" for _ in range(reps)), "ms")
+
+arr = np.zeros((128, 8192), np.float32)
+def put_fresh():
+    a = np.array(arr)
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(a))
+    return time.perf_counter() - t0
+put_fresh()
+print("device_put 4MB fresh     :", " ".join(f"{1e3*put_fresh():.0f}" for _ in range(reps)), "ms")
+
+# chained: each dispatch consumes the previous program's committed outputs
+def chained(reps):
+    global dsnap
+    ds = dsnap
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
+            batch, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+        jax.block_until_ready(res.node_row)
+        ts.append(time.perf_counter() - t0)
+        ds = ds_out
+    return ts
+
+chained(2)
+print("greedy chained-dsnap     :", " ".join(f"{1e3*x:.0f}" for x in chained(reps)), "ms")
+
+# chained + fetch node_row to host (np.asarray) like _complete does
+def chained_fetch(reps):
+    ds = dsnap
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
+            batch, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+        jax.block_until_ready(res.node_row)
+        np.asarray(res.node_row)
+        ts.append(time.perf_counter() - t0)
+        ds = ds_out
+    return ts
+
+chained_fetch(2)
+print("greedy chained+asarray   :", " ".join(f"{1e3*x:.0f}" for x in chained_fetch(reps)), "ms")
+
+# chained with k valid pods: separates per-step scan cost from fixed chain cost
+for k in (1, 32, 128):
+    if k > B: continue
+    b2 = dataclasses.replace(batch, valid=np.asarray(np.arange(batch.size) < k, bool))
+    def chained_k(reps, b2=b2):
+        ds = dsnap
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
+                b2, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+            jax.block_until_ready(res.node_row)
+            ts.append(time.perf_counter() - t0)
+            ds = ds_out
+        return ts
+    chained_k(2)
+    print(f"greedy chained k={k:3d}      :", " ".join(f"{1e3*x:.0f}" for x in chained_k(reps)), "ms")

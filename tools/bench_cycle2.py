@@ -1,0 +1,60 @@
+"""Drive real schedule_cycle()s in isolation; print per-cycle phase splits.
+
+Usage: python tools/bench_cycle2.py SUITE N B S PENDING [cycles]
+"""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.perf.workloads import (
+    node_unique_hostname, node_zoned, node_default, pod_anti_affinity,
+    pod_topology_spread, pod_default, ZONES3,
+)
+
+suite = sys.argv[1]
+N = int(sys.argv[2]); B = int(sys.argv[3]); S = int(sys.argv[4])
+PEND = int(sys.argv[5]); CYC = int(sys.argv[6]) if len(sys.argv) > 6 else 12
+
+node_tmpl = {"anti": node_unique_hostname, "spread": node_zoned(ZONES3),
+             "basic": node_default}[suite]
+pod_tmpl = {"anti": pod_anti_affinity("sched-1"), "spread": pod_topology_spread,
+            "basic": pod_default}[suite]
+
+store = ObjectStore()
+sched = TPUScheduler(store, batch_size=B, pipeline=True)
+sched.presize(N, S + PEND + 64)
+for i in range(N):
+    store.create("Node", node_tmpl(i))
+init_tmpl = {"anti": pod_anti_affinity("sched-0"), "spread": pod_default,
+             "basic": pod_default}[suite]
+for i in range(S):
+    p = init_tmpl(100000 + i)
+    p.spec.node_name = f"node-{i % N:06d}"
+    store.create("Pod", p)
+for i in range(PEND):
+    store.create("Pod", pod_tmpl(i))
+
+# instrument _complete's block vs asarray
+orig_complete = TPUScheduler._complete
+SPLITS = []
+
+def patched_complete(self, fl):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fl.node_row_dev)
+    t_block = time.perf_counter() - t0
+    out = orig_complete(self, fl)
+    SPLITS.append((t_block, time.perf_counter() - t0 - t_block))
+    return out
+
+TPUScheduler._complete = patched_complete
+
+print("cycle  total_ms  block_ms  rest_complete_ms  sched")
+for c in range(CYC):
+    t0 = time.perf_counter()
+    stats = sched.schedule_cycle()
+    dt = time.perf_counter() - t0
+    blk, rest = SPLITS[-1] if SPLITS and stats.attempted else (0.0, 0.0)
+    print(f"{c:5d} {1e3*dt:9.1f} {1e3*blk:9.1f} {1e3*rest:17.1f}  {stats.scheduled}")

@@ -1,0 +1,184 @@
+"""Decompose the anti-affinity cycle's device cost at 5k nodes.
+
+Times (post-warmup, blocked):
+  prepare-only program        — plugin prepare planes (IPA matmuls etc.)
+  full fused greedy program   — prepare + 128-step scan
+  batch engine (auction)      — prepare + round-based program
+"""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.perf.workloads import node_unique_hostname, pod_anti_affinity
+from kubernetes_tpu.framework.runtime import initial_dynamic_state, coupling_flags
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+S = int(sys.argv[3]) if len(sys.argv) > 3 else 0  # pre-scheduled anti-affinity pods
+
+store = ObjectStore()
+sched = TPUScheduler(store, batch_size=B)
+sched.presize(N, S + 4 * B)
+for i in range(N):
+    store.create("Node", node_unique_hostname(i))
+tmpl = pod_anti_affinity("sched-0")
+for i in range(S):
+    p = tmpl(100000 + i)
+    p.spec.node_name = f"node-{i % N:06d}"
+    store.create("Pod", p)
+pods = []
+for i in range(B):
+    p = tmpl(i)
+    store.create("Pod", p)
+    pods.append(p)
+
+infos = sched.queue.pop_batch(B)
+assert len(infos) == B
+changed = sched.cache.update_snapshot(sched.snapshot)
+sched.encoder.sync(sched.snapshot, changed)
+batch = sched.compiler.compile([qi.pod for qi in infos], pad_to=B)
+profile = "default-scheduler"
+fw = sched._framework(profile)
+jt = sched._jitted_by[profile]
+host_auxes = fw.host_prepare(batch, sched.snapshot, sched.encoder,
+                             namespace_labels=sched.namespace_labels)
+dsnap, upd = sched.encoder.to_device_deferred()
+nom_rows, nom_req = sched._nominated_arrays(set())
+order = np.arange(batch.size, dtype=np.int32)
+coupling = coupling_flags(batch)
+
+
+def timeit(label, fn, n=3):
+    fn()  # warm (compile)
+    jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:36s} {1e3*dt:9.1f} ms")
+    return dt
+
+
+prep = jax.jit(lambda b, s, d, h: fw.prepare(b, s, initial_dynamic_state(s), h))
+timeit("prepare only", lambda: prep(batch, dsnap, nom_rows * 0, host_auxes) if False else prep(batch, dsnap, None, host_auxes))
+
+timeit("fused greedy (prepare+scan)", lambda: jt["greedy"](
+    batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None))
+
+timeit("fused batch (prepare+auction)", lambda: jt["batch"](
+    batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, None))
+
+# scan with only K valid pods: reveals per-step cost
+for k in (1, 8, 32):
+    import dataclasses
+    b2 = dataclasses.replace(batch, valid=np.asarray(
+        np.arange(batch.size) < k, dtype=bool))
+    timeit(f"fused greedy ({k} valid pods)", lambda b2=b2: jt["greedy"](
+        b2, dsnap, upd, nom_rows, nom_req, host_auxes, order, None))
+
+# fresh-array variant: copies of host_auxes/batch each call (suite conditions —
+# every cycle builds new numpy arrays, defeating jax's transfer cache)
+import copy
+
+def fresh_call():
+    ha = {k: {kk: np.array(vv) for kk, vv in v.items()} if isinstance(v, dict)
+          else v for k, v in host_auxes.items()}
+    return jt["greedy"](batch, dsnap, upd, nom_rows, nom_req, ha, order, None)
+
+timeit("fused greedy (fresh host_auxes)", fresh_call)
+
+import dataclasses
+def fresh_batch_call():
+    b2 = dataclasses.replace(
+        batch, **{f.name: (np.array(getattr(batch, f.name))
+                           if isinstance(getattr(batch, f.name), np.ndarray) else getattr(batch, f.name))
+                  for f in dataclasses.fields(batch)
+                  if isinstance(getattr(batch, f.name), np.ndarray)})
+    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+
+timeit("fused greedy (fresh batch arrays)", fresh_batch_call)
+
+def fresh_both():
+    ha = {k: {kk: np.array(vv) for kk, vv in v.items()} if isinstance(v, dict)
+          else v for k, v in host_auxes.items()}
+    b2 = dataclasses.replace(
+        batch, **{f.name: np.array(getattr(batch, f.name))
+                  for f in dataclasses.fields(batch)
+                  if isinstance(getattr(batch, f.name), np.ndarray)})
+    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+
+timeit("fused greedy (fresh both)", fresh_both)
+
+# _complete-style fetch: dispatch, then poll is_ready + np.asarray
+def fetch_style():
+    res, auxes_o, dsnap_o, dyn_o, diag = jt["greedy"](
+        batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+    if hasattr(res.node_row, "copy_to_host_async"):
+        res.node_row.copy_to_host_async()
+    t0 = time.perf_counter()
+    dev = res.node_row
+    if hasattr(dev, "is_ready"):
+        while not dev.is_ready():
+            time.sleep(0.002)
+    t_ready = time.perf_counter() - t0
+    nr = np.asarray(dev)
+    t_fetch = time.perf_counter() - t0 - t_ready
+    return t_ready, t_fetch
+
+fetch_style()
+rs = [fetch_style() for _ in range(5)]
+print("ready_ms", [round(1e3*a, 1) for a, b in rs])
+print("fetch_ms", [round(1e3*b, 1) for a, b in rs])
+
+# and a full cycle as the scheduler does it (dispatch k, complete k)
+def cycle_like():
+    t0 = time.perf_counter()
+    res, auxes_o, dsnap_o, dyn_o, diag = jt["greedy"](
+        batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+    if hasattr(res.node_row, "copy_to_host_async"):
+        res.node_row.copy_to_host_async()
+    dev = res.node_row
+    while hasattr(dev, "is_ready") and not dev.is_ready():
+        time.sleep(0.002)
+    nr = np.asarray(dev)
+    return time.perf_counter() - t0
+
+cycle_like()
+print("cycle_ms", [round(1e3*cycle_like(), 1) for _ in range(5)])
+
+import jax as _jax
+
+def variant(label, finish):
+    def one():
+        res, *_ = jt["greedy"](
+            batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+        t0 = time.perf_counter()
+        out = finish(res.node_row)
+        return time.perf_counter() - t0
+    one()
+    print(label, [round(1e3*one(), 1) for _ in range(5)])
+
+variant("block_then_asarray", lambda d: np.asarray(_jax.block_until_ready(d)))
+variant("asarray_direct     ", lambda d: np.asarray(d))
+
+def f3(d):
+    d.copy_to_host_async()
+    return np.asarray(d)
+variant("async_then_asarray ", f3)
+
+def f4(d):
+    d.copy_to_host_async()
+    while not d.is_ready():
+        time.sleep(0.002)
+    return np.asarray(d)
+variant("async_poll_asarray ", f4)
+
+def f5(d):
+    while not d.is_ready():
+        time.sleep(0.002)
+    return np.asarray(d)
+variant("poll_no_async      ", f5)

@@ -1,0 +1,57 @@
+"""while_loop vs scan vs fori_loop iteration cost on this backend."""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+K = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+N = 8192
+
+x0 = jnp.zeros((N,), jnp.float32)
+
+
+@jax.jit
+def w_while(x, k):
+    def cond(s):
+        i, _ = s
+        return i < k
+
+    def body(s):
+        i, x = s
+        return i + 1, x + jnp.sum(x) * 1e-9 + 1.0
+
+    return jax.lax.while_loop(cond, body, (jnp.int32(0), x))[1]
+
+
+@jax.jit
+def w_scan(x, k):
+    def step(x, _):
+        return x + jnp.sum(x) * 1e-9 + 1.0, None
+
+    return jax.lax.scan(step, x, None, length=K)[0]
+
+
+@jax.jit
+def w_fori(x, k):
+    def body(i, x):
+        return x + jnp.sum(x) * 1e-9 + 1.0
+
+    return jax.lax.fori_loop(0, k, body, x)
+
+
+def t(label, fn, *args):
+    fn(*args).block_until_ready()
+    # chained: output feeds next input (defeats any result caching)
+    x = x0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        x = fn(x, *args[1:])
+    x.block_until_ready()
+    dt = (time.perf_counter() - t0) / 5
+    print(f"{label:12s} K={K}: {1e3*dt:8.1f} ms  ({1e6*dt/K:6.1f} us/iter)")
+
+
+t("while_loop", w_while, x0, jnp.int32(K))
+t("scan", w_scan, x0, jnp.int32(K))
+t("fori_loop", w_fori, x0, jnp.int32(K))

@@ -1,0 +1,86 @@
+"""Phase-level profiler for a perf suite: times host_prepare / batch compile /
+snapshot sync / device dispatch / complete / bind per cycle.
+
+Usage: python tools/profile_suite.py SUITE SIZE [scale]
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from kubernetes_tpu.perf.workloads import build_workload
+from kubernetes_tpu.perf import harness
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.framework.runtime import BatchedFramework
+
+PHASES = {}
+
+
+def timed(obj, name, label=None):
+    label = label or name
+    orig = getattr(obj, name)
+
+    def wrap(*a, **k):
+        t0 = time.perf_counter()
+        out = orig(*a, **k)
+        PHASES.setdefault(label, []).append(time.perf_counter() - t0)
+        return out
+
+    setattr(obj, name, wrap)
+
+
+def main():
+    import os
+
+    suite, size = sys.argv[1], sys.argv[2]
+    scale = float(sys.argv[3]) if len(sys.argv) > 3 else 1.0
+    w = build_workload(suite, size, scale)
+    if os.environ.get("BATCH"):
+        w.batch_size = int(os.environ["BATCH"])
+
+    # instrument TPUScheduler methods at class level
+    for meth in ["_dispatch_batch", "_complete", "_bind_phase", "_run_assignment"]:
+        timed(TPUScheduler, meth)
+
+    # count host bytes shipped to the fused program per cycle
+    import jax
+
+    orig_run = TPUScheduler._run_assignment
+
+    def run_with_bytes(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
+        tot = 0
+        for leaf in jax.tree_util.tree_leaves((batch, upd, nom_rows, nom_req, host_auxes)):
+            if isinstance(leaf, np.ndarray):
+                tot += leaf.nbytes
+        PHASES.setdefault("upload_MB", []).append(tot / 1e6 / 1e3)  # store as "s"→MB/1000
+        return orig_run(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes)
+
+    TPUScheduler._run_assignment = run_with_bytes
+    timed(BatchedFramework, "host_prepare")
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+    timed(PodBatchCompiler, "compile", "podbatch.compile")
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+    timed(ClusterEncoder, "sync", "encoder.sync")
+    timed(ClusterEncoder, "to_device_deferred")
+    from kubernetes_tpu.state.cache import Cache
+    timed(Cache, "update_snapshot")
+
+    t0 = time.perf_counter()
+    items = harness.run_workload(w)
+    wall = time.perf_counter() - t0
+    for it in items:
+        if it.labels.get("Metric") in ("SchedulingThroughput",
+                                       "scheduler_scheduling_attempt_duration_seconds"):
+            print(it.labels["Metric"], {k: round(v, 3) for k, v in it.data.items()})
+    print(f"wall={wall:.1f}s")
+    print(f"{'phase':28s} {'n':>5s} {'total_s':>9s} {'mean_ms':>9s} {'max_ms':>9s}  last8_ms")
+    for k, v in sorted(PHASES.items(), key=lambda kv: -sum(kv[1])):
+        a = np.array(v)
+        tail = " ".join(f"{1e3*x:.0f}" for x in v[-8:])
+        print(f"{k:28s} {len(v):5d} {a.sum():9.2f} {1e3*a.mean():9.1f} {1e3*a.max():9.1f}  [{tail}]")
+
+
+if __name__ == "__main__":
+    main()
