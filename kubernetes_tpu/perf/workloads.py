@@ -149,7 +149,7 @@ def _basic(n, p, mp) -> Workload:
             Op("createPods", p, pod_template=pod_default),
             Op("createPods", mp, pod_template=pod_default, collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -162,7 +162,7 @@ def _anti_affinity(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_anti_affinity("sched-1"),
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -175,7 +175,7 @@ def _affinity(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_affinity("sched-1"),
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -188,7 +188,7 @@ def _topology(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_topology_spread,
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -201,7 +201,7 @@ def _preemption(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_high_priority,
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -217,7 +217,7 @@ def _unschedulable(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_default,
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
     )
 
 
@@ -259,7 +259,7 @@ def _mixed_churn(n, p, mp) -> Workload:
             Op("createPods", mp, pod_template=pod_default,
                collect_metrics=True),
         ],
-        batch_size=128,
+        batch_size=256,
         churn_between_cycles=churn,
     )
 

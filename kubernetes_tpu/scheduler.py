@@ -90,6 +90,35 @@ class CycleStats:
     in_flight: int = 0  # pods dispatched to device, decision not yet bound
 
 
+def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
+    """True when any pod carries state the deep pipeline's device-resident
+    resource delta cannot chain between batches: pod (anti)affinity and
+    topology-spread read/write aux tables built from the snapshot's
+    scheduled-pod arrays (which lack a still-in-flight batch), host-port
+    sets and volume bindings live in host-side structures updated at
+    assume/bind time.  Resource requests, node selectors/affinity, taints
+    and images chain exactly.  Preemption-CAPABLE pods (priority > 0, policy
+    not Never) also block: the in-flight batch's delta-charged resources are
+    not backed by pod-array entries, so a failing preemptor's dry-run could
+    never evict them — shallow mode makes the previous batch visible as
+    victims first."""
+    from .state.node_info import _pod_host_ports
+
+    for p in pods:
+        if p.spec.topology_spread_constraints:
+            return True
+        aff = p.spec.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            return True
+        if _pod_host_ports(p):
+            return True
+        if getattr(p.spec, "volumes", None):
+            return True
+        if (p.spec.priority or 0) > 0 and p.spec.preemption_policy != "Never":
+            return True
+    return False
+
+
 @dataclass
 class _InFlight:
     """One dispatched batch awaiting fetch/bind (the pipelined binding cycle)."""
@@ -104,11 +133,25 @@ class _InFlight:
     t0: float
     cycle: int
     node_names: Optional[List[Optional[str]]] = None  # resolved at _complete
-    # row→name map captured at _complete (before the next dispatch's
-    # encoder.sync can reuse rows of deleted nodes); the bind-phase
-    # preemption path resolves candidate-mask rows with THIS map, for the
-    # same reason node_names are resolved early
+    # row→name map captured at DISPATCH (later encoder.syncs may reuse rows
+    # of deleted nodes — a deep-pipelined batch completes after the next
+    # dispatch's sync); _complete and the bind-phase preemption path both
+    # resolve rows through THIS map
     name_of: Optional[Dict[int, str]] = None
+    # True when this batch carries constraints the deep pipeline's resource
+    # delta can't chain (affinity/spread tables, host ports, volumes) —
+    # the NEXT batch must then complete this one before dispatching
+    interacts: bool = True
+    # scheduler's node-delete generation at dispatch: a later delete can
+    # free an encoder row the next sync reuses, so deep chaining is only
+    # allowed while the generation is unchanged
+    node_del_gen: int = -1
+    # background fetch of node_row (started at dispatch): the device→host
+    # round trip (~100ms on the tunnel) overlaps the next batch's window
+    # instead of riding _complete's critical path
+    fetch_thread: object = None
+    fetched: object = None  # np.ndarray once the thread lands it
+    fetched_at: float = 0.0  # clock() when the decision became available
     profile: str = DEFAULT_SCHEDULER_NAME
     # the framework the batch was dispatched with: _fws may be rebuilt (domain
     # growth) between dispatch and the deferred bind, so the record owns it
@@ -146,7 +189,8 @@ class TPUScheduler:
         # (scheduler.go:623).  Default off: tests and interactive callers get
         # the synchronous contract (schedule_cycle returns with pods bound).
         self.pipeline = pipeline
-        self._inflight: Optional[_InFlight] = None
+        self._inflight_q: List[_InFlight] = []  # oldest first, depth ≤ 2
+        self._node_del_gen = 0  # bumped on node DELETE (deep-pipeline gate)
         # "scan" = exact greedy-sequential lax.scan; "batch" = round-based
         # parallel prefix commits (framework/runtime.py batch_assign); "auto"
         # uses batch unless the coupled fraction exceeds the threshold
@@ -262,6 +306,10 @@ class TPUScheduler:
                 ClusterEvent(EventResource.NODE, action)
             )
         elif ev.type == DELETED:
+            # deep-pipeline guard: a delete can free an encoder row that the
+            # next sync reuses; an in-flight batch's delta rows would then
+            # charge the wrong node (see schedule_cycle's deep gate)
+            self._node_del_gen += 1
             self.cache.remove_node(node.metadata.name)
             self.queue.move_all_to_active_or_backoff(fwk_events.NODE_DELETE)
 
@@ -357,6 +405,23 @@ class TPUScheduler:
                 requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
             )
 
+        def apply_prev_delta(dyn, d_rows, d_req, d_nz):
+            # Depth-2 pipeline: the still-in-flight previous batch's resource
+            # consumption, applied from ITS device-resident decisions
+            # (d_rows = prev node_row, a future) without any host round trip.
+            # Rows <0 (unscheduled/padding) contribute nothing; a shallow
+            # cycle passes all -1 so the same compiled program serves both.
+            n = dyn.requested.shape[0]
+            rows = jnp.clip(d_rows, 0, n - 1)
+            ok = (d_rows >= 0)[:, None]
+            req = dyn.requested.at[rows].add(
+                jnp.where(ok, d_req, 0).astype(dyn.requested.dtype)
+            )
+            nz = dyn.non_zero.at[rows].add(
+                jnp.where(ok, d_nz, 0).astype(dyn.non_zero.dtype)
+            )
+            return dyn._replace(requested=req, non_zero=nz)
+
         def diagnostics(batch, dsnap, dyn, auxes):
             # FitError diagnosis bits in the SAME program (XLA CSEs the
             # filter planes) — the eager fallback paid a ~100ms pacing round
@@ -368,16 +433,20 @@ class TPUScheduler:
             # lazily in _candidate_mask.
             return fw.diagnose_bits(batch, dsnap, dyn, auxes)
 
-        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, key):
+        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, delta,
+                         host_auxes, order, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+            dyn = apply_prev_delta(dyn, *delta)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
             return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
 
-        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, key):
+        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, delta,
+                        host_auxes, order, coupling, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+            dyn = apply_prev_delta(dyn, *delta)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
             return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
@@ -405,41 +474,86 @@ class TPUScheduler:
     # --- the batched scheduling cycle ----------------------------------------
 
     def schedule_cycle(self) -> CycleStats:
-        """One pipelined step: complete the in-flight batch (fetch + assume),
-        dispatch the next batch against the assumed snapshot, then run the
-        completed batch's binding cycle while the new batch computes on device.
+        """One pipelined step.
+
+        Shallow pipeline (pipeline=True, interacting batches): complete the
+        in-flight batch (fetch + assume), dispatch the next batch against the
+        assumed snapshot, then run the completed batch's binding cycle while
+        the new batch computes on device.
+
+        DEEP pipeline (pipeline=True, constraint-free batches): the next
+        batch dispatches BEFORE the in-flight batch's decisions are fetched —
+        its program consumes the in-flight batch's device-resident node_row
+        as a resource delta (apply_prev_delta), so the ~100-200ms device
+        round-trip of fetch + chained dispatch overlaps the next batch's
+        window entirely.  Depth is capped at 2; eligibility requires that
+        neither batch carries state the delta can't chain (pod (anti)
+        affinity, topology spread, host ports, volumes — those read/write
+        aux tables built from the snapshot's scheduled-pod arrays, which
+        won't contain the in-flight batch until it is completed).
 
         Synchronous mode (pipeline=False) dispatches and completes the same
         batch within the call — identical results, no overlap."""
-        prev = self._inflight
-        self._inflight = None
-        prev_rows = None
-        if prev is not None:
-            prev_rows = self._complete(prev)  # fetch decisions + assume in cache
+        inflight = self._inflight_q
+        stats = CycleStats()
+
+        def merge(s):
+            stats.attempted += s.attempted
+            stats.scheduled += s.scheduled
+            stats.unschedulable += s.unschedulable
+            stats.batch_seconds += s.batch_seconds
 
         infos = self.queue.pop_batch(
             self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
         )
-        nxt = self._dispatch_batch(infos) if infos else None
+        next_interacts = _pods_block_deep([qi.pod for qi in infos]) if infos else True
+        deep = (
+            bool(infos)
+            and self.pipeline
+            and not self.extenders
+            and bool(inflight)
+            and not inflight[-1].interacts
+            and not next_interacts
+            # a node delete since the in-flight dispatch can free an encoder
+            # row that THIS dispatch's sync reuses — the in-flight delta rows
+            # would charge the wrong node; complete it first instead
+            and inflight[-1].node_del_gen == self._node_del_gen
+        )
+        # complete (fetch + assume) everything except — in deep mode — the
+        # newest in-flight batch, whose placements chain on device instead
+        completed: List[Tuple[_InFlight, np.ndarray]] = []
+        keep = 1 if deep else 0
+        while len(inflight) > keep:
+            fl = inflight.pop(0)
+            completed.append((fl, self._complete(fl)))
 
-        if prev is not None:
-            stats = self._bind_phase(prev, prev_rows)  # overlaps nxt's device window
-        else:
-            stats = CycleStats()
+        nxt = None
+        if infos:
+            prev = inflight[-1] if deep else None
+            nxt = self._dispatch_batch(infos, prev=prev,
+                                       interacts=next_interacts)
+
+        for fl, rows in completed:  # binds overlap nxt's device window
+            merge(self._bind_phase(fl, rows))
 
         if nxt is not None:
             if self.pipeline:
-                self._inflight = nxt
-                stats.in_flight = len(nxt.infos)
+                inflight.append(nxt)
             else:
                 rows = self._complete(nxt)
-                stats = self._bind_phase(nxt, rows)
+                merge(self._bind_phase(nxt, rows))
+        stats.in_flight = sum(len(fl.infos) for fl in inflight)
         self._observe_pending()
         return stats
 
-    def _dispatch_batch(self, infos: List[QueuedPodInfo]) -> _InFlight:
+    def _dispatch_batch(self, infos: List[QueuedPodInfo],
+                        prev: Optional[_InFlight] = None,
+                        interacts: Optional[bool] = None) -> _InFlight:
         """Snapshot → compile → ONE device dispatch; decisions fetched
-        (blocking) at _complete."""
+        (blocking) at _complete.  ``prev`` (deep pipeline) is a still-in-
+        flight batch whose device-resident decisions feed this program as a
+        resource delta; ``interacts`` is the caller's already-computed
+        _pods_block_deep result for this batch (recomputed when absent)."""
         from .component_base.trace import Trace
 
         t0 = self.clock()
@@ -473,53 +587,88 @@ class TPUScheduler:
             node_row, algo_lat = self._assign_with_extenders(
                 fw, jt, batch, dsnap, dyn, auxes, pods, t0
             )
-            return _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
-                             t0, cycle, profile=profile, fw=fw)
+            fl = _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
+                           t0, cycle, profile=profile, fw=fw)
+            fl.name_of = dict(self.encoder.row_to_name())
+            return fl
         dsnap, upd = self.encoder.to_device_deferred()
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
+        delta = None
+        if prev is not None:
+            delta = (prev.node_row_dev, prev.batch.request, prev.batch.non_zero)
         res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
-            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes
+            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes, delta=delta
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
         trace.log_if_long(0.1)
-        return _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
-                         None, t0, cycle, profile=profile, fw=fw, diag_dev=diag)
+        fl = _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
+                       None, t0, cycle, profile=profile, fw=fw, diag_dev=diag)
+        # Row→name capture at DISPATCH (not complete): a deep-pipelined
+        # batch is completed only after the NEXT dispatch's encoder.sync,
+        # which may reuse rows of nodes deleted in between — resolving
+        # through the live map then would bind to the wrong node.
+        fl.name_of = dict(self.encoder.row_to_name())
+        fl.interacts = interacts if interacts is not None else _pods_block_deep(pods)
+        fl.node_del_gen = self._node_del_gen
+        # background fetch: the thread blocks in np.asarray until the
+        # program lands, so by _complete time the decisions are host-side
+        # and the cycle pays no fetch round trip
+        import threading
+
+        def _bg_fetch(dev=res.node_row, rec=fl, clk=self.clock):
+            try:
+                rec.fetched = np.asarray(dev)
+            except Exception:
+                rec.fetched = None  # _complete falls back to a sync fetch
+            rec.fetched_at = clk()
+
+        fl.fetch_thread = threading.Thread(target=_bg_fetch, daemon=True)
+        fl.fetch_thread.start()
+        return fl
 
     def _complete(self, fl: _InFlight) -> np.ndarray:
         """Fetch the batch's decisions and assume placements in the cache so
         the NEXT dispatch's snapshot accounts for them (assume :571; the bind
         happens later, exactly like the reference's binding goroutine)."""
-        # Plain blocking wait + fetch: measured on this tunnel (round 4,
-        # tools/bench_cycle.py), block_until_ready + np.asarray lands in
-        # ~1ms, while the round-3 copy_to_host_async + is_ready polling path
-        # cost 100-200ms per cycle — the async-copy scheduling itself stalls
-        # the stream.  (Round 3's measurement of the opposite predates the
-        # current backend.)
-        dev = fl.node_row_dev
-        jax.block_until_ready(dev)
-        node_row = np.asarray(dev)
+        # Join the dispatch-time background fetch (the device→host round
+        # trip overlapped the next batch's window); fall back to a direct
+        # blocking fetch when no thread ran (extender path) or it failed.
+        # (Round 3's copy_to_host_async + is_ready polling measured 100-200ms
+        # SLOWER than a plain blocking fetch on the current backend —
+        # tools/bench_cycle.py — so the fallback is the simple one.)
+        if fl.fetch_thread is not None:
+            fl.fetch_thread.join()
+        if fl.fetched is not None:
+            node_row = fl.fetched
+        else:
+            dev = fl.node_row_dev
+            jax.block_until_ready(dev)
+            node_row = np.asarray(dev)
+            fl.fetched_at = self.clock()
         if fl.algo_lat is None:
-            algo = self.clock() - fl.t0
+            # decision became available when the background fetch landed,
+            # not when the (possibly later) _complete joined it
+            algo = max(fl.fetched_at - fl.t0, 0.0)
             fl.algo_lat = np.full(len(fl.infos), algo)
             # one algorithm invocation for the whole batch → one sample
             # (the extender path samples per-pod cycles itself)
             m.scheduling_algorithm_duration.observe(algo)
         node_row = np.array(node_row)  # own copy — may be demoted below
-        name_of = self.encoder.row_to_name()
-        # the bind phase (which runs AFTER the next dispatch's encoder.sync)
-        # must resolve candidate-mask rows with this pre-sync map too
-        fl.name_of = name_of
-        # Resolve rows → names NOW, before the next dispatch's encoder.sync
-        # can free/reuse rows of deleted nodes; the bind phase runs after
-        # that sync and must not re-resolve (it would bind to the wrong node).
+        # resolve rows through the DISPATCH-time map (see _InFlight.name_of);
+        # a node deleted since dispatch fails the cache liveness check below
+        # and its pod retries, exactly like the reference's binding-error path
+        name_of = fl.name_of if fl.name_of is not None else self.encoder.row_to_name()
         fl.node_names = [None] * len(fl.infos)
         for i, qi in enumerate(fl.infos):
             row = int(node_row[i])
             if row >= 0:
                 name = name_of.get(row)
-                if name is None:  # node deleted since dispatch — retry the pod
-                    node_row[i] = -1
+                info = self.cache._nodes.get(name) if name is not None else None
+                # a deleted node that still hosts pods keeps a ghost cache
+                # entry with .node=None — that's gone too, retry the pod
+                if info is None or info.node is None:
+                    node_row[i] = -1  # node gone since dispatch — retry the pod
                     continue
                 fl.node_names[i] = name
                 self._nominated.pop(qi.pod.uid, None)
@@ -629,17 +778,23 @@ class TPUScheduler:
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
 
-    def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
+    def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req,
+                        host_auxes, delta=None):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
         serializes into one commit per round there, and the row-sliced scan
         is cheaper per step than the dense per-round recompute.
 
+        ``delta`` is the depth-2 pipeline's in-flight-batch resource carry
+        (rows, req, nz) — see apply_prev_delta; None means a no-op delta.
+
         Returns (AssignResult, auxes, updated dsnap, dyn) from ONE fused
         dispatch (snapshot scatter + nominations + prepare + assign)."""
         from .framework.runtime import coupling_flags
 
+        if delta is None:
+            delta = self._noop_delta()
         # numpy, NOT jnp.arange: an eager jnp op is its own device program,
         # and each program execution on the tunnel pays a ~100ms pacing round
         order = np.arange(batch.size, dtype=np.int32)
@@ -650,12 +805,28 @@ class TPUScheduler:
             frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
             if mode == "batch" or frac <= self.coupled_fraction_threshold:
                 return jt["batch"](
-                    batch, dsnap, upd, nom_rows, nom_req, host_auxes,
+                    batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
                     order, coupling, self.rng_key,
                 )
         return jt["greedy"](
-            batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, self.rng_key
+            batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order,
+            self.rng_key,
         )
+
+    def _noop_delta(self):
+        """Fixed-shape no-op delta (all rows -1) so shallow and deep cycles
+        share one compiled program."""
+        b = self.batch_size
+        r = self.encoder.cfg.num_resource_dims
+        cached = getattr(self, "_noop_delta_cache", None)
+        if cached is None or cached[1].shape != (b, r):
+            cached = (
+                np.full(b, -1, dtype=np.int32),
+                np.zeros((b, r), dtype=np.int32),
+                np.zeros((b, 2), dtype=np.int32),
+            )
+            self._noop_delta_cache = cached
+        return cached
 
     def _assign_with_extenders(
         self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float

@@ -1,0 +1,132 @@
+"""Depth-2 pipeline parity: deep-chained dispatch must produce the same
+bindings as the synchronous path (the delta chain reproduces assume exactly
+for resource-only batches), and constraint batches must force shallow mode.
+"""
+
+import numpy as np
+
+from kubernetes_tpu.scheduler import TPUScheduler, _pods_block_deep
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def _nodes(store, n):
+    for i in range(n):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:03d}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+        )
+
+
+def _pods(store, k):
+    for i in range(k):
+        store.create(
+            "Pod",
+            make_pod().name(f"p{i:03d}").uid(f"p{i:03d}").namespace("default")
+            .req({"cpu": str(250 + 50 * (i % 5)) + "m", "memory": "512Mi"})
+            .obj(),
+        )
+
+
+def _bindings(store):
+    pods, _ = store.list("Pod")
+    return {p.metadata.name: p.spec.node_name for p in pods}
+
+
+def _run(pipeline):
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=16, pipeline=pipeline)
+    sched.presize(32, 96)
+    _nodes(store, 24)
+    _pods(store, 80)
+    deep_dispatches = 0
+    orig = TPUScheduler._dispatch_batch
+
+    def counting(self, infos, prev=None, **kw):
+        nonlocal deep_dispatches
+        if prev is not None:
+            deep_dispatches += 1
+        return orig(self, infos, prev=prev, **kw)
+
+    TPUScheduler._dispatch_batch = counting
+    try:
+        sched.run_until_idle()
+    finally:
+        TPUScheduler._dispatch_batch = orig
+    return _bindings(store), deep_dispatches
+
+
+def test_deep_pipeline_matches_sync():
+    sync_bindings, deep_sync = _run(pipeline=False)
+    deep_bindings, deep_count = _run(pipeline=True)
+    assert deep_sync == 0
+    assert deep_count > 0, "deep path never exercised"
+    assert all(v for v in sync_bindings.values())
+    assert deep_bindings == sync_bindings
+
+
+def test_constraint_pods_block_deep():
+    anti = (
+        make_pod().name("a").uid("a").namespace("default")
+        .req({"cpu": "100m"})
+        .label("color", "green")
+        .pod_affinity("kubernetes.io/hostname", {"color": "green"}, anti=True)
+        .obj()
+    )
+    spread = (
+        make_pod().name("s").uid("s").namespace("default")
+        .req({"cpu": "100m"})
+        .topology_spread(1, "zone", labels={"x": "y"})
+        .obj()
+    )
+    ported = (
+        make_pod().name("hp").uid("hp").namespace("default")
+        .req({"cpu": "100m"})
+        .host_port(8080)
+        .obj()
+    )
+    plain = make_pod().name("p").uid("p").namespace("default").req(
+        {"cpu": "100m"}
+    ).obj()
+    assert _pods_block_deep([anti])
+    assert _pods_block_deep([spread])
+    assert _pods_block_deep([ported])
+    assert not _pods_block_deep([plain])
+    assert _pods_block_deep([plain, anti])
+
+
+def test_deep_pipeline_with_constraint_batches_matches_sync():
+    """Interleaved anti-affinity pods force shallow cycles mid-run; results
+    must still equal the synchronous path."""
+
+    def build(pipeline):
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=8, pipeline=pipeline)
+        sched.presize(16, 64)
+        for i in range(12):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .label("kubernetes.io/hostname", f"n{i:03d}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+            )
+        for i in range(24):
+            store.create(
+                "Pod",
+                make_pod().name(f"p{i:03d}").uid(f"p{i:03d}").namespace("default")
+                .req({"cpu": "200m"}).obj(),
+            )
+        for i in range(8):
+            store.create(
+                "Pod",
+                make_pod().name(f"anti{i}").uid(f"anti{i}").namespace("default")
+                .req({"cpu": "100m"}).label("color", "green")
+                .pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                              anti=True)
+                .obj(),
+            )
+        sched.run_until_idle()
+        return _bindings(store)
+
+    assert build(True) == build(False)

@@ -92,6 +92,33 @@ def test_sharded_greedy_assign_runs():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_auction_matches_unsharded():
+    """batch_assign (the round-based auction) under the mesh: its while-loop
+    argmax/min reductions over the sharded node axis are the program most
+    likely to hide a sharded-reduction bug (VERDICT r3 weak #4)."""
+    from kubernetes_tpu.framework.runtime import coupling_flags
+
+    rng = np.random.default_rng(14)
+    fw, batch, snap, enc, dsnap, dyn, host_auxes = _pipeline_with_auxes(rng, 16, 8)
+    auxes = jax.jit(fw.prepare)(batch, dsnap, dyn, host_auxes)
+    coupling = coupling_flags(batch)
+    order = jnp.arange(batch.size)
+    res0 = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling)
+
+    mesh = node_sharded_mesh(jax.devices()[:8])
+    sh_snap = shard_snapshot(dsnap, mesh)
+    sh_dyn = shard_dynamic_state(dyn, mesh)
+    sh_aux = shard_host_auxes(host_auxes, mesh, dsnap.num_nodes)
+    with mesh:
+        auxes_sh = jax.jit(fw.prepare)(batch, sh_snap, sh_dyn, sh_aux)
+        res1 = jax.jit(fw.batch_assign)(
+            batch, sh_snap, sh_dyn, auxes_sh, order, coupling
+        )
+    assert np.array_equal(np.asarray(res0.node_row), np.asarray(res1.node_row))
+    assert int((np.asarray(res0.node_row) >= 0).sum()) >= 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_assignment_parity_at_5k_nodes():
     """5000-node smoke over the 8-device mesh: full greedy assignment, real
     aux planes, sharded == unsharded bindings.  A scale where a sharded

@@ -53,16 +53,17 @@ dsnap, upd = sched.encoder.to_device_deferred()
 nom_rows, nom_req = sched._nominated_arrays(set())
 order = np.arange(batch.size, dtype=np.int32)
 coupling = coupling_flags(batch)
+delta = sched._noop_delta()
 
 
 def once(which):
     t0 = time.perf_counter()
     if which == "greedy":
         res, *_ = jt["greedy"](batch, dsnap, upd, nom_rows, nom_req,
-                               host_auxes, order, None)
+                               delta, host_auxes, order, None)
     else:
         res, *_ = jt["batch"](batch, dsnap, upd, nom_rows, nom_req,
-                              host_auxes, order, coupling, None)
+                              delta, host_auxes, order, coupling, None)
     jax.block_until_ready(res.node_row)
     return time.perf_counter() - t0
 
@@ -87,7 +88,7 @@ def fresh_inputs():
 def once_fresh():
     b2, ha = fresh_inputs()
     t0 = time.perf_counter()
-    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, delta, ha, order, None)
     jax.block_until_ready(res.node_row)
     return time.perf_counter() - t0
 
@@ -97,7 +98,7 @@ print("greedy fresh-arrays+block:", " ".join(f"{1e3*once_fresh():.0f}" for _ in 
 def once_poll():
     b2, ha = fresh_inputs()
     t0 = time.perf_counter()
-    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    res, *_ = jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, delta, ha, order, None)
     d = res.node_row
     if hasattr(d, "copy_to_host_async"):
         d.copy_to_host_async()
@@ -126,7 +127,7 @@ def chained(reps):
     for _ in range(reps):
         t0 = time.perf_counter()
         res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
-            batch, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+            batch, ds, upd, nom_rows, nom_req, delta, host_auxes, order, None)
         jax.block_until_ready(res.node_row)
         ts.append(time.perf_counter() - t0)
         ds = ds_out
@@ -142,7 +143,7 @@ def chained_fetch(reps):
     for _ in range(reps):
         t0 = time.perf_counter()
         res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
-            batch, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+            batch, ds, upd, nom_rows, nom_req, delta, host_auxes, order, None)
         jax.block_until_ready(res.node_row)
         np.asarray(res.node_row)
         ts.append(time.perf_counter() - t0)
@@ -162,7 +163,7 @@ for k in (1, 32, 128):
         for _ in range(reps):
             t0 = time.perf_counter()
             res, auxes_o, ds_out, dyn_o, diag = jt["greedy"](
-                b2, ds, upd, nom_rows, nom_req, host_auxes, order, None)
+                b2, ds, upd, nom_rows, nom_req, delta, host_auxes, order, None)
             jax.block_until_ready(res.node_row)
             ts.append(time.perf_counter() - t0)
             ds = ds_out

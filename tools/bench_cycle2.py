@@ -58,3 +58,4 @@ for c in range(CYC):
     dt = time.perf_counter() - t0
     blk, rest = SPLITS[-1] if SPLITS and stats.attempted else (0.0, 0.0)
     print(f"{c:5d} {1e3*dt:9.1f} {1e3*blk:9.1f} {1e3*rest:17.1f}  {stats.scheduled}")
+

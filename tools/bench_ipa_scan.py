@@ -49,6 +49,7 @@ dsnap, upd = sched.encoder.to_device_deferred()
 nom_rows, nom_req = sched._nominated_arrays(set())
 order = np.arange(batch.size, dtype=np.int32)
 coupling = coupling_flags(batch)
+delta = sched._noop_delta()
 
 
 def timeit(label, fn, n=3):
@@ -67,10 +68,10 @@ prep = jax.jit(lambda b, s, d, h: fw.prepare(b, s, initial_dynamic_state(s), h))
 timeit("prepare only", lambda: prep(batch, dsnap, nom_rows * 0, host_auxes) if False else prep(batch, dsnap, None, host_auxes))
 
 timeit("fused greedy (prepare+scan)", lambda: jt["greedy"](
-    batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None))
+    batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None))
 
 timeit("fused batch (prepare+auction)", lambda: jt["batch"](
-    batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, coupling, None))
+    batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, coupling, None))
 
 # scan with only K valid pods: reveals per-step cost
 for k in (1, 8, 32):
@@ -78,7 +79,7 @@ for k in (1, 8, 32):
     b2 = dataclasses.replace(batch, valid=np.asarray(
         np.arange(batch.size) < k, dtype=bool))
     timeit(f"fused greedy ({k} valid pods)", lambda b2=b2: jt["greedy"](
-        b2, dsnap, upd, nom_rows, nom_req, host_auxes, order, None))
+        b2, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None))
 
 # fresh-array variant: copies of host_auxes/batch each call (suite conditions —
 # every cycle builds new numpy arrays, defeating jax's transfer cache)
@@ -87,7 +88,7 @@ import copy
 def fresh_call():
     ha = {k: {kk: np.array(vv) for kk, vv in v.items()} if isinstance(v, dict)
           else v for k, v in host_auxes.items()}
-    return jt["greedy"](batch, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    return jt["greedy"](batch, dsnap, upd, nom_rows, nom_req, delta, ha, order, None)
 
 timeit("fused greedy (fresh host_auxes)", fresh_call)
 
@@ -98,7 +99,7 @@ def fresh_batch_call():
                            if isinstance(getattr(batch, f.name), np.ndarray) else getattr(batch, f.name))
                   for f in dataclasses.fields(batch)
                   if isinstance(getattr(batch, f.name), np.ndarray)})
-    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None)
 
 timeit("fused greedy (fresh batch arrays)", fresh_batch_call)
 
@@ -109,14 +110,14 @@ def fresh_both():
         batch, **{f.name: np.array(getattr(batch, f.name))
                   for f in dataclasses.fields(batch)
                   if isinstance(getattr(batch, f.name), np.ndarray)})
-    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, ha, order, None)
+    return jt["greedy"](b2, dsnap, upd, nom_rows, nom_req, delta, ha, order, None)
 
 timeit("fused greedy (fresh both)", fresh_both)
 
 # _complete-style fetch: dispatch, then poll is_ready + np.asarray
 def fetch_style():
     res, auxes_o, dsnap_o, dyn_o, diag = jt["greedy"](
-        batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+        batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None)
     if hasattr(res.node_row, "copy_to_host_async"):
         res.node_row.copy_to_host_async()
     t0 = time.perf_counter()
@@ -138,7 +139,7 @@ print("fetch_ms", [round(1e3*b, 1) for a, b in rs])
 def cycle_like():
     t0 = time.perf_counter()
     res, auxes_o, dsnap_o, dyn_o, diag = jt["greedy"](
-        batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+        batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None)
     if hasattr(res.node_row, "copy_to_host_async"):
         res.node_row.copy_to_host_async()
     dev = res.node_row
@@ -155,7 +156,7 @@ import jax as _jax
 def variant(label, finish):
     def one():
         res, *_ = jt["greedy"](
-            batch, dsnap, upd, nom_rows, nom_req, host_auxes, order, None)
+            batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order, None)
         t0 = time.perf_counter()
         out = finish(res.node_row)
         return time.perf_counter() - t0

@@ -49,13 +49,15 @@ def main():
 
     orig_run = TPUScheduler._run_assignment
 
-    def run_with_bytes(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes):
+    def run_with_bytes(self, jt, batch, dsnap, upd, nom_rows, nom_req,
+                       host_auxes, **kw):
         tot = 0
         for leaf in jax.tree_util.tree_leaves((batch, upd, nom_rows, nom_req, host_auxes)):
             if isinstance(leaf, np.ndarray):
                 tot += leaf.nbytes
         PHASES.setdefault("upload_MB", []).append(tot / 1e6 / 1e3)  # store as "s"→MB/1000
-        return orig_run(self, jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes)
+        return orig_run(self, jt, batch, dsnap, upd, nom_rows, nom_req,
+                        host_auxes, **kw)
 
     TPUScheduler._run_assignment = run_with_bytes
     timed(BatchedFramework, "host_prepare")
