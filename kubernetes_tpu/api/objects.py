@@ -737,15 +737,31 @@ class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
     provisioner: str = ""
+    # storagev1 AllowedTopologies ([]TopologySelectorTerm): terms OR, a
+    # term's matchLabelExpressions AND — exactly NodeSelector semantics with
+    # In operators, so it is modeled as one (used by topology-aware dynamic
+    # provisioning, volumebinding/binder.go checkVolumeProvisions)
+    allowed_topologies: Optional[NodeSelector] = None
 
     kind = "StorageClass"
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "StorageClass":
+        terms = []
+        for t in d.get("allowedTopologies") or []:
+            reqs = [
+                NodeSelectorRequirement(
+                    key=e.get("key", ""), operator=OP_IN,
+                    values=[str(v) for v in e.get("values") or []],
+                )
+                for e in t.get("matchLabelExpressions") or []
+            ]
+            terms.append(NodeSelectorTerm(match_expressions=reqs))
         return cls(
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             volume_binding_mode=d.get("volumeBindingMode", VOLUME_BINDING_IMMEDIATE),
             provisioner=d.get("provisioner", ""),
+            allowed_topologies=NodeSelector(node_selector_terms=terms) if terms else None,
         )
 
 

@@ -195,6 +195,25 @@ class HTTPExtender:
 
 
 def _pod_to_dict(pod: v1.Pod) -> dict:
+    """Serialized form cached per pod object: one scheduling round calls
+    filter AND prioritize for the same pod (2 serializations), and a pod
+    deferred across rounds repeats both.  The cache key is
+    (resourceVersion, nodeName): the sim store bumps resourceVersion on
+    every update, so in-place mutations that went through the store
+    invalidate; nodeName covers the bind subresource path."""
+    key = (pod.metadata.resource_version, pod.spec.node_name)
+    cached = getattr(pod, "_extender_dict", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    d = _pod_to_dict_uncached(pod)
+    try:
+        pod._extender_dict = (key, d)
+    except Exception:
+        pass
+    return d
+
+
+def _pod_to_dict_uncached(pod: v1.Pod) -> dict:
     return {
         "metadata": {
             "name": pod.metadata.name,

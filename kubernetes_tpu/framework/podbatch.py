@@ -82,6 +82,7 @@ class PodBatch:
     node_name_id: np.ndarray  # i32[B] (MISSING when spec.nodeName unset)
     nominated_row: np.ndarray  # i32[B] node row from status.nominatedNodeName (-1 none)
     ports: np.ndarray  # i32[B, PP]
+    ports_ip: np.ndarray  # i32[B, PP] (hostIP dictionary id; ID_WILDCARD_IP = any)
     image_ids: np.ndarray  # i32[B, CI] (container images, for ImageLocality)
     # tolerations
     tol_valid: np.ndarray  # bool[B, TT]
@@ -223,11 +224,12 @@ class PodBatchCompiler:
         label_vals = np.full((b, pl_cap), MISSING, dtype=np.int32)
 
         port_lists = [sorted(
-            {_PROTO_CODE.get(proto, 0) * 65536 + port
-             for (_ip, proto, port) in _pod_host_ports(p)}
+            {(_PROTO_CODE.get(proto, 0) * 65536 + port, dic.intern(ip))
+             for (ip, proto, port) in _pod_host_ports(p)}
         ) for p in pods]
         pp_cap = self._cap("pp", max((len(pl) for pl in port_lists), default=0), 2)
         ports = np.full((b, pp_cap), MISSING, dtype=np.int32)
+        ports_ip = np.full((b, pp_cap), MISSING, dtype=np.int32)
 
         ci_cap = self._cap("ci", max((len(p.spec.containers) for p in pods), default=0), 2)
         image_ids = np.full((b, ci_cap), MISSING, dtype=np.int32)
@@ -259,7 +261,9 @@ class PodBatchCompiler:
             for j, (k, val) in enumerate(pod.metadata.labels.items()):
                 label_keys[i, j] = dic.intern(k)
                 label_vals[i, j] = dic.intern(val)
-            ports[i, : len(port_lists[i])] = port_lists[i]
+            for j, (code, ip_id) in enumerate(port_lists[i]):
+                ports[i, j] = code
+                ports_ip[i, j] = ip_id
             for j, c in enumerate(pod.spec.containers):
                 if c.image:
                     image_ids[i, j] = dic.intern(c.image)
@@ -379,7 +383,7 @@ class PodBatchCompiler:
             valid=valid, request=request, non_zero=non_zero, ns=ns,
             label_keys=label_keys, label_vals=label_vals, priority=priority,
             node_name_id=node_name_id, nominated_row=nominated_row,
-            ports=ports, image_ids=image_ids,
+            ports=ports, ports_ip=ports_ip, image_ids=image_ids,
             tol_valid=tol_valid, tol_key=tol_key, tol_val=tol_val,
             tol_op=tol_op, tol_effect=tol_effect,
             node_selector=compiled_ns, node_affinity=compiled_na,

@@ -607,6 +607,49 @@ class BatchedFramework:
         dyn, _, assigned, _, _, feas_n, _ = jax.lax.while_loop(cond, body, init)
         return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn)
 
+    def apply_commits(self, batch, snap, dyn, auxes, commit, choice):
+        """Apply a set of simultaneous placements (commit bool[B], choice
+        i32[B]) to the dynamic state and every dynamic plugin's aux — the
+        standalone jittable form of batch_assign's per-round state update,
+        used by the round-based extender path.  Returns (dyn, auxes) with
+        non-dynamic auxes unchanged."""
+        n_cap = snap.node_valid.shape[0]
+        u = (
+            (choice[:, None] == jnp.arange(n_cap)[None, :]) & commit[:, None]
+        ).astype(jnp.float32)  # [B, N]
+        req_add = jnp.einsum("bn,br->nr", u, batch.request.astype(jnp.float32))
+        nz_add = jnp.einsum("bn,br->nr", u, batch.non_zero.astype(jnp.float32))
+        new_dyn = DynamicState(
+            requested=dyn.requested + req_add.astype(dyn.requested.dtype),
+            non_zero=dyn.non_zero + nz_add.astype(dyn.non_zero.dtype),
+        )
+        b = batch.valid.shape[0]
+        new_auxes = list(auxes)
+        slow = []
+        for k, (pw, aux) in enumerate(zip(self.plugins, auxes)):
+            if not pw.plugin.dynamic or aux is None:
+                continue
+            bfn = getattr(pw.plugin, "update_batch", None)
+            if bfn is not None:
+                new_auxes[k] = bfn(aux, commit, choice, u, batch, snap)
+            elif hasattr(pw.plugin, "update"):
+                slow.append(k)
+        auxes = tuple(new_auxes)
+        if slow:
+            def upd(i, auxes):
+                def app(auxes):
+                    out = list(auxes)
+                    for k in slow:
+                        out[k] = self.plugins[k].plugin.update(
+                            auxes[k], i, choice[i], batch, snap
+                        )
+                    return tuple(out)
+
+                return jax.lax.cond(commit[i], app, lambda a: a, auxes)
+
+            auxes = jax.lax.fori_loop(0, b, upd, auxes)
+        return new_dyn, auxes
+
     def greedy_assign_dense(self, batch, snap, dyn, auxes, order, key=None) -> AssignResult:
         """Reference implementation: full [B, N] recompute per step (used by the
         fast-path parity test)."""

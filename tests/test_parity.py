@@ -182,3 +182,71 @@ def test_taint_score_prefer_no_schedule():
     # both feasible; clean strictly preferred
     assert np.asarray(mask)[0, r["clean"]] and np.asarray(mask)[0, r["tainted"]]
     assert np.asarray(scores)[0, r["clean"]] > np.asarray(scores)[0, r["tainted"]]
+
+
+def test_nodeports_hostip_exact_parity():
+    """Exact HostPortInfo wildcard semantics on device (VERDICT r3 item 6):
+    pods differing only by concrete hostIP coexist on a node; 0.0.0.0
+    conflicts with every IP on the same (proto, port).  Device mask ==
+    oracle feasibility over a mixed-hostIP cluster."""
+    cache = Cache()
+    for i in range(4):
+        cache.add_node(
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj()
+        )
+    # n00: pod bound to 10.0.0.1:8080; n01: wildcard :8080; n02: :9090 UDP
+    cache.add_pod(
+        make_pod().name("s0").uid("s0").namespace("default")
+        .req({"cpu": "1"}).host_port(8080, host_ip="10.0.0.1").node("n00").obj()
+    )
+    cache.add_pod(
+        make_pod().name("s1").uid("s1").namespace("default")
+        .req({"cpu": "1"}).host_port(8080).node("n01").obj()  # 0.0.0.0
+    )
+    cache.add_pod(
+        make_pod().name("s2").uid("s2").namespace("default")
+        .req({"cpu": "1"}).host_port(9090, protocol="UDP").node("n02").obj()
+    )
+    pods = [
+        # same port, DIFFERENT concrete IP → only n01 (wildcard) blocked
+        make_pod().name("p0").uid("p0").namespace("default")
+        .req({"cpu": "1"}).host_port(8080, host_ip="10.0.0.2").obj(),
+        # same port, SAME concrete IP → n00 and n01 blocked
+        make_pod().name("p1").uid("p1").namespace("default")
+        .req({"cpu": "1"}).host_port(8080, host_ip="10.0.0.1").obj(),
+        # wildcard → n00 and n01 blocked
+        make_pod().name("p2").uid("p2").namespace("default")
+        .req({"cpu": "1"}).host_port(8080).obj(),
+        # UDP 9090 wildcard → n02 blocked only
+        make_pod().name("p3").uid("p3").namespace("default")
+        .req({"cpu": "1"}).host_port(9090, protocol="UDP").obj(),
+        # TCP 9090 (protocol differs) → nothing blocked
+        make_pod().name("p4").uid("p4").namespace("default")
+        .req({"cpu": "1"}).host_port(9090).obj(),
+    ]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    mask, _ = fw.jit_compute(batch, dsnap, dyn, auxes)
+    mask = np.asarray(mask)
+    row_of = dict(enc.node_rows)
+
+    oracle = okl.Oracle()
+    infos = snap.node_info_list
+    expected_blocked = {
+        "p0": {"n01"},
+        "p1": {"n00", "n01"},
+        "p2": {"n00", "n01"},
+        "p3": {"n02"},
+        "p4": set(),
+    }
+    for i, pod in enumerate(pods):
+        dev_names = {name for name, r in row_of.items() if mask[i, r]}
+        feas_names = {ni.node_name for ni in oracle.feasible_nodes(pod, infos)}
+        assert dev_names == feas_names, (
+            f"{pod.metadata.name}: device-only={dev_names - feas_names} "
+            f"oracle-only={feas_names - dev_names}"
+        )
+        blocked = {f"n{j:02d}" for j in range(4)} - dev_names
+        assert blocked == expected_blocked[pod.metadata.name], (
+            f"{pod.metadata.name}: blocked={blocked}"
+        )

@@ -29,7 +29,7 @@ def test_workload_basic_with_metrics():
     assert steady.data["TotalCount"] >= steady.data["Count"] >= 0
     assert by_metric["XLACompilesInWindow"].data["Count"] >= 0
     doc = json.loads(data_items_to_json(items))
-    assert doc["version"] == "v1" and len(doc["dataItems"]) == 4
+    assert doc["version"] == "v1" and len(doc["dataItems"]) == 5
 
 
 def test_workload_churn():

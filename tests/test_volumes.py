@@ -127,3 +127,171 @@ def test_node_volume_limits():
     store.create("Pod", p)
     sched.run_until_idle()
     assert store.get("Pod", "default", "p").spec.node_name == "free"
+
+
+def mk_sc(name, mode=None, provisioner="", zones=None):
+    sc = v1.StorageClass(
+        volume_binding_mode=mode or v1.VOLUME_BINDING_WAIT,
+        provisioner=provisioner,
+    )
+    sc.metadata.name = name
+    if zones:
+        sc.allowed_topologies = v1.NodeSelector(node_selector_terms=[
+            v1.NodeSelectorTerm(match_expressions=[
+                v1.NodeSelectorRequirement(
+                    key="topology.kubernetes.io/zone", operator=v1.OP_IN,
+                    values=list(zones),
+                )
+            ])
+        ])
+    return sc
+
+
+def test_smallest_fitting_pv_chosen():
+    """Capacity-aware matching (volume.FindMatchingVolume): the SMALLEST PV
+    that fits is bound, leaving larger volumes for larger claims."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("StorageClass", mk_sc("local"))
+    store.create("Node", make_node().name("n0").obj())
+    for name, size in [("pv-big", "100Gi"), ("pv-small", "10Gi"), ("pv-mid", "50Gi")]:
+        store.create("PersistentVolume", mk_pv(name, storage=size, sc="local"))
+    store.create("PersistentVolumeClaim", mk_pvc("c0", sc="local", storage="5Gi"))
+    store.create(
+        "Pod",
+        make_pod().name("p").uid("p").namespace("default")
+        .req({"cpu": "1"}).pvc("c0").obj(),
+    )
+    sched.run_until_idle()
+    pvc = store.get("PersistentVolumeClaim", "default", "c0")
+    assert pvc.volume_name == "pv-small"
+
+
+def test_provisioning_respects_allowed_topologies():
+    """Topology-aware dynamic provisioning: only nodes inside the class's
+    AllowedTopologies may host the pod, and the provisioned PV is pinned to
+    the selected node's topology segment (binder.go checkVolumeProvisions)."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4)
+    store.create("StorageClass",
+                 mk_sc("zonal", provisioner="ebs.csi", zones=["z1"]))
+    store.create("Node", make_node().name("n0")
+                 .label("topology.kubernetes.io/zone", "z0").obj())
+    store.create("Node", make_node().name("n1")
+                 .label("topology.kubernetes.io/zone", "z1").obj())
+    store.create("PersistentVolumeClaim", mk_pvc("c0", sc="zonal"))
+    store.create(
+        "Pod",
+        make_pod().name("p").uid("p").namespace("default")
+        .req({"cpu": "1"}).pvc("c0").obj(),
+    )
+    sched.run_until_idle()
+    pod = store.get("Pod", "default", "p")
+    assert pod.spec.node_name == "n1"
+    pvc = store.get("PersistentVolumeClaim", "default", "c0")
+    pv = store.get("PersistentVolume", "", pvc.volume_name)
+    assert pv.node_affinity is not None
+    from kubernetes_tpu.api.labels import match_node_selector
+
+    assert match_node_selector(pv.node_affinity, store.get("Node", "", "n1"))
+    assert not match_node_selector(pv.node_affinity, store.get("Node", "", "n0"))
+
+
+def test_multi_pvc_partial_bind_rollback():
+    """Reserve failure on the SECOND claim unassumes the first claim's PV
+    (AssumePodVolumes rollback), so another pod can still take it."""
+    from kubernetes_tpu.plugins.volumes import StoreVolumeListers, VolumeBindingPlugin
+
+    store = ObjectStore()
+    listers = StoreVolumeListers(store)
+    plug = VolumeBindingPlugin(listers)
+    store.create("StorageClass", mk_sc("local"))
+    store.create("Node", make_node().name("n0").obj())
+    store.create("PersistentVolume", mk_pv("pv0", storage="10Gi", sc="local"))
+    store.create("PersistentVolumeClaim", mk_pvc("c0", sc="local", storage="5Gi"))
+    # c1 wants more than any PV offers → reserve must fail after assuming pv0
+    store.create("PersistentVolumeClaim", mk_pvc("c1", sc="local", storage="500Gi"))
+    pod = (make_pod().name("p").uid("p").namespace("default")
+           .req({"cpu": "1"}).pvc("c0").pvc("c1").obj())
+    status = plug.reserve(None, pod, "n0")
+    assert status is not None and not status.is_success()
+    plug.unreserve(None, pod, "n0")
+    assert plug._assumed_pv == {}
+    assert plug._decisions == {}
+
+
+def test_volume_binding_parity_randomized():
+    """Device-path VolumeBinding masks == oracle.volume_binding_feasible over
+    randomized volume clusters (bound PVs, WFC static PVs, provisioned
+    classes with topologies, immediate classes)."""
+    import numpy as np
+
+    from kubernetes_tpu.oracle import volume_binding_feasible
+    from kubernetes_tpu.plugins.volumes import StoreVolumeListers, VolumeBindingPlugin
+    from kubernetes_tpu.state.cache import Cache, Snapshot
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+
+    rng = np.random.default_rng(21)
+    for trial in range(4):
+        store = ObjectStore()
+        listers = StoreVolumeListers(store)
+        zones = ["z0", "z1", "z2"]
+        cache = Cache()
+        nodes = []
+        for i in range(8):
+            nd = (make_node().name(f"n{i}")
+                  .label("topology.kubernetes.io/zone", zones[i % 3])
+                  .label("kubernetes.io/hostname", f"n{i}")
+                  .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj())
+            nodes.append(nd)
+            store.create("Node", nd)
+            cache.add_node(nd)
+        store.create("StorageClass", mk_sc("wfc"))
+        store.create("StorageClass",
+                     mk_sc("prov", provisioner="x.csi",
+                           zones=[zones[int(rng.integers(3))]]))
+        store.create("StorageClass", mk_sc("imm", mode=v1.VOLUME_BINDING_IMMEDIATE))
+        for j in range(6):
+            pin = [f"n{int(rng.integers(8))}"] if rng.random() < 0.7 else None
+            store.create("PersistentVolume", mk_pv(
+                f"pv{j}", storage=f"{int(rng.choice([5, 20, 80]))}Gi",
+                sc="wfc", node_values=pin,
+            ))
+        pods = []
+        for k in range(8):
+            w = (make_pod().name(f"p{k}").uid(f"p{k}-{trial}")
+                 .namespace("default").req({"cpu": "1"}))
+            kind = k % 4
+            if kind == 0:  # static WFC claim
+                store.create("PersistentVolumeClaim", mk_pvc(
+                    f"c{k}", sc="wfc",
+                    storage=f"{int(rng.choice([1, 10, 50]))}Gi"))
+                w = w.pvc(f"c{k}")
+            elif kind == 1:  # provisioned, topology-limited
+                store.create("PersistentVolumeClaim", mk_pvc(f"c{k}", sc="prov"))
+                w = w.pvc(f"c{k}")
+            elif kind == 2:  # immediate-mode unbound → unschedulable
+                store.create("PersistentVolumeClaim", mk_pvc(f"c{k}", sc="imm"))
+                w = w.pvc(f"c{k}")
+            # kind 3: no volumes
+            pods.append(w.obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        enc = ClusterEncoder()
+        comp = PodBatchCompiler(enc)
+        batch = comp.compile(pods)
+        enc.full_sync(snap)
+        plug = VolumeBindingPlugin(listers)
+        host_aux = plug.host_prepare(batch, snap, enc)
+        mask = (np.ones((batch.size, enc._n), bool) if host_aux is None
+                else host_aux["mask"])
+        rows = enc.node_rows
+        for i, pod in enumerate(pods):
+            for nd in nodes:
+                want = volume_binding_feasible(pod, nd, listers)
+                got = bool(mask[i, rows[nd.metadata.name]])
+                assert got == want, (
+                    f"trial {trial} pod p{i} node {nd.metadata.name}: "
+                    f"device={got} oracle={want}"
+                )
