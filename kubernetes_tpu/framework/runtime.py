@@ -32,6 +32,20 @@ class AssignResult(NamedTuple):
     dyn: DynamicState  # final dynamic state after all assignments
 
 
+class PrevBatch(NamedTuple):
+    """Deep-pipeline carry: the still-in-flight previous batch's identity +
+    device-resident decisions, consumed by the next batch's fused program
+    (apply_prev_delta for resources, plugin chain_prev hooks for tables)."""
+
+    rows: jnp.ndarray  # i32[B0] node row per prev pod (-1 = none; device)
+    req: jnp.ndarray  # i32[B0, R]
+    nz: jnp.ndarray  # i32[B0, 2]
+    valid: jnp.ndarray  # bool[B0]
+    label_keys: jnp.ndarray  # i32[B0, PL]
+    label_vals: jnp.ndarray  # i32[B0, PL]
+    ns: jnp.ndarray  # i32[B0]
+
+
 class CouplingFlags(NamedTuple):
     """Host-computed batch coupling for the parallel assignment engine.
 
@@ -111,6 +125,20 @@ class BatchedFramework:
             else:
                 auxes.append(fn(batch, snap, dyn, host_auxes.get(pw.plugin.name)))
         return tuple(auxes)
+
+    def chain_prev(self, batch, snap, auxes, prev: "PrevBatch"):
+        """Fold a still-in-flight previous batch's placements into this
+        batch's plugin aux tables (deep pipeline): dispatch to each plugin's
+        ``chain_prev`` hook.  A no-op bundle (all rows -1) leaves every table
+        unchanged, so shallow and deep cycles share one compiled program."""
+        out = []
+        for pw, aux in zip(self.plugins, auxes):
+            fn = getattr(pw.plugin, "chain_prev", None)
+            if fn is None or aux is None:
+                out.append(aux)
+            else:
+                out.append(fn(aux, batch, snap, prev))
+        return tuple(out)
 
     # --- filter + score ------------------------------------------------------
 

@@ -300,6 +300,41 @@ class PodTopologySpreadPlugin(Plugin):
         soft_counts = point_scatter_add(aux.soft_counts, dom_at, inc_soft)
         return aux._replace(hard_counts=hard_counts, soft_counts=soft_counts)
 
+    def chain_prev(self, aux: TSAux, batch, snap, prev):
+        """Deep-pipeline cross-BATCH chaining: fold the still-in-flight
+        previous batch's placements (device-resident ``prev.rows``) into this
+        batch's count tables, exactly as if those pods were already in the
+        snapshot.  The cross-match (this batch's constraint selectors vs the
+        previous batch's pod labels, same namespace) is computed from the
+        prev batch's label arrays inside the program, so no host round trip
+        touches the chain."""
+        if aux is None:
+            return None
+        d = self.domain_cap
+        n = snap.num_nodes
+        placed = (prev.rows >= 0) & jnp.asarray(prev.valid)  # [B0]
+        rows = jnp.clip(prev.rows, 0, n - 1)
+        # selector (b, c) vs prev batch's pods → [B1, C, B0] — the same
+        # helper prepare() uses against snapshot/pending pods
+        m = self._selector_vs_pods(
+            batch, prev.label_keys, prev.label_vals, prev.ns, snap.numeric
+        )
+        m = m & placed[None, None, :]
+        # counted-node gates + domain of each prev pod's node under (b, c)
+        counted_h = aux.counted_hard[:, rows]  # [B1, B0]
+        counted_s = aux.counted_soft[:, rows]
+        dom_at = aux.dom_val[:, :, rows]  # [B1, C, B0]
+        inc_h = domain_scatter_add(
+            (m & counted_h[:, None, :]).astype(jnp.float32), dom_at, d + 1
+        )
+        inc_s = domain_scatter_add(
+            (m & counted_s[:, None, :]).astype(jnp.float32), dom_at, d + 1
+        )
+        return aux._replace(
+            hard_counts=aux.hard_counts + inc_h.astype(jnp.int32),
+            soft_counts=aux.soft_counts + inc_s.astype(jnp.int32),
+        )
+
     def update_batch(self, aux: TSAux, commit, choice, u, batch, snap):
         """All of a round's placements at once (batch_assign):
         contributions are commutative scatter-adds, so the per-pod update

@@ -91,12 +91,12 @@ class CycleStats:
 
 
 def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
-    """True when any pod carries state the deep pipeline's device-resident
-    resource delta cannot chain between batches: pod (anti)affinity and
-    topology-spread read/write aux tables built from the snapshot's
+    """True when any pod carries state the deep pipeline cannot chain
+    between batches: pod (anti)affinity tables built from the snapshot's
     scheduled-pod arrays (which lack a still-in-flight batch), host-port
     sets and volume bindings live in host-side structures updated at
-    assume/bind time.  Resource requests, node selectors/affinity, taints
+    assume/bind time.  Topology-spread tables ARE chained (chain_prev), so
+    spread pods stay deep.  Resource requests, node selectors/affinity, taints
     and images chain exactly.  Preemption-CAPABLE pods (priority > 0, policy
     not Never) also block: the in-flight batch's delta-charged resources are
     not backed by pod-array entries, so a failing preemptor's dry-run could
@@ -105,8 +105,9 @@ def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     from .state.node_info import _pod_host_ports
 
     for p in pods:
-        if p.spec.topology_spread_constraints:
-            return True
+        # topology-spread constraints are CHAINABLE: the fused program folds
+        # the in-flight batch's placements into this batch's count tables
+        # (PodTopologySpreadPlugin.chain_prev), so spread pods deep-pipeline
         aff = p.spec.affinity
         if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
             return True
@@ -138,9 +139,10 @@ class _InFlight:
     # dispatch's sync); _complete and the bind-phase preemption path both
     # resolve rows through THIS map
     name_of: Optional[Dict[int, str]] = None
-    # True when this batch carries constraints the deep pipeline's resource
-    # delta can't chain (affinity/spread tables, host ports, volumes) —
-    # the NEXT batch must then complete this one before dispatching
+    # True when this batch carries constraints the deep pipeline can't
+    # chain (pod (anti)affinity tables, host ports, volumes, preemption
+    # capability — spread tables DO chain via chain_prev) — the NEXT batch
+    # must then complete this one before dispatching
     interacts: bool = True
     # scheduler's node-delete generation at dispatch: a later delete can
     # free an encoder row the next sync reuses, so deep chaining is only
@@ -406,20 +408,21 @@ class TPUScheduler:
                 requested=dyn.requested.at[rows].add(add.astype(dyn.requested.dtype))
             )
 
-        def apply_prev_delta(dyn, d_rows, d_req, d_nz):
+        def apply_prev_delta(dyn, prev):
             # Depth-2 pipeline: the still-in-flight previous batch's resource
             # consumption, applied from ITS device-resident decisions
-            # (d_rows = prev node_row, a future) without any host round trip.
-            # Rows <0 (unscheduled/padding) contribute nothing; a shallow
-            # cycle passes all -1 so the same compiled program serves both.
+            # (prev.rows = prev node_row, a future) without any host round
+            # trip.  Rows <0 (unscheduled/padding) contribute nothing; a
+            # shallow cycle passes all -1 so the same compiled program serves
+            # both.
             n = dyn.requested.shape[0]
-            rows = jnp.clip(d_rows, 0, n - 1)
-            ok = (d_rows >= 0)[:, None]
+            rows = jnp.clip(prev.rows, 0, n - 1)
+            ok = (prev.rows >= 0)[:, None]
             req = dyn.requested.at[rows].add(
-                jnp.where(ok, d_req, 0).astype(dyn.requested.dtype)
+                jnp.where(ok, prev.req, 0).astype(dyn.requested.dtype)
             )
             nz = dyn.non_zero.at[rows].add(
-                jnp.where(ok, d_nz, 0).astype(dyn.non_zero.dtype)
+                jnp.where(ok, prev.nz, 0).astype(dyn.non_zero.dtype)
             )
             return dyn._replace(requested=req, non_zero=nz)
 
@@ -434,21 +437,23 @@ class TPUScheduler:
             # lazily in _candidate_mask.
             return fw.diagnose_bits(batch, dsnap, dyn, auxes)
 
-        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, delta,
+        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, prev,
                          host_auxes, order, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-            dyn = apply_prev_delta(dyn, *delta)
+            dyn = apply_prev_delta(dyn, prev)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+            auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
             return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
 
-        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, delta,
+        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prev,
                         host_auxes, order, coupling, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-            dyn = apply_prev_delta(dyn, *delta)
+            dyn = apply_prev_delta(dyn, prev)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+            auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
             return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
 
@@ -493,10 +498,10 @@ class TPUScheduler:
         as a resource delta (apply_prev_delta), so the ~100-200ms device
         round-trip of fetch + chained dispatch overlaps the next batch's
         window entirely.  Depth is capped at 2; eligibility requires that
-        neither batch carries state the delta can't chain (pod (anti)
-        affinity, topology spread, host ports, volumes — those read/write
-        aux tables built from the snapshot's scheduled-pod arrays, which
-        won't contain the in-flight batch until it is completed).
+        neither batch carries state the chain can't carry (pod (anti)
+        affinity, host ports, volumes, preemption capability — see
+        _pods_block_deep; topology-spread tables ARE chained via the
+        plugins' chain_prev hooks, and resources via apply_prev_delta).
 
         Synchronous mode (pipeline=False) dispatches and completes the same
         batch within the call — identical results, no overlap."""
@@ -601,7 +606,14 @@ class TPUScheduler:
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
         delta = None
         if prev is not None:
-            delta = (prev.node_row_dev, prev.batch.request, prev.batch.non_zero)
+            from .framework.runtime import PrevBatch
+
+            pb = prev.batch
+            delta = PrevBatch(
+                rows=prev.node_row_dev, req=pb.request, nz=pb.non_zero,
+                valid=pb.valid, label_keys=pb.label_keys,
+                label_vals=pb.label_vals, ns=pb.ns,
+            )
         res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
             jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes, delta=delta
         )
@@ -623,7 +635,16 @@ class TPUScheduler:
         import threading
 
         def _bg_fetch(dev=res.node_row, diag_dev=diag, rec=fl, clk=self.clock):
+            # Poll-with-sleep instead of a blocking fetch: a blocking
+            # jax fetch holds the GIL for its whole wait, which STALLS the
+            # main thread's host pipeline (profiled: trivial dictionary
+            # interns averaging 1.7ms under contention).  time.sleep
+            # releases the GIL; np.asarray on an already-ready array is
+            # ~0.1ms, so the thread's GIL footprint stays negligible.
             try:
+                if hasattr(dev, "is_ready"):
+                    while not dev.is_ready():
+                        time.sleep(0.004)
                 rec.fetched = np.asarray(dev)
             except Exception:
                 rec.fetched = None  # _complete falls back to a sync fetch
@@ -821,7 +842,7 @@ class TPUScheduler:
         from .framework.runtime import coupling_flags
 
         if delta is None:
-            delta = self._noop_delta()
+            delta = self._noop_delta(batch)
         # numpy, NOT jnp.arange: an eager jnp op is its own device program,
         # and each program execution on the tunnel pays a ~100ms pacing round
         order = np.arange(batch.size, dtype=np.int32)
@@ -840,20 +861,27 @@ class TPUScheduler:
             self.rng_key,
         )
 
-    def _noop_delta(self):
-        """Fixed-shape no-op delta (all rows -1) so shallow and deep cycles
-        share one compiled program."""
-        b = self.batch_size
-        r = self.encoder.cfg.num_resource_dims
-        cached = getattr(self, "_noop_delta_cache", None)
-        if cached is None or cached[1].shape != (b, r):
-            cached = (
-                np.full(b, -1, dtype=np.int32),
-                np.zeros((b, r), dtype=np.int32),
-                np.zeros((b, 2), dtype=np.int32),
-            )
-            self._noop_delta_cache = cached
-        return cached
+    def _noop_delta(self, like_batch):
+        """No-op PrevBatch (all rows -1) with the SAME array shapes as a
+        real one built from ``like_batch``, so shallow and deep cycles share
+        one compiled program per batch shape."""
+        from .framework.runtime import PrevBatch
+
+        key = (like_batch.request.shape, like_batch.label_keys.shape)
+        cached = getattr(self, "_noop_prev_cache", None)
+        if cached is None or cached[0] != key:
+            b = like_batch.valid.shape[0]
+            cached = (key, PrevBatch(
+                rows=np.full(b, -1, dtype=np.int32),
+                req=np.zeros_like(like_batch.request),
+                nz=np.zeros_like(like_batch.non_zero),
+                valid=np.zeros(b, dtype=bool),
+                label_keys=np.full_like(like_batch.label_keys, -1),
+                label_vals=np.full_like(like_batch.label_vals, -1),
+                ns=np.full(b, -1, dtype=np.int32),
+            ))
+            self._noop_prev_cache = cached
+        return cached[1]
 
     def _assign_with_extenders(
         self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float

@@ -90,7 +90,8 @@ def test_constraint_pods_block_deep():
         {"cpu": "100m"}
     ).obj()
     assert _pods_block_deep([anti])
-    assert _pods_block_deep([spread])
+    # spread pods are CHAINABLE (PodTopologySpreadPlugin.chain_prev)
+    assert not _pods_block_deep([spread])
     assert _pods_block_deep([ported])
     assert not _pods_block_deep([plain])
     assert _pods_block_deep([plain, anti])
@@ -130,3 +131,36 @@ def test_deep_pipeline_with_constraint_batches_matches_sync():
         return _bindings(store)
 
     assert build(True) == build(False)
+
+
+def test_deep_pipeline_spread_batches_match_sync():
+    """Topology-spread batches deep-chain via chain_prev; bindings must equal
+    the synchronous path exactly (the chained count tables reproduce the
+    snapshot-fed tables the shallow path would have built)."""
+
+    def build(pipeline):
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=8, pipeline=pipeline)
+        sched.presize(16, 80)
+        for i in range(12):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .label("zone", f"z{i % 3}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+            )
+        for i in range(40):
+            store.create(
+                "Pod",
+                make_pod().name(f"sp{i:03d}").uid(f"sp{i:03d}").namespace("default")
+                .req({"cpu": "100m"}).label("grp", "a")
+                .topology_spread(2, "zone", labels={"grp": "a"})
+                .obj(),
+            )
+        sched.run_until_idle()
+        return _bindings(store)
+
+    deep = build(True)
+    sync = build(False)
+    assert deep == sync
+    assert all(v for v in deep.values())

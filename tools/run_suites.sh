@@ -1,0 +1,43 @@
+#!/bin/bash
+# Final artifact pass: every 5k suite + extender + density, one JSON line each.
+# Writes suites_5k.out (the judge artifact) and density.json.  A failing or
+# timed-out suite writes an explicit error marker line instead of silently
+# vanishing, and the script exits non-zero if anything failed.
+cd "$(dirname "$0")/.."
+set -u
+OUT=suites_5k.out
+FAILED=0
+: > "$OUT"
+run() {
+  local suite="$1" size="$2" line
+  echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
+  line=$(BENCH_SUITE="$suite" BENCH_SIZE="$size" BENCH_ORACLE_SAMPLE=4 \
+    timeout 3000 python bench.py 2>> suites_run.log | tail -1)
+  if [ -z "$line" ] || ! python -c "import json,sys; json.loads(sys.argv[1])" "$line" 2>/dev/null; then
+    echo "{\"error\": \"suite $suite/$size failed or timed out\"}" >> "$OUT"
+    echo "FAILED: $suite/$size" >> suites_run.log
+    FAILED=1
+  else
+    echo "$line" >> "$OUT"
+  fi
+}
+run SchedulingBasic 5000Nodes
+run SchedulingPodAntiAffinity 5000Nodes
+run SchedulingPodAffinity 5000Nodes
+run TopologySpreading 5000Nodes
+run Unschedulable 5000Nodes/200InitPods
+run SchedulingWithMixedChurn 5000Nodes
+run PreemptionBasic 5000Nodes
+run SchedulingExtender 500Nodes
+# no-extender comparison point at the same shape
+run SchedulingBasic 500Nodes
+dline=$(BENCH_SUITE=Density BENCH_SIZE=1000Nodes/30000Pods BENCH_ORACLE_SAMPLE=4 \
+  timeout 3000 python bench.py 2>> suites_run.log | tail -1)
+if [ -n "$dline" ] && python -c "import json,sys; json.loads(sys.argv[1])" "$dline" 2>/dev/null; then
+  echo "$dline" > density.json
+else
+  echo "FAILED: Density" >> suites_run.log
+  FAILED=1
+fi
+echo "ALL DONE (failed=$FAILED) $(date +%H:%M:%S)" >> suites_run.log
+exit $FAILED
