@@ -39,6 +39,28 @@ class Candidate:
     num_pdb_violations: int
 
 
+@dataclass
+class PlainTables:
+    """Per-snapshot victim tables for PLAIN preemptors at one priority
+    threshold — the preemptor-independent 80% of select_victims_vectorized
+    (potential-victim enumeration, ordering, resource vectors), built ONCE
+    per (snapshot generation, priority, PDB state) and shared by every
+    preemptor in a burst.  At 5k nodes the per-preemptor rebuild was ~35ms
+    × a 256-pod batch ≈ 9s/cycle — the dominant PreemptionBasic cost."""
+
+    names: List[str]
+    index: Dict[str, int]
+    infos: List[NodeInfo]
+    victims: List[List[v1.Pod]]       # violating-first, importance-descending
+    base: np.ndarray                   # [C,4] used minus all potential victims
+    alloc: np.ndarray                  # [C,4]
+    vr_mat: np.ndarray                 # [C,Vmax,4]
+    v_valid: np.ndarray                # [C,Vmax] bool
+    v_viol: np.ndarray                 # [C,Vmax] bool  (PDB-violating victim)
+    v_prio: np.ndarray                 # [C,Vmax] int64
+    v_ts: np.ndarray                   # [C,Vmax] float64 creation timestamps
+
+
 def candidate_mask_device(batch, snap, dyn, static_ok_mask):
     """bool[B, N]: pod b would resource-fit on node n with every lower-priority
     pod evicted; static (unresolvable) filters must already pass.
@@ -107,6 +129,243 @@ class Evaluator:
         # find them all claimed by earlier nominations, return no candidate,
         # and burn a full retry cycle
         self._offset = 0
+        # (snapshot id, snapshot generation, priority, pdb fingerprint) →
+        # PlainTables; one entry per threshold survives a whole batch
+        self._tables: Dict[tuple, PlainTables] = {}
+        # (priority, pdb fingerprint) → node name → cached per-node row,
+        # keyed by NodeInfo.generation: across cycles only nodes whose pods
+        # changed (evictions, binds) rebuild their victim row — the full
+        # rebuild was ~0.9s/cycle at 5k nodes / 25k pods
+        self._rows: Dict[tuple, Dict[str, tuple]] = {}
+
+    def plain_tables(
+        self,
+        snapshot: Snapshot,
+        priority: int,
+        pdbs: Sequence[v1.PodDisruptionBudget] = (),
+    ) -> PlainTables:
+        """Build (or fetch) the preemptor-independent victim tables for every
+        node holding at least one pod below ``priority``.  Static node
+        predicates are NOT applied here — they depend on the preemptor and
+        are verified on the ranked winner only (see preempt_plain)."""
+        pdb_fp = tuple(
+            (p.metadata.namespace, p.metadata.name, p.disruptions_allowed)
+            for p in pdbs
+        )
+        key = (id(snapshot), snapshot.generation, priority, pdb_fp)
+        hit = self._tables.get(key)
+        if hit is not None:
+            return hit
+        # evict only STALE generations: a batch mixing preemptor priorities
+        # keeps one live entry per threshold (a full clear would rebuild the
+        # tables once per pod, not once per threshold)
+        for k in [k for k in self._tables if k[:2] != key[:2]]:
+            del self._tables[k]
+        from .api.resource import compute_pod_resource_request
+
+        if len(self._rows) > 8:  # many distinct thresholds: drop stale keys
+            self._rows.clear()
+        rows = self._rows.setdefault((priority, pdb_fp), {})
+
+        names: List[str] = []
+        infos: List[NodeInfo] = []
+        victim_lists: List[List[v1.Pod]] = []
+        row_data: List[tuple] = []
+        seen = set()
+        for info in snapshot.node_info_list:
+            name = info.node_name
+            seen.add(name)
+            cached = rows.get(name)
+            if cached is not None and cached[0] == info.generation:
+                if cached[1] is None:  # no potential victims on this node
+                    continue
+                _, victims, vr, viol, prio, ts, base_u, alloc_u = cached
+            else:
+                potential = [
+                    pi.pod for pi in info.pods
+                    if pi.pod.spec.priority < priority
+                ]
+                if not potential:
+                    rows[name] = (info.generation, None)
+                    continue
+                used = info.requested
+                u = np.array(
+                    [used.milli_cpu, used.memory, used.ephemeral_storage,
+                     len(info.pods)], dtype=np.int64,
+                )
+                potential.sort(
+                    key=lambda p: (-p.spec.priority,
+                                   p.metadata.creation_timestamp or 0)
+                )
+                violating, non_violating = pods_with_pdb_violation(
+                    potential, pdbs)
+                victims = violating + non_violating
+                nv = len(victims)
+                vr = np.zeros((nv, 4), dtype=np.int64)
+                prio = np.zeros(nv, dtype=np.int64)
+                ts = np.zeros(nv, dtype=np.float64)
+                for vi, victim in enumerate(victims):
+                    r = compute_pod_resource_request(victim)
+                    vr[vi] = (r.milli_cpu, r.memory, r.ephemeral_storage, 1)
+                    prio[vi] = victim.spec.priority or 0
+                    ts[vi] = victim.metadata.creation_timestamp or 0
+                viol = np.zeros(nv, dtype=bool)
+                viol[:len(violating)] = True
+                base_u = u - vr.sum(axis=0)
+                al = info.allocatable
+                alloc_u = np.array(
+                    [al.milli_cpu, al.memory, al.ephemeral_storage,
+                     al.allowed_pod_number], dtype=np.int64,
+                )
+                rows[name] = (info.generation, victims, vr, viol, prio, ts,
+                              base_u, alloc_u)
+            names.append(name)
+            infos.append(info)
+            victim_lists.append(victims)
+            row_data.append((vr, viol, prio, ts, base_u, alloc_u))
+        if len(rows) > len(seen):  # nodes deleted since last cycle
+            for name in list(rows):
+                if name not in seen:
+                    del rows[name]
+
+        c = len(names)
+        vmax = max((r[0].shape[0] for r in row_data), default=0)
+        vr_mat = np.zeros((c, vmax, 4), dtype=np.int64)
+        v_valid = np.zeros((c, vmax), dtype=bool)
+        v_viol = np.zeros((c, vmax), dtype=bool)
+        v_prio = np.zeros((c, vmax), dtype=np.int64)
+        v_ts = np.zeros((c, vmax), dtype=np.float64)
+        base = np.zeros((c, 4), dtype=np.int64)
+        alloc = np.zeros((c, 4), dtype=np.int64)
+        for ci, (vr, viol, prio, ts, base_u, alloc_u) in enumerate(row_data):
+            nv = vr.shape[0]
+            vr_mat[ci, :nv] = vr
+            v_valid[ci, :nv] = True
+            v_viol[ci, :nv] = viol
+            v_prio[ci, :nv] = prio
+            v_ts[ci, :nv] = ts
+            base[ci] = base_u
+            alloc[ci] = alloc_u
+        tables = PlainTables(
+            names=names, index={n: i for i, n in enumerate(names)},
+            infos=infos, victims=victim_lists,
+            base=base, alloc=alloc,
+            vr_mat=vr_mat, v_valid=v_valid, v_viol=v_viol,
+            v_prio=v_prio, v_ts=v_ts,
+        )
+        self._tables[key] = tables
+        return tables
+
+    def preempt_plain(
+        self,
+        pod: v1.Pod,
+        tables: PlainTables,
+        candidate_names: Sequence[str],
+        nominated: Optional[Dict[str, List[v1.Pod]]] = None,
+    ) -> Optional[Candidate]:
+        """Fast preempt() body for plain preemptors: numpy reprieve sweep +
+        vectorized 6-criteria ranking over the shared tables, materializing
+        ONLY the winner's victim list.  Static node predicates are verified
+        on the ranked winner (walking down on the rare failure) — the exact
+        outcome the serial path reaches by pre-filtering every candidate."""
+        from .api.resource import compute_pod_resource_request
+        from .oracle import (
+            node_affinity_fits,
+            node_name_fits,
+            node_schedulable,
+            tolerates_all_hard_taints,
+        )
+
+        req = compute_pod_resource_request(pod)
+        if req.scalar_resources:
+            raise ValueError(
+                "preempt_plain does not support preemptors with scalar "
+                "(extended) resource requests; use select_victims_on_node"
+            )
+        rows = np.array(
+            [tables.index[n] for n in candidate_names if n in tables.index],
+            dtype=np.int64,
+        )
+        if rows.size == 0:
+            return None
+        req_v = np.array(
+            [req.milli_cpu, req.memory, req.ephemeral_storage, 1],
+            dtype=np.int64,
+        )
+        base = tables.base[rows].copy()
+        # fold nominated reservations (equal-or-higher-priority nominees on a
+        # candidate add their request before the fit check, matching
+        # select_victims_on_node's AddNominatedPods analog)
+        if nominated:
+            my_prio = pod.spec.priority or 0
+            for ri, row in enumerate(rows):
+                noms = nominated.get(tables.names[row])
+                if not noms:
+                    continue
+                for nom in noms:
+                    if nom.uid != pod.uid and (nom.spec.priority or 0) >= my_prio:
+                        nr = compute_pod_resource_request(nom)
+                        base[ri] += (nr.milli_cpu, nr.memory,
+                                     nr.ephemeral_storage, 1)
+        alloc = tables.alloc[rows]
+        vr = tables.vr_mat[rows]
+        v_valid = tables.v_valid[rows]
+
+        def fits(u):
+            free = alloc - u
+            return np.all((req_v == 0) | (req_v <= free), axis=1)
+
+        feasible = fits(base)
+        if not feasible.any():
+            return None
+        used = base.copy()
+        reprieved = np.zeros_like(v_valid)
+        for vi in range(v_valid.shape[1]):
+            trial = used + vr[:, vi]
+            ok = fits(trial) & v_valid[:, vi] & feasible
+            used = np.where(ok[:, None], trial, used)
+            reprieved[:, vi] = ok
+        victim_mask = v_valid & ~reprieved
+        count = victim_mask.sum(axis=1)
+        valid = feasible & (count > 0)
+        if not valid.any():
+            return None
+        v_prio = tables.v_prio[rows]
+        v_ts = tables.v_ts[rows]
+        big = np.int64(1) << 60
+        nviol = (victim_mask & tables.v_viol[rows]).sum(axis=1)
+        top_prio = np.where(victim_mask, v_prio, -big).max(axis=1)
+        sum_key = np.where(victim_mask, v_prio + (1 << 31), 0).sum(axis=1)
+        is_top = victim_mask & (v_prio == top_prio[:, None])
+        earliest = np.where(is_top, v_ts, np.inf).min(axis=1)
+        # pickOneNodeForPreemption's lexicographic chain; invalid rows rank
+        # last, full ties resolve to the first candidate in window order
+        # (np.lexsort is stable; last key is most significant)
+        order = np.lexsort((
+            -earliest, count, sum_key, top_prio,
+            nviol, np.where(valid, 0, 1),
+        ))
+        for oi in order:
+            if not valid[oi]:
+                return None
+            row = int(rows[oi])
+            info = tables.infos[row]
+            node = info.node
+            if (node is None or not node_name_fits(pod, node)
+                    or not node_schedulable(pod, node)
+                    or not node_affinity_fits(pod, node)
+                    or not tolerates_all_hard_taints(pod, node)):
+                continue  # statics fail: winner drops, next-ranked wins
+            victims = [
+                p for vi, p in enumerate(tables.victims[row])
+                if victim_mask[oi, vi]
+            ]
+            victims.sort(
+                key=lambda p: (-p.spec.priority,
+                               p.metadata.creation_timestamp or 0)
+            )
+            return Candidate(info.node_name, victims, int(nviol[oi]))
+        return None
 
     def select_victims_on_node(
         self,
@@ -400,20 +659,31 @@ class Evaluator:
         cap = max_candidates or max(100, n // 10)
         node_infos = snapshot.node_info_list
         has_anti = bool(snapshot.have_pods_with_required_anti_affinity_list)
-        by_name = {ni.node_name: ni for ni in node_infos}
         candidates: List[Candidate] = []
         pool = list(candidate_nodes)
         if len(pool) > cap:
             start = self._offset % len(pool)
             self._offset += cap
             pool = pool[start:] + pool[:start]
-        cand_infos = [by_name[name] for name in pool[:cap] if name in by_name]
+        pool = pool[:cap]
         from .api.resource import compute_pod_resource_request
 
         vectorizable = (
             _is_plain_preemptor(pod, has_anti)
             and not compute_pod_resource_request(pod).scalar_resources
         )
+        wants_all_candidates = any(
+            getattr(e, "supports_preemption", False) and e.is_interested(pod)
+            for e in extenders
+        )
+        if vectorizable and not wants_all_candidates:
+            # shared-tables fast path: ranking needs only the winner, so the
+            # per-candidate Candidate materialization (and the per-preemptor
+            # table rebuild) is skipped entirely
+            tables = self.plain_tables(snapshot, pod.spec.priority or 0, pdbs)
+            return self.preempt_plain(pod, tables, pool, nominated=nominated)
+        by_name = snapshot.node_info_map
+        cand_infos = [by_name[name] for name in pool if name in by_name]
         if vectorizable:
             results = self.select_victims_vectorized(
                 pod, cand_infos, pdbs, nominated=nominated

@@ -260,6 +260,13 @@ class PriorityQueue:
 
     # --- flush loops (reference: goroutines at 1s / 30s) ----------------------
 
+    def next_backoff_expiry(self) -> Optional[float]:
+        """Expiry time of the soonest still-backed-off pod, or None.  Flushes
+        first, so already-expired pods are in the active queue, not here —
+        the scheduler's batch-formation hysteresis peeks at this."""
+        self.flush()
+        return self._backoff[0][0] if self._backoff else None
+
     def flush(self) -> None:
         self._apply_pending_moves()
         now = self._clock()

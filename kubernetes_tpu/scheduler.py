@@ -90,6 +90,14 @@ class CycleStats:
     in_flight: int = 0  # pods dispatched to device, decision not yet bound
 
 
+def _unpack_diag(bits: np.ndarray, n_filters: int) -> np.ndarray:
+    """int32[B] bitmask → bool[B, K] diagnosis bits (see diagnostics() in
+    _build_jitted: bit k = filter plugin k leaves the pod a feasible node)."""
+    return (
+        (bits[:, None].astype(np.int64) >> np.arange(n_filters)[None, :]) & 1
+    ).astype(bool)
+
+
 def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     """True when any pod carries state the deep pipeline cannot chain
     between batches: pod (anti)affinity tables built from the snapshot's
@@ -160,6 +168,12 @@ class _InFlight:
     # growth) between dispatch and the deferred bind, so the record owns it
     fw: object = None
     diag_dev: object = None  # bool[B, K] per-filter-plugin any-feasible bits
+    # speculative preemption candidate mask, dispatched AT DISPATCH TIME when
+    # the profile's recent failure rate predicts the bind phase will need it
+    # (a failure-heavy cycle otherwise serializes cand dispatch + fetch after
+    # the decision fetch — 2 extra full-priced tunnel rounds)
+    cand_dev: object = None
+    cand_np: object = None  # prefetched by the background thread
 
 
 class TPUScheduler:
@@ -178,6 +192,7 @@ class TPUScheduler:
         profiles: Optional[Dict[str, object]] = None,
         pod_initial_backoff: float = 1.0,
         pod_max_backoff: float = 10.0,
+        batch_wait: float = 0.5,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -192,6 +207,16 @@ class TPUScheduler:
         # (scheduler.go:623).  Default off: tests and interactive callers get
         # the synchronous contract (schedule_cycle returns with pods bound).
         self.pipeline = pipeline
+        # per-profile EMA of the batch failure fraction — drives the
+        # speculative candidate-mask dispatch (see _dispatch_batch)
+        self._fail_ema: Dict[str, float] = {}
+        # batch-formation hysteresis: when the active queue holds less than
+        # half a batch but a backoff wave (e.g. 256 preemptors nominated
+        # together) expires within this window, wait for it — the wave then
+        # fills ONE device batch instead of trickling into several
+        # fragmented cycles that each pay full tunnel pacing (measured:
+        # PreemptionBasic retries averaged 78 pods over 107 cycles)
+        self.batch_wait = batch_wait
         self._inflight_q: List[_InFlight] = []  # oldest first, depth ≤ 2
         self._node_del_gen = 0  # bumped on node DELETE (deep-pipeline gate)
         # "scan" = exact greedy-sequential lax.scan; "batch" = round-based
@@ -426,7 +451,9 @@ class TPUScheduler:
             )
             return dyn._replace(requested=req, non_zero=nz)
 
-        def diagnostics(batch, dsnap, dyn, auxes):
+        n_filters = len(fw.filter_names)
+
+        def diagnostics(batch, dsnap, dyn, auxes, node_row):
             # FitError diagnosis bits in the SAME program (XLA CSEs the
             # filter planes) — the eager fallback paid a ~100ms pacing round
             # per plugin per batch.  The preemption candidate mask
@@ -435,7 +462,20 @@ class TPUScheduler:
             # 5k-node/16k-pod shapes, ~400ms/cycle) and belongs only on
             # batches that actually have unschedulable pods — computed
             # lazily in _candidate_mask.
-            return fw.diagnose_bits(batch, dsnap, dyn, auxes)
+            #
+            # PACKED with node_row into one [2, B] i32: every separate
+            # device→host fetch on the tunnel pays its own ~100ms round, so
+            # fetching decisions and diagnosis separately doubled the
+            # per-cycle fetch cost (measured in the r4 preemption suite).
+            bits = fw.diagnose_bits(batch, dsnap, dyn, auxes)
+            if n_filters <= 31:
+                packed_bits = jnp.sum(
+                    bits.astype(jnp.int32)
+                    << jnp.arange(n_filters, dtype=jnp.int32)[None, :],
+                    axis=1,
+                )
+                return jnp.stack([node_row.astype(jnp.int32), packed_bits])
+            return bits  # >31 filter plugins: unpacked legacy shape
 
         def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, prev,
                          host_auxes, order, key):
@@ -445,7 +485,8 @@ class TPUScheduler:
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
-            return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
+            return res, auxes, dsnap, dyn, diagnostics(
+                batch, dsnap, dyn, auxes, res.node_row)
 
         def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prev,
                         host_auxes, order, coupling, key):
@@ -455,7 +496,8 @@ class TPUScheduler:
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
-            return res, auxes, dsnap, dyn, diagnostics(batch, dsnap, dyn, auxes)
+            return res, auxes, dsnap, dyn, diagnostics(
+                batch, dsnap, dyn, auxes, res.node_row)
 
         def cand_mask(batch, dsnap, dyn, auxes):
             static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
@@ -514,6 +556,8 @@ class TPUScheduler:
             stats.unschedulable += s.unschedulable
             stats.batch_seconds += s.batch_seconds
 
+        if self.batch_wait > 0:
+            self._await_backoff_wave()
         infos = self.queue.pop_batch(
             self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
         )
@@ -556,6 +600,28 @@ class TPUScheduler:
         stats.in_flight = sum(len(fl.infos) for fl in inflight)
         self._observe_pending()
         return stats
+
+    def _await_backoff_wave(self) -> None:
+        """Hold the cycle briefly while an imminent backoff wave drains into
+        the active queue (see batch_wait in __init__).  Engages only when the
+        active queue is under half a batch AND backoff pods outnumber it —
+        deep-queue workloads (the steady suites) never enter the loop."""
+        # REAL-time deadline (not self.clock): under an injected fake clock
+        # time.sleep would never advance a clock-based deadline and the loop
+        # would spin forever — the wait budget is wall time either way
+        real_deadline = time.monotonic() + self.batch_wait
+        while True:
+            # flush FIRST (next_backoff_expiry applies the debounced event
+            # moves + expired backoffs): a just-failed wave sits in pending
+            # moves where pending_count can't see it yet
+            nxt = self.queue.next_backoff_expiry()
+            a, b, _ = self.queue.pending_count()
+            if b == 0 or nxt is None or a >= self.batch_size // 2 or a >= b:
+                return
+            now = self.clock()
+            if time.monotonic() >= real_deadline or nxt - now > self.batch_wait:
+                return
+            time.sleep(min(0.02, max(nxt - now, 0.001)))
 
     def _dispatch_batch(self, infos: List[QueuedPodInfo],
                         prev: Optional[_InFlight] = None,
@@ -629,10 +695,25 @@ class TPUScheduler:
         fl.name_of = dict(self.encoder.row_to_name())
         fl.interacts = interacts if interacts is not None else _pods_block_deep(pods)
         fl.node_del_gen = self._node_del_gen
+        # Speculative candidate mask: when this profile's recent cycles were
+        # failure-heavy and the batch can preempt, dispatch the cand program
+        # NOW so its device window + fetch overlap the bind phase instead of
+        # serializing inside it (2 tunnel rounds off every failing cycle).
+        # A wrong guess costs one overlapped device program, no extra rounds
+        # on the critical path.
+        if (
+            self._fail_ema.get(profile, 0.0) > 0.25
+            and any((p.spec.priority or 0) > 0
+                    and p.spec.preemption_policy != "Never" for p in pods)
+        ):
+            fl.cand_dev = jt["cand"](batch, dsnap_out, dyn_out, auxes)
         # background fetch: the thread blocks in np.asarray until the
         # program lands, so by _complete time the decisions are host-side
         # and the cycle pays no fetch round trip
         import threading
+
+        n_filters = len(fw.filter_names)
+        packed_mode = n_filters <= 31  # matches diagnostics() in _build_jitted
 
         def _bg_fetch(dev=res.node_row, diag_dev=diag, rec=fl, clk=self.clock):
             # Poll-with-sleep instead of a blocking fetch: a blocking
@@ -642,6 +723,28 @@ class TPUScheduler:
             # releases the GIL; np.asarray on an already-ready array is
             # ~0.1ms, so the thread's GIL footprint stays negligible.
             try:
+                if packed_mode and diag_dev is not None:
+                    # packed [2, B] i32 (node_row; diagnosis bitmask):
+                    # decisions + diagnosis land in ONE device→host round
+                    if hasattr(diag_dev, "is_ready"):
+                        while not diag_dev.is_ready():
+                            time.sleep(0.004)
+                    packed = np.asarray(diag_dev)
+                    rec.fetched = packed[0]
+                    rec.diag_np = _unpack_diag(packed[1], n_filters)
+                    rec.fetched_at = clk()
+                    if rec.cand_dev is not None:
+                        try:  # speculative cand mask: land it off-path too,
+                            # with the same GIL-releasing readiness poll (a
+                            # blocking asarray would stall the main thread
+                            # for the cand program's whole device window)
+                            if hasattr(rec.cand_dev, "is_ready"):
+                                while not rec.cand_dev.is_ready():
+                                    time.sleep(0.004)
+                            rec.cand_np = np.asarray(rec.cand_dev)
+                        except Exception:
+                            rec.cand_np = None
+                    return
                 if hasattr(dev, "is_ready"):
                     while not dev.is_ready():
                         time.sleep(0.004)
@@ -652,7 +755,7 @@ class TPUScheduler:
             # prefetch the diagnosis bits too (tiny [B, K] bool): a failing
             # batch's bind phase then pays no extra device round trip
             try:
-                rec.diag_np = np.asarray(diag_dev)
+                rec.diag_np = None if diag_dev is None else np.asarray(diag_dev)
             except Exception:
                 rec.diag_np = None
 
@@ -754,7 +857,10 @@ class TPUScheduler:
                 if diag_np is None:
                     diag_np = fl.diag_np  # prefetched by the bg thread
                 if diag_np is None and fl.diag_dev is not None:
-                    diag_np = np.asarray(fl.diag_dev)  # one sync per failing batch
+                    raw = np.asarray(fl.diag_dev)  # one sync per failing batch
+                    nf = len(fw.filter_names)
+                    diag_np = (_unpack_diag(raw[1], nf)
+                               if nf <= 31 else raw)
                 qi.unschedulable_plugins = self._diagnose(
                     fw, batch, dsnap, dyn, auxes, i,
                     diag_row=None if diag_np is None else diag_np[i],
@@ -784,8 +890,21 @@ class TPUScheduler:
                         # through the post-sync map
                         name_of = (fl.name_of if fl.name_of is not None
                                    else self.encoder.row_to_name())
+                        # row→name as an object ndarray: per-pod candidate
+                        # name lists become one fancy index instead of an
+                        # O(N) dict-lookup comprehension per failing pod
+                        names_arr = np.full(
+                            (max(name_of) + 1) if name_of else 0,
+                            None, dtype=object,
+                        )
+                        for r, nm in name_of.items():
+                            names_arr[r] = nm
                         pf_ctx = (self.store.list("PodDisruptionBudget")[0],
-                                  name_of)
+                                  name_of, names_arr)
+                    if cand_np is None:
+                        cand_np = fl.cand_np  # speculative dispatch landed it
+                    if cand_np is None and fl.cand_dev is not None:
+                        cand_np = np.asarray(fl.cand_dev)
                     if cand_np is None:
                         cand_np = np.asarray(
                             self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
@@ -811,6 +930,10 @@ class TPUScheduler:
                 float(fl.algo_lat[i]) + (self.clock() - t_pod)
             )
         stats.batch_seconds = self.clock() - fl.t0
+        if stats.attempted:
+            frac = stats.unschedulable / stats.attempted
+            prev_ema = self._fail_ema.get(fl.profile, 0.0)
+            self._fail_ema[fl.profile] = 0.5 * prev_ema + 0.5 * frac
         if klog.V(2):
             klog.V(2).info_s(
                 "Scheduling cycle complete", profile=fl.profile,
@@ -1153,7 +1276,8 @@ class TPUScheduler:
         """DefaultPreemption PostFilter (scheduler.go:533-552 → preemption.go:138).
 
         ``cand_row`` bool[N] comes from the per-batch jitted candidate mask;
-        ``pf_ctx`` is the batch-hoisted (PDB list, row→name map).
+        ``pf_ctx`` is the batch-hoisted (PDB list, row→name map, row→name
+        object ndarray).
         """
         pod = qi.pod
         if pod.spec.preemption_policy == "Never":
@@ -1162,8 +1286,10 @@ class TPUScheduler:
         rows = np.where(cand_row)[0]
         if rows.size == 0:
             return
-        pdbs, name_of = pf_ctx
-        names = [name_of[int(r)] for r in rows if int(r) in name_of]
+        pdbs, _name_of, names_arr = pf_ctx
+        rows = rows[rows < names_arr.size]
+        picked = names_arr[rows]
+        names = picked[picked != None].tolist()  # noqa: E711 — elementwise
         nominated: Dict[str, List[v1.Pod]] = {}
         for _uid, (nn, _req, npod) in self._nominated.items():
             nominated.setdefault(nn, []).append(npod)

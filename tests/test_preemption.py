@@ -128,3 +128,64 @@ def test_vectorized_victim_selection_matches_serial():
             assert got is not None, info.node_name
             assert [p.uid for p in got.victims] == [p.uid for p in want.victims]
             assert got.num_pdb_violations == want.num_pdb_violations
+
+
+def test_preempt_plain_tables_match_full_materialization():
+    """preempt()'s shared-tables fast path must pick the SAME candidate (node,
+    victims, violation count) as ranking the fully materialized
+    select_victims_vectorized results through pick_one_node — across
+    randomized clusters, PDBs, priorities, and nominated reservations."""
+    import numpy as np
+
+    from kubernetes_tpu.perf.workloads import node_default
+
+    rng = np.random.default_rng(11)
+    for trial in range(6):
+        cache = Cache()
+        n = int(rng.integers(8, 30))
+        for i in range(n):
+            cache.add_node(node_default(i))
+        npods = int(rng.integers(40, 160))
+        for i in range(npods):
+            p = (make_pod().name(f"low{trial}-{i}").uid(f"low{trial}-{i}")
+                 .namespace("default")
+                 .label("app", "guarded" if i % 4 == 0 else "plain")
+                 .req({"cpu": f"{int(rng.choice([1, 2, 4]))}",
+                       "memory": "1Gi"})
+                 .priority(int(rng.choice([0, 1, 2, 5])))
+                 .obj())
+            p.spec.node_name = f"node-{int(rng.integers(n)):06d}"
+            p.metadata.creation_timestamp = float(rng.integers(1000))
+            cache.add_pod(p)
+        snap = snapshot_of(cache)
+
+        guard = v1.PodDisruptionBudget()
+        guard.metadata.name = "g"
+        guard.metadata.namespace = "default"
+        guard.selector = v1.LabelSelector(match_labels={"app": "guarded"})
+        guard.disruptions_allowed = int(rng.integers(0, 2))
+        pdbs = [guard] if trial % 2 == 0 else []
+
+        preemptor = (make_pod().name("hi").uid("hi").namespace("default")
+                     .req({"cpu": "3", "memory": "2Gi"}).priority(50).obj())
+        nom_pod = (make_pod().name("nom").uid("nom").namespace("default")
+                   .req({"cpu": "2", "memory": "1Gi"}).priority(60).obj())
+        nominated = {f"node-{int(rng.integers(n)):06d}": [nom_pod]}
+
+        names = [ni.node_name for ni in snap.node_info_list]
+        ev = Evaluator()
+        got = ev.preempt(preemptor, snap, names, pdbs, nominated=nominated)
+
+        ref = Evaluator()
+        infos = [snap.node_info_map[nm] for nm in names]
+        results = ref.select_victims_vectorized(
+            preemptor, infos, pdbs, nominated=nominated)
+        want = ref.pick_one_node([c for c in results if c is not None])
+
+        if want is None:
+            assert got is None, f"trial {trial}: fast path found {got}"
+        else:
+            assert got is not None, f"trial {trial}: fast path found nothing"
+            assert got.node_name == want.node_name, f"trial {trial}"
+            assert [p.uid for p in got.victims] == [p.uid for p in want.victims]
+            assert got.num_pdb_violations == want.num_pdb_violations

@@ -1,0 +1,75 @@
+"""Does returning the big TSAux outputs from the fused program cost tunnel
+time?  Chained timing of full-output vs node_row-only programs for a TSC
+batch at 5k nodes."""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+import jax
+
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.perf.workloads import node_zoned, pod_topology_spread, pod_default, ZONES3
+from kubernetes_tpu.framework.runtime import coupling_flags
+from kubernetes_tpu.state.encoding import apply_scatter
+from kubernetes_tpu.framework.runtime import initial_dynamic_state
+
+N, B, S = 5000, 256, 5000
+store = ObjectStore()
+sched = TPUScheduler(store, batch_size=B)
+sched.presize(N, S + 4 * B)
+for i in range(N):
+    store.create("Node", node_zoned(ZONES3)(i))
+for i in range(S):
+    p = pod_default(100000 + i)
+    p.spec.node_name = f"node-{i % N:06d}"
+    store.create("Pod", p)
+for i in range(B):
+    store.create("Pod", pod_topology_spread(i))
+
+infos = sched.queue.pop_batch(B)
+changed = sched.cache.update_snapshot(sched.snapshot)
+sched.encoder.sync(sched.snapshot, changed)
+batch = sched.compiler.compile([qi.pod for qi in infos], pad_to=B)
+fw = sched._framework("default-scheduler")
+host_auxes = fw.host_prepare(batch, sched.snapshot, sched.encoder,
+                             namespace_labels=sched.namespace_labels)
+dsnap, upd = sched.encoder.to_device_deferred()
+nom_rows, nom_req = sched._nominated_arrays(set())
+prev = sched._noop_delta(batch)
+order = np.arange(batch.size, dtype=np.int32)
+
+
+def make(variant):
+    def prog(batch, dsnap, upd, nom_rows, nom_req, prev, host_auxes, order):
+        ds = apply_scatter(dsnap, upd)
+        dyn = initial_dynamic_state(ds)
+        auxes = fw.prepare(batch, ds, dyn, host_auxes)
+        auxes = fw.chain_prev(batch, ds, auxes, prev)
+        res = fw.greedy_assign(batch, ds, dyn, auxes, order)
+        diag = fw.diagnose_bits(batch, ds, dyn, auxes)
+        if variant == "full":
+            return res, auxes, ds, dyn, diag
+        if variant == "no-aux":
+            return res.node_row, ds, diag
+        return res.node_row, diag  # minimal: no dsnap chain either
+
+    return jax.jit(prog)
+
+
+for variant in ("full", "no-aux", "minimal"):
+    jt = make(variant)
+    out = jt(batch, dsnap, upd, nom_rows, nom_req, prev, host_auxes, order)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+    ds = dsnap
+    ts = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        out = jt(batch, ds, upd, nom_rows, nom_req, prev, host_auxes, order)
+        leaves = jax.tree_util.tree_leaves(out)
+        jax.block_until_ready(leaves[0])
+        ts.append(time.perf_counter() - t0)
+        if variant == "full":
+            ds = out[2]
+        elif variant == "no-aux":
+            ds = out[1]
+    print(f"{variant:8s}:", " ".join(f"{1e3*x:.0f}" for x in ts), "ms")
