@@ -500,6 +500,7 @@ class PodStatus:
     phase: str = POD_PENDING
     nominated_node_name: str = ""
     conditions: List[Dict] = field(default_factory=list)
+    pod_ip: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "PodStatus":
@@ -508,6 +509,7 @@ class PodStatus:
             phase=d.get("phase", POD_PENDING),
             nominated_node_name=d.get("nominatedNodeName", ""),
             conditions=list(d.get("conditions") or []),
+            pod_ip=str(d.get("podIP", "")),
         )
 
 
@@ -918,17 +920,222 @@ class Job:
     status_succeeded: int = 0
     status_active: int = 0
     completed: bool = False
+    # batch/v1 JobSpec.ttlSecondsAfterFinished + JobStatus.completionTime
+    # (consumed by the TTL-after-finished controller)
+    ttl_seconds_after_finished: Optional[int] = None
+    completion_time: Optional[float] = None
 
     kind = "Job"
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Job":
         spec = d.get("spec") or {}
+        ttl = spec.get("ttlSecondsAfterFinished")
         return cls(
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             completions=int(spec.get("completions", 1)),
             parallelism=int(spec.get("parallelism", 1)),
             template=PodTemplateSpec.from_dict(spec.get("template")),
+            ttl_seconds_after_finished=(None if ttl is None else int(ttl)),
+        )
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace (reference: pkg/apis/core/types.go Namespace;
+    deletion semantics in pkg/controller/namespace)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    finalizers: List[str] = field(default_factory=lambda: ["kubernetes"])
+    status_phase: str = "Active"  # Active | Terminating
+
+    kind = "Namespace"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Namespace":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            finalizers=[str(f) for f in (spec.get("finalizers")
+                                         or ["kubernetes"])],
+            status_phase=str(status.get("phase", "Active")),
+        )
+
+
+@dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota: spec.hard limits; status mirrors hard + observed
+    used (reference: pkg/apis/core/types.go ResourceQuota; controller at
+    pkg/controller/resourcequota)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, str] = field(default_factory=dict)
+    status_hard: Dict[str, str] = field(default_factory=dict)
+    status_used: Dict[str, str] = field(default_factory=dict)
+
+    kind = "ResourceQuota"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ResourceQuota":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            hard={k: str(v) for k, v in (spec.get("hard") or {}).items()},
+            status_hard={k: str(v)
+                         for k, v in (status.get("hard") or {}).items()},
+            status_used={k: str(v)
+                         for k, v in (status.get("used") or {}).items()},
+        )
+
+
+@dataclass
+class EndpointAddress:
+    ip: str = ""
+    node_name: str = ""
+    target_name: str = ""  # backing pod's name (targetRef)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "EndpointAddress":
+        ref = d.get("targetRef") or {}
+        return cls(
+            ip=str(d.get("ip", "")),
+            node_name=str(d.get("nodeName", "")),
+            target_name=str(ref.get("name", "")),
+        )
+
+
+@dataclass
+class EndpointSubset:
+    addresses: List[EndpointAddress] = field(default_factory=list)
+    not_ready_addresses: List[EndpointAddress] = field(default_factory=list)
+    ports: List[int] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "EndpointSubset":
+        return cls(
+            addresses=[EndpointAddress.from_dict(a)
+                       for a in d.get("addresses") or []],
+            not_ready_addresses=[EndpointAddress.from_dict(a)
+                                 for a in d.get("notReadyAddresses") or []],
+            ports=[int(p.get("port", 0)) if isinstance(p, Mapping) else int(p)
+                   for p in d.get("ports") or []],
+        )
+
+
+@dataclass
+class Endpoints:
+    """core/v1 Endpoints (reference: pkg/controller/endpoint)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subsets: List[EndpointSubset] = field(default_factory=list)
+
+    kind = "Endpoints"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Endpoints":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            subsets=[EndpointSubset.from_dict(s)
+                     for s in d.get("subsets") or []],
+        )
+
+
+@dataclass
+class Endpoint:
+    """discovery/v1 Endpoint (one entry of an EndpointSlice)."""
+
+    addresses: List[str] = field(default_factory=list)
+    ready: bool = True
+    node_name: str = ""
+    target_name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Endpoint":
+        cond = d.get("conditions") or {}
+        ref = d.get("targetRef") or {}
+        return cls(
+            addresses=[str(a) for a in d.get("addresses") or []],
+            ready=bool(cond.get("ready", True)),
+            node_name=str(d.get("nodeName", "")),
+            target_name=str(ref.get("name", "")),
+        )
+
+
+@dataclass
+class EndpointSlice:
+    """discovery/v1 EndpointSlice, ≤100 endpoints per slice (reference:
+    pkg/controller/endpointslice; maxEndpointsPerSlice default)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    address_type: str = "IPv4"
+    endpoints: List[Endpoint] = field(default_factory=list)
+    ports: List[int] = field(default_factory=list)
+
+    kind = "EndpointSlice"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "EndpointSlice":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            address_type=str(d.get("addressType", "IPv4")),
+            endpoints=[Endpoint.from_dict(e)
+                       for e in d.get("endpoints") or []],
+            ports=[int(p.get("port", 0)) if isinstance(p, Mapping) else int(p)
+                   for p in d.get("ports") or []],
+        )
+
+
+@dataclass
+class CronJob:
+    """batch/v1 CronJob (reference: pkg/apis/batch/types.go CronJobSpec;
+    controller at pkg/controller/cronjob)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    schedule: str = "* * * * *"
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    starting_deadline_seconds: Optional[int] = None
+    job_template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    job_completions: int = 1
+    job_parallelism: int = 1
+    last_schedule_time: Optional[float] = None
+
+    kind = "CronJob"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CronJob":
+        spec = d.get("spec") or {}
+        jt = (spec.get("jobTemplate") or {}).get("spec") or {}
+        sd = spec.get("startingDeadlineSeconds")
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            schedule=str(spec.get("schedule", "* * * * *")),
+            suspend=bool(spec.get("suspend", False)),
+            concurrency_policy=str(spec.get("concurrencyPolicy", "Allow")),
+            starting_deadline_seconds=(None if sd is None else int(sd)),
+            job_template=PodTemplateSpec.from_dict(jt.get("template")),
+            job_completions=int(jt.get("completions", 1)),
+            job_parallelism=int(jt.get("parallelism", 1)),
+        )
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount (reference: pkg/controller/serviceaccount
+    ensures 'default' per namespace)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: List[str] = field(default_factory=list)
+
+    kind = "ServiceAccount"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ServiceAccount":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            secrets=[str(s) for s in d.get("secrets") or []],
         )
 
 

@@ -53,6 +53,13 @@ class Scheme:
         self._kinds[typ.kind] = (group, version, typ)
         return self
 
+    def gv_of(self, typ: Type):
+        """(group, version) a type is served under, or None (ObjectKinds)."""
+        entry = self._kinds.get(getattr(typ, "kind", None))
+        if entry is None or entry[2] is not typ:
+            return None
+        return entry[0], entry[1]
+
     def recognized(self) -> List[str]:
         return sorted(
             f"{g + '/' if g else ''}{ver}:{kind}"
@@ -90,8 +97,11 @@ def default_scheme() -> Scheme:
     """All served kinds (the analog of each API group's AddToScheme)."""
     s = Scheme()
     for typ in (v1.Pod, v1.Node, v1.Service, v1.PersistentVolume,
-                v1.PersistentVolumeClaim):
+                v1.PersistentVolumeClaim, v1.Namespace, v1.ResourceQuota,
+                v1.Endpoints, v1.ServiceAccount):
         s.add_known_type("", "v1", typ)
+    s.add_known_type("discovery.k8s.io", "v1", v1.EndpointSlice)
+    s.add_known_type("batch", "v1", v1.CronJob)
     s.add_known_type("storage.k8s.io", "v1", v1.StorageClass)
     s.add_known_type("storage.k8s.io", "v1", v1.CSINode)
     s.add_known_type("policy", "v1", v1.PodDisruptionBudget)

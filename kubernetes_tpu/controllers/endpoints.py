@@ -1,0 +1,166 @@
+"""Endpoints + EndpointSlice controllers.
+
+Reference: pkg/controller/endpoint/endpoints_controller.go (Endpoints per
+Service from selector-matched pods; ready vs notReady split) and
+pkg/controller/endpointslice (discovery/v1 slices, ≤100 endpoints per slice,
+kubernetes.io/service-name label ties slices to their Service).
+
+Pod IPs: real kubelets report status.podIP; hollow nodes don't, so a
+deterministic sim IP is derived from the pod UID when absent — the
+controller's grouping/slicing behavior is what's under test, not IPAM.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Tuple
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+
+MAX_ENDPOINTS_PER_SLICE = 100
+
+
+def _pod_ip(pod: v1.Pod) -> str:
+    if pod.status.pod_ip:
+        return pod.status.pod_ip
+    # zlib.crc32, not hash(): str hash is randomized per process, which made
+    # sim endpoints nondeterministic across runs
+    h = zlib.crc32((pod.metadata.uid or pod.metadata.name).encode())
+    return f"10.{(h >> 16) & 255}.{(h >> 8) & 255}.{h & 255}"
+
+
+def _service_pods(store: ObjectStore, svc) -> Tuple[List[v1.Pod], List[v1.Pod]]:
+    """(ready, not_ready) pods selected by the service, in name order."""
+    if not svc.selector:
+        return [], []
+    pods, _ = store.list("Pod")
+    ready, not_ready = [], []
+    for p in sorted(pods, key=lambda p: p.metadata.name):
+        if p.metadata.namespace != svc.metadata.namespace:
+            continue
+        if p.metadata.deletion_timestamp is not None:
+            continue
+        labels = p.metadata.labels or {}
+        if any(labels.get(k) != want for k, want in svc.selector.items()):
+            continue
+        if not p.spec.node_name:
+            continue  # unscheduled pods have no endpoint yet
+        if p.status.phase == v1.POD_RUNNING:
+            ready.append(p)
+        elif p.status.phase == v1.POD_PENDING:
+            not_ready.append(p)
+    return ready, not_ready
+
+
+def _addr(pod: v1.Pod) -> v1.EndpointAddress:
+    return v1.EndpointAddress(
+        ip=_pod_ip(pod), node_name=pod.spec.node_name or "",
+        target_name=pod.metadata.name,
+    )
+
+
+class EndpointsController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        services, _ = self.store.list("Service")
+        for svc in services:
+            ready, not_ready = _service_pods(self.store, svc)
+            subset = v1.EndpointSubset(
+                addresses=[_addr(p) for p in ready],
+                not_ready_addresses=[_addr(p) for p in not_ready],
+            )
+            want = [subset] if (ready or not_ready) else []
+            cur = self.store.get("Endpoints", svc.metadata.namespace,
+                                 svc.metadata.name)
+            if cur is None:
+                ep = v1.Endpoints(
+                    metadata=v1.ObjectMeta(name=svc.metadata.name,
+                                           namespace=svc.metadata.namespace),
+                    subsets=want,
+                )
+                self.store.create("Endpoints", ep)
+                changed = True
+            elif _subset_key(cur.subsets) != _subset_key(want):
+                cur.subsets = want
+                self.store.update("Endpoints", cur)
+                changed = True
+        # services gone → endpoints garbage
+        eps, _ = self.store.list("Endpoints")
+        live = {(s.metadata.namespace, s.metadata.name) for s in services}
+        for ep in eps:
+            if (ep.metadata.namespace, ep.metadata.name) not in live:
+                self.store.delete("Endpoints", ep.metadata.namespace,
+                                  ep.metadata.name)
+                changed = True
+        return changed
+
+
+class EndpointSliceController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        services, _ = self.store.list("Service")
+        want_names = set()
+        for svc in services:
+            ready, not_ready = _service_pods(self.store, svc)
+            endpoints = [
+                v1.Endpoint(addresses=[_pod_ip(p)], ready=True,
+                            node_name=p.spec.node_name or "",
+                            target_name=p.metadata.name)
+                for p in ready
+            ] + [
+                v1.Endpoint(addresses=[_pod_ip(p)], ready=False,
+                            node_name=p.spec.node_name or "",
+                            target_name=p.metadata.name)
+                for p in not_ready
+            ]
+            for i in range(0, max(1, len(endpoints)), MAX_ENDPOINTS_PER_SLICE):
+                chunk = endpoints[i:i + MAX_ENDPOINTS_PER_SLICE]
+                name = f"{svc.metadata.name}-{i // MAX_ENDPOINTS_PER_SLICE}"
+                want_names.add((svc.metadata.namespace, name))
+                cur = self.store.get("EndpointSlice", svc.metadata.namespace,
+                                     name)
+                if cur is None:
+                    sl = v1.EndpointSlice(
+                        metadata=v1.ObjectMeta(
+                            name=name, namespace=svc.metadata.namespace,
+                            labels={"kubernetes.io/service-name":
+                                    svc.metadata.name},
+                        ),
+                        endpoints=chunk,
+                    )
+                    self.store.create("EndpointSlice", sl)
+                    changed = True
+                elif _ep_key(cur.endpoints) != _ep_key(chunk):
+                    cur.endpoints = chunk
+                    self.store.update("EndpointSlice", cur)
+                    changed = True
+        slices, _ = self.store.list("EndpointSlice")
+        for sl in slices:
+            if (sl.metadata.namespace, sl.metadata.name) not in want_names:
+                self.store.delete("EndpointSlice", sl.metadata.namespace,
+                                  sl.metadata.name)
+                changed = True
+        return changed
+
+
+def _subset_key(subsets) -> tuple:
+    return tuple(
+        (tuple((a.ip, a.node_name, a.target_name) for a in s.addresses),
+         tuple((a.ip, a.node_name, a.target_name)
+               for a in s.not_ready_addresses))
+        for s in subsets
+    )
+
+
+def _ep_key(endpoints) -> tuple:
+    return tuple(
+        (tuple(e.addresses), e.ready, e.node_name, e.target_name)
+        for e in endpoints
+    )

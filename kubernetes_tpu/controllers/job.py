@@ -9,8 +9,11 @@ from .replicaset import _owned_pods, make_pod_from_template
 
 
 class JobController:
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, clock=None):
+        import time
+
         self.store = store
+        self.clock = clock or time.time
 
     def sync_once(self) -> bool:
         changed = False
@@ -28,6 +31,7 @@ class JobController:
             want_active = min(job.parallelism, job.completions - succeeded)
             if succeeded >= job.completions:
                 job.completed = True
+                job.completion_time = self.clock()  # JobStatus.completionTime
                 job.status_succeeded = succeeded
                 job.status_active = 0
                 self.store.update("Job", job)

@@ -25,24 +25,37 @@ class ControllerManager:
         return self
 
     def register_defaults(self) -> "ControllerManager":
+        from .cronjob import CronJobController
         from .deployment import DeploymentController
         from .disruption import DisruptionController
+        from .endpoints import EndpointsController, EndpointSliceController
         from .garbagecollector import GarbageCollector
         from .job import JobController
+        from .namespace import NamespaceController
         from .nodelifecycle import NodeLifecycleController
         from .replicaset import ReplicaSetController
+        from .resourcequota import ResourceQuotaController
+        from .serviceaccount import ServiceAccountController
         from .statefulset import StatefulSetController
         from .daemonset import DaemonSetController
         from .podautoscaler import HorizontalPodAutoscalerController
+        from .ttlafterfinished import TTLAfterFinishedController
 
+        self.register(NamespaceController(self.store))
+        self.register(ServiceAccountController(self.store))
         self.register(DeploymentController(self.store))
         self.register(ReplicaSetController(self.store))
         self.register(StatefulSetController(self.store))
         self.register(DaemonSetController(self.store))
-        self.register(JobController(self.store))
+        self.register(CronJobController(self.store, clock=self.clock))
+        self.register(JobController(self.store, clock=self.clock))
+        self.register(TTLAfterFinishedController(self.store, clock=self.clock))
         self.register(NodeLifecycleController(self.store, clock=self.clock))
         self.register(DisruptionController(self.store))
         self.register(HorizontalPodAutoscalerController(self.store))
+        self.register(EndpointsController(self.store))
+        self.register(EndpointSliceController(self.store))
+        self.register(ResourceQuotaController(self.store))
         self.register(GarbageCollector(self.store))
         return self
 

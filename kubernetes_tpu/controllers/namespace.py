@@ -1,0 +1,38 @@
+"""Namespace lifecycle controller.
+
+Reference: pkg/controller/namespace/namespace_controller.go +
+deletion/namespaced_resources_deleter.go — a namespace with a deletion
+timestamp moves to Terminating, every namespaced object in it is deleted,
+and once the namespace is empty the kubernetes finalizer is removed and the
+namespace itself goes away.
+"""
+
+from __future__ import annotations
+
+from ..sim.store import ObjectStore
+
+
+class NamespaceController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        namespaces, _ = self.store.list("Namespace")
+        for ns in namespaces:
+            if ns.metadata.deletion_timestamp is None:
+                continue
+            if ns.status_phase != "Terminating":
+                ns.status_phase = "Terminating"
+                self.store.update("Namespace", ns)
+                changed = True
+            contents = self.store.list_namespaced(ns.metadata.name)
+            for kind, obj in contents:
+                self.store.delete(kind, ns.metadata.name, obj.metadata.name)
+                changed = True
+            if not self.store.list_namespaced(ns.metadata.name):
+                # deleteNamespace: finalizer removal lets the apiserver drop it
+                ns.finalizers = [f for f in ns.finalizers if f != "kubernetes"]
+                self.store.delete("Namespace", "", ns.metadata.name)
+                changed = True
+        return changed

@@ -1,0 +1,51 @@
+"""ResourceQuota status controller.
+
+Reference: pkg/controller/resourcequota/resource_quota_controller.go syncs
+status.used from observed objects; enforcement happens at admission
+(sim/store.py _admit_quota, the plugin/pkg/admission/resourcequota analog).
+"""
+
+from __future__ import annotations
+
+from ..api.resource import compute_pod_resource_request
+from ..sim.store import ObjectStore
+
+
+def _fmt_milli(milli: int) -> str:
+    return f"{milli}m" if milli % 1000 else str(milli // 1000)
+
+
+class ResourceQuotaController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        quotas, _ = self.store.list("ResourceQuota")
+        if not quotas:
+            return False
+        pods, _ = self.store.list("Pod")
+        by_ns: dict = {}
+        for p in pods:
+            if p.status.phase in ("Succeeded", "Failed"):
+                continue  # terminal pods release their quota share
+            by_ns.setdefault(p.metadata.namespace, []).append(p)
+        for q in quotas:
+            ns_pods = by_ns.get(q.metadata.namespace, [])
+            cpu = sum(compute_pod_resource_request(p).milli_cpu
+                      for p in ns_pods)
+            mem = sum(compute_pod_resource_request(p).memory for p in ns_pods)
+            used = {}
+            for key in q.hard:
+                if key in ("pods", "count/pods"):
+                    used[key] = str(len(ns_pods))
+                elif key in ("cpu", "requests.cpu"):
+                    used[key] = _fmt_milli(cpu)
+                elif key in ("memory", "requests.memory"):
+                    used[key] = str(mem)
+            if q.status_used != used or q.status_hard != dict(q.hard):
+                q.status_used = used
+                q.status_hard = dict(q.hard)
+                self.store.update("ResourceQuota", q)
+                changed = True
+        return changed

@@ -1,0 +1,31 @@
+"""ServiceAccount controller.
+
+Reference: pkg/controller/serviceaccount/serviceaccounts_controller.go —
+every Active namespace gets a 'default' ServiceAccount; recreated if deleted.
+"""
+
+from __future__ import annotations
+
+from ..api import objects as v1
+from ..sim.store import ObjectStore
+
+
+class ServiceAccountController:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        changed = False
+        namespaces, _ = self.store.list("Namespace")
+        for ns in namespaces:
+            if ns.status_phase != "Active" or ns.metadata.deletion_timestamp:
+                continue
+            if self.store.get("ServiceAccount", ns.metadata.name,
+                              "default") is None:
+                sa = v1.ServiceAccount(
+                    metadata=v1.ObjectMeta(name="default",
+                                           namespace=ns.metadata.name),
+                )
+                self.store.create("ServiceAccount", sa)
+                changed = True
+        return changed

@@ -1,0 +1,41 @@
+"""TTL-after-finished controller.
+
+Reference: pkg/controller/ttlafterfinished/ttlafterfinished_controller.go —
+finished Jobs with spec.ttlSecondsAfterFinished are deleted once the TTL
+elapses past status.completionTime; their pods go with them (the sim GC's
+owner-reference cascade handles that).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..sim.store import ObjectStore
+
+
+class TTLAfterFinishedController:
+    def __init__(self, store: ObjectStore, clock=None):
+        self.store = store
+        self.clock = clock or time.time
+
+    def sync_once(self) -> bool:
+        changed = False
+        now = self.clock()
+        jobs, _ = self.store.list("Job")
+        for job in jobs:
+            ttl = job.ttl_seconds_after_finished
+            if ttl is None or not job.completed:
+                continue
+            done_at = job.completion_time
+            if done_at is None:
+                # finished before completion_time existed: stamp now so the
+                # TTL counts from first observation (controller restart path)
+                job.completion_time = now
+                self.store.update("Job", job)
+                changed = True
+                continue
+            if now - done_at >= ttl:
+                self.store.delete("Job", job.metadata.namespace,
+                                  job.metadata.name)
+                changed = True
+        return changed

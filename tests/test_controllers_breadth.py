@@ -1,0 +1,264 @@
+"""Round-4 controller breadth: namespace, quota, endpoints/slices, cronjob,
+TTL-after-finished, serviceaccount.
+
+Reference: pkg/controller/{namespace,resourcequota,endpoint,endpointslice,
+cronjob,ttlafterfinished,serviceaccount} + plugin/pkg/admission/resourcequota.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.controllers.cronjob import CronJobController, CronSchedule
+from kubernetes_tpu.controllers.endpoints import (
+    EndpointsController,
+    EndpointSliceController,
+)
+from kubernetes_tpu.controllers.job import JobController
+from kubernetes_tpu.controllers.namespace import NamespaceController
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.serviceaccount import ServiceAccountController
+from kubernetes_tpu.controllers.ttlafterfinished import (
+    TTLAfterFinishedController,
+)
+from kubernetes_tpu.sim.store import ObjectStore, QuotaExceeded
+from kubernetes_tpu.testutil import make_pod
+
+
+def _ns(name):
+    ns = v1.Namespace()
+    ns.metadata.name = name
+    return ns
+
+
+def test_namespace_deletion_cascades():
+    store = ObjectStore()
+    store.create("Namespace", _ns("team-a"))
+    store.create("Pod", make_pod().name("p0").uid("p0")
+                 .namespace("team-a").req({"cpu": "1"}).obj())
+    svc = v1.Service(metadata=v1.ObjectMeta(name="s0", namespace="team-a"),
+                     selector={"app": "a"})
+    store.create("Service", svc)
+    nc = NamespaceController(store)
+    assert nc.sync_once() is False  # nothing terminating
+
+    ns = store.get("Namespace", "", "team-a")
+    ns.metadata.deletion_timestamp = 1.0
+    store.update("Namespace", ns)
+    nc.sync_once()
+    assert store.get("Pod", "team-a", "p0") is None
+    assert store.get("Service", "team-a", "s0") is None
+    assert store.get("Namespace", "", "team-a") is None
+
+
+def test_service_account_default_per_namespace():
+    store = ObjectStore()
+    store.create("Namespace", _ns("team-a"))
+    store.create("Namespace", _ns("team-b"))
+    sac = ServiceAccountController(store)
+    sac.sync_once()
+    assert store.get("ServiceAccount", "team-a", "default") is not None
+    assert store.get("ServiceAccount", "team-b", "default") is not None
+    # recreated if deleted
+    store.delete("ServiceAccount", "team-a", "default")
+    sac.sync_once()
+    assert store.get("ServiceAccount", "team-a", "default") is not None
+
+
+def test_resource_quota_admission_and_status():
+    store = ObjectStore()
+    q = v1.ResourceQuota()
+    q.metadata.name = "rq"
+    q.metadata.namespace = "default"
+    q.hard = {"pods": "2", "requests.cpu": "3"}
+    store.create("ResourceQuota", q)
+
+    store.create("Pod", make_pod().name("p0").uid("p0").namespace("default")
+                 .req({"cpu": "1"}).obj())
+    store.create("Pod", make_pod().name("p1").uid("p1").namespace("default")
+                 .req({"cpu": "1"}).obj())
+    # third pod exceeds pods: 2
+    with pytest.raises(QuotaExceeded):
+        store.create("Pod", make_pod().name("p2").uid("p2")
+                     .namespace("default").req({"cpu": "1"}).obj())
+    # other namespaces unaffected
+    store.create("Pod", make_pod().name("px").uid("px").namespace("other")
+                 .req({"cpu": "9"}).obj())
+
+    # cpu quota enforced too: delete one pod, then an oversized request fails
+    store.delete("Pod", "default", "p1")
+    with pytest.raises(QuotaExceeded):
+        store.create("Pod", make_pod().name("p3").uid("p3")
+                     .namespace("default").req({"cpu": "3"}).obj())
+    store.create("Pod", make_pod().name("p4").uid("p4").namespace("default")
+                 .req({"cpu": "2"}).obj())
+
+    rc = ResourceQuotaController(store)
+    rc.sync_once()
+    q = store.get("ResourceQuota", "default", "rq")
+    assert q.status_used["pods"] == "2"
+    assert q.status_used["requests.cpu"] == "3"
+    assert q.status_hard == {"pods": "2", "requests.cpu": "3"}
+
+
+def test_endpoints_ready_and_not_ready_split():
+    store = ObjectStore()
+    svc = v1.Service(metadata=v1.ObjectMeta(name="web", namespace="default"),
+                     selector={"app": "web"})
+    store.create("Service", svc)
+    running = (make_pod().name("w0").uid("w0").namespace("default")
+               .label("app", "web").req({"cpu": "1"}).obj())
+    running.spec.node_name = "n0"
+    running.status.phase = v1.POD_RUNNING
+    running.status.pod_ip = "10.0.0.5"
+    store.create("Pod", running)
+    pending = (make_pod().name("w1").uid("w1").namespace("default")
+               .label("app", "web").req({"cpu": "1"}).obj())
+    pending.spec.node_name = "n1"
+    store.create("Pod", pending)
+    other = (make_pod().name("x0").uid("x0").namespace("default")
+             .label("app", "db").req({"cpu": "1"}).obj())
+    other.spec.node_name = "n0"
+    other.status.phase = v1.POD_RUNNING
+    store.create("Pod", other)
+
+    ec = EndpointsController(store)
+    ec.sync_once()
+    ep = store.get("Endpoints", "default", "web")
+    assert ep is not None
+    assert [a.ip for a in ep.subsets[0].addresses] == ["10.0.0.5"]
+    assert [a.target_name for a in ep.subsets[0].not_ready_addresses] == ["w1"]
+
+    # pod becomes ready → moves subsets; service deleted → endpoints GC'd
+    pending.status.phase = v1.POD_RUNNING
+    store.update("Pod", pending)
+    ec.sync_once()
+    ep = store.get("Endpoints", "default", "web")
+    assert len(ep.subsets[0].addresses) == 2
+    store.delete("Service", "default", "web")
+    ec.sync_once()
+    assert store.get("Endpoints", "default", "web") is None
+
+
+def test_endpoint_slices_chunk_at_100():
+    store = ObjectStore()
+    svc = v1.Service(metadata=v1.ObjectMeta(name="big", namespace="default"),
+                     selector={"app": "big"})
+    store.create("Service", svc)
+    for i in range(130):
+        p = (make_pod().name(f"b{i:03d}").uid(f"b{i:03d}")
+             .namespace("default").label("app", "big")
+             .req({"cpu": "1m"}).obj())
+        p.spec.node_name = f"n{i % 4}"
+        p.status.phase = v1.POD_RUNNING
+        store.create("Pod", p)
+    esc = EndpointSliceController(store)
+    esc.sync_once()
+    slices, _ = store.list("EndpointSlice")
+    assert sorted(s.metadata.name for s in slices) == ["big-0", "big-1"]
+    sizes = sorted(len(s.endpoints) for s in slices)
+    assert sizes == [30, 100]
+    assert all(s.metadata.labels["kubernetes.io/service-name"] == "big"
+               for s in slices)
+
+
+def test_cron_schedule_parsing():
+    # 2026-01-01 00:00:00 UTC is a Thursday
+    t0 = 1767225600.0
+    assert CronSchedule("* * * * *").matches(t0)
+    assert CronSchedule("0 0 * * *").matches(t0)
+    assert not CronSchedule("5 * * * *").matches(t0)
+    assert CronSchedule("*/15 * * * *").matches(t0 + 900)
+    assert not CronSchedule("*/15 * * * *").matches(t0 + 60)
+    assert CronSchedule("* * * * 4").matches(t0)  # Thursday
+    assert not CronSchedule("* * * * 0").matches(t0)
+    assert CronSchedule("0-30 * * * *").matches(t0 + 1200)
+    assert CronSchedule("1,2,3 * * * *").matches(t0 + 120)
+    sched = CronSchedule("*/10 * * * *")
+    # most RECENT unmet boundary wins (older misses are skipped)
+    assert sched.most_recent(t0 + 1, t0 + 1500) == t0 + 1200
+    assert sched.most_recent(t0 + 601, t0 + 900) is None
+
+
+def test_cronjob_fires_and_respects_forbid():
+    t0 = 1767225600.0
+    now = {"t": t0 + 30}
+    store = ObjectStore()
+    cj = v1.CronJob()
+    cj.metadata.name = "tick"
+    cj.metadata.namespace = "default"
+    cj.metadata.uid = "tick"
+    cj.metadata.creation_timestamp = t0 - 30
+    cj.schedule = "* * * * *"
+    cj.concurrency_policy = "Forbid"
+    store.create("CronJob", cj)
+    cc = CronJobController(store, clock=lambda: now["t"])
+    cc.sync_once()
+    jobs, _ = store.list("Job")
+    assert len(jobs) == 1  # fired for the t0 boundary
+    assert cj.last_schedule_time == t0
+
+    # next minute: active un-finished job + Forbid → no new job
+    now["t"] = t0 + 90
+    cc.sync_once()
+    assert len(store.list("Job")[0]) == 1
+
+    # job finishes → next boundary fires again
+    job = store.list("Job")[0][0]
+    job.completed = True
+    store.update("Job", job)
+    now["t"] = t0 + 150
+    cc.sync_once()
+    assert len(store.list("Job")[0]) == 2
+
+    # suspend stops firing
+    cj.suspend = True
+    store.update("CronJob", cj)
+    now["t"] = t0 + 210
+    cc.sync_once()
+    assert len(store.list("Job")[0]) == 2
+
+
+def test_ttl_after_finished_deletes_job():
+    now = {"t": 100.0}
+    store = ObjectStore()
+    job = v1.Job()
+    job.metadata.name = "done"
+    job.metadata.namespace = "default"
+    job.ttl_seconds_after_finished = 60
+    job.completed = True
+    job.completion_time = 100.0
+    store.create("Job", job)
+    keeper = v1.Job()
+    keeper.metadata.name = "keep"
+    keeper.metadata.namespace = "default"
+    keeper.completed = True  # no TTL: never collected
+    store.create("Job", keeper)
+
+    tc = TTLAfterFinishedController(store, clock=lambda: now["t"])
+    tc.sync_once()
+    assert store.get("Job", "default", "done") is not None  # ttl not elapsed
+    now["t"] = 161.0
+    tc.sync_once()
+    assert store.get("Job", "default", "done") is None
+    assert store.get("Job", "default", "keep") is not None
+
+
+def test_job_controller_stamps_completion_time():
+    now = {"t": 500.0}
+    store = ObjectStore()
+    job = v1.Job()
+    job.metadata.name = "j"
+    job.metadata.namespace = "default"
+    job.metadata.uid = "j"
+    job.completions = 1
+    job.parallelism = 1
+    store.create("Job", job)
+    jc = JobController(store, clock=lambda: now["t"])
+    jc.sync_once()
+    pods, _ = store.list("Pod")
+    assert len(pods) == 1
+    pods[0].status.phase = v1.POD_SUCCEEDED
+    store.update("Pod", pods[0])
+    jc.sync_once()
+    job = store.get("Job", "default", "j")
+    assert job.completed and job.completion_time == 500.0
