@@ -1,0 +1,281 @@
+"""Object → manifest serialization (the inverse of each type's from_dict).
+
+Reference: staging/src/k8s.io/apimachinery/pkg/runtime serializer/json — the
+apiserver's wire form.  Every served kind round-trips:
+``scheme.decode(to_manifest(obj))`` reconstructs the object (status
+subresources of workload kinds excepted, matching the reference's
+spec-vs-status split on ordinary writes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from . import objects as v1
+
+# snake_case fields whose wire names are not plain camelCase
+_RENAMES = {
+    "host_ip": "hostIP",
+    "pod_ip": "podIP",
+    "pod_cidr": "podCIDR",
+}
+# NodeAffinity/PodAffinity/PodAntiAffinity wire names for required/preferred
+_AFFINITY_RENAMES = {
+    "required": "requiredDuringSchedulingIgnoredDuringExecution",
+    "preferred": "preferredDuringSchedulingIgnoredDuringExecution",
+}
+
+
+def _camel(s: str) -> str:
+    if s in _RENAMES:
+        return _RENAMES[s]
+    head, *rest = s.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def _is_default(f: dataclasses.Field, value) -> bool:
+    if f.default is not dataclasses.MISSING:
+        return value == f.default
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        try:
+            return value == f.default_factory()  # type: ignore[misc]
+        except Exception:
+            return False
+    return False
+
+
+def _ser(value: Any) -> Any:
+    """Generic dataclass → camelCase dict, skipping default-valued fields."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        renames = (_AFFINITY_RENAMES
+                   if isinstance(value, (v1.NodeAffinity, v1.PodAffinity))
+                   else {})
+        out = {}
+        for f in dataclasses.fields(value):
+            val = getattr(value, f.name)
+            if val is None or _is_default(f, val):
+                continue
+            out[renames.get(f.name) or _camel(f.name)] = _ser(val)
+        return out
+    if isinstance(value, dict):
+        return {k: _ser(x) for k, x in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_ser(x) for x in value]
+    return value
+
+
+def _meta(meta: v1.ObjectMeta) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": meta.name}
+    if meta.namespace:
+        out["namespace"] = meta.namespace
+    if meta.uid:
+        out["uid"] = meta.uid
+    if meta.labels:
+        out["labels"] = dict(meta.labels)
+    if meta.annotations:
+        out["annotations"] = dict(meta.annotations)
+    if meta.resource_version:
+        out["resourceVersion"] = str(meta.resource_version)
+    if meta.creation_timestamp:
+        out["creationTimestamp"] = meta.creation_timestamp
+    if meta.deletion_timestamp is not None:
+        out["deletionTimestamp"] = meta.deletion_timestamp
+    if meta.owner_references:
+        out["ownerReferences"] = [
+            {"apiVersion": o.api_version, "kind": o.kind, "name": o.name,
+             "uid": o.uid, "controller": o.controller}
+            for o in meta.owner_references
+        ]
+    return out
+
+
+def _volume(vol: v1.Volume) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": vol.name}
+    if vol.pvc_name is not None:
+        out["persistentVolumeClaim"] = {"claimName": vol.pvc_name}
+    if vol.host_path is not None:
+        out["hostPath"] = {"path": vol.host_path}
+    if vol.gce_pd_name is not None:
+        out["gcePersistentDisk"] = {"pdName": vol.gce_pd_name}
+    if vol.aws_ebs_volume_id is not None:
+        out["awsElasticBlockStore"] = {"volumeID": vol.aws_ebs_volume_id}
+    return out
+
+
+def _pod_spec(spec: v1.PodSpec) -> Dict[str, Any]:
+    out = _ser(spec)
+    if spec.volumes:
+        out["volumes"] = [_volume(vol) for vol in spec.volumes]
+    return out
+
+
+def _template(t: v1.PodTemplateSpec) -> Dict[str, Any]:
+    return {"metadata": {"labels": dict(t.labels)},
+            "spec": _pod_spec(t.spec)}
+
+
+def _workload_spec(obj) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {"template": _template(obj.template)}
+    if getattr(obj, "selector", None) is not None:
+        spec["selector"] = _ser(obj.selector)
+    if hasattr(obj, "replicas"):
+        spec["replicas"] = obj.replicas
+    return spec
+
+
+def _storageclass_topologies(sc: v1.StorageClass):
+    return [
+        {"matchLabelExpressions": [
+            {"key": r.key, "values": list(r.values)}
+            for r in term.match_expressions
+        ]}
+        for term in sc.allowed_topologies.node_selector_terms
+    ]
+
+
+def _spec_status(obj) -> Dict[str, Any]:
+    """Kind-specific body (everything except metadata/apiVersion/kind)."""
+    if isinstance(obj, (v1.Pod, v1.Node)):
+        body = {"spec": (_pod_spec(obj.spec) if isinstance(obj, v1.Pod)
+                         else _ser(obj.spec))}
+        status = _ser(obj.status)
+        if isinstance(obj, v1.Node):
+            # allocatable defaults to capacity in from_dict; keep both
+            status = {"capacity": dict(obj.status.capacity),
+                      "allocatable": dict(obj.status.allocatable),
+                      "images": _ser(obj.status.images),
+                      "conditions": list(obj.status.conditions)}
+        return {**body, "status": status}
+    if isinstance(obj, v1.Service):
+        return {"spec": {"selector": dict(obj.selector)}}
+    if isinstance(obj, v1.PodDisruptionBudget):
+        return {
+            "spec": {k: val for k, val in (
+                ("selector", _ser(obj.selector) if obj.selector else None),
+                ("minAvailable", obj.min_available),
+                ("maxUnavailable", obj.max_unavailable)) if val is not None},
+            "status": {"disruptionsAllowed": obj.disruptions_allowed,
+                       "currentHealthy": obj.current_healthy,
+                       "desiredHealthy": obj.desired_healthy,
+                       "expectedPods": obj.expected_pods},
+        }
+    if isinstance(obj, v1.PersistentVolumeClaim):
+        spec: Dict[str, Any] = {
+            "volumeName": obj.volume_name,
+            "accessModes": list(obj.access_modes),
+            "resources": {"requests": {"storage": obj.requested_storage}},
+        }
+        if obj.storage_class_name is not None:
+            spec["storageClassName"] = obj.storage_class_name
+        return {"spec": spec, "status": {"phase": obj.phase}}
+    if isinstance(obj, v1.PersistentVolume):
+        spec = {"capacity": dict(obj.capacity),
+                "storageClassName": obj.storage_class_name,
+                "accessModes": list(obj.access_modes)}
+        if obj.node_affinity is not None:
+            spec["nodeAffinity"] = {"required": _ser(obj.node_affinity)}
+        if obj.claim_ref:
+            ns, _, name = obj.claim_ref.partition("/")
+            spec["claimRef"] = {"namespace": ns, "name": name}
+        return {"spec": spec}
+    if isinstance(obj, v1.PriorityClass):
+        return {"value": obj.value, "globalDefault": obj.global_default,
+                "preemptionPolicy": obj.preemption_policy}
+    if isinstance(obj, v1.StorageClass):
+        out: Dict[str, Any] = {"volumeBindingMode": obj.volume_binding_mode,
+                               "provisioner": obj.provisioner}
+        if obj.allowed_topologies is not None:
+            out["allowedTopologies"] = _storageclass_topologies(obj)
+        return out
+    if isinstance(obj, v1.CSINode):
+        return {"spec": {"drivers": [
+            {"name": name, "allocatable": {"count": count}}
+            for name, count in obj.driver_limits.items()
+        ]}}
+    if isinstance(obj, (v1.ReplicaSet, v1.Deployment, v1.StatefulSet,
+                        v1.DaemonSet)):
+        return {"spec": _workload_spec(obj)}
+    if isinstance(obj, v1.Job):
+        spec = {"completions": obj.completions,
+                "parallelism": obj.parallelism,
+                "template": _template(obj.template)}
+        if obj.ttl_seconds_after_finished is not None:
+            spec["ttlSecondsAfterFinished"] = obj.ttl_seconds_after_finished
+        return {"spec": spec,
+                "status": {"succeeded": obj.status_succeeded,
+                           "active": obj.status_active}}
+    if isinstance(obj, v1.CronJob):
+        spec = {"schedule": obj.schedule, "suspend": obj.suspend,
+                "concurrencyPolicy": obj.concurrency_policy,
+                "jobTemplate": {"spec": {
+                    "completions": obj.job_completions,
+                    "parallelism": obj.job_parallelism,
+                    "template": _template(obj.job_template)}}}
+        if obj.starting_deadline_seconds is not None:
+            spec["startingDeadlineSeconds"] = obj.starting_deadline_seconds
+        return {"spec": spec}
+    if isinstance(obj, v1.Namespace):
+        return {"spec": {"finalizers": list(obj.finalizers)},
+                "status": {"phase": obj.status_phase}}
+    if isinstance(obj, v1.ResourceQuota):
+        return {"spec": {"hard": dict(obj.hard)},
+                "status": {"hard": dict(obj.status_hard),
+                           "used": dict(obj.status_used)}}
+    if isinstance(obj, v1.Endpoints):
+        return {"subsets": [
+            {"addresses": [_ep_addr(a) for a in s.addresses],
+             "notReadyAddresses": [_ep_addr(a)
+                                   for a in s.not_ready_addresses],
+             "ports": [{"port": p} for p in s.ports]}
+            for s in obj.subsets
+        ]}
+    if isinstance(obj, v1.EndpointSlice):
+        return {"addressType": obj.address_type,
+                "ports": [{"port": p} for p in obj.ports],
+                "endpoints": [
+                    {"addresses": list(e.addresses),
+                     "conditions": {"ready": e.ready},
+                     "nodeName": e.node_name,
+                     "targetRef": {"kind": "Pod", "name": e.target_name}}
+                    for e in obj.endpoints
+                ]}
+    if isinstance(obj, v1.ServiceAccount):
+        return {"secrets": list(obj.secrets)}
+    if obj.__class__.__name__ == "HorizontalPodAutoscaler":
+        return {"spec": {
+            "scaleTargetRef": {"kind": obj.target_kind,
+                               "name": obj.target_name},
+            "minReplicas": obj.min_replicas,
+            "maxReplicas": obj.max_replicas,
+            "metrics": [{"resource": {"name": "cpu", "target": {
+                "averageUtilization": obj.target_utilization}}}],
+        }}
+    # unknown kind: best-effort generic walk
+    body = _ser(obj)
+    body.pop("metadata", None)
+    return body
+
+
+def _ep_addr(a: v1.EndpointAddress) -> Dict[str, Any]:
+    return {"ip": a.ip, "nodeName": a.node_name,
+            "targetRef": {"kind": "Pod", "name": a.target_name}}
+
+
+def to_manifest(obj, scheme=None) -> Dict[str, Any]:
+    """Serialize a served object to its wire manifest.  ``scheme`` supplies
+    the apiVersion (group/version); without one the kind alone is emitted."""
+    out: Dict[str, Any] = {"kind": obj.kind}
+    if scheme is not None:
+        gv = scheme.gv_of(type(obj))
+        if gv is not None:
+            group, version = gv
+            out["apiVersion"] = f"{group}/{version}" if group else version
+    out["metadata"] = _meta(obj.metadata)
+    out.update(_spec_status(obj))
+    return out
+
+
+def roundtrips(obj, scheme) -> bool:
+    """decode(to_manifest(obj)) == obj — test helper."""
+    return scheme.decode(to_manifest(obj, scheme)) == obj

@@ -1,0 +1,4 @@
+from .server import APIServer, resource_of
+from .client import HTTPApiClient
+
+__all__ = ["APIServer", "HTTPApiClient", "resource_of"]

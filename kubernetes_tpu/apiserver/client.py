@@ -1,0 +1,173 @@
+"""HTTP client adapter: the Reflector's (list, watch) contract over the wire.
+
+Reference: client-go rest.Client + tools/cache ListerWatcher — LIST returns
+(objects, resourceVersion), WATCH streams ordered events from that rv.  A
+Reflector(HTTPApiClient(url), "Pod") therefore runs list+watch over real
+HTTP exactly as it does over the in-process store.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable, List, Optional, Tuple
+
+from ..api.scheme import Scheme, default_scheme
+from ..sim.store import WatchEvent
+from .server import resource_of
+
+
+class HTTPApiClient:
+    def __init__(self, base_url: str, scheme: Optional[Scheme] = None,
+                 user: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.scheme = scheme or default_scheme()
+        self.user = user
+        self._watch_threads: List[threading.Thread] = []
+        self._stopped = False
+
+    # --- url plumbing -------------------------------------------------------
+
+    def _prefix(self, kind: str) -> str:
+        gv = self.scheme.gv_of(self._type_of(kind))
+        group, version = gv if gv else ("", "v1")
+        return (f"/apis/{group}/{version}" if group else f"/api/{version}")
+
+    def _type_of(self, kind: str):
+        for entry in self.scheme.recognized():
+            if entry.split(":", 1)[1] == kind:
+                return self.scheme.decode({"kind": kind,
+                                           "metadata": {}}).__class__
+        raise KeyError(kind)
+
+    def _url(self, kind: str, namespace: str = "", name: str = "",
+             query: str = "") -> str:
+        path = self._prefix(kind)
+        if namespace:
+            path += f"/namespaces/{namespace}"
+        path += f"/{resource_of(kind)}"
+        if name:
+            path += f"/{name}"
+        return self.base_url + path + (f"?{query}" if query else "")
+
+    def _request(self, method: str, url: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.user:
+            req.add_header("X-Remote-User", self.user)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # --- the ListerWatcher contract ----------------------------------------
+
+    def list(self, kind: str) -> Tuple[List[object], int]:
+        payload = self._request("GET", self._url(kind))
+        rv = int(payload.get("metadata", {}).get("resourceVersion", "0"))
+        objs = [self.scheme.decode(m) for m in payload.get("items", [])]
+        return objs, rv
+
+    def for_kind(self, kind: str) -> "_KindClient":
+        """A (list, watch) view of ONE kind — the shape Reflector expects.
+        In-process stores multiplex kinds on one watch; HTTP serves one
+        resource per stream, so the per-kind view bridges the two."""
+        return _KindClient(self, kind)
+
+    def watch_kind(self, kind: str, handler: Callable[[WatchEvent], None],
+                   since_rv: int = 0, timeout_seconds: float = 30):
+        stop = threading.Event()
+
+        def run():
+            url = self._url(
+                kind,
+                query=f"watch=true&resourceVersion={since_rv}"
+                      f"&timeoutSeconds={timeout_seconds}",
+            )
+            req = urllib.request.Request(url)
+            if self.user:
+                req.add_header("X-Remote-User", self.user)
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_seconds + 5) as resp:
+                    for raw in resp:
+                        if stop.is_set():
+                            break
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        ev = json.loads(line)
+                        obj = self.scheme.decode(ev["object"])
+                        rv = int(ev["object"].get("metadata", {})
+                                 .get("resourceVersion", "0"))
+                        handler(WatchEvent(ev["type"], kind, obj, rv))
+            except Exception:
+                if not stop.is_set():
+                    raise
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+
+        def unwatch():
+            stop.set()
+        return unwatch
+
+    # --- CRUD convenience ----------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str):
+        try:
+            return self.scheme.decode(
+                self._request("GET", self._url(kind, namespace, name)))
+        except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+            if e.code == 404:
+                return None
+            raise
+
+    def create(self, kind: str, obj) -> dict:
+        from ..api.serialize import to_manifest
+
+        ns = "" if kind in _CLUSTER_SCOPED else obj.metadata.namespace
+        return self._request("POST", self._url(kind, ns),
+                             to_manifest(obj, self.scheme))
+
+    def update(self, kind: str, obj) -> dict:
+        from ..api.serialize import to_manifest
+
+        ns = "" if kind in _CLUSTER_SCOPED else obj.metadata.namespace
+        return self._request("PUT", self._url(kind, ns, obj.metadata.name),
+                             to_manifest(obj, self.scheme))
+
+    def delete(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request("DELETE", self._url(kind, namespace, name))
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> dict:
+        url = (self.base_url + f"/api/v1/namespaces/{namespace}"
+               f"/pods/{name}/binding")
+        return self._request("POST", url, {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": name},
+            "target": {"kind": "Node", "name": node_name},
+        })
+
+
+class _KindClient:
+    """Reflector-compatible (list, watch) facade over one HTTP resource."""
+
+    CLUSTER_SCOPED = None  # filled below (Reflector reads the class attr)
+
+    def __init__(self, client: HTTPApiClient, kind: str):
+        self._client = client
+        self._kind = kind
+
+    def list(self, kind: str):
+        return self._client.list(kind)
+
+    def watch(self, handler, since_rv: int = 0):
+        return self._client.watch_kind(self._kind, handler, since_rv=since_rv)
+
+
+import urllib.error  # noqa: E402  (used in get())
+
+from ..sim.store import ObjectStore as _OS  # noqa: E402
+
+_CLUSTER_SCOPED = _OS.CLUSTER_SCOPED
+_KindClient.CLUSTER_SCOPED = _OS.CLUSTER_SCOPED

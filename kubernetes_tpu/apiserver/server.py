@@ -1,0 +1,431 @@
+"""HTTP API server over the object store.
+
+Reference surface: staging/src/k8s.io/apiserver (handlers/rest.go GET/LIST/
+POST/PUT/PATCH/DELETE + watch streaming) and pkg/registry/core/pod/rest
+(the pods/{name}/binding subresource).  The storage behind it is the
+resourceVersion'd ObjectStore (sim/store.py), so LIST+WATCH semantics —
+consistent snapshot rv, ordered events after it — come from the same code
+path the in-process clients use.
+
+Served paths:
+  /api/v1/{resource}[/{name}]                        (core, cluster-scoped)
+  /api/v1/namespaces/{ns}/{resource}[/{name}]        (core, namespaced)
+  /apis/{group}/{version}/...                        (named groups)
+  /api/v1/namespaces/{ns}/pods/{name}/binding        (POST, binding)
+  /healthz /readyz /api /apis                        (discovery + health)
+
+Query params: ``watch=true`` + ``resourceVersion`` stream JSON-lines watch
+events (chunked); ``labelSelector`` (equality terms) and ``fieldSelector``
+(``spec.nodeName``/``metadata.name``) filter lists, mirroring the selectors
+kubelets and controllers actually use.
+
+Authorization: a pluggable ``authorizer(user, verb, resource, namespace) ->
+bool`` — the RBAC-shaped decision point without the full policy object model.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api.scheme import Scheme, SchemeError, default_scheme
+from ..api.serialize import to_manifest
+from ..sim.store import ADDED, DELETED, MODIFIED, ObjectStore, QuotaExceeded
+
+
+def resource_of(kind: str) -> str:
+    """Kind → REST resource name (lowercase plural, apimachinery style)."""
+    low = kind.lower()
+    if low.endswith("ss"):  # StorageClass → storageclasses
+        return low + "es"
+    if low.endswith("s"):  # Endpoints → endpoints
+        return low
+    return low + "s"
+
+
+def _match_label_selector(param: str, obj) -> bool:
+    labels = getattr(obj.metadata, "labels", {}) or {}
+    for term in param.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            k, v = term.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in term:
+            k, v = term.split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:  # bare key: exists
+            if term not in labels:
+                return False
+    return True
+
+
+def _match_field_selector(param: str, obj) -> bool:
+    for term in param.split(","):
+        term = term.strip()
+        if not term or "=" not in term:
+            continue
+        k, v = term.split("=", 1)
+        k = k.strip().removeprefix("==")
+        if k == "metadata.name":
+            if obj.metadata.name != v:
+                return False
+        elif k == "metadata.namespace":
+            if getattr(obj.metadata, "namespace", "") != v:
+                return False
+        elif k == "spec.nodeName":
+            if getattr(obj.spec, "node_name", "") != v:
+                return False
+    return True
+
+
+class APIServer:
+    """Thread-per-connection HTTP front end for an ObjectStore."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        scheme: Optional[Scheme] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authorizer: Optional[Callable[[str, str, str, str], bool]] = None,
+    ):
+        self.store = store
+        self.scheme = scheme or default_scheme()
+        self.authorizer = authorizer
+        # resource name → kind, built from the scheme's served kinds
+        self.kinds_by_resource: Dict[str, str] = {}
+        for entry in self.scheme.recognized():
+            kind = entry.split(":", 1)[1]
+            self.kinds_by_resource[resource_of(kind)] = kind
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # --- path handling ------------------------------------------------------
+
+    def route(self, path: str) -> Optional[Tuple[str, str, str, str]]:
+        """path → (kind, namespace, name, subresource); '' for absent parts.
+
+        None for non-resource paths (health/discovery handled elsewhere)."""
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api":
+            if len(parts) < 3 or parts[1] != "v1":
+                return None
+            rest = parts[2:]
+        elif parts[0] == "apis":
+            if len(parts) < 4:
+                return None
+            rest = parts[3:]
+        else:
+            return None
+        ns = ""
+        if rest[0] == "namespaces" and len(rest) >= 3:
+            ns = rest[1]
+            rest = rest[2:]
+        elif rest[0] == "namespaces" and len(rest) == 2:
+            # /api/v1/namespaces/{name} — the Namespace object itself
+            return ("Namespace", "", rest[1], "")
+        elif rest[0] == "namespaces":
+            return ("Namespace", "", "", "")
+        kind = self.kinds_by_resource.get(rest[0])
+        if kind is None:
+            return None
+        name = rest[1] if len(rest) > 1 else ""
+        sub = rest[2] if len(rest) > 2 else ""
+        return (kind, ns, name, sub)
+
+
+def _make_handler(api: APIServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "kubernetes-tpu-apiserver"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        # --- plumbing -------------------------------------------------------
+
+        def _send_json(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _status_err(self, code: int, reason: str, message: str):
+            self._send_json(code, {
+                "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                "reason": reason, "message": message, "code": code,
+            })
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b"{}"
+            return json.loads(raw or b"{}")
+
+        def _authorized(self, verb: str, resource: str, ns: str) -> bool:
+            if api.authorizer is None:
+                return True
+            user = self.headers.get("X-Remote-User", "system:anonymous")
+            return api.authorizer(user, verb, resource, ns)
+
+        def _check(self, verb: str, kind: str, ns: str) -> bool:
+            if not self._authorized(verb, resource_of(kind), ns):
+                self._status_err(403, "Forbidden",
+                                 f"user cannot {verb} {resource_of(kind)}")
+                return False
+            return True
+
+        # --- verbs ----------------------------------------------------------
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            if url.path in ("/healthz", "/readyz", "/livez"):
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if url.path == "/api":
+                self._send_json(200, {"kind": "APIVersions",
+                                      "versions": ["v1"]})
+                return
+            if url.path == "/apis":
+                groups = sorted({e.split(":")[0] for e in
+                                 api.scheme.recognized() if "/" in e})
+                self._send_json(200, {"kind": "APIGroupList",
+                                      "groups": [{"name": g.split("/")[0]}
+                                                 for g in groups]})
+                return
+            r = api.route(url.path)
+            if r is None:
+                self._status_err(404, "NotFound", url.path)
+                return
+            kind, ns, name, _sub = r
+            if not self._check("watch" if "watch" in q else
+                               ("get" if name else "list"), kind, ns):
+                return
+            if name:
+                obj = api.store.get(kind, ns, name)
+                if obj is None:
+                    self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                    return
+                self._send_json(200, to_manifest(obj, api.scheme))
+                return
+            if q.get("watch", ["false"])[0] == "true":
+                self._watch(kind, ns, q)
+                return
+            objs, rv = api.store.list(kind)
+            sel = q.get("labelSelector", [None])[0]
+            fsel = q.get("fieldSelector", [None])[0]
+            items = []
+            for o in objs:
+                if ns and getattr(o.metadata, "namespace", "") != ns:
+                    continue
+                if sel and not _match_label_selector(sel, o):
+                    continue
+                if fsel and not _match_field_selector(fsel, o):
+                    continue
+                items.append(to_manifest(o, api.scheme))
+            self._send_json(200, {
+                "kind": f"{kind}List", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(rv)},
+                "items": items,
+            })
+
+        def _watch(self, kind: str, ns: str, q: dict):
+            """Chunked JSON-lines watch stream from a resourceVersion."""
+            since = int(q.get("resourceVersion", ["0"])[0] or 0)
+            timeout = float(q.get("timeoutSeconds", ["30"])[0])
+            events: "queue.Queue" = queue.Queue(maxsize=4096)
+
+            def on_event(ev):
+                if ev.kind != kind:
+                    return
+                if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
+                    return
+                try:
+                    events.put_nowait(ev)
+                except queue.Full:
+                    pass  # client too slow: it relists on gap detection
+
+            unwatch = api.store.watch(on_event, since_rv=since)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                deadline = time.monotonic() + timeout
+                while True:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        break
+                    try:
+                        ev = events.get(timeout=min(remain, 0.25))
+                    except queue.Empty:
+                        continue
+                    line = json.dumps({
+                        "type": ev.type,
+                        "object": to_manifest(ev.obj, api.scheme),
+                    }).encode() + b"\n"
+                    chunk = f"{len(line):X}\r\n".encode() + line + b"\r\n"
+                    try:
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError,
+                            socket.timeout):
+                        return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+            finally:
+                unwatch()
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            r = api.route(url.path)
+            if r is None:
+                self._status_err(404, "NotFound", url.path)
+                return
+            kind, ns, name, sub = r
+            if kind == "Pod" and name and sub == "binding":
+                if not self._check("create", "Pod", ns):
+                    return
+                body = self._body()
+                node = ((body.get("target") or {}).get("name")) or ""
+                if api.store.bind_pod(ns, name, node):
+                    self._send_json(201, {"kind": "Status",
+                                          "status": "Success"})
+                else:
+                    self._status_err(404, "NotFound", f"pod {ns}/{name}")
+                return
+            if not self._check("create", kind, ns):
+                return
+            try:
+                obj = api.scheme.decode(self._body())
+            except (SchemeError, ValueError) as e:
+                self._status_err(400, "BadRequest", str(e))
+                return
+            if ns:
+                obj.metadata.namespace = ns
+            try:
+                api.store.create(kind, obj)
+            except QuotaExceeded as e:
+                self._status_err(403, "Forbidden", str(e))
+                return
+            except ValueError as e:
+                self._status_err(409, "AlreadyExists", str(e))
+                return
+            self._send_json(201, to_manifest(obj, api.scheme))
+
+        def do_PUT(self):
+            url = urlparse(self.path)
+            r = api.route(url.path)
+            if r is None or not r[2]:
+                self._status_err(404, "NotFound", url.path)
+                return
+            kind, ns, name, _sub = r
+            if not self._check("update", kind, ns):
+                return
+            if api.store.get(kind, ns, name) is None:
+                self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                return
+            try:
+                obj = api.scheme.decode(self._body())
+            except (SchemeError, ValueError) as e:
+                self._status_err(400, "BadRequest", str(e))
+                return
+            obj.metadata.namespace = ns or obj.metadata.namespace
+            obj.metadata.name = name
+            api.store.update(kind, obj)
+            self._send_json(200, to_manifest(obj, api.scheme))
+
+        def do_PATCH(self):
+            url = urlparse(self.path)
+            r = api.route(url.path)
+            if r is None or not r[2]:
+                self._status_err(404, "NotFound", url.path)
+                return
+            kind, ns, name, _sub = r
+            if not self._check("patch", kind, ns):
+                return
+            cur = api.store.get(kind, ns, name)
+            if cur is None:
+                self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                return
+            merged = _merge(to_manifest(cur, api.scheme), self._body())
+            try:
+                obj = api.scheme.decode(merged)
+            except (SchemeError, ValueError) as e:
+                self._status_err(400, "BadRequest", str(e))
+                return
+            obj.metadata.uid = cur.metadata.uid
+            api.store.update(kind, obj)
+            self._send_json(200, to_manifest(obj, api.scheme))
+
+        def do_DELETE(self):
+            url = urlparse(self.path)
+            r = api.route(url.path)
+            if r is None or not r[2]:
+                self._status_err(404, "NotFound", url.path)
+                return
+            kind, ns, name, _sub = r
+            if not self._check("delete", kind, ns):
+                return
+            obj = api.store.delete(kind, ns, name)
+            if obj is None:
+                self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                return
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+
+    return Handler
+
+
+def _merge(base: dict, patch: dict) -> dict:
+    """RFC 7386 JSON merge patch."""
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
