@@ -31,7 +31,13 @@ import numpy as np
 from ..api import objects as v1
 from ..api.labels import match_label_selector
 from ..state.dictionary import MISSING, Dictionary
-from ..state.encoding import EFFECT_CODE, _PROTO_CODE, ClusterEncoder, EncodingCapacityError
+from ..state.encoding import (
+    EFFECT_CODE,
+    _PROTO_CODE,
+    ClusterEncoder,
+    EncodingCapacityError,
+    _pow2,
+)
 from ..state import selectors as sel
 from ..state.selectors import (
     CompiledLabelSelectors,
@@ -118,6 +124,16 @@ class PodBatch:
     # constraint row is invalid padding
     has_spread: bool = False
     has_affinity: bool = False
+    # pow-2 bound on compact domain indices across the batch's USED spread
+    # keys.  The encoder's global domain_cap covers EVERY registered topology
+    # key — one hostname-keyed pod anywhere (5k domains at 5k nodes) would
+    # make every zone-spread batch contract [C, N, 8192] one-hots when its
+    # own key has 3 domains.  Static (trace-time constant) → one compiled
+    # program variant per bucket.  None (the default for any batch built
+    # without the compiler's sizing pass) falls back to the global
+    # domain_cap in the plugin — a too-small bucket would silently merge
+    # domains past it.
+    tsc_domain_bucket: Optional[int] = None
 
     def __len__(self) -> int:
         return len(self.pods)
@@ -141,7 +157,8 @@ class PodBatch:
 from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 
 _reg(AffinityTermGroup)
-_reg(PodBatch, skip=("pods",), static=("has_spread", "has_affinity"))
+_reg(PodBatch, skip=("pods",),
+     static=("has_spread", "has_affinity", "tsc_domain_bucket"))
 
 
 class PodBatchCompiler:
@@ -377,6 +394,15 @@ class PodBatchCompiler:
             groups[gname] = self._compile_affinity_group(pods, b, gname)
         has_spread = bool(tsc_valid.any())
         has_affinity = any(bool(g.valid.any()) for g in groups.values())
+        # effective domain axis for THIS batch's spread keys (see the field
+        # comment): pow2 of the largest used key's live domain count, with
+        # headroom floor 8 so zone-churn (a 4th zone appearing) doesn't
+        # recompile.  MISSING-keyed rows (padding) contribute nothing.
+        d_needed = 1
+        for slot in np.unique(tsc_key[tsc_valid]):
+            if 0 <= slot < len(self.enc.topo_value_maps):
+                d_needed = max(d_needed, len(self.enc.topo_value_maps[slot]))
+        tsc_domain_bucket = _pow2(d_needed, 8)
 
         return PodBatch(
             pods=list(pods),
@@ -394,6 +420,7 @@ class PodBatchCompiler:
             tsc_when=tsc_when, tsc_min_domains=tsc_min_domains,
             tsc_selectors=tsc_selectors,
             has_spread=has_spread, has_affinity=has_affinity,
+            tsc_domain_bucket=tsc_domain_bucket,
             **groups,
         )
 

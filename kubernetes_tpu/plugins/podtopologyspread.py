@@ -76,7 +76,12 @@ class PodTopologySpreadPlugin(Plugin):
         # trace-time constant pytree aux)
         if not getattr(batch, "has_spread", True):
             return None
-        d = self.domain_cap
+        # batch-local domain axis (PodBatch.tsc_domain_bucket): the GLOBAL
+        # domain_cap covers every registered topo key — a hostname key at 5k
+        # nodes would make a zone-spread batch's every gather contract a
+        # [C, N, 8192] one-hot for 3 live domains (measured 2.4s/batch in
+        # the TopologySpreading suite's scan)
+        d = getattr(batch, "tsc_domain_bucket", None) or self.domain_cap
         b, c_cap = batch.tsc_valid.shape
         n = snap.num_nodes
 
@@ -161,7 +166,6 @@ class PodTopologySpreadPlugin(Plugin):
     def filter(self, batch, snap, dyn, aux: TSAux = None):
         if aux is None:
             return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
-        d = self.domain_cap
         # global min over present domains (criticalPaths); empty → +BIG (pass)
         min_match = jnp.min(
             jnp.where(aux.hard_present, aux.hard_counts, BIG), axis=-1
@@ -183,7 +187,7 @@ class PodTopologySpreadPlugin(Plugin):
         """Raw score; NaN marks ignored nodes (handled in normalize)."""
         if aux is None:
             return jnp.zeros((batch.valid.shape[0], snap.num_nodes))
-        d = self.domain_cap
+        d = aux.soft_counts.shape[-1] - 1
         # pairs present among feasible (mask) non-ignored nodes restrict counting
         if mask is None:
             mask = jnp.ones(aux.counted_soft.shape, bool)
@@ -251,7 +255,7 @@ class PodTopologySpreadPlugin(Plugin):
     def score_row(self, batch, snap, dyn, aux: TSAux, i, mask_row=None):
         if aux is None:
             return jnp.zeros(snap.num_nodes)
-        d = self.domain_cap
+        d = aux.soft_counts.shape[-1] - 1
         soft_valid = aux.soft_valid[i]  # [C]
         has_key = aux.has_key[i]  # [C, N]
         dom = aux.dom_val[i]
@@ -285,7 +289,6 @@ class PodTopologySpreadPlugin(Plugin):
         pending pod j's constraint selectors and the node is counted for j."""
         if aux is None:
             return None
-        d = self.domain_cap
         b, c_cap, _ = aux.dom_val.shape
         dom_at = aux.dom_val[:, :, node_row]  # [B, C]
         inc = (
@@ -310,7 +313,7 @@ class PodTopologySpreadPlugin(Plugin):
         touches the chain."""
         if aux is None:
             return None
-        d = self.domain_cap
+        d = aux.hard_counts.shape[-1] - 1
         n = snap.num_nodes
         placed = (prev.rows >= 0) & jnp.asarray(prev.valid)  # [B0]
         rows = jnp.clip(prev.rows, 0, n - 1)
@@ -341,7 +344,7 @@ class PodTopologySpreadPlugin(Plugin):
         folds into two einsums against the commit one-hot ``u`` [B, N]."""
         if aux is None:
             return None
-        d = self.domain_cap
+        d = aux.hard_counts.shape[-1] - 1
         # pending-pod j's table (b, c) gains at the domain of each committed
         # pod i's node, where i matches (b, c)'s selector and the node counts
         contrib = jnp.einsum(

@@ -1,6 +1,10 @@
 """Does returning the big TSAux outputs from the fused program cost tunnel
 time?  Chained timing of full-output vs node_row-only programs for a TSC
-batch at 5k nodes."""
+batch at 5k nodes.
+
+NOTE: outputs that stay device-resident cost nothing until fetched —
+variants must np.asarray every compared leaf (done below via device_get),
+not just block on computation, or the bench measures dispatch only."""
 import sys, time
 sys.path.insert(0, ".")
 import numpy as np
@@ -65,8 +69,10 @@ for variant in ("full", "no-aux", "minimal"):
     for _ in range(6):
         t0 = time.perf_counter()
         out = jt(batch, ds, upd, nom_rows, nom_req, prev, host_auxes, order)
-        leaves = jax.tree_util.tree_leaves(out)
-        jax.block_until_ready(leaves[0])
+        # fetch EVERY leaf: device-resident outputs cost nothing until
+        # transferred, so blocking on computation alone measures dispatch
+        # only, not the output-size difference this bench exists to compare
+        jax.device_get(out)
         ts.append(time.perf_counter() - t0)
         if variant == "full":
             ds = out[2]
