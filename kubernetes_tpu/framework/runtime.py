@@ -168,6 +168,13 @@ class BatchedFramework:
         scores = self.run_scores(batch, snap, dyn, auxes, mask)
         return mask, scores
 
+    def compute_packed(self, batch, snap, dyn, auxes):
+        """compute() as ONE f32[B, N]: -inf marks infeasible nodes.  A single
+        fetched array costs one device→host tunnel round; (mask, scores)
+        separately cost two (the extender round path's per-round fetch)."""
+        mask, scores = self.compute(batch, snap, dyn, auxes)
+        return jnp.where(mask, scores.astype(jnp.float32), -jnp.inf)
+
     @property
     def filter_names(self):
         """Names of plugins with a Filter, in plugin order (Diagnosis keys)."""

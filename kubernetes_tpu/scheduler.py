@@ -516,8 +516,9 @@ class TPUScheduler:
             "compute_row": jax.jit(fw.compute_row),
             # round-based extender path: one dense compute + one batched
             # state update per ROUND (was one compute_row device round per
-            # POD — ~100ms tunnel pacing × batch size)
-            "compute": jax.jit(fw.compute),
+            # POD — ~100ms tunnel pacing × batch size); the packed form
+            # fetches mask+scores in ONE tunnel round
+            "compute_packed": jax.jit(fw.compute_packed),
             "apply_commits": jax.jit(fw.apply_commits),
             # one device round per FAILING batch (not fused into every cycle:
             # its freed-resources einsum is ~200 TFLOP at 5k/16k shapes)
@@ -1046,9 +1047,9 @@ class TPUScheduler:
         rounds = 0
         while unresolved and rounds <= b:
             rounds += 1
-            mask_d, scores_d = jt["compute"](batch, dsnap, dyn, auxes)
-            mask = np.asarray(mask_d)
-            scores = np.asarray(scores_d)
+            packed = np.asarray(jt["compute_packed"](batch, dsnap, dyn, auxes))
+            mask = np.isfinite(packed)
+            scores = packed
             claimed: Set[int] = set()
             commit = np.zeros(b, dtype=bool)
             choice = np.zeros(b, dtype=np.int32)
@@ -1114,18 +1115,24 @@ class TPUScheduler:
                     m.scheduling_algorithm_duration.observe(algo_lat[i])
                     deferred_only = False
                     continue
-                names = [n for n in approved if row_of[n] not in claimed]
-                # ledger re-check: drop nodes the round's earlier accepts
+                # vectorized pick over the approved rows (the per-name
+                # python loops here were ~1s of a 256-pod round's 2s):
+                # ledger re-check drops nodes the round's earlier accepts
                 # already filled (resource dims only — node-local sets are
                 # safe under the one-commit-per-node rule)
-                names = [
-                    n for n in names
-                    if np.all(
-                        (req_pod[i] == 0)
-                        | (req_pod[i] <= alloc[row_of[n]] - requested[row_of[n]])
-                    )
-                ]
-                if not names:
+                rows = np.fromiter(
+                    (row_of[n] for n in approved), dtype=np.int64,
+                    count=len(approved),
+                )
+                ok = ~np.isin(rows, list(claimed)) if claimed else \
+                    np.ones(rows.shape, bool)
+                fits = np.all(
+                    (req_pod[i] == 0)
+                    | (req_pod[i] <= alloc[rows] - requested[rows]),
+                    axis=1,
+                )
+                ok &= fits
+                if not ok.any():
                     # nothing left this round; if other pods committed, the
                     # state changes — retry next round, else unschedulable
                     if claimed or still:
@@ -1135,12 +1142,14 @@ class TPUScheduler:
                         m.scheduling_algorithm_duration.observe(algo_lat[i])
                         deferred_only = False
                     continue
-                merged = {
-                    n: float(scores[i, row_of[n]]) + ranked.get(n, 0.0)
-                    for n in names
-                }
-                best = max(names, key=lambda n: merged[n])
-                row = row_of[best]
+                merged = scores[i, rows]
+                if ranked:
+                    merged = merged + np.fromiter(
+                        (ranked.get(n, 0.0) for n in approved),
+                        dtype=np.float64, count=len(approved),
+                    )
+                merged = np.where(ok, merged, -np.inf)
+                row = int(rows[int(np.argmax(merged))])
                 out[i] = row
                 commit[i] = True
                 choice[i] = row
