@@ -149,6 +149,56 @@ class HTTPApiClient:
         })
 
 
+class HTTPStoreFacade:
+    """ObjectStore-shaped facade over HTTPApiClient — the CRUD subset
+    kubectl and other store-driven callers use, so they run unchanged
+    against the HTTP apiserver (kubectl --server)."""
+
+    def __init__(self, client: HTTPApiClient):
+        self._client = client
+
+    @property
+    def CLUSTER_SCOPED(self):  # noqa: N802 — mirrors ObjectStore's attr
+        return _CLUSTER_SCOPED
+
+    def list(self, kind: str):
+        try:
+            return self._client.list(kind)
+        except KeyError:  # kind not served: the store returns empty, not 404
+            return [], 0
+
+    def get(self, kind: str, namespace: str, name: str):
+        if kind in _CLUSTER_SCOPED:
+            namespace = ""
+        return self._client.get(kind, namespace, name)
+
+    def create(self, kind: str, obj) -> int:
+        reply = self._client.create(kind, obj)
+        return int((reply.get("metadata") or {}).get("resourceVersion", "0"))
+
+    def update(self, kind: str, obj) -> int:
+        reply = self._client.update(kind, obj)
+        return int((reply.get("metadata") or {}).get("resourceVersion", "0"))
+
+    def delete(self, kind: str, namespace: str, name: str):
+        if kind in _CLUSTER_SCOPED:
+            namespace = ""
+        try:
+            # DELETE returns the deleted object's final state (one round
+            # trip, no get-then-delete TOCTOU window)
+            return self._client.scheme.decode(
+                self._client.delete(kind, namespace, name))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def watch(self, handler, since_rv: int = 0):
+        raise NotImplementedError(
+            "HTTP watch is per-resource: use HTTPApiClient.watch_kind / "
+            "for_kind (one stream per kind)")
+
+
 class _KindClient:
     """Reflector-compatible (list, watch) facade over one HTTP resource."""
 

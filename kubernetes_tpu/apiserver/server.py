@@ -413,7 +413,9 @@ def _make_handler(api: APIServer):
             if obj is None:
                 self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
                 return
-            self._send_json(200, {"kind": "Status", "status": "Success"})
+            # the deleted object's final state, as the reference apiserver
+            # returns it (clients needing only confirmation ignore the body)
+            self._send_json(200, to_manifest(obj, api.scheme))
 
     return Handler
 

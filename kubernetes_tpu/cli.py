@@ -183,6 +183,11 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     import argparse
 
     ap = argparse.ArgumentParser(prog="ktpu")
+    ap.add_argument(
+        "-s", "--server",
+        help="apiserver URL (kubectl --server): verbs run over HTTP "
+             "instead of an in-process store",
+    )
     sub = ap.add_subparsers(dest="verb", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
@@ -190,7 +195,13 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
     args = ap.parse_args(argv)
-    store = ObjectStore()
+    if args.server:
+        from .apiserver import HTTPApiClient
+        from .apiserver.client import HTTPStoreFacade
+
+        store = HTTPStoreFacade(HTTPApiClient(args.server))
+    else:
+        store = ObjectStore()
     k = Kubectl(store)
     if args.verb == "get":
         print(k.get(args.kind, args.namespace))

@@ -134,6 +134,10 @@ class PodBatch:
     # domain_cap in the plugin — a too-small bucket would silently merge
     # domains past it.
     tsc_domain_bucket: Optional[int] = None
+    # same bound over the batch's pod-(anti)affinity term keys — drives both
+    # the InterPodAffinity table width AND its planes-vs-tables choice
+    # (zone-affinity batches get [B,T,9] tables instead of [B,T,N] planes)
+    ipa_domain_bucket: Optional[int] = None
 
     def __len__(self) -> int:
         return len(self.pods)
@@ -158,7 +162,8 @@ from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 
 _reg(AffinityTermGroup)
 _reg(PodBatch, skip=("pods",),
-     static=("has_spread", "has_affinity", "tsc_domain_bucket"))
+     static=("has_spread", "has_affinity", "tsc_domain_bucket",
+             "ipa_domain_bucket"))
 
 
 class PodBatchCompiler:
@@ -398,11 +403,10 @@ class PodBatchCompiler:
         # comment): pow2 of the largest used key's live domain count, with
         # headroom floor 8 so zone-churn (a 4th zone appearing) doesn't
         # recompile.  MISSING-keyed rows (padding) contribute nothing.
-        d_needed = 1
-        for slot in np.unique(tsc_key[tsc_valid]):
-            if 0 <= slot < len(self.enc.topo_value_maps):
-                d_needed = max(d_needed, len(self.enc.topo_value_maps[slot]))
-        tsc_domain_bucket = _pow2(d_needed, 8)
+        tsc_domain_bucket = self._domain_bucket(tsc_key[tsc_valid])
+        ipa_domain_bucket = self._domain_bucket(
+            *(g.topo_key[g.valid] for g in groups.values())
+        )
 
         return PodBatch(
             pods=list(pods),
@@ -421,6 +425,7 @@ class PodBatchCompiler:
             tsc_selectors=tsc_selectors,
             has_spread=has_spread, has_affinity=has_affinity,
             tsc_domain_bucket=tsc_domain_bucket,
+            ipa_domain_bucket=ipa_domain_bucket,
             **groups,
         )
 
@@ -453,6 +458,17 @@ class PodBatchCompiler:
         if not names and not all_ns:
             names = {pod.namespace}
         return sorted(names), all_ns
+
+    def _domain_bucket(self, *slot_arrays) -> int:
+        """pow2 bound on the live domain counts of the topo-key slots named
+        by the given arrays, floor 8 (headroom so small-domain churn — a 4th
+        zone appearing — doesn't recompile).  See PodBatch.tsc_domain_bucket."""
+        d = 1
+        for arr in slot_arrays:
+            for slot in np.unique(arr):
+                if 0 <= slot < len(self.enc.topo_value_maps):
+                    d = max(d, len(self.enc.topo_value_maps[slot]))
+        return _pow2(d, 8)
 
     def _compile_affinity_group(
         self, pods: Sequence[v1.Pod], b: int, group: str

@@ -109,19 +109,28 @@ class InterPodAffinityPlugin(Plugin):
     name = "InterPodAffinity"
     dynamic = True
 
-    def _use_planes(self, snap) -> bool:
+    def _d(self, batch) -> int:
+        """Batch-local domain axis (PodBatch.ipa_domain_bucket): the global
+        domain_cap covers every registered topo key, so one hostname key
+        would size a zone-affinity batch's tables (and flip it to planes)
+        for 5k domains when its own keys have 3."""
+        return getattr(batch, "ipa_domain_bucket", None) or self.domain_cap
+
+    def _use_planes(self, batch, snap) -> bool:
         """Static (trace-time) representation choice for the count state:
         per-node PLANES [B,T,N] when domains are dense (hostname topology,
         D ≈ N — the per-step table gathers would be O(N²)); per-domain
         TABLES [B,T,D+1] when D ≪ N (zone/rack topologies — carrying and
         rewriting [B,T,N] planes per scan step would cost ~N/D more than
-        the tables they replace).  domain_cap and num_nodes are both static
+        the tables they replace).  The bucket and num_nodes are both static
         shapes, so each regime compiles its own program."""
-        return self.domain_cap * 4 >= snap.num_nodes
+        return self._d(batch) * 4 >= snap.num_nodes
 
     def _read_cnt(self, snap, cnt, dom):
-        """cnt state → per-node counts [..., N] under either representation."""
-        if self._use_planes(snap):
+        """cnt state → per-node counts [..., N] under either representation
+        (planes iff the count axis IS the node axis; the table axis d+1 is
+        odd, the node tier is a power of two, so the shapes never alias)."""
+        if cnt.shape[-1] == dom.shape[-1]:
             return cnt
         return domain_gather(cnt, dom)
 
@@ -244,9 +253,8 @@ class InterPodAffinityPlugin(Plugin):
 
     # --- device prepare -------------------------------------------------------
 
-    def _group_arrays(self, group, snap):
+    def _group_arrays(self, group, snap, d):
         """dom [B, T, N] with trash slot, plus validity."""
-        d = self.domain_cap
         key = jnp.clip(group.topo_key, 0, snap.node_topo.shape[1] - 1)
         dom = jnp.transpose(snap.node_topo[:, key], (1, 2, 0))  # [B, T, N]
         has = (dom != MISSING) & jnp.asarray(group.valid)[:, :, None]
@@ -262,12 +270,11 @@ class InterPodAffinityPlugin(Plugin):
         )
         return m & ns_ok & jnp.asarray(group.valid)[:, :, None]
 
-    def _counts(self, match, dom, pod_node, pod_valid):
+    def _counts(self, match, dom, pod_node, pod_valid, d):
         """Per-term matches of scheduled pods → domain tables, as two
         contractions: matches×(pod→node one-hot) gives per-node counts, then
         a domain scatter-add folds nodes into domains (both MXU-friendly —
         the per-(pod,term) gather this replaces serializes on TPU)."""
-        d = self.domain_cap
         b, t, _p = match.shape
         n = dom.shape[-1]
         prow = jnp.clip(pod_node, 0, n - 1)
@@ -285,16 +292,16 @@ class InterPodAffinityPlugin(Plugin):
         # plugin's O(N·D) domain programs are compiled out entirely
         if not getattr(batch, "has_affinity", True) and host_aux is None:
             return None
-        d = self.domain_cap
+        d = self._d(batch)
         b = batch.valid.shape[0]
         n = snap.num_nodes
         g_aff, g_anti = batch.req_affinity, batch.req_anti_affinity
         g_paff, g_panti = batch.pref_affinity, batch.pref_anti_affinity
 
-        dom_aff = self._group_arrays(g_aff, snap)
-        dom_anti = self._group_arrays(g_anti, snap)
-        dom_paff = self._group_arrays(g_paff, snap)
-        dom_panti = self._group_arrays(g_panti, snap)
+        dom_aff = self._group_arrays(g_aff, snap, d)
+        dom_anti = self._group_arrays(g_anti, snap, d)
+        dom_paff = self._group_arrays(g_paff, snap, d)
+        dom_panti = self._group_arrays(g_panti, snap, d)
 
         num = snap.numeric
         m_aff = self._match_vs(g_aff, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
@@ -312,12 +319,12 @@ class InterPodAffinityPlugin(Plugin):
             g_aff.valid
         )[:, :, None]
 
-        aff_counts = self._counts(m_aff_all, dom_aff, snap.pod_node, snap.pod_valid)
-        anti_counts = self._counts(m_anti, dom_anti, snap.pod_node, snap.pod_valid)
-        paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid)
-        panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid)
+        aff_counts = self._counts(m_aff_all, dom_aff, snap.pod_node, snap.pod_valid, d)
+        anti_counts = self._counts(m_anti, dom_anti, snap.pod_node, snap.pod_valid, d)
+        paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid, d)
+        panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid, d)
         aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
-        if self._use_planes(snap):
+        if self._use_planes(batch, snap):
             # tables → per-node planes, gathered ONCE here (IPAAux docstring)
             aff_cnt = domain_gather(aff_counts, dom_aff).astype(jnp.int32)
             anti_cnt = domain_gather(anti_counts, dom_anti).astype(jnp.int32)
@@ -375,7 +382,7 @@ class InterPodAffinityPlugin(Plugin):
     def filter(self, batch, snap, dyn, aux: IPAAux):
         if aux is None:
             return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
-        d = self.domain_cap
+        d = self._d(batch)
         g_aff_valid = jnp.asarray(batch.req_affinity.valid)  # [B, T1]
         g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
 
@@ -400,7 +407,7 @@ class InterPodAffinityPlugin(Plugin):
     def score(self, batch, snap, dyn, aux: IPAAux, mask=None):
         if aux is None:
             return jnp.zeros((batch.valid.shape[0], snap.num_nodes))
-        d = self.domain_cap
+        d = self._d(batch)
         w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
         c_paff = self._read_cnt(snap, aux.paff_cnt, aux.dom_paff)  # [B,T3,N]
@@ -429,7 +436,7 @@ class InterPodAffinityPlugin(Plugin):
     def filter_row(self, batch, snap, dyn, aux: IPAAux, i):
         if aux is None:
             return jnp.ones(snap.num_nodes, bool)
-        d = self.domain_cap
+        d = self._d(batch)
         aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
         anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
         cnt = self._read_cnt(snap, aux.aff_cnt[i], aux.dom_aff[i])  # [T1, N]
@@ -447,7 +454,7 @@ class InterPodAffinityPlugin(Plugin):
     def score_row(self, batch, snap, dyn, aux: IPAAux, i, mask_row=None):
         if aux is None:
             return jnp.zeros(snap.num_nodes)
-        d = self.domain_cap
+        d = self._d(batch)
         w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
         w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
         c_paff = self._read_cnt(snap, aux.paff_cnt[i], aux.dom_paff[i])
@@ -464,10 +471,10 @@ class InterPodAffinityPlugin(Plugin):
         if aux is None:
             return None
         """Pod i placed on node_row — the device analog of updateWithPod."""
-        d = self.domain_cap
+        d = self._d(batch)
         t1 = aux.dom_aff.shape[1]
 
-        use_planes = self._use_planes(snap)
+        use_planes = self._use_planes(batch, snap)
 
         def bump(cnt, dom, dom_at, inc):
             # inc[b,t] is already gated on (dom_at < d).  Planes: O(B·T·N)
@@ -541,9 +548,9 @@ class InterPodAffinityPlugin(Plugin):
         contribution in `update` is a commutative add/OR, so the whole round
         folds into einsum contractions against the commit one-hot ``u``
         [B, N] (placed pod i → its node)."""
-        d = self.domain_cap
+        d = self._d(batch)
 
-        use_planes = self._use_planes(snap)
+        use_planes = self._use_planes(batch, snap)
 
         def count_inc(cross, dom):
             """cross [B, T, B] (term (b,t) vs pending pod i) → (count-state

@@ -229,3 +229,29 @@ def test_quota_admission_over_http(server):
             f"{base}/api/v1/namespaces/default/pods", method="POST",
             data=json.dumps(p2).encode()))
     assert e.value.code == 403  # quota exceeded → Forbidden
+
+
+def test_kubectl_over_http(server):
+    """kubectl --server: the CLI's verbs run over the HTTP facade."""
+    from kubernetes_tpu.apiserver.client import HTTPStoreFacade
+    from kubernetes_tpu.cli import Kubectl
+
+    k = Kubectl(HTTPStoreFacade(HTTPApiClient(server.url)))
+    out = k.apply(
+        "apiVersion: v1\n"
+        "kind: Pod\n"
+        "metadata:\n"
+        "  name: web\n"
+        "  namespace: default\n"
+        "spec:\n"
+        "  containers:\n"
+        "  - name: c\n"
+        "    resources:\n"
+        "      requests:\n"
+        "        cpu: '1'\n"
+    )
+    assert out == ["pod/web created"]
+    assert server.store.get("Pod", "default", "web") is not None
+    assert "web" in k.get("Pod", "default")
+    assert k.delete("Pod", "default", "web") == "pod/web deleted"
+    assert server.store.get("Pod", "default", "web") is None
