@@ -111,3 +111,50 @@ def test_activate():
     q.add_unschedulable(info, q.scheduling_cycle())
     q.activate([pod])
     assert q.pop().pod.metadata.name == "p"
+
+
+def test_next_backoff_expiry_flushes_first():
+    """next_backoff_expiry applies pending moves + expiries before peeking,
+    so the scheduler's batch-formation hysteresis sees fresh state."""
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock)
+    q.add(make_pod().name("p").uid("p").obj())
+    info = q.pop()
+    q.add_unschedulable(info, q.scheduling_cycle())
+    # the DEBOUNCED move (recorded, not yet applied) must be visible
+    q.move_all_to_active_or_backoff(ev.WILDCARD_EVENT)
+    assert q.next_backoff_expiry() == clock.t + 1.0  # initial backoff 1s
+    clock.advance(1.5)
+    assert q.next_backoff_expiry() is None  # expired → moved to active
+    assert q.pending_count()[0] == 1
+
+
+def test_scheduler_backoff_wave_coalesces_into_one_batch():
+    """Batch-formation hysteresis (TPUScheduler.batch_wait): a retry wave
+    whose backoffs expire within the window fills ONE device batch instead
+    of trickling into fragmented cycles (the round-4 PreemptionBasic fix)."""
+
+    from kubernetes_tpu.scheduler import TPUScheduler
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.testutil import make_node
+
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=16, pod_initial_backoff=0.08,
+                         batch_wait=0.5)
+    # no nodes yet: the whole wave fails together and enters backoff
+    for i in range(16):
+        store.create("Pod", make_pod().name(f"w{i}").uid(f"w{i}")
+                     .req({"cpu": "1"}).obj())
+    s1 = sched.schedule_cycle()
+    assert s1.attempted == 16 and s1.scheduled == 0
+    # nodes appear; the NODE_ADD event moves the wave to the backoff queue
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+                     .obj())
+    # next cycle starts before the backoff expires: the hysteresis must wait
+    # out the wave and dispatch all 16 retries as ONE batch (without it this
+    # cycle pops only the few pods whose backoff happens to have expired)
+    s2 = sched.schedule_cycle()
+    assert s2.attempted == 16, f"wave fragmented: {s2.attempted} pods"
+    assert s2.scheduled == 16
