@@ -56,6 +56,13 @@ WHEN_SCHEDULE_ANYWAY = 1
 from ..state.units import pow2_round_up as _pow2
 
 
+# the four pod-(anti)affinity term groups, in PodBatch field order — the ONE
+# source for the compiler loop, the group_present default, and
+# InterPodAffinityPlugin._present
+AFFINITY_GROUPS = ("req_affinity", "req_anti_affinity",
+                   "pref_affinity", "pref_anti_affinity")
+
+
 @dataclass
 class AffinityTermGroup:
     """One group of pod-affinity terms for the whole batch ([B, T] padded).
@@ -138,6 +145,11 @@ class PodBatch:
     # the InterPodAffinity table width AND its planes-vs-tables choice
     # (zone-affinity batches get [B,T,9] tables instead of [B,T,N] planes)
     ipa_domain_bucket: Optional[int] = None
+    # which of the four (anti)affinity term groups have ANY valid term in
+    # this batch (static): InterPodAffinity compiles out the per-scan-step
+    # update work of empty groups — an anti-only batch skips the three
+    # other groups' [B,T,N] plane rewrites on every step
+    group_present: tuple = AFFINITY_GROUPS
 
     def __len__(self) -> int:
         return len(self.pods)
@@ -163,7 +175,7 @@ from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 _reg(AffinityTermGroup)
 _reg(PodBatch, skip=("pods",),
      static=("has_spread", "has_affinity", "tsc_domain_bucket",
-             "ipa_domain_bucket"))
+             "ipa_domain_bucket", "group_present"))
 
 
 class PodBatchCompiler:
@@ -395,10 +407,13 @@ class PodBatchCompiler:
         tsc_selectors = self._compile_ls("tsc_sel", tsc_sel_list)
 
         groups = {}
-        for gname in ("req_affinity", "req_anti_affinity", "pref_affinity", "pref_anti_affinity"):
+        for gname in AFFINITY_GROUPS:
             groups[gname] = self._compile_affinity_group(pods, b, gname)
         has_spread = bool(tsc_valid.any())
-        has_affinity = any(bool(g.valid.any()) for g in groups.values())
+        group_present = tuple(
+            name for name in AFFINITY_GROUPS if bool(groups[name].valid.any())
+        )
+        has_affinity = bool(group_present)  # derived: one source of truth
         # effective domain axis for THIS batch's spread keys (see the field
         # comment): pow2 of the largest used key's live domain count, with
         # headroom floor 8 so zone-churn (a 4th zone appearing) doesn't
@@ -426,6 +441,7 @@ class PodBatchCompiler:
             has_spread=has_spread, has_affinity=has_affinity,
             tsc_domain_bucket=tsc_domain_bucket,
             ipa_domain_bucket=ipa_domain_bucket,
+            group_present=group_present,
             **groups,
         )
 

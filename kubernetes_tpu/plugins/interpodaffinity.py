@@ -126,6 +126,14 @@ class InterPodAffinityPlugin(Plugin):
         shapes, so each regime compiles its own program."""
         return self._d(batch) * 4 >= snap.num_nodes
 
+    def _present(self, batch, name: str) -> bool:
+        """Static batch-content flag: does the batch have ANY valid term in
+        this group?  Empty groups compile out of the per-step update work
+        (PodBatch.group_present)."""
+        from ..framework.podbatch import AFFINITY_GROUPS
+
+        return name in getattr(batch, "group_present", AFFINITY_GROUPS)
+
     def _read_cnt(self, snap, cnt, dom):
         """cnt state → per-node counts [..., N] under either representation
         (planes iff the count axis IS the node axis; the table axis d+1 is
@@ -297,53 +305,75 @@ class InterPodAffinityPlugin(Plugin):
         n = snap.num_nodes
         g_aff, g_anti = batch.req_affinity, batch.req_anti_affinity
         g_paff, g_panti = batch.pref_affinity, batch.pref_anti_affinity
-
-        dom_aff = self._group_arrays(g_aff, snap, d)
-        dom_anti = self._group_arrays(g_anti, snap, d)
-        dom_paff = self._group_arrays(g_paff, snap, d)
-        dom_panti = self._group_arrays(g_panti, snap, d)
-
         num = snap.numeric
-        m_aff = self._match_vs(g_aff, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
-        m_anti = self._match_vs(g_anti, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
-        m_paff = self._match_vs(g_paff, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
-        m_panti = self._match_vs(g_panti, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns, num)
+        use_planes = self._use_planes(batch, snap)
 
-        # affinityCounts: pods matching ALL req-affinity terms, bumped per term
+        def group_state(group, name, match_builder):
+            """(dom, cnt, cross) for one term group — ABSENT groups compile
+            to cheap broadcast zeros/trash instead of the [B,T,P] selector
+            matrices and [B,T,P,N] count einsums (the dominant per-cycle
+            prepare cost for constraint-sparse batches)."""
+            t = group.valid.shape[1]
+            if not self._present(batch, name):
+                dom = jnp.full((b, t, n), d, jnp.int32)  # all-trash
+                cnt_w = n if use_planes else d + 1
+                cnt = jnp.zeros((b, t, cnt_w), jnp.int32)
+                cross = jnp.zeros((b, t, b), bool)
+                return dom, cnt, cross
+            dom = self._group_arrays(group, snap, d)
+            m = match_builder(
+                group, snap.pod_label_keys, snap.pod_label_vals, snap.pod_ns)
+            counts = self._counts(m, dom, snap.pod_node, snap.pod_valid, d)
+            cnt = (domain_gather(counts, dom).astype(jnp.int32)
+                   if use_planes else counts)
+            cross = self._match_vs(
+                group, batch.label_keys, batch.label_vals, batch.ns, num)
+            return dom, cnt, cross, counts
+
+        def plain_match(group, keys, vals, ns):
+            return self._match_vs(group, keys, vals, ns, num)
+
+        # req-affinity: affinityCounts count pods matching ALL terms
         has_terms = jnp.any(jnp.asarray(g_aff.valid), axis=1)  # [B]
-        all_match = (
-            jnp.all(m_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
-            & has_terms[:, None]
-        )  # [B, P]
-        m_aff_all = jnp.broadcast_to(all_match[:, None, :], m_aff.shape) & jnp.asarray(
-            g_aff.valid
-        )[:, :, None]
-
-        aff_counts = self._counts(m_aff_all, dom_aff, snap.pod_node, snap.pod_valid, d)
-        anti_counts = self._counts(m_anti, dom_anti, snap.pod_node, snap.pod_valid, d)
-        paff_counts = self._counts(m_paff, dom_paff, snap.pod_node, snap.pod_valid, d)
-        panti_counts = self._counts(m_panti, dom_panti, snap.pod_node, snap.pod_valid, d)
-        aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
-        if self._use_planes(batch, snap):
-            # tables → per-node planes, gathered ONCE here (IPAAux docstring)
-            aff_cnt = domain_gather(aff_counts, dom_aff).astype(jnp.int32)
-            anti_cnt = domain_gather(anti_counts, dom_anti).astype(jnp.int32)
-            paff_cnt = domain_gather(paff_counts, dom_paff).astype(jnp.int32)
-            panti_cnt = domain_gather(panti_counts, dom_panti).astype(jnp.int32)
+        if self._present(batch, "req_affinity"):
+            dom_aff = self._group_arrays(g_aff, snap, d)
+            m_aff = plain_match(g_aff, snap.pod_label_keys,
+                                snap.pod_label_vals, snap.pod_ns)
+            all_match = (
+                jnp.all(m_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
+                & has_terms[:, None]
+            )  # [B, P]
+            m_aff_all = jnp.broadcast_to(
+                all_match[:, None, :], m_aff.shape
+            ) & jnp.asarray(g_aff.valid)[:, :, None]
+            aff_counts = self._counts(
+                m_aff_all, dom_aff, snap.pod_node, snap.pod_valid, d)
+            aff_total = jnp.sum(aff_counts[..., :d], axis=(1, 2))  # [B]
+            aff_cnt = (domain_gather(aff_counts, dom_aff).astype(jnp.int32)
+                       if use_planes else aff_counts)
+            x_aff = self._match_vs(
+                g_aff, batch.label_keys, batch.label_vals, batch.ns, num)
+            x_aff_all = (
+                jnp.all(x_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
+                & has_terms[:, None]
+                & batch.valid[None, :]
+            )  # [B, B]
         else:
-            aff_cnt, anti_cnt = aff_counts, anti_counts
-            paff_cnt, panti_cnt = paff_counts, panti_counts
+            t1 = g_aff.valid.shape[1]
+            dom_aff = jnp.full((b, t1, n), d, jnp.int32)
+            aff_cnt = jnp.zeros(
+                (b, t1, n if use_planes else d + 1), jnp.int32)
+            aff_total = jnp.zeros((b,), jnp.int32)
+            x_aff = jnp.zeros((b, t1, b), bool)
+            x_aff_all = jnp.zeros((b, b), bool)
 
-        # cross tensors vs pending pods
-        x_aff = self._match_vs(g_aff, batch.label_keys, batch.label_vals, batch.ns, num)
-        x_anti = self._match_vs(g_anti, batch.label_keys, batch.label_vals, batch.ns, num)
-        x_paff = self._match_vs(g_paff, batch.label_keys, batch.label_vals, batch.ns, num)
-        x_panti = self._match_vs(g_panti, batch.label_keys, batch.label_vals, batch.ns, num)
-        x_aff_all = (
-            jnp.all(x_aff | ~jnp.asarray(g_aff.valid)[:, :, None], axis=1)
-            & has_terms[:, None]
-            & batch.valid[None, :]
-        )  # [B, B]
+        dom_anti, anti_cnt, x_anti, *_ = group_state(
+            g_anti, "req_anti_affinity", plain_match)
+        dom_paff, paff_cnt, x_paff, *_ = group_state(
+            g_paff, "pref_affinity", plain_match)
+        dom_panti, panti_cnt, x_panti, *_ = group_state(
+            g_panti, "pref_anti_affinity", plain_match)
+
         diag = jnp.arange(b)
         self_match_all = x_aff_all[diag, diag]
 
@@ -383,24 +413,30 @@ class InterPodAffinityPlugin(Plugin):
         if aux is None:
             return jnp.ones((batch.valid.shape[0], snap.num_nodes), bool)
         d = self._d(batch)
-        g_aff_valid = jnp.asarray(batch.req_affinity.valid)  # [B, T1]
-        g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
+        b, n = batch.valid.shape[0], snap.num_nodes
+        if self._present(batch, "req_affinity"):
+            g_aff_valid = jnp.asarray(batch.req_affinity.valid)  # [B, T1]
+            # incoming required affinity (satisfyPodAffinity :338-360)
+            cnt = self._read_cnt(snap, aux.aff_cnt, aux.dom_aff)  # [B, T1, N]
+            key_ok = aux.dom_aff < d
+            keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)
+            pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
+            first_pod = (aux.aff_total == 0) & aux.self_match_all  # [B]
+            aff_ok = keys_all & (pods_exist | first_pod[:, None])
+        else:
+            aff_ok = jnp.ones((b, n), bool)
 
-        # incoming required affinity (satisfyPodAffinity, filtering.go:338-360)
-        cnt = self._read_cnt(snap, aux.aff_cnt, aux.dom_aff)  # [B, T1, N]
-        key_ok = aux.dom_aff < d
-        keys_all = jnp.all(~g_aff_valid[:, :, None] | key_ok, axis=1)  # [B, N]
-        pods_exist = jnp.all(~g_aff_valid[:, :, None] | (cnt > 0), axis=1)
-        first_pod = (aux.aff_total == 0) & aux.self_match_all  # [B]
-        aff_ok = keys_all & (pods_exist | first_pod[:, None])
+        if self._present(batch, "req_anti_affinity"):
+            g_anti_valid = jnp.asarray(batch.req_anti_affinity.valid)
+            # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
+            acnt = self._read_cnt(snap, aux.anti_cnt, aux.dom_anti)
+            anti_bad = jnp.any(
+                g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0),
+                axis=1,
+            )
+            aff_ok = aff_ok & ~anti_bad
 
-        # incoming required anti-affinity (satisfyPodAntiAffinity :323-335)
-        acnt = self._read_cnt(snap, aux.anti_cnt, aux.dom_anti)
-        anti_bad = jnp.any(
-            g_anti_valid[:, :, None] & (aux.dom_anti < d) & (acnt > 0), axis=1
-        )
-
-        return aff_ok & ~anti_bad & ~aux.exist_anti_block & ~aux.block_dyn
+        return aff_ok & ~aux.exist_anti_block & ~aux.block_dyn
 
     # --- score ----------------------------------------------------------------
 
@@ -408,14 +444,19 @@ class InterPodAffinityPlugin(Plugin):
         if aux is None:
             return jnp.zeros((batch.valid.shape[0], snap.num_nodes))
         d = self._d(batch)
-        w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
-        w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
-        c_paff = self._read_cnt(snap, aux.paff_cnt, aux.dom_paff)  # [B,T3,N]
-        c_panti = self._read_cnt(snap, aux.panti_cnt, aux.dom_panti)
-        own = (
-            jnp.sum(jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0), axis=1)
-            - jnp.sum(jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0), axis=1)
-        )
+        own = 0.0
+        if self._present(batch, "pref_affinity"):
+            w_paff = jnp.asarray(batch.pref_affinity.weight)  # [B, T3]
+            c_paff = self._read_cnt(snap, aux.paff_cnt, aux.dom_paff)
+            own = own + jnp.sum(
+                jnp.where(aux.dom_paff < d, c_paff * w_paff[:, :, None], 0.0),
+                axis=1)
+        if self._present(batch, "pref_anti_affinity"):
+            w_panti = jnp.asarray(batch.pref_anti_affinity.weight)
+            c_panti = self._read_cnt(snap, aux.panti_cnt, aux.dom_panti)
+            own = own - jnp.sum(
+                jnp.where(aux.dom_panti < d, c_panti * w_panti[:, :, None], 0.0),
+                axis=1)
         return own + aux.score_static + aux.score_dyn
 
     def normalize(self, scores, mask):
@@ -437,32 +478,43 @@ class InterPodAffinityPlugin(Plugin):
         if aux is None:
             return jnp.ones(snap.num_nodes, bool)
         d = self._d(batch)
-        aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
-        anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
-        cnt = self._read_cnt(snap, aux.aff_cnt[i], aux.dom_aff[i])  # [T1, N]
-        key_ok = aux.dom_aff[i] < d
-        keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
-        pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
-        first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
-        aff_ok = keys_all & (pods_exist | first_pod)
-        acnt = self._read_cnt(snap, aux.anti_cnt[i], aux.dom_anti[i])
-        anti_bad = jnp.any(
-            anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0), axis=0
-        )
-        return aff_ok & ~anti_bad & ~aux.exist_anti_block[i] & ~aux.block_dyn[i]
+        if self._present(batch, "req_affinity"):
+            aff_valid = jnp.asarray(batch.req_affinity.valid)[i]  # [T1]
+            cnt = self._read_cnt(snap, aux.aff_cnt[i], aux.dom_aff[i])
+            key_ok = aux.dom_aff[i] < d
+            keys_all = jnp.all(~aff_valid[:, None] | key_ok, axis=0)  # [N]
+            pods_exist = jnp.all(~aff_valid[:, None] | (cnt > 0), axis=0)
+            first_pod = (aux.aff_total[i] == 0) & aux.self_match_all[i]
+            aff_ok = keys_all & (pods_exist | first_pod)
+        else:
+            aff_ok = jnp.ones(snap.num_nodes, bool)
+        if self._present(batch, "req_anti_affinity"):
+            anti_valid = jnp.asarray(batch.req_anti_affinity.valid)[i]
+            acnt = self._read_cnt(snap, aux.anti_cnt[i], aux.dom_anti[i])
+            anti_bad = jnp.any(
+                anti_valid[:, None] & (aux.dom_anti[i] < d) & (acnt > 0),
+                axis=0,
+            )
+            aff_ok = aff_ok & ~anti_bad
+        return aff_ok & ~aux.exist_anti_block[i] & ~aux.block_dyn[i]
 
     def score_row(self, batch, snap, dyn, aux: IPAAux, i, mask_row=None):
         if aux is None:
             return jnp.zeros(snap.num_nodes)
         d = self._d(batch)
-        w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
-        w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
-        c_paff = self._read_cnt(snap, aux.paff_cnt[i], aux.dom_paff[i])
-        c_panti = self._read_cnt(snap, aux.panti_cnt[i], aux.dom_panti[i])
-        own = (
-            jnp.sum(jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0), axis=0)
-            - jnp.sum(jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0), axis=0)
-        )
+        own = 0.0
+        if self._present(batch, "pref_affinity"):
+            w_paff = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
+            c_paff = self._read_cnt(snap, aux.paff_cnt[i], aux.dom_paff[i])
+            own = own + jnp.sum(
+                jnp.where(aux.dom_paff[i] < d, c_paff * w_paff[:, None], 0.0),
+                axis=0)
+        if self._present(batch, "pref_anti_affinity"):
+            w_panti = jnp.asarray(batch.pref_anti_affinity.weight)[i]
+            c_panti = self._read_cnt(snap, aux.panti_cnt[i], aux.dom_panti[i])
+            own = own - jnp.sum(
+                jnp.where(aux.dom_panti[i] < d, c_panti * w_panti[:, None], 0.0),
+                axis=0)
         return own + aux.score_static[i] + aux.score_dyn[i]
 
     # --- in-scan update -------------------------------------------------------
@@ -486,40 +538,46 @@ class InterPodAffinityPlugin(Plugin):
             return point_scatter_add(cnt, dom_at, inc)
 
         # 1) pending pods' affinityCounts: j gains where i matches ALL j's terms
-        dom_at_aff = aux.dom_aff[:, :, node_row]  # [B, T1]
-        inc_aff = (
-            aux.aff_cross_all[:, i][:, None]
-            & jnp.asarray(batch.req_affinity.valid)
-            & (dom_at_aff < d)
-        ).astype(jnp.int32)
-        aff_cnt = bump(aux.aff_cnt, aux.dom_aff, dom_at_aff, inc_aff)
-        aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
+        aff_cnt, aff_total = aux.aff_cnt, aux.aff_total
+        if self._present(batch, "req_affinity"):
+            dom_at_aff = aux.dom_aff[:, :, node_row]  # [B, T1]
+            inc_aff = (
+                aux.aff_cross_all[:, i][:, None]
+                & jnp.asarray(batch.req_affinity.valid)
+                & (dom_at_aff < d)
+            ).astype(jnp.int32)
+            aff_cnt = bump(aux.aff_cnt, aux.dom_aff, dom_at_aff, inc_aff)
+            aff_total = aux.aff_total + jnp.sum(inc_aff, axis=1)
 
         # 2) pending pods' antiAffinityCounts (their own terms vs placed pod i)
-        dom_at_anti = aux.dom_anti[:, :, node_row]
-        inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
-        anti_cnt = bump(aux.anti_cnt, aux.dom_anti, dom_at_anti, inc_anti)
-
         # 3) placed pod i's own req-anti terms block domains for matching pods j
         #    (anti_cross[i] is [T2, B]: term t of pod i vs pending pod j)
-        same_anti = (aux.dom_anti[i] == aux.dom_anti[i, :, node_row][:, None]) & (
-            aux.dom_anti[i] < d
-        )  # [T2, N]
-        block_dyn = aux.block_dyn | jnp.any(
-            aux.anti_cross[i][:, :, None] & same_anti[:, None, :], axis=0
-        )  # [B, N]
+        anti_cnt, block_dyn = aux.anti_cnt, aux.block_dyn
+        if self._present(batch, "req_anti_affinity"):
+            dom_at_anti = aux.dom_anti[:, :, node_row]
+            inc_anti = (aux.anti_cross[:, :, i] & (dom_at_anti < d)).astype(jnp.int32)
+            anti_cnt = bump(aux.anti_cnt, aux.dom_anti, dom_at_anti, inc_anti)
+            same_anti = (aux.dom_anti[i] == aux.dom_anti[i, :, node_row][:, None]) & (
+                aux.dom_anti[i] < d
+            )  # [T2, N]
+            block_dyn = aux.block_dyn | jnp.any(
+                aux.anti_cross[i][:, :, None] & same_anti[:, None, :], axis=0
+            )  # [B, N]
 
         # 4) pending pods' own pref planes gain from placed pod i
-        dom_at_paff = aux.dom_paff[:, :, node_row]
-        paff_cnt = bump(
-            aux.paff_cnt, aux.dom_paff, dom_at_paff,
-            (aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32),
-        )
-        dom_at_panti = aux.dom_panti[:, :, node_row]
-        panti_cnt = bump(
-            aux.panti_cnt, aux.dom_panti, dom_at_panti,
-            (aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32),
-        )
+        paff_cnt, panti_cnt = aux.paff_cnt, aux.panti_cnt
+        if self._present(batch, "pref_affinity"):
+            dom_at_paff = aux.dom_paff[:, :, node_row]
+            paff_cnt = bump(
+                aux.paff_cnt, aux.dom_paff, dom_at_paff,
+                (aux.paff_cross[:, :, i] & (dom_at_paff < d)).astype(jnp.int32),
+            )
+        if self._present(batch, "pref_anti_affinity"):
+            dom_at_panti = aux.dom_panti[:, :, node_row]
+            panti_cnt = bump(
+                aux.panti_cnt, aux.dom_panti, dom_at_panti,
+                (aux.panti_cross[:, :, i] & (dom_at_panti < d)).astype(jnp.int32),
+            )
 
         # 5) placed pod i's own terms add symmetric score for matching pods j:
         #    req-aff × hardWeight, pref-aff +w, pref-anti −w over i's term domains
@@ -528,12 +586,16 @@ class InterPodAffinityPlugin(Plugin):
             same = ((dom_i == dom_i[:, node_row][:, None]) & (dom_i < d)).astype(jnp.float32)
             return jnp.einsum("tj,tn->jn", cross_i.astype(jnp.float32) * w_i[:, None], same)
 
-        w1 = jnp.full((t1,), self.hard_weight, jnp.float32)
-        score_dyn = aux.score_dyn + plane(aux.aff_term_cross[i], aux.dom_aff[i], w1)
-        w3 = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
-        score_dyn = score_dyn + plane(aux.paff_cross[i], aux.dom_paff[i], w3)
-        w4 = jnp.asarray(batch.pref_anti_affinity.weight)[i]
-        score_dyn = score_dyn - plane(aux.panti_cross[i], aux.dom_panti[i], w4)
+        score_dyn = aux.score_dyn
+        if self._present(batch, "req_affinity"):
+            w1 = jnp.full((t1,), self.hard_weight, jnp.float32)
+            score_dyn = score_dyn + plane(aux.aff_term_cross[i], aux.dom_aff[i], w1)
+        if self._present(batch, "pref_affinity"):
+            w3 = jnp.asarray(batch.pref_affinity.weight)[i]  # [T3]
+            score_dyn = score_dyn + plane(aux.paff_cross[i], aux.dom_paff[i], w3)
+        if self._present(batch, "pref_anti_affinity"):
+            w4 = jnp.asarray(batch.pref_anti_affinity.weight)[i]
+            score_dyn = score_dyn - plane(aux.panti_cross[i], aux.dom_panti[i], w4)
 
         return aux._replace(
             aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
@@ -564,24 +626,32 @@ class InterPodAffinityPlugin(Plugin):
             inc = domain_gather(tbl, dom) if use_planes else tbl
             return inc, jnp.sum(tbl, axis=(1, 2))
 
-        g_aff_valid = jnp.asarray(batch.req_affinity.valid)
-        aff_cross = (
-            aux.aff_cross_all[:, None, :] & g_aff_valid[:, :, None]
-        )  # [B, T1, B]
-        aff_inc, aff_mass = count_inc(aff_cross, aux.dom_aff)
-        # aff_total adds the TABLE mass (one bump per domain), not the plane
-        # mass (which would multiply by domain size)
-        aff_total = aux.aff_total + aff_mass.astype(jnp.int32)
-        aff_cnt = aux.aff_cnt + aff_inc.astype(jnp.int32)
-        anti_cnt = aux.anti_cnt + count_inc(
-            aux.anti_cross, aux.dom_anti
-        )[0].astype(jnp.int32)
-        paff_cnt = aux.paff_cnt + count_inc(
-            aux.paff_cross, aux.dom_paff
-        )[0].astype(jnp.int32)
-        panti_cnt = aux.panti_cnt + count_inc(
-            aux.panti_cross, aux.dom_panti
-        )[0].astype(jnp.int32)
+        aff_cnt, aff_total = aux.aff_cnt, aux.aff_total
+        if self._present(batch, "req_affinity"):
+            g_aff_valid = jnp.asarray(batch.req_affinity.valid)
+            aff_cross = (
+                aux.aff_cross_all[:, None, :] & g_aff_valid[:, :, None]
+            )  # [B, T1, B]
+            aff_inc, aff_mass = count_inc(aff_cross, aux.dom_aff)
+            # aff_total adds the TABLE mass (one bump per domain), not the
+            # plane mass (which would multiply by domain size)
+            aff_total = aux.aff_total + aff_mass.astype(jnp.int32)
+            aff_cnt = aux.aff_cnt + aff_inc.astype(jnp.int32)
+        anti_cnt = aux.anti_cnt
+        if self._present(batch, "req_anti_affinity"):
+            anti_cnt = aux.anti_cnt + count_inc(
+                aux.anti_cross, aux.dom_anti
+            )[0].astype(jnp.int32)
+        paff_cnt = aux.paff_cnt
+        if self._present(batch, "pref_affinity"):
+            paff_cnt = aux.paff_cnt + count_inc(
+                aux.paff_cross, aux.dom_paff
+            )[0].astype(jnp.int32)
+        panti_cnt = aux.panti_cnt
+        if self._present(batch, "pref_anti_affinity"):
+            panti_cnt = aux.panti_cnt + count_inc(
+                aux.panti_cross, aux.dom_panti
+            )[0].astype(jnp.int32)
 
         def same_domains(dom):
             """same[i, t, n] — node n shares committed pod i's domain under
@@ -594,16 +664,18 @@ class InterPodAffinityPlugin(Plugin):
             )
 
         # placed pods' own req-anti terms block matching pods over their domains
-        same_anti = same_domains(aux.dom_anti)
-        block_add = (
-            jnp.einsum(
-                "itj,itn->jn",
-                aux.anti_cross.astype(jnp.float32),
-                same_anti.astype(jnp.float32),
+        block_dyn = aux.block_dyn
+        if self._present(batch, "req_anti_affinity"):
+            same_anti = same_domains(aux.dom_anti)
+            block_add = (
+                jnp.einsum(
+                    "itj,itn->jn",
+                    aux.anti_cross.astype(jnp.float32),
+                    same_anti.astype(jnp.float32),
+                )
+                > 0.5
             )
-            > 0.5
-        )
-        block_dyn = aux.block_dyn | block_add
+            block_dyn = aux.block_dyn | block_add
 
         # symmetric score: placed pods' own terms credit matching pods
         def plane(cross, dom, w):
@@ -612,14 +684,18 @@ class InterPodAffinityPlugin(Plugin):
                 "itj,itn->jn", cross.astype(jnp.float32) * w, same
             )
 
-        w1 = jnp.full(aux.dom_aff.shape[:2], self.hard_weight, jnp.float32)[
-            :, :, None
-        ]
-        score_dyn = aux.score_dyn + plane(aux.aff_term_cross, aux.dom_aff, w1)
-        w3 = jnp.asarray(batch.pref_affinity.weight)[:, :, None]
-        score_dyn = score_dyn + plane(aux.paff_cross, aux.dom_paff, w3)
-        w4 = jnp.asarray(batch.pref_anti_affinity.weight)[:, :, None]
-        score_dyn = score_dyn - plane(aux.panti_cross, aux.dom_panti, w4)
+        score_dyn = aux.score_dyn
+        if self._present(batch, "req_affinity"):
+            w1 = jnp.full(aux.dom_aff.shape[:2], self.hard_weight, jnp.float32)[
+                :, :, None
+            ]
+            score_dyn = score_dyn + plane(aux.aff_term_cross, aux.dom_aff, w1)
+        if self._present(batch, "pref_affinity"):
+            w3 = jnp.asarray(batch.pref_affinity.weight)[:, :, None]
+            score_dyn = score_dyn + plane(aux.paff_cross, aux.dom_paff, w3)
+        if self._present(batch, "pref_anti_affinity"):
+            w4 = jnp.asarray(batch.pref_anti_affinity.weight)[:, :, None]
+            score_dyn = score_dyn - plane(aux.panti_cross, aux.dom_panti, w4)
 
         return aux._replace(
             aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
