@@ -239,7 +239,8 @@ def compute_pod_resource_request(pod) -> Resource:
         # (the hot case — preemption dry-runs call this hundreds of times per
         # attempt); fall back to the content fingerprint only on identity
         # miss, so in-place dict mutation still invalidates
-        if cached[0] == _resource_identity(pod) or cached[1] == _resource_fingerprint(pod):
+        if _identity_match(cached[0], _resource_identity(pod)) or \
+                cached[1] == _resource_fingerprint(pod):
             return cached[2]
     fp = _resource_fingerprint(pod)
     r = _compute_pod_resource_request(pod)
@@ -258,11 +259,26 @@ def _resource_identity(pod) -> tuple:
     that mutates a requests dict's VALUES in place must replace the dict (or
     delete ``pod._cached_resource_request``) — same contract as the
     reference's immutable-spec assumption, but enforced at dict granularity.
+
+    Holds the dict OBJECTS (matched via ``is``), not bare ``id()`` values: a
+    cached id of a freed dict could be reused by a new dict with different
+    content, serving a stale Resource; live references make reuse impossible.
     """
     return (
-        tuple(id(c.resources.requests) for c in pod.spec.containers),
-        tuple(id(c.resources.requests) for c in pod.spec.init_containers),
-        id(pod.spec.overhead),
+        tuple(c.resources.requests for c in pod.spec.containers),
+        tuple(c.resources.requests for c in pod.spec.init_containers),
+        pod.spec.overhead,
+    )
+
+
+def _identity_match(a: tuple, b: tuple) -> bool:
+    """Element-wise ``is`` over two _resource_identity tuples."""
+    ca, ia, oa = a
+    cb, ib, ob = b
+    return (
+        oa is ob
+        and len(ca) == len(cb) and all(x is y for x, y in zip(ca, cb))
+        and len(ia) == len(ib) and all(x is y for x, y in zip(ia, ib))
     )
 
 
@@ -301,7 +317,8 @@ def compute_pod_resource_request_non_zero(pod) -> Resource:
     """
     cached = getattr(pod, "_cached_resource_request_nz", None)
     if cached is not None:
-        if cached[0] == _resource_identity(pod) or cached[1] == _resource_fingerprint(pod):
+        if _identity_match(cached[0], _resource_identity(pod)) or \
+                cached[1] == _resource_fingerprint(pod):
             return cached[2]
     r = _compute_pod_resource_request_non_zero(pod)
     try:

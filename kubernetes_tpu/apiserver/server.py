@@ -36,7 +36,14 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.scheme import Scheme, SchemeError, default_scheme
 from ..api.serialize import to_manifest
-from ..sim.store import ADDED, DELETED, MODIFIED, ObjectStore, QuotaExceeded
+from ..sim.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ObjectStore,
+    QuotaExceeded,
+    StaleResourceVersion,
+)
 
 
 def resource_of(kind: str) -> str:
@@ -368,14 +375,40 @@ def _make_handler(api: APIServer):
                 self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
                 return
             try:
-                obj = api.scheme.decode(self._body())
+                body = self._body()
+                obj = api.scheme.decode(body)
             except (SchemeError, ValueError) as e:
                 self._status_err(400, "BadRequest", str(e))
                 return
             obj.metadata.namespace = ns or obj.metadata.namespace
             obj.metadata.name = name
-            api.store.update(kind, obj)
+            rv = ((body.get("metadata") or {}).get("resourceVersion"))
+            if not self._store_update_rv(kind, obj,
+                                         None if rv in (None, "") else rv):
+                return
             self._send_json(200, to_manifest(obj, api.scheme))
+
+        def _store_update_rv(self, kind, obj, rv) -> bool:
+            """Write through the store with ``rv`` (when not None) as an
+            atomic CAS precondition — a submitted rv that is no longer
+            current means the writer read a stale object: 409 Conflict, the
+            contract controllers' read-modify-write loops rely on (apiserver
+            Conflict; etcd3 store.go GuaranteedUpdate).  The check happens
+            INSIDE the store lock so concurrent writers with the same rv
+            cannot both pass."""
+            try:
+                api.store.update(kind, obj, expected_rv=rv)
+            except StaleResourceVersion as e:
+                self._status_err(
+                    409, "Conflict",
+                    f"operation cannot be fulfilled: the object has been "
+                    f"modified ({e})",
+                )
+                return False
+            except KeyError:
+                self._status_err(404, "NotFound", f"{kind}")
+                return False
+            return True
 
         def do_PATCH(self):
             url = urlparse(self.path)
@@ -386,19 +419,45 @@ def _make_handler(api: APIServer):
             kind, ns, name, _sub = r
             if not self._check("patch", kind, ns):
                 return
-            cur = api.store.get(kind, ns, name)
-            if cur is None:
-                self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+            patch = self._body()
+            client_rv = ((patch.get("metadata") or {}).get("resourceVersion"))
+            # The write CASes on the rv the merge was computed against, so a
+            # concurrent writer between read and write surfaces as a CAS
+            # miss, never a lost update.  A client-supplied rv that is stale
+            # → 409 (the client read a stale object); with no client rv the
+            # server re-reads and re-applies the merge, the reference
+            # apiserver's internal GuaranteedUpdate retry loop.
+            for _ in range(5):
+                cur = api.store.get(kind, ns, name)
+                if cur is None:
+                    self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                    return
+                merged = _merge(to_manifest(cur, api.scheme), patch)
+                try:
+                    obj = api.scheme.decode(merged)
+                except (SchemeError, ValueError) as e:
+                    self._status_err(400, "BadRequest", str(e))
+                    return
+                obj.metadata.uid = cur.metadata.uid
+                if client_rv not in (None, "") and \
+                        str(client_rv) != str(cur.metadata.resource_version):
+                    break  # stale client rv → Conflict below
+                try:
+                    api.store.update(kind, obj,
+                                     expected_rv=cur.metadata.resource_version)
+                except StaleResourceVersion:
+                    if client_rv not in (None, ""):
+                        break
+                    continue  # benign race: re-merge against the new state
+                except KeyError:
+                    self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                    return
+                self._send_json(200, to_manifest(obj, api.scheme))
                 return
-            merged = _merge(to_manifest(cur, api.scheme), self._body())
-            try:
-                obj = api.scheme.decode(merged)
-            except (SchemeError, ValueError) as e:
-                self._status_err(400, "BadRequest", str(e))
-                return
-            obj.metadata.uid = cur.metadata.uid
-            api.store.update(kind, obj)
-            self._send_json(200, to_manifest(obj, api.scheme))
+            self._status_err(
+                409, "Conflict",
+                "operation cannot be fulfilled: the object has been modified",
+            )
 
         def do_DELETE(self):
             url = urlparse(self.path)

@@ -36,7 +36,6 @@ from ..state.encoding import (
     _PROTO_CODE,
     ClusterEncoder,
     EncodingCapacityError,
-    _pow2,
 )
 from ..state import selectors as sel
 from ..state.selectors import (

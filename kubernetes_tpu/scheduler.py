@@ -193,6 +193,7 @@ class TPUScheduler:
         pod_initial_backoff: float = 1.0,
         pod_max_backoff: float = 10.0,
         batch_wait: float = 0.5,
+        serialize_extender_callouts: str = "auto",
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -263,6 +264,21 @@ class TPUScheduler:
         )
         self.preemption = Evaluator()
         self.extenders = list(extenders or [])
+        # DOCUMENTED DEVIATION from the reference's strictly sequential
+        # per-pod extender callouts (scheduleOne → findNodesThatPassExtenders,
+        # scheduler.go:1035): the round-based path fires all unresolved pods'
+        # filter/prioritize callouts concurrently at round start.  A
+        # STATEFUL extender tracking its own managed resources would see
+        # every request before any accept — it could approve placements the
+        # sequential cadence would have rejected (the host-side ledger
+        # re-check covers framework resource dims only, not extender-internal
+        # state).  "auto" serializes callouts for rounds where any interested
+        # extender declares managedResources (the exact case where internal
+        # state matters); "always"/"never" force either cadence.
+        if serialize_extender_callouts not in ("auto", "always", "never"):
+            raise ValueError(
+                f"unknown serialize_extender_callouts {serialize_extender_callouts!r}")
+        self.serialize_extender_callouts = serialize_extender_callouts
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
@@ -754,9 +770,18 @@ class TPUScheduler:
                 rec.fetched = None  # _complete falls back to a sync fetch
             rec.fetched_at = clk()
             # prefetch the diagnosis bits too (tiny [B, K] bool): a failing
-            # batch's bind phase then pays no extra device round trip
+            # batch's bind phase then pays no extra device round trip.  In
+            # packed mode the device array is the raw [2, B] i32 stack —
+            # unpack row 1 here; _bind_phase consumes diag_np as bool[B, K]
+            # and would otherwise misread the packed ints as diagnosis rows.
             try:
-                rec.diag_np = None if diag_dev is None else np.asarray(diag_dev)
+                if diag_dev is None:
+                    rec.diag_np = None
+                elif packed_mode:
+                    raw = np.asarray(diag_dev)
+                    rec.diag_np = _unpack_diag(raw[1], n_filters)
+                else:
+                    rec.diag_np = np.asarray(diag_dev)
             except Exception:
                 rec.diag_np = None
 
@@ -1064,9 +1089,19 @@ class TPUScheduler:
             # minus same-round claims, so protocol semantics are unchanged.
             def callout(i):
                 pod = pods[i]
-                row_names = [
-                    name_of[r] for r in np.where(mask[i])[0] if r in name_of
-                ]
+                feas = np.where(mask[i])[0]
+                # serialized cadence: the sent list reflects the round's
+                # earlier accepts (nodes the live ledger says no longer fit
+                # are dropped), approximating the reference's
+                # assumed-snapshot view between sequential scheduleOne calls
+                if serialize and claimed:
+                    live = np.all(
+                        (req_pod[i] == 0)
+                        | (req_pod[i] <= alloc[feas] - requested[feas]),
+                        axis=1,
+                    )
+                    feas = feas[live]
+                row_names = [name_of[r] for r in feas if r in name_of]
                 # managed-resources gating (extender.go:444-471): extenders
                 # not interested in this pod are skipped entirely
                 exts = [e for e in self.extenders if e.is_interested(pod)]
@@ -1090,11 +1125,21 @@ class TPUScheduler:
 
             from concurrent.futures import ThreadPoolExecutor
 
-            if len(unresolved) > 1:
+            # serialize_extender_callouts (see __init__): a stateful extender
+            # (managedResources) must see requests in commit order, AFTER
+            # earlier accepts — callouts then run lazily inside the walk
+            # below instead of concurrently at round start
+            mode = self.serialize_extender_callouts
+            serialize = mode == "always" or (
+                mode == "auto"
+                and any(getattr(e.cfg, "managed_resources", None)
+                        for e in self.extenders)
+            )
+            if serialize or len(unresolved) <= 1:
+                results = {}  # filled on demand, in commit order
+            else:
                 with ThreadPoolExecutor(max_workers=16) as pool:
                     results = dict(zip(unresolved, pool.map(callout, unresolved)))
-            else:
-                results = {i: callout(i) for i in unresolved}
 
             round_closed = False
             for i in unresolved:
@@ -1109,7 +1154,9 @@ class TPUScheduler:
                 if reads[i] and claimed:
                     still.append(i)
                     continue
-                approved, ranked, err = results[i]
+                approved, ranked, err = (
+                    results[i] if i in results else callout(i)
+                )
                 if err is not None:
                     algo_lat[i] = self.clock() - t0
                     m.scheduling_algorithm_duration.observe(algo_lat[i])

@@ -26,6 +26,10 @@ class QuotaExceeded(ValueError):
     """Pod rejected by ResourceQuota admission (403 Forbidden analog)."""
 
 
+class StaleResourceVersion(ValueError):
+    """CAS precondition failed in ObjectStore.update (409 Conflict analog)."""
+
+
 @dataclass
 class WatchEvent:
     type: str
@@ -90,11 +94,23 @@ class ObjectStore:
             self._emit(WatchEvent(ADDED, kind, obj, self._rv))
             return self._rv
 
-    def update(self, kind: str, obj) -> int:
+    def update(self, kind: str, obj, expected_rv=None) -> int:
+        """``expected_rv`` (when not None) is an atomic compare-and-swap
+        precondition checked under the store lock: the write applies only if
+        the stored object's resourceVersion still equals it, else
+        StaleResourceVersion — the etcd3 GuaranteedUpdate contract that makes
+        the apiserver's 409 actually prevent lost updates (a handler-level
+        check-then-act would race concurrent writers)."""
         with self._lock:
             key = self._key(kind, obj)
             if key not in self._objects:
                 raise KeyError(key)
+            if expected_rv is not None:
+                cur_rv = self._objects[key].metadata.resource_version
+                if str(expected_rv) != str(cur_rv):
+                    raise StaleResourceVersion(
+                        f"{key}: submitted resourceVersion {expected_rv}, "
+                        f"current {cur_rv}")
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[key] = obj

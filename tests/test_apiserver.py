@@ -138,6 +138,48 @@ def test_crud_and_binding_over_http(server):
     assert "v1" in _get(f"{base}/api")["versions"]
 
 
+def test_put_patch_stale_resource_version_conflict(server):
+    """A PUT/PATCH carrying a stale metadata.resourceVersion gets 409
+    Conflict (read-modify-write safety, etcd3 GuaranteedUpdate semantics);
+    omitting resourceVersion or sending the current one succeeds."""
+    base = server.url
+    pod = make_pod().name("rv").uid("rv1").namespace("default") \
+        .req({"cpu": "1"}).obj()
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/api/v1/namespaces/default/pods", method="POST",
+        data=json.dumps(to_manifest(pod, SCHEME)).encode()))
+    cur = _get(f"{base}/api/v1/namespaces/default/pods/rv")
+    rv = cur["metadata"]["resourceVersion"]
+
+    # PUT with the CURRENT rv succeeds (and bumps it)
+    cur["metadata"]["labels"] = {"gen": "1"}
+    out = json.loads(urllib.request.urlopen(urllib.request.Request(
+        f"{base}/api/v1/namespaces/default/pods/rv", method="PUT",
+        data=json.dumps(cur).encode())).read())
+    assert out["metadata"]["labels"]["gen"] == "1"
+
+    # PUT with the now-STALE rv → 409 Conflict
+    cur["metadata"]["resourceVersion"] = rv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/api/v1/namespaces/default/pods/rv", method="PUT",
+            data=json.dumps(cur).encode()))
+    assert e.value.code == 409
+    assert json.loads(e.value.read())["reason"] == "Conflict"
+
+    # PATCH with a stale rv → 409; without rv → merges fine
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/api/v1/namespaces/default/pods/rv", method="PATCH",
+            data=json.dumps({"metadata": {"resourceVersion": rv,
+                                          "labels": {"gen": "2"}}}).encode()))
+    assert e.value.code == 409
+    patched = json.loads(urllib.request.urlopen(urllib.request.Request(
+        f"{base}/api/v1/namespaces/default/pods/rv", method="PATCH",
+        data=json.dumps({"metadata": {"labels": {"gen": "2"}}}).encode())).read())
+    assert patched["metadata"]["labels"]["gen"] == "2"
+
+
 def test_watch_streams_events(server):
     base = server.url
     events = []
