@@ -20,9 +20,11 @@ absolute p50/p99 against the reference's published envelope instead.
 
 Env knobs: BENCH_SUITE/BENCH_SIZE pick any named suite from
 kubernetes_tpu/perf/workloads.py (default NorthStar/5000Nodes/10000Pods);
-BENCH_SCALE shrinks it; BENCH_ORACLE_SAMPLE sets oracle sample size;
-BENCH_ALL=1 additionally runs the reference's 500-node suites and writes
-perf-dashboard JSON to perf_dashboard.json.
+BENCH_SCALE shrinks it; BENCH_BATCH overrides the device batch size (main
+suite only, not the BENCH_ALL sweep — used by tools/batch_sweep.py);
+BENCH_ORACLE_SAMPLE sets oracle sample size; BENCH_ALL=1 additionally runs
+the reference's 500-node suites and writes perf-dashboard JSON to
+perf_dashboard.json.
 """
 
 import copy
@@ -43,7 +45,9 @@ def run_named(suite: str, size: str, scale: float):
     from kubernetes_tpu.perf.harness import run_workload
     from kubernetes_tpu.perf.workloads import build_workload
 
-    w = build_workload(suite, size, scale=scale)
+    batch = os.environ.get("BENCH_BATCH")
+    w = build_workload(suite, size, scale=scale,
+                       batch_size=max(1, int(batch)) if batch else None)
     t0 = time.perf_counter()
     items = run_workload(w)
     wall = time.perf_counter() - t0

@@ -139,6 +139,14 @@ class Suite:
     name: str
     build: Callable[[int, int, int], Workload]  # (initNodes, initPods, measurePods)
     sizes: Dict[str, tuple]  # workload name → (initNodes, initPods, measurePods)
+    # per-suite device batch override (None = the build's default).  The
+    # deep-queue NorthStar runs B=512: the tunnel's fixed per-cycle cost
+    # (~150ms chained dispatch + ~100ms fetch) dominates the ~10ms of device
+    # compute, so doubling the batch nearly doubles throughput — measured
+    # 1002 → 2024 pods/s (256 → 512) with attempt p99 DROPPING 0.94 → 0.62s
+    # (fewer cycles per backlog wave); 1024 pushed p99 to 0.90s for +13%
+    # throughput — past the knee (tools/profile_suite.py, round 5).
+    batch_size: Optional[int] = None
 
 
 def _basic(n, p, mp) -> Workload:
@@ -318,7 +326,8 @@ SUITES: Dict[str, Suite] = {
               {"500Nodes": (500, 500, 1000)}),
         # The north-star config (BASELINE.md): 5k nodes, 10k pending pods,
         # measured per-attempt
-        Suite("NorthStar", _basic, {"5000Nodes/10000Pods": (5000, 2000, 10000)}),
+        Suite("NorthStar", _basic, {"5000Nodes/10000Pods": (5000, 2000, 10000)},
+              batch_size=512),
         # The reference's historic density target (scheduler_perf README:
         # 30k pods on 1000 fake nodes; 3k pods on 100 nodes)
         Suite("Density", _basic,
@@ -328,7 +337,8 @@ SUITES: Dict[str, Suite] = {
 }
 
 
-def build_workload(suite: str, size: str, scale: float = 1.0) -> Workload:
+def build_workload(suite: str, size: str, scale: float = 1.0,
+                   batch_size: Optional[int] = None) -> Workload:
     s = SUITES[suite]
     n, p, mp = s.sizes[size]
     if scale != 1.0:
@@ -337,4 +347,13 @@ def build_workload(suite: str, size: str, scale: float = 1.0) -> Workload:
         mp = max(2, int(mp * scale))
     w = s.build(n, p, mp)
     w.name = f"{suite}/{size}"
+    if batch_size is not None:
+        w.batch_size = batch_size
+    elif s.batch_size is not None:
+        # cap the suite's batch at the scaled backlog: a scale=0.1 dev run
+        # must not pad every cycle (and its compiled programs) to the full
+        # 512 when only ~100 pods ever queue
+        from ..state.units import pow2_round_up
+
+        w.batch_size = min(s.batch_size, max(16, pow2_round_up(mp)))
     return w
