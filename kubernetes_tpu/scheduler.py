@@ -194,6 +194,7 @@ class TPUScheduler:
         pod_max_backoff: float = 10.0,
         batch_wait: float = 0.5,
         serialize_extender_callouts: str = "auto",
+        pipeline_depth: int = 3,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -208,6 +209,15 @@ class TPUScheduler:
         # (scheduler.go:623).  Default off: tests and interactive callers get
         # the synchronous contract (schedule_cycle returns with pods bound).
         self.pipeline = pipeline
+        # Deep-chain depth (pipeline=True only): how many batches may be in
+        # flight at once, the newest D-1 chained on device.  At depth 2 the
+        # completing batch's program is only one dispatch old and the fetch
+        # join waits a full tunnel round (~130ms/cycle measured at B=512);
+        # at depth 3 completions are two dispatches old and join for free.
+        # Capped at 3: the fused program carries two PrevBatch delta slots.
+        if not 1 <= pipeline_depth <= 3:
+            raise ValueError(f"pipeline_depth must be 1..3, got {pipeline_depth}")
+        self.pipeline_depth = pipeline_depth
         # per-profile EMA of the batch failure fraction — drives the
         # speculative candidate-mask dispatch (see _dispatch_batch)
         self._fail_ema: Dict[str, float] = {}
@@ -450,12 +460,13 @@ class TPUScheduler:
             )
 
         def apply_prev_delta(dyn, prev):
-            # Depth-2 pipeline: the still-in-flight previous batch's resource
+            # Deep pipeline: a still-in-flight previous batch's resource
             # consumption, applied from ITS device-resident decisions
             # (prev.rows = prev node_row, a future) without any host round
             # trip.  Rows <0 (unscheduled/padding) contribute nothing; a
             # shallow cycle passes all -1 so the same compiled program serves
-            # both.
+            # both.  Depth 3 passes TWO prev bundles (the two newest
+            # in-flight batches), each applied in turn.
             n = dyn.requested.shape[0]
             rows = jnp.clip(prev.rows, 0, n - 1)
             ok = (prev.rows >= 0)[:, None]
@@ -493,24 +504,28 @@ class TPUScheduler:
                 return jnp.stack([node_row.astype(jnp.int32), packed_bits])
             return bits  # >31 filter plugins: unpacked legacy shape
 
-        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, prev,
+        def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, prevs,
                          host_auxes, order, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-            dyn = apply_prev_delta(dyn, prev)
+            for prev in prevs:  # oldest→newest in-flight carry (≤2 bundles)
+                dyn = apply_prev_delta(dyn, prev)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-            auxes = fw.chain_prev(batch, dsnap, auxes, prev)
+            for prev in prevs:
+                auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
             return res, auxes, dsnap, dyn, diagnostics(
                 batch, dsnap, dyn, auxes, res.node_row)
 
-        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prev,
+        def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prevs,
                         host_auxes, order, coupling, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
-            dyn = apply_prev_delta(dyn, prev)
+            for prev in prevs:
+                dyn = apply_prev_delta(dyn, prev)
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
-            auxes = fw.chain_prev(batch, dsnap, auxes, prev)
+            for prev in prevs:
+                auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
             return res, auxes, dsnap, dyn, diagnostics(
                 batch, dsnap, dyn, auxes, res.node_row)
@@ -552,15 +567,17 @@ class TPUScheduler:
         the new batch computes on device.
 
         DEEP pipeline (pipeline=True, constraint-free batches): the next
-        batch dispatches BEFORE the in-flight batch's decisions are fetched —
-        its program consumes the in-flight batch's device-resident node_row
-        as a resource delta (apply_prev_delta), so the ~100-200ms device
-        round-trip of fetch + chained dispatch overlaps the next batch's
-        window entirely.  Depth is capped at 2; eligibility requires that
-        neither batch carries state the chain can't carry (pod (anti)
-        affinity, host ports, volumes, preemption capability — see
-        _pods_block_deep; topology-spread tables ARE chained via the
-        plugins' chain_prev hooks, and resources via apply_prev_delta).
+        batch dispatches BEFORE the in-flight batches' decisions are fetched
+        — its program consumes each still-in-flight batch's device-resident
+        node_row as a resource delta (apply_prev_delta), so the ~100-200ms
+        device round-trip of fetch + chained dispatch overlaps the next
+        batch's window entirely.  Depth is ``pipeline_depth`` (default 3: up
+        to two batches chained; completions are then two dispatches old and
+        their fetch join is free); eligibility requires that no chained
+        batch carries state the chain can't carry (pod (anti)affinity, host
+        ports, volumes, preemption capability — see _pods_block_deep;
+        topology-spread tables ARE chained via the plugins' chain_prev
+        hooks, and resources via apply_prev_delta).
 
         Synchronous mode (pipeline=False) dispatches and completes the same
         batch within the call — identical results, no overlap."""
@@ -579,30 +596,33 @@ class TPUScheduler:
             self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
         )
         next_interacts = _pods_block_deep([qi.pod for qi in infos]) if infos else True
-        deep = (
-            bool(infos)
-            and self.pipeline
-            and not self.extenders
-            and bool(inflight)
-            and not inflight[-1].interacts
-            and not next_interacts
-            # a node delete since the in-flight dispatch can free an encoder
-            # row that THIS dispatch's sync reuses — the in-flight delta rows
-            # would charge the wrong node; complete it first instead
-            and inflight[-1].node_del_gen == self._node_del_gen
-        )
-        # complete (fetch + assume) everything except — in deep mode — the
-        # newest in-flight batch, whose placements chain on device instead
+        # Deep chain tail: the newest run of in-flight batches this dispatch
+        # can chain on device (each must be constraint-free and predate no
+        # node delete — a freed encoder row that THIS dispatch's sync reuses
+        # would make the in-flight delta rows charge the wrong node).  Depth
+        # D keeps up to D-1; a depth-3 steady state completes batches TWO
+        # dispatches old, whose programs have long landed — the fetch join
+        # costs ~0 instead of a full tunnel round.
+        tail = 0
+        if bool(infos) and self.pipeline and not self.extenders \
+                and not next_interacts:
+            limit = self.pipeline_depth - 1
+            for fl in reversed(inflight):
+                if (tail >= limit or fl.interacts
+                        or fl.node_del_gen != self._node_del_gen):
+                    break
+                tail += 1
+        # complete (fetch + assume) everything except the chained tail
         completed: List[Tuple[_InFlight, np.ndarray]] = []
-        keep = 1 if deep else 0
+        keep = tail
         while len(inflight) > keep:
             fl = inflight.pop(0)
             completed.append((fl, self._complete(fl)))
 
         nxt = None
         if infos:
-            prev = inflight[-1] if deep else None
-            nxt = self._dispatch_batch(infos, prev=prev,
+            prevs = list(inflight[-tail:]) if tail else None
+            nxt = self._dispatch_batch(infos, prevs=prevs,
                                        interacts=next_interacts)
 
         for fl, rows in completed:  # binds overlap nxt's device window
@@ -641,13 +661,14 @@ class TPUScheduler:
             time.sleep(min(0.02, max(nxt - now, 0.001)))
 
     def _dispatch_batch(self, infos: List[QueuedPodInfo],
-                        prev: Optional[_InFlight] = None,
+                        prevs: Optional[List[_InFlight]] = None,
                         interacts: Optional[bool] = None) -> _InFlight:
         """Snapshot → compile → ONE device dispatch; decisions fetched
-        (blocking) at _complete.  ``prev`` (deep pipeline) is a still-in-
-        flight batch whose device-resident decisions feed this program as a
-        resource delta; ``interacts`` is the caller's already-computed
-        _pods_block_deep result for this batch (recomputed when absent)."""
+        (blocking) at _complete.  ``prevs`` (deep pipeline) are the still-in-
+        flight batches (oldest first, ≤2) whose device-resident decisions
+        feed this program as resource deltas; ``interacts`` is the caller's
+        already-computed _pods_block_deep result for this batch (recomputed
+        when absent)."""
         from .component_base.trace import Trace
 
         t0 = self.clock()
@@ -687,18 +708,21 @@ class TPUScheduler:
             return fl
         dsnap, upd = self.encoder.to_device_deferred()
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
-        delta = None
-        if prev is not None:
+        deltas = None
+        if prevs:
             from .framework.runtime import PrevBatch
 
-            pb = prev.batch
-            delta = PrevBatch(
-                rows=prev.node_row_dev, req=pb.request, nz=pb.non_zero,
-                valid=pb.valid, label_keys=pb.label_keys,
-                label_vals=pb.label_vals, ns=pb.ns,
-            )
+            deltas = [
+                PrevBatch(
+                    rows=p.node_row_dev, req=p.batch.request,
+                    nz=p.batch.non_zero, valid=p.batch.valid,
+                    label_keys=p.batch.label_keys,
+                    label_vals=p.batch.label_vals, ns=p.batch.ns,
+                )
+                for p in prevs
+            ]
         res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
-            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes, delta=delta
+            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes, deltas=deltas
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
@@ -976,22 +1000,29 @@ class TPUScheduler:
         m.pending_pods.set(u, ("unschedulable",))
 
     def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req,
-                        host_auxes, delta=None):
+                        host_auxes, deltas=None):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
         serializes into one commit per round there, and the row-sliced scan
         is cheaper per step than the dense per-round recompute.
 
-        ``delta`` is the depth-2 pipeline's in-flight-batch resource carry
-        (rows, req, nz) — see apply_prev_delta; None means a no-op delta.
+        ``deltas`` are the deep pipeline's in-flight-batch resource carries
+        (≤2 PrevBatch, oldest first) — see apply_prev_delta; the program
+        always receives exactly two slots, noop-padded, so every depth
+        shares one compiled executable.
 
         Returns (AssignResult, auxes, updated dsnap, dyn) from ONE fused
         dispatch (snapshot scatter + nominations + prepare + assign)."""
         from .framework.runtime import coupling_flags
 
-        if delta is None:
-            delta = self._noop_delta(batch)
+        # slot count is fixed per scheduler config (depth-1 chained carries;
+        # none in sync mode) so every cycle of an instance shares one
+        # compiled executable and shallow configs pay no noop passes
+        n_slots = self.pipeline_depth - 1 if self.pipeline else 0
+        noop = self._noop_delta(batch)
+        deltas = list(deltas or [])
+        delta = tuple((deltas + [noop] * n_slots)[:n_slots])
         # numpy, NOT jnp.arange: an eager jnp op is its own device program,
         # and each program execution on the tunnel pays a ~100ms pacing round
         order = np.arange(batch.size, dtype=np.int32)

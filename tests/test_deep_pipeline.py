@@ -1,9 +1,11 @@
-"""Depth-2 pipeline parity: deep-chained dispatch must produce the same
-bindings as the synchronous path (the delta chain reproduces assume exactly
-for resource-only batches), and constraint batches must force shallow mode.
+"""Deep-pipeline parity (depths 2 and 3): deep-chained dispatch must produce
+the same bindings as the synchronous path (the delta chain reproduces assume
+exactly for resource-only batches), and constraint batches must force
+shallow mode.
 """
 
 import numpy as np
+import pytest
 
 from kubernetes_tpu.scheduler import TPUScheduler, _pods_block_deep
 from kubernetes_tpu.sim.store import ObjectStore
@@ -34,34 +36,39 @@ def _bindings(store):
     return {p.metadata.name: p.spec.node_name for p in pods}
 
 
-def _run(pipeline):
+def _run(pipeline, depth=2):
     store = ObjectStore()
-    sched = TPUScheduler(store, batch_size=16, pipeline=pipeline)
+    sched = TPUScheduler(store, batch_size=16, pipeline=pipeline,
+                         pipeline_depth=depth)
     sched.presize(32, 96)
     _nodes(store, 24)
     _pods(store, 80)
     deep_dispatches = 0
+    max_chain = 0
     orig = TPUScheduler._dispatch_batch
 
-    def counting(self, infos, prev=None, **kw):
-        nonlocal deep_dispatches
-        if prev is not None:
+    def counting(self, infos, prevs=None, **kw):
+        nonlocal deep_dispatches, max_chain
+        if prevs:
             deep_dispatches += 1
-        return orig(self, infos, prev=prev, **kw)
+            max_chain = max(max_chain, len(prevs))
+        return orig(self, infos, prevs=prevs, **kw)
 
     TPUScheduler._dispatch_batch = counting
     try:
         sched.run_until_idle()
     finally:
         TPUScheduler._dispatch_batch = orig
-    return _bindings(store), deep_dispatches
+    return _bindings(store), deep_dispatches, max_chain
 
 
-def test_deep_pipeline_matches_sync():
-    sync_bindings, deep_sync = _run(pipeline=False)
-    deep_bindings, deep_count = _run(pipeline=True)
+@pytest.mark.parametrize("depth", [2, 3])
+def test_deep_pipeline_matches_sync(depth):
+    sync_bindings, deep_sync, _ = _run(pipeline=False)
+    deep_bindings, deep_count, max_chain = _run(pipeline=True, depth=depth)
     assert deep_sync == 0
     assert deep_count > 0, "deep path never exercised"
+    assert max_chain == depth - 1, "chain never reached configured depth"
     assert all(v for v in sync_bindings.values())
     assert deep_bindings == sync_bindings
 

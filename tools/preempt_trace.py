@@ -1,0 +1,95 @@
+"""Per-cycle trace of the PreemptionBasic suite: batch composition,
+compiles, preempt timings — finds where the 75 pods/s goes.
+
+Usage: python tools/preempt_trace.py [N] [INIT] [MEASURE] [BATCH]
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from kubernetes_tpu.perf.workloads import (
+    node_default, pod_high_priority, pod_low_priority,
+)
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.utils.compilemon import monitor
+
+monitor.install()
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+INIT = int(sys.argv[2]) if len(sys.argv) > 2 else 20000
+MEAS = int(sys.argv[3]) if len(sys.argv) > 3 else 5000
+BATCH = int(sys.argv[4]) if len(sys.argv) > 4 else 256
+
+store = ObjectStore()
+sched = TPUScheduler(store, batch_size=BATCH, pipeline=True)
+sched.presize(N, INIT + MEAS + BATCH)
+for i in range(N):
+    store.create("Node", node_default(i))
+for i in range(INIT):
+    store.create("Pod", pod_low_priority(i))
+
+t0 = time.perf_counter()
+sched.run_until_idle(max_cycles=10 * (INIT // BATCH + 1))
+print(f"init scheduled in {time.perf_counter()-t0:.1f}s; compiles so far: "
+      f"{monitor.snapshot()}")
+
+# preempt timing instrumentation
+from kubernetes_tpu.preemption import Evaluator
+
+for meth in ("preempt_plain", "plain_tables"):
+    orig = getattr(Evaluator, meth)
+
+    def make(orig=orig, meth=meth):
+        acc = {"n": 0, "s": 0.0}
+
+        def wrap(self, *a, **kw):
+            t = time.perf_counter()
+            out = orig(self, *a, **kw)
+            acc["n"] += 1
+            acc["s"] += time.perf_counter() - t
+            return out
+
+        wrap._acc = acc
+        return wrap
+
+    setattr(Evaluator, meth, make())
+
+for i in range(MEAS):
+    store.create("Pod", pod_high_priority(i))
+
+print("cycle  att sched unsch inflight  dur_ms  compiles  active/backoff/unsch")
+t0 = time.perf_counter()
+c0, s0 = monitor.snapshot()
+cyc = 0
+idle_wait = 0.0
+while True:
+    tc = time.perf_counter()
+    pre_c = monitor.snapshot()[0]
+    s = sched.schedule_cycle()
+    dur = time.perf_counter() - tc
+    dc = monitor.snapshot()[0] - pre_c
+    a, b, u = sched.queue.pending_count()
+    if s.attempted or dc or cyc % 10 == 0:
+        print(f"{cyc:5d} {s.attempted:4d} {s.scheduled:5d} {s.unschedulable:5d}"
+              f" {s.in_flight:8d} {1e3*dur:7.0f} {dc:9d}  {a}/{b}/{u}")
+    cyc += 1
+    if s.attempted == 0 and s.in_flight == 0:
+        if a == b == u == 0 or idle_wait > 20:
+            break
+        time.sleep(0.02)
+        idle_wait += 0.02
+    else:
+        idle_wait = 0.0
+wall = time.perf_counter() - t0
+c1, s1 = monitor.snapshot()
+pods, _ = store.list("Pod")
+bound = sum(1 for p in pods if p.spec.node_name and p.metadata.name.startswith("high"))
+print(f"\nbound {bound}/{MEAS} in {wall:.1f}s = {bound/wall:.1f} pods/s; "
+      f"in-window compiles {c1-c0} ({s1-s0:.1f}s)")
+for meth in ("preempt_plain", "plain_tables"):
+    acc = getattr(Evaluator, meth)._acc
+    print(f"{meth}: n={acc['n']} total={acc['s']:.2f}s")
