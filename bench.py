@@ -90,8 +90,9 @@ def main():
 
     from kubernetes_tpu.perf.workloads import SUITES
 
-    n_nodes, _, mp = SUITES[suite].sizes[size]
+    n_nodes, init_p, mp = SUITES[suite].sizes[size]
     n_nodes = max(4, int(n_nodes * scale))
+    init_p = max(0, int(init_p * scale))
     mp = max(2, int(mp * scale))
     o_ms = oracle_per_pod_ms(n_nodes, sample)
     mean_s = att["Average"]
@@ -99,17 +100,21 @@ def main():
 
     # Go-envelope baseline (kubernetes_tpu/perf/go_envelope.py): an
     # idealized vectorized model of the Go default scheduler's work profile
-    # — one pod at a time, adaptive sampling, default plugin math — whose
-    # measured times LOWER-BOUND the Go scheduler's (numpy SIMD ≥ 16
-    # goroutines of per-node calls; all fixed costs omitted).  Two variants:
+    # — one pod at a time, adaptive sampling, THE SUITE'S default-plugin
+    # math (spread/affinity topology maps, preemption dry-runs, churn,
+    # extender callouts — suite_envelope_config) — whose measured times
+    # LOWER-BOUND the Go scheduler's (numpy SIMD ≥ 16 goroutines of
+    # per-node calls; all fixed costs omitted).  Two variants:
     # sampled = Go's actual trade (scores 10% of nodes at 5k);
     # dense  = what one-at-a-time would cost at THIS repo's optimality
     # (every node scored for every pod).
     from kubernetes_tpu.perf.go_envelope import envelope_stats
 
     env_pods = min(mp, 2000)  # the envelope is steady-state; 2k pods suffice
-    env_sampled = envelope_stats(n_nodes, env_pods)
-    env_dense = envelope_stats(n_nodes, env_pods, sample=False)
+    env_sampled = envelope_stats(n_nodes, env_pods, suite=suite,
+                                 init_pods=init_p)
+    env_dense = envelope_stats(n_nodes, env_pods, sample=False, suite=suite,
+                               init_pods=init_p)
     p99_s = att["ExactPerc99"]
     vs_env_p99 = (env_sampled["attempt_ms"]["p99"] / 1e3) / p99_s if p99_s else 0.0
     env_thr = env_sampled["throughput_pods_per_s"]
@@ -154,8 +159,11 @@ def main():
                 "sequential PYTHON oracle (reference semantics, not the Go "
                 "scheduler) / device-path mean per-attempt; vs_go_envelope_* "
                 "compare against an idealized numpy model of the Go "
-                "scheduler's work profile that LOWER-BOUNDS its times (see "
-                "perf/go_envelope.py) — ratios <1 mean the envelope wins"
+                "scheduler's work profile carrying THIS SUITE's "
+                "default-plugin math (spread/affinity topology maps, "
+                "preemption dry-run+retry, churn, extender callouts — "
+                "perf/go_envelope.py suite_envelope_config) that "
+                "LOWER-BOUNDS its times — ratios <1 mean the envelope wins"
             ),
             "oracle_per_pod_ms": round(o_ms, 2),
             "go_envelope": {

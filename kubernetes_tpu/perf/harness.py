@@ -148,12 +148,17 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         )
                     if wi == 3:
                         # an unschedulable pod warms the FAILURE path: the
-                        # diagnosis fetch and the jitted preemption
+                        # diagnosis fetch AND the jitted preemption
                         # candidate-mask program (run per failing batch).
-                        # Default priority 0 → no pod ranks strictly lower,
-                        # so the warm preemption attempt finds no victims
-                        # and disturbs nothing.
-                        warm = warm.req({"cpu": "100000"})
+                        # Priority 1 makes it preemption-CAPABLE (the earlier
+                        # priority-0 warmup pods rank strictly lower, so
+                        # can_preempt holds and the ~200-TFLOP cand einsum
+                        # compiles HERE, not on the window's first failing
+                        # batch — measured 11.7s in-window at 5k/25k);
+                        # the 100000-cpu request can't fit any node even
+                        # with every victim evicted, so the warm preemption
+                        # nominates nothing and disturbs nothing.
+                        warm = warm.req({"cpu": "100000"}).priority(1)
                     warm = warm.obj()
                     warm_keys.append((warm.metadata.namespace, warm.metadata.name))
                     store.create("Pod", warm)

@@ -139,14 +139,16 @@ class Suite:
     name: str
     build: Callable[[int, int, int], Workload]  # (initNodes, initPods, measurePods)
     sizes: Dict[str, tuple]  # workload name → (initNodes, initPods, measurePods)
-    # per-suite device batch override (None = the build's default).  The
-    # deep-queue NorthStar runs B=512: the tunnel's fixed per-cycle cost
-    # (~150ms chained dispatch + ~100ms fetch) dominates the ~10ms of device
-    # compute, so doubling the batch nearly doubles throughput — measured
-    # 1002 → 2024 pods/s (256 → 512) with attempt p99 DROPPING 0.94 → 0.62s
-    # (fewer cycles per backlog wave); 1024 pushed p99 to 0.90s for +13%
-    # throughput — past the knee (tools/profile_suite.py, round 5).
-    batch_size: Optional[int] = None
+    # per-suite device batch override (None = the build's default): an int,
+    # or a dict keyed by size name for suites whose sizes want different
+    # operating points.  The deep-queue NorthStar runs B=512: the tunnel's
+    # fixed per-cycle cost (~150ms chained dispatch + ~100ms fetch)
+    # dominates the ~10ms of device compute, so doubling the batch nearly
+    # doubles throughput — measured 1002 → 2024 pods/s (256 → 512) with
+    # attempt p99 DROPPING 0.94 → 0.62s (fewer cycles per backlog wave);
+    # 1024 pushed p99 to 0.90s for +13% throughput — past the knee
+    # (tools/profile_suite.py, round 5).
+    batch_size: Optional[object] = None
 
 
 def _basic(n, p, mp) -> Workload:
@@ -316,7 +318,11 @@ SUITES: Dict[str, Suite] = {
         Suite("TopologySpreading", _topology,
               {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
         Suite("PreemptionBasic", _preemption,
-              {"500Nodes": (500, 2000, 500), "5000Nodes": (5000, 20000, 5000)}),
+              {"500Nodes": (500, 2000, 500), "5000Nodes": (5000, 20000, 5000)},
+              # 5k: every measured pod needs a fail→preempt→retry pair of
+              # cycles; amortizing the fixed tunnel cost over 512 attempts
+              # per cycle nearly halves the pair's wall share
+              batch_size={"5000Nodes": 512}),
         Suite("Unschedulable", _unschedulable,
               {"500Nodes/200InitPods": (500, 200, 1000),
                "5000Nodes/200InitPods": (5000, 200, 5000)}),
@@ -347,13 +353,16 @@ def build_workload(suite: str, size: str, scale: float = 1.0,
         mp = max(2, int(mp * scale))
     w = s.build(n, p, mp)
     w.name = f"{suite}/{size}"
+    suite_batch = s.batch_size
+    if isinstance(suite_batch, dict):
+        suite_batch = suite_batch.get(size)
     if batch_size is not None:
         w.batch_size = batch_size
-    elif s.batch_size is not None:
+    elif suite_batch is not None:
         # cap the suite's batch at the scaled backlog: a scale=0.1 dev run
         # must not pad every cycle (and its compiled programs) to the full
         # 512 when only ~100 pods ever queue
         from ..state.units import pow2_round_up
 
-        w.batch_size = min(s.batch_size, max(16, pow2_round_up(mp)))
+        w.batch_size = min(suite_batch, max(16, pow2_round_up(mp)))
     return w

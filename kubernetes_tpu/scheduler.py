@@ -104,12 +104,16 @@ def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     scheduled-pod arrays (which lack a still-in-flight batch), host-port
     sets and volume bindings live in host-side structures updated at
     assume/bind time.  Topology-spread tables ARE chained (chain_prev), so
-    spread pods stay deep.  Resource requests, node selectors/affinity, taints
-    and images chain exactly.  Preemption-CAPABLE pods (priority > 0, policy
-    not Never) also block: the in-flight batch's delta-charged resources are
-    not backed by pod-array entries, so a failing preemptor's dry-run could
-    never evict them — shallow mode makes the previous batch visible as
-    victims first."""
+    spread pods stay deep.  Resource requests, node selectors/affinity,
+    taints and images chain exactly.
+
+    Preemption-CAPABLE pods (priority > 0, policy not Never) also block:
+    beyond the victim-visibility problem (in-flight placements have no
+    snapshot pod entries for the dry-run to evict), a same-process A/B
+    (tools/preempt_ab.py, round 5) measured chaining preemptor waves at
+    231/87 pods/s vs 266/265 blocked — extra in-flight staleness makes
+    their preemption claims collide, refusing nominated fast binds into
+    backoff churn."""
     from .state.node_info import _pod_host_ports
 
     for p in pods:
@@ -174,6 +178,10 @@ class _InFlight:
     # the decision fetch — 2 extra full-priced tunnel rounds)
     cand_dev: object = None
     cand_np: object = None  # prefetched by the background thread
+    # priority-level table captured at dispatch for the segment-sum
+    # candidate mask (the lazy bind-phase call must see the SAME pod set
+    # the record's dsnap was built from, not a later sync's)
+    cand_levels: object = None
 
 
 class TPUScheduler:
@@ -195,6 +203,7 @@ class TPUScheduler:
         batch_wait: float = 0.5,
         serialize_extender_callouts: str = "auto",
         pipeline_depth: int = 3,
+        nominated_fast_bind: bool = True,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -289,6 +298,10 @@ class TPUScheduler:
             raise ValueError(
                 f"unknown serialize_extender_callouts {serialize_extender_callouts!r}")
         self.serialize_extender_callouts = serialize_extender_callouts
+        # bind a plain preemptor to its nominated node within the failing
+        # attempt (see _try_nominated_fast_bind); off = always nominate and
+        # requeue, the pre-round-5 cadence
+        self.nominated_fast_bind = nominated_fast_bind
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
@@ -298,6 +311,12 @@ class TPUScheduler:
         # dry-runs see them on their nominated node —
         # RunFilterPluginsWithNominatedPods analog)
         self._nominated: Dict[str, Tuple[str, np.ndarray, v1.Pod]] = {}
+        # uid → dispatch seq at which the pod was preemption-FAST-BOUND: its
+        # nomination entry stands in for the not-yet-snapshotted assume and
+        # is purged by the first dispatch whose update_snapshot sees the
+        # bind (seq strictly greater — see _bind_phase / _dispatch_batch)
+        self._fastbound_noms: Dict[str, int] = {}
+        self._dispatch_seq = 0
         from .client.events import EventRecorder
 
         # Scheduled / FailedScheduling events through the store-backed
@@ -530,14 +549,14 @@ class TPUScheduler:
             return res, auxes, dsnap, dyn, diagnostics(
                 batch, dsnap, dyn, auxes, res.node_row)
 
-        def cand_mask(batch, dsnap, dyn, auxes):
+        def cand_mask(batch, dsnap, dyn, auxes, levels):
             static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
             for pw, aux in zip(fw.plugins, auxes):
                 if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
                     pw.plugin, "filter"
                 ):
                     static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
-            return candidate_mask_device(batch, dsnap, dyn, static_ok)
+            return candidate_mask_device(batch, dsnap, dyn, static_ok, levels)
 
         return {
             "prepare": jax.jit(fw.prepare),
@@ -676,9 +695,19 @@ class TPUScheduler:
         # (utiltrace in schedulePod, scheduler.go:775-791)
         trace = Trace("Scheduling", pods=len(infos))
         cycle = self.queue.scheduling_cycle()
+        self._dispatch_seq += 1
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
+        # fast-bound nominations whose assume this refresh now carries: the
+        # reservation would double-count from here on — release it.  Marks
+        # from the bind phase that ran after the PREVIOUS dispatch carry
+        # that dispatch's seq; anything strictly older than this dispatch
+        # is covered by the snapshot just built.
+        for uid, seq in list(self._fastbound_noms.items()):
+            if seq < self._dispatch_seq:
+                self._fastbound_noms.pop(uid, None)
+                self._nominated.pop(uid, None)
         trace.step("Snapshot update")
         pods = [qi.pod for qi in infos]
         # fixed padding: every cycle compiles to ONE (batch_size, tier)
@@ -742,12 +771,16 @@ class TPUScheduler:
         # serializing inside it (2 tunnel rounds off every failing cycle).
         # A wrong guess costs one overlapped device program, no extra rounds
         # on the critical path.
-        if (
-            self._fail_ema.get(profile, 0.0) > 0.25
-            and any((p.spec.priority or 0) > 0
-                    and p.spec.preemption_policy != "Never" for p in pods)
-        ):
-            fl.cand_dev = jt["cand"](batch, dsnap_out, dyn_out, auxes)
+        can_preempt = any((p.spec.priority or 0) > 0
+                          and p.spec.preemption_policy != "Never" for p in pods)
+        if can_preempt:
+            # levels only matter to the candidate mask; a batch that can
+            # never preempt must not pay the O(P log P) np.unique on the
+            # dispatch critical path
+            fl.cand_levels = self._priority_levels()
+        if can_preempt and self._fail_ema.get(profile, 0.0) > 0.25:
+            fl.cand_dev = jt["cand"](batch, dsnap_out, dyn_out, auxes,
+                                     fl.cand_levels)
         # background fetch: the thread blocks in np.asarray until the
         # program lands, so by _complete time the decisions are host-side
         # and the cycle pays no fetch round trip
@@ -869,6 +902,7 @@ class TPUScheduler:
         batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
         diag_np = cand_np = min_sched_prio = None
         pf_ctx = None  # per-batch preemption context, built on first failure
+        fast_bound_uids: List[str] = []  # nominations to release at phase end
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
             row = int(node_row[i])
@@ -902,8 +936,7 @@ class TPUScheduler:
                     if self.store.get("Pod", qi.pod.namespace, qi.pod.metadata.name) is not None:
                         self.queue.add_unschedulable(qi, fl.cycle)
             else:
-                stats.unschedulable += 1
-                m.schedule_attempts.inc(("unschedulable",))
+                fast_bound = None  # node name when preemption fast-binds
                 if diag_np is None:
                     diag_np = fl.diag_np  # prefetched by the bg thread
                 if diag_np is None and fl.diag_dev is not None:
@@ -957,20 +990,46 @@ class TPUScheduler:
                         cand_np = np.asarray(fl.cand_dev)
                     if cand_np is None:
                         cand_np = np.asarray(
-                            self._candidate_mask(fl.profile, batch, dsnap, dyn, auxes)
+                            self._candidate_mask(
+                                fl.profile, batch, dsnap, dyn, auxes,
+                                levels=fl.cand_levels,
+                            )
                         )
-                    self._run_post_filter(
+                    fast_bound = self._run_post_filter(
                         fw, qi, batch, dsnap, dyn, auxes, i,
                         cand_row=cand_np[i], pf_ctx=pf_ctx,
                     )
-                self.queue.add_unschedulable(qi, fl.cycle)
-                # scheduler.go:386 (Warning/FailedScheduling with diagnosis)
-                failing = ", ".join(sorted(qi.unschedulable_plugins))
-                self.recorder.eventf(
-                    qi.pod, "Warning", "FailedScheduling",
-                    f"0/{len(self.snapshot.node_info_list)} nodes are "
-                    f"available: failed plugins: {failing}",
-                )
+                if fast_bound is not None:
+                    # preemption fast-bound the pod to its nominated node
+                    # within this attempt (_try_nominated_fast_bind); its
+                    # nomination entry stays live until the end of this bind
+                    # phase so later preemptors in the batch see the claim
+                    # through their nominated maps (the shared snapshot
+                    # tables predate the assume)
+                    fast_bound_uids.append(qi.pod.uid)
+                    stats.scheduled += 1
+                    m.schedule_attempts.inc(("scheduled",))
+                    m.pod_scheduling_attempts.observe(qi.attempts)
+                    m.pod_scheduling_duration.observe(
+                        self.clock() - qi.initial_attempt_timestamp
+                    )
+                    self.recorder.eventf(
+                        qi.pod, "Normal", "Scheduled",
+                        f"Successfully assigned {qi.pod.namespace}/"
+                        f"{qi.pod.metadata.name} to {fast_bound} "
+                        f"(nominated-node fast path after preemption)",
+                    )
+                else:
+                    stats.unschedulable += 1
+                    m.schedule_attempts.inc(("unschedulable",))
+                    self.queue.add_unschedulable(qi, fl.cycle)
+                    # scheduler.go:386 (Warning/FailedScheduling + diagnosis)
+                    failing = ", ".join(sorted(qi.unschedulable_plugins))
+                    self.recorder.eventf(
+                        qi.pod, "Warning", "FailedScheduling",
+                        f"0/{len(self.snapshot.node_info_list)} nodes are "
+                        f"available: failed plugins: {failing}",
+                    )
             # True per-attempt latency (scheduler_perf util.go:238-276): the
             # pod's decision is unavailable until its device program returns
             # (whole batch in the fused path, its own cycle in the extender
@@ -979,9 +1038,25 @@ class TPUScheduler:
             m.scheduling_attempt_duration.observe(
                 float(fl.algo_lat[i]) + (self.clock() - t_pod)
             )
+        # Fast-bound pods' nominations must OUTLIVE this bind phase: a later
+        # batch was already dispatched before it ran (pipeline), so that
+        # batch's bind-phase preemption tables come from a snapshot that
+        # predates these assumes — only the nominated map makes the claims
+        # visible there.  Mark them with the current dispatch sequence;
+        # _dispatch_batch purges marks older than its own update_snapshot
+        # (which then carries the binds), avoiding double-counting.
+        # Releasing here instead made follow-on preemptor waves evict
+        # victims on already-claimed nodes (measured: 338/392 of a tail
+        # batch re-failing into 10s backoffs).
+        for uid in fast_bound_uids:
+            if uid in self._nominated:
+                self._fastbound_noms[uid] = self._dispatch_seq
         stats.batch_seconds = self.clock() - fl.t0
         if stats.attempted:
-            frac = stats.unschedulable / stats.attempted
+            # the EMA drives the speculative candidate-mask dispatch, so it
+            # must count attempts that NEEDED preemption — fast-bound pods
+            # end up "scheduled" but consumed the mask all the same
+            frac = (stats.unschedulable + len(fast_bound_uids)) / stats.attempted
             prev_ema = self._fail_ema.get(fl.profile, 0.0)
             self._fail_ema[fl.profile] = 0.5 * prev_ema + 0.5 * frac
         if klog.V(2):
@@ -1352,11 +1427,27 @@ class TPUScheduler:
     # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
     _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
 
-    def _candidate_mask(self, profile, batch, dsnap, dyn, auxes):
+    def _priority_levels(self):
+        """Sorted unique scheduled-pod priorities, padded to the fixed
+        PRIORITY_LEVEL_CAP with i32-max, for the segment-sum candidate mask;
+        None routes to the dense-einsum fallback (>cap distinct levels)."""
+        from .preemption import PRIORITY_LEVEL_CAP
+
+        valid = np.asarray(self.encoder.pod_valid)
+        u = np.unique(np.asarray(self.encoder.pod_priority)[valid])
+        if u.size > PRIORITY_LEVEL_CAP:
+            return None
+        out = np.full(PRIORITY_LEVEL_CAP, np.iinfo(np.int32).max,
+                      dtype=np.int32)
+        out[: u.size] = u
+        return out
+
+    def _candidate_mask(self, profile, batch, dsnap, dyn, auxes, levels=None):
         """Preemption candidate mask for a whole batch — the profile's jitted
         program, ONE device round per failing batch (eager plugin.filter
         calls would each pay a ~100ms pacing round on the tunnel)."""
-        return self._jitted_by[profile]["cand"](batch, dsnap, dyn, auxes)
+        return self._jitted_by[profile]["cand"](batch, dsnap, dyn, auxes,
+                                                levels)
 
     def _run_post_filter(self, fw, qi: QueuedPodInfo, batch, dsnap, dyn, auxes,
                          i: int, cand_row, pf_ctx):
@@ -1365,6 +1456,10 @@ class TPUScheduler:
         ``cand_row`` bool[N] comes from the per-batch jitted candidate mask;
         ``pf_ctx`` is the batch-hoisted (PDB list, row→name map, row→name
         object ndarray).
+
+        Returns the node name when the preemptor was FAST-BOUND to its
+        nominated node within this attempt (_try_nominated_fast_bind), else
+        None (nominated-and-requeued, or no preemption happened).
         """
         pod = qi.pod
         if pod.spec.preemption_policy == "Never":
@@ -1390,9 +1485,9 @@ class TPUScheduler:
         except ExtenderError:
             # non-ignorable extender failure aborts this preemption attempt
             # (preemption.go callExtenders error path); pod retries later
-            return
+            return None
         if cand is None:
-            return
+            return None
         for victim in cand.victims:
             self.store.delete("Pod", victim.namespace, victim.metadata.name)
         m.preemption_victims.observe(len(cand.victims))
@@ -1401,6 +1496,93 @@ class TPUScheduler:
             cand.node_name, np.asarray(self.encoder.pod_request_units(pod)), pod
         )
         self.store.update("Pod", pod)
+        if self._try_nominated_fast_bind(fw, qi, cand):
+            return cand.node_name
+        return None
+
+    def _try_nominated_fast_bind(self, fw, qi: QueuedPodInfo, cand) -> bool:
+        """Bind a successful preemptor to its nominated node in the SAME
+        attempt — the reference's nominated-node fast path
+        (scheduler.go:926-935: a nominatedNodeName pod's retry evaluates
+        that node first and uses it without re-scoring) compressed to zero
+        queue round-trips, which is exact here because sim victims terminate
+        instantly at eviction (the reference requeues only to wait out
+        graceful termination).  Restricted to PLAIN preemptors with no
+        preemption-capable extender in play: for those the dry-run verified
+        the full filter suite (statics + resources; ports/volumes/spread/
+        affinity are structurally absent), and the live-cache re-check below
+        confirms nothing changed between dry-run and now.  All other
+        preemptors keep the nominate-and-requeue flow."""
+        from .api.resource import compute_pod_resource_request
+        from .oracle import (
+            fits_resources,
+            node_affinity_fits,
+            node_name_fits,
+            node_schedulable,
+            tolerates_all_hard_taints,
+        )
+        from .preemption import _is_plain_preemptor
+
+        if not self.nominated_fast_bind:
+            return False
+        pod = qi.pod
+        has_anti = bool(self.snapshot.have_pods_with_required_anti_affinity_list)
+        if not _is_plain_preemptor(pod, has_anti):
+            return False
+        if compute_pod_resource_request(pod).scalar_resources:
+            return False
+        if any(getattr(e, "supports_preemption", False) and e.is_interested(pod)
+               for e in self.extenders):
+            return False
+        # live cache view: the evictions above already flowed through the
+        # synchronous store watch into cache NodeInfos
+        info = self.cache._nodes.get(cand.node_name)
+        if info is None or info.node is None:
+            return False
+        node = info.node
+        if not (node_name_fits(pod, node) and node_schedulable(pod, node)
+                and node_affinity_fits(pod, node)
+                and tolerates_all_hard_taints(pod, node)
+                and fits_resources(pod, info)):
+            return False
+        # In-flight batches were dispatched against the PRE-eviction
+        # snapshot and may be placing pods into this node's then-free space
+        # right now — the live cache can't show those placements until
+        # their completes.  If any in-flight pod could fit that
+        # snapshot-view free space, only fast-bind when the preemptor fits
+        # entirely within the resources its evictions freed (leaving the
+        # contested free space untouched); otherwise nominate-and-requeue.
+        row = self.encoder.node_rows.get(cand.node_name)
+        if row is not None and self._inflight_q:
+            free_snap = (self.encoder.allocatable[row].astype(np.int64)
+                         - self.encoder.requested[row])
+            claimable = any(
+                bool(np.any(np.all(
+                    np.asarray(fl2.batch.request)[np.asarray(fl2.batch.valid)]
+                    <= free_snap[None, :], axis=1)))
+                for fl2 in self._inflight_q
+            )
+            if claimable:
+                req = compute_pod_resource_request(pod)
+                freed = np.zeros(4, dtype=np.int64)
+                for victim in cand.victims:
+                    vr = compute_pod_resource_request(victim)
+                    freed += (vr.milli_cpu, vr.memory,
+                              vr.ephemeral_storage, 1)
+                need = np.array(
+                    [req.milli_cpu, req.memory, req.ephemeral_storage, 1],
+                    dtype=np.int64,
+                )
+                if not bool(np.all(need <= freed)):
+                    return False
+        pod.status.nominated_node_name = None
+        self.cache.assume_pod(pod, cand.node_name)
+        if not self._run_reserve_and_bind(fw, pod, cand.node_name):
+            self.cache.forget_pod(pod)
+            pod.status.nominated_node_name = cand.node_name
+            return False
+        self.cache.finish_binding(pod)
+        return True
 
     def _diagnose(self, fw, batch, dsnap, dyn, auxes, i: int, diag_row=None) -> Set[str]:
         """Which plugins reject pod i everywhere (FitError.Diagnosis analog).

@@ -189,3 +189,62 @@ def test_preempt_plain_tables_match_full_materialization():
             assert got.node_name == want.node_name, f"trial {trial}"
             assert [p.uid for p in got.victims] == [p.uid for p in want.victims]
             assert got.num_pdb_violations == want.num_pdb_violations
+
+
+def test_candidate_mask_segment_sum_matches_einsum():
+    """The priority-level segment-sum candidate mask must agree with the
+    dense-einsum fallback on randomized clusters (same pods, same batch)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+    from kubernetes_tpu.preemption import (
+        PRIORITY_LEVEL_CAP,
+        candidate_mask_device,
+    )
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+
+    rng = np.random.default_rng(7)
+    for trial in range(3):
+        n, p_sched, b = 24, 80, 12
+        enc = ClusterEncoder()
+        cache = Cache()
+        for i in range(n):
+            cache.add_node(
+                make_node().name(f"node-{i:03d}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj()
+            )
+        prios = rng.choice([0, 1, 5, 20], size=p_sched)
+        for i in range(p_sched):
+            pod = (
+                make_pod().name(f"sp{i}").uid(f"sp{i}").namespace("default")
+                .req({"cpu": f"{int(rng.integers(100, 900))}m",
+                      "memory": "256Mi"})
+                .priority(int(prios[i])).obj()
+            )
+            pod.spec.node_name = f"node-{int(rng.integers(n)):03d}"
+            cache.add_pod(pod)
+        snap = Snapshot()
+        changed = cache.update_snapshot(snap)
+        enc.sync(snap, changed)
+        dsnap = enc.to_device()
+        from kubernetes_tpu.framework.runtime import initial_dynamic_state
+
+        dyn = initial_dynamic_state(dsnap)
+        pods = [
+            make_pod().name(f"hp{i}").uid(f"hp{i}").namespace("default")
+            .req({"cpu": "3", "memory": "512Mi"})
+            .priority(int(rng.choice([0, 2, 10, 30]))).obj()
+            for i in range(b)
+        ]
+        batch = PodBatchCompiler(enc, {}).compile(pods, pad_to=16)
+        static_ok = jnp.asarray(
+            np.ones((batch.valid.shape[0], dsnap.node_valid.shape[0]), bool)
+        ) & dsnap.node_valid[None, :] & batch.valid[:, None]
+        u = np.unique(np.asarray(enc.pod_priority)[np.asarray(enc.pod_valid)])
+        levels = np.full(PRIORITY_LEVEL_CAP, np.iinfo(np.int32).max, np.int32)
+        levels[: u.size] = u
+        fast = np.asarray(candidate_mask_device(
+            batch, dsnap, dyn, static_ok, jnp.asarray(levels)))
+        dense = np.asarray(candidate_mask_device(batch, dsnap, dyn, static_ok))
+        assert np.array_equal(fast, dense), f"trial {trial}"

@@ -1,5 +1,11 @@
-"""Preemption through the full scheduler loop (PostFilter → victims deleted →
-nominatedNodeName → rescheduled)."""
+"""Preemption through the full scheduler loop.
+
+Two cadences: the default nominated-node FAST path (victims deleted → pod
+bound to the nominated node within the same attempt — the sim's instant
+victim termination collapses the reference's requeue-and-retry,
+scheduler.go:926-935), and the reference's full nominate-and-requeue flow
+(nominated_fast_bind=False: PostFilter → victims deleted →
+nominatedNodeName → rescheduled on retry)."""
 
 from kubernetes_tpu.scheduler import TPUScheduler
 from kubernetes_tpu.sim.store import ObjectStore
@@ -17,7 +23,8 @@ class FakeClock:
         self.t += dt
 
 
-def test_preemption_end_to_end():
+def test_preemption_end_to_end_fast_bind():
+    """Default cadence: the plain preemptor binds within its failing attempt."""
     store = ObjectStore()
     clock = FakeClock()
     sched = TPUScheduler(store, batch_size=4, clock=clock)
@@ -28,7 +35,41 @@ def test_preemption_end_to_end():
     sched.run_until_idle()
     assert store.get("Pod", "default", "low").spec.node_name == "only"
 
-    # high-priority pod arrives; node is full → preempt the low-priority pod
+    # high-priority pod arrives; node is full → preempt + bind in one attempt
+    store.create("Pod", make_pod().name("high").uid("high").namespace("default")
+                 .priority(100).req({"cpu": "2"}).obj())
+    clock.advance(3.0)
+    sched.run_until_idle()
+    high = store.get("Pod", "default", "high")
+    assert store.get("Pod", "default", "low") is None  # victim deleted
+    assert high.spec.node_name == "only"  # bound, no retry cycle
+    # the fast-bound nomination MUST outlive its bind phase (it stands in
+    # for the not-yet-snapshotted assume — releasing it early made
+    # follow-on preemptor waves evict victims on already-claimed nodes)
+    # and is purged by the next dispatch whose snapshot carries the bind
+    assert set(sched._nominated) == {"high"}
+    assert set(sched._fastbound_noms) == {"high"}
+    store.create("Pod", make_pod().name("tick").uid("tick")
+                 .namespace("default").req({"cpu": "100m"}).obj())
+    clock.advance(3.0)
+    sched.run_until_idle()
+    assert not sched._nominated  # purged once the snapshot carries the bind
+
+
+def test_preemption_end_to_end_nominate_and_requeue():
+    """Reference cadence (nominated_fast_bind=False): nominate, requeue,
+    bind on the retry."""
+    store = ObjectStore()
+    clock = FakeClock()
+    sched = TPUScheduler(store, batch_size=4, clock=clock,
+                         nominated_fast_bind=False)
+    store.create("Node", make_node().name("only")
+                 .capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+    store.create("Pod", make_pod().name("low").uid("low").namespace("default")
+                 .priority(1).req({"cpu": "2"}).obj())
+    sched.run_until_idle()
+    assert store.get("Pod", "default", "low").spec.node_name == "only"
+
     store.create("Pod", make_pod().name("high").uid("high").namespace("default")
                  .priority(100).req({"cpu": "2"}).obj())
     clock.advance(3.0)
