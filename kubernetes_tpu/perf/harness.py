@@ -160,10 +160,23 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         # nominates nothing and disturbs nothing.
                         warm = warm.req({"cpu": "100000"}).priority(1)
                     warm = warm.obj()
-                    warm_keys.append((warm.metadata.namespace, warm.metadata.name))
                     store.create("Pod", warm)
                     sched.schedule_cycle()
                     sched.schedule_cycle()  # pipeline: complete + bind it
+                    if wi == 2:
+                        # delete the anti-affinity warm pod IMMEDIATELY: a
+                        # scheduled required-anti-affinity pod makes
+                        # host_prepare build existing-pod anti term tables
+                        # for EVERY later batch, so the template warms would
+                        # warm a program variant the (anti-pod-free) window
+                        # never runs — and the window's first batch would
+                        # compile the tables-compiled-out variant in-window
+                        sched.run_until_idle(max_cycles=4)
+                        store.delete("Pod", warm.metadata.namespace,
+                                     warm.metadata.name)
+                    else:
+                        warm_keys.append((warm.metadata.namespace,
+                                          warm.metadata.name))
                 # …and two pods from the SUITE'S OWN template: its label /
                 # constraint shapes can differ from the synthetic warmups'
                 # sticky caps, and the first template batch would otherwise
@@ -179,10 +192,48 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     warm.spec.preemption_policy = "Never"
                     warm_keys.append((warm.metadata.namespace, warm.metadata.name))
                     store.create("Pod", warm)
+                    if wi == 1:
+                        # warm the FULL-UPLOAD program variant (upd=None
+                        # pytree) against the suite's own aux structure: a
+                        # mid-window dirty burst past the scatter bucket
+                        # (e.g. a whole batch's binds + churn events, or a
+                        # preemption victim storm) takes this path
+                        sched.encoder.force_full_next()
                     sched.schedule_cycle()
                     sched.schedule_cycle()
                 for ns, name in warm_keys:
                     store.delete("Pod", ns, name)
+                if w.churn_between_cycles is not None:
+                    # exercise the churn hook once pre-window: the objects
+                    # it creates (service → selector-spread host tables,
+                    # churn node/pod) change the fused program's host-aux
+                    # pytree, and the first in-window churn batch otherwise
+                    # pays that re-trace as an in-window compile
+                    def _key(o):
+                        return (getattr(o.metadata, "namespace", "") or "",
+                                o.metadata.name)
+
+                    pre = {
+                        kind: {_key(o) for o in store.list(kind)[0]}
+                        for kind in ("Node", "Pod", "Service")
+                    }
+                    w.churn_between_cycles(store, 0)
+                    sched.schedule_cycle()
+                    sched.schedule_cycle()
+                    # second call with the SAME cycle index exercises the
+                    # recreate path (delete + re-add of the churn node/pod/
+                    # service), and the full-upload variant is re-warmed
+                    # against the churn-present aux structure (service
+                    # tables in host_auxes)
+                    w.churn_between_cycles(store, 0)
+                    sched.encoder.force_full_next()
+                    sched.schedule_cycle()
+                    sched.schedule_cycle()
+                    for kind, had in pre.items():
+                        for o in list(store.list(kind)[0]):
+                            ns, name = _key(o)
+                            if (ns, name) not in had:
+                                store.delete(kind, ns, name)
             created = []
             for _ in range(op.count):
                 p = tmpl(pod_idx)

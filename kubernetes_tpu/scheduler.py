@@ -107,28 +107,41 @@ def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     spread pods stay deep.  Resource requests, node selectors/affinity,
     taints and images chain exactly.
 
-    Preemption-CAPABLE pods (priority > 0, policy not Never) also block:
-    beyond the victim-visibility problem (in-flight placements have no
-    snapshot pod entries for the dry-run to evict), a same-process A/B
-    (tools/preempt_ab.py, round 5) measured chaining preemptor waves at
-    231/87 pods/s vs 266/265 blocked — extra in-flight staleness makes
-    their preemption claims collide, refusing nominated fast binds into
-    backoff churn."""
-    from .state.node_info import _pod_host_ports
-
+    Preemption-CAPABLE pods (priority > 0, policy not Never) also block
+    WHEN LIKELY TO PREEMPT: beyond the victim-visibility problem (in-flight
+    placements have no snapshot pod entries for the dry-run to evict), a
+    same-process A/B (tools/preempt_ab.py, round 5) measured chaining
+    preemptor waves at 231/87 pods/s vs 266/265 blocked — extra in-flight
+    staleness makes their preemption claims collide, refusing nominated
+    fast binds into backoff churn.  The refinement lives in
+    TPUScheduler._infos_block_deep: a preemption-capable pod that has never
+    failed AND fits the current snapshot somewhere (e.g. MixedChurn's
+    priority-10 churn pods on a half-empty cluster) does not block — if it
+    does fail anyway, its bind phase defers preemption to the retry, which
+    THEN blocks (see _bind_phase)."""
     for p in pods:
-        # topology-spread constraints are CHAINABLE: the fused program folds
-        # the in-flight batch's placements into this batch's count tables
-        # (PodTopologySpreadPlugin.chain_prev), so spread pods deep-pipeline
-        aff = p.spec.affinity
-        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
-            return True
-        if _pod_host_ports(p):
-            return True
-        if getattr(p.spec, "volumes", None):
+        if _pod_blocks_static(p):
             return True
         if (p.spec.priority or 0) > 0 and p.spec.preemption_policy != "Never":
             return True
+    return False
+
+
+def _pod_blocks_static(p: v1.Pod) -> bool:
+    """The statically non-chainable constraints, shared by _pods_block_deep
+    and TPUScheduler._infos_block_deep so the two predicates cannot drift:
+    pod (anti)affinity tables, host ports, volumes.  Topology-spread
+    constraints are CHAINABLE (the fused program folds in-flight placements
+    into this batch's count tables via PodTopologySpreadPlugin.chain_prev)."""
+    from .state.node_info import _pod_host_ports
+
+    aff = p.spec.affinity
+    if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+        return True
+    if _pod_host_ports(p):
+        return True
+    if getattr(p.spec, "volumes", None):
+        return True
     return False
 
 
@@ -178,6 +191,10 @@ class _InFlight:
     # the decision fetch — 2 extra full-priced tunnel rounds)
     cand_dev: object = None
     cand_np: object = None  # prefetched by the background thread
+    # True when this batch was dispatched deep-chained on in-flight prevs:
+    # a failing preemptor in it defers preemption to its retry (the chained
+    # deltas hide state the dry-run could neither see nor evict)
+    chained: bool = False
     # priority-level table captured at dispatch for the segment-sum
     # candidate mask (the lazy bind-phase call must see the SAME pod set
     # the record's dsnap was built from, not a later sync's)
@@ -614,7 +631,7 @@ class TPUScheduler:
         infos = self.queue.pop_batch(
             self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
         )
-        next_interacts = _pods_block_deep([qi.pod for qi in infos]) if infos else True
+        next_interacts = self._infos_block_deep(infos) if infos else True
         # Deep chain tail: the newest run of in-flight batches this dispatch
         # can chain on device (each must be constraint-free and predate no
         # node delete — a freed encoder row that THIS dispatch's sync reuses
@@ -765,14 +782,19 @@ class TPUScheduler:
         fl.name_of = dict(self.encoder.row_to_name())
         fl.interacts = interacts if interacts is not None else _pods_block_deep(pods)
         fl.node_del_gen = self._node_del_gen
+        fl.chained = bool(prevs)
         # Speculative candidate mask: when this profile's recent cycles were
         # failure-heavy and the batch can preempt, dispatch the cand program
         # NOW so its device window + fetch overlap the bind phase instead of
         # serializing inside it (2 tunnel rounds off every failing cycle).
         # A wrong guess costs one overlapped device program, no extra rounds
         # on the critical path.
-        can_preempt = any((p.spec.priority or 0) > 0
-                          and p.spec.preemption_policy != "Never" for p in pods)
+        # chained batches never run the candidate mask (their bind defers
+        # preemption to the retry), so neither the levels table nor the
+        # speculative dispatch applies to them
+        can_preempt = not prevs and any(
+            (p.spec.priority or 0) > 0
+            and p.spec.preemption_policy != "Never" for p in pods)
         if can_preempt:
             # levels only matter to the candidate mask; a batch that can
             # never preempt must not pay the O(P log P) np.unique on the
@@ -960,6 +982,11 @@ class TPUScheduler:
                 can_preempt = (
                     qi.pod.spec.preemption_policy != "Never"
                     and min_sched_prio < (qi.pod.spec.priority or 0)
+                    # a deep-chained batch's dry-run would run against
+                    # chained-delta state it can neither see as victims nor
+                    # evict — defer to the retry, which blocks the chain
+                    # (_infos_block_deep: attempts > 1) and preempts clean
+                    and not fl.chained
                 )
                 if can_preempt:
                     # the lazy context (PDB list, row→name, candidate-mask
@@ -1426,6 +1453,60 @@ class TPUScheduler:
 
     # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
     _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
+
+    def _infos_block_deep(self, infos: List[QueuedPodInfo]) -> bool:
+        """_pods_block_deep with the preemption refinement: a
+        preemption-capable pod blocks the deep chain only when it is LIKELY
+        to actually preempt — it failed before, or it fits nowhere in the
+        current snapshot (a fresh fitting pod, e.g. MixedChurn's
+        priority-10 churn pod on a roomy cluster, schedules normally and
+        never runs the dry-run).  If the prediction misses and a chained
+        preemptor fails, _bind_phase defers preemption to the retry, which
+        then blocks — so a preemption dry-run never sees chained-delta
+        state it can't evict.
+
+        Soundness of chaining ON such a batch (a later batch B chained on
+        this batch A while A still runs bind-phase preemption after a
+        prediction miss): B's program can only place pods within A's
+        snapshot-view free space (it carries A's deltas), and
+        _try_nominated_fast_bind's claimable guard refuses the fast bind
+        whenever ANY in-flight pod fits that same snapshot free space — so
+        a fast-bound preemptor and a chained batch can never double-book a
+        node; the nominate-and-requeue path only FREES resources (victims
+        deleted, claim reserved at future dispatches).
+        """
+        preempt_qis: List[QueuedPodInfo] = []
+        for qi in infos:
+            p = qi.pod
+            if _pod_blocks_static(p):
+                return True
+            if (p.spec.priority or 0) > 0 and p.spec.preemption_policy != "Never":
+                # pop_batch already counted this attempt: >1 means a retry
+                if qi.attempts > 1 or qi.unschedulable_plugins:
+                    return True
+                preempt_qis.append(qi)
+        if not preempt_qis:
+            return False
+        if not self.pipeline or self.extenders:
+            # the result only gates deep chaining; sync/extender modes must
+            # not pay the per-pod fit scans below (their dispatch path
+            # ignores it) — conservatively block
+            return True
+        valid = np.asarray(self.encoder.node_valid)
+        free = (self.encoder.allocatable[valid].astype(np.int64)
+                - self.encoder.requested[valid])
+        seen_fit: Dict[bytes, bool] = {}  # templated pods share request vectors
+        for qi in preempt_qis:
+            req = np.asarray(self.encoder.pod_request_units(qi.pod))
+            key = req.tobytes()
+            fit = seen_fit.get(key)
+            if fit is None:
+                fit = bool(np.any(np.all(
+                    (req == 0) | (req[None, :] <= free), axis=1)))
+                seen_fit[key] = fit
+            if not fit:
+                return True
+        return False
 
     def _priority_levels(self):
         """Sorted unique scheduled-pod priorities, padded to the fixed

@@ -525,6 +525,16 @@ class ClusterEncoder:
 
     # --- device upload -------------------------------------------------------
 
+    def force_full_next(self) -> None:
+        """Make the next to_device_deferred take the full-upload path
+        (upd=None).  Warmups use this to pre-trace the fused program's
+        None-scatter pytree variant against the measured window's host-aux
+        structure — a mid-window dirty burst (batch binds + churn events
+        exceeding the scatter bucket) otherwise pays that re-trace as an
+        in-window compile (measured 0.13s + one poisoned 256-attempt cycle
+        in MixedChurn)."""
+        self._force_full_once = True
+
     def to_device_deferred(self):
         """Like to_device, but returns the row-scatter payload instead of
         executing it: ``(dsnap, upd)`` where ``upd`` is None (full upload
@@ -536,6 +546,9 @@ class ClusterEncoder:
         fused compute itself.  Caller MUST ``commit_device()`` the updated
         DeviceSnapshot returned by its program (the arrays are async —
         committing the futures immediately is safe)."""
+        if getattr(self, "_force_full_once", False):
+            self._force_full_once = False
+            return self.to_device(force_full=True), None
         numeric, use_scatter = self._upload_gate()
         # A dirty burst past the scatter bucket (preemption victim storms)
         # takes the FULL-upload path — already compiled — rather than
