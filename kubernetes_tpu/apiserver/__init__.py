@@ -1,4 +1,17 @@
-from .server import APIServer, resource_of
+from .server import (
+    APIServer,
+    UserInfo,
+    header_authenticator,
+    resource_of,
+    token_authenticator,
+)
 from .client import HTTPApiClient
 
-__all__ = ["APIServer", "HTTPApiClient", "resource_of"]
+__all__ = [
+    "APIServer",
+    "HTTPApiClient",
+    "UserInfo",
+    "header_authenticator",
+    "resource_of",
+    "token_authenticator",
+]

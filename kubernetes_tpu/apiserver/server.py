@@ -19,8 +19,25 @@ events (chunked); ``labelSelector`` (equality terms) and ``fieldSelector``
 (``spec.nodeName``/``metadata.name``) filter lists, mirroring the selectors
 kubelets and controllers actually use.
 
-Authorization: a pluggable ``authorizer(user, verb, resource, namespace) ->
-bool`` — the RBAC-shaped decision point without the full policy object model.
+Request chain (the reference generic server's handler chain shape,
+staging/src/k8s.io/apiserver/pkg/server/config.go:816 — authn → authz →
+admission → registry):
+
+- Authentication: a pluggable ``authenticators`` list, each
+  ``(headers) -> Optional[UserInfo]``; the first non-None wins, and when
+  authenticators are configured an unidentified request gets 401.
+  ``header_authenticator`` implements the reference's request-header authn
+  (X-Remote-User / X-Remote-Group); ``token_authenticator`` the static
+  token file (Authorization: Bearer).
+- Authorization: a pluggable ``authorizer(user, verb, resource,
+  namespace) -> bool`` — the RBAC-shaped decision point without the full
+  policy object model.
+- Admission: ``mutating_admission`` then ``validating_admission`` hook
+  lists run on every write after decode, before storage — each mutating
+  hook is ``(operation, kind, obj, user) -> obj | None`` (None keeps the
+  object), each validating hook returns an error string to deny (403
+  AdmissionDenied) or None to admit.  The reference's webhook/plugin
+  chain reduced to in-process hook points.
 """
 
 from __future__ import annotations
@@ -44,6 +61,46 @@ from ..sim.store import (
     QuotaExceeded,
     StaleResourceVersion,
 )
+
+
+class UserInfo:
+    """Authenticated request identity (authentication/user.Info analog)."""
+
+    __slots__ = ("name", "groups")
+
+    def __init__(self, name: str, groups: Tuple[str, ...] = ()):
+        self.name = name
+        self.groups = tuple(groups)
+
+    def __repr__(self):
+        return f"UserInfo({self.name!r}, groups={self.groups!r})"
+
+
+def header_authenticator(headers) -> Optional[UserInfo]:
+    """Request-header authentication (the reference's front-proxy authn:
+    --requestheader-username-headers): X-Remote-User (+ X-Remote-Group)."""
+    user = headers.get("X-Remote-User")
+    if not user:
+        return None
+    groups = tuple(
+        g.strip() for g in (headers.get("X-Remote-Group") or "").split(",")
+        if g.strip()
+    )
+    return UserInfo(user, groups)
+
+
+def token_authenticator(tokens: Dict[str, str]):
+    """Static bearer-token authentication (token-file authn): token →
+    username map; returns an authenticator callable."""
+
+    def authenticate(headers) -> Optional[UserInfo]:
+        auth = headers.get("Authorization") or ""
+        if not auth.startswith("Bearer "):
+            return None
+        user = tokens.get(auth[len("Bearer "):].strip())
+        return UserInfo(user) if user else None
+
+    return authenticate
 
 
 def resource_of(kind: str) -> str:
@@ -105,10 +162,19 @@ class APIServer:
         host: str = "127.0.0.1",
         port: int = 0,
         authorizer: Optional[Callable[[str, str, str, str], bool]] = None,
+        authenticators: Optional[list] = None,
+        mutating_admission: Optional[list] = None,
+        validating_admission: Optional[list] = None,
     ):
         self.store = store
         self.scheme = scheme or default_scheme()
         self.authorizer = authorizer
+        # authn chain: first non-None UserInfo wins; configured-but-failed
+        # authentication is 401 (no anonymous fallthrough)
+        self.authenticators = list(authenticators or [])
+        # admission hook points (mutating then validating), run on writes
+        self.mutating_admission = list(mutating_admission or [])
+        self.validating_admission = list(validating_admission or [])
         # resource name → kind, built from the scheme's served kinds
         self.kinds_by_resource: Dict[str, str] = {}
         for entry in self.scheme.recognized():
@@ -203,18 +269,54 @@ def _make_handler(api: APIServer):
             raw = self.rfile.read(length) if length else b"{}"
             return json.loads(raw or b"{}")
 
-        def _authorized(self, verb: str, resource: str, ns: str) -> bool:
-            if api.authorizer is None:
-                return True
-            user = self.headers.get("X-Remote-User", "system:anonymous")
-            return api.authorizer(user, verb, resource, ns)
+        def _user(self) -> Optional[UserInfo]:
+            """Run the authn chain.  None means 401 was already sent.  With
+            no chain configured, header identity is honored with an
+            anonymous fallback (no 401s — the pre-authn surface)."""
+            if not api.authenticators:
+                return (header_authenticator(self.headers)
+                        or UserInfo("system:anonymous"))
+            for auth in api.authenticators:
+                ui = auth(self.headers)
+                if ui is not None:
+                    return ui
+            self._status_err(401, "Unauthorized",
+                             "no authenticator identified the request")
+            return None
 
         def _check(self, verb: str, kind: str, ns: str) -> bool:
-            if not self._authorized(verb, resource_of(kind), ns):
+            """authn → authz for one request; sends the 401/403 on failure
+            and stashes the identity for the admission hooks."""
+            user = self._user()
+            if user is None:
+                return False
+            self._req_user = user
+            if api.authorizer is not None and not api.authorizer(
+                    user.name, verb, resource_of(kind), ns):
                 self._status_err(403, "Forbidden",
-                                 f"user cannot {verb} {resource_of(kind)}")
+                                 f"user {user.name} cannot {verb} "
+                                 f"{resource_of(kind)}")
                 return False
             return True
+
+        def _admit(self, operation: str, kind: str, obj):
+            """Mutating then validating admission (config.go:816 chain
+            position: after authz, before the registry write).  Returns the
+            (possibly mutated) object, or None when a validating hook
+            denied (403 already sent)."""
+            user = getattr(self, "_req_user", None)
+            for hook in api.mutating_admission:
+                out = hook(operation, kind, obj, user)
+                if out is not None:
+                    obj = out
+            for hook in api.validating_admission:
+                err = hook(operation, kind, obj, user)
+                if err:
+                    self._status_err(
+                        403, "AdmissionDenied",
+                        f"admission webhook denied the request: {err}")
+                    return None
+            return obj
 
         # --- verbs ----------------------------------------------------------
 
@@ -337,6 +439,18 @@ def _make_handler(api: APIServer):
                     return
                 body = self._body()
                 node = ((body.get("target") or {}).get("name")) or ""
+                # admission covers the binding subresource too (the
+                # reference runs its chain on every write, bindings
+                # included) — hooks see the pod with the proposed nodeName
+                pod = api.store.get("Pod", ns, name)
+                if pod is not None:
+                    import copy as _copy
+
+                    proposed = _copy.copy(pod)
+                    proposed.spec = _copy.copy(pod.spec)
+                    proposed.spec.node_name = node
+                    if self._admit("CONNECT", "Pod", proposed) is None:
+                        return
                 if api.store.bind_pod(ns, name, node):
                     self._send_json(201, {"kind": "Status",
                                           "status": "Success"})
@@ -352,6 +466,9 @@ def _make_handler(api: APIServer):
                 return
             if ns:
                 obj.metadata.namespace = ns
+            obj = self._admit("CREATE", kind, obj)
+            if obj is None:
+                return
             try:
                 api.store.create(kind, obj)
             except QuotaExceeded as e:
@@ -382,6 +499,9 @@ def _make_handler(api: APIServer):
                 return
             obj.metadata.namespace = ns or obj.metadata.namespace
             obj.metadata.name = name
+            obj = self._admit("UPDATE", kind, obj)
+            if obj is None:
+                return
             rv = ((body.get("metadata") or {}).get("resourceVersion"))
             if not self._store_update_rv(kind, obj,
                                          None if rv in (None, "") else rv):
@@ -439,6 +559,9 @@ def _make_handler(api: APIServer):
                     self._status_err(400, "BadRequest", str(e))
                     return
                 obj.metadata.uid = cur.metadata.uid
+                obj = self._admit("UPDATE", kind, obj)
+                if obj is None:
+                    return
                 if client_rv not in (None, "") and \
                         str(client_rv) != str(cur.metadata.resource_version):
                     break  # stale client rv → Conflict below
@@ -467,6 +590,13 @@ def _make_handler(api: APIServer):
                 return
             kind, ns, name, _sub = r
             if not self._check("delete", kind, ns):
+                return
+            cur = api.store.get(kind, ns, name)
+            if cur is None:
+                self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
+                return
+            # admission gates DELETE as well (hooks see the current object)
+            if self._admit("DELETE", kind, cur) is None:
                 return
             obj = api.store.delete(kind, ns, name)
             if obj is None:

@@ -234,6 +234,16 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                             ns, name = _key(o)
                             if (ns, name) not in had:
                                 store.delete(kind, ns, name)
+                        # contract: the warm churn calls must only have
+                        # CREATED objects — a hook that deleted/renamed
+                        # pre-existing state would corrupt the measured
+                        # window's declared initial cluster silently
+                        now = {_key(o) for o in store.list(kind)[0]}
+                        missing = had - now
+                        assert not missing, (
+                            f"churn hook removed pre-existing {kind} "
+                            f"objects during warmup: {sorted(missing)[:4]}"
+                        )
             created = []
             for _ in range(op.count):
                 p = tmpl(pod_idx)

@@ -1,0 +1,189 @@
+"""Render the perf tables in COMPONENTS.md / BASELINE.md FROM the committed
+artifacts (VERDICT r4 weak #1 / next #2: "generate, don't transcribe" — the
+round-4 docs cited bench_final.json for numbers the file didn't contain).
+
+Reads bench_final.json, suites_5k.out, density.json and rewrites everything
+between the GENERATED:PERF sentinels in both docs.  Run as the LAST step of
+any artifact refresh (tools/run_suites.sh does).  Exits non-zero if a doc
+cites an artifact that is missing or unparsable, or if sentinels are absent.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BEGIN = "<!-- GENERATED:PERF:BEGIN (tools/render_perf_docs.py — edit the artifacts, not this block) -->"
+END = "<!-- GENERATED:PERF:END -->"
+
+
+def load_bench(path):
+    with open(os.path.join(REPO, path)) as f:
+        return json.load(f)
+
+
+def load_suites(path="suites_5k.out"):
+    out = {}
+    with open(os.path.join(REPO, path)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            if "error" in d:
+                out[d["error"]] = d
+                continue
+            out[d["detail"]["workload"]] = d
+    return out
+
+
+def _ms(x):
+    return f"{x:.0f}"
+
+
+def suite_row(d):
+    dd = d["detail"]
+    att = dd["attempt_ms"]
+    env = dd.get("go_envelope", {})
+    ratio = env.get("vs_go_envelope_throughput")
+    env_thr = (env.get("sampled") or {}).get("throughput_pods_per_s")
+    comp = dd["xla_compiles_in_window"]
+    steady = dd.get("steady_state_ms", {})
+    env_cell = f"{env_thr:.0f}" if env_thr is not None else "—"
+    ratio_cell = f"{ratio:.2f}" if ratio is not None else "—"
+    return (
+        f"| {dd['workload']} | {dd['throughput_pods_per_s']:.1f} | "
+        f"{_ms(att['p50'])} / {_ms(att['p99'])} | "
+        f"{int(comp['count'])} | "
+        f"{int(steady.get('attempts', 0))}/{int(steady.get('of_total', 0))} | "
+        f"{env_cell} | {ratio_cell} |"
+    )
+
+
+def render_components(suites, bench, density):
+    dd = bench["detail"]
+    att = dd["attempt_ms"]
+    env = dd["go_envelope"]
+    lines = [
+        BEGIN,
+        "",
+        "North star (`bench.py`, NorthStar 5000 nodes / 2000 scheduled / "
+        "10000 pending, full default plugin set — every number below is "
+        "read from the committed `bench_final.json`):",
+        "",
+        "| Metric | Value |",
+        "|---|---|",
+        f"| Throughput | **{dd['throughput_pods_per_s']:.1f} pods/s** |",
+        f"| attempt p50 / p90 / p99 | {_ms(att['p50'])} / {_ms(att['p90'])} "
+        f"/ {_ms(att['p99'])} ms |",
+        f"| in-window XLA compiles | {int(dd['xla_compiles_in_window']['count'])} |",
+        f"| sampled Go envelope (same run) | "
+        f"{env['sampled']['throughput_pods_per_s']:.1f} pods/s |",
+        f"| vs_go_envelope_throughput | **{env['vs_go_envelope_throughput']:.3f}** |",
+        f"| vs_go_envelope_dense_throughput | "
+        f"{env['vs_go_envelope_dense_throughput']:.2f} |",
+        "",
+        "All suites, one artifact pass (`suites_5k.out`; the tunnel-attached "
+        "chip's weather moves numbers ±2× between passes — the envelope "
+        "column is measured in the SAME run, with each suite's own "
+        "default-plugin work model, so the ratio is weather-paired):",
+        "",
+        "| Suite | pods/s | p50 / p99 (ms) | compiles | steady/total "
+        "attempts | suite envelope (sampled) | ratio |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name, d in suites.items():
+        if "error" in d:
+            lines.append(f"| {name} | FAILED | — | — | — | — | — |")
+            continue
+        lines.append(suite_row(d))
+    if density:
+        ddd = density["detail"]
+        datt = ddd["attempt_ms"]
+        lines += [
+            "",
+            "Density (reference historic target, 30k pods / 1000 nodes, "
+            "`density.json`): "
+            f"**{ddd['throughput_pods_per_s']:.1f} pods/s**, attempt p50 "
+            f"{_ms(datt['p50'])} ms / p99 {_ms(datt['p99'])} ms / max "
+            f"{_ms(datt['max'])} ms, "
+            f"{int(ddd['xla_compiles_in_window']['count'])} in-window "
+            "compiles.",
+        ]
+    lines += ["", END]
+    return "\n".join(lines)
+
+
+def render_baseline(bench):
+    dd = bench["detail"]
+    att = dd["attempt_ms"]
+    env = dd["go_envelope"]
+    ratio = env["vs_go_envelope_throughput"]
+    verdict = "MET" if ratio >= 1.0 else "NOT met in this pass's weather"
+    lines = [
+        BEGIN,
+        "",
+        "| Clause | Status | Evidence (all from `bench_final.json`) |",
+        "|---|---|---|",
+        "| ≥50× p99 `schedule_attempt_duration` reduction | **NOT met "
+        "under the per-attempt definition — by design trade** | attempt "
+        f"p99 {_ms(att['p99'])} ms: an attempt spans its whole batch "
+        "window plus the tunnel's fixed turnaround, so per-attempt latency "
+        "cannot beat a per-pod loop whose idealized envelope answers in "
+        f"{env['sampled']['attempt_ms']['p99']:.2f} ms.  What the batch "
+        "design buys is throughput at full optimality (next row); "
+        "`BATCH_SWEEP.json` publishes the latency/throughput frontier. |",
+        "| Throughput vs the sampled Go envelope | "
+        f"**{verdict}: ratio {ratio:.3f}** | "
+        f"{dd['throughput_pods_per_s']:.1f} pods/s scoring ALL 5000 nodes "
+        f"per pod vs the envelope's "
+        f"{env['sampled']['throughput_pods_per_s']:.1f} pods/s scoring 10% "
+        f"(same-run measurement); dense-work ratio "
+        f"{env['vs_go_envelope_dense_throughput']:.2f} |",
+        "| Binding parity vs default scheduler | **Met** | oracle-parity "
+        "suites (`tests/test_parity.py`, `test_fast_scan.py`, "
+        "`test_batch_assign.py`, `test_volumes.py`), deterministic replay, "
+        "deep-pipeline (depths 2 AND 3) == synchronous bindings "
+        "(`tests/test_deep_pipeline.py`) |",
+        "| Single pod scores 100k-node clusters in one shot | **Met — "
+        "executed, WITH assignment** | `SCALE_100K_EXEC.json`: sharded "
+        "filter+score AND both assignment engines over a concrete "
+        "100,352-node snapshot; bindings asserted feasible "
+        "(mask-consistent, no node oversubscribed) |",
+        "",
+        END,
+    ]
+    return "\n".join(lines)
+
+
+def splice(path, block):
+    p = os.path.join(REPO, path)
+    text = open(p).read()
+    if BEGIN not in text or END not in text:
+        print(f"ERROR: {path} lacks the GENERATED:PERF sentinels", file=sys.stderr)
+        return False
+    head, rest = text.split(BEGIN, 1)
+    _, tail = rest.split(END, 1)
+    open(p, "w").write(head + block + tail)
+    print(f"rendered {path}")
+    return True
+
+
+def main() -> int:
+    try:
+        bench = load_bench("bench_final.json")
+        suites = load_suites()
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ERROR: cannot load artifacts: {e}", file=sys.stderr)
+        return 1
+    try:
+        density = load_bench("density.json")
+    except (OSError, json.JSONDecodeError):
+        density = None
+    ok = splice("COMPONENTS.md", render_components(suites, bench, density))
+    ok &= splice("BASELINE.md", render_baseline(bench))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,5 +39,8 @@ else
   echo "FAILED: Density" >> suites_run.log
   FAILED=1
 fi
+# re-render the doc tables FROM the fresh artifacts (generate, don't
+# transcribe): no doc may cite a number its artifact doesn't contain
+python tools/render_perf_docs.py || FAILED=1
 echo "ALL DONE (failed=$FAILED) $(date +%H:%M:%S)" >> suites_run.log
 exit $FAILED
