@@ -583,6 +583,9 @@ class NodeStatus:
     allocatable: Dict[str, object] = field(default_factory=dict)
     images: List[ContainerImage] = field(default_factory=list)
     conditions: List[Dict] = field(default_factory=list)
+    # v1.NodeStatus.volumesAttached (AttachedVolume names), maintained by
+    # the attach-detach controller (controllers/volumebinder.py)
+    volumes_attached: List[str] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: Optional[Mapping]) -> "NodeStatus":
@@ -594,6 +597,10 @@ class NodeStatus:
             allocatable=alloc,
             images=[ContainerImage.from_dict(i) for i in d.get("images") or []],
             conditions=list(d.get("conditions") or []),
+            volumes_attached=[
+                (v.get("name") if isinstance(v, Mapping) else str(v))
+                for v in d.get("volumesAttached") or []
+            ],
         )
 
 

@@ -145,7 +145,10 @@ def _spec_status(obj) -> Dict[str, Any]:
             status = {"capacity": dict(obj.status.capacity),
                       "allocatable": dict(obj.status.allocatable),
                       "images": _ser(obj.status.images),
-                      "conditions": list(obj.status.conditions)}
+                      "conditions": list(obj.status.conditions),
+                      "volumesAttached": [
+                          {"name": n} for n in obj.status.volumes_attached
+                      ]}
         return {**body, "status": status}
     if isinstance(obj, v1.Service):
         return {"spec": {"selector": dict(obj.selector)}}

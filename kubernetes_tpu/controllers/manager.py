@@ -24,7 +24,12 @@ class ControllerManager:
         self.controllers.append(controller)
         return self
 
-    def register_defaults(self) -> "ControllerManager":
+    def register_defaults(self, cluster_cidr: str = "10.244.0.0/16",
+                          node_cidr_mask: int = 24) -> "ControllerManager":
+        """``cluster_cidr``/``node_cidr_mask`` configure the NodeIpam loop
+        (--cluster-cidr / --node-cidr-mask-size); the /16-with-/24 default
+        covers 256 nodes — size it to the cluster (a 100k-node sim wants
+        e.g. 10.0.0.0/8 with /25)."""
         from .cronjob import CronJobController
         from .deployment import DeploymentController
         from .disruption import DisruptionController
@@ -38,8 +43,13 @@ class ControllerManager:
         from .serviceaccount import ServiceAccountController
         from .statefulset import StatefulSetController
         from .daemonset import DaemonSetController
+        from .nodeipam import NodeIpamController
         from .podautoscaler import HorizontalPodAutoscalerController
         from .ttlafterfinished import TTLAfterFinishedController
+        from .volumebinder import (
+            AttachDetachController,
+            PersistentVolumeBinderController,
+        )
 
         self.register(NamespaceController(self.store))
         self.register(ServiceAccountController(self.store))
@@ -56,6 +66,10 @@ class ControllerManager:
         self.register(EndpointsController(self.store))
         self.register(EndpointSliceController(self.store))
         self.register(ResourceQuotaController(self.store))
+        self.register(NodeIpamController(self.store, cluster_cidr=cluster_cidr,
+                                         node_mask=node_cidr_mask))
+        self.register(PersistentVolumeBinderController(self.store))
+        self.register(AttachDetachController(self.store))
         self.register(GarbageCollector(self.store))
         return self
 

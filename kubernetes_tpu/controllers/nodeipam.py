@@ -1,0 +1,65 @@
+"""Node IPAM controller: allocate a podCIDR per node from the cluster CIDR.
+
+Reference: pkg/controller/nodeipam/node_ipam_controller.go +
+ipam/range_allocator.go — each new node gets the next free /node-mask subnet
+of --cluster-cidr; the subnet returns to the pool when the node goes away.
+Stateless reconcile: the used-set is recomputed from live nodes each sync,
+so restart recovery is the same code path (the reference rebuilds its
+cidr_set from informer state the same way, range_allocator.go Occupy)."""
+
+from __future__ import annotations
+
+import ipaddress
+
+from ..sim.store import ObjectStore
+
+
+class NodeIpamController:
+    def __init__(self, store: ObjectStore,
+                 cluster_cidr: str = "10.244.0.0/16",
+                 node_mask: int = 24):
+        self.store = store
+        self.cluster = ipaddress.ip_network(cluster_cidr)
+        self.node_mask = node_mask
+        if node_mask < self.cluster.prefixlen:
+            raise ValueError(
+                f"node mask /{node_mask} larger than cluster {cluster_cidr}")
+
+    def sync_once(self) -> bool:
+        nodes, _ = self.store.list("Node")
+        used = set()
+        pending = []
+        for node in nodes:
+            cidr = node.spec.pod_cidr
+            if cidr:
+                used.add(cidr)
+            else:
+                pending.append(node)
+        if not pending:
+            return False
+        # deterministic node order (the reference serializes through one
+        # workqueue); subnets() yields in address order
+        pending.sort(key=lambda n: n.metadata.name)
+        free = (
+            str(s) for s in self.cluster.subnets(new_prefix=self.node_mask)
+            if str(s) not in used
+        )
+        changed = False
+        for node in pending:
+            cidr = next(free, None)
+            if cidr is None:
+                # pool exhausted — remaining nodes stay pending, loudly
+                # (the reference records a CIDRNotAvailable event)
+                from ..component_base import logging as klog
+
+                klog.error_s(
+                    None, "CIDRNotAvailable: cluster CIDR exhausted",
+                    cluster=str(self.cluster), node=node.metadata.name,
+                    pending=len(pending),
+                )
+                break
+            node.spec.pod_cidr = cidr
+            used.add(cidr)
+            self.store.update("Node", node)
+            changed = True
+        return changed

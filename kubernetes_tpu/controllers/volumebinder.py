@@ -1,0 +1,152 @@
+"""PersistentVolume binder + attach-detach controllers.
+
+Reference: pkg/controller/volume/persistentvolume/pv_controller.go
+(syncUnboundClaim/syncVolume: Immediate-mode claims bind to the
+smallest-fitting available PV; a bound PV whose claim vanished becomes
+Released) and pkg/controller/volume/attachdetach/attach_detach_controller.go
+(desired state = volumes of scheduled pods per node; node.status
+volumesAttached reconciled to it).
+
+WaitForFirstConsumer claims are explicitly NOT handled here — the
+scheduler's VolumeBinding plugin owns them (plugins/volumes.py), exactly
+the reference's split (pv_controller skips WaitForFirstConsumer claims
+until a pod triggers provisioning/binding)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..api import objects as v1
+from ..api.resource import parse_quantity_exact
+from ..sim.store import ObjectStore
+
+
+def _storage(q) -> object:
+    try:
+        return parse_quantity_exact(q or 0)
+    except (ValueError, ArithmeticError):
+        return 0
+
+
+class PersistentVolumeBinderController:
+    """Immediate-mode PVC ↔ PV binding (the control-plane half of pkg/volume)."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def _binding_mode(self, class_name: Optional[str]) -> str:
+        if not class_name:
+            return v1.VOLUME_BINDING_IMMEDIATE
+        sc = self.store.get("StorageClass", "", class_name)
+        if sc is None:
+            return v1.VOLUME_BINDING_IMMEDIATE
+        return sc.volume_binding_mode
+
+    def sync_once(self) -> bool:
+        changed = False
+        pvs, _ = self.store.list("PersistentVolume")
+        pvcs, _ = self.store.list("PersistentVolumeClaim")
+        claims_by_key = {
+            f"{c.metadata.namespace}/{c.metadata.name}": c for c in pvcs
+        }
+        pvs_by_name = {pv.metadata.name: pv for pv in pvs}
+        # release PVs whose claim is gone OR bound elsewhere (the reference
+        # compares ClaimRef UID; a delete+recreate of a same-named claim
+        # that bound a different volume must not leak this one).  Retain
+        # policy modeled by clearing claim_ref so the volume is
+        # re-matchable, the sim's recycle policy.
+        for pv in pvs:
+            if not pv.claim_ref:
+                continue
+            claim = claims_by_key.get(pv.claim_ref)
+            if claim is None or (claim.volume_name
+                                 and claim.volume_name != pv.metadata.name):
+                pv.claim_ref = None
+                self.store.update("PersistentVolume", pv)
+                changed = True
+        available = [pv for pv in pvs if not pv.claim_ref]
+        for pvc in pvcs:
+            key = f"{pvc.metadata.namespace}/{pvc.metadata.name}"
+            if pvc.volume_name:
+                # pre-bound claim (spec.volumeName set by the user): stamp
+                # the PV's claimRef too — syncUnboundClaim's static-binding
+                # arm; a claim naming a missing or foreign PV stays Pending
+                pv = pvs_by_name.get(pvc.volume_name)
+                if pv is None or (pv.claim_ref and pv.claim_ref != key):
+                    continue
+                if pv.claim_ref != key:
+                    pv.claim_ref = key
+                    self.store.update("PersistentVolume", pv)
+                    if pv in available:
+                        available.remove(pv)
+                    changed = True
+                if pvc.phase != "Bound":
+                    pvc.phase = "Bound"
+                    self.store.update("PersistentVolumeClaim", pvc)
+                    changed = True
+                continue
+            mode = self._binding_mode(pvc.storage_class_name)
+            if mode != v1.VOLUME_BINDING_IMMEDIATE:
+                continue  # the scheduler's VolumeBinding plugin owns these
+            need = _storage(pvc.requested_storage)
+            fits = [
+                pv for pv in available
+                if (pv.storage_class_name or "") == (pvc.storage_class_name or "")
+                and _storage(pv.capacity.get("storage")) >= need
+                and (not pvc.access_modes
+                     or set(pvc.access_modes) <= set(pv.access_modes))
+            ]
+            if not fits:
+                continue
+            # smallest fitting volume wins, name tie-break — the SAME key
+            # the scheduler plugin uses (plugins/volumes.py smallest-fit) so
+            # binder and plugin choose identically on identical inputs
+            best = min(fits, key=lambda pv: (
+                _storage(pv.capacity.get("storage")), pv.metadata.name))
+            best.claim_ref = key
+            pvc.volume_name = best.metadata.name
+            pvc.phase = "Bound"
+            self.store.update("PersistentVolume", best)
+            self.store.update("PersistentVolumeClaim", pvc)
+            available.remove(best)
+            changed = True
+        return changed
+
+
+class AttachDetachController:
+    """Reconcile node.status.volumesAttached to the PVs of each node's
+    scheduled pods (desired-state-of-world → actual, attach_detach_controller
+    reconciler.go) — the sim has no real attach operation, so actual ==
+    desired after one sync, which is the reference's steady state."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def sync_once(self) -> bool:
+        pods, _ = self.store.list("Pod")
+        pvcs = {
+            f"{c.metadata.namespace}/{c.metadata.name}": c
+            for c in self.store.list("PersistentVolumeClaim")[0]
+        }
+        desired: Dict[str, Set[str]] = {}
+        for pod in pods:
+            node = pod.spec.node_name
+            # terminated pods release their attachments (the reference's
+            # desired-state-of-world excludes Succeeded/Failed pods)
+            if not node or pod.status.phase in ("Succeeded", "Failed"):
+                continue
+            for vol in getattr(pod.spec, "volumes", None) or []:
+                pvc_name = getattr(vol, "pvc_name", "")
+                if not pvc_name:
+                    continue
+                claim = pvcs.get(f"{pod.metadata.namespace}/{pvc_name}")
+                if claim is not None and claim.volume_name:
+                    desired.setdefault(node, set()).add(claim.volume_name)
+        changed = False
+        for node in self.store.list("Node")[0]:
+            want = sorted(desired.get(node.metadata.name, ()))
+            if node.status.volumes_attached != want:
+                node.status.volumes_attached = want
+                self.store.update("Node", node)
+                changed = True
+        return changed

@@ -262,3 +262,101 @@ def test_job_controller_stamps_completion_time():
     jc.sync_once()
     job = store.get("Job", "default", "j")
     assert job.completed and job.completion_time == 500.0
+
+
+def test_node_ipam_allocates_disjoint_pod_cidrs():
+    """nodeipam/range_allocator.go: each node gets a distinct /24 of the
+    cluster CIDR; a freed subnet is reused; existing assignments survive."""
+    from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+    from kubernetes_tpu.testutil import make_node
+
+    store = ObjectStore()
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4"}).obj())
+    c = NodeIpamController(store, cluster_cidr="10.244.0.0/22", node_mask=24)
+    assert c.sync_once()
+    cidrs = {n.metadata.name: n.spec.pod_cidr for n in store.list("Node")[0]}
+    assert len(set(cidrs.values())) == 4
+    assert all(cidr.endswith("/24") and cidr.startswith("10.244.")
+               for cidr in cidrs.values())
+    # idempotent; delete a node → its subnet is reallocated to a new node
+    assert not c.sync_once()
+    freed = cidrs["n1"]
+    store.delete("Node", "", "n1")
+    store.create("Node", make_node().name("n9").capacity({"cpu": "4"}).obj())
+    c.sync_once()
+    assert store.get("Node", "", "n9").spec.pod_cidr == freed
+    # pool of 4 /24s is now full: a 5th node stays pending
+    store.create("Node", make_node().name("n10").capacity({"cpu": "4"}).obj())
+    c.sync_once()
+    assert store.get("Node", "", "n10").spec.pod_cidr == ""
+
+
+def test_pv_binder_immediate_binding_and_release():
+    """pv_controller.go: Immediate claims bind to the smallest fitting PV of
+    their class; deleting the claim releases the volume for rebinding;
+    WaitForFirstConsumer claims are left to the scheduler plugin."""
+    from kubernetes_tpu.controllers.volumebinder import (
+        PersistentVolumeBinderController,
+    )
+
+    store = ObjectStore()
+    for name, cap in (("pv-big", "100Gi"), ("pv-small", "10Gi")):
+        store.create("PersistentVolume", v1.PersistentVolume(
+            metadata=v1.ObjectMeta(name=name),
+            capacity={"storage": cap}, storage_class_name="std",
+            access_modes=["ReadWriteOnce"],
+        ))
+    store.create("StorageClass", v1.StorageClass(
+        metadata=v1.ObjectMeta(name="std")))
+    store.create("StorageClass", v1.StorageClass(
+        metadata=v1.ObjectMeta(name="wffc"),
+        volume_binding_mode=v1.VOLUME_BINDING_WAIT))
+    store.create("PersistentVolumeClaim", v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="claim", namespace="default"),
+        storage_class_name="std", requested_storage="5Gi",
+        access_modes=["ReadWriteOnce"],
+    ))
+    store.create("PersistentVolumeClaim", v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="lazy", namespace="default"),
+        storage_class_name="wffc", requested_storage="5Gi",
+    ))
+    c = PersistentVolumeBinderController(store)
+    assert c.sync_once()
+    claim = store.get("PersistentVolumeClaim", "default", "claim")
+    assert claim.volume_name == "pv-small" and claim.phase == "Bound"
+    assert store.get("PersistentVolume", "", "pv-small").claim_ref == \
+        "default/claim"
+    # WaitForFirstConsumer untouched (the scheduler plugin owns it)
+    assert store.get("PersistentVolumeClaim", "default", "lazy").volume_name == ""
+    # claim deleted → volume released and rebindable
+    store.delete("PersistentVolumeClaim", "default", "claim")
+    assert c.sync_once()
+    assert store.get("PersistentVolume", "", "pv-small").claim_ref is None
+
+
+def test_attach_detach_reconciles_node_volumes_attached():
+    """attach_detach_controller: node.status.volumesAttached follows the
+    bound PVs of the node's scheduled pods; detaches when the pod leaves."""
+    from kubernetes_tpu.controllers.volumebinder import AttachDetachController
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0").capacity({"cpu": "4"}).obj())
+    store.create("PersistentVolumeClaim", v1.PersistentVolumeClaim(
+        metadata=v1.ObjectMeta(name="data", namespace="default"),
+        volume_name="pv-x", phase="Bound",
+    ))
+    pod = make_pod().name("p").uid("p").namespace("default") \
+        .req({"cpu": "1"}).obj()
+    pod.spec.volumes = [v1.Volume(name="d", pvc_name="data")]
+    pod.spec.node_name = "n0"
+    store.create("Pod", pod)
+    c = AttachDetachController(store)
+    assert c.sync_once()
+    assert store.get("Node", "", "n0").status.volumes_attached == ["pv-x"]
+    assert not c.sync_once()  # steady state
+    store.delete("Pod", "default", "p")
+    assert c.sync_once()
+    assert store.get("Node", "", "n0").status.volumes_attached == []
