@@ -485,8 +485,8 @@ class TPUScheduler:
         # snapshot row-scatter, the nominated-pod reservations, prepare,
         # and the assignment engine.  Each separate device program on the
         # tunnel-attached TPU pays a ~100ms pacing round, so the eager
-        # scatter/upload path tripled cycle latency.  The standalone
-        # prepare remains for the extender/diagnose path.
+        # scatter/upload path tripled cycle latency.  The extender path
+        # rides its own fused first round (prepare_packed below).
         def reserve_nominated(dsnap, nom_rows, nom_req):
             dyn = initial_dynamic_state(dsnap)
             rows = jnp.clip(nom_rows, 0, dsnap.requested.shape[0] - 1)
@@ -575,8 +575,19 @@ class TPUScheduler:
                     static_ok = static_ok & pw.plugin.filter(batch, dsnap, dyn, aux)
             return candidate_mask_device(batch, dsnap, dyn, static_ok, levels)
 
+        def prepare_packed(batch, dsnap, upd, nom_rows, nom_req, host_auxes):
+            # the extender path's FIRST round fused into one program:
+            # deferred snapshot scatter + nominated reservations + prepare +
+            # the packed [B, N] feasibility/score plane — the eager
+            # to_device + standalone prepare + first compute_packed cost
+            # three separate tunnel rounds per batch
+            dsnap = apply_scatter(dsnap, upd)
+            dyn = reserve_nominated(dsnap, nom_rows, nom_req)
+            auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+            return fw.compute_packed(batch, dsnap, dyn, auxes), auxes, dsnap, dyn
+
         return {
-            "prepare": jax.jit(fw.prepare),
+            "prepare_packed": jax.jit(prepare_packed),
             "greedy": jax.jit(fused_greedy),
             "batch": jax.jit(fused_batch),
             "compute_static": jax.jit(fw.compute_static),
@@ -739,14 +750,18 @@ class TPUScheduler:
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
         if self.extenders:
-            # sequential per-pod cycles: each pod's decision lands at its own
-            # time, so per-attempt latency must not absorb later pods' cycles
-            dsnap = self.encoder.to_device()
-            dyn = initial_dynamic_state(dsnap)
-            dyn = self._reserve_nominated(dyn, {qi.pod.uid for qi in infos})
-            auxes = jt["prepare"](batch, dsnap, dyn, host_auxes)
+            # round-based cycles: each pod's decision lands at its own
+            # round, so per-attempt latency must not absorb later pods'
+            # rounds.  Snapshot scatter + nominations + prepare + the first
+            # round's packed plane ride ONE fused program (prepare_packed).
+            dsnap, upd = self.encoder.to_device_deferred()
+            nom_rows, nom_req = self._nominated_arrays(
+                {qi.pod.uid for qi in infos})
+            packed0, auxes, dsnap, dyn = jt["prepare_packed"](
+                batch, dsnap, upd, nom_rows, nom_req, host_auxes)
+            self.encoder.commit_device(dsnap)
             node_row, algo_lat = self._assign_with_extenders(
-                fw, jt, batch, dsnap, dyn, auxes, pods, t0
+                fw, jt, batch, dsnap, dyn, auxes, pods, t0, packed0=packed0
             )
             fl = _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
                            t0, cycle, profile=profile, fw=fw)
@@ -1166,7 +1181,7 @@ class TPUScheduler:
         return cached[1]
 
     def _assign_with_extenders(
-        self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float
+        self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float, packed0=None
     ) -> Tuple[np.ndarray, np.ndarray]:
         """ROUND-BASED extender assignment (findNodesThatPassExtenders
         scheduler.go:1035 + extender prioritize merge :1146-1185).
@@ -1205,7 +1220,11 @@ class TPUScheduler:
         rounds = 0
         while unresolved and rounds <= b:
             rounds += 1
-            packed = np.asarray(jt["compute_packed"](batch, dsnap, dyn, auxes))
+            if rounds == 1 and packed0 is not None:
+                packed = np.asarray(packed0)  # rode the fused first program
+            else:
+                packed = np.asarray(
+                    jt["compute_packed"](batch, dsnap, dyn, auxes))
             mask = np.isfinite(packed)
             scores = packed
             claimed: Set[int] = set()
@@ -1433,23 +1452,6 @@ class TPUScheduler:
             out_rows[: len(rows)] = rows
             out_reqs[: len(rows)] = np.asarray(reqs, dtype=np.float32)
         return out_rows, out_reqs
-
-    def _reserve_nominated(self, dyn, batch_uids: Set[str]):
-        """Virtually consume resources of nominated-but-pending pods not in this
-        batch, so the cycle can't steal their reserved spot."""
-        import jax.numpy as jnp
-
-        for uid, (node_name, req, _pod) in list(self._nominated.items()):
-            if uid in batch_uids:
-                continue
-            row = self.encoder.node_rows.get(node_name)
-            if row is None:
-                del self._nominated[uid]
-                continue
-            dyn = dyn._replace(
-                requested=dyn.requested.at[row].add(jnp.asarray(req))
-            )
-        return dyn
 
     # static (UnschedulableAndUnresolvable-style) plugins preemption can't fix
     _STATIC_PLUGINS = {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}

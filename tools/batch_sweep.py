@@ -1,0 +1,74 @@
+"""Batch-size frontier sweep (VERDICT r4 #8): run the NorthStar workload at
+B ∈ {16, 64, 128, 256, 512, 1024}, record throughput + attempt quantiles per
+point, write BATCH_SWEEP.json.  Turns the "per-attempt p99 is a batch-design
+trade" prose into data: the artifact shows which operating point a
+latency-sensitive profile would pick and what throughput it costs.
+
+Runs bench.py per point in a subprocess (fresh program cache state per B;
+the persistent compile cache makes repeats warm).  Run ALONE on the TPU —
+a concurrent bench makes both runs' numbers garbage.
+
+Usage: python tools/batch_sweep.py [out.json]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATCHES = [16, 64, 128, 256, 512, 1024]
+
+
+def run_point(batch: int) -> dict:
+    env = dict(os.environ, BENCH_BATCH=str(batch),
+               BENCH_SUITE="NorthStar", BENCH_SIZE="5000Nodes/10000Pods")
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError:
+        return {"batch": batch, "error": proc.stderr[-500:]}
+    dd = d["detail"]
+    return {
+        "batch": batch,
+        "throughput_pods_per_s": dd["throughput_pods_per_s"],
+        "attempt_ms": dd["attempt_ms"],
+        "xla_compiles_in_window": dd["xla_compiles_in_window"],
+        "vs_go_envelope_throughput":
+            dd["go_envelope"]["vs_go_envelope_throughput"],
+        "go_envelope_sampled_pods_per_s":
+            dd["go_envelope"]["sampled"]["throughput_pods_per_s"],
+    }
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "BATCH_SWEEP.json")
+    points = []
+    for b in BATCHES:
+        print(f"sweep: B={b} ...", flush=True)
+        p = run_point(b)
+        points.append(p)
+        print(f"  -> {p.get('throughput_pods_per_s', p.get('error'))} pods/s, "
+              f"p99 {p.get('attempt_ms', {}).get('p99')} ms", flush=True)
+    artifact = {
+        "workload": "NorthStar/5000Nodes/10000Pods",
+        "note": (
+            "one pass per point on the tunnel-attached chip; weather moves "
+            "numbers ±2x between points — read the SHAPE (throughput rises "
+            "with B until the latency knee), not single-point deltas"
+        ),
+        "points": points,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
