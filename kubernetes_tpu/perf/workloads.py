@@ -234,22 +234,42 @@ def _unschedulable(n, p, mp) -> Workload:
 def _extender(n, p, mp) -> Workload:
     """SchedulingBasic shape with ONE HTTP extender on the path — measures
     the round-based extender cadence (VERDICT r3 weak #5: within 3× of the
-    no-extender path).  The extender is a real in-process HTTP server
-    (TPUScoreExtenderServer) doing a trivial filter+prioritize, so the
-    measured cost is the protocol + rounds, not artificial extender work."""
-    from ..extender import ExtenderConfig, HTTPExtender, TPUScoreExtenderServer
+    no-extender path).  The extender runs in a SUBPROCESS, as a real
+    extender would (the reference's is a separate binary by definition):
+    the protocol cost measured is the scheduler-side client + wire, not
+    the extender's own handler sharing the scheduler's GIL."""
+    import multiprocessing as mp_
 
-    def score_fn(pod_dict, names):
-        return names, {name: 1 for name in names}
+    from ..extender import ExtenderConfig, HTTPExtender
 
     def make_extenders():
-        srv = TPUScoreExtenderServer(score_fn)
-        srv.start()
+        # the subprocess target lives in extender.py: a spawn child imports
+        # only stdlib modules, not the jax stack behind the perf package
+        from functools import partial
+
+        from ..extender import run_subprocess_score_server, uniform_score_fn
+
+        ctx = mp_.get_context("spawn")
+        parent, child = ctx.Pipe()
+        proc = ctx.Process(
+            target=partial(run_subprocess_score_server, uniform_score_fn),
+            args=(child,), daemon=True)
+        proc.start()
+        if not parent.poll(60):
+            proc.terminate()
+            raise RuntimeError("extender subprocess failed to start")
+        port = parent.recv()
         ext = HTTPExtender(ExtenderConfig(
-            url_prefix=srv.url, filter_verb="filter", prioritize_verb="prioritize",
-            weight=1, node_cache_capable=True,
+            url_prefix=f"http://127.0.0.1:{port}", filter_verb="filter",
+            prioritize_verb="prioritize", weight=1, node_cache_capable=True,
         ))
-        return [ext], srv.stop
+
+        def stop():
+            ext.close()
+            proc.terminate()
+            proc.join(timeout=5)
+
+        return [ext], stop
 
     return Workload(
         name="SchedulingExtender",
@@ -328,11 +348,13 @@ SUITES: Dict[str, Suite] = {
                "5000Nodes/200InitPods": (5000, 200, 5000)}),
         Suite("SchedulingWithMixedChurn", _mixed_churn,
               {"1000Nodes": (1000, 0, 1000), "5000Nodes": (5000, 0, 2000)}),
-        # extender batch 512: the per-batch fixed tunnel rounds (fused
-        # prepare+first-plane, per-round fetch + commit) amortize over 2
-        # batches instead of 4 for the 1000 measured pods
+        # extender batch 384: large enough to amortize the per-batch fixed
+        # tunnel rounds (fused prepare+first-plane), but UNDER the node
+        # count — the one-commit-per-node round rule defers (batch − nodes)
+        # pods into extra full-priced device rounds at 512 (measured: 384
+        # commits every pod in round one, p99 1.1s vs 1.9s)
         Suite("SchedulingExtender", _extender,
-              {"500Nodes": (500, 500, 1000)}, batch_size=512),
+              {"500Nodes": (500, 500, 1000)}, batch_size=384),
         # The north-star config (BASELINE.md): 5k nodes, 10k pending pods,
         # measured per-attempt
         Suite("NorthStar", _basic, {"5000Nodes/10000Pods": (5000, 2000, 10000)},
