@@ -75,14 +75,21 @@ class HTTPApiClient:
         return _KindClient(self, kind)
 
     def watch_kind(self, kind: str, handler: Callable[[WatchEvent], None],
-                   since_rv: int = 0, timeout_seconds: float = 30):
+                   since_rv: int = 0, timeout_seconds: float = 30,
+                   on_bookmark: Optional[Callable[[int], None]] = None):
+        """Stream watch events to ``handler``.  Bookmarks are requested
+        (allowWatchBookmarks, reflector.go's default) and consumed HERE:
+        they carry no object, only a fresh resourceVersion, which is handed
+        to ``on_bookmark`` (e.g. a Reflector advancing its restart point)
+        rather than surfaced as a WatchEvent."""
         stop = threading.Event()
 
         def run():
             url = self._url(
                 kind,
                 query=f"watch=true&resourceVersion={since_rv}"
-                      f"&timeoutSeconds={timeout_seconds}",
+                      f"&timeoutSeconds={timeout_seconds}"
+                      f"&allowWatchBookmarks=true",
             )
             req = urllib.request.Request(url)
             if self.user:
@@ -96,9 +103,13 @@ class HTTPApiClient:
                         if not line:
                             continue
                         ev = json.loads(line)
-                        obj = self.scheme.decode(ev["object"])
-                        rv = int(ev["object"].get("metadata", {})
+                        rv = int((ev["object"].get("metadata") or {})
                                  .get("resourceVersion", "0"))
+                        if ev["type"] == "BOOKMARK":
+                            if on_bookmark is not None:
+                                on_bookmark(rv)
+                            continue
+                        obj = self.scheme.decode(ev["object"])
                         handler(WatchEvent(ev["type"], kind, obj, rv))
             except Exception:
                 if not stop.is_set():
@@ -211,8 +222,9 @@ class _KindClient:
     def list(self, kind: str):
         return self._client.list(kind)
 
-    def watch(self, handler, since_rv: int = 0):
-        return self._client.watch_kind(self._kind, handler, since_rv=since_rv)
+    def watch(self, handler, since_rv: int = 0, on_bookmark=None):
+        return self._client.watch_kind(self._kind, handler, since_rv=since_rv,
+                                       on_bookmark=on_bookmark)
 
 
 import urllib.error  # noqa: E402  (used in get())

@@ -379,10 +379,19 @@ def _make_handler(api: APIServer):
             })
 
         def _watch(self, kind: str, ns: str, q: dict):
-            """Chunked JSON-lines watch stream from a resourceVersion."""
+            """Chunked JSON-lines watch stream from a resourceVersion.
+
+            ``allowWatchBookmarks=true`` adds periodic BOOKMARK events — an
+            otherwise-empty object carrying just the store's current
+            resourceVersion (the watch cache's bookmark machinery,
+            cacher.go:56,161-185) — so an idle watcher's restart point
+            stays fresh and a relist after disconnect replays almost
+            nothing."""
             since = int(q.get("resourceVersion", ["0"])[0] or 0)
             timeout = float(q.get("timeoutSeconds", ["30"])[0])
+            bookmarks = q.get("allowWatchBookmarks", ["false"])[0] == "true"
             events: "queue.Queue" = queue.Queue(maxsize=4096)
+            lossy = [False]  # an overflowed stream must never bookmark
 
             def on_event(ev):
                 if ev.kind != kind:
@@ -392,33 +401,62 @@ def _make_handler(api: APIServer):
                 try:
                     events.put_nowait(ev)
                 except queue.Full:
-                    pass  # client too slow: it relists on gap detection
+                    # client too slow: it relists on gap detection — and a
+                    # bookmark after a drop could advance the client PAST
+                    # the dropped event, so bookmarks stop for good
+                    lossy[0] = True
 
             unwatch = api.store.watch(on_event, since_rv=since)
+
+            def write_line(payload: dict) -> bool:
+                line = json.dumps(payload).encode() + b"\n"
+                chunk = f"{len(line):X}\r\n".encode() + line + b"\r\n"
+                try:
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                    return True
+                except (BrokenPipeError, ConnectionResetError,
+                        socket.timeout):
+                    return False
+
             try:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 deadline = time.monotonic() + timeout
+                # bookmark cadence: ~1s idle (the reference's cacher sends
+                # them at bookmarkFrequency ~1/min; the sim's watches are
+                # short-lived, so a faster tick keeps the behavior testable)
+                next_bookmark = time.monotonic() + 1.0
                 while True:
                     remain = deadline - time.monotonic()
                     if remain <= 0:
                         break
+                    if bookmarks and time.monotonic() >= next_bookmark:
+                        next_bookmark = time.monotonic() + 1.0
+                        # correctness order: read the rv under the store
+                        # lock FIRST (all events ≤ it have been emitted to
+                        # this watcher's callback), THEN require the queue
+                        # drained — the bookmark then provably covers only
+                        # events already written to the wire (cacher.go
+                        # bookmarks cover progress sent to that watcher)
+                        rv = api.store.current_rv()
+                        if not lossy[0] and events.empty():
+                            if not write_line({
+                                "type": "BOOKMARK",
+                                "object": {"kind": kind, "metadata":
+                                           {"resourceVersion": str(rv)}},
+                            }):
+                                return
                     try:
                         ev = events.get(timeout=min(remain, 0.25))
                     except queue.Empty:
                         continue
-                    line = json.dumps({
+                    if not write_line({
                         "type": ev.type,
                         "object": to_manifest(ev.obj, api.scheme),
-                    }).encode() + b"\n"
-                    chunk = f"{len(line):X}\r\n".encode() + line + b"\r\n"
-                    try:
-                        self.wfile.write(chunk)
-                        self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError,
-                            socket.timeout):
+                    }):
                         return
                 try:
                     self.wfile.write(b"0\r\n\r\n")

@@ -46,8 +46,19 @@ class Reflector:
             for h in self._handlers:
                 h(ADDED, o, None)
         self.last_rv = rv
-        self._unwatch = self.store.watch(self._on_event, since_rv=rv)
+        try:
+            # HTTP stores stream watch BOOKMARKs (rv-only progress marks);
+            # consuming them keeps the relist-after-disconnect point fresh
+            # even when no object events flow.  In-process stores don't
+            # take the kwarg — they have no stream to keep alive.
+            self._unwatch = self.store.watch(
+                self._on_event, since_rv=rv, on_bookmark=self._on_bookmark)
+        except TypeError:
+            self._unwatch = self.store.watch(self._on_event, since_rv=rv)
         self._synced = True
+
+    def _on_bookmark(self, rv: int):
+        self.last_rv = max(self.last_rv, rv)
 
     def stop(self):
         if self._unwatch:

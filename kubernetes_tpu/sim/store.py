@@ -152,6 +152,14 @@ class ObjectStore:
             self._emit(WatchEvent(DELETED, kind, obj, self._rv))
             return obj
 
+    def current_rv(self) -> int:
+        """The latest resourceVersion, read under the store lock — while
+        held, no write is mid-emit, so every event ≤ this rv has been fully
+        delivered to watch callbacks (the watch-bookmark correctness
+        condition)."""
+        with self._lock:
+            return self._rv
+
     def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
         if kind in self.CLUSTER_SCOPED:
             namespace = ""

@@ -297,6 +297,47 @@ def test_watch_streams_events(server):
     assert events[0]["object"]["metadata"]["name"] == "w0"
 
 
+def test_watch_bookmarks(server):
+    """allowWatchBookmarks=true yields periodic BOOKMARK events carrying
+    only the current resourceVersion (the watch cache's bookmark machinery,
+    cacher.go:56,161-185); the HTTP client consumes them via on_bookmark
+    instead of surfacing object events."""
+    from kubernetes_tpu.apiserver import HTTPApiClient
+
+    base = server.url
+    server.store.create("Node", make_node().name("bk0").obj())
+    # raw stream: a bookmark arrives within ~2s of idle watching
+    req = urllib.request.Request(
+        f"{base}/api/v1/nodes?watch=true&resourceVersion=0"
+        f"&timeoutSeconds=4&allowWatchBookmarks=true")
+    types = []
+    with urllib.request.urlopen(req, timeout=8) as resp:
+        for raw in resp:
+            line = raw.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            types.append(ev["type"])
+            if ev["type"] == "BOOKMARK":
+                assert int(ev["object"]["metadata"]["resourceVersion"]) >= 1
+                assert "spec" not in ev["object"]  # rv only, no object body
+                break
+    assert "BOOKMARK" in types and "ADDED" in types
+
+    # client side: bookmarks advance the restart point, never reach handler
+    client = HTTPApiClient(base)
+    got, marks = [], []
+    unwatch = client.watch_kind("Node", got.append, since_rv=0,
+                                timeout_seconds=3,
+                                on_bookmark=marks.append)
+    deadline = time.monotonic() + 6
+    while not marks and time.monotonic() < deadline:
+        time.sleep(0.1)
+    unwatch()
+    assert marks and all(rv >= 1 for rv in marks)
+    assert all(ev.type != "BOOKMARK" for ev in got)
+
+
 def test_reflector_over_http(server):
     """The client-go shape: Reflector(list+watch) drives an informer cache
     over the wire, including events that happen after the initial list."""
