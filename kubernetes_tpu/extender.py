@@ -210,7 +210,8 @@ class HTTPExtender:
                 sock, server_hostname=u.hostname)
         return (sock, sock.makefile("rb"))
 
-    def _send(self, verb: str, payload: dict) -> dict:
+    def _send(self, verb: str, payload: dict,
+              idempotent: bool = False) -> dict:
         """POST over a POOLED persistent connection — hand-rolled HTTP/1.1
         (see the fast-path note above; the stdlib stack's per-message
         parsing was ~1.9ms of GIL per callout).  Keep-alive with one safe
@@ -218,7 +219,13 @@ class HTTPExtender:
         and mid-request errors are NOT retried (the extender may have
         acted).  The reference's client shares one keep-alive http.Client
         (extender.go NewHTTPExtender -> utilnet.SetTransportDefaults) --
-        same discipline, leaner stack."""
+        same discipline, leaner stack.
+
+        ``idempotent`` marks pure-query verbs (filter/prioritize): those may
+        be resent even after a PARTIAL response (server reset mid-reply) —
+        one transient reset otherwise turns the pod unschedulable and costs
+        the suite a 30s backoff window; side-effecting verbs (bind,
+        preempt) never resend once any byte arrived (double-bind hazard)."""
         u = urlparse(self.cfg.url_prefix)
         path = f"{u.path.rstrip('/')}/{verb}"
         body = json.dumps(payload).encode()
@@ -275,7 +282,8 @@ class HTTPExtender:
             except (ConnectionResetError, BrokenPipeError) as e:
                 rfile.close()
                 sock.close()
-                if got_bytes or attempt or fresh:
+                if attempt or (got_bytes and not idempotent) \
+                        or (fresh and not idempotent):
                     raise ExtenderError(str(e)) from e
                 conn = self._fresh_conn()
             except (ValueError, json.JSONDecodeError) as e:
@@ -299,7 +307,7 @@ class HTTPExtender:
             return node_names, {}
         args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
         try:
-            result = self._send(self.cfg.filter_verb, args)
+            result = self._send(self.cfg.filter_verb, args, idempotent=True)
         except Exception as e:
             if self.cfg.ignorable:
                 return node_names, {}
@@ -317,7 +325,8 @@ class HTTPExtender:
             return {}
         args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
         try:
-            result = self._send(self.cfg.prioritize_verb, args)
+            result = self._send(self.cfg.prioritize_verb, args,
+                                idempotent=True)
         except Exception as e:
             if self.cfg.ignorable:
                 return {}
