@@ -27,9 +27,12 @@ class SchemeError(Exception):
 
 
 class Scheme:
-    def __init__(self):
+    def __init__(self, converter=None):
         # kind → (group, canonical version, type)
         self._kinds: Dict[str, Tuple[str, str, Type]] = {}
+        # spoke-version conversion registry (api/conversion.py); None = the
+        # scheme serves canonical versions only
+        self.converter = converter
 
     def add_known_type(self, group: str, version: str, typ: Type) -> "Scheme":
         """AddKnownTypes analog; the type's ``kind`` attribute names it.
@@ -83,6 +86,12 @@ class Scheme:
         group, _version, typ = entry
         api = manifest.get("apiVersion", "")
         if api:
+            # a registered SPOKE version converts to the canonical (hub)
+            # manifest first (api/conversion.py — the apimachinery
+            # conversion path every decode runs through)
+            if self.converter is not None and self.converter.has(kind, api):
+                manifest = self.converter.to_hub(kind, api, manifest)
+                api = manifest.get("apiVersion", "")
             mgroup = api.split("/", 1)[0] if "/" in api else ""
             if mgroup != group:
                 want = f"{group + '/' if group else ''}<version>"
@@ -92,10 +101,31 @@ class Scheme:
                 )
         return typ.from_dict(manifest)
 
+    def convert_manifest(self, obj_or_manifest, target_api_version: str):
+        """Re-serve an object (or its canonical manifest) at a registered
+        spoke apiVersion — the read side of conversion (a client asking for
+        autoscaling/v1 gets the v1 shape of a v2-stored object)."""
+        from .serialize import to_manifest
+
+        manifest = (obj_or_manifest if isinstance(obj_or_manifest, dict)
+                    else to_manifest(obj_or_manifest, self))
+        kind = manifest.get("kind")
+        canonical = manifest.get("apiVersion", "")
+        if target_api_version == canonical:
+            return manifest
+        if self.converter is None or not self.converter.has(
+                kind, target_api_version):
+            raise SchemeError(
+                f"kind {kind!r} is not served at {target_api_version!r}")
+        return self.converter.from_hub(kind, target_api_version, manifest)
+
 
 def default_scheme() -> Scheme:
-    """All served kinds (the analog of each API group's AddToScheme)."""
-    s = Scheme()
+    """All served kinds (the analog of each API group's AddToScheme), with
+    the in-tree spoke-version conversions attached."""
+    from .conversion import default_converter
+
+    s = Scheme(converter=default_converter())
     for typ in (v1.Pod, v1.Node, v1.Service, v1.PersistentVolume,
                 v1.PersistentVolumeClaim, v1.Namespace, v1.ResourceQuota,
                 v1.Endpoints, v1.ServiceAccount):

@@ -1,0 +1,83 @@
+"""Versioned-manifest conversion (apimachinery runtime conversion analog).
+
+Reference: pkg/apis/autoscaling/v1/conversion.go (the structural HPA
+conversion), generated identity conversions for graduated groups
+(batch/v1beta1 CronJob, policy/v1beta1 PDB, discovery v1beta1).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.scheme import SchemeError, default_scheme
+from kubernetes_tpu.api.serialize import to_manifest
+
+SCHEME = default_scheme()
+
+
+def test_hpa_v1_manifest_decodes_structurally():
+    """autoscaling/v1's targetCPUUtilizationPercentage converts into the
+    v2 metrics list the internal type reads."""
+    m = {
+        "apiVersion": "autoscaling/v1", "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                 "minReplicas": 2, "maxReplicas": 8,
+                 "targetCPUUtilizationPercentage": 65},
+    }
+    hpa = SCHEME.decode(m)
+    assert hpa.target_utilization == 65.0
+    assert hpa.min_replicas == 2 and hpa.max_replicas == 8
+    assert hpa.target_name == "web"
+
+
+def test_hpa_served_back_at_v1():
+    """convert_manifest re-serves a v2-stored HPA at the v1 spoke shape."""
+    hpa = SCHEME.decode({
+        "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "api"},
+        "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "api"},
+                 "minReplicas": 1, "maxReplicas": 4,
+                 "metrics": [{"type": "Resource", "resource": {
+                     "name": "cpu",
+                     "target": {"type": "Utilization",
+                                "averageUtilization": 70}}}]},
+    })
+    out = SCHEME.convert_manifest(hpa, "autoscaling/v1")
+    assert out["apiVersion"] == "autoscaling/v1"
+    assert out["spec"]["targetCPUUtilizationPercentage"] == 70
+    assert "metrics" not in out["spec"]
+    # and the spoke round-trips: v1 → hub → v1 preserves the target
+    back = SCHEME.converter.to_hub("HorizontalPodAutoscaler",
+                                   "autoscaling/v1", out)
+    again = SCHEME.converter.from_hub("HorizontalPodAutoscaler",
+                                      "autoscaling/v1", back)
+    assert again["spec"]["targetCPUUtilizationPercentage"] == 70
+
+
+def test_graduated_spoke_versions_decode():
+    """batch/v1beta1 CronJob and policy/v1beta1 PDB manifests (field-
+    identical pre-graduation schemas) decode through the identity spokes."""
+    cj = SCHEME.decode({
+        "apiVersion": "batch/v1beta1", "kind": "CronJob",
+        "metadata": {"name": "nightly", "namespace": "default"},
+        "spec": {"schedule": "0 3 * * *"},
+    })
+    assert cj.schedule == "0 3 * * *"
+    pdb = SCHEME.decode({
+        "apiVersion": "policy/v1beta1", "kind": "PodDisruptionBudget",
+        "metadata": {"name": "pdb", "namespace": "default"},
+        "spec": {"minAvailable": 2,
+                 "selector": {"matchLabels": {"app": "a"}}},
+    })
+    assert pdb.min_available == 2
+
+
+def test_wrong_group_still_rejected():
+    with pytest.raises(SchemeError):
+        SCHEME.decode({"apiVersion": "batch/v1", "kind": "Deployment",
+                       "metadata": {"name": "x"}})
+    with pytest.raises(SchemeError):
+        SCHEME.convert_manifest(
+            to_manifest(v1.Namespace(metadata=v1.ObjectMeta(name="n")),
+                        SCHEME),
+            "policy/v1beta1")
