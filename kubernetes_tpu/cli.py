@@ -147,6 +147,97 @@ class Kubectl:
         self.store.update(kind, o)
         return f"{kind.lower()}/{name} scaled to {replicas}"
 
+    def get_json(self, kind: str, namespace: str, name: str) -> str:
+        """``get -o json``: the object's wire manifest."""
+        from .api.serialize import to_manifest
+        import json
+
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None:
+            return f"{kind} {namespace}/{name} not found"
+        return json.dumps(to_manifest(o, _scheme()), indent=2)
+
+    def label(self, kind: str, namespace: str, name: str,
+              key: str, value: Optional[str]) -> str:
+        """``kubectl label``: value None (key-) removes."""
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None:
+            return f"{kind} {namespace}/{name} not found"
+        labels = dict(o.metadata.labels or {})
+        if value is None:
+            labels.pop(key, None)
+        else:
+            labels[key] = value
+        o.metadata.labels = labels
+        self.store.update(kind, o)
+        return f"{kind.lower()}/{name} labeled"
+
+    def annotate(self, kind: str, namespace: str, name: str,
+                 key: str, value: Optional[str]) -> str:
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None:
+            return f"{kind} {namespace}/{name} not found"
+        ann = dict(getattr(o.metadata, "annotations", {}) or {})
+        if value is None:
+            ann.pop(key, None)
+        else:
+            ann[key] = value
+        o.metadata.annotations = ann
+        self.store.update(kind, o)
+        return f"{kind.lower()}/{name} annotated"
+
+    def patch(self, kind: str, namespace: str, name: str,
+              patch_json: str) -> str:
+        """``kubectl patch --type=merge``: RFC 7386 merge against the
+        manifest, decoded back through the scheme."""
+        import json
+
+        from .api.serialize import to_manifest
+        from .apiserver.server import _merge
+
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        cur = self.store.get(kind, namespace, name)
+        if cur is None:
+            return f"{kind} {namespace}/{name} not found"
+        merged = _merge(to_manifest(cur, _scheme()), json.loads(patch_json))
+        try:
+            obj = _scheme().decode(merged)
+        except SchemeError as e:
+            return f"error: {e}"
+        obj.metadata.uid = cur.metadata.uid
+        self.store.update(kind, obj)
+        return f"{kind.lower()}/{name} patched"
+
+    def rollout_status(self, kind: str, namespace: str, name: str) -> str:
+        """``kubectl rollout status`` for Deployments/ReplicaSets: ready vs
+        desired (kubectl/pkg/polymorphichelpers/rollout_status.go shape)."""
+        kind = KIND_ALIASES.get(kind.lower(), kind)
+        o = self.store.get(kind, namespace, name)
+        if o is None:
+            return f"{kind} {namespace}/{name} not found"
+        desired = getattr(o, "replicas", None)
+        if desired is None:
+            return f"cannot get rollout status for {kind}"
+        if kind == "Deployment":
+            # ready = the template-hash ReplicaSet's ready count
+            ready = sum(
+                rs.status_ready_replicas
+                for rs in self.store.list("ReplicaSet")[0]
+                if rs.metadata.namespace == namespace
+                and any(ref.name == name for ref in
+                        (rs.metadata.owner_references or []))
+            )
+        else:
+            ready = getattr(o, "status_ready_replicas", 0)
+        if ready >= desired:
+            return (f'{kind.lower()} "{name}" successfully rolled out '
+                    f"({ready}/{desired} updated replicas are available)")
+        return (f"Waiting for rollout to finish: {ready} of {desired} "
+                f"updated replicas are available...")
+
     # --- node ops -------------------------------------------------------------
 
     def cordon(self, name: str, on: bool = True) -> str:
@@ -194,6 +285,19 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     g.add_argument("-n", "--namespace")
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
+    for verb in ("label", "annotate"):
+        p = sub.add_parser(verb)
+        p.add_argument("kind"); p.add_argument("name")
+        p.add_argument("kv", help="key=value, or key- to remove")
+        p.add_argument("-n", "--namespace", default="")
+    p = sub.add_parser("patch")
+    p.add_argument("kind"); p.add_argument("name")
+    p.add_argument("-p", "--patch", required=True)
+    p.add_argument("-n", "--namespace", default="")
+    p = sub.add_parser("rollout")
+    p.add_argument("action", choices=["status"])
+    p.add_argument("kind"); p.add_argument("name")
+    p.add_argument("-n", "--namespace", default="default")
     args = ap.parse_args(argv)
     if args.server:
         from .apiserver import HTTPApiClient
@@ -209,6 +313,17 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         with open(args.filename) as f:
             for line in k.apply(f.read()):
                 print(line)
+    elif args.verb in ("label", "annotate"):
+        if args.kv.endswith("-"):
+            key, value = args.kv[:-1], None
+        else:
+            key, _, value = args.kv.partition("=")
+        fn = k.label if args.verb == "label" else k.annotate
+        print(fn(args.kind, args.namespace, args.name, key, value))
+    elif args.verb == "patch":
+        print(k.patch(args.kind, args.namespace, args.name, args.patch))
+    elif args.verb == "rollout":
+        print(k.rollout_status(args.kind, args.namespace, args.name))
     return 0
 
 

@@ -98,3 +98,46 @@ def test_scheduler_binary_with_config(tmp_path):
     rc = main(["--config", str(cfg), "--sim-nodes", "4", "--sim-pods", "4",
                "--batch-size", "4", "--leader-elect"])
     assert rc == 0
+
+
+def test_label_annotate_patch_rollout_and_json():
+    """Round-5 verb additions: label/annotate (add + remove), merge patch,
+    rollout status, get -o json."""
+    import json
+
+    from kubernetes_tpu.api import objects as v1
+
+    store = ObjectStore()
+    k = Kubectl(store)
+    store.create("Node", make_node().name("n1").capacity({"cpu": "4"}).obj())
+    assert "labeled" in k.label("node", "", "n1", "tier", "gold")
+    assert store.get("Node", "", "n1").metadata.labels["tier"] == "gold"
+    assert "labeled" in k.label("node", "", "n1", "tier", None)
+    assert "tier" not in store.get("Node", "", "n1").metadata.labels
+    assert "annotated" in k.annotate("node", "", "n1", "note", "x")
+    assert store.get("Node", "", "n1").metadata.annotations["note"] == "x"
+
+    # merge patch through the scheme
+    assert "patched" in k.patch(
+        "node", "", "n1", json.dumps({"metadata": {"labels": {"zone": "a"}}}))
+    assert store.get("Node", "", "n1").metadata.labels["zone"] == "a"
+
+    # get -o json emits the wire manifest
+    out = json.loads(k.get_json("node", "", "n1"))
+    assert out["kind"] == "Node" and out["metadata"]["name"] == "n1"
+
+    # rollout status: a Deployment with a ready owner-referenced ReplicaSet
+    dep = v1.Deployment(metadata=v1.ObjectMeta(name="web", namespace="default"),
+                        replicas=2)
+    store.create("Deployment", dep)
+    rs = v1.ReplicaSet(metadata=v1.ObjectMeta(
+        name="web-abc", namespace="default",
+        owner_references=[v1.OwnerReference(kind="Deployment", name="web",
+                                            uid=dep.metadata.uid)]),
+        replicas=2)
+    rs.status_ready_replicas = 0
+    store.create("ReplicaSet", rs)
+    assert "Waiting for rollout" in k.rollout_status("deploy", "default", "web")
+    rs.status_ready_replicas = 2
+    store.update("ReplicaSet", rs)
+    assert "successfully rolled out" in k.rollout_status("deploy", "default", "web")
