@@ -1,9 +1,11 @@
-"""Feature gates (reference: component-base/featuregate + the 114 gates of
+"""Feature gates (reference: component-base/featuregate + the gates of
 pkg/features/kube_features.go).
 
-Gates relevant to the scheduling capability surface are pre-registered with
-their ~v1.24 default states; unknown gates can be registered at runtime.
-``--feature-gates``-style strings parse via set_from_string.
+The FULL ~v1.24 registry (113 gates) is pre-registered with the
+reference's default/stage/lock values — the surface --feature-gates accepts;
+the scheduling-relevant subset actually changes behavior here, and unknown
+gates can still be registered at runtime.  ``--feature-gates``-style strings
+parse via set_from_string.
 """
 
 from __future__ import annotations
@@ -42,7 +44,7 @@ class FeatureGate:
         self._enabled[name] = value
 
     def set_from_string(self, s: str) -> None:
-        """'Foo=true,Bar=false' (the --feature-gates flag format)."""
+        ''''Foo=true,Bar=false' (the --feature-gates flag format).'''
         for part in filter(None, (p.strip() for p in s.split(","))):
             name, _, val = part.partition("=")
             self.set(name, val.strip().lower() in ("true", "1", "t"))
@@ -53,19 +55,124 @@ class FeatureGate:
 
 default_feature_gate = FeatureGate()
 
-# scheduling-relevant gates @ ~v1.24 defaults (pkg/features/kube_features.go)
-for _name, _spec in {
-    "DefaultPodTopologySpread": FeatureSpec(True, GA),
-    "MinDomainsInPodTopologySpread": FeatureSpec(False, ALPHA),
-    "NodeAffinityLabelSelector": FeatureSpec(True, GA),
-    "PodAffinityNamespaceSelector": FeatureSpec(True, BETA),
-    "PodOverhead": FeatureSpec(True, BETA),
-    "PodDisruptionBudget": FeatureSpec(True, GA, lock_to_default=True),
-    "PreferNominatedNode": FeatureSpec(True, GA),
-    "VolumeCapacityPriority": FeatureSpec(False, ALPHA),
-    "CSIStorageCapacity": FeatureSpec(True, BETA),
+# the reference's full default gate map @ ~v1.24 (name, default, stage,
+# lock-to-default) — data extracted from pkg/features/kube_features.go's
+# defaultKubernetesFeatureGates; this is API surface (names/defaults), not
+# code.  Gates the scheduler consults are the same entries they always were.
+_DEFAULT_GATES = {
+    "AppArmor": FeatureSpec(True, BETA),
+    "DynamicKubeletConfig": FeatureSpec(False, DEPRECATED),
+    "ExperimentalHostUserNamespaceDefaultingGate": FeatureSpec(False, BETA),
+    "DevicePlugins": FeatureSpec(True, BETA),
+    "RotateKubeletServerCertificate": FeatureSpec(True, BETA),
     "LocalStorageCapacityIsolation": FeatureSpec(True, BETA),
-    "NonPreemptingPriority": FeatureSpec(True, GA),
-    "TaintBasedEvictions": FeatureSpec(True, GA),
-}.items():
+    "EphemeralContainers": FeatureSpec(True, BETA),
+    "QOSReserved": FeatureSpec(False, ALPHA),
+    "ExpandPersistentVolumes": FeatureSpec(True, BETA),
+    "ExpandInUsePersistentVolumes": FeatureSpec(True, BETA),
+    "ExpandCSIVolumes": FeatureSpec(True, BETA),
+    "CPUManager": FeatureSpec(True, BETA),
+    "MemoryManager": FeatureSpec(True, BETA),
+    "CPUCFSQuotaPeriod": FeatureSpec(False, ALPHA),
+    "TopologyManager": FeatureSpec(True, BETA),
+    "StorageObjectInUseProtection": FeatureSpec(True, GA, lock_to_default=True),
+    "CSIMigration": FeatureSpec(True, BETA),
+    "CSIMigrationGCE": FeatureSpec(True, BETA),
+    "InTreePluginGCEUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationAWS": FeatureSpec(True, BETA),
+    "InTreePluginAWSUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationAzureDisk": FeatureSpec(True, BETA),
+    "InTreePluginAzureDiskUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationAzureFile": FeatureSpec(True, BETA),
+    "InTreePluginAzureFileUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationvSphere": FeatureSpec(False, BETA),
+    "InTreePluginvSphereUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationOpenStack": FeatureSpec(True, GA, lock_to_default=True),
+    "InTreePluginOpenStackUnregister": FeatureSpec(False, ALPHA),
+    "CSIMigrationRBD": FeatureSpec(False, ALPHA),
+    "InTreePluginRBDUnregister": FeatureSpec(False, ALPHA),
+    "ConfigurableFSGroupPolicy": FeatureSpec(True, GA, lock_to_default=True),
+    "CSIMigrationPortworx": FeatureSpec(False, ALPHA),
+    "InTreePluginPortworxUnregister": FeatureSpec(False, ALPHA),
+    "CSIInlineVolume": FeatureSpec(True, BETA),
+    "CSIStorageCapacity": FeatureSpec(True, BETA),
+    "CSIServiceAccountToken": FeatureSpec(True, GA, lock_to_default=True),
+    "GenericEphemeralVolume": FeatureSpec(True, GA, lock_to_default=True),
+    "CSIVolumeFSGroupPolicy": FeatureSpec(True, GA, lock_to_default=True),
+    "VolumeSubpath": FeatureSpec(True, GA, lock_to_default=True),
+    "NetworkPolicyEndPort": FeatureSpec(True, BETA),
+    "ProcMountType": FeatureSpec(False, ALPHA),
+    "TTLAfterFinished": FeatureSpec(True, GA, lock_to_default=True),
+    "IndexedJob": FeatureSpec(True, BETA),
+    "JobTrackingWithFinalizers": FeatureSpec(True, BETA),
+    "JobReadyPods": FeatureSpec(False, ALPHA),
+    "KubeletPodResources": FeatureSpec(True, BETA),
+    "LocalStorageCapacityIsolationFSQuotaMonitoring": FeatureSpec(False, ALPHA),
+    "NonPreemptingPriority": FeatureSpec(True, GA, lock_to_default=True),
+    "PodOverhead": FeatureSpec(True, BETA),
+    "IPv6DualStack": FeatureSpec(True, GA, lock_to_default=True),
+    "EndpointSlice": FeatureSpec(True, GA, lock_to_default=True),
+    "EndpointSliceProxying": FeatureSpec(True, GA, lock_to_default=True),
+    "EndpointSliceTerminatingCondition": FeatureSpec(True, BETA),
+    "ProxyTerminatingEndpoints": FeatureSpec(False, ALPHA),
+    "EndpointSliceNodeName": FeatureSpec(True, GA, lock_to_default=True),
+    "WindowsEndpointSliceProxying": FeatureSpec(True, GA, lock_to_default=True),
+    "PodDisruptionBudget": FeatureSpec(True, GA, lock_to_default=True),
+    "DaemonSetUpdateSurge": FeatureSpec(True, BETA),
+    "DownwardAPIHugePages": FeatureSpec(True, BETA),
+    "AnyVolumeDataSource": FeatureSpec(False, ALPHA),
+    "DefaultPodTopologySpread": FeatureSpec(True, GA, lock_to_default=True),
+    "WinOverlay": FeatureSpec(True, BETA),
+    "WinDSR": FeatureSpec(False, ALPHA),
+    "DisableAcceleratorUsageMetrics": FeatureSpec(True, BETA),
+    "HPAContainerMetrics": FeatureSpec(False, ALPHA),
+    "SizeMemoryBackedVolumes": FeatureSpec(True, BETA),
+    "ExecProbeTimeout": FeatureSpec(True, GA),
+    "KubeletCredentialProviders": FeatureSpec(False, ALPHA),
+    "GracefulNodeShutdown": FeatureSpec(True, BETA),
+    "GracefulNodeShutdownBasedOnPodPriority": FeatureSpec(False, ALPHA),
+    "ServiceLBNodePortControl": FeatureSpec(True, GA, lock_to_default=True),
+    "MixedProtocolLBService": FeatureSpec(False, ALPHA),
+    "VolumeCapacityPriority": FeatureSpec(False, ALPHA),
+    "PreferNominatedNode": FeatureSpec(True, GA, lock_to_default=True),
+    "ProbeTerminationGracePeriod": FeatureSpec(False, BETA),
+    "NodeSwap": FeatureSpec(False, ALPHA),
+    "PodDeletionCost": FeatureSpec(True, BETA),
+    "StatefulSetAutoDeletePVC": FeatureSpec(False, ALPHA),
+    "TopologyAwareHints": FeatureSpec(False, BETA),
+    "PodAffinityNamespaceSelector": FeatureSpec(True, GA, lock_to_default=True),
+    "ServiceLoadBalancerClass": FeatureSpec(True, BETA),
+    "IngressClassNamespacedParams": FeatureSpec(True, GA, lock_to_default=True),
+    "ServiceInternalTrafficPolicy": FeatureSpec(True, BETA),
+    "LogarithmicScaleDown": FeatureSpec(True, BETA),
+    "SuspendJob": FeatureSpec(True, GA, lock_to_default=True),
+    "KubeletPodResourcesGetAllocatable": FeatureSpec(True, BETA),
+    "CSIVolumeHealth": FeatureSpec(False, ALPHA),
+    "WindowsHostProcessContainers": FeatureSpec(True, BETA),
+    "DisableCloudProviders": FeatureSpec(False, ALPHA),
+    "DisableKubeletCloudCredentialProviders": FeatureSpec(False, ALPHA),
+    "StatefulSetMinReadySeconds": FeatureSpec(True, BETA),
+    "ExpandedDNSConfig": FeatureSpec(False, ALPHA),
+    "SeccompDefault": FeatureSpec(False, ALPHA),
+    "PodSecurity": FeatureSpec(True, BETA),
+    "ReadWriteOncePod": FeatureSpec(False, ALPHA),
+    "CSRDuration": FeatureSpec(True, BETA),
+    "DelegateFSGroupToCSIDriver": FeatureSpec(True, BETA),
+    "KubeletInUserNamespace": FeatureSpec(False, ALPHA),
+    "MemoryQoS": FeatureSpec(False, ALPHA),
+    "CPUManagerPolicyOptions": FeatureSpec(True, BETA),
+    "ControllerManagerLeaderMigration": FeatureSpec(True, BETA),
+    "CPUManagerPolicyAlphaOptions": FeatureSpec(False, ALPHA),
+    "CPUManagerPolicyBetaOptions": FeatureSpec(True, BETA),
+    "JobMutableNodeSchedulingDirectives": FeatureSpec(True, BETA),
+    "IdentifyPodOS": FeatureSpec(False, ALPHA),
+    "PodAndContainerStatsFromCRI": FeatureSpec(False, ALPHA),
+    "HonorPVReclaimPolicy": FeatureSpec(False, BETA),
+    "RecoverVolumeExpansionFailure": FeatureSpec(False, ALPHA),
+    "GRPCContainerProbe": FeatureSpec(False, ALPHA),
+    "LegacyServiceAccountTokenNoAutoGeneration": FeatureSpec(True, BETA),
+    "MinDomainsInPodTopologySpread": FeatureSpec(False, ALPHA),
+    "HPAScaleToZero": FeatureSpec(False, ALPHA),
+}
+for _name, _spec in _DEFAULT_GATES.items():
     default_feature_gate.register(_name, _spec)
