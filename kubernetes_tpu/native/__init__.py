@@ -121,3 +121,50 @@ class NativeInterner:
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n
         )
         return out
+
+
+# --- native preemption victim sweep ------------------------------------------
+
+_ps_lock = threading.Lock()
+_ps_lib: Optional[ctypes.CDLL] = None
+_ps_tried = False
+
+_PS_SRC = os.path.join(os.path.dirname(__file__), "preempt_sweep.cpp")
+_PS_SO = os.path.join(os.path.dirname(__file__), "_preempt_sweep.so")
+
+
+def load_preempt_sweep() -> Optional[ctypes.CDLL]:
+    """C++ reprieve sweep + candidate ranking (preemption.py preempt_plain's
+    hot loop); compiled on first use, None without a toolchain — callers
+    fall back to the numpy path, which stays the parity oracle."""
+    global _ps_lib, _ps_tried
+    with _ps_lock:
+        if _ps_tried:
+            return _ps_lib
+        _ps_tried = True
+        if os.environ.get("KTPU_NO_NATIVE"):
+            _ps_lib = None
+            return None
+        try:
+            if not os.path.exists(_PS_SO) or (
+                os.path.getmtime(_PS_SO) < os.path.getmtime(_PS_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _PS_SO, _PS_SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_PS_SO)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.ktpu_preempt_sweep.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                i64p, i64p, i64p, u8p, u8p, i64p,
+                ctypes.POINTER(ctypes.c_double), i64p,
+                u8p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32), u8p,
+            ]
+            lib.ktpu_preempt_sweep.restype = ctypes.c_int64
+            _ps_lib = lib
+        except Exception:
+            _ps_lib = None
+        return _ps_lib

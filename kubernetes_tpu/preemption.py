@@ -134,6 +134,87 @@ def candidate_mask_device(batch, snap, dyn, static_ok_mask, levels=None):
     return fits & has_victims & static_ok_mask
 
 
+def _sweep_and_rank(base, alloc, vr, v_valid, v_viol, v_prio, v_ts, req_v):
+    """The reprieve sweep + pickOneNodeForPreemption ranking over flat
+    candidate arrays → (victim_mask, nviol, order, valid), or
+    (..., None) when no candidate fits at all.
+
+    Dispatches to the native C++ single pass (native/preempt_sweep.cpp)
+    when available — the numpy path below is the parity oracle
+    (tests/test_preemption.py pins native == numpy on randomized inputs)
+    and the fallback without a toolchain or under KTPU_NO_NATIVE."""
+    c, vmax = v_valid.shape
+    lib = None
+    if c and vmax:
+        from .native import load_preempt_sweep
+
+        lib = load_preempt_sweep()
+    if lib is not None:
+        import ctypes
+
+        i64 = np.ascontiguousarray
+        base_c = i64(base, dtype=np.int64)
+        alloc_c = i64(alloc, dtype=np.int64)
+        vr_c = i64(vr, dtype=np.int64)
+        valid_c = np.ascontiguousarray(v_valid, dtype=np.uint8)
+        viol_c = np.ascontiguousarray(v_viol, dtype=np.uint8)
+        prio_c = i64(v_prio, dtype=np.int64)
+        ts_c = np.ascontiguousarray(v_ts, dtype=np.float64)
+        req_c = i64(req_v, dtype=np.int64)
+        victim_mask = np.zeros((c, vmax), dtype=np.uint8)
+        order = np.zeros(c, dtype=np.int32)
+        nviol = np.zeros(c, dtype=np.int32)
+        valid = np.zeros(c, dtype=np.uint8)
+
+        def p(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        n_valid = lib.ktpu_preempt_sweep(
+            c, vmax, base_c.shape[1],
+            p(base_c, ctypes.c_int64), p(alloc_c, ctypes.c_int64),
+            p(vr_c, ctypes.c_int64), p(valid_c, ctypes.c_uint8),
+            p(viol_c, ctypes.c_uint8), p(prio_c, ctypes.c_int64),
+            p(ts_c, ctypes.c_double), p(req_c, ctypes.c_int64),
+            p(victim_mask, ctypes.c_uint8), p(order, ctypes.c_int32),
+            p(nviol, ctypes.c_int32), p(valid, ctypes.c_uint8),
+        )
+        if n_valid == 0:
+            return victim_mask.astype(bool), nviol, order, None
+        return victim_mask.astype(bool), nviol, order, valid.astype(bool)
+
+    def fits(u):
+        free = alloc - u
+        return np.all((req_v == 0) | (req_v <= free), axis=1)
+
+    feasible = fits(base)
+    if not feasible.any():
+        return None, None, None, None
+    used = base.copy()
+    reprieved = np.zeros_like(v_valid)
+    for vi in range(v_valid.shape[1]):
+        trial = used + vr[:, vi]
+        ok = fits(trial) & v_valid[:, vi] & feasible
+        used = np.where(ok[:, None], trial, used)
+        reprieved[:, vi] = ok
+    victim_mask = v_valid & ~reprieved
+    count = victim_mask.sum(axis=1)
+    valid = feasible & (count > 0)
+    big = np.int64(1) << 60
+    nviol = (victim_mask & v_viol).sum(axis=1)
+    top_prio = np.where(victim_mask, v_prio, -big).max(axis=1)
+    sum_key = np.where(victim_mask, v_prio + (1 << 31), 0).sum(axis=1)
+    is_top = victim_mask & (v_prio == top_prio[:, None])
+    earliest = np.where(is_top, v_ts, np.inf).min(axis=1)
+    # pickOneNodeForPreemption's lexicographic chain; invalid rows rank
+    # last, full ties resolve to the first candidate in window order
+    # (np.lexsort is stable; last key is most significant)
+    order = np.lexsort((
+        -earliest, count, sum_key, top_prio,
+        nviol, np.where(valid, 0, 1),
+    ))
+    return victim_mask, nviol, order, valid
+
+
 def pods_with_pdb_violation(
     victims: Sequence[v1.Pod], pdbs: Sequence[v1.PodDisruptionBudget]
 ) -> Tuple[List[v1.Pod], List[v1.Pod]]:
@@ -353,40 +434,12 @@ class Evaluator:
         vr = tables.vr_mat[rows]
         v_valid = tables.v_valid[rows]
 
-        def fits(u):
-            free = alloc - u
-            return np.all((req_v == 0) | (req_v <= free), axis=1)
-
-        feasible = fits(base)
-        if not feasible.any():
+        victim_mask, nviol, order, valid = _sweep_and_rank(
+            base, alloc, vr, v_valid, tables.v_viol[rows],
+            tables.v_prio[rows], tables.v_ts[rows], req_v,
+        )
+        if valid is None or not valid.any():
             return None
-        used = base.copy()
-        reprieved = np.zeros_like(v_valid)
-        for vi in range(v_valid.shape[1]):
-            trial = used + vr[:, vi]
-            ok = fits(trial) & v_valid[:, vi] & feasible
-            used = np.where(ok[:, None], trial, used)
-            reprieved[:, vi] = ok
-        victim_mask = v_valid & ~reprieved
-        count = victim_mask.sum(axis=1)
-        valid = feasible & (count > 0)
-        if not valid.any():
-            return None
-        v_prio = tables.v_prio[rows]
-        v_ts = tables.v_ts[rows]
-        big = np.int64(1) << 60
-        nviol = (victim_mask & tables.v_viol[rows]).sum(axis=1)
-        top_prio = np.where(victim_mask, v_prio, -big).max(axis=1)
-        sum_key = np.where(victim_mask, v_prio + (1 << 31), 0).sum(axis=1)
-        is_top = victim_mask & (v_prio == top_prio[:, None])
-        earliest = np.where(is_top, v_ts, np.inf).min(axis=1)
-        # pickOneNodeForPreemption's lexicographic chain; invalid rows rank
-        # last, full ties resolve to the first candidate in window order
-        # (np.lexsort is stable; last key is most significant)
-        order = np.lexsort((
-            -earliest, count, sum_key, top_prio,
-            nviol, np.where(valid, 0, 1),
-        ))
         for oi in order:
             if not valid[oi]:
                 return None

@@ -248,3 +248,67 @@ def test_candidate_mask_segment_sum_matches_einsum():
             batch, dsnap, dyn, static_ok, jnp.asarray(levels)))
         dense = np.asarray(candidate_mask_device(batch, dsnap, dyn, static_ok))
         assert np.array_equal(fast, dense), f"trial {trial}"
+
+
+def test_native_sweep_matches_numpy_oracle():
+    """native/preempt_sweep.cpp == the numpy reprieve+ranking path on
+    randomized inputs (valid rows only — invalid rows are never read)."""
+    import numpy as np
+
+    from kubernetes_tpu.native import load_preempt_sweep
+    from kubernetes_tpu.preemption import _sweep_and_rank
+
+    if load_preempt_sweep() is None:
+        import pytest as _pytest
+
+        _pytest.skip("no native toolchain")
+
+    rng = np.random.default_rng(11)
+    for trial in range(40):
+        c = int(rng.integers(1, 24))
+        vmax = int(rng.integers(1, 7))
+        r = 4
+        alloc = rng.integers(4, 4000, size=(c, r)).astype(np.int64)
+        vr = rng.integers(0, 900, size=(c, vmax, r)).astype(np.int64)
+        v_valid = rng.random((c, vmax)) < 0.8
+        vr[~v_valid] = 0
+        used_now = (vr * v_valid[:, :, None]).sum(axis=1) \
+            + rng.integers(0, 500, size=(c, r))
+        base = used_now - (vr * v_valid[:, :, None]).sum(axis=1)
+        v_viol = rng.random((c, vmax)) < 0.3
+        v_prio = rng.integers(0, 5, size=(c, vmax)).astype(np.int64)
+        v_ts = rng.integers(0, 100, size=(c, vmax)).astype(np.float64)
+        req_v = rng.integers(0, 1200, size=r).astype(np.int64)
+
+        import os
+
+        nat = _sweep_and_rank(base, alloc, vr, v_valid, v_viol, v_prio,
+                              v_ts, req_v)
+        os.environ["KTPU_NO_NATIVE"] = "1"
+        try:
+            import kubernetes_tpu.native as native_mod
+
+            # force the numpy fallback regardless of the cached lib
+            saved = native_mod.load_preempt_sweep
+            native_mod.load_preempt_sweep = lambda: None
+            ref = _sweep_and_rank(base, alloc, vr, v_valid, v_viol, v_prio,
+                                  v_ts, req_v)
+            native_mod.load_preempt_sweep = saved
+        finally:
+            os.environ.pop("KTPU_NO_NATIVE", None)
+
+        n_mask, n_nviol, n_order, n_valid = nat
+        r_mask, r_nviol, r_order, r_valid = ref
+        if r_valid is None or not r_valid.any():
+            assert n_valid is None or not n_valid.any(), f"trial {trial}"
+            continue
+        assert n_valid is not None, f"trial {trial}"
+        assert np.array_equal(n_valid, r_valid), f"trial {trial}"
+        # identical ranked prefix of VALID candidates, identical victim
+        # sets + violation counts on them
+        n_pref = [i for i in n_order if n_valid[i]]
+        r_pref = [i for i in r_order if r_valid[i]]
+        assert n_pref == r_pref, f"trial {trial}: {n_pref} != {r_pref}"
+        for i in n_pref:
+            assert np.array_equal(n_mask[i], r_mask[i]), f"trial {trial} c{i}"
+            assert n_nviol[i] == r_nviol[i], f"trial {trial} c{i}"
