@@ -222,11 +222,11 @@ class GoEnvelope:
             dom_counts = np.bincount(
                 self.domain_id, weights=self.match_count,
                 minlength=self.n_domains)
-        if self.spread is not None:
+        if self.spread is not None and not self.spread.get("schedule_anyway"):
             skew_ok = (dom_counts[self.domain_id[order]] + 1.0
                        - dom_counts.min()) <= self.spread["max_skew"]
             fits &= skew_ok
-        if self.ipa is not None:
+        if self.ipa is not None and not self.ipa.get("preferred"):
             if self.ipa.get("anti"):
                 fits &= dom_counts[self.domain_id[order]] == 0
             else:
@@ -340,7 +340,30 @@ def suite_envelope_config(suite: str, n_nodes: int, init_pods: int) -> dict:
     base = {"node_template": w.node_default, "init_template": None,
             "init_count": 0, "init_matches": False, "kwargs": {},
             "measure_template": None}
-    if suite == "TopologySpreading":
+    if suite == "PreferredTopologySpreading":
+        base.update(
+            node_template=w.node_zoned(w.ZONES3),
+            init_template=w.pod_default, init_count=init_pods,
+            measure_template=w.pod_preferred_topology_spread,
+            kwargs={"spread": {"key": "topology.kubernetes.io/zone",
+                               "max_skew": 5, "schedule_anyway": True}},
+        )
+    elif suite == "SchedulingPreferredPodAffinity":
+        base.update(
+            node_template=w.node_unique_hostname,
+            init_template=w.pod_preferred_affinity("sched-0"),
+            init_count=init_pods, init_matches=True,
+            measure_template=w.pod_preferred_affinity("sched-1"),
+            kwargs={"ipa": {"key": "kubernetes.io/hostname",
+                            "anti": False, "preferred": True}},
+        )
+    elif suite == "SchedulingNodeAffinity":
+        base.update(
+            node_template=w.node_zoned(["zone1"]),
+            init_template=w.pod_node_affinity, init_count=init_pods,
+            measure_template=w.pod_node_affinity,
+        )
+    elif suite == "TopologySpreading":
         base.update(
             node_template=w.node_zoned(w.ZONES3),
             init_template=w.pod_default, init_count=init_pods,

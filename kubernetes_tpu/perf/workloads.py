@@ -134,6 +134,51 @@ def pod_topology_spread(i: int) -> v1.Pod:
     )
 
 
+def pod_preferred_topology_spread(i: int) -> v1.Pod:
+    """pod-with-preferred-topology-spreading.yaml: maxSkew=5 ScheduleAnyway."""
+    return (
+        _base_pod(i, "pspread", "default")
+        .req({"cpu": "100m", "memory": "500Mi"})
+        .label("color", "blue")
+        .topology_spread(
+            5, "topology.kubernetes.io/zone",
+            when_unsatisfiable=v1.SCHEDULE_ANYWAY,
+            labels={"color": "blue"},
+        )
+        .obj()
+    )
+
+
+def pod_node_affinity(i: int) -> v1.Pod:
+    """pod-with-node-affinity.yaml: required node affinity zone In
+    {zone1, zone2}."""
+    return (
+        _base_pod(i, "naff", "default")
+        .req({"cpu": "100m", "memory": "500Mi"})
+        .node_affinity_in("topology.kubernetes.io/zone", ["zone1", "zone2"])
+        .obj()
+    )
+
+
+def pod_preferred_affinity(ns: str) -> Callable[[int], v1.Pod]:
+    """pod-with-preferred-pod-affinity.yaml: color=red, PREFERRED (w=1)
+    affinity on hostname across sched-0/sched-1."""
+
+    def tmpl(i: int) -> v1.Pod:
+        return (
+            _base_pod(i, f"paff-{ns}", ns)
+            .req({"cpu": "100m", "memory": "500Mi"})
+            .label("color", "red")
+            .pod_affinity(
+                "kubernetes.io/hostname", {"color": "red"}, weight=1,
+                namespaces=["sched-1", "sched-0"],
+            )
+            .obj()
+        )
+
+    return tmpl
+
+
 @dataclass
 class Suite:
     name: str
@@ -196,6 +241,46 @@ def _topology(n, p, mp) -> Workload:
             Op("createNodes", n, node_template=node_zoned(ZONES3)),
             Op("createPods", p, pod_template=pod_default),
             Op("createPods", mp, pod_template=pod_topology_spread,
+               collect_metrics=True),
+        ],
+        batch_size=256,
+    )
+
+
+def _node_affinity(n, p, mp) -> Workload:
+    return Workload(
+        name="SchedulingNodeAffinity",
+        ops=[
+            Op("createNodes", n, node_template=node_zoned(["zone1"])),
+            Op("createPods", p, pod_template=pod_node_affinity),
+            Op("createPods", mp, pod_template=pod_node_affinity,
+               collect_metrics=True),
+        ],
+        batch_size=256,
+    )
+
+
+def _preferred_affinity(n, p, mp) -> Workload:
+    return Workload(
+        name="SchedulingPreferredPodAffinity",
+        ops=[
+            Op("createNodes", n, node_template=node_unique_hostname),
+            Op("createPods", p, pod_template=pod_preferred_affinity("sched-0")),
+            Op("createPods", mp,
+               pod_template=pod_preferred_affinity("sched-1"),
+               collect_metrics=True),
+        ],
+        batch_size=256,
+    )
+
+
+def _preferred_topology(n, p, mp) -> Workload:
+    return Workload(
+        name="PreferredTopologySpreading",
+        ops=[
+            Op("createNodes", n, node_template=node_zoned(ZONES3)),
+            Op("createPods", p, pod_template=pod_default),
+            Op("createPods", mp, pod_template=pod_preferred_topology_spread,
                collect_metrics=True),
         ],
         batch_size=256,
@@ -337,6 +422,12 @@ SUITES: Dict[str, Suite] = {
               {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
         Suite("TopologySpreading", _topology,
               {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
+        Suite("PreferredTopologySpreading", _preferred_topology,
+              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
+        Suite("SchedulingNodeAffinity", _node_affinity,
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
+        Suite("SchedulingPreferredPodAffinity", _preferred_affinity,
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
         Suite("PreemptionBasic", _preemption,
               {"500Nodes": (500, 2000, 500), "5000Nodes": (5000, 20000, 5000)},
               # 5k: every measured pod needs a fail→preempt→retry pair of
