@@ -25,6 +25,9 @@ run SchedulingBasic 5000Nodes
 run SchedulingPodAntiAffinity 5000Nodes
 run SchedulingPodAffinity 5000Nodes
 run TopologySpreading 5000Nodes
+run PreferredTopologySpreading 5000Nodes
+run SchedulingNodeAffinity 5000Nodes
+run SchedulingPreferredPodAffinity 5000Nodes
 run Unschedulable 5000Nodes/200InitPods
 run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
