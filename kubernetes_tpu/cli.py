@@ -222,13 +222,20 @@ class Kubectl:
         if desired is None:
             return f"cannot get rollout status for {kind}"
         if kind == "Deployment":
-            # ready = the template-hash ReplicaSet's ready count
+            # ready = the CURRENT-template ReplicaSet's ready count (the
+            # reference's updatedReplicas view): owner kind+name checked,
+            # and only the RS named for the deployment's template hash —
+            # an old RS's still-ready pods must not report a rollout done
+            from .controllers.deployment import _template_hash
+
+            current_rs = f"{name}-{_template_hash(o.template)}"
             ready = sum(
                 rs.status_ready_replicas
                 for rs in self.store.list("ReplicaSet")[0]
                 if rs.metadata.namespace == namespace
-                and any(ref.name == name for ref in
-                        (rs.metadata.owner_references or []))
+                and rs.metadata.name == current_rs
+                and any(ref.kind == "Deployment" and ref.name == name
+                        for ref in (rs.metadata.owner_references or []))
             )
         else:
             ready = getattr(o, "status_ready_replicas", 0)
@@ -282,6 +289,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     sub = ap.add_subparsers(dest="verb", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["json"])
     g.add_argument("-n", "--namespace")
     a = sub.add_parser("apply")
     a.add_argument("-f", "--filename", required=True)
@@ -289,11 +298,13 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         p = sub.add_parser(verb)
         p.add_argument("kind"); p.add_argument("name")
         p.add_argument("kv", help="key=value, or key- to remove")
-        p.add_argument("-n", "--namespace", default="")
+        # namespaced objects live under "default" unless told otherwise
+        # (cluster-scoped kinds coerce the namespace to "" in the store)
+        p.add_argument("-n", "--namespace", default="default")
     p = sub.add_parser("patch")
     p.add_argument("kind"); p.add_argument("name")
     p.add_argument("-p", "--patch", required=True)
-    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("-n", "--namespace", default="default")
     p = sub.add_parser("rollout")
     p.add_argument("action", choices=["status"])
     p.add_argument("kind"); p.add_argument("name")
@@ -308,14 +319,21 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         store = ObjectStore()
     k = Kubectl(store)
     if args.verb == "get":
-        print(k.get(args.kind, args.namespace))
+        if args.name and args.output == "json":
+            print(k.get_json(args.kind, args.namespace or "default",
+                             args.name))
+        elif args.name:
+            print(k.describe(args.kind, args.namespace or "default",
+                             args.name))
+        else:
+            print(k.get(args.kind, args.namespace))
     elif args.verb == "apply":
         with open(args.filename) as f:
             for line in k.apply(f.read()):
                 print(line)
     elif args.verb in ("label", "annotate"):
-        if args.kv.endswith("-"):
-            key, value = args.kv[:-1], None
+        if "=" not in args.kv and args.kv.endswith("-"):
+            key, value = args.kv[:-1], None  # key- removes
         else:
             key, _, value = args.kv.partition("=")
         fn = k.label if args.verb == "label" else k.annotate

@@ -1,8 +1,9 @@
 """Native (C++) host hot paths, loaded via ctypes with a Python fallback.
 
-``load_interner()`` compiles interner.cpp with g++ on first use (cached .so next
-to the source) and returns the ctypes handle module, or None when no toolchain
-is available — callers (state/dictionary.py) fall back to pure Python.
+Each kernel compiles its .cpp with g++ on first use (cached .so next to the
+source) through one shared loader; callers fall back to pure Python when no
+toolchain is available or KTPU_NO_NATIVE is set (both backends stay tested —
+the Python paths are the parity oracles).
 """
 
 from __future__ import annotations
@@ -11,55 +12,77 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+_HERE = os.path.dirname(__file__)
 
-_SRC = os.path.join(os.path.dirname(__file__), "interner.cpp")
-_SO = os.path.join(os.path.dirname(__file__), "_interner.so")
+
+class _NativeLib:
+    """Shared compile-and-cache scaffold: lock, one attempt, mtime-gated
+    g++ rebuild, CDLL load + prototype configuration, exception → None,
+    KTPU_NO_NATIVE opt-out — applied uniformly to every kernel."""
+
+    def __init__(self, src: str, so: str,
+                 configure: Callable[[ctypes.CDLL], None]):
+        self._src = os.path.join(_HERE, src)
+        self._so = os.path.join(_HERE, so)
+        self._configure = configure
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if self._tried:
+                return self._lib
+            self._tried = True
+            if os.environ.get("KTPU_NO_NATIVE"):
+                return None
+            try:
+                if not os.path.exists(self._so) or (
+                    os.path.getmtime(self._so) < os.path.getmtime(self._src)
+                ):
+                    subprocess.run(
+                        ["g++", "-O2", "-shared", "-fPIC", "-o",
+                         self._so, self._src],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                lib = ctypes.CDLL(self._so)
+                self._configure(lib)
+                self._lib = lib
+            except Exception:
+                self._lib = None
+            return self._lib
+
+
+def _configure_interner(lib: ctypes.CDLL) -> None:
+    lib.ktpu_interner_new.restype = ctypes.c_void_p
+    lib.ktpu_interner_free.argtypes = [ctypes.c_void_p]
+    lib.ktpu_interner_size.argtypes = [ctypes.c_void_p]
+    lib.ktpu_interner_size.restype = ctypes.c_int64
+    lib.ktpu_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.ktpu_intern.restype = ctypes.c_int32
+    lib.ktpu_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.ktpu_lookup.restype = ctypes.c_int32
+    lib.ktpu_intern_many.argtypes = [
+    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ktpu_intern_many.restype = ctypes.c_int64
+    lib.ktpu_numeric_table.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+    ]
+    lib.ktpu_string.argtypes = [
+    ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.ktpu_string.restype = ctypes.c_int64
+
+
+_interner = _NativeLib("interner.cpp", "_interner.so", _configure_interner)
 
 
 def load_interner() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        try:
-            if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
-                    check=True, capture_output=True, timeout=120,
-                )
-            lib = ctypes.CDLL(_SO)
-            lib.ktpu_interner_new.restype = ctypes.c_void_p
-            lib.ktpu_interner_free.argtypes = [ctypes.c_void_p]
-            lib.ktpu_interner_size.argtypes = [ctypes.c_void_p]
-            lib.ktpu_interner_size.restype = ctypes.c_int64
-            lib.ktpu_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
-            lib.ktpu_intern.restype = ctypes.c_int32
-            lib.ktpu_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
-            lib.ktpu_lookup.restype = ctypes.c_int32
-            lib.ktpu_intern_many.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int32),
-            ]
-            lib.ktpu_intern_many.restype = ctypes.c_int64
-            lib.ktpu_numeric_table.argtypes = [
-                ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
-            ]
-            lib.ktpu_string.argtypes = [
-                ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
-            ]
-            lib.ktpu_string.restype = ctypes.c_int64
-            _lib = lib
-        except Exception:
-            _lib = None
-        return _lib
+    return _interner.load()
 
 
 class NativeInterner:
@@ -123,48 +146,26 @@ class NativeInterner:
         return out
 
 
-# --- native preemption victim sweep ------------------------------------------
 
-_ps_lock = threading.Lock()
-_ps_lib: Optional[ctypes.CDLL] = None
-_ps_tried = False
+def _configure_preempt_sweep(lib: ctypes.CDLL) -> None:
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ktpu_preempt_sweep.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        i64p, i64p, i64p, u8p, u8p, i64p,
+        ctypes.POINTER(ctypes.c_double), i64p,
+        u8p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), u8p,
+    ]
+    lib.ktpu_preempt_sweep.restype = ctypes.c_int64
 
-_PS_SRC = os.path.join(os.path.dirname(__file__), "preempt_sweep.cpp")
-_PS_SO = os.path.join(os.path.dirname(__file__), "_preempt_sweep.so")
+
+_preempt_sweep = _NativeLib("preempt_sweep.cpp", "_preempt_sweep.so",
+                            _configure_preempt_sweep)
 
 
 def load_preempt_sweep() -> Optional[ctypes.CDLL]:
     """C++ reprieve sweep + candidate ranking (preemption.py preempt_plain's
-    hot loop); compiled on first use, None without a toolchain — callers
+    hot loop); None without a toolchain or under KTPU_NO_NATIVE — callers
     fall back to the numpy path, which stays the parity oracle."""
-    global _ps_lib, _ps_tried
-    with _ps_lock:
-        if _ps_tried:
-            return _ps_lib
-        _ps_tried = True
-        if os.environ.get("KTPU_NO_NATIVE"):
-            _ps_lib = None
-            return None
-        try:
-            if not os.path.exists(_PS_SO) or (
-                os.path.getmtime(_PS_SO) < os.path.getmtime(_PS_SRC)
-            ):
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _PS_SO, _PS_SRC],
-                    check=True, capture_output=True, timeout=120,
-                )
-            lib = ctypes.CDLL(_PS_SO)
-            i64p = ctypes.POINTER(ctypes.c_int64)
-            u8p = ctypes.POINTER(ctypes.c_uint8)
-            lib.ktpu_preempt_sweep.argtypes = [
-                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                i64p, i64p, i64p, u8p, u8p, i64p,
-                ctypes.POINTER(ctypes.c_double), i64p,
-                u8p, ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), u8p,
-            ]
-            lib.ktpu_preempt_sweep.restype = ctypes.c_int64
-            _ps_lib = lib
-        except Exception:
-            _ps_lib = None
-        return _ps_lib
+    return _preempt_sweep.load()

@@ -126,12 +126,22 @@ def test_label_annotate_patch_rollout_and_json():
     out = json.loads(k.get_json("node", "", "n1"))
     assert out["kind"] == "Node" and out["metadata"]["name"] == "n1"
 
-    # rollout status: a Deployment with a ready owner-referenced ReplicaSet
+    # rollout status: only the CURRENT-template-hash ReplicaSet counts (an
+    # old RS's ready pods must not report the rollout done)
+    from kubernetes_tpu.controllers.deployment import _template_hash
+
     dep = v1.Deployment(metadata=v1.ObjectMeta(name="web", namespace="default"),
                         replicas=2)
     store.create("Deployment", dep)
+    stale = v1.ReplicaSet(metadata=v1.ObjectMeta(
+        name="web-oldhash", namespace="default",
+        owner_references=[v1.OwnerReference(kind="Deployment", name="web",
+                                            uid=dep.metadata.uid)]),
+        replicas=2)
+    stale.status_ready_replicas = 2  # ready but NOT the current template
+    store.create("ReplicaSet", stale)
     rs = v1.ReplicaSet(metadata=v1.ObjectMeta(
-        name="web-abc", namespace="default",
+        name=f"web-{_template_hash(dep.template)}", namespace="default",
         owner_references=[v1.OwnerReference(kind="Deployment", name="web",
                                             uid=dep.metadata.uid)]),
         replicas=2)
