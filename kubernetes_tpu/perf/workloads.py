@@ -417,9 +417,14 @@ SUITES: Dict[str, Suite] = {
         Suite("SchedulingBasic", _basic,
               {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 1000, 1000)}),
         Suite("SchedulingPodAntiAffinity", _anti_affinity,
-              {"500Nodes": (500, 100, 400), "5000Nodes": (5000, 1000, 1000)}),
+              {"500Nodes": (500, 100, 400), "5000Nodes": (5000, 1000, 1000)},
+              # coupled batches run the greedy scan: per-pod device cost is
+              # linear in B, so B=512 amortizes only the fixed tunnel
+              # rounds — measured 512.0 → 642.8 pods/s same-weather
+              batch_size={"5000Nodes": 512}),
         Suite("SchedulingPodAffinity", _affinity,
-              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)},
+              batch_size={"5000Nodes": 512}),
         Suite("TopologySpreading", _topology,
               {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
         Suite("PreferredTopologySpreading", _preferred_topology,
@@ -427,7 +432,8 @@ SUITES: Dict[str, Suite] = {
         Suite("SchedulingNodeAffinity", _node_affinity,
               {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
         Suite("SchedulingPreferredPodAffinity", _preferred_affinity,
-              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)},
+              batch_size={"5000Nodes": 512}),
         Suite("PreemptionBasic", _preemption,
               {"500Nodes": (500, 2000, 500), "5000Nodes": (5000, 20000, 5000)},
               # 5k: every measured pod needs a fail→preempt→retry pair of
