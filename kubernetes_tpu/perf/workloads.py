@@ -415,7 +415,8 @@ SUITES: Dict[str, Suite] = {
     s.name: s
     for s in [
         Suite("SchedulingBasic", _basic,
-              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 1000, 1000)}),
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 1000, 1000)},
+              batch_size={"5000Nodes": 512}),
         Suite("SchedulingPodAntiAffinity", _anti_affinity,
               {"500Nodes": (500, 100, 400), "5000Nodes": (5000, 1000, 1000)},
               # coupled batches run the greedy scan: per-pod device cost is
@@ -426,11 +427,14 @@ SUITES: Dict[str, Suite] = {
               {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)},
               batch_size={"5000Nodes": 512}),
         Suite("TopologySpreading", _topology,
-              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
+              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)},
+              batch_size={"5000Nodes": 512}),
         Suite("PreferredTopologySpreading", _preferred_topology,
-              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)}),
+              {"500Nodes": (500, 1000, 1000), "5000Nodes": (5000, 5000, 2000)},
+              batch_size={"5000Nodes": 512}),
         Suite("SchedulingNodeAffinity", _node_affinity,
-              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)}),
+              {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)},
+              batch_size={"5000Nodes": 512}),
         Suite("SchedulingPreferredPodAffinity", _preferred_affinity,
               {"500Nodes": (500, 500, 1000), "5000Nodes": (5000, 5000, 1000)},
               batch_size={"5000Nodes": 512}),
@@ -442,9 +446,11 @@ SUITES: Dict[str, Suite] = {
               batch_size={"5000Nodes": 512}),
         Suite("Unschedulable", _unschedulable,
               {"500Nodes/200InitPods": (500, 200, 1000),
-               "5000Nodes/200InitPods": (5000, 200, 5000)}),
+               "5000Nodes/200InitPods": (5000, 200, 5000)},
+              batch_size={"5000Nodes/200InitPods": 512}),
         Suite("SchedulingWithMixedChurn", _mixed_churn,
-              {"1000Nodes": (1000, 0, 1000), "5000Nodes": (5000, 0, 2000)}),
+              {"1000Nodes": (1000, 0, 1000), "5000Nodes": (5000, 0, 2000)},
+              batch_size={"5000Nodes": 512}),
         # extender batch 384: large enough to amortize the per-batch fixed
         # tunnel rounds (fused prepare+first-plane), but UNDER the node
         # count — the one-commit-per-node round rule defers (batch − nodes)
