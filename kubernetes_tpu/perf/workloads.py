@@ -464,9 +464,9 @@ SUITES: Dict[str, Suite] = {
               batch_size=512),
         # The reference's historic density target (scheduler_perf README:
         # 30k pods on 1000 fake nodes; 3k pods on 100 nodes).  B=512 on the
-        # deep 30k backlog: 647 (r4 artifact) → 1143 in the A/B probe run
-        # and 1361.7 in the committed density.json pass (same tunnel-round
-        # amortization as NorthStar; weather moves passes ±2×)
+        # deep 30k backlog: 647 (r4 artifact) → 1143-1478 across round-5
+        # passes (the committed density.json holds the current one; same
+        # tunnel-round amortization as NorthStar, weather moves passes ±2×)
         Suite("Density", _basic,
               {"1000Nodes/30000Pods": (1000, 0, 30000),
                "100Nodes/3000Pods": (100, 0, 3000)},
