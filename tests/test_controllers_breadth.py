@@ -360,3 +360,24 @@ def test_attach_detach_reconciles_node_volumes_attached():
     store.delete("Pod", "default", "p")
     assert c.sync_once()
     assert store.get("Node", "", "n0").status.volumes_attached == []
+
+
+def test_node_ipam_custom_cidr_and_mask():
+    """register_defaults passthrough: a /8 cluster with /25 node masks (the
+    100k-scale configuration the docstring names)."""
+    from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+    from kubernetes_tpu.testutil import make_node
+
+    store = ObjectStore()
+    for i in range(3):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4"}).obj())
+    c = NodeIpamController(store, cluster_cidr="10.0.0.0/8", node_mask=25)
+    assert c.sync_once()
+    cidrs = [n.spec.pod_cidr for n in store.list("Node")[0]]
+    assert all(cidr.endswith("/25") for cidr in cidrs)
+    assert len(set(cidrs)) == 3
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        NodeIpamController(store, cluster_cidr="10.0.0.0/26", node_mask=25)

@@ -81,3 +81,41 @@ def test_wrong_group_still_rejected():
             to_manifest(v1.Namespace(metadata=v1.ObjectMeta(name="n")),
                         SCHEME),
             "policy/v1beta1")
+
+
+def test_spoke_round_trip_battery():
+    """Every registered spoke: hub → spoke → hub is lossless for what the
+    spoke can express (the apimachinery fuzzed round-trip contract, at the
+    battery level this build's manifests support)."""
+    conv = SCHEME.converter
+    hpa_hub = {
+        "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "a", "namespace": "default"},
+        "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "a"},
+                 "minReplicas": 1, "maxReplicas": 3,
+                 "metrics": [{"type": "Resource", "resource": {
+                     "name": "cpu", "target": {"type": "Utilization",
+                                               "averageUtilization": 55}}}]},
+        "status": {"currentMetrics": [{"type": "Resource", "resource": {
+            "name": "cpu", "current": {"averageUtilization": 40}}}]},
+    }
+    spoke = conv.from_hub("HorizontalPodAutoscaler", "autoscaling/v1", hpa_hub)
+    assert spoke["status"]["currentCPUUtilizationPercentage"] == 40
+    back = conv.to_hub("HorizontalPodAutoscaler", "autoscaling/v1", spoke)
+    assert back["spec"]["metrics"][0]["resource"]["target"][
+        "averageUtilization"] == 55
+    assert back["status"]["currentMetrics"][0]["resource"]["current"][
+        "averageUtilization"] == 40
+
+    for kind, spoke_v in (("CronJob", "batch/v1beta1"),
+                          ("PodDisruptionBudget", "policy/v1beta1"),
+                          ("EndpointSlice", "discovery.k8s.io/v1beta1")):
+        assert conv.spoke_versions(kind) == [spoke_v]
+        m = {"apiVersion": spoke_v, "kind": kind,
+             "metadata": {"name": "x", "namespace": "default"},
+             "spec": {"anything": 1}}
+        hub = conv.to_hub(kind, spoke_v, m)
+        assert hub["apiVersion"] != spoke_v
+        again = conv.from_hub(kind, spoke_v, hub)
+        assert again["apiVersion"] == spoke_v
+        assert again["spec"] == {"anything": 1}
