@@ -9,23 +9,40 @@ HTTP exactly as it does over the in-process store.
 from __future__ import annotations
 
 import json
+import random
 import threading
+import time
 import urllib.request
 from typing import Callable, List, Optional, Tuple
 
 from ..api.scheme import Scheme, default_scheme
-from ..sim.store import WatchEvent
+from ..chaos.retry import backoff_delay
+from ..metrics import scheduler_metrics as m
+from ..sim.store import ERROR, WatchEvent
 from .server import resource_of
+
+# retryable statuses (client-go rest/request.go:927 retries on 429 +
+# transient 5xx, reading Retry-After for the wait)
+RETRYABLE_CODES = (429, 500, 503)
 
 
 class HTTPApiClient:
     def __init__(self, base_url: str, scheme: Optional[Scheme] = None,
-                 user: str = ""):
+                 user: str = "", max_retries: int = 4,
+                 retry_backoff: float = 0.05, retry_backoff_max: float = 2.0,
+                 jitter_seed: int = 0):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme()
         self.user = user
         self._watch_threads: List[threading.Thread] = []
         self._stopped = False
+        # retrying transport: 429/500/503 are resent after honoring the
+        # server's Retry-After (floor) or jittered exponential backoff;
+        # other statuses surface to the caller unchanged
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_max = retry_backoff_max
+        self._retry_rng = random.Random(jitter_seed)
 
     # --- url plumbing -------------------------------------------------------
 
@@ -53,12 +70,31 @@ class HTTPApiClient:
 
     def _request(self, method: str, url: str, body: Optional[dict] = None):
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
-        if self.user:
-            req.add_header("X-Remote-User", self.user)
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read() or b"{}")
+        attempt = 0
+        while True:
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Content-Type", "application/json")
+            if self.user:
+                req.add_header("X-Remote-User", self.user)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+                if e.code not in RETRYABLE_CODES or attempt >= self.max_retries:
+                    raise
+                m.client_request_retries.inc((str(e.code),))
+                # Retry-After is a FLOOR (the server's load-shedding hint,
+                # APF filters); without one, jittered exponential backoff.
+                # Safe to resend even non-idempotent verbs: a shed request
+                # (429/503) or handler-refused write never reached storage.
+                try:
+                    retry_after = float(e.headers.get("Retry-After") or 0.0)
+                except (TypeError, ValueError):
+                    retry_after = 0.0
+                time.sleep(backoff_delay(
+                    attempt, self.retry_backoff, self.retry_backoff_max,
+                    self._retry_rng, floor=retry_after))
+                attempt += 1
 
     # --- the ListerWatcher contract ----------------------------------------
 
@@ -76,12 +112,23 @@ class HTTPApiClient:
 
     def watch_kind(self, kind: str, handler: Callable[[WatchEvent], None],
                    since_rv: int = 0, timeout_seconds: float = 30,
-                   on_bookmark: Optional[Callable[[int], None]] = None):
+                   on_bookmark: Optional[Callable[[int], None]] = None,
+                   on_error: Optional[Callable[[Optional[Exception]], None]] = None):
         """Stream watch events to ``handler``.  Bookmarks are requested
         (allowWatchBookmarks, reflector.go's default) and consumed HERE:
         they carry no object, only a fresh resourceVersion, which is handed
         to ``on_bookmark`` (e.g. a Reflector advancing its restart point)
-        rather than surfaced as a WatchEvent."""
+        rather than surfaced as a WatchEvent.
+
+        ``on_error`` is the stream-lifecycle callback, invoked from the
+        watch thread with the failure when the stream errors (transport
+        exception, or WatchDropped for an in-band ERROR event — rv
+        continuity broken, the consumer must RELIST) and with None when the
+        stream simply ends at the server's timeoutSeconds (rv continuity
+        intact — a cheap re-watch from last_rv suffices; reflector.go's
+        ListAndWatch restart makes the same distinction).  Without it,
+        transport errors raise in the watch thread (the pre-chaos
+        behavior)."""
         stop = threading.Event()
 
         def run():
@@ -103,6 +150,17 @@ class HTTPApiClient:
                         if not line:
                             continue
                         ev = json.loads(line)
+                        if ev["type"] == ERROR:
+                            # in-band stream failure (watch protocol ERROR,
+                            # e.g. 410 Gone / chaos drop): rv continuity is
+                            # broken — the consumer must relist
+                            if on_error is not None and not stop.is_set():
+                                from ..chaos.faults import WatchDropped
+
+                                on_error(WatchDropped(
+                                    str((ev.get("object") or {})
+                                        .get("message", "watch ERROR"))))
+                            return
                         rv = int((ev["object"].get("metadata") or {})
                                  .get("resourceVersion", "0"))
                         if ev["type"] == "BOOKMARK":
@@ -111,11 +169,25 @@ class HTTPApiClient:
                             continue
                         obj = self.scheme.decode(ev["object"])
                         handler(WatchEvent(ev["type"], kind, obj, rv))
-            except Exception:
+            except Exception as e:
                 if not stop.is_set():
+                    if on_error is not None:
+                        on_error(e)
+                        return
                     raise
+                return
+            # clean end of stream (server's timeoutSeconds elapsed): None
+            # tells the reflector rv continuity held — re-watch from
+            # last_rv, no relist needed
+            if on_error is not None and not stop.is_set():
+                on_error(None)
         t = threading.Thread(target=run, daemon=True)
         t.start()
+        # prune finished threads while appending: a relisting reflector
+        # re-invokes watch_kind on every stream cycle, and an unbounded
+        # list of dead Thread objects would leak over a long chaos soak
+        self._watch_threads = [
+            w for w in self._watch_threads if w.is_alive()]
         self._watch_threads.append(t)
 
         def unwatch():
@@ -187,7 +259,13 @@ class HTTPStoreFacade:
         reply = self._client.create(kind, obj)
         return int((reply.get("metadata") or {}).get("resourceVersion", "0"))
 
-    def update(self, kind: str, obj) -> int:
+    def update(self, kind: str, obj, expected_rv=None) -> int:
+        """``expected_rv`` is accepted for ObjectStore signature parity
+        (LeaderElector's CAS renew passes it): over HTTP the CAS rides the
+        PUT body's metadata.resourceVersion — the server 409s when it is
+        stale — so the kwarg only needs to be stamped into the object."""
+        if expected_rv is not None:
+            obj.metadata.resource_version = expected_rv
         reply = self._client.update(kind, obj)
         return int((reply.get("metadata") or {}).get("resourceVersion", "0"))
 
@@ -222,9 +300,11 @@ class _KindClient:
     def list(self, kind: str):
         return self._client.list(kind)
 
-    def watch(self, handler, since_rv: int = 0, on_bookmark=None):
+    def watch(self, handler, since_rv: int = 0, on_bookmark=None,
+              on_error=None):
         return self._client.watch_kind(self._kind, handler, since_rv=since_rv,
-                                       on_bookmark=on_bookmark)
+                                       on_bookmark=on_bookmark,
+                                       on_error=on_error)
 
 
 import urllib.error  # noqa: E402  (used in get())

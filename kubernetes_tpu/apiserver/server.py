@@ -56,6 +56,7 @@ from ..api.serialize import to_manifest
 from ..sim.store import (
     ADDED,
     DELETED,
+    ERROR,
     MODIFIED,
     ObjectStore,
     QuotaExceeded,
@@ -165,10 +166,17 @@ class APIServer:
         authenticators: Optional[list] = None,
         mutating_admission: Optional[list] = None,
         validating_admission: Optional[list] = None,
+        fault_injector=None,
     ):
         self.store = store
         self.scheme = scheme or default_scheme()
         self.authorizer = authorizer
+        # chaos hook (chaos.faults.FaultSchedule-shaped, or None): write
+        # verbs may be shed with 429/500/503 + Retry-After BEFORE reaching
+        # the store (the APF load-shedding surface), and watch streams may
+        # be cut with an in-band ERROR event.  Attach the schedule HERE for
+        # HTTP actors (not also to the store — that would double-inject).
+        self.fault = fault_injector
         # authn chain: first non-None UserInfo wins; configured-but-failed
         # authentication is 401 (no anonymous fallthrough)
         self.authenticators = list(authenticators or [])
@@ -250,19 +258,44 @@ def _make_handler(api: APIServer):
 
         # --- plumbing -------------------------------------------------------
 
-        def _send_json(self, code: int, payload: dict):
+        def _send_json(self, code: int, payload: dict, headers=()):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in headers:
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _status_err(self, code: int, reason: str, message: str):
+        def _status_err(self, code: int, reason: str, message: str,
+                        headers=()):
             self._send_json(code, {
                 "kind": "Status", "apiVersion": "v1", "status": "Failure",
                 "reason": reason, "message": message, "code": code,
-            })
+            }, headers=headers)
+
+        def _shed(self, verb: str, kind: str, name: str) -> bool:
+            """Chaos load shedding for write verbs: True when this request
+            was answered with an injected 429/500/503 (Retry-After carries
+            the server's wait hint, fractional seconds — the sim's clients
+            parse floats; real Retry-After is integral).  Runs BEFORE
+            admission/storage so a shed write never half-applied and any
+            retry is safe."""
+            if api.fault is None:
+                return False
+            hit = api.fault.http_fault(verb, kind, name)
+            if hit is None:
+                return False
+            code, retry_after = hit
+            reason = {429: "TooManyRequests", 503: "ServiceUnavailable"}.get(
+                code, "InternalError")
+            self._status_err(
+                code, reason, f"chaos: shed {verb} {kind}/{name}",
+                headers=(("Retry-After", f"{retry_after:.3f}"),)
+                if retry_after else (),
+            )
+            return True
 
         def _body(self) -> dict:
             length = int(self.headers.get("Content-Length") or 0)
@@ -453,6 +486,25 @@ def _make_handler(api: APIServer):
                         ev = events.get(timeout=min(remain, 0.25))
                     except queue.Empty:
                         continue
+                    if api.fault is not None and api.fault.should_drop_watch(
+                            ev.kind,
+                            getattr(ev.obj.metadata, "name", ""),
+                            rv=ev.resource_version):
+                        # chaos stream cut: the in-band ERROR event (watch
+                        # protocol stream-failure marker) REPLACES this
+                        # event — the client must relist to recover it,
+                        # exactly as after a real 410 Gone
+                        if write_line({
+                            "type": ERROR,
+                            "object": {"kind": "Status", "status": "Failure",
+                                       "reason": "Expired",
+                                       "message": "chaos: watch dropped"},
+                        }):
+                            try:  # close the stream cleanly after ERROR
+                                self.wfile.write(b"0\r\n\r\n")
+                            except (BrokenPipeError, ConnectionResetError):
+                                pass
+                        return
                     if not write_line({
                         "type": ev.type,
                         "object": to_manifest(ev.obj, api.scheme),
@@ -472,6 +524,8 @@ def _make_handler(api: APIServer):
                 self._status_err(404, "NotFound", url.path)
                 return
             kind, ns, name, sub = r
+            if self._shed("POST", kind, name or ""):
+                return
             if kind == "Pod" and name and sub == "binding":
                 if not self._check("create", "Pod", ns):
                     return
@@ -524,6 +578,8 @@ def _make_handler(api: APIServer):
                 self._status_err(404, "NotFound", url.path)
                 return
             kind, ns, name, _sub = r
+            if self._shed("PUT", kind, name):
+                return
             if not self._check("update", kind, ns):
                 return
             if api.store.get(kind, ns, name) is None:
@@ -575,6 +631,8 @@ def _make_handler(api: APIServer):
                 self._status_err(404, "NotFound", url.path)
                 return
             kind, ns, name, _sub = r
+            if self._shed("PATCH", kind, name):
+                return
             if not self._check("patch", kind, ns):
                 return
             patch = self._body()
@@ -627,6 +685,8 @@ def _make_handler(api: APIServer):
                 self._status_err(404, "NotFound", url.path)
                 return
             kind, ns, name, _sub = r
+            if self._shed("DELETE", kind, name):
+                return
             if not self._check("delete", kind, ns):
                 return
             cur = api.store.get(kind, ns, name)

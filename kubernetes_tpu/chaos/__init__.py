@@ -1,0 +1,30 @@
+"""Chaos harness: seeded fault injection + the retry transports it exercises.
+
+Layout:
+  - faults.py — FaultSchedule (deterministic per-key fault decisions),
+    TransientApiError / InjectedConflict / WatchDropped, steal_lease
+  - retry.py  — RetryingStore (Retry-After-honoring write retries)
+  - soak.py   — the convergence-under-failure workload driver
+    (tests/test_chaos.py battery + tools/chaos_soak.py share it)
+
+soak is imported lazily — it pulls in the scheduler (and jax); the fault
+primitives stay importable from stdlib-only contexts (subprocess servers).
+"""
+
+from .faults import (  # noqa: F401
+    FaultSchedule,
+    InjectedConflict,
+    TransientApiError,
+    WatchDropped,
+    steal_lease,
+)
+from .retry import RetryingStore  # noqa: F401
+
+__all__ = [
+    "FaultSchedule",
+    "InjectedConflict",
+    "TransientApiError",
+    "WatchDropped",
+    "RetryingStore",
+    "steal_lease",
+]

@@ -1,0 +1,285 @@
+"""Seeded, deterministic fault injection for the sim control plane.
+
+The reference survives production because every layer assumes its neighbor
+is flaky: the apiserver sheds load with 429 + Retry-After (API Priority and
+Fairness, staging/src/k8s.io/apiserver/pkg/server/filters), etcd surfaces
+conflicts that GuaranteedUpdate retries, watch streams drop and client-go
+reflectors relist.  ``FaultSchedule`` reproduces those failure modes inside
+the sim so the retry/degradation machinery (RetryingStore, the HTTP client's
+Retry-After transport, the informer relist path, the extender circuit
+breaker) can be exercised end to end.
+
+Determinism contract
+--------------------
+Every decision is a pure function of ``(seed, tag, key, seq)`` where ``seq``
+is a per-key counter: the Nth write to Pod ``p42`` sees the same fault in
+every run with the same seed, REGARDLESS of thread interleavings or how the
+scheduler groups its batches.  The per-key sequence (create, bind, ...) is
+what must be deterministic for replay — wall-clock ordering across keys is
+not.  Hashing is blake2s (process-independent — Python's tuple ``hash`` is
+salted per process and would break replay — and with real avalanche: crc32
+clusters sequential names like pod-0001/pod-0002 into near-identical rolls,
+turning a 5% rate into all-or-nothing per name prefix).
+
+Faults are injected BEFORE the guarded mutation applies (a rejected write
+never half-happened), so a retry after TransientApiError/InjectedConflict is
+always safe — the in-process analog of an apiserver 429 rejected at
+admission, before storage.
+
+Wiring: pass one schedule to EITHER ``ObjectStore(fault_injector=...)`` (in-
+process actors) OR ``APIServer(fault_injector=...)`` (HTTP actors) — wiring
+both layers of the same stack double-injects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..sim.store import StaleResourceVersion
+
+
+class TransientApiError(RuntimeError):
+    """A retryable control-plane failure (429/500/503 analog).
+
+    ``retry_after`` carries the server's Retry-After hint in seconds;
+    retrying transports (chaos.retry.RetryingStore, HTTPApiClient) honor it.
+    """
+
+    def __init__(self, code: int, retry_after: float = 0.0, message: str = ""):
+        super().__init__(message or f"transient API error {code}")
+        self.code = code
+        self.retry_after = retry_after
+
+
+class InjectedConflict(StaleResourceVersion):
+    """A chaos-injected 409 (CAS-conflict storm).
+
+    Subclasses StaleResourceVersion so existing 409 handling (the apiserver's
+    Conflict response, controller read-modify-write loops) applies unchanged;
+    the distinct type lets RetryingStore know the conflict is synthetic —
+    the stored object is actually current, so a plain resend is correct
+    (a REAL stale rv must be re-read by the caller instead).
+    """
+
+
+class WatchDropped(ConnectionError):
+    """Delivered to a watcher's on_error callback when its stream is cut."""
+
+
+class FaultSchedule:
+    """One seeded schedule of fault decisions across all fault classes.
+
+    Rates are independent probabilities per operation; ``max_faults_per_key``
+    bounds the injected failures any single (op, kind, name) can see so a
+    bounded-retry client always converges (an unlucky key cannot 429
+    forever).  ``exempt_kinds`` defaults to Event: best-effort event writes
+    retrying through injected faults would add nondeterministic op sequences
+    without exercising anything new.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        *,
+        watch_drop_rate: float = 0.0,
+        write_429_rate: float = 0.0,
+        write_500_rate: float = 0.0,
+        write_503_rate: float = 0.0,
+        conflict_rate: float = 0.0,
+        slow_rate: float = 0.0,
+        slow_seconds: float = 0.02,
+        retry_after: float = 0.02,
+        max_faults_per_key: int = 3,
+        exempt_kinds=frozenset({"Event"}),
+    ):
+        self.seed = seed
+        self.watch_drop_rate = watch_drop_rate
+        self.write_429_rate = write_429_rate
+        self.write_500_rate = write_500_rate
+        self.write_503_rate = write_503_rate
+        self.conflict_rate = conflict_rate
+        self.slow_rate = slow_rate
+        self.slow_seconds = slow_seconds
+        self.retry_after = retry_after
+        self.max_faults_per_key = max_faults_per_key
+        self.exempt_kinds = frozenset(exempt_kinds)
+        # RLock: the memoized watch-drop path holds it across _seq/_record
+        self._lock = threading.RLock()
+        self._counters: Dict[tuple, int] = {}
+        self._key_faults: Dict[tuple, int] = {}
+        # (kind, name, rv) → decision, so N concurrent watch streams of the
+        # same store share ONE deterministic decision per event (see
+        # should_drop_watch)
+        self._drop_memo: Dict[tuple, bool] = {}
+        # fault class → total injected; equal across same-seed runs whenever
+        # each key's op sequence is deterministic (the soak's assertion)
+        self.injected: Dict[str, int] = {}
+
+    # --- deterministic primitives -------------------------------------------
+
+    def _roll(self, *parts) -> float:
+        digest = hashlib.blake2s(
+            "|".join(map(str, (self.seed,) + parts)).encode(),
+            digest_size=8,
+        ).digest()
+        return int.from_bytes(digest, "big") / 2.0**64
+
+    def _seq(self, *key) -> int:
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+            return n
+
+    def _record(self, fault: str, key: tuple):
+        from ..metrics import scheduler_metrics as m
+
+        with self._lock:
+            self.injected[fault] = self.injected.get(fault, 0) + 1
+            self._key_faults[key] = self._key_faults.get(key, 0) + 1
+        m.chaos_faults_injected.inc((fault,))
+
+    def _exhausted(self, key: tuple) -> bool:
+        with self._lock:
+            return self._key_faults.get(key, 0) >= self.max_faults_per_key
+
+    def injected_counts(self) -> Dict[str, int]:
+        """Snapshot of fault-class → injected count (the determinism probe)."""
+        with self._lock:
+            return dict(self.injected)
+
+    # --- hooks consumed by sim/store.py -------------------------------------
+
+    def write_fault(self, op: str, kind: str, name: str) -> None:
+        """Raise the scheduled fault (if any) for this write attempt.
+
+        Called by ObjectStore create/update/delete/bind BEFORE the mutation
+        and before taking the store lock (injected delays must not stall
+        unrelated writers).
+        """
+        if kind in self.exempt_kinds:
+            return
+        self.maybe_delay(op, kind, name)
+        seq = self._seq("write", op, kind, name)
+        key = (op, kind, name)
+        if self._exhausted(key):
+            return
+        r = self._roll("write", op, kind, name, seq)
+        edge = self.write_429_rate
+        if r < edge:
+            self._record("write_429", key)
+            raise TransientApiError(429, self.retry_after,
+                                    f"chaos: 429 on {op} {kind}/{name}")
+        edge += self.write_500_rate
+        if r < edge:
+            self._record("write_500", key)
+            raise TransientApiError(500, 0.0,
+                                    f"chaos: 500 on {op} {kind}/{name}")
+        edge += self.write_503_rate
+        if r < edge:
+            self._record("write_503", key)
+            raise TransientApiError(503, self.retry_after,
+                                    f"chaos: 503 on {op} {kind}/{name}")
+        edge += self.conflict_rate
+        if r < edge and op in ("update", "bind"):
+            self._record("conflict", key)
+            raise InjectedConflict(
+                f"chaos: conflict storm on {op} {kind}/{name}")
+
+    def should_drop_watch(self, kind: str, name: str, rv=None) -> bool:
+        """Decide whether the watch stream carrying this event is cut.
+
+        Keyed by the EVENT (kind, name, per-key event seq), not the watcher:
+        the decision stays deterministic even when watcher subscription
+        order varies between runs.  Callers that know the event's
+        resourceVersion pass ``rv`` so N independent streams carrying the
+        SAME event (each HTTP watch connection consults separately) share
+        one memoized decision — without it each stream would consume its
+        own sequence number and the injected count would depend on how many
+        watchers happened to be connected (thread-interleaving-shaped,
+        which the determinism contract forbids).
+        """
+        if self.watch_drop_rate <= 0 or kind in self.exempt_kinds:
+            return False
+        if rv is None:
+            return self._decide_drop(kind, name)
+        with self._lock:
+            memo_key = (kind, name, rv)
+            if memo_key not in self._drop_memo:
+                self._drop_memo[memo_key] = self._decide_drop(kind, name)
+            return self._drop_memo[memo_key]
+
+    def _decide_drop(self, kind: str, name: str) -> bool:
+        seq = self._seq("watch", kind, name)
+        key = ("watch", kind, name)
+        if self._exhausted(key):
+            return False
+        if self._roll("watch", kind, name, seq) < self.watch_drop_rate:
+            self._record("watch_drop", key)
+            return True
+        return False
+
+    def maybe_delay(self, op: str, kind: str, name: str) -> None:
+        """Slow-response injection (sleeps; never raises)."""
+        if self.slow_rate <= 0:
+            return
+        seq = self._seq("slow", op, kind, name)
+        if self._roll("slow", op, kind, name, seq) < self.slow_rate:
+            self._record("slow", ("slow", op, kind, name))
+            time.sleep(self.slow_seconds)
+
+    # --- hook consumed by apiserver/server.py -------------------------------
+
+    def http_fault(self, method: str, kind: str,
+                   name: str) -> Optional[Tuple[int, float]]:
+        """(status code, retry_after_seconds) to shed this request with, or
+        None to serve it.  The apiserver front end maps this to a Status
+        response with a Retry-After header (the APF load-shedding surface);
+        retry_after is 0 for 500s (no hint — clients fall back to their own
+        backoff)."""
+        if kind in self.exempt_kinds:
+            return None
+        self.maybe_delay(method, kind, name)
+        seq = self._seq("http", method, kind, name)
+        key = ("http", method, kind, name)
+        if self._exhausted(key):
+            return None
+        r = self._roll("http", method, kind, name, seq)
+        edge = self.write_429_rate
+        if r < edge:
+            self._record("http_429", key)
+            return (429, self.retry_after)
+        edge += self.write_500_rate
+        if r < edge:
+            self._record("http_500", key)
+            return (500, 0.0)
+        edge += self.write_503_rate
+        if r < edge:
+            self._record("http_503", key)
+            return (503, self.retry_after)
+        return None
+
+
+def steal_lease(store, namespace: str, name: str,
+                usurper: str = "chaos-usurper", clock=time.monotonic) -> bool:
+    """Leader-election lease loss: hand the lease to ``usurper`` with a fresh
+    renewTime, as a competing candidate (or an apiserver restart replaying a
+    stale cache) would.  The current holder's next renewal sees a foreign
+    holderIdentity and must release → reacquire (LeaderElector's
+    renewal-failure path).  Returns False when no lease exists."""
+    import copy
+
+    lease = store.get("Lease", namespace, name)
+    if lease is None:
+        return False
+    # mutate a private copy: in-process stores hand out the live object,
+    # and a steal whose write is itself fault-rejected must not leave a
+    # half-applied holder visible (the module's pre-mutation invariant)
+    lease = copy.copy(lease)
+    lease.metadata = copy.copy(lease.metadata)
+    lease.holder_identity = usurper
+    lease.renew_time = clock()
+    store.update("Lease", lease)
+    return True

@@ -1,0 +1,105 @@
+"""Retrying store transport: jittered exponential backoff honoring Retry-After.
+
+Reference: client-go rest/request.go retries on 429/5xx reading Retry-After
+(request.go:927 retryAfterSeconds) and util/retry.OnError for conflict
+loops.  ``RetryingStore`` is the in-process analog — an ObjectStore-shaped
+wrapper whose writes ride the same (list, watch, get) surface but absorb
+TransientApiError and chaos-injected conflicts with bounded retries, so the
+scheduler, hollow kubelets, and controllers run unchanged against a faulty
+control plane.
+
+Only SYNTHETIC conflicts (InjectedConflict) are resent blind: the store
+object really is current, the 409 was injected ahead of it.  A genuine
+StaleResourceVersion means the caller read a stale object and must re-read —
+it propagates.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from .faults import InjectedConflict, TransientApiError
+
+
+def backoff_delay(attempt: int, initial: float, cap: float, rng,
+                  floor: float = 0.0) -> float:
+    """Jittered exponential backoff with an optional Retry-After floor —
+    the ONE implementation of the wait every retrying path uses
+    (RetryingStore, HTTPApiClient._request, Reflector's relist loop).
+    Full jitter (client-go wait.Backoff Jitter) keeps a fault storm's
+    retries from re-colliding in lockstep; ``floor`` carries the server's
+    Retry-After hint, which always wins when longer."""
+    backoff = min(cap, initial * (2 ** attempt))
+    return max(floor, backoff * (0.5 + rng.random()))
+
+
+class RetryingStore:
+    """Wraps any ObjectStore-shaped store with write retries.
+
+    Reads (get/list/watch/...) pass straight through — the sim injects
+    faults on writes and watch streams, and read retry would add nothing to
+    the paths under test.  ``sleep`` is injectable so fast tests can no-op
+    the backoff while keeping the retry accounting real.
+    """
+
+    def __init__(self, store, max_retries: int = 6,
+                 backoff_initial: float = 0.01, backoff_max: float = 0.5,
+                 jitter_seed: int = 0, sleep=time.sleep):
+        self._store = store
+        self.max_retries = max_retries
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        self._rng = random.Random(jitter_seed)
+        self._sleep = sleep
+        self.retries = 0  # total resends across all ops (determinism probe)
+
+    @property
+    def CLUSTER_SCOPED(self):  # noqa: N802 — mirrors ObjectStore's attr
+        return self._store.CLUSTER_SCOPED
+
+    def _retry(self, fn):
+        from ..metrics import scheduler_metrics as m
+
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except TransientApiError as e:
+                if attempt >= self.max_retries:
+                    raise
+                self.retries += 1
+                m.client_request_retries.inc((str(e.code),))
+                self._sleep(backoff_delay(attempt, self.backoff_initial,
+                                          self.backoff_max, self._rng,
+                                          floor=e.retry_after))
+            except InjectedConflict:
+                if attempt >= self.max_retries:
+                    raise
+                self.retries += 1
+                m.client_request_retries.inc(("409",))
+                self._sleep(backoff_delay(attempt, self.backoff_initial,
+                                          self.backoff_max, self._rng))
+            attempt += 1
+
+    # --- retried writes ------------------------------------------------------
+
+    def create(self, kind: str, obj) -> int:
+        return self._retry(lambda: self._store.create(kind, obj))
+
+    def update(self, kind: str, obj, expected_rv=None) -> int:
+        return self._retry(
+            lambda: self._store.update(kind, obj, expected_rv=expected_rv))
+
+    def delete(self, kind: str, namespace: str, name: str):
+        return self._retry(lambda: self._store.delete(kind, namespace, name))
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
+        return self._retry(
+            lambda: self._store.bind_pod(namespace, name, node_name))
+
+    # --- passthrough reads / watch -------------------------------------------
+
+    def __getattr__(self, attr):
+        # get, list, list_namespaced, watch, current_rv, fault, _objects ...
+        return getattr(self._store, attr)

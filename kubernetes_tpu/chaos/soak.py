@@ -1,0 +1,168 @@
+"""Convergence-under-failure soak: a HollowCluster workload scheduled end to
+end while a seeded FaultSchedule cuts watch streams, sheds writes with 429s,
+and storms CAS conflicts — with one extender outage riding along.
+
+Shared by tests/test_chaos.py (small fast battery + the full slow soak) and
+tools/chaos_soak.py (the local full-size runner), so the acceptance workload
+is one definition, not two drifting copies.
+
+What converging means here (the honest-scale-claim prerequisite):
+  - every pod bound EXACTLY once (one bind MODIFIED event per pod in the
+    store's history — no duplicate or lost binds through the retry paths);
+  - zero scheduler crashes (every fault routed through retry/requeue);
+  - bounded retries: each injected write fault is absorbed by exactly one
+    client resend (store_retries == injected write faults);
+  - determinism: the same seed injects the same faults and costs the same
+    retries across runs — fault decisions key on per-object operation
+    sequences, not wall-clock interleavings (chaos/faults.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List
+
+from ..client.informer import InformerFactory
+from ..sim.hollow_node import HollowCluster
+from ..sim.store import MODIFIED, ObjectStore
+from .faults import FaultSchedule
+from .retry import RetryingStore
+
+
+@dataclass
+class SoakResult:
+    pods: int
+    bound: int
+    duplicate_binds: int
+    unbound: List[str]
+    injected: Dict[str, int]
+    store_retries: int
+    informer_relists: int
+    informer_items: int
+    circuit_state: int  # final extender circuit state (-1: no extender ran)
+    wall_seconds: float
+
+    @property
+    def converged(self) -> bool:
+        return (self.bound == self.pods and self.duplicate_binds == 0
+                and not self.unbound)
+
+    def determinism_signature(self) -> Dict[str, object]:
+        """The replay-stable part of a run: injected fault counts + the
+        retries they cost.  Wall time, cycle counts, and extender callout
+        counts are wall-clock-shaped and excluded on purpose."""
+        return {"injected": dict(self.injected),
+                "store_retries": self.store_retries}
+
+
+def run_soak(
+    n_pods: int = 500,
+    n_nodes: int = 50,
+    seed: int = 7,
+    batch_size: int = 64,
+    *,
+    watch_drop_rate: float = 0.10,
+    write_429_rate: float = 0.05,
+    write_500_rate: float = 0.02,
+    conflict_rate: float = 0.03,
+    extender_outage: bool = True,
+    timeout_seconds: float = 300.0,
+) -> SoakResult:
+    """Drive ``n_pods`` through a faulty control plane until convergence.
+
+    The default rates match the acceptance bar: ≥10% watch drops, 5% write
+    429s (plus 500s and a conflict storm), one ignorable extender hard down
+    (connection refused) so its circuit opens and the cycle degrades
+    around it.
+    """
+    from ..extender import ExtenderConfig, HTTPExtender
+    from ..scheduler import TPUScheduler
+    from ..testutil import make_pod
+
+    fault = FaultSchedule(
+        seed,
+        watch_drop_rate=watch_drop_rate,
+        write_429_rate=write_429_rate,
+        write_500_rate=write_500_rate,
+        conflict_rate=conflict_rate,
+        retry_after=0.01,
+        slow_rate=0.0,
+    )
+    raw = ObjectStore(fault_injector=fault)
+    store = RetryingStore(raw, jitter_seed=seed)
+
+    # a relisting pod informer rides along: watch drops must cost it
+    # relists, not correctness (its cache is checked at the end)
+    factory = InformerFactory(store)
+    pod_informer = factory.informer("Pod")
+    factory.start()
+
+    extenders = []
+    if extender_outage:
+        # hard-down ignorable extender: port 9 (discard) refuses instantly;
+        # after failure_threshold trips the circuit opens and stays open
+        # for the whole run (reset far beyond the soak) — pods keep
+        # scheduling without it
+        extenders = [HTTPExtender(ExtenderConfig(
+            url_prefix="http://127.0.0.1:9", filter_verb="filter",
+            ignorable=True, http_timeout=0.2,
+            failure_threshold=3, circuit_reset_seconds=3600.0,
+        ))]
+
+    sched = TPUScheduler(
+        store, batch_size=batch_size, extenders=extenders,
+        pod_initial_backoff=0.05, pod_max_backoff=0.5, batch_wait=0.05,
+    )
+    sched.presize(n_nodes, n_pods)
+    HollowCluster(store, n_nodes)
+
+    t0 = time.monotonic()
+    for i in range(n_pods):
+        store.create(
+            "Pod",
+            make_pod().name(f"chaos-{i:05d}").uid(f"chaos-{i:05d}")
+            .namespace("default").req({"cpu": "1"}).obj(),
+        )
+
+    deadline = t0 + timeout_seconds
+    while time.monotonic() < deadline:
+        sched.run_until_idle(max_cycles=50 * (n_pods // batch_size + 1))
+        pods, _ = raw.list("Pod")
+        unbound = [p for p in pods if not p.spec.node_name]
+        if not unbound:
+            break
+        # stragglers parked in unschedulableQ (a requeue that missed the
+        # event window would otherwise wait the 60s flush): activate and
+        # re-drive — the failure handler's contract is retry, not loss
+        sched.queue.activate(unbound)
+    wall = time.monotonic() - t0
+
+    pods, _ = raw.list("Pod")
+    bound = sum(1 for p in pods if p.spec.node_name)
+    unbound_names = [p.metadata.name for p in pods if not p.spec.node_name]
+    # exactly-once binding, from the store's own event history: with no
+    # hollow syncs or preemption in this workload, every Pod MODIFIED is a
+    # bind — more than one per pod means a duplicate bind slipped through
+    binds = Counter(
+        ev.obj.metadata.name for ev in raw._log
+        if ev.kind == "Pod" and ev.type == MODIFIED
+    )
+    duplicate_binds = sum(c - 1 for c in binds.values() if c > 1)
+
+    circuit_state = extenders[0].breaker.state if extenders else -1
+    result = SoakResult(
+        pods=n_pods,
+        bound=bound,
+        duplicate_binds=duplicate_binds,
+        unbound=unbound_names,
+        injected=fault.injected_counts(),
+        store_retries=store.retries,
+        informer_relists=pod_informer.reflector.relists,
+        informer_items=len(pod_informer.list()),
+        circuit_state=circuit_state,
+        wall_seconds=wall,
+    )
+    factory.stop()
+    return result

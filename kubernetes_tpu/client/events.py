@@ -46,7 +46,7 @@ class EventRecorder:
             ev.count += 1
             ev.last_timestamp = now
             ev.message = message
-            self.store.update("Event", ev)
+            self._write(self.store.update, ev)
             return ev
         ev = Event(
             involved_object=ref, reason=reason, message=message, type=event_type,
@@ -55,8 +55,20 @@ class EventRecorder:
         ev.metadata.namespace = obj.metadata.namespace or "default"
         ev.metadata.name = f"{obj.metadata.name}.{int(now * 1e6):x}"
         self._index[key] = ev
-        self.store.create("Event", ev)
+        self._write(self.store.create, ev)
         return ev
+
+    @staticmethod
+    def _write(op, ev) -> None:
+        """Best-effort store write: events are observability, never
+        load-bearing — the reference's recorder drops events rather than
+        fail the caller (client-go tools/record broadcaster semantics), so
+        a flaky control plane must not turn a Scheduled notification into
+        a binding-cycle crash.  The local aggregate keeps counting."""
+        try:
+            op("Event", ev)
+        except Exception:
+            pass
 
     def events_for(self, obj) -> List[Event]:
         ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"

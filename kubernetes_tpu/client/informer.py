@@ -2,23 +2,46 @@
 
 Reference: client-go tools/cache — Reflector.ListAndWatch (reflector.go:49,254):
 LIST returns a consistent snapshot + resourceVersion; WATCH resumes from that rv;
-on restart the reflector relists (the stateless-recovery property SURVEY §5
-"checkpoint/resume" relies on).  SharedInformer fans one watch out to many
-handlers with add/update/delete callbacks and a synced() barrier.
+on watch failure the reflector backs off and RELISTS (reflector.go:312 —
+watchErrorHandler + the ListAndWatch restart loop), which is the
+stateless-recovery property SURVEY §5 "checkpoint/resume" relies on.
+SharedInformer fans one watch out to many handlers with add/update/delete
+callbacks and a synced() barrier.
+
+Failure handling (the chaos-harness spine):
+  - a WATCH that errors, is dropped (chaos watch-stream cut), or ends
+    (HTTP timeoutSeconds) routes to ``_on_watch_error`` → full relist with
+    jittered exponential backoff, then resubscribe from the fresh rv;
+  - an in-band ``ERROR`` WatchEvent (the watch protocol's stream-failure
+    marker) relists the same way;
+  - the relist DIFFS the fresh snapshot against the local cache and emits
+    synthetic ADDED/MODIFIED/DELETED so handlers converge without replaying
+    the whole world (DeltaFIFO Replace semantics).  Caveat: in-process
+    stores share object identity, so a mutation-in-place during the drop
+    window carries no rv change to diff on — the cache is still correct
+    (same object), only the notification is elided.
 """
 
 from __future__ import annotations
 
+import inspect
+import random
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..sim.store import ADDED, DELETED, MODIFIED, ObjectStore, WatchEvent
+from ..chaos.retry import backoff_delay
+from ..metrics import scheduler_metrics as m
+from ..sim.store import ADDED, DELETED, ERROR, MODIFIED, ObjectStore, WatchEvent
 
 
 class Reflector:
     """ListAndWatch one kind into a local store dict."""
 
-    def __init__(self, store: ObjectStore, kind: str):
+    def __init__(self, store: ObjectStore, kind: str,
+                 relist_backoff_initial: float = 0.05,
+                 relist_backoff_max: float = 5.0,
+                 sleep=time.sleep, jitter_seed: int = 0):
         self.store = store
         self.kind = kind
         self.items: Dict[Tuple[str, str], object] = {}
@@ -26,6 +49,15 @@ class Reflector:
         self._handlers: List[Callable[[str, object, Optional[object]], None]] = []
         self._unwatch = None
         self._synced = False
+        self._stopped = False
+        self.relists = 0  # successful relists (also informer_relists_total)
+        self._backoff_initial = relist_backoff_initial
+        self._backoff_max = relist_backoff_max
+        self._sleep = sleep
+        self._jitter = random.Random(jitter_seed)
+        # serializes relists: a drop callback and a stream-end callback from
+        # two transports must not diff against the same cache concurrently
+        self._relist_lock = threading.Lock()
 
     def add_handler(self, fn: Callable[[str, object, Optional[object]], None]):
         """fn(event_type, obj, old_obj)."""
@@ -40,27 +72,123 @@ class Reflector:
 
     def run(self):
         """LIST (snapshot + rv), deliver synthetic ADDs, then WATCH from rv."""
+        self._stopped = False
         objs, rv = self.store.list(self.kind)
-        for o in objs:
-            self.items[self._key(o)] = o
-            for h in self._handlers:
-                h(ADDED, o, None)
+        self._apply_relist(objs, rv)
+        self._synced = True
+
+    def _apply_relist(self, objs, rv: int):
+        """Diff a fresh snapshot against the cache, deliver the synthetic
+        events, resubscribe (DeltaFIFO Replace: handlers see only what
+        actually changed across the outage window).
+
+        Each key commits to the cache AFTER its handlers ran, so a handler
+        that raises leaves the remaining keys undelivered AND uncommitted —
+        a later relist rediffs and redelivers them (at-least-once, same as
+        the reference's requeue-on-handler-error; handlers here dedup by
+        uid).  The handler exception itself propagates, matching live watch
+        delivery — it is a handler bug, not a stream failure, and must not
+        spin the relist retry loop (which may run under the in-process
+        store's write lock)."""
+        new_items = {self._key(o): o for o in objs}
+        for key, obj in new_items.items():
+            old = self.items.get(key)
+            if old is None:
+                for h in self._handlers:
+                    h(ADDED, obj, None)
+                self.items[key] = obj
+            elif old is not obj and (
+                    old.metadata.resource_version
+                    != obj.metadata.resource_version):
+                for h in self._handlers:
+                    h(MODIFIED, obj, old)
+                self.items[key] = obj
+        for key, old in list(self.items.items()):
+            if key not in new_items:
+                for h in self._handlers:
+                    h(DELETED, old, old)
+                self.items.pop(key, None)
         self.last_rv = rv
+        self._subscribe(rv)
+
+    def _subscribe(self, rv: int):
+        """WATCH from rv, passing the optional stream kwargs the store's
+        watch actually accepts.  Capability detection is by signature, NOT
+        by probing with a TypeError-catching call: a TypeError raised
+        INSIDE a watch implementation that already registered its callback
+        would otherwise double-subscribe the handler (ADVICE round 5)."""
+        watch = self.store.watch
+        kwargs = {}
         try:
+            params = inspect.signature(watch).parameters
+            var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in params.values())
+        except (TypeError, ValueError):  # builtins without introspection
+            params, var_kw = {}, False
+        if "on_bookmark" in params or var_kw:
             # HTTP stores stream watch BOOKMARKs (rv-only progress marks);
             # consuming them keeps the relist-after-disconnect point fresh
-            # even when no object events flow.  In-process stores don't
-            # take the kwarg — they have no stream to keep alive.
-            self._unwatch = self.store.watch(
-                self._on_event, since_rv=rv, on_bookmark=self._on_bookmark)
-        except TypeError:
-            self._unwatch = self.store.watch(self._on_event, since_rv=rv)
-        self._synced = True
+            # even when no object events flow
+            kwargs["on_bookmark"] = self._on_bookmark
+        if "on_error" in params or var_kw:
+            kwargs["on_error"] = self._on_watch_error
+        self._unwatch = watch(self._on_event, since_rv=rv, **kwargs)
 
     def _on_bookmark(self, rv: int):
         self.last_rv = max(self.last_rv, rv)
 
+    def _on_watch_error(self, exc: Optional[Exception] = None):
+        """The watch stream ended.  ``exc`` None means a CLEAN end (the
+        HTTP server's timeoutSeconds elapsed): rv continuity is intact, so
+        re-watch from last_rv — no O(N) relist, no relist-metric noise.
+        Any exception (drop, in-band ERROR, transport failure) means the
+        continuity is broken: full relist + resubscribe, with jittered
+        exponential backoff between failed attempts.  The FIRST attempt
+        runs immediately — the in-process store delivers drops
+        synchronously from inside a write (under its lock), where sleeping
+        would stall every other writer."""
+        if self._stopped:
+            return
+        self._unwatch = None
+        with self._relist_lock:
+            if self._stopped:
+                return
+            if exc is None:
+                try:
+                    self._subscribe(self.last_rv)
+                    self._unwatch_if_stopped()
+                    return
+                except Exception:
+                    pass  # resubscribe failed — fall through to relist
+            attempt = 0
+            while not self._stopped:
+                if attempt > 0:
+                    self._sleep(backoff_delay(
+                        attempt - 1, self._backoff_initial,
+                        self._backoff_max, self._jitter))
+                # only the LIST retries here — apply/deliver exceptions are
+                # handler bugs and propagate (see _apply_relist)
+                try:
+                    objs, rv = self.store.list(self.kind)
+                except Exception:
+                    attempt += 1
+                    continue
+                self._apply_relist(objs, rv)
+                self.relists += 1
+                m.informer_relists.inc((self.kind,))
+                self._unwatch_if_stopped()
+                return
+
+    def _unwatch_if_stopped(self):
+        """Close the race where stop() ran while a relist/rewatch was in
+        flight: the fresh subscription would otherwise outlive the
+        'stopped' reflector forever (the store holds a strong reference)."""
+        if self._stopped and self._unwatch:
+            self._unwatch()
+            self._unwatch = None
+
     def stop(self):
+        self._stopped = True
         if self._unwatch:
             self._unwatch()
             self._unwatch = None
@@ -69,6 +197,12 @@ class Reflector:
         return self._synced
 
     def _on_event(self, ev: WatchEvent):
+        if ev.type == ERROR:
+            # in-band stream-failure marker (watch protocol ERROR event,
+            # e.g. 410 Gone): the rv continuity is broken — full relist
+            # (the exception argument routes past the clean-end rewatch)
+            self._on_watch_error(ConnectionError("in-band watch ERROR event"))
+            return
         if ev.kind != self.kind:
             return
         self.last_rv = ev.resource_version
@@ -131,3 +265,7 @@ class InformerFactory:
 
     def wait_for_cache_sync(self) -> bool:
         return all(i.has_synced() for i in self._informers.values())
+
+    def stop(self):
+        for inf in self._informers.values():
+            inf.reflector.stop()

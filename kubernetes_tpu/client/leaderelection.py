@@ -5,6 +5,16 @@ Lease by CAS on holderIdentity/renewTime; renew every RetryPeriod; a candidate
 steals the lease when renewTime is older than LeaseDuration.  The scheduler
 exits when it loses the lease (cmd/kube-scheduler/app/server.go:204-215) —
 active/passive replication for the control plane (SURVEY §5 failure detection).
+
+Failure semantics (leaderelection.go:269-287 renew → release):
+  - every write CASes on the resourceVersion the lease was READ at, so two
+    candidates racing for an expired lease cannot both win (the reference's
+    Update conflict path);
+  - a renewal that fails — transient store error, CAS conflict, or a
+    usurped holderIdentity — RELEASES leadership (on_stopped_leading fires,
+    the holder stops acting) and the next tick re-enters the acquire path:
+    renewal-failure → release → reacquire, never a crash and never two
+    concurrent leaders.
 """
 
 from __future__ import annotations
@@ -14,7 +24,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.objects import ObjectMeta
-from ..sim.store import ObjectStore
+from ..metrics import scheduler_metrics as m
+from ..sim.store import ObjectStore, StaleResourceVersion
 
 
 @dataclass
@@ -41,8 +52,11 @@ class LeaseLock:
         lease.metadata.name = self.name
         self.store.create("Lease", lease)
 
-    def update(self, lease: Lease) -> None:
-        self.store.update("Lease", lease)
+    def update(self, lease: Lease, expected_rv=None) -> None:
+        """CAS write: ``expected_rv`` (the rv the lease was read at) makes
+        concurrent acquire/renew attempts serialize through the store's
+        conflict check instead of last-writer-wins."""
+        self.store.update("Lease", lease, expected_rv=expected_rv)
 
 
 class LeaderElector:
@@ -62,13 +76,42 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
+        self.renew_failures = 0  # consecutive failed acquire/renew ticks
 
     def is_leader(self) -> bool:
         return self._leading
 
     def try_acquire_or_renew(self) -> bool:
-        """One tick of the acquire/renew loop; returns current leadership."""
+        """One tick of the acquire/renew loop; returns current leadership.
+
+        Any store failure (transient error, lost CAS race, create collision)
+        counts as a renewal failure: leadership is released this tick and
+        the acquire path re-runs on the next — the caller's retry cadence is
+        the RetryPeriod loop."""
         now = self.clock()
+        try:
+            leading = self._tick(now)
+        except StaleResourceVersion:
+            # lost the CAS race: someone else renewed/stole between our read
+            # and write — they hold the lease, we certainly don't
+            leading = False
+        except ValueError:
+            # create raced another candidate's create (AlreadyExists)
+            leading = False
+        except Exception:
+            # transient control-plane failure (chaos 429/500, network):
+            # we cannot prove the lease is ours — release, reacquire later
+            leading = False
+        if leading:
+            self.renew_failures = 0
+        else:
+            self.renew_failures += 1
+        self._set_leading(leading)
+        return leading
+
+    def _tick(self, now: float) -> bool:
+        import copy
+
         lease = self.lock.get()
         if lease is None:
             lease = Lease(
@@ -77,21 +120,23 @@ class LeaderElector:
                 renew_time=now,
             )
             self.lock.create(lease)
-            self._set_leading(True)
             return True
+        # mutate a private copy: in-process stores hand out the LIVE object,
+        # and a write that fails (CAS conflict, injected fault) must not
+        # leave our half-written holder/renewTime visible to other readers
+        rv = lease.metadata.resource_version
+        lease = copy.copy(lease)
+        lease.metadata = copy.copy(lease.metadata)
         expired = now - lease.renew_time > lease.lease_duration_seconds
         if lease.holder_identity == self.identity:
             lease.renew_time = now
-            self.lock.update(lease)
-            self._set_leading(True)
+            self.lock.update(lease, expected_rv=rv)
             return True
         if expired:
             lease.holder_identity = self.identity
             lease.renew_time = now
-            self.lock.update(lease)
-            self._set_leading(True)
+            self.lock.update(lease, expected_rv=rv)
             return True
-        self._set_leading(False)
         return False
 
     def _set_leading(self, leading: bool):
@@ -99,4 +144,7 @@ class LeaderElector:
             self.on_started_leading()
         if not leading and self._leading and self.on_stopped_leading:
             self.on_stopped_leading()
+        if leading != self._leading:
+            m.leader_election_status.set(1.0 if leading else 0.0,
+                                         (self.identity,))
         self._leading = leading

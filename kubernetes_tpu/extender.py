@@ -20,11 +20,13 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from .api import objects as v1
+from .metrics import scheduler_metrics as m
 
 
 @dataclass
@@ -44,10 +46,75 @@ class ExtenderConfig:
     # non-empty, the extender is only consulted for pods that request or
     # limit at least one of them (IsInterested / hasManagedResources).
     managed_resources: List[str] = field(default_factory=list)
+    # Circuit breaker (degradation policy, not in the reference config —
+    # the reference relies on ignorable alone, which still pays the full
+    # http_timeout on EVERY callout during an outage): after
+    # ``failure_threshold`` consecutive transport failures the circuit
+    # opens and callouts are skipped outright; after
+    # ``circuit_reset_seconds`` one half-open probe is let through —
+    # success closes the circuit, failure re-opens it.
+    failure_threshold: int = 3
+    circuit_reset_seconds: float = 30.0
 
 
 class ExtenderError(Exception):
     pass
+
+
+# circuit states — also the extender_circuit_state gauge values
+CIRCUIT_CLOSED = 0
+CIRCUIT_OPEN = 1
+CIRCUIT_HALF_OPEN = 2
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a timed half-open probe.
+
+    Thread-safe: the scheduler fans extender callouts across a 16-worker
+    pool, and N workers hitting a dead extender must resolve to ONE open
+    circuit (and later exactly one half-open probe), not N racing states.
+    """
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_seconds: float = 30.0, clock=time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_seconds = reset_seconds
+        self.clock = clock
+        self._state = CIRCUIT_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call go out now?  OPEN past the reset window transitions
+        to HALF_OPEN and admits exactly one probe; further calls are
+        refused until that probe resolves via success()/failure()."""
+        with self._lock:
+            if self._state == CIRCUIT_CLOSED:
+                return True
+            if self._state == CIRCUIT_OPEN and \
+                    self.clock() - self._opened_at >= self.reset_seconds:
+                self._state = CIRCUIT_HALF_OPEN
+                return True
+            return False
+
+    def success(self) -> None:
+        with self._lock:
+            self._state = CIRCUIT_CLOSED
+            self._failures = 0
+
+    def failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if (self._state == CIRCUIT_HALF_OPEN
+                    or self._failures >= self.failure_threshold):
+                self._state = CIRCUIT_OPEN
+                self._opened_at = self.clock()
 
 
 # --- minimal HTTP/1.1 fast path ---------------------------------------------
@@ -79,11 +146,22 @@ def _read_headers(rfile) -> Optional[Dict[bytes, bytes]]:
 
 
 def _read_body(rfile, headers: Dict[bytes, bytes]) -> Optional[bytes]:
-    """Content-Length-framed body; None when the framing is not the
-    simple kind — the client surfaces that as ExtenderError (ignorable
-    policy applies) and the server drops the connection."""
+    """Content-Length- or chunked-framed body; None when the framing is
+    neither — the client surfaces that as ExtenderError (ignorable policy
+    applies) and the server drops the connection.
+
+    Chunked matters for interop: a real Go extender writing large JSON
+    replies through json.NewEncoder(w) emits Transfer-Encoding: chunked
+    (net/http buffers only small handler writes), so rejecting it failed
+    every callout against exactly the external extenders this module
+    exists for (ADVICE round 5)."""
+    te = headers.get(b"transfer-encoding")
+    if te is not None:
+        if te.strip().lower() != b"chunked":
+            return None
+        return _read_chunked(rfile)
     cl = headers.get(b"content-length")
-    if cl is None or headers.get(b"transfer-encoding"):
+    if cl is None:
         return None
     n = int(cl)
     chunks = []
@@ -96,14 +174,68 @@ def _read_body(rfile, headers: Dict[bytes, bytes]) -> Optional[bytes]:
     return b"".join(chunks)
 
 
+def _read_chunked(rfile) -> Optional[bytes]:
+    """RFC 7230 §4.1 chunked decoding: size line (hex, extensions after
+    ';' ignored) → chunk data → CRLF, until the 0-size chunk, then trailer
+    lines until the blank line.  None on a malformed size line (stream
+    desynced — caller treats as unsupported framing and drops the
+    connection); ConnectionResetError when the peer closes mid-body."""
+    chunks = []
+    while True:
+        size_line = rfile.readline(65536)
+        if not size_line:
+            raise ConnectionResetError("peer closed mid-chunk-size")
+        try:
+            n = int(size_line.split(b";", 1)[0].strip(), 16)
+        except ValueError:
+            return None
+        if n == 0:
+            while True:  # trailer section
+                t = rfile.readline(65536)
+                if not t:
+                    raise ConnectionResetError("peer closed in trailers")
+                if t in (b"\r\n", b"\n"):
+                    return b"".join(chunks)
+        remaining = n
+        while remaining > 0:
+            chunk = rfile.read(remaining)
+            if not chunk:
+                raise ConnectionResetError("peer closed mid-chunk")
+            chunks.append(chunk)
+            remaining -= len(chunk)
+        crlf = rfile.readline(65536)  # the chunk-terminating CRLF
+        if crlf not in (b"\r\n", b"\n"):
+            return None
+
+
 class HTTPExtender:
-    def __init__(self, cfg: ExtenderConfig):
+    def __init__(self, cfg: ExtenderConfig, clock=time.monotonic):
         self.cfg = cfg
         # pool of idle keep-alive connections, shared across threads: the
         # scheduler's callout ThreadPoolExecutor is per-round, so
         # thread-local connections would be rebuilt (and leaked) each round
         self._pool: List[tuple] = []  # (socket, buffered reader)
         self._pool_lock = threading.Lock()
+        # per-extender circuit breaker (see ExtenderConfig): transport
+        # failures trip it; an open circuit skips callouts so an ignorable
+        # extender's outage stops costing http_timeout per pod, and a
+        # non-ignorable one fails fast into the unschedulable/backoff path
+        self.breaker = CircuitBreaker(cfg.failure_threshold,
+                                      cfg.circuit_reset_seconds, clock=clock)
+        self._publish_circuit()
+
+    def _publish_circuit(self) -> None:
+        m.extender_circuit_state.set(self.breaker.state,
+                                     (self.cfg.url_prefix,))
+
+    def _circuit_allow(self) -> bool:
+        ok = self.breaker.allow()
+        self._publish_circuit()
+        return ok
+
+    def _circuit_result(self, ok: bool) -> None:
+        (self.breaker.success if ok else self.breaker.failure)()
+        self._publish_circuit()
 
     def close(self) -> None:
         with self._pool_lock:
@@ -152,6 +284,13 @@ class HTTPExtender:
         "numPDBViolations": int}."""
         if not self.supports_preemption:
             return node_name_to_victims
+        if not self._circuit_allow():
+            # checked BEFORE building the victims payload: an open circuit
+            # must not pay the per-victim pod serialization it would discard
+            if self.cfg.ignorable:
+                return node_name_to_victims
+            raise ExtenderError(
+                f"extender {self.cfg.url_prefix}: circuit open")
         if self.cfg.node_cache_capable:
             victims_key = "nodeNameToMetaVictims"
             victims = {
@@ -174,9 +313,11 @@ class HTTPExtender:
         try:
             result = self._send(self.cfg.preempt_verb, args)
         except Exception as e:
+            self._circuit_result(False)
             if self.cfg.ignorable:
                 return node_name_to_victims
             raise ExtenderError(str(e)) from e
+        self._circuit_result(True)
         reply = result.get("nodeNameToMetaVictims") or result.get("nodeNameToVictims") or {}
         out = {}
         for node, meta in reply.items():
@@ -229,8 +370,12 @@ class HTTPExtender:
         u = urlparse(self.cfg.url_prefix)
         path = f"{u.path.rstrip('/')}/{verb}"
         body = json.dumps(payload).encode()
+        # resolved port, matching _fresh_conn: u.port is None for a URL
+        # without an explicit port, and "Host: example.com:None" breaks
+        # strict servers / vhost routing (ADVICE round 5)
+        port = u.port or (443 if u.scheme == "https" else 80)
         head = (
-            f"POST {path} HTTP/1.1\r\nHost: {u.hostname}:{u.port}\r\n"
+            f"POST {path} HTTP/1.1\r\nHost: {u.hostname}:{port}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\nConnection: keep-alive\r\n\r\n"
         ).encode()
@@ -305,14 +450,28 @@ class HTTPExtender:
         nodenames when nodeCacheCapable (extender.go:277-345)."""
         if not self.cfg.filter_verb:
             return node_names, {}
+        if not self._circuit_allow():
+            # open circuit: an ignorable extender is SKIPPED (all nodes
+            # pass, the cycle proceeds without it — graceful degradation);
+            # a non-ignorable one fails fast, sparing the timeout, and the
+            # scheduler's callout handler turns that into
+            # unschedulable+backoff, never a crashed cycle
+            if self.cfg.ignorable:
+                return node_names, {}
+            raise ExtenderError(
+                f"extender {self.cfg.url_prefix}: circuit open")
         args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
         try:
             result = self._send(self.cfg.filter_verb, args, idempotent=True)
         except Exception as e:
+            self._circuit_result(False)
             if self.cfg.ignorable:
                 return node_names, {}
             raise ExtenderError(str(e)) from e
+        self._circuit_result(True)
         if result.get("error"):
+            # protocol-level error from a HEALTHY extender (it answered):
+            # not a transport failure — the circuit stays closed
             raise ExtenderError(result["error"])
         return list(result.get("nodenames") or []), dict(result.get("failedNodes") or {})
 
@@ -323,14 +482,21 @@ class HTTPExtender:
         scheduler.go:1146-1185)."""
         if not self.cfg.prioritize_verb:
             return {}
+        if not self._circuit_allow():
+            if self.cfg.ignorable:
+                return {}
+            raise ExtenderError(
+                f"extender {self.cfg.url_prefix}: circuit open")
         args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
         try:
             result = self._send(self.cfg.prioritize_verb, args,
                                 idempotent=True)
         except Exception as e:
+            self._circuit_result(False)
             if self.cfg.ignorable:
                 return {}
             raise ExtenderError(str(e)) from e
+        self._circuit_result(True)
         return {
             hp["host"]: hp["score"] * self.cfg.weight
             for hp in result or []
@@ -339,10 +505,18 @@ class HTTPExtender:
     def bind(self, pod: v1.Pod, node_name: str) -> bool:
         if not self.cfg.bind_verb:
             return False
-        result = self._send(self.cfg.bind_verb, {
-            "podNamespace": pod.namespace, "podName": pod.metadata.name,
-            "podUID": pod.uid, "node": node_name,
-        })
+        if not self._circuit_allow():
+            raise ExtenderError(
+                f"extender {self.cfg.url_prefix}: circuit open")
+        try:
+            result = self._send(self.cfg.bind_verb, {
+                "podNamespace": pod.namespace, "podName": pod.metadata.name,
+                "podUID": pod.uid, "node": node_name,
+            })
+        except Exception:
+            self._circuit_result(False)
+            raise
+        self._circuit_result(True)
         if result.get("error"):
             raise ExtenderError(result["error"])
         return True

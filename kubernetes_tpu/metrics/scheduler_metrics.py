@@ -58,3 +58,41 @@ queue_incoming_pods = default_registry.register(
 scheduler_cache_size = default_registry.register(
     Gauge("scheduler_scheduler_cache_size")  # labels: (type,)
 )
+
+# --- robustness / degradation observability ----------------------------------
+# The chaos harness (kubernetes_tpu/chaos/) asserts these series so every
+# retry, relist, and circuit transition is visible, not silent.
+
+scheduler_retries = default_registry.register(
+    # labels: (reason,) — "cycle_error" (whole-batch dispatch failure
+    # requeued) | "bind_error" (per-pod binding-cycle fault requeued)
+    Counter("scheduler_retries_total",
+            "Pods requeued through the failure handler instead of dropped")
+)
+extender_circuit_state = default_registry.register(
+    # labels: (url,) — 0 closed, 1 open, 2 half-open (extender.CircuitBreaker)
+    Gauge("extender_circuit_state",
+          "Per-extender circuit breaker state (0 closed, 1 open, 2 half-open)")
+)
+informer_relists = default_registry.register(
+    # labels: (kind,)
+    Counter("informer_relists_total",
+            "Reflector full relists after a watch drop/error")
+)
+client_request_retries = default_registry.register(
+    # labels: (code,) — HTTP status (or 409 for injected conflicts) that
+    # triggered the resend; shared by HTTPApiClient and chaos.RetryingStore
+    Counter("client_request_retries_total",
+            "API requests resent after a retryable failure")
+)
+chaos_faults_injected = default_registry.register(
+    # labels: (fault,) — write_429 | write_500 | write_503 | conflict |
+    # watch_drop | slow | http_429 | http_500 | http_503
+    Counter("chaos_faults_injected_total",
+            "Faults the active FaultSchedule actually injected")
+)
+leader_election_status = default_registry.register(
+    # labels: (identity,) — 1 while leading (the reference's
+    # leader_election_master_status)
+    Gauge("leader_election_master_status")
+)

@@ -139,6 +139,15 @@ def _sweep_and_rank(base, alloc, vr, v_valid, v_viol, v_prio, v_ts, req_v):
     candidate arrays → (victim_mask, nviol, order, valid), or
     (..., None) when no candidate fits at all.
 
+    OUTPUT CONTRACT — valid rows only: victim_mask/nviol/order carry
+    meaningful values ONLY for rows where ``valid`` is True (and ``order``
+    only up to the first invalid entry).  For infeasible candidates the
+    native C++ pass zeroes victim_mask/nviol while the numpy oracle leaves
+    real values there (all valid victims, actual violation counts) — the
+    two backends intentionally diverge on rows no caller may read, and the
+    parity test compares valid rows only.  Consumers of the full outputs
+    must gate on ``valid`` or get backend-dependent garbage.
+
     Dispatches to the native C++ single pass (native/preempt_sweep.cpp)
     when available — the numpy path below is the parity oracle
     (tests/test_preemption.py pins native == numpy on randomized inputs)

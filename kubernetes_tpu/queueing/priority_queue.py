@@ -165,6 +165,21 @@ class PriorityQueue:
         else:
             self._unschedulable[uid] = info
 
+    def requeue_after_error(self, info: QueuedPodInfo) -> None:
+        """Transient-error requeue: straight to the backoff heap.
+
+        An INTERNAL error (store outage mid-cycle, bind transport fault) is
+        retriable on a timer — no cluster event will ever arrive to move the
+        pod out of unschedulableQ, so parking it there strands it for the
+        60s leftover flush.  The reference routes framework errors the same
+        way (handleSchedulingFailure → podBackoffQ)."""
+        uid = info.pod.uid
+        if uid in self._in_active or uid in self._in_backoff \
+                or uid in self._unschedulable:
+            return
+        info.timestamp = self._clock()
+        self._push_backoff(info)
+
     def scheduling_cycle(self) -> int:
         return self._moves
 

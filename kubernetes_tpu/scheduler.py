@@ -81,6 +81,12 @@ def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
     ]
 
 
+class _TransientBindError(Exception):
+    """A store/transport fault during the binding cycle (NOT a plugin
+    rejection): already rolled back; retriable on a timer via the backoff
+    queue — no cluster event is needed to unblock the pod."""
+
+
 @dataclass
 class CycleStats:
     attempted: int = 0
@@ -669,8 +675,17 @@ class TPUScheduler:
         nxt = None
         if infos:
             prevs = list(inflight[-tail:]) if tail else None
-            nxt = self._dispatch_batch(infos, prevs=prevs,
-                                       interacts=next_interacts)
+            try:
+                nxt = self._dispatch_batch(infos, prevs=prevs,
+                                           interacts=next_interacts)
+            except Exception as e:
+                # whole-cycle fault (store outage mid-dispatch, extender
+                # transport collapse, device error): route through the
+                # failure handler — the batch requeues via the existing pod
+                # backoff instead of vanishing, and the scheduler loop keeps
+                # running (handleSchedulingFailure, schedule_one.go:921)
+                self._handle_cycle_failure(infos, e)
+                stats.attempted += len(infos)
 
         for fl, rows in completed:  # binds overlap nxt's device window
             merge(self._bind_phase(fl, rows))
@@ -684,6 +699,34 @@ class TPUScheduler:
         stats.in_flight = sum(len(fl.infos) for fl in inflight)
         self._observe_pending()
         return stats
+
+    def _handle_cycle_failure(self, infos: List[QueuedPodInfo],
+                              err: Exception) -> None:
+        """Failure handler for a batch whose cycle died before producing
+        decisions: every pod requeues through the BACKOFF queue (a transient
+        error is retriable on a timer — no cluster event will arrive to
+        unpark it from unschedulableQ; attempts was already counted by pop,
+        so the exponential per-pod backoff applies), so control-plane
+        faults cost a retry, not a lost pod."""
+        m.scheduler_retries.inc(("cycle_error",), by=len(infos))
+        klog.V(1).info_s("Scheduling cycle failed; requeueing batch",
+                         error=f"{type(err).__name__}: {err}",
+                         pods=len(infos))
+        for qi in infos:
+            self._requeue_after_failure(qi)
+
+    def _requeue_after_failure(self, qi: QueuedPodInfo) -> None:
+        """Requeue one pod after an error path, guarding against the
+        deleted-while-in-flight ghost (its DELETE event was consumed while
+        the pod was out of the queue).  A store read that itself fails
+        requeues anyway — a spurious retry beats a dropped pod."""
+        try:
+            exists = self.store.get(
+                "Pod", qi.pod.namespace, qi.pod.metadata.name) is not None
+        except Exception:
+            exists = True
+        if exists:
+            self.queue.requeue_after_error(qi)
 
     def _await_backoff_wave(self) -> None:
         """Hold the cycle briefly while an imminent backoff wave drains into
@@ -960,7 +1003,16 @@ class TPUScheduler:
                 # name resolved at completion time (see _complete) — the
                 # row→name map may have changed under the next dispatch's sync
                 node_name = fl.node_names[i]
-                ok = self._run_reserve_and_bind(fw, qi.pod, node_name)
+                try:
+                    ok = self._run_reserve_and_bind(fw, qi.pod, node_name)
+                except _TransientBindError:
+                    # already rolled back; timer retry via backoff — the
+                    # rest of the batch's bind phase proceeds untouched
+                    self.cache.forget_pod(qi.pod)
+                    self._requeue_after_failure(qi)
+                    m.scheduling_attempt_duration.observe(
+                        float(fl.algo_lat[i]) + (self.clock() - t_pod))
+                    continue
                 if ok:
                     self.cache.finish_binding(qi.pod)
                     stats.scheduled += 1
@@ -1020,7 +1072,10 @@ class TPUScheduler:
                     # the lazy context (PDB list, row→name, candidate-mask
                     # program) is only built once a pod that CAN preempt
                     # fails — its full-pod-tier einsum must not run for
-                    # Never-policy batches
+                    # Never-policy batches (store writes inside the post
+                    # filter ride the bind_error guard at the call site
+                    # below: a transient fault requeues this pod, it never
+                    # kills the rest of the batch's bind phase)
                     if pf_ctx is None:
                         # row→name from _complete (pre-sync): the next batch's
                         # encoder.sync may have reused a deleted node's row,
@@ -1050,10 +1105,22 @@ class TPUScheduler:
                                 levels=fl.cand_levels,
                             )
                         )
-                    fast_bound = self._run_post_filter(
-                        fw, qi, batch, dsnap, dyn, auxes, i,
-                        cand_row=cand_np[i], pf_ctx=pf_ctx,
-                    )
+                    try:
+                        fast_bound = self._run_post_filter(
+                            fw, qi, batch, dsnap, dyn, auxes, i,
+                            cand_row=cand_np[i], pf_ctx=pf_ctx,
+                        )
+                    except Exception as e:
+                        # transient store fault mid-preemption (victim
+                        # delete / nomination write blew through retries):
+                        # degrade to nominate-nothing — the pod requeues
+                        # with backoff below and re-runs preemption clean
+                        m.scheduler_retries.inc(("bind_error",))
+                        klog.V(1).info_s(
+                            "PostFilter failed; pod will retry",
+                            pod=qi.pod.key(),
+                            error=f"{type(e).__name__}: {e}")
+                        fast_bound = None
                 if fast_bound is not None:
                     # preemption fast-bound the pod to its nominated node
                     # within this attempt (_try_nominated_fast_bind); its
@@ -1437,7 +1504,21 @@ class TPUScheduler:
             if status is not None and not status.is_success():
                 rollback()
                 return False
-        ok = self.store.bind_pod(pod.namespace, pod.metadata.name, node_name)
+        try:
+            ok = self.store.bind_pod(pod.namespace, pod.metadata.name,
+                                     node_name)
+        except Exception as e:
+            # transport fault that outlived the client's retries: rollback,
+            # then surface as _TransientBindError so the caller requeues to
+            # BACKOFF (timer retry) rather than unschedulableQ (event wait).
+            # Chaos faults inject BEFORE the store mutation, so a failed
+            # bind provably did not half-apply (no double-bind ambiguity).
+            m.scheduler_retries.inc(("bind_error",))
+            klog.V(1).info_s("Bind failed; pod will retry",
+                             pod=pod.key(), node=node_name,
+                             error=f"{type(e).__name__}: {e}")
+            rollback()
+            raise _TransientBindError(str(e)) from e
         if not ok:
             # binding-cycle error (e.g. pod deleted mid-cycle) unreserves too,
             # else VolumeBinding assume-state leaks (scheduler.go:676-689)
@@ -1680,7 +1761,11 @@ class TPUScheduler:
                     return False
         pod.status.nominated_node_name = None
         self.cache.assume_pod(pod, cand.node_name)
-        if not self._run_reserve_and_bind(fw, pod, cand.node_name):
+        try:
+            ok = self._run_reserve_and_bind(fw, pod, cand.node_name)
+        except _TransientBindError:
+            ok = False  # rolled back; fall through to nominate-and-requeue
+        if not ok:
             self.cache.forget_pod(pod)
             pod.status.nominated_node_name = cand.node_name
             return False

@@ -20,6 +20,9 @@ from ..api import objects as v1
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# stream-level failure marker (the reference watch protocol's ERROR event,
+# apimachinery/pkg/watch): consumers must relist — never a state change
+ERROR = "ERROR"
 
 
 class QuotaExceeded(ValueError):
@@ -41,12 +44,20 @@ class WatchEvent:
 class ObjectStore:
     """Thread-safe store; watchers receive events synchronously in rv order."""
 
-    def __init__(self):
+    def __init__(self, fault_injector=None):
         self._lock = threading.RLock()
         self._rv = 0
         self._objects: Dict[Tuple[str, str, str], object] = {}
         self._log: List[WatchEvent] = []  # full event history (bounded use: sim)
         self._watchers: List[Callable[[WatchEvent], None]] = []
+        # watcher → on_error callback for watchers that can survive a stream
+        # drop (reflectors relist); watchers without one are never dropped —
+        # an in-process synchronous callback has no stream to cut
+        self._error_cbs: Dict[Callable, Callable] = {}
+        # chaos hook (chaos.faults.FaultSchedule-shaped, or None): consulted
+        # before every write mutation and on every watch fan-out.  None (the
+        # default) costs one attribute check per op.
+        self.fault = fault_injector
         # namespaces holding at least one ResourceQuota: pod admission is
         # zero-cost until a quota actually exists somewhere
         self._quota_namespaces: set = set()
@@ -69,12 +80,35 @@ class ObjectStore:
 
     def _emit(self, ev: WatchEvent):
         self._log.append(ev)
+        drop = False
+        if self.fault is not None and self._error_cbs:
+            name = getattr(getattr(ev.obj, "metadata", None), "name", "")
+            drop = self.fault.should_drop_watch(ev.kind, name,
+                                                rv=ev.resource_version)
         for w in list(self._watchers):
-            w(ev)
+            cb = self._error_cbs.get(w)
+            if drop and cb is not None:
+                # cut the stream BEFORE delivering: the dropped watcher
+                # misses this event and must recover it by relisting (the
+                # reflector's ListAndWatch restart).  Resumable watchers
+                # only — a plain callback has no relist path.
+                self._watchers.remove(w)
+                del self._error_cbs[w]
+                from ..chaos.faults import WatchDropped
+
+                cb(WatchDropped(
+                    f"chaos: watch dropped at {ev.kind} rv={ev.resource_version}"))
+            else:
+                w(ev)
 
     # --- CRUD ----------------------------------------------------------------
 
     def create(self, kind: str, obj) -> int:
+        if self.fault is not None:
+            # outside the lock: an injected delay/429 must not stall other
+            # writers; raising HERE means the mutation never half-applied,
+            # so a client retry is always safe
+            self.fault.write_fault("create", kind, obj.metadata.name)
         with self._lock:
             if kind == "Pod":
                 self._admit_pod(obj)
@@ -101,6 +135,8 @@ class ObjectStore:
         StaleResourceVersion — the etcd3 GuaranteedUpdate contract that makes
         the apiserver's 409 actually prevent lost updates (a handler-level
         check-then-act would race concurrent writers)."""
+        if self.fault is not None:
+            self.fault.write_fault("update", kind, obj.metadata.name)
         with self._lock:
             key = self._key(kind, obj)
             if key not in self._objects:
@@ -132,6 +168,8 @@ class ObjectStore:
     def delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
         if kind in self.CLUSTER_SCOPED:
             namespace = ""
+        if self.fault is not None:
+            self.fault.write_fault("delete", kind, name)
         with self._lock:
             obj = self._objects.pop((kind, namespace, name), None)
             if obj is None:
@@ -183,14 +221,30 @@ class ObjectStore:
 
     # --- watch ---------------------------------------------------------------
 
-    def watch(self, handler: Callable[[WatchEvent], None], since_rv: int = 0):
-        """Replays history after since_rv, then subscribes (list+watch contract)."""
+    def watch(self, handler: Callable[[WatchEvent], None], since_rv: int = 0,
+              on_error: Optional[Callable[[Exception], None]] = None):
+        """Replays history after since_rv, then subscribes (list+watch contract).
+
+        ``on_error`` (optional) marks the watcher as RESUMABLE: under chaos
+        fault injection its stream may be cut, in which case the callback
+        receives a WatchDropped and the watcher must relist + resubscribe
+        (client/informer.py Reflector does).  Watchers without one are never
+        dropped — a synchronous in-process callback has no stream."""
         with self._lock:
             for ev in self._log:
                 if ev.resource_version > since_rv:
                     handler(ev)
             self._watchers.append(handler)
-            return lambda: self._watchers.remove(handler)
+            if on_error is not None:
+                self._error_cbs[handler] = on_error
+
+            def unwatch():
+                with self._lock:
+                    if handler in self._watchers:
+                        self._watchers.remove(handler)
+                    self._error_cbs.pop(handler, None)
+
+            return unwatch
 
     def _admit_pod(self, pod) -> None:
         """Priority admission: resolve priorityClassName → spec.priority
@@ -261,6 +315,8 @@ class ObjectStore:
     # --- binding subresource --------------------------------------------------
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
+        if self.fault is not None:
+            self.fault.write_fault("bind", "Pod", name)
         with self._lock:
             pod = self.get("Pod", namespace, name)
             if pod is None:

@@ -28,28 +28,34 @@ MEAS = int(sys.argv[3]) if len(sys.argv) > 3 else 5000
 BATCH = int(sys.argv[4]) if len(sys.argv) > 4 else 512
 
 orig_block = sched_mod._pods_block_deep
+orig_infos_block = TPUScheduler._infos_block_deep
 
 
 def _block_without_preempt_clause(pods):
     """_pods_block_deep minus the preemption-capability clause — the
     'allow preemptor chaining' arm of the A/B (measured WORSE: 231/87
     pods/s vs 266/265 blocked; staleness-driven claim collisions)."""
-    from kubernetes_tpu.state.node_info import _pod_host_ports
-
     for p in pods:
-        aff = p.spec.affinity
-        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
-            return True
-        if _pod_host_ports(p):
-            return True
-        if getattr(p.spec, "volumes", None):
+        if sched_mod._pod_blocks_static(p):
             return True
     return False
+
+
+def _infos_block_without_preempt_clause(self, infos):
+    """B-arm gate for the path schedule_cycle ACTUALLY takes: deep-chain
+    gating flows through TPUScheduler._infos_block_deep (the module-level
+    _pods_block_deep only serves the interacts-is-None fallback), so the
+    method must be patched too or both arms measure identical blocking
+    (ADVICE round 5)."""
+    return _block_without_preempt_clause([qi.pod for qi in infos])
 
 
 def run(block_chain: bool) -> float:
     sched_mod._pods_block_deep = (
         orig_block if block_chain else _block_without_preempt_clause
+    )
+    TPUScheduler._infos_block_deep = (
+        orig_infos_block if block_chain else _infos_block_without_preempt_clause
     )
     store = ObjectStore()
     sched = TPUScheduler(store, batch_size=BATCH, pipeline=True)
@@ -89,3 +95,4 @@ for rep in range(2):
     run(True)
     run(False)
 sched_mod._pods_block_deep = orig_block
+TPUScheduler._infos_block_deep = orig_infos_block
