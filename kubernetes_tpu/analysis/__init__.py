@@ -1,0 +1,21 @@
+"""Project-native static invariant checker + runtime lock instrumentation.
+
+The reproduction's hack/verify-* analog: AST checks over this codebase's
+real failure modes (trace safety at the jit boundary, recompile hazards,
+lock discipline, exception hygiene, metrics registration), ratcheted
+against a committed baseline so tier-1 fails only on NEW violations, plus
+an opt-in runtime lock-order monitor (lockcheck) the chaos battery runs
+under.
+
+Entry points:
+  tools/analyze.py           CLI (human/JSON reports, --check gate,
+                             --write-baseline)
+  analysis.registry          check registry (default_checks)
+  analysis.core              engine (load_project / run_checks)
+  analysis.baseline          ratchet (load / diff / write)
+  analysis.lockcheck         runtime lock wrapper (maybe_wrap / activate)
+
+This __init__ stays import-light on purpose: lock owners import
+``analysis.lockcheck`` on hot construction paths; the ast machinery loads
+only when a caller pulls registry/core explicitly.
+"""

@@ -1,0 +1,69 @@
+"""Ratchet baseline: grandfathered findings, count-matched by stable key.
+
+The committed analysis_baseline.json maps Finding.key() -> count.  A run
+is clean when no key exceeds its baselined count (NEW violations fail);
+keys whose live count dropped are STALE — the baseline should be shrunk
+(tools/analyze.py --write-baseline) so fixed sites stay fixed.  The gate
+in tests/test_static_analysis.py enforces both directions: the baseline
+only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+BASELINE_FILENAME = "analysis_baseline.json"
+
+
+def baseline_counts(findings: List[Finding]) -> Dict[str, int]:
+    return dict(Counter(f.key() for f in findings))
+
+
+def load(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write(findings: List[Finding], path: str) -> None:
+    data = {
+        "version": 1,
+        "comment": ("Grandfathered static-analysis findings — shrink this "
+                    "file (fix sites, rerun tools/analyze.py "
+                    "--write-baseline), never grow it."),
+        "findings": dict(sorted(baseline_counts(findings).items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def diff(findings: List[Finding],
+         baseline: Dict[str, int]) -> Tuple[List[Finding], List[str]]:
+    """(new_findings, stale_keys).
+
+    new_findings: concrete findings beyond the baselined count for their
+    key (if a key has 2 live sites but baseline says 1, the LAST site by
+    line number is reported as new).  stale_keys: baseline entries whose
+    live count dropped below the recorded count.
+    """
+    live = baseline_counts(findings)
+    by_key: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key(), []).append(f)
+    new: List[Finding] = []
+    for key, fs in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(fs) > allowed:
+            fs_sorted = sorted(fs, key=lambda f: f.line)
+            new.extend(fs_sorted[allowed:])
+    stale = [k for k, n in baseline.items() if live.get(k, 0) < n]
+    new.sort(key=lambda f: (f.path, f.line, f.check))
+    return new, sorted(stale)

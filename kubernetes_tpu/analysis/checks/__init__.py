@@ -1,0 +1,7 @@
+"""Check modules register themselves on import (plugins/__init__.py idiom)."""
+
+from . import exception_hygiene  # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import metrics_registration  # noqa: F401
+from . import recompile_hazard  # noqa: F401
+from . import trace_safety  # noqa: F401

@@ -1,0 +1,74 @@
+"""exception-hygiene: broad excepts that swallow errors silently.
+
+An ``except Exception`` (or bare ``except``) whose handler neither
+re-raises, logs (klog/logging/print), nor records a metric hides real
+failures — the class of bug PR 1's chaos harness exists to surface.  The
+fix is one of: narrow the exception type to what the code actually
+tolerates, add a klog line, or let it propagate.  Sites that are genuinely
+best-effort get grandfathered in the baseline (shrink it, never grow it).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+
+BROAD = {"Exception", "BaseException"}
+# a call whose dotted name starts with one of these prefixes, or whose last
+# segment is one of these names, makes the handler non-silent
+LOGGING_PREFIXES = ("klog.", "logging.", "m.", "metrics.", "self.log",
+                    "log.", "logger.", "_logger.", "warnings.")
+LOGGING_TAILS = {"info_s", "error_s", "info", "error", "warning", "warn",
+                 "debug", "exception", "print", "log", "inc", "observe",
+                 "add_note"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        return dotted_name(t).rsplit(".", 1)[-1] in BROAD
+    if isinstance(t, ast.Tuple):
+        return any(dotted_name(e).rsplit(".", 1)[-1] in BROAD
+                   for e in t.elts)
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            if name.startswith(LOGGING_PREFIXES):
+                return False
+            if name.rsplit(".", 1)[-1] in LOGGING_TAILS:
+                return False
+    return True
+
+
+@register_check
+class ExceptionHygieneCheck(Check):
+    name = "exception-hygiene"
+    description = ("`except Exception` handlers that swallow without "
+                   "re-raise, log, or metric")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ExceptHandler) and \
+                        _is_broad(node) and _is_silent(node):
+                    scope = mod.scope_of(node) or "<module>"
+                    findings.append(mod.finding(
+                        self.name, "silent-swallow", node,
+                        f"broad except in `{scope}` swallows the error "
+                        f"with no re-raise, log, or metric — narrow the "
+                        f"type or surface the failure"))
+        return findings

@@ -1,0 +1,251 @@
+"""lock-discipline: attributes mutated both under and outside a class's lock.
+
+For every class that owns a threading.Lock/RLock attribute (sim/store.py
+ObjectStore, client/informer.py Reflector, metrics/registry.py Counter /
+Histogram, utils/compilemon.py CompileMonitor), each ``self.X`` mutation
+site is classified as locked (lexically inside ``with self.<lock>``) or
+unlocked.  An attribute with BOTH kinds of site is a discipline break: the
+unlocked sites race the protected ones.
+
+Helper-method propagation keeps private helpers honest without false
+positives: a method whose intra-class call sites are ALL lock-held is
+itself treated as lock-held (ObjectStore._emit is only ever called under
+``self._lock`` from create/update/delete/bind_pod).  ``__init__`` is
+exempt — the object is not shared yet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+
+MUTATING_METHODS = {"append", "add", "remove", "pop", "popitem", "clear",
+                    "update", "extend", "insert", "discard", "setdefault"}
+EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.X assigned a value whose expression constructs a *Lock()."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        makes_lock = any(
+            isinstance(n, ast.Call)
+            and dotted_name(n.func).rsplit(".", 1)[-1] in ("Lock", "RLock")
+            for n in ast.walk(node.value))
+        if not makes_lock:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'X' when node is self.X (through one optional subscript), else ''."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+class _Site:
+    __slots__ = ("attr", "node", "method", "lexically_locked")
+
+    def __init__(self, attr: str, node: ast.AST, method: str,
+                 lexically_locked: bool):
+        self.attr = attr
+        self.node = node
+        self.method = method
+        self.lexically_locked = lexically_locked
+
+
+def _lock_wrappers(cls: ast.ClassDef, locks: Set[str]) -> Set[str]:
+    """Contextmanager methods that hold the lock for their caller: a
+    generator method whose yield sits inside ``with self.<lock>`` (the
+    store's _locked_emit pattern) — ``with self.wrapper():`` in another
+    method then counts as lock-held."""
+    out: Set[str] = set()
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(meth):
+            if isinstance(node, ast.With) and any(
+                    _self_attr(i.context_expr) in locks
+                    for i in node.items):
+                if any(isinstance(n, (ast.Yield, ast.YieldFrom))
+                       for n in ast.walk(node)):
+                    out.add(meth.name)
+                    break
+    return out
+
+
+def _under_lock(mod: ModuleInfo, node: ast.AST, locks: Set[str],
+                stop: ast.AST, wrappers: Set[str] = frozenset()) -> bool:
+    cur = mod.parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                if _self_attr(expr) in locks:
+                    return True
+                if isinstance(expr, ast.Call) and \
+                        _self_attr(expr.func) in wrappers:
+                    return True
+        cur = mod.parents.get(cur)
+    return False
+
+
+def _method_of(mod: ModuleInfo, node: ast.AST, cls_qual: str) -> str:
+    """Bare name of the class method whose body contains node ('' if not)."""
+    scope = mod.scope_of(node)
+    if not scope.startswith(cls_qual + "."):
+        return ""
+    return scope[len(cls_qual) + 1:].split(".", 1)[0]
+
+
+def _mutation_sites(mod: ModuleInfo, cls: ast.ClassDef, cls_qual: str,
+                    locks: Set[str],
+                    wrappers: Set[str] = frozenset()) -> List[_Site]:
+    sites: List[_Site] = []
+
+    def add(attr: str, node: ast.AST):
+        method = _method_of(mod, node, cls_qual)
+        if not method or method in EXEMPT_METHODS or attr in locks:
+            return
+        sites.append(_Site(attr, node, method,
+                           _under_lock(mod, node, locks, cls, wrappers)))
+
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    add(attr, node)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    add(attr, node)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATING_METHODS:
+            attr = _self_attr(node.func.value)
+            if attr:
+                add(attr, node)
+    return sites
+
+
+def _intra_class_calls(
+        mod: ModuleInfo, cls: ast.ClassDef, cls_qual: str, locks: Set[str],
+        wrappers: Set[str] = frozenset()
+) -> Dict[str, List[Tuple[str, bool, ast.Call]]]:
+    """method -> [(caller, lexically_locked, call_node)] for self.m() calls."""
+    methods = {n.name for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    calls: Dict[str, List[Tuple[str, bool, ast.Call]]] = {m: [] for m in methods}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self" and node.func.attr in methods:
+            caller = _method_of(mod, node, cls_qual)
+            if caller:
+                calls[node.func.attr].append(
+                    (caller, _under_lock(mod, node, locks, cls, wrappers),
+                     node))
+    return calls
+
+
+def _always_locked_methods(
+        calls: Dict[str, List[Tuple[str, bool, ast.Call]]]) -> Set[str]:
+    """Fixed point: methods whose every intra-class call site is lock-held
+    (lexically, or inside an already always-locked method)."""
+    locked: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for m, sites in calls.items():
+            if m in locked or not sites:
+                continue
+            if all(lex or caller in locked for caller, lex, _ in sites):
+                locked.add(m)
+                changed = True
+    return locked
+
+
+@register_check
+class LockDisciplineCheck(Check):
+    name = "lock-discipline"
+    description = ("attributes of lock-owning classes mutated both under "
+                   "and outside the lock")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._scan_class(mod, node))
+        return findings
+
+    def _scan_class(self, mod: ModuleInfo,
+                    cls: ast.ClassDef) -> Iterable[Finding]:
+        locks = _lock_attrs(cls)
+        if not locks:
+            return
+        # scope_of(cls) is the scope INSIDE the class body (its qualname)
+        cls_qual = mod.scope_of(cls) or cls.name
+        wrappers = _lock_wrappers(cls, locks)
+        sites = _mutation_sites(mod, cls, cls_qual, locks, wrappers)
+        calls = _intra_class_calls(mod, cls, cls_qual, locks, wrappers)
+        propagated = _always_locked_methods(calls)
+        lock_desc = "/".join(sorted(locks))
+
+        # mixed-helper-call: a helper that mutates state and is reached
+        # both under the lock and without it — its mutations are only
+        # protected on SOME paths (client/informer.py's _apply_relist
+        # called from the locked error path AND from run()).
+        mutating_methods = {s.method for s in sites}
+        for method, call_sites in sorted(calls.items()):
+            if method not in mutating_methods or method in propagated:
+                continue
+            locked_calls = [c for c in call_sites
+                            if c[1] or c[0] in propagated]
+            unlocked_calls = [c for c in call_sites
+                              if not (c[1] or c[0] in propagated)]
+            if not locked_calls or not unlocked_calls:
+                continue
+            for caller, _, node in unlocked_calls:
+                yield mod.finding(
+                    self.name, "mixed-helper-call", node,
+                    f"`self.{method}()` mutates state and is called under "
+                    f"`self.{lock_desc}` elsewhere, but WITHOUT it here in "
+                    f"`{caller}` — the helper's writes are unprotected on "
+                    f"this path ({cls.name})")
+
+        by_attr: Dict[str, List[_Site]] = {}
+        for s in sites:
+            by_attr.setdefault(s.attr, []).append(s)
+        for attr, attr_sites in sorted(by_attr.items()):
+            locked = [s for s in attr_sites
+                      if s.lexically_locked or s.method in propagated]
+            unlocked = [s for s in attr_sites
+                        if not (s.lexically_locked or s.method in propagated)]
+            if not locked or not unlocked:
+                continue
+            for s in unlocked:
+                yield mod.finding(
+                    self.name, "mixed-lock-use", s.node,
+                    f"`self.{attr}` is mutated under `self.{lock_desc}` at "
+                    f"{len(locked)} site(s) but WITHOUT it here in "
+                    f"`{s.method}` — unlocked writes race the protected "
+                    f"ones ({cls.name})")

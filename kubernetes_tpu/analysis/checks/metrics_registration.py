@@ -1,0 +1,164 @@
+"""metrics-registration: every emitted metric exists, exactly once.
+
+Guards the typo'd-counter class of bug: a misspelled attribute on the
+scheduler_metrics module (``m.informer_relist.inc`` — note the missing
+``s``) raises AttributeError only on the code path that emits it, which
+under chaos is exactly the path nothing exercises until production.
+
+Rules:
+  unknown-attr       ``m.X`` where the scheduler_metrics module defines no
+                     module-level ``X``
+  unknown-name       ``default_registry.get("name")`` for a name no
+                     registered metric carries
+  duplicate-name     the same metric name string constructed more than once
+  registered-unused  a registered series no scanned code ever references
+                     (dead metric, or the emit site was lost in a refactor)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+
+METRICS_MODULE_SUFFIX = "metrics/scheduler_metrics.py"
+METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+
+def _module_level_names(mod: ModuleInfo) -> Set[str]:
+    names: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            names.update(t.id for t in node.targets
+                         if isinstance(t, ast.Name))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            names.update(a.asname or a.name.split(".")[0]
+                         for a in node.names)
+    return names
+
+
+def _metric_defs(mod: ModuleInfo) -> Dict[str, str]:
+    """attr name -> registered metric name string (module level only)."""
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        for call in ast.walk(node.value):
+            if isinstance(call, ast.Call) and \
+                    dotted_name(call.func).rsplit(".", 1)[-1] in METRIC_CTORS \
+                    and call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                out[node.targets[0].id] = call.args[0].value
+                break
+    return out
+
+
+def _aliases_of_metrics_module(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "scheduler_metrics":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("scheduler_metrics"):
+                    out.add(a.asname or a.name.split(".")[0])
+    return out
+
+
+@register_check
+class MetricsRegistrationCheck(Check):
+    name = "metrics-registration"
+    description = ("emitted metric attributes/names resolve to exactly one "
+                   "registered series; registered series are emitted")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        metrics_mod = project.find(METRICS_MODULE_SUFFIX)
+        if metrics_mod is None:
+            return []
+        defs = _metric_defs(metrics_mod)
+        valid_attrs = _module_level_names(metrics_mod)
+        registered_names = set(defs.values())
+        findings: List[Finding] = []
+        used_attrs: Set[str] = set()
+
+        # duplicate-name: every Counter/Gauge/Histogram construction
+        seen_ctor: Dict[str, Tuple[str, int]] = {}
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        dotted_name(node.func).rsplit(".", 1)[-1] in \
+                        METRIC_CTORS and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    mname = node.args[0].value
+                    if mname in seen_ctor:
+                        first = seen_ctor[mname]
+                        findings.append(mod.finding(
+                            self.name, "duplicate-name", node,
+                            f"metric `{mname}` is constructed more than "
+                            f"once (first at {first[0]}:{first[1]}) — two "
+                            f"series fight over one name"))
+                    else:
+                        seen_ctor[mname] = (mod.path, node.lineno)
+
+        for mod in project.modules:
+            aliases = _aliases_of_metrics_module(mod)
+            for node in ast.walk(mod.tree):
+                # unknown-attr: alias.X where X is not defined
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in aliases:
+                    used_attrs.add(node.attr)
+                    if node.attr not in valid_attrs:
+                        findings.append(mod.finding(
+                            self.name, "unknown-attr", node,
+                            f"`{node.value.id}.{node.attr}` does not exist "
+                            f"in metrics/scheduler_metrics.py — typo'd "
+                            f"metric raises AttributeError at emit time"))
+                # unknown-name: registry.get("...") string lookups
+                if isinstance(node, ast.Call) and \
+                        dotted_name(node.func).endswith("registry.get") and \
+                        node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    if node.args[0].value not in registered_names:
+                        findings.append(mod.finding(
+                            self.name, "unknown-name", node,
+                            f"registry lookup of `{node.args[0].value}` "
+                            f"matches no registered metric"))
+                # any bare-name reference also counts as usage (re-exports)
+                if isinstance(node, ast.Name) and node.id in defs and \
+                        mod is not metrics_mod:
+                    used_attrs.add(node.id)
+
+        # registered-unused: defined series nothing references by attr OR
+        # by name string (tests are out of scan scope on purpose — an
+        # emit-path must exist in the code itself)
+        looked_up: Set[str] = set()
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        node.value in registered_names and \
+                        mod is not metrics_mod:
+                    looked_up.add(node.value)
+        for attr, mname in sorted(defs.items()):
+            if attr not in used_attrs and mname not in looked_up:
+                # anchor the finding at the registration site
+                for node in metrics_mod.tree.body:
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.targets[0], ast.Name) and \
+                            node.targets[0].id == attr:
+                        findings.append(metrics_mod.finding(
+                            self.name, "registered-unused", node,
+                            f"metric `{mname}` ({attr}) is registered but "
+                            f"no scanned code emits or reads it"))
+                        break
+        return findings

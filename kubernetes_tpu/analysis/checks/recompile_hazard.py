@@ -1,0 +1,199 @@
+"""recompile-hazard: jax.jit wraps that defeat the compile cache.
+
+The round-2 profile (utils/compilemon.py docstring) showed recompilation
+was 90% of bench wall time; the contract is O(1) compiles per cluster
+tier.  Hazards flagged:
+
+  jit-in-loop        jax.jit(...) inside a for/while body — a fresh
+                     callable (and cache entry) per iteration
+  jit-immediate      jax.jit(f)(args) — wrap-and-call compiles per call
+  jit-lambda         jax.jit(lambda ...) inside a function — the lambda's
+                     identity changes per enclosing call, so the jit cache
+                     keys never hit
+  uncached-builder   a function that builds jax.jit programs whose result
+                     is not stored in an init-time cache (self attribute /
+                     module-level binding) at some call site
+  unhashable-static  a list/dict/set literal passed in a position declared
+                     static_argnums on the jitted callable
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("jax.jit", "jit"))
+
+
+def _in_loop(mod: ModuleInfo, node: ast.AST) -> bool:
+    for a in mod.ancestors(node):
+        if isinstance(a, (ast.For, ast.While)):
+            return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _static_argnums(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+@register_check
+class RecompileHazardCheck(Check):
+    name = "recompile-hazard"
+    description = ("per-call jax.jit wrapping, jit-of-lambda, uncached "
+                   "program builders, unhashable static args")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            findings.extend(self._scan_module(mod))
+        return findings
+
+    def _scan_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        # functions whose body wraps jit and returns/yields the result:
+        # candidate "builders" whose call sites must cache
+        builder_quals: Set[str] = set()
+        jitted_names: Dict[str, ast.Call] = {}  # local name -> jit call
+        for node in ast.walk(mod.tree):
+            if not _is_jit_call(node):
+                continue
+            parent = mod.parents.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                yield mod.finding(
+                    self.name, "jit-immediate", node,
+                    "jax.jit(f)(...) wraps AND calls in one expression — "
+                    "the compiled program is rebuilt every execution; "
+                    "cache the jitted callable at init")
+                continue
+            if _in_loop(mod, node):
+                yield mod.finding(
+                    self.name, "jit-in-loop", node,
+                    "jax.jit(...) inside a loop body creates a fresh "
+                    "callable (and compile-cache entry) per iteration — "
+                    "hoist the wrap out of the loop")
+            if node.args and isinstance(node.args[0], ast.Lambda) and \
+                    mod.enclosing_function(node) is not None:
+                yield mod.finding(
+                    self.name, "jit-lambda", node,
+                    "jax.jit(lambda ...) inside a function: the lambda's "
+                    "identity changes per call, so the jit cache never "
+                    "hits across calls — name it and wrap once at init")
+            fn = mod.enclosing_function(node)
+            if fn is not None and self._escapes_via_return(mod, node, fn):
+                builder_quals.add(mod.scope_of(node))
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                jitted_names[parent.targets[0].id] = node
+
+        yield from self._check_builders(mod, builder_quals)
+        yield from self._check_static_args(mod, jitted_names)
+
+    @staticmethod
+    def _escapes_via_return(mod: ModuleInfo, jit_call: ast.Call,
+                            fn: ast.AST) -> bool:
+        """jit result returned directly, or via a local that is returned
+        (incl. as a dict/tuple element — the scheduler's program table)."""
+        for a in mod.ancestors(jit_call):
+            if isinstance(a, ast.Return):
+                return True
+            if a is fn:
+                break
+        # assigned to a local that appears in some return expression
+        parent = mod.parents.get(jit_call)
+        if isinstance(parent, ast.Assign) and \
+                isinstance(parent.targets[0], ast.Name):
+            local = parent.targets[0].id
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Name) and n.id == local:
+                            return True
+        return False
+
+    def _check_builders(self, mod: ModuleInfo,
+                        builder_quals: Set[str]) -> Iterable[Finding]:
+        """Every call site of a jit-program builder must store the result
+        into an init-time cache: a self attribute/subscript, or a
+        module-level binding outside any loop."""
+        bare_builders = {q.rsplit(".", 1)[-1]: q for q in builder_quals}
+        if not bare_builders:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = ""
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "self"):
+                callee = node.func.attr
+            qual = bare_builders.get(callee)
+            if qual is None or mod.scope_of(node).startswith(qual):
+                continue  # not a builder call / recursive self-reference
+            if not self._cached_at_init(mod, node):
+                yield mod.finding(
+                    self.name, "uncached-builder", node,
+                    f"result of `{callee}()` (which builds jax.jit "
+                    f"programs) is not stored in an init-time cache — "
+                    f"each call here compiles fresh executables")
+
+    @staticmethod
+    def _cached_at_init(mod: ModuleInfo, call: ast.Call) -> bool:
+        # walk up through container displays / comprehensions to the
+        # nearest Assign ({v: make(v) for v in ...} at module scope IS an
+        # init-time cache); stop at function or statement boundaries
+        parent = mod.parents.get(call)
+        while isinstance(parent, (ast.Dict, ast.List, ast.Tuple, ast.Set,
+                                  ast.DictComp, ast.ListComp, ast.SetComp,
+                                  ast.comprehension)):
+            parent = mod.parents.get(parent)
+        if not isinstance(parent, ast.Assign):
+            return False
+        if _in_loop(mod, call):
+            return False
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Attribute):  # self._jitted_by[...] = ...
+            return True
+        if isinstance(tgt, ast.Name):
+            # module-level binding (one-time script/init cost) or an
+            # __init__-scope local is treated as cached
+            scope = mod.scope_of(call)
+            return scope == "" or scope.endswith("__init__")
+        return False
+
+    def _check_static_args(self, mod: ModuleInfo,
+                           jitted: Dict[str, ast.Call]) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in jitted):
+                continue
+            statics = _static_argnums(jitted[node.func.id])
+            for idx in statics:
+                if idx < len(node.args) and isinstance(
+                        node.args[idx], (ast.List, ast.Dict, ast.Set)):
+                    yield mod.finding(
+                        self.name, "unhashable-static", node.args[idx],
+                        f"arg {idx} of `{node.func.id}` is declared "
+                        f"static_argnums but receives an unhashable "
+                        f"literal — jit will raise (or thrash) at call "
+                        f"time; pass a tuple/frozen value")

@@ -1,0 +1,214 @@
+"""trace-safety: host syncs / impurity reachable inside jit-traced code.
+
+The jit boundary (scheduler.py _build_jitted, state/encoding.py
+_scatter_rows) is the hot path: a ``.item()``, ``np.asarray`` or
+``time.time()`` inside a traced function either forces a device→host sync
+per call (~100ms on the tunnel-attached TPU) or silently bakes a
+trace-time constant into the compiled program.  Roots are found three
+ways: ``@jax.jit`` decorators, ``jax.jit(fn)`` wraps resolved to
+same-module function defs, and a seed list of known traced entry points
+(the framework/plugin tensor surface, which is jitted from
+scheduler.py:596-609 across module boundaries).  Reachability closes over
+same-module calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+
+# (path suffix, qualname prefix) pairs marking functions traced from another
+# module's jit boundary.  "" prefix = every function in the module.
+TRACED_SEEDS: Tuple[Tuple[str, str], ...] = (
+    ("ops/segment.py", ""),
+    ("state/encoding.py", "apply_scatter"),
+    ("framework/runtime.py", "initial_dynamic_state"),
+    ("framework/runtime.py", "BatchedFramework.prepare"),
+    ("framework/runtime.py", "BatchedFramework.chain_prev"),
+    ("framework/runtime.py", "BatchedFramework.compute_static"),
+    ("framework/runtime.py", "BatchedFramework.compute_row"),
+    ("framework/runtime.py", "BatchedFramework.compute_packed"),
+    ("framework/runtime.py", "BatchedFramework.apply_commits"),
+    ("framework/runtime.py", "BatchedFramework.greedy_assign"),
+    ("framework/runtime.py", "BatchedFramework.batch_assign"),
+    ("framework/runtime.py", "BatchedFramework.diagnose_bits"),
+    ("framework/runtime.py", "BatchedFramework.select_host"),
+    ("plugins/helpers.py", ""),
+)
+# every method with one of these names on any class under plugins/ runs
+# inside the fused programs (the Plugin protocol's traced surface)
+TRACED_PLUGIN_METHODS = {"filter", "score", "prepare", "chain_prev"}
+
+# numpy attributes that are trace-safe (static shape/dtype reads, constants)
+NP_BENIGN = {"shape", "ndim", "dtype", "int8", "int16", "int32", "int64",
+             "uint8", "uint32", "float16", "float32", "float64", "bool_",
+             "inf", "nan", "newaxis", "pi"}
+# time.* and random.* are impure: they execute ONCE at trace time and bake
+# that value into the compiled program forever
+IMPURE_MODULES = {"time", "random"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _numpy_aliases(mod: ModuleInfo) -> Set[str]:
+    out = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _jit_roots(mod: ModuleInfo) -> Set[str]:
+    """Qualnames of functions jit-wrapped within this module."""
+    roots: Set[str] = set()
+    # decorator form: @jax.jit / @jit / @partial(jax.jit, ...)
+    for q, fn in mod.functions.items():
+        for dec in getattr(fn, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            names = {dotted_name(target)}
+            if isinstance(dec, ast.Call):
+                names |= {dotted_name(a) for a in dec.args}
+            if names & {"jax.jit", "jit"}:
+                roots.add(q)
+    # wrap form: jax.jit(fn) where fn names a def anywhere in the module
+    # (the scheduler's _build_jitted table wraps nested defs this way)
+    by_bare: Dict[str, List[str]] = {}
+    for q in mod.functions:
+        by_bare.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("jax.jit", "jit")
+                and node.args):
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                roots.update(by_bare.get(arg.id, ()))
+            elif isinstance(arg, ast.Lambda):
+                roots.add(mod.scope_of(arg))  # scan the enclosing scope
+    return roots
+
+
+def _seeded(mod: ModuleInfo) -> Set[str]:
+    roots: Set[str] = set()
+    for suffix, prefix in TRACED_SEEDS:
+        if not mod.path.endswith(suffix):
+            continue
+        for q in mod.functions:
+            if not prefix or q == prefix or q.startswith(prefix + "."):
+                roots.add(q)
+    if "/plugins/" in mod.path:
+        for q in mod.functions:
+            bare = q.rsplit(".", 1)[-1]
+            if bare in TRACED_PLUGIN_METHODS and "." in q:
+                roots.add(q)
+    return roots
+
+
+def _close_over_calls(mod: ModuleInfo, roots: Set[str]) -> Set[str]:
+    """Add same-module functions called (by bare name or self.X) from roots."""
+    by_bare: Dict[str, List[str]] = {}
+    for q in mod.functions:
+        by_bare.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+    work = list(roots)
+    seen = set(roots)
+    while work:
+        q = work.pop()
+        fn = mod.functions.get(q)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = ""
+            if isinstance(node.func, ast.Name):
+                callee = node.func.id
+            elif (isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "self"):
+                callee = node.func.attr
+            for cq in by_bare.get(callee, ()):
+                if cq not in seen:
+                    seen.add(cq)
+                    work.append(cq)
+    return seen
+
+
+@register_check
+class TraceSafetyCheck(Check):
+    name = "trace-safety"
+    description = ("host syncs, numpy ops, side effects, and wall-clock / "
+                   "PRNG impurity inside jit-traced functions")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            roots = _jit_roots(mod) | _seeded(mod)
+            if not roots:
+                continue
+            traced = _close_over_calls(mod, roots)
+            np_aliases = _numpy_aliases(mod)
+            for q in sorted(traced):
+                fn = mod.functions.get(q)
+                if fn is None:
+                    continue
+                findings.extend(self._scan(mod, q, fn, np_aliases))
+        return findings
+
+    def _scan(self, mod: ModuleInfo, qual: str, fn: ast.AST,
+              np_aliases: Set[str]) -> Iterable[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # skip calls that belong to a NESTED function with its own
+            # qualname (it is scanned under its own root if reachable)
+            if mod.scope_of(node) != qual:
+                continue
+            name = dotted_name(node.func)
+            head, _, tail = name.partition(".")
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in SYNC_METHODS:
+                yield mod.finding(
+                    self.name, "host-sync", node,
+                    f".{node.func.attr}() in traced `{qual}` forces a "
+                    f"device->host sync per call")
+            elif head in np_aliases and tail not in NP_BENIGN:
+                yield mod.finding(
+                    self.name, "numpy-op", node,
+                    f"{name}(...) in traced `{qual}` runs on host at trace "
+                    f"time (constant-folded) or forces a transfer — use jnp")
+            elif head in IMPURE_MODULES:
+                yield mod.finding(
+                    self.name, "impure", node,
+                    f"{name}() in traced `{qual}` executes once at trace "
+                    f"time; the compiled program reuses that value forever")
+            elif name in ("print",) or head in ("klog", "logging"):
+                yield mod.finding(
+                    self.name, "side-effect", node,
+                    f"{name}(...) in traced `{qual}` only runs at trace "
+                    f"time — it will not fire per call")
+            elif name in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                if self._may_be_traced(arg):
+                    yield mod.finding(
+                        self.name, "concretize", node,
+                        f"{name}(...) in traced `{qual}` concretizes its "
+                        f"argument — a traced array here raises or syncs")
+
+    @staticmethod
+    def _may_be_traced(arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Constant):
+            return False
+        # len(...) and *.shape[...] are static under trace
+        if isinstance(arg, ast.Call) and dotted_name(arg.func) == "len":
+            return False
+        if isinstance(arg, ast.Subscript) and \
+                isinstance(arg.value, ast.Attribute) and \
+                arg.value.attr == "shape":
+            return False
+        if isinstance(arg, ast.Attribute) and arg.attr in ("shape", "ndim",
+                                                           "size"):
+            return False
+        return True

@@ -1,0 +1,179 @@
+"""Static-analysis core: parsed-module model + finding type + engine.
+
+The reproduction's analog of the reference's ``hack/verify-*`` static gates
+(go vet / staticcheck): an AST-based invariant checker over this project's
+real failure modes — jit trace safety, recompile hazards, lock discipline,
+exception hygiene, metrics registration.  Checks plug into a registry
+(analysis/registry.py) mirroring the scheduler's plugin registry; findings
+are ratcheted against a committed baseline (analysis/baseline.py) so
+pre-existing violations are grandfathered while new ones fail tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.
+
+    The baseline key deliberately excludes the line NUMBER: unrelated edits
+    above a grandfathered site must not churn the ratchet.  Identity is
+    (check, path, enclosing scope, rule, normalized source line); duplicate
+    keys are count-matched (see baseline.diff).
+    """
+
+    check: str  # registered check name, e.g. "trace-safety"
+    rule: str  # short rule id within the check, e.g. "host-sync"
+    path: str  # repo-relative posix path
+    line: int  # 1-based line (report only — not part of the key)
+    symbol: str  # dotted scope ("" = module level)
+    message: str
+    snippet: str  # stripped source line at ``line``
+
+    def key(self) -> str:
+        return "::".join(
+            (self.check, self.path, self.symbol, self.rule, self.snippet))
+
+    def location(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}{sym}"
+
+
+class ModuleInfo:
+    """One parsed source file: AST + source lines + scope/parent maps."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.scopes: Dict[ast.AST, str] = {self.tree: ""}
+        self._index(self.tree, "")
+        # every FunctionDef/AsyncFunctionDef/Lambda keyed by qualname; nested
+        # functions use dotted names ("TPUScheduler._build_jitted.fused_greedy")
+        self.functions: Dict[str, ast.AST] = {
+            q: n for n, q in self.scopes.items()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+    def _index(self, node: ast.AST, scope: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+            else:
+                sub = scope
+            self.scopes[child] = sub
+            self._index(child, sub)
+
+    def scope_of(self, node: ast.AST) -> str:
+        return self.scopes.get(node, "")
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def finding(self, check: str, rule: str, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(check=check, rule=rule, path=self.path, line=line,
+                       symbol=self.scope_of(node), message=message,
+                       snippet=self.line_text(line))
+
+
+@dataclass
+class Project:
+    """All modules under analysis (the unit every check receives)."""
+
+    modules: List[ModuleInfo] = field(default_factory=list)
+
+    def by_path(self) -> Dict[str, ModuleInfo]:
+        return {m.path: m for m in self.modules}
+
+    def find(self, suffix: str) -> Optional[ModuleInfo]:
+        for m in self.modules:
+            if m.path.endswith(suffix):
+                return m
+        return None
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# paths scanned by default (repo-relative); tests/ is deliberately out of
+# scope — fixtures there contain violations on purpose
+DEFAULT_SCAN_PATHS = ("kubernetes_tpu", "tools", "bench.py")
+
+
+def discover_files(root: str,
+                   paths: Iterable[str] = DEFAULT_SCAN_PATHS) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            out.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+    return sorted(out)
+
+
+def load_project(root: str,
+                 paths: Iterable[str] = DEFAULT_SCAN_PATHS) -> Project:
+    modules: List[ModuleInfo] = []
+    for f in discover_files(root, paths):
+        rel = os.path.relpath(f, root)
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            modules.append(ModuleInfo(rel, src))
+        except SyntaxError:
+            # non-importable scratch files must not kill the gate; the
+            # test suite imports everything that matters anyway
+            continue
+    return Project(modules=modules)
+
+
+def project_from_sources(sources: Dict[str, str]) -> Project:
+    """Build a Project from {virtual_path: source} — the test fixture path."""
+    return Project(modules=[ModuleInfo(p, s) for p, s in sources.items()])
+
+
+def run_checks(project: Project, checks) -> List[Finding]:
+    findings: List[Finding] = []
+    for check in checks:
+        findings.extend(check.run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.check, f.rule))
+    return findings

@@ -1,0 +1,202 @@
+"""Runtime lock instrumentation: acquisition-order tracking across threads.
+
+The static lock-discipline check sees lexical structure; this is its
+runtime partner — the project's analog of the Go race detector run over
+the scheduler's concurrent integration tests.  An active LockMonitor
+records, per thread, the stack of held instrumented locks and builds a
+global acquired-after graph; acquiring B while holding A records edge
+A→B, and a pre-existing path B→…→A is a lock-order inversion (two threads
+interleaving those orders can deadlock, as informer relist vs store
+fan-out nearly did — see client/informer.py's _relist_lock comments).
+
+Opt-in and zero-cost when inactive: lock owners construct through
+``maybe_wrap``, which returns the raw lock unless a monitor is active
+(one module-global read per construction).  tests/test_chaos.py activates
+a monitor for every test and asserts no inversions at teardown.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_active: Optional["LockMonitor"] = None
+_seq = itertools.count(1)
+
+
+class LockOrderViolation(RuntimeError):
+    pass
+
+
+class LockMonitor:
+    """Acquired-after graph + per-thread held stacks.
+
+    ``strict=True`` raises LockOrderViolation at the acquiring site;
+    default collects into ``violations`` so a mid-critical-section raise
+    cannot corrupt the structure under test — the chaos fixture asserts
+    at teardown instead.
+    """
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self.violations: List[str] = []
+        self._edges: Dict[str, Set[str]] = {}  # key -> keys acquired after
+        self._edge_site: Dict[Tuple[str, str], str] = {}
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+
+    # --- per-thread held stack ------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        s = getattr(self._tls, "stack", None)
+        if s is None:
+            s = self._tls.stack = []
+        return s
+
+    # --- graph ----------------------------------------------------------------
+
+    def _path_exists(self, src: str, dst: str) -> Optional[List[str]]:
+        seen = {src}
+        work: List[Tuple[str, List[str]]] = [(src, [src])]
+        while work:
+            cur, path = work.pop()
+            if cur == dst:
+                return path
+            for nxt in self._edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append((nxt, path + [nxt]))
+        return None
+
+    def note_acquire(self, key: str, where: str = "") -> None:
+        stack = self._stack()
+        if key in stack:  # RLock reentry: no new ordering information
+            stack.append(key)
+            return
+        held = list(dict.fromkeys(stack))
+        with self._mu:
+            for h in held:
+                inverse = self._path_exists(key, h)
+                if inverse is not None:
+                    prior = self._edge_site.get((inverse[0], inverse[1]), "?")
+                    msg = (f"lock-order inversion: acquiring {key} while "
+                           f"holding {h}, but order {' -> '.join(inverse)} "
+                           f"was established at {prior}; now at {where or 'n/a'}")
+                    self.violations.append(msg)
+                    if self.strict:
+                        raise LockOrderViolation(msg)
+                    # do NOT record the inverted edge: closing the cycle
+                    # would make every later acquisition in the ORIGINAL
+                    # (correct) order report a violation too, burying the
+                    # one real site in noise
+                    continue
+                self._edges.setdefault(h, set()).add(key)
+                self._edge_site.setdefault((h, key), where or "n/a")
+        stack.append(key)
+
+    def note_release(self, key: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == key:
+                del stack[i]
+                return
+
+    def report(self) -> str:
+        if not self.violations:
+            return "lockcheck: no lock-order inversions observed"
+        lines = [f"lockcheck: {len(self.violations)} lock-order violation(s):"]
+        lines.extend(f"  {v}" for v in self.violations)
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise LockOrderViolation(self.report())
+
+
+class CheckedLock:
+    """Proxy over a threading.Lock/RLock reporting to a LockMonitor.
+
+    Distinct instances sharing a display name stay distinct in the order
+    graph (keyed by a process-unique sequence number), so two ObjectStore
+    instances' `_lock`s are separate vertices — an inversion between them
+    is real, an inversion with *itself* is impossible."""
+
+    __slots__ = ("_inner", "name", "_key", "_monitor")
+
+    def __init__(self, inner, name: str, monitor: LockMonitor):
+        self._inner = inner
+        self.name = name
+        self._key = f"{name}#{next(_seq)}"
+        self._monitor = monitor
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # record intent BEFORE blocking: a true deadlock never returns, so
+        # post-acquire bookkeeping would miss exactly the case that matters
+        self._monitor.note_acquire(self._key, _caller())
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            self._monitor.note_release(self._key)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._monitor.note_release(self._key)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:  # RLock pre-3.12 has no locked()
+            return False
+
+
+def _caller() -> str:
+    import sys
+
+    try:
+        f = sys._getframe(1)
+    except (AttributeError, ValueError):
+        return "n/a"
+    try:
+        # walk past every lockcheck frame (acquire / __enter__ depth
+        # varies between `with lock:` and direct lock.acquire() calls)
+        while f is not None and "lockcheck" in f.f_code.co_filename:
+            f = f.f_back
+        if f is None:
+            return "n/a"
+        return f"{f.f_code.co_filename}:{f.f_lineno} in {f.f_code.co_name}"
+    finally:
+        del f
+
+
+def maybe_wrap(lock, name: str):
+    """Instrument ``lock`` iff a monitor is active (else return it as-is).
+
+    Lock OWNERS call this at construction; cost when inactive is a single
+    global read, so it belongs even on the ObjectStore hot path."""
+    if _active is None:
+        return lock
+    return CheckedLock(lock, name, _active)
+
+
+def activate(monitor: Optional[LockMonitor] = None) -> LockMonitor:
+    global _active
+    _active = monitor or LockMonitor()
+    return _active
+
+
+def deactivate() -> None:
+    global _active
+    _active = None
+
+
+def active_monitor() -> Optional[LockMonitor]:
+    return _active

@@ -1,0 +1,45 @@
+"""Check registry — the analyzer's analog of plugins/__init__.py.
+
+Each check is a class with a ``name``/``description`` and a
+``run(project) -> Iterable[Finding]``; ``@register_check`` enrolls it so
+tools/analyze.py and the tier-1 gate drive the same default set (mirroring
+how the scheduler's BatchedFramework drives the registered plugin list).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Type
+
+from .core import Finding, Project
+
+CHECK_REGISTRY: Dict[str, Type["Check"]] = {}
+
+
+class Check:
+    name: str = ""
+    description: str = ""
+
+    def run(self, project: Project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def register_check(cls: Type[Check]) -> Type[Check]:
+    assert cls.name, f"{cls.__name__} must define a name"
+    CHECK_REGISTRY[cls.name] = cls
+    return cls
+
+
+def default_checks(names: Iterable[str] = ()) -> List[Check]:
+    """Instantiate the requested checks (all registered ones by default).
+
+    Importing .checks here (not at module import) keeps the lockcheck /
+    maybe_wrap hot path free of analyzer imports.
+    """
+    from . import checks  # noqa: F401  (registers via decorators)
+
+    wanted = list(names) or sorted(CHECK_REGISTRY)
+    unknown = [n for n in wanted if n not in CHECK_REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown checks: {unknown}; "
+                       f"registered: {sorted(CHECK_REGISTRY)}")
+    return [CHECK_REGISTRY[n]() for n in wanted]

@@ -246,8 +246,8 @@ def compute_pod_resource_request(pod) -> Resource:
     r = _compute_pod_resource_request(pod)
     try:
         pod._cached_resource_request = (_resource_identity(pod), fp, r)
-    except Exception:
-        pass
+    except (AttributeError, TypeError):
+        pass  # __slots__/frozen pod stand-ins can't carry the cache
     return r
 
 
@@ -325,8 +325,8 @@ def compute_pod_resource_request_non_zero(pod) -> Resource:
         pod._cached_resource_request_nz = (
             _resource_identity(pod), _resource_fingerprint(pod), r
         )
-    except Exception:
-        pass
+    except (AttributeError, TypeError):
+        pass  # __slots__/frozen pod stand-ins can't carry the cache
     return r
 
 

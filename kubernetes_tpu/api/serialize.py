@@ -13,6 +13,7 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 from . import objects as v1
+from ..component_base import logging as klog
 
 # snake_case fields whose wire names are not plain camelCase
 _RENAMES = {
@@ -40,7 +41,12 @@ def _is_default(f: dataclasses.Field, value) -> bool:
     if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
         try:
             return value == f.default_factory()  # type: ignore[misc]
-        except Exception:
+        except Exception as e:
+            # treat an unevaluable default as "not default" (the field gets
+            # serialized — lossless), but say so: a raising default_factory
+            # is a schema bug worth seeing, not swallowing
+            klog.V(1).info_s("default_factory failed during serialization",
+                             field=f.name, err=f"{type(e).__name__}: {e}")
             return False
     return False
 

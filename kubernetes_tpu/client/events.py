@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 from ..api.objects import ObjectMeta
+from ..component_base import logging as klog
 from ..sim.store import ObjectStore
 
 
@@ -67,8 +68,12 @@ class EventRecorder:
         a binding-cycle crash.  The local aggregate keeps counting."""
         try:
             op("Event", ev)
-        except Exception:
-            pass
+        except Exception as e:
+            # still best-effort (never fail the caller), but a dropped
+            # event is visible at debug verbosity instead of vanishing
+            klog.V(2).info_s("event recorder dropped store write",
+                             reason=ev.reason, obj=ev.involved_object,
+                             err=f"{type(e).__name__}: {e}")
 
     def events_for(self, obj) -> List[Event]:
         ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"

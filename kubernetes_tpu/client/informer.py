@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis import lockcheck
 from ..chaos.retry import backoff_delay
 from ..metrics import scheduler_metrics as m
 from ..sim.store import ADDED, DELETED, ERROR, MODIFIED, ObjectStore, WatchEvent
@@ -57,7 +58,8 @@ class Reflector:
         self._jitter = random.Random(jitter_seed)
         # serializes relists: a drop callback and a stream-end callback from
         # two transports must not diff against the same cache concurrently
-        self._relist_lock = threading.Lock()
+        self._relist_lock = lockcheck.maybe_wrap(
+            threading.Lock(), f"Reflector[{kind}]._relist_lock")
 
     def add_handler(self, fn: Callable[[str, object, Optional[object]], None]):
         """fn(event_type, obj, old_obj)."""
@@ -71,10 +73,17 @@ class Reflector:
         return (ns, obj.metadata.name)
 
     def run(self):
-        """LIST (snapshot + rv), deliver synthetic ADDs, then WATCH from rv."""
+        """LIST (snapshot + rv), deliver synthetic ADDs, then WATCH from rv.
+
+        Holds ``_relist_lock`` around the diff+subscribe, same as the
+        error-driven relist path: a watch drop delivered while run()'s
+        synthetic ADDs are still flowing would otherwise diff the same
+        cache concurrently (found by the lock-discipline static check —
+        run() was the one unlocked caller of _apply_relist)."""
         self._stopped = False
         objs, rv = self.store.list(self.kind)
-        self._apply_relist(objs, rv)
+        with self._relist_lock:
+            self._apply_relist(objs, rv)
         self._synced = True
 
     def _apply_relist(self, objs, rv: int):
@@ -88,8 +97,7 @@ class Reflector:
         the reference's requeue-on-handler-error; handlers here dedup by
         uid).  The handler exception itself propagates, matching live watch
         delivery — it is a handler bug, not a stream failure, and must not
-        spin the relist retry loop (which may run under the in-process
-        store's write lock)."""
+        spin the relist retry loop."""
         new_items = {self._key(o): o for o in objs}
         for key, obj in new_items.items():
             old = self.items.get(key)
@@ -144,9 +152,11 @@ class Reflector:
         Any exception (drop, in-band ERROR, transport failure) means the
         continuity is broken: full relist + resubscribe, with jittered
         exponential backoff between failed attempts.  The FIRST attempt
-        runs immediately — the in-process store delivers drops
-        synchronously from inside a write (under its lock), where sleeping
-        would stall every other writer."""
+        runs immediately — the in-process store delivers drops on the
+        writer's thread (after releasing its lock — a drop callback that
+        ran UNDER the store lock inverted lock order against this relist
+        path, found by the runtime lockcheck), so a gratuitous first sleep
+        would still stall that writer."""
         if self._stopped:
             return
         self._unwatch = None

@@ -536,8 +536,8 @@ def _pod_to_dict(pod: v1.Pod) -> dict:
     d = _pod_to_dict_uncached(pod)
     try:
         pod._extender_dict = (key, d)
-    except Exception:
-        pass
+    except (AttributeError, TypeError):
+        pass  # __slots__/frozen pod stand-ins can't carry the cache
     return d
 
 

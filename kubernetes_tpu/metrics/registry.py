@@ -13,6 +13,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import lockcheck
+
 
 def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
     return [start * (factor ** i) for i in range(count)]
@@ -28,7 +30,8 @@ class Counter(Metric):
     def __init__(self, name, help_=""):
         super().__init__(name, help_)
         self._v: Dict[Tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.maybe_wrap(
+            threading.Lock(), f"Counter[{name}]._lock")
 
     def inc(self, labels: Tuple = (), by: float = 1.0):
         with self._lock:
@@ -68,7 +71,8 @@ class Histogram(Metric):
         self._samples: Dict[Tuple, List[float]] = {}
         self._samples_dropped: Dict[Tuple, int] = {}
         self.max_samples = 200_000
-        self._lock = threading.Lock()
+        self._lock = lockcheck.maybe_wrap(
+            threading.Lock(), f"Histogram[{name}]._lock")
 
     def observe(self, v: float, labels: Tuple = ()):
         with self._lock:

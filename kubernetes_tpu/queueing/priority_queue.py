@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..api import objects as v1
 from ..framework.events import ClusterEvent
+from ..metrics import scheduler_metrics as m
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0  # :54-64
 DEFAULT_POD_MAX_BACKOFF = 10.0
@@ -88,7 +89,10 @@ class PriorityQueue:
         def __lt__(self, other):
             return self.less(self.info, other.info)
 
-    def _push_active(self, info: QueuedPodInfo):
+    def _push_active(self, info: QueuedPodInfo, event: Optional[str] = None):
+        """``event`` labels queue_incoming_pods (metrics.go's per-event
+        inflow accounting); None = internal churn (pop_batch put-back),
+        not a queue entry."""
         uid = info.pod.uid
         if uid in self._in_active:
             return
@@ -96,6 +100,8 @@ class PriorityQueue:
             self._active, (self._Key(info, self._less), next(self._seq), info)
         )
         self._in_active.add(uid)
+        if event is not None:
+            m.queue_incoming_pods.inc(("active", event))
 
     # --- public API ----------------------------------------------------------
 
@@ -104,7 +110,7 @@ class PriorityQueue:
         info = QueuedPodInfo(
             pod=pod, timestamp=now, initial_attempt_timestamp=now
         )
-        self._push_active(info)
+        self._push_active(info, "PodAdd")
 
     def __len__(self) -> int:
         self.flush()
@@ -161,9 +167,11 @@ class PriorityQueue:
             return
         info.timestamp = self._clock()
         if pod_scheduling_cycle is not None and self._moves > pod_scheduling_cycle:
-            self._push_backoff(info)
+            self._push_backoff(info, "ScheduleAttemptFailure")
         else:
             self._unschedulable[uid] = info
+            m.queue_incoming_pods.inc(
+                ("unschedulable", "ScheduleAttemptFailure"))
 
     def requeue_after_error(self, info: QueuedPodInfo) -> None:
         """Transient-error requeue: straight to the backoff heap.
@@ -178,7 +186,7 @@ class PriorityQueue:
                 or uid in self._unschedulable:
             return
         info.timestamp = self._clock()
-        self._push_backoff(info)
+        self._push_backoff(info, "SchedulingError")
 
     def scheduling_cycle(self) -> int:
         return self._moves
@@ -187,7 +195,7 @@ class PriorityQueue:
         d = self._initial_backoff * (2 ** max(info.attempts - 1, 0))
         return info.timestamp + min(d, self._max_backoff)
 
-    def _push_backoff(self, info: QueuedPodInfo):
+    def _push_backoff(self, info: QueuedPodInfo, event: Optional[str] = None):
         uid = info.pod.uid
         if uid in self._in_backoff:
             return
@@ -195,6 +203,8 @@ class PriorityQueue:
             self._backoff, (self._backoff_time(info), next(self._seq), info)
         )
         self._in_backoff.add(uid)
+        if event is not None:
+            m.queue_incoming_pods.inc(("backoff", event))
 
     def activate(self, pods: Sequence[v1.Pod]) -> None:
         """Activate (:318): force named pods from backoff/unschedulable to active."""
@@ -202,7 +212,8 @@ class PriorityQueue:
         self._remove_from_backoff(uids, to_active=True)
         for uid in list(self._unschedulable):
             if uid in uids:
-                self._push_active(self._unschedulable.pop(uid))
+                self._push_active(self._unschedulable.pop(uid),
+                                  "ForceActivate")
 
     def _remove_from_backoff(self, uids: Set[str], to_active: bool):
         kept = []
@@ -210,7 +221,7 @@ class PriorityQueue:
             if info.pod.uid in uids and info.pod.uid in self._in_backoff:
                 self._in_backoff.discard(info.pod.uid)
                 if to_active:
-                    self._push_active(info)
+                    self._push_active(info, "ForceActivate")
             else:
                 kept.append((expiry, seq, info))
         heapq.heapify(kept)
@@ -239,14 +250,16 @@ class PriorityQueue:
                 deduped.append(ev)
         moved = []
         for uid, info in self._unschedulable.items():
-            if any(self._pod_matches_event(info, ev) for ev in deduped):
-                moved.append(uid)
-        for uid in moved:
+            ev = next((ev for ev in deduped
+                       if self._pod_matches_event(info, ev)), None)
+            if ev is not None:
+                moved.append((uid, ev.label or "ClusterEvent"))
+        for uid, label in moved:
             info = self._unschedulable.pop(uid)
             if self._clock() < self._backoff_time(info):
-                self._push_backoff(info)
+                self._push_backoff(info, label)
             else:
-                self._push_active(info)
+                self._push_active(info, label)
 
     def _pod_matches_event(self, info: QueuedPodInfo, event: ClusterEvent) -> bool:
         if event.is_wildcard():
@@ -264,9 +277,9 @@ class PriorityQueue:
         if info is not None:
             info.pod = new
             if self._clock() < self._backoff_time(info):
-                self._push_backoff(info)
+                self._push_backoff(info, "PodUpdate")
             else:
-                self._push_active(info)
+                self._push_active(info, "PodUpdate")
 
     def delete(self, pod: v1.Pod) -> None:
         self._in_active.discard(pod.uid)
@@ -292,11 +305,11 @@ class PriorityQueue:
             heapq.heappop(self._backoff)
             if info.pod.uid in self._in_backoff:
                 self._in_backoff.discard(info.pod.uid)
-                self._push_active(info)
+                self._push_active(info, "BackoffComplete")
         for uid, info in list(self._unschedulable.items()):
             if now - info.timestamp > self._unschedulable_limit:
                 del self._unschedulable[uid]
                 if now < self._backoff_time(info):
-                    self._push_backoff(info)
+                    self._push_backoff(info, "UnschedulableTimeout")
                 else:
-                    self._push_active(info)
+                    self._push_active(info, "UnschedulableTimeout")

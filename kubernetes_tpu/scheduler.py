@@ -998,6 +998,9 @@ class TPUScheduler:
         fast_bound_uids: List[str] = []  # nominations to release at phase end
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
+            # captured BEFORE any requeue: add_unschedulable/_push_backoff
+            # reset qi.timestamp, which would zero the e2e wait term below
+            queued_at = qi.timestamp
             row = int(node_row[i])
             if row >= 0:
                 # name resolved at completion time (see _complete) — the
@@ -1157,9 +1160,14 @@ class TPUScheduler:
             # (whole batch in the fused path, its own cycle in the extender
             # path), so its attempt spans that algorithm time plus its own
             # host reserve/permit/bind segment — not a batch average.
-            m.scheduling_attempt_duration.observe(
-                float(fl.algo_lat[i]) + (self.clock() - t_pod)
-            )
+            now = self.clock()
+            attempt = float(fl.algo_lat[i]) + (now - t_pod)
+            m.scheduling_attempt_duration.observe(attempt)
+            # e2e additionally covers the wait since this attempt entered
+            # the queue (metrics.go:78-84); the algorithm window overlaps
+            # the wait in the pipelined path, so take the max, not the sum
+            m.e2e_scheduling_duration.observe(
+                max(attempt, now - queued_at))
         # Fast-bound pods' nominations must OUTLIVE this bind phase: a later
         # batch was already dispatched before it ran (pipeline), so that
         # batch's bind-phase preemption tables come from a snapshot that

@@ -12,10 +12,13 @@ Reference behaviors mirrored:
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from ..analysis import lockcheck
 from ..api import objects as v1
+from ..component_base import logging as klog
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -45,7 +48,10 @@ class ObjectStore:
     """Thread-safe store; watchers receive events synchronously in rv order."""
 
     def __init__(self, fault_injector=None):
-        self._lock = threading.RLock()
+        # instrumented under an active lockcheck monitor (chaos tests run
+        # with lock-order inversion detection); raw RLock otherwise
+        self._lock = lockcheck.maybe_wrap(threading.RLock(),
+                                          "ObjectStore._lock")
         self._rv = 0
         self._objects: Dict[Tuple[str, str, str], object] = {}
         self._log: List[WatchEvent] = []  # full event history (bounded use: sim)
@@ -66,6 +72,9 @@ class ObjectStore:
         # whole object map for a default each time (profiled: 12s of a 100s
         # 25k-pod preemption suite)
         self._default_priority_class = None
+        # per-thread deferred-drop-callback state for _locked_emit
+        # (reentrant writes share the outermost frame's pending list)
+        self._emit_tls = threading.local()
 
     # --- helpers -------------------------------------------------------------
 
@@ -78,28 +87,113 @@ class ObjectStore:
         ns = "" if kind in cls.CLUSTER_SCOPED else getattr(meta, "namespace", "")
         return (kind, ns, meta.name)
 
-    def _emit(self, ev: WatchEvent):
+    def _emit(self, ev: WatchEvent,
+              deferred: List[Callable[[], None]]) -> None:
+        """Deliver ``ev`` to live watchers; drop callbacks are DEFERRED.
+
+        Ordinary events are delivered synchronously under the store lock
+        (the current_rv/watch-bookmark contract needs writes to be fully
+        fanned out before the lock releases).  Watch-DROP callbacks are
+        NOT: the dropped reflector's recovery acquires its own relist lock
+        and then calls back into store.list/watch (relist-lock → store-lock
+        order), so invoking it here — under the store lock — inverts that
+        order and can deadlock against an in-flight relist.  Found by the
+        runtime lockcheck monitor over tests/test_chaos.py.
+
+        Drop thunks go into the CALLER-owned ``deferred`` list, appended
+        BEFORE any live delivery and run by the CRUD callers in a finally
+        after the lock releases — so a watcher handler that raises
+        mid-fan-out (handler bugs propagate by design) cannot strand an
+        already-cut watcher without its WatchDropped notification.  The
+        stream is cut under the lock either way, so the dropped watcher
+        missed this event regardless — and its relist now lists a fully
+        committed write."""
         self._log.append(ev)
         drop = False
         if self.fault is not None and self._error_cbs:
             name = getattr(getattr(ev.obj, "metadata", None), "name", "")
             drop = self.fault.should_drop_watch(ev.kind, name,
                                                 rv=ev.resource_version)
-        for w in list(self._watchers):
-            cb = self._error_cbs.get(w)
-            if drop and cb is not None:
-                # cut the stream BEFORE delivering: the dropped watcher
-                # misses this event and must recover it by relisting (the
-                # reflector's ListAndWatch restart).  Resumable watchers
-                # only — a plain callback has no relist path.
+        live = list(self._watchers)
+        if drop:
+            # pass 1: cut every resumable stream and queue its callback
+            # (the reflector's ListAndWatch restart) before ANY delivery
+            for w in live:
+                cb = self._error_cbs.get(w)
+                if cb is None:
+                    continue  # plain callbacks have no relist path
                 self._watchers.remove(w)
                 del self._error_cbs[w]
                 from ..chaos.faults import WatchDropped
 
-                cb(WatchDropped(
-                    f"chaos: watch dropped at {ev.kind} rv={ev.resource_version}"))
-            else:
-                w(ev)
+                exc = WatchDropped(
+                    f"chaos: watch dropped at {ev.kind} rv={ev.resource_version}")
+                deferred.append(lambda cb=cb, exc=exc: cb(exc))
+            live = [w for w in live if w in self._watchers]
+        # pass 2: synchronous delivery to the surviving watchers
+        for w in live:
+            w(ev)
+
+    @contextmanager
+    def _locked_emit(self):
+        """Store lock + deferred drop-callback drain, as ONE structural
+        unit: every write path MUST use this (never a bare ``with
+        self._lock`` around ``_emit``) so the drop callbacks queued by
+        _emit always run after the lock releases — even when a watcher
+        handler raises mid-fan-out — and never under it (the lock-order
+        inversion the runtime lockcheck caught).
+
+        Two hardenings the simple try/finally form lacked:
+        - RLock reentrancy: a synchronous watcher callback may write back
+          into the store on the same thread; the inner frame's ``with
+          self._lock`` exit only decrements the RLock, so draining there
+          would run drop callbacks with the lock still held by the outer
+          frame.  Callbacks therefore accumulate in per-thread state and
+          drain only at the OUTERMOST frame, after the lock fully
+          releases.
+        - A drop callback that raises must not strand the remaining
+          dropped watchers un-notified, nor mask an in-flight write
+          exception: every callback runs; the first callback error
+          propagates only when the write itself succeeded."""
+        tls = self._emit_tls
+        depth = getattr(tls, "depth", 0)
+        if depth == 0:
+            tls.pending = []
+        tls.depth = depth + 1
+        try:
+            with self._lock:
+                yield tls.pending
+        except BaseException:
+            tls.depth = depth
+            if depth == 0:
+                # the write failed — deliver the notifications anyway, but
+                # the write's exception wins; callback errors are logged
+                for err in self._drain(tls.pending):
+                    klog.error_s(err, "watch-drop callback failed during "
+                                      "failing write")
+            raise
+        else:
+            tls.depth = depth
+            if depth == 0:
+                errors = self._drain(tls.pending)
+                if errors:
+                    raise errors[0]
+
+    def _drain(self, pending: List[Callable[[], None]]) -> List[BaseException]:
+        """Run every deferred callback (outside the lock); collect errors."""
+        cbs, pending[:] = list(pending), []
+        errors: List[BaseException] = []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception as e:
+                # collected for the caller (re-raised after a clean write,
+                # logged after a failing one) — the loop must finish so one
+                # bad callback can't strand the other dropped watchers
+                klog.V(2).info_s("deferred watch-drop callback raised",
+                                 err=f"{type(e).__name__}: {e}")
+                errors.append(e)
+        return errors
 
     # --- CRUD ----------------------------------------------------------------
 
@@ -109,7 +203,7 @@ class ObjectStore:
             # writers; raising HERE means the mutation never half-applied,
             # so a client retry is always safe
             self.fault.write_fault("create", kind, obj.metadata.name)
-        with self._lock:
+        with self._locked_emit() as deferred:
             if kind == "Pod":
                 self._admit_pod(obj)
                 if self._quota_namespaces:
@@ -125,7 +219,7 @@ class ObjectStore:
             elif kind == "PriorityClass" and getattr(obj, "global_default",
                                                      False):
                 self._default_priority_class = obj
-            self._emit(WatchEvent(ADDED, kind, obj, self._rv))
+            self._emit(WatchEvent(ADDED, kind, obj, self._rv), deferred)
             return self._rv
 
     def update(self, kind: str, obj, expected_rv=None) -> int:
@@ -137,7 +231,7 @@ class ObjectStore:
         check-then-act would race concurrent writers)."""
         if self.fault is not None:
             self.fault.write_fault("update", kind, obj.metadata.name)
-        with self._lock:
+        with self._locked_emit() as deferred:
             key = self._key(kind, obj)
             if key not in self._objects:
                 raise KeyError(key)
@@ -162,7 +256,7 @@ class ObjectStore:
                     self._default_priority_class = next(
                         (o for (k, _, _), o in self._objects.items()
                          if k == "PriorityClass" and o.global_default), None)
-            self._emit(WatchEvent(MODIFIED, kind, obj, self._rv))
+            self._emit(WatchEvent(MODIFIED, kind, obj, self._rv), deferred)
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
@@ -170,7 +264,7 @@ class ObjectStore:
             namespace = ""
         if self.fault is not None:
             self.fault.write_fault("delete", kind, name)
-        with self._lock:
+        with self._locked_emit() as deferred:
             obj = self._objects.pop((kind, namespace, name), None)
             if obj is None:
                 return None
@@ -187,7 +281,7 @@ class ObjectStore:
                     (o for (k, _, _), o in self._objects.items()
                      if k == "PriorityClass" and o.global_default), None)
             self._rv += 1
-            self._emit(WatchEvent(DELETED, kind, obj, self._rv))
+            self._emit(WatchEvent(DELETED, kind, obj, self._rv), deferred)
             return obj
 
     def current_rv(self) -> int:
@@ -317,12 +411,12 @@ class ObjectStore:
     def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
         if self.fault is not None:
             self.fault.write_fault("bind", "Pod", name)
-        with self._lock:
+        with self._locked_emit() as deferred:
             pod = self.get("Pod", namespace, name)
             if pod is None:
                 return False
             pod.spec.node_name = node_name
             self._rv += 1
             pod.metadata.resource_version = self._rv
-            self._emit(WatchEvent(MODIFIED, "Pod", pod, self._rv))
+            self._emit(WatchEvent(MODIFIED, "Pod", pod, self._rv), deferred)
             return True

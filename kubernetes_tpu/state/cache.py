@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from ..api import objects as v1
+from ..metrics import scheduler_metrics as m
 from .node_info import NodeInfo, next_generation
 
 DEFAULT_ASSUME_TTL_SECONDS = 15 * 60.0
@@ -228,4 +229,9 @@ class Cache:
                 n for n in snapshot.node_info_list if n.pods_with_required_anti_affinity
             ]
         snapshot.generation = max_gen
+        # cache.go updateMetrics: size gauges refresh on every snapshot
+        m.scheduler_cache_size.set(float(len(self._nodes)), ("nodes",))
+        m.scheduler_cache_size.set(
+            float(len(self._assumed_pods)), ("assumed_pods",))
+        m.scheduler_cache_size.set(float(len(self._pod_states)), ("pods",))
         return changed

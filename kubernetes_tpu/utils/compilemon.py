@@ -28,7 +28,10 @@ class CompileMonitor:
     def __init__(self):
         self.count = 0
         self.seconds = 0.0
-        self._lock = threading.Lock()
+        from ..analysis import lockcheck
+
+        self._lock = lockcheck.maybe_wrap(
+            threading.Lock(), "CompileMonitor._lock")
         self._registered = False
 
     def _listener(self, event: str, duration: float, **kw):

@@ -1,0 +1,687 @@
+"""Analyzer battery: per-check fixtures (positive + negative), the repo
+ratchet gate, and the runtime lockcheck monitor.
+
+The ratchet gate here IS the tier-1 enforcement of tools/analyze.py
+--check: a new violation anywhere in scanned code fails this file.
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from kubernetes_tpu.analysis import baseline as baseline_mod
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.analysis.core import (
+    DEFAULT_SCAN_PATHS,
+    ModuleInfo,
+    load_project,
+    project_from_sources,
+    run_checks,
+)
+from kubernetes_tpu.analysis.registry import CHECK_REGISTRY, default_checks
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze(sources, checks=()):
+    """Run checks over {path: source}; returns findings."""
+    project = project_from_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+    return run_checks(project, default_checks(checks))
+
+
+def rules(findings):
+    return sorted({(f.check, f.rule) for f in findings})
+
+
+# --- registry ----------------------------------------------------------------
+
+
+def test_all_five_checks_registered():
+    default_checks()  # imports the check modules
+    assert {"trace-safety", "recompile-hazard", "lock-discipline",
+            "exception-hygiene", "metrics-registration"} <= set(CHECK_REGISTRY)
+
+
+def test_unknown_check_rejected():
+    with pytest.raises(KeyError):
+        default_checks(["no-such-check"])
+
+
+# --- trace-safety ------------------------------------------------------------
+
+
+TRACE_POS = {
+    "pkg/mod.py": """
+    import time
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def traced(x):
+        t = time.time()
+        y = np.asarray(x)
+        z = x.sum().item()
+        print("debug", z)
+        return y * t + float(x)
+    """
+}
+
+
+def test_trace_safety_flags_host_syncs():
+    got = rules(analyze(TRACE_POS, ["trace-safety"]))
+    assert ("trace-safety", "host-sync") in got
+    assert ("trace-safety", "numpy-op") in got
+    assert ("trace-safety", "impure") in got
+    assert ("trace-safety", "side-effect") in got
+    assert ("trace-safety", "concretize") in got
+
+
+def test_trace_safety_wrap_form_and_transitive_calls():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+
+        def helper(x):
+            return x.sum().item()
+
+        def outer():
+            def inner(x):
+                return helper(x)
+            return jax.jit(inner)
+        """
+    }, ["trace-safety"])
+    assert any(f.rule == "host-sync" and "helper" in f.symbol
+               for f in findings)
+
+
+def test_trace_safety_clean_function_passes():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def traced(x):
+            k = int(x.shape[0])  # static shape read: fine
+            return jnp.sum(x) * k
+        """
+    }, ["trace-safety"])
+    assert findings == []
+
+
+def test_trace_safety_ignores_untraced_functions():
+    findings = analyze({
+        "pkg/mod.py": """
+        import time
+
+        def host_only(x):
+            return time.time() + x.item()
+        """
+    }, ["trace-safety"])
+    assert findings == []
+
+
+# --- recompile-hazard --------------------------------------------------------
+
+
+def test_recompile_jit_in_loop_and_immediate():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+
+        def f(x):
+            return x
+
+        def run(xs):
+            for x in xs:
+                g = jax.jit(f)
+                g(x)
+            return jax.jit(f)(xs)
+        """
+    }, ["recompile-hazard"])
+    got = rules(findings)
+    assert ("recompile-hazard", "jit-in-loop") in got
+    assert ("recompile-hazard", "jit-immediate") in got
+
+
+def test_recompile_lambda_inside_function():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+
+        def per_call(x):
+            g = jax.jit(lambda y: y + 1)
+            return g(x)
+        """
+    }, ["recompile-hazard"])
+    assert ("recompile-hazard", "jit-lambda") in rules(findings)
+
+
+def test_recompile_uncached_builder_vs_cached():
+    src = """
+    import jax
+
+    def build(fn):
+        return jax.jit(fn)
+
+    class Sched:
+        def __init__(self, fn):
+            self._progs = {}
+            self._progs["main"] = self.rebuild(fn)  # cached: OK
+
+        def rebuild(self, fn):
+            return jax.jit(fn)
+
+        def cycle(self, fn, x):
+            prog = self.rebuild(fn)  # NOT cached: flagged
+            return prog(x)
+
+    TABLE = build(len)  # module-level cache: OK
+    """
+    findings = analyze({"pkg/mod.py": src}, ["recompile-hazard"])
+    flagged_lines = [f.snippet for f in findings
+                     if f.rule == "uncached-builder"]
+    assert flagged_lines == ["prog = self.rebuild(fn)  # NOT cached: flagged"]
+
+
+def test_recompile_unhashable_static_arg():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+
+        def f(x, cfg):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+        out = g(1, [1, 2, 3])
+        """
+    }, ["recompile-hazard"])
+    assert ("recompile-hazard", "unhashable-static") in rules(findings)
+
+
+def test_recompile_init_cached_table_passes():
+    findings = analyze({
+        "pkg/mod.py": """
+        import jax
+
+        JITS = {name: jax.jit(fn) for name, fn in {"len": len}.items()}
+        """
+    }, ["recompile-hazard"])
+    assert findings == []
+
+
+# --- lock-discipline ---------------------------------------------------------
+
+
+LOCK_POS = {
+    "pkg/mod.py": """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def sneak(self, k, v):
+            self._items[k] = v  # mutated WITHOUT the lock: flagged
+    """
+}
+
+
+def test_lock_discipline_mixed_use_flagged():
+    findings = analyze(LOCK_POS, ["lock-discipline"])
+    assert [f.rule for f in findings] == ["mixed-lock-use"]
+    assert "sneak" in findings[0].message
+
+
+def test_lock_discipline_propagated_helper_ok():
+    findings = analyze({
+        "pkg/mod.py": """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._emit(k, v)
+
+            def delete(self, k):
+                with self._lock:
+                    self._emit(k, None)
+
+            def _emit(self, k, v):
+                self._items[k] = v  # only ever called under the lock
+        """
+    }, ["lock-discipline"])
+    assert findings == []
+
+
+def test_lock_discipline_mixed_helper_call_flagged():
+    findings = analyze({
+        "pkg/mod.py": """
+        import threading
+
+        class Refl:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = {}
+
+            def apply(self, k, v):
+                self.items[k] = v
+
+            def locked_path(self, k, v):
+                with self._lock:
+                    self.apply(k, v)
+
+            def unlocked_path(self, k, v):
+                self.apply(k, v)  # same helper, no lock: flagged
+        """
+    }, ["lock-discipline"])
+    assert [f.rule for f in findings] == ["mixed-helper-call"]
+    assert "unlocked_path" in findings[0].message
+
+
+def test_lock_discipline_contextmanager_wrapper_counts_as_locked():
+    """`with self._locked_emit():` (a generator method yielding inside
+    `with self._lock`) is lock-held context — the ObjectStore pattern."""
+    findings = analyze({
+        "pkg/mod.py": """
+        import threading
+        from contextlib import contextmanager
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}
+
+            @contextmanager
+            def _locked(self):
+                with self._lock:
+                    yield
+
+            def put(self, k, v):
+                with self._locked():
+                    self._items[k] = v
+
+            def put2(self, k, v):
+                with self._locked():
+                    self._items[k] = v
+        """
+    }, ["lock-discipline"])
+    assert findings == []
+
+
+def test_lock_discipline_init_exempt_and_lockless_class_ignored():
+    findings = analyze({
+        "pkg/mod.py": """
+        import threading
+
+        class WithLock:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.x = 0  # __init__ mutation: exempt
+
+            def bump(self):
+                with self._lock:
+                    self.x += 1
+
+        class NoLock:
+            def __init__(self):
+                self.y = 0
+
+            def bump(self):
+                self.y += 1
+        """
+    }, ["lock-discipline"])
+    assert findings == []
+
+
+# --- exception-hygiene -------------------------------------------------------
+
+
+def test_exception_hygiene_silent_flagged_loud_ok():
+    findings = analyze({
+        "pkg/mod.py": """
+        from kubernetes_tpu.component_base import logging as klog
+
+        def silent():
+            try:
+                risky()
+            except Exception:
+                return None  # flagged
+
+        def reraises():
+            try:
+                risky()
+            except Exception:
+                raise
+
+        def logs():
+            try:
+                risky()
+            except Exception as e:
+                klog.error_s(e, "boom")
+
+        def narrow():
+            try:
+                risky()
+            except (KeyError, ValueError):
+                return None  # narrowed: not flagged
+        """
+    }, ["exception-hygiene"])
+    assert len(findings) == 1
+    assert findings[0].symbol == "silent"
+
+
+def test_exception_hygiene_bare_except_flagged():
+    findings = analyze({
+        "pkg/mod.py": """
+        def f():
+            try:
+                risky()
+            except:
+                pass
+        """
+    }, ["exception-hygiene"])
+    assert [f.rule for f in findings] == ["silent-swallow"]
+
+
+# --- metrics-registration ----------------------------------------------------
+
+
+METRICS_SRC = """
+from .registry import Counter, Gauge, default_registry
+
+pods_scheduled = default_registry.register(
+    Counter("pods_scheduled_total"))
+queue_depth = default_registry.register(
+    Gauge("queue_depth"))
+"""
+
+
+def test_metrics_unknown_attr_and_name():
+    findings = analyze({
+        "kubernetes_tpu/metrics/scheduler_metrics.py": METRICS_SRC,
+        "kubernetes_tpu/worker.py": """
+        from .metrics import scheduler_metrics as m
+
+        def done(registry):
+            m.pods_scheduled.inc()          # fine
+            m.queue_depth.set(3.0)          # fine
+            m.pod_scheduled.inc()           # typo: flagged
+            registry.get("no_such_metric")  # flagged
+            registry.get("queue_depth")     # fine
+        """,
+    }, ["metrics-registration"])
+    got = rules(findings)
+    assert ("metrics-registration", "unknown-attr") in got
+    assert ("metrics-registration", "unknown-name") in got
+    assert not any(f.rule == "registered-unused" for f in findings)
+
+
+def test_metrics_duplicate_and_unused():
+    findings = analyze({
+        "kubernetes_tpu/metrics/scheduler_metrics.py": METRICS_SRC,
+        "kubernetes_tpu/other.py": """
+        from .metrics.registry import Counter
+
+        shadow = Counter("pods_scheduled_total")  # duplicate: flagged
+        """,
+    }, ["metrics-registration"])
+    got = rules(findings)
+    assert ("metrics-registration", "duplicate-name") in got
+    # neither metric is emitted by attr/name anywhere scanned
+    unused = {f.message.split("`")[1] for f in findings
+              if f.rule == "registered-unused"}
+    assert "queue_depth" in unused
+
+
+# --- the repo ratchet gate (tier-1 enforcement) ------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    project = load_project(REPO_ROOT, DEFAULT_SCAN_PATHS)
+    return run_checks(project, default_checks())
+
+
+def test_repo_gate_no_new_violations(repo_findings):
+    base = baseline_mod.load(
+        os.path.join(REPO_ROOT, baseline_mod.BASELINE_FILENAME))
+    assert base, "analysis_baseline.json missing or empty"
+    new, stale = baseline_mod.diff(repo_findings, base)
+    assert not new, (
+        "NEW static-analysis violation(s) — fix them or consciously "
+        "re-baseline via tools/analyze.py --write-baseline:\n"
+        + "\n".join(f"  {f.location()} [{f.check}/{f.rule}] {f.message}"
+                    for f in new))
+    assert not stale, (
+        "STALE baseline entr(ies) — violations were fixed; shrink the "
+        "baseline (tools/analyze.py --write-baseline) so they stay "
+        "fixed:\n" + "\n".join(f"  {k}" for k in stale))
+
+
+def test_repo_gate_catches_fresh_violation(repo_findings):
+    """Introducing a violation in a scratch module must fail the diff."""
+    scratch = ModuleInfo("kubernetes_tpu/scratch_violation.py", textwrap.dedent("""
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+    """))
+    project = load_project(REPO_ROOT, DEFAULT_SCAN_PATHS)
+    project.modules.append(scratch)
+    findings = run_checks(project, default_checks(["exception-hygiene"]))
+    base = baseline_mod.load(
+        os.path.join(REPO_ROOT, baseline_mod.BASELINE_FILENAME))
+    new, _ = baseline_mod.diff(findings, base)
+    assert any(f.path == "kubernetes_tpu/scratch_violation.py" for f in new)
+
+
+def test_baseline_counts_are_count_matched():
+    """A key with N baselined sites fails on the N+1th, not before."""
+    src_one = {
+        "pkg/mod.py": """
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+        """
+    }
+    findings = analyze(src_one, ["exception-hygiene"])
+    base = baseline_mod.baseline_counts(findings)
+    # same snippet appearing TWICE in the same scope exceeds the count
+    doubled = analyze({
+        "pkg/mod.py": """
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+            try:
+                pass
+            except Exception:
+                pass
+        """
+    }, ["exception-hygiene"])
+    new, stale = baseline_mod.diff(doubled, base)
+    assert len(new) == 1 and not stale
+    # and the original set stays clean against its own baseline
+    new2, stale2 = baseline_mod.diff(findings, base)
+    assert not new2 and not stale2
+
+
+def test_each_check_has_documented_finding_or_fixture(repo_findings):
+    """Every check proved itself on this codebase: live baselined findings
+    for trace-safety / lock-discipline / exception-hygiene /
+    metrics-registration (see COMPONENTS.md for the triage); the
+    recompile-hazard finding (tools/bench_outputs.py per-variant jit
+    rebuild) was fixed in place, so its live count may be zero."""
+    live = {f.check for f in repo_findings}
+    assert {"trace-safety", "lock-discipline", "exception-hygiene",
+            "metrics-registration"} <= live
+
+
+# --- runtime lockcheck -------------------------------------------------------
+
+
+def test_lockcheck_detects_inversion():
+    mon = lockcheck.LockMonitor()
+    a = lockcheck.CheckedLock(threading.Lock(), "A", mon)
+    b = lockcheck.CheckedLock(threading.Lock(), "B", mon)
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+    assert mon.violations, "A->B then B->A must be reported"
+    assert "inversion" in mon.report()
+    # the inverted edge is NOT recorded: re-acquiring in the ORIGINAL
+    # correct order afterwards must not pile on spurious violations
+    n = len(mon.violations)
+    t3 = threading.Thread(target=order_ab)
+    t3.start()
+    t3.join()
+    assert len(mon.violations) == n
+    with pytest.raises(lockcheck.LockOrderViolation):
+        mon.assert_clean()
+
+
+def test_lockcheck_transitive_inversion():
+    mon = lockcheck.LockMonitor()
+    a = lockcheck.CheckedLock(threading.Lock(), "A", mon)
+    b = lockcheck.CheckedLock(threading.Lock(), "B", mon)
+    c = lockcheck.CheckedLock(threading.Lock(), "C", mon)
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:  # A->B->C established; C->A closes the cycle
+            pass
+    assert mon.violations
+
+
+def test_lockcheck_consistent_order_and_reentrancy_clean():
+    mon = lockcheck.LockMonitor()
+    a = lockcheck.CheckedLock(threading.Lock(), "A", mon)
+    r = lockcheck.CheckedLock(threading.RLock(), "R", mon)
+    for _ in range(3):
+        with a:
+            with r:
+                with r:  # RLock reentry: no ordering edge
+                    pass
+    mon.assert_clean()
+
+
+def test_lockcheck_strict_raises_at_site():
+    mon = lockcheck.LockMonitor(strict=True)
+    a = lockcheck.CheckedLock(threading.Lock(), "A", mon)
+    b = lockcheck.CheckedLock(threading.Lock(), "B", mon)
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockcheck.LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_maybe_wrap_inactive_is_passthrough():
+    lockcheck.deactivate()
+    raw = threading.Lock()
+    assert lockcheck.maybe_wrap(raw, "X") is raw
+    mon = lockcheck.activate()
+    try:
+        wrapped = lockcheck.maybe_wrap(raw, "X")
+        assert isinstance(wrapped, lockcheck.CheckedLock)
+        with wrapped:
+            pass
+        mon.assert_clean()
+    finally:
+        lockcheck.deactivate()
+
+
+def test_lockcheck_nonblocking_acquire_failure_unwinds():
+    mon = lockcheck.LockMonitor()
+    a = lockcheck.CheckedLock(threading.Lock(), "A", mon)
+    assert a.acquire()
+    got = []
+
+    def try_lock():
+        got.append(a.acquire(blocking=False))
+
+    t = threading.Thread(target=try_lock)
+    t.start()
+    t.join()
+    assert got == [False]
+    a.release()
+    # the failed acquire left no phantom hold: ordering stays clean
+    b = lockcheck.CheckedLock(threading.Lock(), "B", mon)
+    with b:
+        with a:
+            pass
+    mon.assert_clean()
+
+
+def test_store_bind_pod_bumps_resource_version():
+    """The deferred-drop-callback restructure of ObjectStore must preserve
+    the bind subresource's rv bump: the bound pod carries the NEW
+    resourceVersion (CAS and relist-diff correctness both read it)."""
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.testutil import make_pod
+
+    store = ObjectStore()
+    pod = make_pod().name("bp").namespace("default").obj()
+    store.create("Pod", pod)
+    rv_before = pod.metadata.resource_version
+    assert store.bind_pod("default", "bp", "node-x")
+    assert pod.metadata.resource_version == store.current_rv()
+    assert pod.metadata.resource_version > rv_before
+
+
+def test_instrumented_object_store_runs_clean():
+    """A store + reflector exercising create/update/watch under an active
+    monitor: real lock traffic, no inversions."""
+    from kubernetes_tpu.client.informer import Reflector
+    from kubernetes_tpu.perf.workloads import node_default
+    from kubernetes_tpu.sim.store import ObjectStore
+
+    mon = lockcheck.activate()
+    try:
+        store = ObjectStore()
+        refl = Reflector(store, "Node")
+        refl.run()
+        for i in range(4):
+            store.create("Node", node_default(i))
+        assert len(refl.items) == 4
+        refl.stop()
+        mon.assert_clean()
+    finally:
+        lockcheck.deactivate()

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Static invariant analyzer CLI — kubernetes_tpu/analysis front end.
+
+  python tools/analyze.py                 human report of all findings
+  python tools/analyze.py --json          JSON report (machine consumers)
+  python tools/analyze.py --check         gate mode: exit 1 on findings NOT
+                                          grandfathered in
+                                          analysis_baseline.json, or on
+                                          stale baseline entries (the
+                                          ratchet only shrinks)
+  python tools/analyze.py --write-baseline  rewrite the baseline from the
+                                          current findings (do this after
+                                          FIXING sites, never to absorb
+                                          new violations)
+  --checks a,b  run a subset; --paths P ...  scan other roots (fixtures)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from kubernetes_tpu.analysis.core import (  # noqa: E402
+    DEFAULT_SCAN_PATHS,
+    load_project,
+    run_checks,
+)
+from kubernetes_tpu.analysis.registry import default_checks  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def human_report(findings, checks) -> str:
+    lines = []
+    by_check = Counter(f.check for f in findings)
+    for check in checks:
+        n = by_check.get(check.name, 0)
+        lines.append(f"== {check.name}: {n} finding(s) — {check.description}")
+        for f in findings:
+            if f.check == check.name:
+                lines.append(f"  {f.location()} [{f.rule}]")
+                lines.append(f"      {f.message}")
+                if f.snippet:
+                    lines.append(f"      > {f.snippet}")
+    lines.append(f"total: {len(findings)} finding(s) across "
+                 f"{len(checks)} check(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", help="JSON report")
+    ap.add_argument("--check", action="store_true",
+                    help="gate against the committed baseline")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO_ROOT,
+                                         baseline_mod.BASELINE_FILENAME))
+    ap.add_argument("--checks", default="",
+                    help="comma-separated subset of registered checks")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="roots to scan (default: %s)"
+                         % (DEFAULT_SCAN_PATHS,))
+    args = ap.parse_args(argv)
+
+    checks = default_checks(
+        [c for c in args.checks.split(",") if c] if args.checks else ())
+    project = load_project(REPO_ROOT, args.paths or DEFAULT_SCAN_PATHS)
+    findings = run_checks(project, checks)
+
+    if args.write_baseline:
+        if args.checks or args.paths:
+            print("refusing --write-baseline with --checks/--paths: a "
+                  "subset run would clobber every other check's "
+                  "grandfathered entries; rerun without subset flags.",
+                  file=sys.stderr)
+            return 2
+        baseline_mod.write(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) "
+              f"({len(baseline_mod.baseline_counts(findings))} keys) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "by_check": dict(Counter(f.check for f in findings)),
+        }, indent=1))
+    else:
+        print(human_report(findings, checks))
+
+    if not args.check:
+        return 0
+
+    base = baseline_mod.load(args.baseline)
+    # a subset run must not misread the rest of the baseline as stale:
+    # restrict the comparison to the checks actually run, and skip stale
+    # enforcement entirely on a partial --paths scan (live counts for
+    # unscanned files are legitimately zero)
+    run_names = {c.name for c in checks}
+    base = {k: v for k, v in base.items()
+            if k.split("::", 1)[0] in run_names}
+    new, stale = baseline_mod.diff(findings, base)
+    if args.paths:
+        stale = []
+    if new:
+        print(f"\nFAIL: {len(new)} NEW violation(s) beyond the baseline:",
+              file=sys.stderr)
+        for f in new:
+            print(f"  {f.location()} [{f.check}/{f.rule}] {f.message}",
+                  file=sys.stderr)
+        print("fix them (preferred), or consciously re-baseline with "
+              "--write-baseline and justify it in the PR.", file=sys.stderr)
+        return 1
+    if stale:
+        print(f"\nFAIL: {len(stale)} STALE baseline entr(ies) — the "
+              f"violations were fixed; shrink the baseline so they stay "
+              f"fixed (tools/analyze.py --write-baseline):", file=sys.stderr)
+        for k in stale:
+            print(f"  {k}", file=sys.stderr)
+        return 1
+    print(f"\nOK: all {len(findings)} finding(s) grandfathered; "
+          f"baseline is tight.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,11 @@ set -u
 OUT=suites_5k.out
 FAILED=0
 : > "$OUT"
+# static invariant gate first: new analyzer violations abort the whole pass
+# before any expensive suite runs (same ratchet tier-1 enforces via
+# tests/test_static_analysis.py) — a failure here is conclusive in seconds,
+# so don't burn hours of 5k-node suites on a known-bad tree
+python tools/analyze.py --check > /dev/null || { echo "FAILED: static analysis gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
