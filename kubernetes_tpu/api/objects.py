@@ -662,6 +662,56 @@ class PodDisruptionBudget:
         )
 
 
+# PodGroup phases (the coscheduling CRD's PodGroupStatus.Phase subset the
+# gang subsystem drives; see kubernetes_tpu/gang/).
+POD_GROUP_PENDING = "Pending"
+POD_GROUP_SCHEDULING = "Scheduling"
+POD_GROUP_SCHEDULED = "Scheduled"
+POD_GROUP_UNSCHEDULABLE = "Unschedulable"
+
+
+@dataclass
+class PodGroup:
+    """scheduling.x-k8s.io/v1alpha1 PodGroup — the gang-scheduling unit.
+
+    Reference: sigs.k8s.io/scheduler-plugins apis/scheduling/v1alpha1
+    (PodGroupSpec.MinMember / ScheduleTimeoutSeconds, PodGroupStatus.Phase).
+    Pods join a group via the ``pod-group.scheduling/name`` label
+    (gang.POD_GROUP_LABEL); the group schedules all-or-nothing once at
+    least ``min_member`` members exist.
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 1
+    schedule_timeout_seconds: Optional[int] = None  # None → subsystem default
+    phase: str = POD_GROUP_PENDING  # status.phase
+
+    kind = "PodGroup"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodGroup":
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        sts = spec.get("scheduleTimeoutSeconds")
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            min_member=int(spec.get("minMember", 1)),
+            schedule_timeout_seconds=(None if sts is None else int(sts)),
+            phase=status.get("phase", POD_GROUP_PENDING),
+        )
+
+
 @dataclass
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)

@@ -188,6 +188,11 @@ def _spec_status(obj) -> Dict[str, Any]:
             ns, _, name = obj.claim_ref.partition("/")
             spec["claimRef"] = {"namespace": ns, "name": name}
         return {"spec": spec}
+    if isinstance(obj, v1.PodGroup):
+        spec: Dict[str, Any] = {"minMember": obj.min_member}
+        if obj.schedule_timeout_seconds is not None:
+            spec["scheduleTimeoutSeconds"] = obj.schedule_timeout_seconds
+        return {"spec": spec, "status": {"phase": obj.phase}}
     if isinstance(obj, v1.PriorityClass):
         return {"value": obj.value, "globalDefault": obj.global_default,
                 "preemptionPolicy": obj.preemption_policy}

@@ -24,6 +24,7 @@ KIND_ALIASES = {
     "pv": "PersistentVolume", "pvc": "PersistentVolumeClaim",
     "sc": "StorageClass", "pdb": "PodDisruptionBudget",
     "pc": "PriorityClass", "priorityclass": "PriorityClass",
+    "pg": "PodGroup", "podgroup": "PodGroup", "podgroups": "PodGroup",
     "ev": "Event", "events": "Event",
 }
 
@@ -66,6 +67,7 @@ class Kubectl:
             "ReplicaSet": ["NAME", "DESIRED", "CURRENT", "READY"],
             "Deployment": ["NAME", "REPLICAS"],
             "Job": ["NAME", "COMPLETIONS", "SUCCEEDED", "DONE"],
+            "PodGroup": ["NAME", "MIN-MEMBER", "PHASE", "TIMEOUT"],
         }.get(kind, ["NAME"])
 
     def _row(self, kind: str, o) -> List[str]:
@@ -89,6 +91,10 @@ class Kubectl:
         if kind == "Job":
             return [o.metadata.name, str(o.completions), str(o.status_succeeded),
                     str(o.completed)]
+        if kind == "PodGroup":
+            timeout = o.schedule_timeout_seconds
+            return [o.metadata.name, str(o.min_member), o.phase,
+                    f"{timeout}s" if timeout is not None else "<default>"]
         return [o.metadata.name]
 
     def describe(self, kind: str, namespace: str, name: str) -> str:

@@ -37,6 +37,7 @@ class EventResource(str, enum.Enum):
     STORAGE_CLASS = "StorageClass"
     CSI_NODE = "CSINode"
     SERVICE = "Service"
+    POD_GROUP = "PodGroup"
     WILDCARD = "*"
 
 
@@ -76,6 +77,9 @@ NODE_TAINT_CHANGE = ClusterEvent(
 )
 NODE_CONDITION_CHANGE = ClusterEvent(
     EventResource.NODE, ActionType.UPDATE_NODE_CONDITION, "NodeConditionChange"
+)
+POD_GROUP_CHANGE = ClusterEvent(
+    EventResource.POD_GROUP, ActionType.ADD | ActionType.UPDATE, "PodGroupChange"
 )
 PVC_ADD = ClusterEvent(EventResource.PVC, ActionType.ADD, "PvcAdd")
 PV_ADD = ClusterEvent(EventResource.PV, ActionType.ADD, "PvAdd")

@@ -3,6 +3,12 @@
 Reference: pkg/scheduler/framework/runtime/waiting_pods_map.go — a Permit plugin
 may return Wait with a timeout; the binding cycle blocks in WaitOnPermit until
 every waiting plugin allows (or any rejects / the timeout fires).
+
+Clock contract: every deadline is computed AND checked against the single
+injected ``clock`` (the scheduler's own) — no raw ``time.monotonic()`` or
+``time.sleep`` anywhere in the deadline math, so gang-timeout behavior is
+exactly reproducible under a fake clock (the wait is re-polled by the
+scheduler's cycle loop, never slept on).
 """
 
 from __future__ import annotations
@@ -48,6 +54,16 @@ class WaitingPodsMap:
 
     def remove(self, uid: str) -> None:
         self._pods.pop(uid, None)
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest pending-plugin deadline across all waiting pods (on the
+        injected clock's scale), or None — lets a driving loop know when a
+        gang hold can next expire without polling blind."""
+        deadlines = [
+            dl for wp in self._pods.values()
+            for dl in wp.pending_plugins.values()
+        ]
+        return min(deadlines) if deadlines else None
 
     def wait_on_permit(self, pod: v1.Pod) -> Optional[str]:
         """→ None (allowed) or a rejection reason. Expired waits reject

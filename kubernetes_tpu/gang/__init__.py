@@ -1,0 +1,37 @@
+"""Gang scheduling subsystem: PodGroup-driven all-or-nothing placement.
+
+Reference: sigs.k8s.io/scheduler-plugins pkg/coscheduling (PodGroup CRD +
+the Coscheduling plugin's QueueSort/PreFilter/Permit/PostBind/Unreserve
+chain).  Layers here:
+
+  - L0 object model: ``api.objects.PodGroup`` (minMember,
+    scheduleTimeoutSeconds, status.phase), registered in the scheme under
+    scheduling.x-k8s.io/v1alpha1; pods join via the POD_GROUP_LABEL label.
+  - ``GangDirectory`` (directory.py): the shared host-side runtime — group
+    membership from store watch events, quorum PreFilter, Permit
+    all-or-nothing release/timeout, phase writes, metrics.
+  - ``CoschedulingPlugin`` (coscheduling.py): the framework plugin shell
+    (QueueSort less, host Permit/Reserve/Unreserve/PostBind hooks, a
+    device score plane preferring the gang's anchor slice).
+  - ``gang_all_or_nothing`` (device.py): the in-batch solver mask — a
+    segment-sum pass over gang ids that zeroes every member of a gang with
+    any unplaced member, so partial placements never reach binding.
+"""
+
+from .device import gang_all_or_nothing
+from .directory import (
+    DEFAULT_GANG_TIMEOUT_SECONDS,
+    POD_GROUP_LABEL,
+    SLICE_LABEL,
+    GangDirectory,
+)
+from .coscheduling import CoschedulingPlugin
+
+__all__ = [
+    "CoschedulingPlugin",
+    "DEFAULT_GANG_TIMEOUT_SECONDS",
+    "GangDirectory",
+    "POD_GROUP_LABEL",
+    "SLICE_LABEL",
+    "gang_all_or_nothing",
+]

@@ -1,0 +1,101 @@
+"""Coscheduling plugin: the framework-facing shell over GangDirectory.
+
+Reference: sigs.k8s.io/scheduler-plugins pkg/coscheduling/coscheduling.go —
+QueueSort (group cohesion), PreFilter (quorum), Permit (all-or-nothing
+Wait/Allow), PostBind (phase), Unreserve (group reject).  Host hooks
+delegate to the scheduler-owned GangDirectory (attached via
+``attach_gang_directory``); the device side contributes one score plane
+preferring nodes in the gang's anchor slice (see GangDirectory.host_aux).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import events as fwk_events
+from ..framework.events import ActionType, ClusterEvent, EventResource
+from ..framework.interface import Code, Plugin, Status
+from .directory import GangDirectory
+
+
+class CoschedulingPlugin(Plugin):
+    name = "Coscheduling"
+    # Permit Wait from this plugin HOLDS the binding cycle across scheduling
+    # cycles (assume + reserve kept, bind deferred) instead of failing it —
+    # see TPUScheduler._run_reserve_and_bind / _flush_waiting_binds.
+    holds_on_wait = True
+
+    def __init__(self):
+        self._dir: GangDirectory = None
+
+    def attach_gang_directory(self, directory: GangDirectory) -> None:
+        self._dir = directory
+
+    def events_to_register(self):
+        # a quorum-rejected member becomes schedulable when a sibling pod
+        # appears or the PodGroup changes; capacity frees on pod delete /
+        # node add
+        return [
+            fwk_events.POD_GROUP_CHANGE,
+            ClusterEvent(EventResource.POD, ActionType.ADD | ActionType.DELETE),
+            fwk_events.NODE_ADD,
+        ]
+
+    # --- host extension points -----------------------------------------------
+
+    def less(self, a, b) -> bool:
+        if self._dir is None:
+            from ..queueing.priority_queue import default_less
+
+            return default_less(a, b)
+        return self._dir.less(a, b)
+
+    def pre_filter(self, state, pod):
+        if self._dir is None:
+            return None
+        return self._dir.prefilter(pod)
+
+    def reserve(self, state, pod, node_name) -> Status:
+        # membership in the reserve chain is what routes rollbacks through
+        # unreserve (the group-failure hook); admission itself is Permit's
+        return Status.success()
+
+    def unreserve(self, state, pod, node_name) -> None:
+        if self._dir is not None:
+            self._dir.on_unreserve(pod)
+
+    def permit(self, state, pod, node_name):
+        if self._dir is None:
+            return Status.success(), 0.0
+        decision, timeout = self._dir.on_permit(pod)
+        if decision == "wait":
+            return Status(code=Code.WAIT), timeout
+        return Status.success(), 0.0
+
+    def post_bind(self, state, pod, node_name) -> None:
+        if self._dir is not None:
+            self._dir.on_bound(pod, node_name)
+
+    # --- device score: prefer the gang's anchor slice -------------------------
+
+    def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
+        b = int(batch.valid.shape[0])
+        if self._dir is None:
+            n = int(np.shape(encoder.node_valid)[0])
+            return (np.full(n, -1, dtype=np.int32),
+                    np.full(b, -2, dtype=np.int32))
+        return self._dir.host_aux(b, encoder)
+
+    def prepare(self, batch, snap, dyn, host_aux):
+        return host_aux
+
+    def score(self, batch, snap, dyn, aux, mask=None):
+        slice_dom, anchor = aux
+        match = (anchor[:, None] == slice_dom[None, :]) & (anchor[:, None] >= 0)
+        return match.astype(jnp.float32)
+
+    def normalize(self, scores, mask):
+        from ..plugins.helpers import default_normalize
+
+        return default_normalize(scores, mask)

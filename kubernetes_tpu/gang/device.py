@@ -1,0 +1,37 @@
+"""Device-side gang pass: in-batch all-or-nothing over segment sums.
+
+Runs INSIDE the fused cycle program (scheduler._build_jitted), after the
+assignment engine produced ``node_row`` — a separate device program would
+pay its own ~100ms tunnel pacing round per cycle.  Pure jnp; the segment
+reductions ride the one-hot einsum kernels in ops/segment.py (minor-axis
+gathers/scatters lower to serial loops on TPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.segment import domain_gather, domain_scatter_add
+
+
+def gang_all_or_nothing(node_row, gang_seg):
+    """Mask every member of a gang with ANY unplaced member to -1.
+
+    node_row: i32[B] assigned node row per pod (-1 = unschedulable).
+    gang_seg: i32[B] per-pod gang segment id in [0, B), -1 for pods that
+        are not gang members (including padding rows).
+
+    Either every member of a gang present in this batch got a feasible row
+    or the whole gang is withdrawn — a partially placed gang must never
+    reach the binding cycle (members split across batches are instead held
+    at Permit by the Coscheduling plugin).  An all(-1) gang_seg batch is a
+    no-op, so gang-free and gang-bearing cycles share one compiled program.
+    """
+    b = node_row.shape[0]
+    member = gang_seg >= 0
+    # solos/padding land in an overflow bucket that never feeds back
+    seg = jnp.where(member, gang_seg, b)
+    missed = (member & (node_row < 0)).astype(jnp.float32)
+    miss_per_gang = domain_scatter_add(missed, seg, b + 1)  # f32[B+1]
+    incomplete = domain_gather(miss_per_gang, seg) > 0.5  # bool[B]
+    return jnp.where(member & incomplete, -1, node_row)

@@ -63,6 +63,30 @@ scheduler_cache_size = default_registry.register(
 # The chaos harness (kubernetes_tpu/chaos/) asserts these series so every
 # retry, relist, and circuit transition is visible, not silent.
 
+# --- gang scheduling (kubernetes_tpu/gang/) ----------------------------------
+# Emitted by GangDirectory at the real decision points: a gang release
+# (last member passes Permit), a quorum rejection at PreFilter, and the
+# Permit-timeout group failure.
+
+gang_scheduling_attempts = default_registry.register(
+    # labels: (result,) — "scheduled" (gang released all-or-nothing) |
+    # "timeout" (Permit deadline fired, whole gang requeued) |
+    # "rejected" (non-timeout group failure: a member's binding cycle
+    # rolled back or a member was deleted below quorum mid-wait) |
+    # "quorum_reject" (fewer than minMember members known at PreFilter)
+    Counter("gang_scheduling_attempts_total",
+            "Per-gang scheduling attempt outcomes")
+)
+gang_wait_duration = default_registry.register(
+    # first member entering the Permit wait → gang released or rejected
+    Histogram("gang_wait_duration_seconds", exponential_buckets(0.001, 2, 18),
+              "Time a gang's first waiting member held its Permit wait")
+)
+gang_timeouts = default_registry.register(
+    Counter("gang_timeouts_total",
+            "Gangs whose Permit wait expired before all members placed")
+)
+
 scheduler_retries = default_registry.register(
     # labels: (reason,) — "cycle_error" (whole-batch dispatch failure
     # requeued) | "bind_error" (per-pod binding-cycle fault requeued)

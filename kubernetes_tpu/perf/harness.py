@@ -30,7 +30,8 @@ from ..testutil import make_node, make_pod
 
 @dataclass
 class Op:
-    """One opcode. kinds: createNodes | createPods | barrier | churn."""
+    """One opcode. kinds: createNodes | createPods | createObjects |
+    barrier | churn."""
 
     opcode: str
     count: int = 0
@@ -42,6 +43,9 @@ class Op:
     # (scheduler_perf skipWaitToCompletion — e.g. permanently unschedulable
     # filler pods)
     skip_wait: bool = False
+    # createObjects: i → (kind, object) for non-Node/Pod setup objects
+    # (PodGroups for the gang suites, services, quotas, ...)
+    object_template: Optional[Callable[[int], tuple]] = None
 
 
 @dataclass
@@ -55,6 +59,9 @@ class Workload:
     churn_between_cycles: Optional[Callable] = None
     # () -> (extenders list, cleanup fn): suites measuring the extender path
     make_extenders: Optional[Callable] = None
+    # gang suites: members per PodGroup — turns on the gangs/s +
+    # time-to-full-slice collectors over the measured window
+    gang_size: Optional[int] = None
 
 
 @dataclass
@@ -113,12 +120,18 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
     items: List[DataItem] = []
     node_idx = 0
     pod_idx = 0
+    obj_idx = 0
     for op in w.ops:
         if op.opcode == "createNodes":
             tmpl = op.node_template or default_node
             for _ in range(op.count):
                 store.create("Node", tmpl(node_idx))
                 node_idx += 1
+        elif op.opcode == "createObjects":
+            for _ in range(op.count):
+                kind, obj = op.object_template(obj_idx)
+                store.create(kind, obj)
+                obj_idx += 1
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
             if op.collect_metrics:
@@ -263,6 +276,10 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 m.scheduling_attempt_duration.reset()
                 pending_names = {(p.namespace, p.metadata.name) for p in created}
                 done = 0
+                # gang suites: per-group bind counts → time-to-full-slice
+                # (window start → the gang's LAST member bound)
+                gang_counts: Dict[str, int] = {}
+                gang_done_t: List[float] = []
 
                 def on_bind(ev):
                     nonlocal done
@@ -272,6 +289,14 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     if key in pending_names:
                         pending_names.discard(key)
                         done += 1
+                        if w.gang_size:
+                            from ..gang import POD_GROUP_LABEL
+
+                            g = ev.obj.metadata.labels.get(POD_GROUP_LABEL)
+                            if g:
+                                gang_counts[g] = gang_counts.get(g, 0) + 1
+                                if gang_counts[g] == w.gang_size:
+                                    gang_done_t.append(clock() - t0)
 
                 unwatch = store.watch(on_bind)
                 t0 = clock()
@@ -324,7 +349,8 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                             # flush — the reference's flush goroutines just tick;
                             # spin-wait rather than misreading backoff as done.
                             a, b, u = sched.queue.pending_count()
-                            if (b == 0 and u == 0) or waited > 30.0:
+                            if (b == 0 and u == 0 and stats.waiting == 0) \
+                                    or waited > 30.0:
                                 break
                             time.sleep(0.02)
                             waited += 0.02
@@ -354,6 +380,28 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         data={"Average": round(throughput, 1)},
                         unit="pods/s",
                     ))
+                    if w.gang_size:
+                        gd = sorted(gang_done_t)
+
+                        def _gq(q: float) -> float:
+                            if not gd:
+                                return 0.0
+                            return gd[min(len(gd) - 1,
+                                          max(0, int(round(q * (len(gd) - 1)))))]
+
+                        items.append(DataItem(
+                            labels={"Name": w.name, "Metric": "GangThroughput"},
+                            data={"Average": (round(len(gd) / total_s, 2)
+                                              if total_s > 0 else 0.0),
+                                  "Gangs": float(len(gd))},
+                            unit="gangs/s",
+                        ))
+                        items.append(DataItem(
+                            labels={"Name": w.name, "Metric": "TimeToFullSlice"},
+                            data={"Perc50": _gq(0.50), "Perc90": _gq(0.90),
+                                  "Max": gd[-1] if gd else 0.0},
+                            unit="s",
+                        ))
                     samples = sorted(hist.samples())
 
                     def _exact(vals: List[float], q: float) -> float:

@@ -368,6 +368,76 @@ def _extender(n, p, mp) -> Workload:
     )
 
 
+GANG_SIZE = 8  # members per slice job (one multi-host TPU slice)
+
+
+def node_sliced(gang_size: int = GANG_SIZE) -> Callable[[int], v1.Node]:
+    """One TPU host VM per node, ``gang_size`` hosts per slice — the slice
+    label feeds the Coscheduling anchor-slice score plane."""
+    from ..gang import SLICE_LABEL
+
+    def tmpl(i: int) -> v1.Node:
+        return (
+            make_node().name(f"node-{i:06d}")
+            .capacity({"cpu": "4", "memory": "32Gi", "pods": "110"})
+            .label(SLICE_LABEL, f"slice-{i // gang_size:05d}")
+            .obj()
+        )
+
+    return tmpl
+
+
+def pod_gang(gang_size: int = GANG_SIZE) -> Callable[[int], v1.Pod]:
+    """Gang member i belongs to PodGroup pg-{i // gang_size}; the 3-cpu
+    request packs ONE member per 4-cpu host (a slice job owns its hosts).
+    Harness warmup indices (≥9M) yield plain pods: warms must exercise the
+    normal bind path, not park at the quorum gate behind a group that
+    doesn't exist."""
+    from ..gang import POD_GROUP_LABEL
+
+    def tmpl(i: int) -> v1.Pod:
+        if i >= 9_000_000:
+            return pod_default(i)
+        return (
+            _base_pod(i, "gang", "default")
+            .label(POD_GROUP_LABEL, f"pg-{i // gang_size:05d}")
+            .req({"cpu": "3000m", "memory": "500Mi"})
+            .obj()
+        )
+
+    return tmpl
+
+
+def podgroup_template(gang_size: int = GANG_SIZE) -> Callable[[int], tuple]:
+    def tmpl(i: int):
+        pg = v1.PodGroup(
+            metadata=v1.ObjectMeta(name=f"pg-{i:05d}", namespace="default"),
+            min_member=gang_size,
+            schedule_timeout_seconds=60,
+        )
+        return ("PodGroup", pg)
+
+    return tmpl
+
+
+def _gang_basic(n, p, mp) -> Workload:
+    # a scaled-down dev run may shrink mp below the slice size: shrink the
+    # gang with it so every group can still reach quorum
+    gs = GANG_SIZE if mp >= GANG_SIZE else max(2, mp)
+    ngangs = max(1, mp // gs)
+    return Workload(
+        name="GangBasic",
+        ops=[
+            Op("createNodes", n, node_template=node_sliced(gs)),
+            Op("createObjects", ngangs, object_template=podgroup_template(gs)),
+            Op("createPods", ngangs * gs, pod_template=pod_gang(gs),
+               collect_metrics=True),
+        ],
+        batch_size=64,
+        gang_size=gs,
+    )
+
+
 def _mixed_churn(n, p, mp) -> Workload:
     def churn(store, cycle: int):
         # recreate-mode churn (SchedulingWithMixedChurn): one node, one
@@ -450,6 +520,13 @@ SUITES: Dict[str, Suite] = {
               batch_size={"5000Nodes/200InitPods": 512}),
         Suite("SchedulingWithMixedChurn", _mixed_churn,
               {"1000Nodes": (1000, 0, 1000), "5000Nodes": (5000, 0, 2000)},
+              batch_size={"5000Nodes": 512}),
+        # Gang scheduling: N/8 slice jobs of 8 members, one member per
+        # host, capacity slightly over the job count (every gang lands);
+        # measures gangs/s + time-to-full-slice alongside pods/s
+        Suite("GangBasic", _gang_basic,
+              {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
+               "5000Nodes": (5000, 0, 4800)},
               batch_size={"5000Nodes": 512}),
         # extender batch 384: large enough to amortize the per-batch fixed
         # tunnel rounds (fused prepare+first-plane), but UNDER the node

@@ -57,9 +57,15 @@ class PriorityQueue:
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
         unschedulable_time_limit: float = DEFAULT_UNSCHEDULABLE_TIME_LIMIT,
         cluster_event_map: Optional[Dict[ClusterEvent, Set[str]]] = None,
+        group_key: Optional[Callable[[QueuedPodInfo], Optional[str]]] = None,
     ):
         self._less = less
         self._clock = clock
+        # gang cohesion (kubernetes_tpu/gang/): pods sharing a non-None
+        # group key move out of backoff/unschedulableQ TOGETHER — one
+        # member trickling back alone just burns a Permit-timeout round
+        # per member (the thrash the coscheduling subsystem exists to stop)
+        self._group_key = group_key
         self._initial_backoff = pod_initial_backoff
         self._max_backoff = pod_max_backoff
         self._unschedulable_limit = unschedulable_time_limit
@@ -207,13 +213,38 @@ class PriorityQueue:
             m.queue_incoming_pods.inc(("backoff", event))
 
     def activate(self, pods: Sequence[v1.Pod]) -> None:
-        """Activate (:318): force named pods from backoff/unschedulable to active."""
+        """Activate (:318): force named pods from backoff/unschedulable to
+        active — expanded to every queued member of the named pods' groups
+        (group_key), so a gang re-enters the active queue as ONE unit."""
         uids = {p.uid for p in pods}
+        uids |= self._group_sibling_uids(
+            self._groups_of_pods(pods) if self._group_key else set())
         self._remove_from_backoff(uids, to_active=True)
         for uid in list(self._unschedulable):
             if uid in uids:
                 self._push_active(self._unschedulable.pop(uid),
                                   "ForceActivate")
+
+    def _groups_of_pods(self, pods: Sequence[v1.Pod]) -> Set[str]:
+        # group_key reads info.pod only; a transient wrapper is enough
+        return {
+            k for k in (self._group_key(QueuedPodInfo(pod=p)) for p in pods)
+            if k is not None
+        }
+
+    def _group_sibling_uids(self, groups: Set[str]) -> Set[str]:
+        """uids of every backoff/unschedulableQ member of ``groups``."""
+        if not groups:
+            return set()
+        out: Set[str] = set()
+        for info in self._unschedulable.values():
+            if self._group_key(info) in groups:
+                out.add(info.pod.uid)
+        for _, _, info in self._backoff:
+            if info.pod.uid in self._in_backoff \
+                    and self._group_key(info) in groups:
+                out.add(info.pod.uid)
+        return out
 
     def _remove_from_backoff(self, uids: Set[str], to_active: bool):
         kept = []
@@ -254,9 +285,36 @@ class PriorityQueue:
                        if self._pod_matches_event(info, ev)), None)
             if ev is not None:
                 moved.append((uid, ev.label or "ClusterEvent"))
+        # Gang cohesion: an event that moves ANY member moves the WHOLE
+        # group, and the group bypasses the per-pod backoff gate — members
+        # re-dispatch together or the stragglers burn the released members'
+        # Permit wait one timeout at a time.
+        moved_groups: Set[str] = set()
+        if self._group_key is not None and moved:
+            for uid, _ in moved:
+                g = self._group_key(self._unschedulable[uid])
+                if g is not None:
+                    moved_groups.add(g)
+            if moved_groups:
+                moved_uids = {u for u, _ in moved}
+                label_of = {
+                    self._group_key(self._unschedulable[u]): lbl
+                    for u, lbl in moved
+                }
+                for uid, info in self._unschedulable.items():
+                    g = self._group_key(info)
+                    if g in moved_groups and uid not in moved_uids:
+                        moved.append((uid, label_of[g]))
+                backoff_sibs = self._group_sibling_uids(moved_groups) \
+                    - {u for u, _ in moved}
+                if backoff_sibs:
+                    self._remove_from_backoff(backoff_sibs, to_active=True)
         for uid, label in moved:
             info = self._unschedulable.pop(uid)
-            if self._clock() < self._backoff_time(info):
+            if self._group_key is not None \
+                    and self._group_key(info) in moved_groups:
+                self._push_active(info, label)
+            elif self._clock() < self._backoff_time(info):
                 self._push_backoff(info, label)
             else:
                 self._push_active(info, label)

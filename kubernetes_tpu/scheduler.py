@@ -62,8 +62,11 @@ def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
         VolumeZonePlugin,
     )
 
+    from .gang import CoschedulingPlugin
+
     PW = PluginWithWeight
     return [
+        PW(CoschedulingPlugin(), 1),
         PW(P.NodeUnschedulablePlugin(), 0),
         PW(P.NodeNamePlugin(), 0),
         PW(P.TaintTolerationPlugin(), 3),
@@ -87,6 +90,24 @@ class _TransientBindError(Exception):
     queue — no cluster event is needed to unblock the pod."""
 
 
+# _run_reserve_and_bind outcome: a holds_on_wait Permit plugin (gang
+# Coscheduling) left the pod pending — assume + reserve kept, bind deferred
+_PERMIT_WAIT = object()
+
+
+@dataclass
+class _WaitingBind:
+    """A binding cycle held open at Permit (gang all-or-nothing hold): the
+    pod stays assumed in the cache on ``node_name`` with ``reserved``
+    plugins intact; _flush_waiting_binds finishes or rolls it back."""
+
+    qi: QueuedPodInfo
+    node_name: str
+    fw: object
+    reserved: List
+    since: float
+
+
 @dataclass
 class CycleStats:
     attempted: int = 0
@@ -94,6 +115,9 @@ class CycleStats:
     unschedulable: int = 0
     batch_seconds: float = 0.0
     in_flight: int = 0  # pods dispatched to device, decision not yet bound
+    # gang members assumed + holding a Permit wait (bind deferred until the
+    # gang completes or the wait deadline fires) at cycle end
+    waiting: int = 0
 
 
 def _unpack_diag(bits: np.ndarray, n_filters: int) -> np.ndarray:
@@ -139,6 +163,7 @@ def _pod_blocks_static(p: v1.Pod) -> bool:
     pod (anti)affinity tables, host ports, volumes.  Topology-spread
     constraints are CHAINABLE (the fused program folds in-flight placements
     into this batch's count tables via PodTopologySpreadPlugin.chain_prev)."""
+    from .gang import POD_GROUP_LABEL
     from .state.node_info import _pod_host_ports
 
     aff = p.spec.affinity
@@ -147,6 +172,10 @@ def _pod_blocks_static(p: v1.Pod) -> bool:
     if _pod_host_ports(p):
         return True
     if getattr(p.spec, "volumes", None):
+        return True
+    # gang members carry Permit-hold state (assumes that may roll back on a
+    # group timeout) the deep chain can neither see nor unwind
+    if POD_GROUP_LABEL in p.metadata.labels:
         return True
     return False
 
@@ -299,10 +328,19 @@ class TPUScheduler:
             for pw in factory(8):
                 for ev in pw.plugin.events_to_register():
                     event_map.setdefault(ev, set()).add(pw.plugin.name)
+        # gang runtime (kubernetes_tpu/gang/): one directory shared by every
+        # profile's Coscheduling plugin instance; its less-fn IS the
+        # Coscheduling QueueSort (group cohesion over PrioritySort), and its
+        # group key gives the queue gang-atomic activate/requeue
+        from .gang import GangDirectory
+
+        self.gangs = GangDirectory(store, clock=clock)
         self.queue = PriorityQueue(
+            less=self.gangs.less,
             clock=clock, cluster_event_map=event_map,
             pod_initial_backoff=pod_initial_backoff,
             pod_max_backoff=pod_max_backoff,
+            group_key=self.gangs.queue_group_key,
         )
         self.preemption = Evaluator()
         self.extenders = list(extenders or [])
@@ -328,6 +366,11 @@ class TPUScheduler:
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
+        self.gangs.bind_runtime(self.waiting_pods)
+        # uid → _WaitingBind: binding cycles held open at Permit (gang
+        # members keep their assume + reserve until the gang completes or
+        # the wait deadline fires — flushed every schedule_cycle)
+        self._waiting_binds: Dict[str, "_WaitingBind"] = {}
         # nominator: uid → (node_name, request vector, pod) for pods holding a
         # nominated node across cycles (their reservation is added to the
         # dynamic state so other pods don't steal the spot, and preemption
@@ -366,6 +409,14 @@ class TPUScheduler:
             self._on_node_event(ev)
         elif ev.kind == "Pod":
             self._on_pod_event(ev)
+        elif ev.kind == "PodGroup":
+            # gang directory first (quorum counts read it), then requeue
+            # members whose Coscheduling rejection this change may resolve
+            self.gangs.on_group_event(ev.type, ev.obj)
+            action = {ADDED: ActionType.ADD, MODIFIED: ActionType.UPDATE,
+                      DELETED: ActionType.DELETE}.get(ev.type, ActionType.ALL)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(EventResource.POD_GROUP, action))
         elif ev.kind in self._IGNORED_KINDS:
             return
         else:
@@ -390,6 +441,7 @@ class TPUScheduler:
 
     def _on_node_event(self, ev: WatchEvent):
         node: v1.Node = ev.obj
+        self.gangs.invalidate_nodes()  # slice-domain plane is stale
         if ev.type == ADDED:
             self.cache.add_node(node)
             self.queue.move_all_to_active_or_backoff(fwk_events.NODE_ADD)
@@ -417,6 +469,14 @@ class TPUScheduler:
         # assigned pods always feed the cache (they occupy resources)
         if not assigned and self._profile_of(pod) not in self.profiles:
             return
+        if ev.type == DELETED and pod.uid in self._waiting_binds:
+            # a gang member deleted while holding its Permit wait: abort the
+            # held binding cycle THROUGH the unreserve chain (reserved
+            # plugin state — e.g. VolumeBinding's assumed PVs — must roll
+            # back, and the Coscheduling group-failure hook fails the
+            # gang's remaining waiters fast instead of timing them out)
+            self._cancel_waiting_bind(pod.uid)
+        self.gangs.on_pod_event(ev.type, pod, assigned)
         if ev.type == ADDED:
             if assigned:
                 self.cache.add_pod(pod)
@@ -480,6 +540,12 @@ class TPUScheduler:
         if profile not in self._fws:
             factory = self.profiles[profile]
             fw = BatchedFramework(factory(d))
+            # wire every Coscheduling instance to the shared gang directory
+            # (profiles each construct their own plugin objects)
+            for pw in fw.plugins:
+                attach = getattr(pw.plugin, "attach_gang_directory", None)
+                if attach is not None:
+                    attach(self.gangs)
             self._fws[profile] = fw
             self._jitted_by[profile] = self._build_jitted(fw)
         return self._fws[profile]
@@ -546,8 +612,15 @@ class TPUScheduler:
                 return jnp.stack([node_row.astype(jnp.int32), packed_bits])
             return bits  # >31 filter plugins: unpacked legacy shape
 
+        # gang all-or-nothing: a segment-sum pass over per-pod gang ids
+        # withdraws every member of a gang with ANY unplaced member, INSIDE
+        # the fused program (a standalone device pass would pay its own
+        # ~100ms tunnel round per cycle).  gang_seg all(-1) is a no-op, so
+        # gang-free cycles share the same compiled executable.
+        from .gang import gang_all_or_nothing
+
         def fused_greedy(batch, dsnap, upd, nom_rows, nom_req, prevs,
-                         host_auxes, order, key):
+                         host_auxes, order, gang_seg, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
             for prev in prevs:  # oldest→newest in-flight carry (≤2 bundles)
@@ -556,11 +629,13 @@ class TPUScheduler:
             for prev in prevs:
                 auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.greedy_assign(batch, dsnap, dyn, auxes, order, key)
+            res = res._replace(
+                node_row=gang_all_or_nothing(res.node_row, gang_seg))
             return res, auxes, dsnap, dyn, diagnostics(
                 batch, dsnap, dyn, auxes, res.node_row)
 
         def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prevs,
-                        host_auxes, order, coupling, key):
+                        host_auxes, order, gang_seg, coupling, key):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
             for prev in prevs:
@@ -569,6 +644,8 @@ class TPUScheduler:
             for prev in prevs:
                 auxes = fw.chain_prev(batch, dsnap, auxes, prev)
             res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
+            res = res._replace(
+                node_row=gang_all_or_nothing(res.node_row, gang_seg))
             return res, auxes, dsnap, dyn, diagnostics(
                 batch, dsnap, dyn, auxes, res.node_row)
 
@@ -648,6 +725,11 @@ class TPUScheduler:
         infos = self.queue.pop_batch(
             self.batch_size, group_key=lambda qi: self._profile_of(qi.pod)
         )
+        # gang PreFilter quorum gate: a member whose group is below
+        # minMember can never form the gang — reject HERE, before any
+        # batch-compile or solver work is spent on it
+        if infos and self.gangs.active:
+            infos = self._gang_prefilter(infos, stats)
         next_interacts = self._infos_block_deep(infos) if infos else True
         # Deep chain tail: the newest run of in-flight batches this dispatch
         # can chain on device (each must be constraint-free and predate no
@@ -696,9 +778,37 @@ class TPUScheduler:
             else:
                 rows = self._complete(nxt)
                 merge(self._bind_phase(nxt, rows))
+        # resolve gang Permit holds: released members bind now (the last
+        # sibling's permit this cycle allowed them), expired ones roll the
+        # whole gang back and requeue it atomically
+        ws = self._flush_waiting_binds()
+        stats.scheduled += ws.scheduled
+        stats.unschedulable += ws.unschedulable
+        stats.waiting = len(self._waiting_binds)
         stats.in_flight = sum(len(fl.infos) for fl in inflight)
         self._observe_pending()
         return stats
+
+    def _gang_prefilter(self, infos: List[QueuedPodInfo],
+                        stats: CycleStats) -> List[QueuedPodInfo]:
+        """Host PreFilter pass (Coscheduling quorum): rejected members go
+        straight to unschedulableQ with the plugin diagnosis — no solver
+        work — and requeue on sibling-pod/PodGroup events."""
+        keep: List[QueuedPodInfo] = []
+        cycle = self.queue.scheduling_cycle()
+        for qi in infos:
+            st = self.gangs.prefilter(qi.pod)
+            if st is None or st.is_success():
+                keep.append(qi)
+                continue
+            qi.unschedulable_plugins = {st.plugin or "Coscheduling"}
+            stats.attempted += 1
+            stats.unschedulable += 1
+            m.schedule_attempts.inc(("unschedulable",))
+            self.queue.add_unschedulable(qi, cycle)
+            self.recorder.eventf(
+                qi.pod, "Warning", "FailedScheduling", st.message())
+        return keep
 
     def _handle_cycle_failure(self, infos: List[QueuedPodInfo],
                               err: Exception) -> None:
@@ -789,6 +899,12 @@ class TPUScheduler:
         profile = self._profile_of(infos[0].pod)  # queue groups by profile
         fw = self._framework(profile)
         jt = self._jitted_by[profile]
+        # gang context for this batch: the Coscheduling score plane's
+        # host_prepare reads the staged pod objects (the compiled PodBatch
+        # carries none), and the fused program gets the segment ids for the
+        # in-batch all-or-nothing mask
+        self.gangs.stage_batch(pods)
+        gang_seg = self.gangs.gang_segments(pods, batch.size)
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
@@ -839,7 +955,8 @@ class TPUScheduler:
                 for p in prevs
             ]
         res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
-            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes, deltas=deltas
+            jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes,
+            deltas=deltas, gang_seg=gang_seg,
         )
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
@@ -1007,12 +1124,20 @@ class TPUScheduler:
                 # row→name map may have changed under the next dispatch's sync
                 node_name = fl.node_names[i]
                 try:
-                    ok = self._run_reserve_and_bind(fw, qi.pod, node_name)
+                    ok = self._run_reserve_and_bind(fw, qi.pod, node_name,
+                                                    qi=qi)
                 except _TransientBindError:
                     # already rolled back; timer retry via backoff — the
                     # rest of the batch's bind phase proceeds untouched
                     self.cache.forget_pod(qi.pod)
                     self._requeue_after_failure(qi)
+                    m.scheduling_attempt_duration.observe(
+                        float(fl.algo_lat[i]) + (self.clock() - t_pod))
+                    continue
+                if ok is _PERMIT_WAIT:
+                    # gang Permit hold: assume + reserve kept, bind deferred
+                    # to _flush_waiting_binds — neither scheduled nor
+                    # unschedulable yet; the attempt latency is still real
                     m.scheduling_attempt_duration.observe(
                         float(fl.algo_lat[i]) + (self.clock() - t_pod))
                     continue
@@ -1049,10 +1174,18 @@ class TPUScheduler:
                     nf = len(fw.filter_names)
                     diag_np = (_unpack_diag(raw[1], nf)
                                if nf <= 31 else raw)
-                qi.unschedulable_plugins = self._diagnose(
-                    fw, batch, dsnap, dyn, auxes, i,
-                    diag_row=None if diag_np is None else diag_np[i],
-                )
+                diag_row = None if diag_np is None else diag_np[i]
+                if diag_row is not None and bool(np.all(diag_row)) \
+                        and self.gangs.is_member(qi.pod):
+                    # every filter left this pod a feasible node yet no row
+                    # came back: the gang all-or-nothing mask withdrew its
+                    # gang (a sibling missed) — attribute to Coscheduling,
+                    # not to a filter plugin that didn't reject it
+                    qi.unschedulable_plugins = {"Coscheduling"}
+                else:
+                    qi.unschedulable_plugins = self._diagnose(
+                        fw, batch, dsnap, dyn, auxes, i, diag_row=diag_row,
+                    )
                 # repeat-offender cost cap: the preemption candidate program
                 # (full-pod-tier einsum + its own device round) only runs
                 # when SOME scheduled pod could actually be a victim — a
@@ -1070,6 +1203,9 @@ class TPUScheduler:
                     # evict — defer to the retry, which blocks the chain
                     # (_infos_block_deep: attempts > 1) and preempts clean
                     and not fl.chained
+                    # gang guard: never evict victims for a gang that cannot
+                    # fully place — only the LAST missing member may preempt
+                    and self.gangs.allows_preemption(qi.pod)
                 )
                 if can_preempt:
                     # the lazy context (PDB list, row→name, candidate-mask
@@ -1198,14 +1334,123 @@ class TPUScheduler:
             )
         return stats
 
+    def _cancel_waiting_bind(self, uid: str) -> None:
+        """Abort a held binding cycle without finishing it: unreserve in
+        reverse, forget the assume, drop the waiting entries."""
+        wb = self._waiting_binds.pop(uid, None)
+        if wb is None:
+            return
+        self.waiting_pods.remove(uid)
+        pod = wb.qi.pod
+        for done in reversed(wb.reserved):
+            un = getattr(done.plugin, "unreserve", None)
+            if un is not None:
+                un(None, pod, wb.node_name)
+        self.cache.forget_pod(pod)
+
+    def _flush_waiting_binds(self) -> CycleStats:
+        """Resolve binding cycles held open at Permit (gang holds).
+
+        Allowed pods (the gang's last member released them) finish the
+        PreBind→Bind→PostBind half; rejected/expired pods roll back —
+        unreserve runs the Coscheduling group-failure hook, which rejects
+        every still-waiting sibling, so one member's deadline fails the
+        WHOLE gang in this one flush pass — and every requeued gang pod
+        re-enters the active queue together via the group-aware
+        PriorityQueue.activate (atomic gang requeue)."""
+        stats = CycleStats()
+        if not self._waiting_binds:
+            return stats
+        requeued_gang_pods: List[v1.Pod] = []
+        # loop to a fixed point: a member's timeout rejects its SIBLINGS'
+        # entries via the group-failure hook, and those must resolve in
+        # THIS flush (one atomic gang requeue), not trickle one per cycle
+        progress = True
+        while progress:
+            progress = False
+            for uid in list(self._waiting_binds):
+                wb = self._waiting_binds.get(uid)
+                if wb is None:
+                    continue  # a sibling's rejection already consumed it
+                resolved = self._flush_one_waiting(
+                    uid, wb, stats, requeued_gang_pods)
+                progress = progress or resolved
+        if requeued_gang_pods:
+            # atomic gang requeue: the group-aware activate pulls every
+            # queued sibling (incl. backoff) to active in one step
+            self.queue.activate(requeued_gang_pods)
+        return stats
+
+    def _flush_one_waiting(self, uid: str, wb: "_WaitingBind",
+                           stats: CycleStats,
+                           requeued_gang_pods: List[v1.Pod]) -> bool:
+        """Resolve one held binding cycle; → True when it left the map."""
+        pod = wb.qi.pod
+        reason = self.waiting_pods.wait_on_permit(pod)
+        if reason is None:
+            # allowed: run the deferred PreBind→Bind→PostBind half
+            del self._waiting_binds[uid]
+            try:
+                ok = self._finish_bind(wb.fw, pod, wb.node_name, wb.reserved)
+            except _TransientBindError:
+                self.cache.forget_pod(pod)
+                self._requeue_after_failure(wb.qi)
+                return True
+            now = self.clock()
+            m.scheduling_attempt_duration.observe(now - wb.since)
+            if ok:
+                self.cache.finish_binding(pod)
+                stats.scheduled += 1
+                m.schedule_attempts.inc(("scheduled",))
+                m.pod_scheduling_attempts.observe(wb.qi.attempts)
+                m.pod_scheduling_duration.observe(
+                    now - wb.qi.initial_attempt_timestamp)
+                m.e2e_scheduling_duration.observe(
+                    max(now - wb.qi.timestamp, now - wb.since))
+                self.recorder.eventf(
+                    pod, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.namespace}/"
+                    f"{pod.metadata.name} to {wb.node_name} (gang released)",
+                )
+            else:
+                self.cache.forget_pod(pod)
+                if self.store.get("Pod", pod.namespace,
+                                  pod.metadata.name) is not None:
+                    self.queue.add_unschedulable(wb.qi, None)
+                    requeued_gang_pods.append(pod)
+            return True
+        if self.waiting_pods.get(uid) is None:
+            # rejected or deadline expired: roll the cycle back; the
+            # unreserve chain fires the gang group-failure hook
+            del self._waiting_binds[uid]
+            self.gangs.note_wait_rejected(pod, reason)
+            for done in reversed(wb.reserved):
+                un = getattr(done.plugin, "unreserve", None)
+                if un is not None:
+                    un(None, pod, wb.node_name)
+            self.cache.forget_pod(pod)
+            stats.unschedulable += 1
+            m.schedule_attempts.inc(("unschedulable",))
+            self.recorder.eventf(
+                pod, "Warning", "FailedScheduling",
+                f"pod rejected at permit: {reason}",
+            )
+            if self.store.get("Pod", pod.namespace,
+                              pod.metadata.name) is not None:
+                self.queue.add_unschedulable(wb.qi, None)
+                requeued_gang_pods.append(pod)
+            return True
+        return False  # still waiting — leave the hold in place
+
     def _observe_pending(self):
         a, b, u = self.queue.pending_count()
         m.pending_pods.set(a, ("active",))
         m.pending_pods.set(b, ("backoff",))
         m.pending_pods.set(u, ("unschedulable",))
+        m.pending_pods.set(len(self._waiting_binds), ("gated",))
 
     def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req,
-                        host_auxes, deltas=None):
+                        host_auxes, deltas=None, gang_seg=None):
         """Dispatch between the parallel batch engine and the exact serial
         scan (the parity oracle).  "auto" uses the batch engine unless too
         much of the batch is cross-pod coupled — a mostly-anti-affinity batch
@@ -1231,6 +1476,8 @@ class TPUScheduler:
         # numpy, NOT jnp.arange: an eager jnp op is its own device program,
         # and each program execution on the tunnel pays a ~100ms pacing round
         order = np.arange(batch.size, dtype=np.int32)
+        if gang_seg is None:
+            gang_seg = self.gangs.gang_segments([], batch.valid.shape[0])
         mode = self.assign_mode
         if mode in ("auto", "batch"):
             coupling = coupling_flags(batch)
@@ -1239,11 +1486,11 @@ class TPUScheduler:
             if mode == "batch" or frac <= self.coupled_fraction_threshold:
                 return jt["batch"](
                     batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
-                    order, coupling, self.rng_key,
+                    order, gang_seg, coupling, self.rng_key,
                 )
         return jt["greedy"](
             batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order,
-            self.rng_key,
+            gang_seg, self.rng_key,
         )
 
     def _noop_delta(self, like_batch):
@@ -1468,10 +1715,19 @@ class TPUScheduler:
             m.scheduling_algorithm_duration.observe(algo_lat[i])
         return out, algo_lat
 
-    def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str) -> bool:
-        """Reserve → PreBind → Bind → PostBind (scheduler.go:584-698, host side).
+    def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str,
+                              qi: Optional[QueuedPodInfo] = None):
+        """Reserve → Permit → PreBind → Bind → PostBind (scheduler.go:584-698).
 
-        On any failure, already-reserved plugins are unreserved in reverse order.
+        Returns True (bound), False (rejected, rolled back), or the
+        _PERMIT_WAIT sentinel: a Permit plugin with ``holds_on_wait`` (the
+        gang Coscheduling plugin) left the pod pending — the assume and the
+        reserve are KEPT, the rest of the binding cycle is deferred to
+        _flush_waiting_binds (released when the gang completes, rolled back
+        when the wait deadline fires).  A plain Wait (no holding plugin)
+        keeps the synchronous-sim contract: the cycle fails and the pod
+        retries after backoff.  On any failure, already-reserved plugins
+        are unreserved in reverse order.
         """
         from .framework.interface import Code
 
@@ -1492,21 +1748,47 @@ class TPUScheduler:
                 rollback()
                 return False
             reserved.append(pw)
-        # Permit: plugins may Wait with a timeout (waiting_pods_map analog);
-        # in the synchronous sim an unallowed Wait fails the cycle and the pod
-        # retries after backoff (WaitOnPermit, runtime/framework.go)
+        # Permit: plugins may Wait with a timeout (waiting_pods_map analog)
         if fw.permit_plugins:
+            holding = False
             for pw in fw.permit_plugins:
                 status, timeout = pw.plugin.permit(None, pod, node_name)
                 if status is not None and status.code == Code.WAIT:
                     self.waiting_pods.add(pod, pw.plugin.name, timeout)
+                    holding = holding or getattr(
+                        pw.plugin, "holds_on_wait", False)
                 elif status is not None and not status.is_success():
                     rollback()
                     return False
             reason = self.waiting_pods.wait_on_permit(pod)
             if reason is not None:
+                if holding and qi is not None \
+                        and self.waiting_pods.get(pod.uid) is not None:
+                    # still pending (not rejected): hold the binding cycle
+                    # open — gang members keep their node until the last
+                    # sibling releases them or the deadline fires
+                    self._waiting_binds[pod.uid] = _WaitingBind(
+                        qi=qi, node_name=node_name, fw=fw,
+                        reserved=reserved, since=self.clock())
+                    self.gangs.note_waiting(pod, node_name)
+                    return _PERMIT_WAIT
                 rollback()
                 return False
+        return self._finish_bind(fw, pod, node_name, reserved)
+
+    def _finish_bind(self, fw, pod: v1.Pod, node_name: str,
+                     reserved: List) -> bool:
+        """The post-Permit half of the binding cycle (PreBind → Bind →
+        PostBind), shared by the synchronous path and the waiting-bind
+        flush; rolls back ``reserved`` on failure."""
+
+        def rollback():
+            self.waiting_pods.remove(pod.uid)
+            for done in reversed(reserved):
+                un = getattr(done.plugin, "unreserve", None)
+                if un is not None:
+                    un(None, pod, node_name)
+
         for pw in fw.pre_bind_plugins:
             status = pw.plugin.pre_bind(None, pod, node_name)
             if status is not None and not status.is_success():
@@ -1770,9 +2052,16 @@ class TPUScheduler:
         pod.status.nominated_node_name = None
         self.cache.assume_pod(pod, cand.node_name)
         try:
-            ok = self._run_reserve_and_bind(fw, pod, cand.node_name)
+            ok = self._run_reserve_and_bind(fw, pod, cand.node_name, qi=qi)
         except _TransientBindError:
             ok = False  # rolled back; fall through to nominate-and-requeue
+        if ok is _PERMIT_WAIT:
+            # a gang member reached the fast path while its gang is still
+            # incomplete (the allows_preemption guard makes this rare):
+            # don't hold a preemption fast-bind open — cancel the wait and
+            # fall back to nominate-and-requeue
+            self._cancel_waiting_bind(pod.uid)
+            ok = False
         if not ok:
             self.cache.forget_pod(pod)
             pod.status.nominated_node_name = cand.node_name
@@ -1819,8 +2108,10 @@ class TPUScheduler:
                 # only the BACKOFF queue is worth spinning on: its pods become
                 # poppable within pod_max_backoff.  UnschedulableQ pods need a
                 # cluster event or the 60s flush — callers wanting that drive
-                # cycles themselves (the perf harness does).
-                if b == 0 or waited >= backoff_wait:
+                # cycles themselves (the perf harness does).  Gang Permit
+                # holds (s.waiting) also resolve on later cycles (release or
+                # deadline), so they keep the spin alive up to the budget.
+                if (b == 0 and s.waiting == 0) or waited >= backoff_wait:
                     break
                 time.sleep(0.05)
                 waited += 0.05

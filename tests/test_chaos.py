@@ -75,6 +75,77 @@ def _no_sleep(_seconds):
     pass
 
 
+# --- gang scheduling under faults ---------------------------------------------
+
+
+def test_gang_scheduling_converges_under_watch_drops_and_429_storm():
+    """Gang all-or-nothing survives the chaos battery: under watch drops
+    and a 429/conflict write storm, every gang binds ALL of its members,
+    each member exactly once (no double bind), with no partial gang left
+    behind — retries and Permit-timeout requeues may happen in between,
+    but the end state converges."""
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.gang import POD_GROUP_LABEL
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    fault = FaultSchedule(
+        13, watch_drop_rate=0.15, write_429_rate=0.35, write_500_rate=0.1,
+        conflict_rate=0.1, retry_after=0.0, max_faults_per_key=3,
+    )
+    raw = ObjectStore(fault_injector=fault)
+    store = RetryingStore(raw, sleep=_no_sleep)
+    # exactly-once probe: count unbound→bound transitions per pod uid on
+    # the RAW store (below the retry layer)
+    bind_counts = {}
+    bound_seen = set()
+
+    def on_ev(ev):
+        if ev.kind != "Pod" or not ev.obj.spec.node_name:
+            return
+        if ev.obj.uid not in bound_seen:
+            bound_seen.add(ev.obj.uid)
+            bind_counts[ev.obj.uid] = bind_counts.get(ev.obj.uid, 0) + 1
+
+    raw.watch(on_ev)
+    sched = TPUScheduler(store, batch_size=4, pod_initial_backoff=0.01,
+                         pod_max_backoff=0.05, batch_wait=0)
+    for i in range(8):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    for g in ("ga", "gb"):
+        pg = v1.PodGroup(
+            metadata=v1.ObjectMeta(name=g, namespace="default"),
+            min_member=4, schedule_timeout_seconds=2,
+        )
+        store.create("PodGroup", pg)
+        for i in range(4):
+            store.create("Pod", make_pod().name(f"{g}-{i}").uid(f"{g}-{i}")
+                         .namespace("default").label(POD_GROUP_LABEL, g)
+                         .req({"cpu": "3"}).obj())
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        s = sched.run_until_idle(max_cycles=50, backoff_wait=1.0)
+        done = sum(
+            1 for g in ("ga", "gb") for i in range(4)
+            if raw.get("Pod", "default", f"{g}-{i}").spec.node_name
+        )
+        if done == 8 and s.waiting == 0:
+            break
+        time.sleep(0.02)
+    for g in ("ga", "gb"):
+        members_bound = [
+            bool(raw.get("Pod", "default", f"{g}-{i}").spec.node_name)
+            for i in range(4)
+        ]
+        assert all(members_bound), (g, members_bound)  # all-or-none: ALL
+        assert raw.get("PodGroup", "default", g).phase == \
+            v1.POD_GROUP_SCHEDULED
+    assert all(c == 1 for c in bind_counts.values()), bind_counts
+    assert len(bind_counts) == 8
+    injected = fault.injected_counts()
+    assert sum(injected.values()) > 0  # the storm actually fired
+
+
 # --- FaultSchedule ------------------------------------------------------------
 
 

@@ -1,0 +1,454 @@
+"""Gang scheduling subsystem: PodGroup API, coscheduling plugin, solver
+all-or-nothing mask, queue group cohesion, CLI, and the end-to-end
+starved-gang acceptance scenario."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import roundtrips, to_manifest
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.gang import (
+    POD_GROUP_LABEL,
+    SLICE_LABEL,
+    GangDirectory,
+    gang_all_or_nothing,
+)
+from kubernetes_tpu.queueing import PriorityQueue
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_group(store, name, min_member, timeout=30, created=1000.0,
+               ns="default"):
+    pg = v1.PodGroup(
+        metadata=v1.ObjectMeta(name=name, namespace=ns),
+        min_member=min_member, schedule_timeout_seconds=timeout,
+    )
+    pg.metadata.creation_timestamp = created
+    store.create("PodGroup", pg)
+    return pg
+
+
+def gang_pod(group, i, cpu="3", created=None):
+    p = (make_pod().name(f"{group}-{i}").uid(f"{group}-{i}")
+         .namespace("default").label(POD_GROUP_LABEL, group)
+         .req({"cpu": cpu}).obj())
+    if created is not None:
+        p.metadata.creation_timestamp = created
+    return p
+
+
+# --- L0: API object, scheme, serialization -----------------------------------
+
+
+def test_podgroup_scheme_decode_and_roundtrip():
+    scheme = default_scheme()
+    manifest = {
+        "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+        "kind": "PodGroup",
+        "metadata": {"name": "g1", "namespace": "ml"},
+        "spec": {"minMember": 8, "scheduleTimeoutSeconds": 120},
+        "status": {"phase": "Pending"},
+    }
+    pg = scheme.decode(manifest)
+    assert pg.min_member == 8
+    assert pg.schedule_timeout_seconds == 120
+    assert pg.phase == v1.POD_GROUP_PENDING
+    assert pg.namespace == "ml"
+    # camelCase round-trip through to_manifest → decode
+    assert roundtrips(pg, scheme)
+    wire = to_manifest(pg, scheme)
+    assert wire["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    assert wire["spec"]["minMember"] == 8
+    assert wire["status"]["phase"] == "Pending"
+
+
+def test_podgroup_wrong_group_rejected():
+    from kubernetes_tpu.api.scheme import SchemeError
+
+    with pytest.raises(SchemeError):
+        default_scheme().decode(
+            {"apiVersion": "apps/v1", "kind": "PodGroup",
+             "metadata": {"name": "g"}})
+
+
+# --- solver: device all-or-nothing mask --------------------------------------
+
+
+def test_gang_all_or_nothing_masks_incomplete_gangs():
+    # gang 0 fully placed, gang 1 has one miss, solos untouched
+    node_row = np.array([3, 5, 7, -1, 2, -1], dtype=np.int32)
+    gang_seg = np.array([0, 0, 1, 1, -1, -1], dtype=np.int32)
+    out = np.asarray(gang_all_or_nothing(node_row, gang_seg))
+    assert out.tolist() == [3, 5, -1, -1, 2, -1]
+
+
+def test_gang_all_or_nothing_noop_without_gangs():
+    node_row = np.array([1, -1, 4], dtype=np.int32)
+    seg = np.full(3, -1, dtype=np.int32)
+    assert np.asarray(gang_all_or_nothing(node_row, seg)).tolist() == [1, -1, 4]
+
+
+# --- queue: group-aware activate / event moves -------------------------------
+
+
+def _group_key(info):
+    name = info.pod.metadata.labels.get(POD_GROUP_LABEL)
+    return name or None
+
+
+def test_activate_moves_whole_group_out_of_backoff():
+    from kubernetes_tpu.queueing.priority_queue import QueuedPodInfo
+
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock, group_key=_group_key)
+    a = gang_pod("g", 0)
+    b = gang_pod("g", 1)
+    q.add(a)
+    q.add(b)
+    ia, ib = q.pop(), q.pop()
+    # one member to backoff (transient error), one parked unschedulable
+    q.requeue_after_error(ia)
+    q.add_unschedulable(ib)
+    assert q.pending_count() == (0, 1, 1)
+    # activating ONE member drags the whole gang to active together
+    q.activate([ia.pod])
+    assert q.pending_count() == (2, 0, 0)
+
+
+def test_event_move_drags_gang_siblings_from_backoff():
+    from kubernetes_tpu.framework.events import (
+        ActionType,
+        ClusterEvent,
+        EventResource,
+    )
+
+    clock = FakeClock()
+    q = PriorityQueue(clock=clock, group_key=_group_key)
+    a, b, solo = gang_pod("g", 0), gang_pod("g", 1), \
+        make_pod().name("solo").uid("solo").obj()
+    for p in (a, b, solo):
+        q.add(p)
+    ia, ib, isolo = q.pop(), q.pop(), q.pop()
+    q.add_unschedulable(ia)  # event-movable
+    q.requeue_after_error(ib)  # sibling stuck in backoff
+    q.add_unschedulable(isolo)  # non-member: keeps per-pod backoff gating
+    q.move_all_to_active_or_backoff(
+        ClusterEvent(EventResource.NODE, ActionType.ADD, "NodeAdd"))
+    q.flush()
+    active, backoff, unsched = q.pending_count()
+    # both gang members are ACTIVE (sibling bypassed its backoff window);
+    # the solo pod moved by its own rules (fresh failure → backoff)
+    assert active == 2 and unsched == 0
+    assert backoff == 1
+
+
+# --- directory: quorum, permit, preemption guard ------------------------------
+
+
+def test_directory_quorum_and_release():
+    clock = FakeClock()
+    store = ObjectStore()
+    d = GangDirectory(store, clock=clock)
+    from kubernetes_tpu.framework.waiting_pods import WaitingPodsMap
+
+    wp_map = WaitingPodsMap(clock=clock)
+    d.bind_runtime(wp_map)
+    make_group(store, "g", 3)
+    pods = [gang_pod("g", i) for i in range(3)]
+    for p in pods:
+        d.on_pod_event("ADDED", p, False)
+
+    # below quorum: a 2-member group rejects unresolvably
+    lone = gang_pod("tiny", 0)
+    make_group(store, "tiny", 3)
+    d.on_pod_event("ADDED", lone, False)
+    st = d.prefilter(lone)
+    assert st is not None and not st.is_success()
+    # missing PodGroup object also rejects
+    ghost = gang_pod("ghost", 0)
+    assert d.prefilter(ghost) is not None
+    # full group passes
+    assert d.prefilter(pods[0]) is None
+
+    # permit: first two wait, third releases all
+    assert d.on_permit(pods[0])[0] == "wait"
+    wp_map.add(pods[0], "Coscheduling", 30.0)
+    d.note_waiting(pods[0], "n0")
+    assert d.on_permit(pods[1])[0] == "wait"
+    wp_map.add(pods[1], "Coscheduling", 30.0)
+    d.note_waiting(pods[1], "n1")
+    # preemption guard: with 2/3 placed the last member may preempt
+    assert d.allows_preemption(pods[2])
+    assert not d.allows_preemption(lone)
+    decision, _ = d.on_permit(pods[2])
+    assert decision == "allow"
+    assert wp_map.wait_on_permit(pods[0]) is None  # released
+    assert wp_map.wait_on_permit(pods[1]) is None
+
+
+def test_directory_release_once_with_more_members_than_min():
+    """minMember is a MINIMUM: extra members past the quorum must not
+    re-count the gang attempt or regress the phase."""
+    from kubernetes_tpu.framework.waiting_pods import WaitingPodsMap
+    from kubernetes_tpu.metrics import scheduler_metrics as m
+
+    clock = FakeClock()
+    store = ObjectStore()
+    d = GangDirectory(store, clock=clock)
+    d.bind_runtime(WaitingPodsMap(clock=clock))
+    make_group(store, "g", 2)  # minMember 2, but 4 members exist
+    pods = [gang_pod("g", i) for i in range(4)]
+    for p in pods:
+        d.on_pod_event("ADDED", p, False)
+    before = m.gang_scheduling_attempts.value(("scheduled",))
+    assert d.on_permit(pods[0])[0] == "wait"
+    d.note_waiting(pods[0], "n0")
+    for p in pods[1:]:  # members 2..4 all cross the threshold
+        assert d.on_permit(p)[0] == "allow"
+        d.on_bound(p, "n0")
+    assert m.gang_scheduling_attempts.value(("scheduled",)) == before + 1
+    # phase reached Scheduled (via on_bound) and was not regressed
+    assert store.get("PodGroup", "default", "g").phase == \
+        v1.POD_GROUP_SCHEDULED
+
+
+def test_deleting_waiting_member_fails_gang_fast_and_unreserves():
+    """Deleting a member that holds its Permit wait aborts its binding
+    cycle through the unreserve chain (reserved plugin state rolls back)
+    and fails the remaining waiters immediately — no timeout burn."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=2, clock=clock, batch_wait=0)
+    for i in range(3):  # capacity for 3 of the 4 members
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    make_group(store, "g", 4, timeout=1000)
+    for i in range(4):
+        store.create("Pod", gang_pod("g", i))
+    for _ in range(6):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    # the first batch's two members hold at Permit; the second batch can
+    # place only one member, so the in-batch mask withdrew it
+    assert len(sched._waiting_binds) == 2
+    held = next(iter(sched._waiting_binds))
+    name = sched._waiting_binds[held].qi.pod.metadata.name
+    store.delete("Pod", "default", name)
+    # the held cycle is gone and the survivors failed fast (no waiters
+    # left) — well before the 1000s deadline
+    assert held not in sched._waiting_binds
+    sched.schedule_cycle()
+    assert len(sched._waiting_binds) == 0
+    assert store.get("PodGroup", "default", "g").phase == \
+        v1.POD_GROUP_UNSCHEDULABLE
+
+
+def test_directory_evicts_drained_dead_groups():
+    clock = FakeClock()
+    store = ObjectStore()
+    d = GangDirectory(store, clock=clock)
+    pg = make_group(store, "g", 2)
+    p = gang_pod("g", 0)
+    d.on_pod_event("ADDED", p, False)
+    assert d.active
+    store.delete("PodGroup", "default", "g")
+    d.on_group_event("DELETED", pg)
+    d.on_pod_event("DELETED", p, False)
+    assert not d.active  # fully drained dead group state was dropped
+
+
+# --- end-to-end: the acceptance scenario -------------------------------------
+
+
+def _build_gang_cluster(clock, n_nodes=20, batch_size=4):
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=batch_size, clock=clock,
+                         batch_wait=0)
+    for i in range(n_nodes):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": "4", "pods": "10"})
+            .label(SLICE_LABEL, f"s{i // 8}").obj(),
+        )
+    for gi, g in enumerate(["ga", "gb", "gc"]):
+        make_group(store, g, 8, timeout=30, created=1000.0 + gi)
+        for i in range(8):
+            store.create("Pod", gang_pod(g, i, created=1000.0 + gi))
+    return store, sched
+
+
+def _bound_count(store, groups=("ga", "gb", "gc")):
+    return sum(
+        1 for g in groups for i in range(8)
+        if store.get("Pod", "default", f"{g}-{i}").spec.node_name
+    )
+
+
+def test_e2e_two_gangs_bind_starved_gang_times_out():
+    """3 gangs × 8 pods on 20 single-member hosts (capacity for only two
+    FULL gangs): exactly 16 pods bind — two complete gangs, zero partial
+    placements — and the starved gang's members requeue together with the
+    PodGroup phase reflecting the timeout."""
+    clock = FakeClock()
+    store, sched = _build_gang_cluster(clock)
+    for _ in range(30):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    assert _bound_count(store) == 16
+    for g in ("ga", "gb"):
+        assert all(store.get("Pod", "default", f"{g}-{i}").spec.node_name
+                   for i in range(8))
+        assert store.get("PodGroup", "default", g).phase == \
+            v1.POD_GROUP_SCHEDULED
+    # the starved gang holds some members at Permit, binds NONE
+    assert all(not store.get("Pod", "default", f"gc-{i}").spec.node_name
+               for i in range(8))
+    assert len(sched._waiting_binds) > 0
+    # deadline fires: the whole gang rolls back and requeues TOGETHER
+    clock.advance(40.0)
+    s = sched.schedule_cycle()
+    assert len(sched._waiting_binds) == 0
+    assert s.unschedulable > 0
+    assert _bound_count(store) == 16  # still zero partial placements
+    assert store.get("PodGroup", "default", "gc").phase == \
+        v1.POD_GROUP_UNSCHEDULABLE
+    active, backoff, _ = sched.queue.pending_count()
+    assert active == 8 and backoff == 0  # atomic group requeue
+    from kubernetes_tpu.metrics import scheduler_metrics as m
+
+    assert m.gang_timeouts.value() >= 1.0
+
+
+def test_e2e_gang_packs_one_slice():
+    """A single 8-gang on sliced hosts lands entirely inside one slice
+    (the Coscheduling anchor-slice score plane)."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    for i in range(16):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": "4", "pods": "10"})
+            .label(SLICE_LABEL, f"s{i // 8}").obj(),
+        )
+    make_group(store, "g", 8)
+    for i in range(8):
+        store.create("Pod", gang_pod("g", i))
+    stats = sched.run_until_idle(backoff_wait=1.0)
+    assert stats.scheduled == 8
+    slices = set()
+    for i in range(8):
+        node = store.get("Pod", "default", f"g-{i}").spec.node_name
+        slices.add(store.get("Node", "", node).metadata.labels[SLICE_LABEL])
+    assert len(slices) == 1
+
+
+def test_quorum_reject_then_sibling_arrival_unblocks():
+    """A partial gang parks unschedulable at the PreFilter quorum gate
+    (no solver work) and schedules once the missing members appear."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    make_group(store, "g", 4)
+    for i in range(2):  # only half the gang exists
+        store.create("Pod", gang_pod("g", i))
+    s = sched.schedule_cycle()
+    assert s.unschedulable == 2 and s.scheduled == 0
+    _, _, unsched = sched.queue.pending_count()
+    assert unsched == 2
+    for i in range(2, 4):  # siblings arrive → POD ADD event requeues
+        store.create("Pod", gang_pod("g", i))
+    stats = sched.run_until_idle(backoff_wait=1.0)
+    assert stats.scheduled == 4
+    assert store.get("PodGroup", "default", "g").phase == \
+        v1.POD_GROUP_SCHEDULED
+
+
+def test_gang_never_preempts_unless_last_member():
+    """An incomplete gang's members must not evict victims (the gang may
+    never complete): low-priority victims survive a starved high-priority
+    gang."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    # fill the cluster with low-priority victims
+    for i in range(4):
+        store.create("Pod", make_pod().name(f"low-{i}").uid(f"low-{i}")
+                     .namespace("default").req({"cpu": "3"}).obj())
+    sched.run_until_idle(backoff_wait=1.0)
+    assert all(store.get("Pod", "default", f"low-{i}").spec.node_name
+               for i in range(4))
+    # an 8-member high-priority gang that can NEVER fully fit (4 hosts)
+    make_group(store, "g", 8, timeout=10)
+    for i in range(8):
+        p = gang_pod("g", i)
+        p.spec.priority = 100
+        store.create("Pod", p)
+    for _ in range(12):
+        sched.schedule_cycle()
+        clock.advance(1.0)
+    # victims untouched — no preemption happened for the doomed gang
+    assert all(store.get("Pod", "default", f"low-{i}").spec.node_name
+               for i in range(4))
+    assert _bound_count(store, groups=("g",)) == 0
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def test_cli_get_podgroups_table_and_json():
+    store = ObjectStore()
+    pg = make_group(store, "trainer", 8, timeout=120)
+    pg.phase = v1.POD_GROUP_SCHEDULING
+    store.update("PodGroup", pg)
+    k = Kubectl(store)
+    out = k.get("podgroups")
+    assert "MIN-MEMBER" in out and "PHASE" in out
+    assert "trainer" in out and "Scheduling" in out and "8" in out
+    j = json.loads(k.get_json("pg", "default", "trainer"))
+    assert j["kind"] == "PodGroup"
+    assert j["spec"]["minMember"] == 8
+    assert j["status"]["phase"] == "Scheduling"
+
+
+def test_cli_get_podgroups_over_apiserver():
+    from kubernetes_tpu.apiserver import APIServer, HTTPApiClient
+    from kubernetes_tpu.apiserver.client import HTTPStoreFacade
+
+    store = ObjectStore()
+    make_group(store, "trainer", 4)
+    api = APIServer(store).start()
+    try:
+        k = Kubectl(HTTPStoreFacade(HTTPApiClient(api.url)))
+        out = k.get("podgroups")
+        assert "trainer" in out and "Pending" in out
+        j = json.loads(k.get_json("podgroup", "default", "trainer"))
+        assert j["spec"]["minMember"] == 4
+        assert j["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+    finally:
+        api.stop()

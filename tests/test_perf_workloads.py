@@ -20,6 +20,7 @@ SMALL = {
     "PreemptionBasic": ("500Nodes", 0.02),
     "Unschedulable": ("500Nodes/200InitPods", 0.02),
     "SchedulingWithMixedChurn": ("1000Nodes", 0.01),
+    "GangBasic": ("64Nodes", 0.5),
 }
 
 
@@ -39,6 +40,17 @@ def test_suite_runs_and_collects_metrics(suite):
         assert thr > 0
     else:
         assert thr > 0
+
+
+def test_gang_basic_collects_gang_metrics():
+    w = build_workload("GangBasic", "64Nodes", scale=0.5)
+    w.batch_size = 8
+    items = run_workload(w)
+    by_metric = {i.labels["Metric"]: i for i in items}
+    gangs = by_metric["GangThroughput"].data
+    assert gangs["Gangs"] >= 1  # at least one full slice assembled
+    ttfs = by_metric["TimeToFullSlice"].data
+    assert ttfs["Max"] >= ttfs["Perc50"] >= 0.0
 
 
 def test_all_reference_sizes_listed():

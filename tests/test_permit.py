@@ -31,6 +31,66 @@ def test_waiting_pods_allow_and_timeout():
     assert "timed out" in wp.wait_on_permit(pod)
 
 
+def test_waiting_pods_timeout_expiry_is_clock_driven():
+    """Deadlines live entirely on the injected clock: no expiry until the
+    fake clock crosses the deadline, rejection exactly at/after it."""
+    clock = FakeClock()
+    wp = WaitingPodsMap(clock=clock)
+    pod = make_pod().name("p").uid("p").obj()
+    wp.add(pod, "gate", timeout=10.0)
+    assert wp.next_deadline() == 10.0
+    clock.advance(9.999)
+    assert "still waiting" in wp.wait_on_permit(pod)  # not yet
+    assert wp.get("p") is not None  # entry survives a still-waiting poll
+    clock.advance(0.001)  # exactly at the deadline → rejected
+    reason = wp.wait_on_permit(pod)
+    assert reason is not None and "timed out" in reason
+    assert wp.get("p") is None  # rejected entries are removed
+    assert wp.next_deadline() is None
+
+
+def test_waiting_pods_multi_plugin_pending_semantics():
+    """Several Permit plugins may Wait on one pod: every one must allow
+    before the pod proceeds; ANY expiry rejects; a reject wins over a
+    later allow."""
+    clock = FakeClock()
+    wp = WaitingPodsMap(clock=clock)
+    pod = make_pod().name("p").uid("p").obj()
+    wp.add(pod, "gate-a", timeout=10.0)
+    wp.add(pod, "gate-b", timeout=100.0)
+    assert wp.next_deadline() == 10.0  # earliest of the two
+    wp.get("p").allow("gate-a")
+    reason = wp.wait_on_permit(pod)
+    assert "gate-b" in reason and "gate-a" not in reason  # one remains
+    # the SHORTER (already-allowed) deadline passing must not reject:
+    # only gate-b's own deadline matters now
+    clock.advance(50.0)
+    assert "still waiting" in wp.wait_on_permit(pod)
+    wp.get("p").allow("gate-b")
+    assert wp.wait_on_permit(pod) is None  # all allowed → released
+
+    # rejection beats a later allow
+    wp.add(pod, "gate-a", timeout=10.0)
+    wp.add(pod, "gate-b", timeout=10.0)
+    wp.get("p").reject("gate-a", "quota")
+    wp.get("p").allow("gate-b")
+    reason = wp.wait_on_permit(pod)
+    assert "gate-a" in reason and "quota" in reason
+
+
+def test_waiting_pods_one_plugin_expiry_rejects_whole_wait():
+    """Mixed deadlines: the earliest pending plugin's expiry rejects the
+    pod even though another plugin's wait is still live."""
+    clock = FakeClock()
+    wp = WaitingPodsMap(clock=clock)
+    pod = make_pod().name("p").uid("p").obj()
+    wp.add(pod, "fast", timeout=5.0)
+    wp.add(pod, "slow", timeout=500.0)
+    clock.advance(6.0)
+    reason = wp.wait_on_permit(pod)
+    assert "fast" in reason and "timed out" in reason
+
+
 class GatePlugin(Plugin):
     name = "Gate"
 

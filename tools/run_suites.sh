@@ -13,6 +13,11 @@ FAILED=0
 # tests/test_static_analysis.py) — a failure here is conclusive in seconds,
 # so don't burn hours of 5k-node suites on a known-bad tree
 python tools/analyze.py --check > /dev/null || { echo "FAILED: static analysis gate" >> suites_run.log; exit 1; }
+# gang-subsystem gate: the coscheduling battery (all-or-nothing, Permit
+# holds, timeout requeue, CLI) is cheap and conclusive — fail fast before
+# the expensive suites, same rationale as the analyzer gate above
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_gang.py tests/test_permit.py -q \
+  || { echo "FAILED: gang test gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -36,6 +41,7 @@ run SchedulingPreferredPodAffinity 5000Nodes
 run Unschedulable 5000Nodes/200InitPods
 run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
+run GangBasic 5000Nodes
 run SchedulingExtender 500Nodes
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
