@@ -153,6 +153,10 @@ def main():
                 "count": int(compiles["Count"]),
                 "seconds": compiles["Seconds"],
             },
+            # measured-window wall per scheduler phase (host_prepare /
+            # partition / dispatch / fetch / bind / snapshot / compile) —
+            # makes a suite win or regression attributable to ITS phase
+            "phase_wall_s": data.get("PhaseWallBreakdown", {}),
             "wall_s": round(wall, 1),
             "baseline_note": (
                 "vs_baseline = mean per-pod algorithm time of the in-repo "

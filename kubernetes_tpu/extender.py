@@ -18,6 +18,7 @@ Two halves:
 from __future__ import annotations
 
 import json
+import select
 import socket
 import threading
 import time
@@ -208,6 +209,17 @@ def _read_chunked(rfile) -> Optional[bytes]:
             return None
 
 
+def _conn_stale(sock) -> bool:
+    """True when an idle pooled HTTP connection is unusable: a readable
+    idle socket means the peer closed it (EOF queued) or desynced the
+    stream (unsolicited bytes) — either way a request on it is wasted."""
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+    except (OSError, ValueError):
+        return True  # closed/invalid fd
+    return bool(readable)
+
+
 class HTTPExtender:
     def __init__(self, cfg: ExtenderConfig, clock=time.monotonic):
         self.cfg = cfg
@@ -381,6 +393,14 @@ class HTTPExtender:
         ).encode()
         with self._pool_lock:
             conn = self._pool.pop() if self._pool else None
+        if conn is not None and _conn_stale(conn[0]):
+            # the server idled this keep-alive socket out (EOF already
+            # queued) or left stray bytes: a zero-timeout readability probe
+            # detects it for free, saving the wasted send + the one safe
+            # resend the reset path would burn
+            conn[1].close()
+            conn[0].close()
+            conn = None
         fresh = conn is None
         if fresh:
             conn = self._fresh_conn()

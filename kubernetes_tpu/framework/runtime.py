@@ -30,12 +30,24 @@ class AssignResult(NamedTuple):
     node_row: jnp.ndarray  # i32[B] assigned node row, -1 = unschedulable
     feasible_count: jnp.ndarray  # i32[B] number of feasible nodes seen
     dyn: DynamicState  # final dynamic state after all assignments
+    # engine rounds executed (scan steps for greedy_assign, auction rounds
+    # for batch_assign) — feeds scheduler_assignment_rounds_total.  Plain-int
+    # default (NOT a module-level device array: a concrete jax.Array captured
+    # as a jit closure constant poisons host syncs — see plugins BIG note)
+    rounds: object = 0
 
 
 class PrevBatch(NamedTuple):
     """Deep-pipeline carry: the still-in-flight previous batch's identity +
     device-resident decisions, consumed by the next batch's fused program
-    (apply_prev_delta for resources, plugin chain_prev hooks for tables)."""
+    (apply_prev_delta for resources, plugin chain_prev hooks for tables).
+
+    The four (anti)affinity term groups are carried ONLY when the
+    dispatching batch itself has affinity content (so plain workloads never
+    trace the affinity chain work; the pytree structure — groups present vs
+    None — selects the compiled variant).  They let InterPodAffinity chain
+    the prev batch's OWN terms (symmetric block/score effects) in addition
+    to the label-side matches the arrays above already enable."""
 
     rows: jnp.ndarray  # i32[B0] node row per prev pod (-1 = none; device)
     req: jnp.ndarray  # i32[B0, R]
@@ -44,6 +56,10 @@ class PrevBatch(NamedTuple):
     label_keys: jnp.ndarray  # i32[B0, PL]
     label_vals: jnp.ndarray  # i32[B0, PL]
     ns: jnp.ndarray  # i32[B0]
+    req_affinity: object = None  # AffinityTermGroup | None (all four together)
+    req_anti_affinity: object = None
+    pref_affinity: object = None
+    pref_anti_affinity: object = None
 
 
 class CouplingFlags(NamedTuple):
@@ -51,21 +67,34 @@ class CouplingFlags(NamedTuple):
 
     reads[b] — pod b's filter/score planes read cross-pod tables that other
         batch commits write (own topology-spread constraints or pod
-        (anti)affinity terms): such a pod may only commit when no earlier
-        commit happened in its round, so it always sees exact greedy state.
+        (anti)affinity terms): such a pod may only commit when it is its
+        COMPONENT's first active pod, so it always sees exact greedy state
+        relative to its component.
     solo[b]  — pod b has REQUIRED anti-affinity terms; its commit writes the
-        existing-anti-affinity table every other pod's filter reads
-        (interpodaffinity/filtering.go:44-55), so the commit prefix stops
-        right after it.
+        existing-anti-affinity block plane its component-mates' filters read
+        (interpodaffinity/filtering.go:44-55), so its commit closes its
+        component for the rest of the round.
+    comp[b]  — interaction-component id (framework/conflict.py): pods in
+        different components provably never read each other's table writes,
+        so they commit in the same parallel round.  None → conservative
+        single-component fallback inside batch_assign.
+    multi[b] — pod shares its component with ≥1 other batch pod.
     """
 
     reads: jnp.ndarray  # bool[B]
     solo: jnp.ndarray  # bool[B]
+    comp: object = None  # i32[B] | None
+    multi: object = None  # bool[B] | None
 
 
-def coupling_flags(batch) -> CouplingFlags:
-    """Derive CouplingFlags from a compiled PodBatch (host-side, numpy)."""
+def coupling_flags(batch, namespace_labels=None, info=None) -> CouplingFlags:
+    """Derive CouplingFlags from a compiled PodBatch (host-side, numpy),
+    including the conflict partition over the batch's real pods.  Callers
+    that already ran ``conflict_components`` (the scheduler times it as its
+    own phase) pass the result via ``info``."""
     import numpy as np
+
+    from .conflict import conflict_components
 
     reads = (
         batch.tsc_valid.any(axis=1)
@@ -75,9 +104,22 @@ def coupling_flags(batch) -> CouplingFlags:
         | batch.pref_anti_affinity.valid.any(axis=1)
     )
     solo = batch.req_anti_affinity.valid.any(axis=1)
-    return CouplingFlags(
-        reads=np.asarray(reads, dtype=bool), solo=np.asarray(solo, dtype=bool)
-    )
+    reads = np.asarray(reads, dtype=bool)
+    solo = np.asarray(solo, dtype=bool)
+    if info is None:
+        pods = getattr(batch, "pods", None) or []
+        if not pods and bool(reads.any() or solo.any()):
+            # a coupled batch whose pod objects are unavailable (e.g. a
+            # pytree round-trip dropped the skip=("pods",) aux) cannot be
+            # partitioned — return the CONSERVATIVE comp=None form, which
+            # batch_assign treats as one all-multi component, never the
+            # unsound all-singleton no-coupling partition
+            return CouplingFlags(reads=reads, solo=solo)
+        info = conflict_components(
+            pods, batch.size, namespace_labels=namespace_labels,
+        )
+    return CouplingFlags(reads=reads, solo=solo, comp=info.comp,
+                         multi=info.multi)
 
 
 class BatchedFramework:
@@ -371,10 +413,11 @@ class BatchedFramework:
             feasible_count = feasible_count.at[out["i"]].set(out["feasible_n"])
             return (k + 1, dyn, dauxes, node_row, feasible_count)
 
-        _, dyn, _, node_row, feasible_count = jax.lax.while_loop(
+        k_final, dyn, _, node_row, feasible_count = jax.lax.while_loop(
             cond, body, (jnp.int32(0), dyn, dyn_auxes, node_row0, feasible0)
         )
-        return AssignResult(node_row=node_row, feasible_count=feasible_count, dyn=dyn)
+        return AssignResult(node_row=node_row, feasible_count=feasible_count,
+                            dyn=dyn, rounds=k_final)
 
     def _apply_dynamic(self, dyn, dauxes, dyn_plugins, i, node_row, batch, snap):
         req = batch.request[i]
@@ -400,44 +443,57 @@ class BatchedFramework:
         The serialized assume loop the reference runs one pod at a time
         (pkg/scheduler/scheduler.go:496,571) becomes rounds of ONE dense
         ``[B, N]`` filter+score program — the MXU-friendly shape — followed by
-        an O(B) prefix-commit scan:
+        a CONFLICT-PARTITIONED auction (components from
+        framework/conflict.py via CouplingFlags.comp):
 
           round: ONE dense program computes every unresolved pod's
-          feasibility mask and score plane under the committed state; then an
-          O(B) auction scan walks the pod order, each pod bidding for its
-          BEST STILL-UNUSED feasible node by its own plane:
+          feasibility mask and score plane under the committed state; then
+          pods bid for their BEST STILL-UNUSED feasible node:
             (a) at most one pod per node per round — node-local filters
                 (Fit, NodePorts, volumes…) checked against the round-start
                 state stay valid under the final state; a pod whose feasible
                 nodes are all taken skips and re-bids next round;
-            (b) a pod with cross-pod reads (CouplingFlags.reads) commits only
-                when nothing committed before it this round — and then the
-                unused-set is empty, so it takes its true argmax under exact
-                greedy state; otherwise it waits;
-            (c) a required-anti-affinity pod (CouplingFlags.solo) ends the
-                round, since its commit rewrites the existing-anti-affinity
-                table every later filter row would need.
+            (b) a READER (own cross-pod constraints, CouplingFlags.reads) in
+                a multi-pod component commits only as its component's FIRST
+                ACTIVE pod in order, with its true argmax — every earlier
+                component member resolved in a previous round, so its plane
+                is exact greedy state relative to its component.  Readers in
+                SINGLETON components (nobody in the batch writes their
+                tables) bid in parallel like plain pods — the partitioner's
+                win over the old whole-round serialization;
+            (c) a required-anti-affinity commit (CouplingFlags.solo) closes
+                its COMPONENT for the round (its block-plane write is only
+                read by component-mates), not the whole batch.
 
-        Progress: the first unresolved pod in order always commits or is
-        marked unschedulable each round, so at most B rounds run; an
-        uncoupled batch usually resolves in ONE round (ranked choices stand
-        in for the score updates that spread pods in the serial loop).
+        Progress: the globally first active pod always commits or resolves
+        each round, so at most B rounds run; serialization cost is bounded
+        by the largest component, not the batch.
 
         Parity contract (SURVEY §7.6): on conflict-free batches (pairwise
         distinct argmaxes, no cross-pod reads) the result is identical to
-        greedy_assign.  Under contention placements remain filter-valid under
-        the final committed state, but score-derived choices may differ from
-        the serial order: the one-pod-per-node-per-round rule approximates
-        the spreading that LeastAllocated-style scoring produces serially and
-        intentionally diverges from bin-packing (MostAllocated) stacking —
-        configure assign_mode="scan" for exact serial semantics there.
-        Heavily coupled batches should use the scan (see TPUScheduler's
-        dispatch heuristic).
+        greedy_assign; a single component spanning the whole batch commits
+        one pod per round against fresh dense planes — also identical to the
+        scan.  Across components placements remain filter-valid under the
+        final committed state, but score-derived choices may diverge from
+        the serial order exactly as for plain contended pods — configure
+        assign_mode="scan" for exact serial semantics.  Batches dominated by
+        ONE giant component should use the scan (the TPUScheduler router
+        compares the largest component against its threshold).
         """
         b = batch.valid.shape[0]
         batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
         reads = jnp.asarray(coupling.reads)
         solo = jnp.asarray(coupling.solo)
+        if coupling.comp is None:
+            # conservative fallback: all pods share one component and count
+            # as multi — every reader serializes, solo closes the round for
+            # everyone (the pre-partitioner behavior)
+            comp = jnp.zeros(b, jnp.int32)
+            multi = jnp.ones(b, bool)
+        else:
+            comp = jnp.asarray(coupling.comp, jnp.int32)
+            multi = jnp.asarray(coupling.multi, bool)
+        reader = reads & multi
         order = order.astype(jnp.int32)
 
         # static planes once, as in greedy_assign's fast path
@@ -485,15 +541,22 @@ class BatchedFramework:
         pos_of = jnp.zeros(b, jnp.int32).at[order].set(jnp.arange(b, dtype=jnp.int32))
 
         def auction_commits(active, feasible, mask, scores):
-            """Parallel propose/resolve auction → (commit, choice, unsched).
+            """Conflict-partitioned propose/resolve auction →
+            (commit, choice, unsched).
 
-            Every non-reader bids for its best still-unused feasible node;
-            contested nodes go to the earliest pod in `order`; losers re-bid.
-            Earliest-position-wins makes the fixpoint identical to the serial
-            best-unused walk (serial dictatorship), but each sub-round is a
-            handful of [B, N] vector ops instead of B sequential steps.
-            Readers commit only as the FIRST active pod of a round (exact
-            state); a solo commit ends the round."""
+            In every multi-pod component, the first ACTIVE pod in `order`
+            (the component HEAD) is the only reader allowed to commit this
+            round — an infeasible reader head resolves unschedulable, and a
+            feasible SOLO head closes its component for the round.  Heads
+            then bid in the SAME parallel loop as every other eligible pod
+            (non-readers, singleton-component constraint carriers): each
+            bids for its best still-unused feasible node, contested nodes go
+            to the earliest pod in `order`, losers re-bid among unused
+            nodes.  A head losing a node to ANOTHER component therefore
+            diverts to its next-best unused node within the round — its
+            within-component state is still exact (no component-mate
+            committed this round); the diversion is the same accepted
+            cross-component divergence plain contended pods have."""
             eff = jnp.where(mask, scores, -jnp.inf)
             if tie_noise is not None:
                 eff = jnp.where(mask, eff + tie_noise, -jnp.inf)
@@ -501,26 +564,38 @@ class BatchedFramework:
             nom_ok = (batch.nominated_row >= 0) & mask[jnp.arange(b), nom]
             cols = jnp.arange(n_cap)
 
-            # --- first active pod: the only slot a reader may commit in ------
-            act_pos = jnp.where(active, pos_of, b)
-            first_pos = jnp.min(act_pos)
-            any_active = first_pos < b
-            first_pod = order[jnp.clip(first_pos, 0, b - 1)]
-            first_is_reader = any_active & reads[first_pod]
-            f_row = eff[first_pod]
-            f_choice = jnp.argmax(f_row).astype(jnp.int32)
-            f_choice = jnp.where(nom_ok[first_pod], nom[first_pod], f_choice)
-            f_commit = first_is_reader & feasible[first_pod]
-            f_unsched = first_is_reader & ~feasible[first_pod]
-            round_open = ~(f_commit & solo[first_pod])
+            # --- component heads: the only slot a reader may commit in -------
+            act_pos = jnp.where(active & multi, pos_of, b)
+            # segment-min of active positions per component id (ids ∈ [0, B))
+            comp_oh = comp[:, None] == jnp.arange(b)[None, :]  # [B, C]
+            minpos_c = jnp.min(
+                jnp.where(comp_oh, act_pos[:, None], b), axis=0
+            )  # [C]
+            is_head = active & multi & (pos_of == minpos_c[comp])
+            head_reader = is_head & reader
+            head_unsched = head_reader & ~feasible
+            # rule (c), per component: a SOLO head that will commit this
+            # round rewrites its component-mates' block planes, so the mates
+            # sit the round out (the head itself still bids).  Pessimistic
+            # when the head ends up not committing — that only defers the
+            # mates one round, never invalidates a placement.
+            closed_c = jnp.max(
+                jnp.where(comp_oh, (head_reader & feasible & solo)[:, None],
+                          False), axis=0
+            )  # [C]
+            comp_closed = multi & closed_c[comp] & ~is_head
 
-            # --- parallel phase: all active non-readers -----------------------
-            unresolved0 = active & ~reads & feasible & round_open
-            used0 = (cols == f_choice) & f_commit
-            commit0 = jnp.zeros(b, bool).at[first_pod].set(f_commit)
-            choice0 = jnp.zeros(b, jnp.int32).at[first_pod].set(
-                jnp.where(f_commit, f_choice, 0)
-            )
+            # --- parallel phase: all eligible bidders at once — non-readers
+            # (incl. singleton-component constraint carriers) plus component
+            # HEADS.  A head bids like everyone else and may divert to its
+            # best UNUSED node when another component claims its argmax:
+            # within its component the state is still exact (no mate
+            # committed this round); cross-component diversion is the same
+            # accepted divergence plain contended pods already have.
+            unresolved0 = active & feasible & (~reader | is_head) & ~comp_closed
+            commit0 = jnp.zeros(b, bool)
+            choice0 = jnp.zeros(b, jnp.int32)
+            used0 = jnp.zeros(n_cap, bool)
 
             def pcond(c):
                 unresolved, _, _, _ = c
@@ -550,11 +625,9 @@ class BatchedFramework:
                 pcond, pbody, (unresolved0, used0, commit0, choice0)
             )
             # non-readers that are infeasible resolve as unschedulable any
-            # round (their filters only shrink); readers only at first slot
-            # with exact state
-            unsched = (active & ~reads & ~feasible) | (
-                jnp.zeros(b, bool).at[first_pod].set(f_unsched)
-            )
+            # round (their filters only shrink); readers only as component
+            # heads with exact state
+            unsched = (active & ~reader & ~feasible) | head_unsched
             return commit, choice, unsched
 
         def apply_commits(dyn, dauxes, commit, choice):
@@ -639,8 +712,9 @@ class BatchedFramework:
             jnp.zeros(b, jnp.int32),
             jnp.asarray(0, jnp.int32),
         )
-        dyn, _, assigned, _, _, feas_n, _ = jax.lax.while_loop(cond, body, init)
-        return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn)
+        dyn, _, assigned, _, _, feas_n, rounds = jax.lax.while_loop(cond, body, init)
+        return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn,
+                            rounds=rounds)
 
     def apply_commits(self, batch, snap, dyn, auxes, commit, choice):
         """Apply a set of simultaneous placements (commit bool[B], choice

@@ -87,6 +87,24 @@ gang_timeouts = default_registry.register(
             "Gangs whose Permit wait expired before all members placed")
 )
 
+# --- hybrid assignment engine (framework/conflict.py + batch_assign) ---------
+
+assignment_rounds = default_registry.register(
+    # labels: (engine,) — "batch" (conflict-partitioned auction rounds) |
+    # "scan" (greedy lax.scan steps) | "extender" (host round walk).
+    # Incremented per completed dispatch with the engine's actual round
+    # count (fetched packed with the decisions — zero extra device rounds).
+    Counter("scheduler_assignment_rounds_total",
+            "Assignment-engine rounds executed, by engine")
+)
+coupled_component_size = default_registry.register(
+    # observed at partition time for every multi-pod conflict component —
+    # the auction's serialization is bounded by the largest of these
+    Histogram("scheduler_coupled_component_size",
+              [1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
+              "Sizes of multi-pod pod-interaction components per batch")
+)
+
 scheduler_retries = default_registry.register(
     # labels: (reason,) — "cycle_error" (whole-batch dispatch failure
     # requeued) | "bind_error" (per-pod binding-cycle fault requeued)

@@ -190,32 +190,38 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     else:
                         warm_keys.append((warm.metadata.namespace,
                                           warm.metadata.name))
-                # …and two pods from the SUITE'S OWN template: its label /
+                # …and pods from the SUITE'S OWN template: its label /
                 # constraint shapes can differ from the synthetic warmups'
                 # sticky caps, and the first template batch would otherwise
                 # compile (or cache-load, seconds) its program variant
-                # inside the measured window
-                # THREE template warms, each one dispatch: #0 takes the
-                # full-upload path as a side effect of first-seen topology
-                # key registration (the suite template's spread/affinity
-                # keys resize encoder tables), #1 rides the steady
-                # row-SCATTER path — the variant every in-window cycle
-                # runs (with only two warms, #1's forced full upload left
-                # the scatter variant to cold-compile mid-window: measured
-                # 24.8s p99 in the TopologySpreading artifact pass), and
-                # #2 explicitly warms the FULL-UPLOAD variant (a dirty
-                # burst past the scatter bucket — a batch's binds + churn
-                # events, a preemption victim storm — takes it mid-window).
-                for wi in range(3):
-                    warm = tmpl(9_990_000 + wi)
-                    # warm pods must be NON-DISRUPTIVE: a high-priority suite
-                    # template (PreemptionBasic) would otherwise preempt init
-                    # pods that are never restored, corrupting the measured
-                    # window's declared initial state.  preemptionPolicy is
-                    # data, not shape — the program variant warms identically.
-                    warm.spec.preemption_policy = "Never"
-                    warm_keys.append((warm.metadata.namespace, warm.metadata.name))
-                    store.create("Pod", warm)
+                # inside the measured window.
+                # FOUR template warms covering the {coupled-batch engine} ×
+                # {upload path} variant matrix: #0/#1 dispatch TWO template
+                # pods — a 2-pod batch of a coupled template (anti/affinity/
+                # spread) forms a multi-pod conflict component and routes to
+                # the SCAN engine exactly like the window's full batches
+                # (1-pod warms route singleton components to the batch
+                # engine since the round-6 partitioner, leaving the scan
+                # variant to cold-compile mid-window — measured one in-window
+                # compile collapsing the scaled anti suite); #2/#3 dispatch
+                # ONE pod (the batch-engine variant window TAIL batches may
+                # take).  #0 additionally takes the full-upload path via
+                # first-seen topology-key registration, #1 rides the steady
+                # row-SCATTER path, #2 forces FULL-UPLOAD (dirty bursts past
+                # the scatter bucket take it mid-window), #3 scatter again.
+                for wi in range(4):
+                    for j in range(2 if wi < 2 else 1):
+                        warm = tmpl(9_990_000 + 2 * wi + j)
+                        # warm pods must be NON-DISRUPTIVE: a high-priority
+                        # suite template (PreemptionBasic) would otherwise
+                        # preempt init pods that are never restored,
+                        # corrupting the measured window's declared initial
+                        # state.  preemptionPolicy is data, not shape — the
+                        # program variant warms identically.
+                        warm.spec.preemption_policy = "Never"
+                        warm_keys.append((warm.metadata.namespace,
+                                          warm.metadata.name))
+                        store.create("Pod", warm)
                     if wi == 2:
                         sched.encoder.force_full_next()
                     sched.schedule_cycle()
@@ -299,6 +305,10 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                                     gang_done_t.append(clock() - t0)
 
                 unwatch = store.watch(on_bind)
+                # per-phase wall snapshot (scheduler.phase_wall): the window
+                # delta attributes suite time to host_prepare / partition /
+                # dispatch / fetch / bind so a regression names its phase
+                phase0 = dict(sched.phase_wall)
                 t0 = clock()
                 t_last_progress = t0
                 cycle = 0
@@ -483,6 +493,14 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     },
                     unit="compiles",
                 ))
+                items.append(DataItem(
+                    labels={"Name": w.name, "Metric": "PhaseWallBreakdown"},
+                    data={
+                        k: round(sched.phase_wall[k] - phase0.get(k, 0.0), 4)
+                        for k in sched.phase_wall
+                    },
+                    unit="s",
+                ))
             elif not op.skip_wait:
                 sched.run_until_idle()
         elif op.opcode == "barrier":
@@ -495,6 +513,7 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
             sched.run_until_idle()
         else:
             raise ValueError(f"unknown opcode {op.opcode}")
+    sched.close()  # release the store watch + extender callout pool
     if ext_cleanup is not None:
         ext_cleanup()
     return items

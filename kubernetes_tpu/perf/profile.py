@@ -19,15 +19,30 @@ import sys
 
 
 def profile_workload(suite: str, size: str, scale: float, top: int = 40) -> str:
+    import json
+
     from .harness import run_workload
     from .workloads import build_workload
 
     w = build_workload(suite, size, scale=scale)
     prof = cProfile.Profile()
     prof.enable()
-    run_workload(w)
+    items = run_workload(w)
     prof.disable()
     out = io.StringIO()
+    # per-phase wall breakdown first (also emitted in the bench JSON via
+    # the PhaseWallBreakdown data item): the cProfile table says which
+    # FUNCTIONS are hot, this says which scheduler PHASE the window spent
+    # its wall on — host_prepare / partition / dispatch / fetch / bind
+    phase = next(
+        (i.data for i in items
+         if i.labels.get("Metric") == "PhaseWallBreakdown"), None)
+    if phase is not None:
+        total = sum(phase.values()) or 1.0
+        out.write("Per-phase wall over the measured window (s):\n")
+        for k, v in sorted(phase.items(), key=lambda kv: -kv[1]):
+            out.write(f"  {k:<14}{v:>9.3f}  ({100 * v / total:5.1f}%)\n")
+        out.write(json.dumps({"phase_wall_s": phase}) + "\n\n")
     stats = pstats.Stats(prof, stream=out)
     stats.sort_stats("cumulative").print_stats(top)
     return out.getvalue()

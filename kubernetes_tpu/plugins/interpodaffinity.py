@@ -12,13 +12,16 @@ Reference: pkg/scheduler/framework/plugins/interpodaffinity/
   scoring.go:255+     — NormalizeScore: 100·(s−min)/(max−min)
 
 Device design: the *incoming* batch's term groups are compiled arrays, so the
-incoming-vs-existing maps are matmuls + domain scatter-adds; the sparse
+incoming-vs-existing maps are matmuls + domain scatter-adds; the
 *existing-pods'-own-terms* contributions (exist-anti blocks, symmetric score
-terms) are precomputed host-side over HavePodsWith(Required)AffinityList —
-mirroring exactly which pods the reference walks (scoring.go:149-159).
-In-scan, cross-match tensors between pending pods update the tables/planes in
-O(B·N) per placement — the device analog of preFilterState.updateWithPod
-(filtering.go:74-85).
+terms) live in the INCREMENTAL device-resident group index
+(state/affinity_index.py — maintained by deltas at encoder-sync time, the
+round-6 replacement for the per-cycle host rebuild walk over
+HavePodsWith(Required)AffinityList) and expand to [B, N] planes on device in
+prepare().  In-scan, cross-match tensors between pending pods update the
+tables/planes in O(B·N) per placement — the device analog of
+preFilterState.updateWithPod (filtering.go:74-85); chain_prev extends the
+same updates across still-in-flight batches for the deep pipeline.
 """
 
 from __future__ import annotations
@@ -26,51 +29,16 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
-import numpy as np
 
-from ..api.labels import affinity_term_matches
 from ..framework.events import ActionType, ClusterEvent, EventResource
 from ..ops import domain_gather, domain_scatter_add, point_scatter_add
+from ..ops.segment import domain_gather_backend
 from ..framework.interface import MAX_NODE_SCORE, Plugin
+from ..state.affinity_index import KIND_BLOCK, KIND_SCORE_REQ
 from ..state.dictionary import MISSING
 from .helpers import flat_selector_matrix
 
 DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1  # apis/config InterPodAffinityArgs default
-
-
-def _pow2_g(x: int) -> int:
-    """Smallest pow2 ≥ max(x, 1) (signature-group capacity)."""
-    g = 1
-    while g < max(x, 1):
-        g *= 2
-    return g
-
-
-def _selector_signature(sel) -> tuple:
-    """Hashable identity of a LabelSelector's match semantics."""
-    if sel is None:
-        return None
-    return (
-        tuple(sorted(sel.match_labels.items())),
-        tuple(
-            (e.key, e.operator, tuple(e.values)) for e in sel.match_expressions
-        ),
-    )
-
-
-def _term_signature(term, owner_ns: str) -> tuple:
-    """Two terms with equal signatures match exactly the same target pods
-    (affinity_term_matches semantics: namespaces list, namespaceSelector, the
-    owner-namespace default when both are unset, and the label selector)."""
-    if term.namespaces:
-        ns_key = ("list", tuple(sorted(term.namespaces)))
-        if term.namespace_selector is not None:
-            ns_key = ns_key + ("sel", _selector_signature(term.namespace_selector))
-    elif term.namespace_selector is not None:
-        ns_key = ("sel", _selector_signature(term.namespace_selector))
-    else:
-        ns_key = ("owner", owner_ns)
-    return (term.topology_key, ns_key, _selector_signature(term.label_selector))
 
 
 class IPAAux(NamedTuple):
@@ -156,108 +124,25 @@ class InterPodAffinityPlugin(Plugin):
     # --- host precompute ------------------------------------------------------
 
     def host_prepare(self, batch, snapshot, encoder, namespace_labels=None):
-        """Existing pods' own (anti)affinity terms → static block/score planes.
+        """Existing pods' own (anti)affinity terms → the per-batch match
+        matrix against the encoder's INCREMENTAL affinity-group index.
 
-        Walks only HavePodsWithRequiredAntiAffinityList / HavePodsWithAffinityList
-        (sparse), like the reference — but DEDUPLICATED by term signature:
-        identical terms (selector + namespaces + topology key + weight; the
-        common case is a workload's replicas all carrying the same term) are
-        matched against the batch ONCE, and their owners' topology-domain
-        values aggregate into one count table per signature.  The naive walk
-        was O(scheduled_pods × batch) Python selector matches per cycle —
-        the measured host bottleneck of the 5k-node anti-affinity suite,
-        growing as the run scheduled more pods (178→336ms/cycle profiled at
-        3k nodes).
-        """
-        b = batch.size
-        n = encoder._n
-        node_topo = encoder.node_topo
-
-        # sig → [representative term, representative owner pod, topo slot,
-        #        {domain val → owner-term count}]
-        groups: dict = {}
-
-        def collect(pi, term, kind, weight):
-            slot = encoder.topo_slot(term.topology_key)
-            row = encoder.node_rows.get(pi.pod.spec.node_name)
-            if row is None:
-                return
-            val = int(node_topo[row, slot])
-            if val == MISSING:
-                return
-            sig = (kind, weight, _term_signature(term, pi.pod.namespace))
-            g = groups.get(sig)
-            if g is None:
-                groups[sig] = g = [term, pi.pod, slot, {}]
-            g[3][val] = g[3].get(val, 0) + 1
-
-        for info in snapshot.have_pods_with_required_anti_affinity_list:
-            for pi in info.pods_with_required_anti_affinity:
-                for term in pi.required_anti_affinity_terms:
-                    collect(pi, term, "block", 0.0)
-        for info in snapshot.have_pods_with_affinity_list:
-            for pi in info.pods_with_affinity:
-                if self.hard_weight > 0:
-                    for term in pi.required_affinity_terms:
-                        collect(pi, term, "score", self.hard_weight)
-                for wt in pi.preferred_affinity_terms:
-                    collect(pi, wt.pod_affinity_term, "score", float(wt.weight))
-                for wt in pi.preferred_anti_affinity_terms:
-                    collect(pi, wt.pod_affinity_term, "score", -float(wt.weight))
-
-        if not groups:
-            # nothing in the cluster interacts with this batch — skip the
-            # [B, N] bool + f32 uploads; prepare() makes traced zeros instead
-            return None
-
-        # COMPACT upload form: per-signature (batch-match row, node plane)
-        # factor pairs instead of dense [B, N] planes.  The dense block +
-        # score planes are ~5MB/cycle at 5k nodes, and the host→device
-        # tunnel flush of that upload (~15MB/s effective) dominated the
-        # anti-affinity cycle; the factored form is G×(B+N) ≈ tens of KB
-        # and expands on device in prepare() (one einsum).
-        blk_rows: list = []  # (match[B] bool, plane[N] bool)
-        sc_rows: list = []  # (match[B] bool, plane[N] f32)
-        for (kind, weight, _s), (term, owner, slot, val_counts) in groups.items():
-            matched = np.zeros(b, dtype=bool)
-            for i, pod in enumerate(batch.pods):
-                if affinity_term_matches(term, owner, pod, namespace_labels):
-                    matched[i] = True
-            if not matched.any():
-                continue
-            node_vals = node_topo[:, slot]  # [N]
-            if kind == "block":
-                nmask = np.isin(
-                    node_vals, np.fromiter(val_counts, dtype=np.int64)
-                )
-                blk_rows.append((matched, nmask))
-            else:
-                # per-node owner count under this signature's key, via LUT
-                lut = np.zeros(int(node_vals.max(initial=0)) + 2, np.float32)
-                for v, c in val_counts.items():
-                    if 0 <= v < lut.size:
-                        lut[v] = c
-                per_node = lut[np.clip(node_vals, 0, lut.size - 1)]
-                per_node = np.where(node_vals == MISSING, 0.0, per_node)
-                sc_rows.append((matched, weight * per_node))
-        if not blk_rows and not sc_rows:
-            return None
-        # sticky pow2 caps so signature-count churn doesn't recompile
-        gb = max(_pow2_g(len(blk_rows)), getattr(self, "_gb_cap", 2))
-        gs = max(_pow2_g(len(sc_rows)), getattr(self, "_gs_cap", 2))
-        self._gb_cap, self._gs_cap = gb, gs
-        blk_match = np.zeros((gb, b), dtype=bool)
-        blk_plane = np.zeros((gb, n), dtype=bool)
-        for g, (mrow, prow) in enumerate(blk_rows):
-            blk_match[g], blk_plane[g] = mrow, prow
-        sc_match = np.zeros((gs, b), dtype=bool)
-        sc_plane = np.zeros((gs, n), dtype=np.float32)
-        for g, (mrow, prow) in enumerate(sc_rows):
-            sc_match[g], sc_plane[g] = mrow, prow
-        return {
-            "blk_match": blk_match, "blk_plane": blk_plane,
-            "sc_match": sc_match, "sc_plane": sc_plane,
-        }
+        The per-cycle rebuild walk over HavePodsWith(Required)AffinityList
+        (the measured host bottleneck of the 5k-node anti-affinity suite,
+        178→336ms/cycle at 3k nodes and growing with cluster fill) moved to
+        ``state/affinity_index.AffinityIndex``: contributions are applied
+        once per pod state change at encoder-sync time (assume/forget/bind/
+        node-delete), and the per-signature count tables are device-resident
+        (DeviceSnapshot.aff_*) via the fused row-scatter upload.  Host work
+        here is only the [live-groups × batch] match matrix, memoized per
+        pod identity — O(batch delta) for templated workloads.  A full
+        rebuild survives as the resync/repair path (AffinityIndex.rebuild).
+        The index is hardPodAffinityWeight-FREE: required-affinity score
+        groups store weight 1.0 and prepare() multiplies by THIS plugin's
+        weight at expansion (a trace-time constant), so profiles configured
+        with different weights share the one index without rebuild thrash."""
+        return encoder.aff.match_batch(batch.pods, batch.size,
+                                       namespace_labels)
 
     # --- device prepare -------------------------------------------------------
 
@@ -381,19 +266,44 @@ class InterPodAffinityPlugin(Plugin):
             exist_anti_block = jnp.zeros((b, n), bool)
             score_static = jnp.zeros((b, n), jnp.float32)
         else:
-            # expand the factored per-signature planes (host_prepare) on
-            # device: [G, B] × [G, N] → [B, N]; the dense planes never ride
-            # the host→device link
-            exist_anti_block = jnp.einsum(
-                "gb,gn->bn",
-                jnp.asarray(host_aux["blk_match"], jnp.float32),
-                jnp.asarray(host_aux["blk_plane"], jnp.float32),
-            ) > 0.5
-            score_static = jnp.einsum(
-                "gb,gn->bn",
-                jnp.asarray(host_aux["sc_match"], jnp.float32),
-                jnp.asarray(host_aux["sc_plane"], jnp.float32),
+            # Expand the DEVICE-RESIDENT incremental group tables
+            # (DeviceSnapshot.aff_*, maintained by scatter deltas at
+            # assume/forget/node-delete time — state/affinity_index.py) into
+            # the [B, N] block/score planes: per-group per-node owner counts
+            # via one domain gather over the group's topology slot, then one
+            # einsum against the host-computed [G, B] batch-match matrix.
+            # Neither the count tables nor the dense planes ride the
+            # host→device link per cycle.
+            m = jnp.asarray(host_aux["match"])  # bool[G, B]
+            k_cap = snap.node_topo.shape[1]
+            slot = jnp.clip(snap.aff_slot, 0, k_cap - 1)
+            dom_g = jnp.transpose(snap.node_topo[:, slot])  # [G, N]
+            has = (dom_g != MISSING) & snap.aff_valid[:, None] \
+                & (snap.aff_slot >= 0)[:, None]
+            # domains at or past the table width have no recorded owners by
+            # construction (the index grows the width before counting one) —
+            # they must read 0, not alias into a clipped slot
+            dwidth = snap.aff_counts.shape[1]
+            has = has & (dom_g < dwidth)
+            cnt = domain_gather_backend(
+                snap.aff_counts,
+                jnp.where(has, jnp.clip(dom_g, 0, dwidth - 1), 0),
             )
+            cnt = jnp.where(has, cnt, 0.0)  # f32[G, N] owner counts
+            mb = (m & (snap.aff_kind == KIND_BLOCK)[:, None]).astype(jnp.float32)
+            exist_anti_block = jnp.einsum(
+                "gb,gn->bn", mb, (cnt > 0.5).astype(jnp.float32)
+            ) > 0.5
+            # score rows: preferred groups carry their own signed weight;
+            # required-affinity groups are stored weight-free and take THIS
+            # plugin's hardPodAffinityWeight here (a trace-time constant, so
+            # per-profile weights share one index)
+            w = jnp.where(snap.aff_kind == KIND_SCORE_REQ,
+                          jnp.float32(self.hard_weight), snap.aff_weight)
+            ms = (m & (snap.aff_kind != KIND_BLOCK)[:, None]).astype(
+                jnp.float32
+            ) * w[:, None]
+            score_static = jnp.einsum("gb,gn->bn", ms, cnt)
         return IPAAux(
             dom_aff=dom_aff, dom_anti=dom_anti, dom_paff=dom_paff, dom_panti=dom_panti,
             aff_cnt=aff_cnt, anti_cnt=anti_cnt,
@@ -601,6 +511,141 @@ class InterPodAffinityPlugin(Plugin):
             aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
             block_dyn=block_dyn, paff_cnt=paff_cnt, panti_cnt=panti_cnt,
             score_dyn=score_dyn,
+        )
+
+    # --- deep-pipeline cross-batch chaining -----------------------------------
+
+    def chain_prev(self, aux: IPAAux, batch, snap, prev):
+        """Fold a still-in-flight previous batch's placements into this
+        batch's affinity state, exactly as if those pods were already in the
+        snapshot — the device analog of what the next encoder sync + the
+        incremental affinity index will record once the prev batch's assume
+        lands.  This is what lets affinity-carrying batches ride the DEEP
+        pipeline (pre-round-6 they forced depth 1, the documented root cause
+        of the coupled-suite gap).
+
+        Two halves, mirroring update_batch with the prev batch in the
+        committed role:
+          (i)  this batch's four term groups vs the prev batch's pod labels
+               (PrevBatch.label_keys/label_vals/ns) bump this batch's count
+               tables at the domain of each placed prev pod's node;
+          (ii) the prev batch's OWN terms (PrevBatch.req_affinity …, carried
+               only when the dispatching batch has affinity content — see
+               TPUScheduler._dispatch_batch) block/score this batch's
+               matching pods over the prev terms' topology domains, using
+               RAW topology values (no domain bucketing, so chained batches
+               with different ipa_domain_buckets stay exact).
+        A no-op bundle (all rows -1) leaves every table unchanged, so
+        shallow and deep cycles share one compiled program per variant."""
+        if aux is None:
+            return None
+        # Static gate on the GROUP-CARRYING pytree variant: the scheduler
+        # attaches term groups to every carry slot (real or zeroed) exactly
+        # when affinity chaining is on AND the batch has affinity content.
+        # Group-free carries mean nothing affinity-relevant can be in
+        # flight, and tracing part (i)'s [B,T,N,D] scatter one-hots against
+        # guaranteed-noop slots cost a measured ~0.27s/cycle on the CPU
+        # backend's scaled preferred-affinity suite.
+        if prev.req_anti_affinity is None:
+            return aux
+        d = self._d(batch)
+        use_planes = self._use_planes(batch, snap)
+        n = snap.num_nodes
+        num = snap.numeric
+        placed = (prev.rows >= 0) & jnp.asarray(prev.valid)  # [B0]
+        rows = jnp.clip(prev.rows, 0, n - 1)
+        u = (
+            (rows[:, None] == jnp.arange(n)[None, :]) & placed[:, None]
+        ).astype(jnp.float32)  # [B0, N] placement one-hot (zero row = unplaced)
+
+        def count_inc(cross, dom):
+            """cross [B, T, B0] (this batch's term (b,t) vs prev pod j) →
+            count bump in the active representation + table mass, exactly
+            update_batch's count_inc with the prev placement one-hot."""
+            contrib = jnp.einsum("btj,jn->btn", cross.astype(jnp.float32), u)
+            tbl = domain_scatter_add(contrib, dom, d + 1)
+            tbl = tbl * (jnp.arange(d + 1) < d)
+            inc = domain_gather(tbl, dom) if use_planes else tbl
+            return inc, jnp.sum(tbl, axis=(1, 2))
+
+        aff_cnt, aff_total = aux.aff_cnt, aux.aff_total
+        if self._present(batch, "req_affinity"):
+            g = batch.req_affinity
+            gv = jnp.asarray(g.valid)
+            m = self._match_vs(g, prev.label_keys, prev.label_vals, prev.ns, num)
+            has_terms = jnp.any(gv, axis=1)
+            x_all = jnp.all(m | ~gv[:, :, None], axis=1) & has_terms[:, None]
+            inc, mass = count_inc(x_all[:, None, :] & gv[:, :, None], aux.dom_aff)
+            aff_cnt = aff_cnt + inc.astype(jnp.int32)
+            aff_total = aff_total + mass.astype(jnp.int32)
+        anti_cnt = aux.anti_cnt
+        if self._present(batch, "req_anti_affinity"):
+            m = self._match_vs(batch.req_anti_affinity, prev.label_keys,
+                               prev.label_vals, prev.ns, num)
+            anti_cnt = anti_cnt + count_inc(m, aux.dom_anti)[0].astype(jnp.int32)
+        paff_cnt = aux.paff_cnt
+        if self._present(batch, "pref_affinity"):
+            m = self._match_vs(batch.pref_affinity, prev.label_keys,
+                               prev.label_vals, prev.ns, num)
+            paff_cnt = paff_cnt + count_inc(m, aux.dom_paff)[0].astype(jnp.int32)
+        panti_cnt = aux.panti_cnt
+        if self._present(batch, "pref_anti_affinity"):
+            m = self._match_vs(batch.pref_anti_affinity, prev.label_keys,
+                               prev.label_vals, prev.ns, num)
+            panti_cnt = panti_cnt + count_inc(m, aux.dom_panti)[0].astype(jnp.int32)
+
+        # part (ii): the prev batch's OWN terms (the top gate guarantees the
+        # groups are present from here on)
+        k_cap = snap.node_topo.shape[1]
+
+        def own_terms(pgroup):
+            """(mm [B0, T, B1], same [B0, T, N]) for one PREV group:
+            which of this batch's pods each prev term matches, and which
+            nodes share the prev pod's placed-node topology value under
+            that term's key (raw values — bucket-free)."""
+            pv = jnp.asarray(pgroup.valid)
+            mm = self._match_vs(pgroup, batch.label_keys,
+                                batch.label_vals, batch.ns, num)
+            key = jnp.clip(pgroup.topo_key, 0, k_cap - 1)
+            domp = jnp.transpose(snap.node_topo[:, key], (1, 2, 0))
+            hasp = (domp != MISSING) & pv[:, :, None]  # [B0, T, N]
+            domp_f = jnp.where(hasp, domp, 0).astype(jnp.float32)
+            dom_at = jnp.einsum("jtn,jn->jt", domp_f, u)
+            has_at = jnp.einsum(
+                "jtn,jn->jt", hasp.astype(jnp.float32), u) > 0.5
+            same = hasp & has_at[:, :, None] & (
+                domp_f == dom_at[:, :, None])
+            return mm, same
+
+        mm, same = own_terms(prev.req_anti_affinity)
+        block_dyn = aux.block_dyn | (jnp.einsum(
+            "jtb,jtn->bn", mm.astype(jnp.float32),
+            same.astype(jnp.float32)) > 0.5)
+
+        def own_score(pgroup, weights):
+            mm, same = own_terms(pgroup)
+            return jnp.einsum(
+                "jtb,jtn->bn",
+                mm.astype(jnp.float32) * weights[:, :, None],
+                same.astype(jnp.float32),
+            )
+
+        score_dyn = aux.score_dyn
+        if self.hard_weight > 0:
+            w1 = jnp.full(
+                jnp.asarray(prev.req_affinity.valid).shape,
+                self.hard_weight, jnp.float32)
+            score_dyn = score_dyn + own_score(prev.req_affinity, w1)
+        score_dyn = score_dyn + own_score(
+            prev.pref_affinity, jnp.asarray(prev.pref_affinity.weight))
+        score_dyn = score_dyn - own_score(
+            prev.pref_anti_affinity,
+            jnp.asarray(prev.pref_anti_affinity.weight))
+
+        return aux._replace(
+            aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
+            paff_cnt=paff_cnt, panti_cnt=panti_cnt,
+            block_dyn=block_dyn, score_dyn=score_dyn,
         )
 
     def update_batch(self, aux: IPAAux, commit, choice, u, batch, snap):

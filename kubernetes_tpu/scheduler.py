@@ -130,12 +130,14 @@ def _unpack_diag(bits: np.ndarray, n_filters: int) -> np.ndarray:
 
 def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     """True when any pod carries state the deep pipeline cannot chain
-    between batches: pod (anti)affinity tables built from the snapshot's
-    scheduled-pod arrays (which lack a still-in-flight batch), host-port
-    sets and volume bindings live in host-side structures updated at
-    assume/bind time.  Topology-spread tables ARE chained (chain_prev), so
-    spread pods stay deep.  Resource requests, node selectors/affinity,
-    taints and images chain exactly.
+    between batches: host-port sets and volume bindings live in host-side
+    structures updated at assume/bind time.  Topology-spread tables chain
+    via the plugins' chain_prev hooks, and — since round 6 — pod
+    (anti)affinity state does too (InterPodAffinityPlugin.chain_prev folds
+    in-flight placements into the count tables AND carries the in-flight
+    batch's own terms for the symmetric block/score effects), so the
+    coupled-affinity suites no longer force depth 1.  Resource requests,
+    node selectors/affinity, taints and images chain exactly.
 
     Preemption-CAPABLE pods (priority > 0, policy not Never) also block
     WHEN LIKELY TO PREEMPT: beyond the victim-visibility problem (in-flight
@@ -160,15 +162,14 @@ def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
 def _pod_blocks_static(p: v1.Pod) -> bool:
     """The statically non-chainable constraints, shared by _pods_block_deep
     and TPUScheduler._infos_block_deep so the two predicates cannot drift:
-    pod (anti)affinity tables, host ports, volumes.  Topology-spread
+    host ports and volumes.  Topology-spread AND pod-(anti)affinity
     constraints are CHAINABLE (the fused program folds in-flight placements
-    into this batch's count tables via PodTopologySpreadPlugin.chain_prev)."""
+    into this batch's tables via the plugins' chain_prev hooks); an
+    affinity-carrying in-flight batch additionally requires the NEXT batch
+    to have affinity content — gated in schedule_cycle, not here."""
     from .gang import POD_GROUP_LABEL
     from .state.node_info import _pod_host_ports
 
-    aff = p.spec.affinity
-    if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
-        return True
     if _pod_host_ports(p):
         return True
     if getattr(p.spec, "volumes", None):
@@ -178,6 +179,20 @@ def _pod_blocks_static(p: v1.Pod) -> bool:
     if POD_GROUP_LABEL in p.metadata.labels:
         return True
     return False
+
+
+def _pod_has_affinity(p: v1.Pod) -> bool:
+    """Any ACTUAL pod-(anti)affinity term present — must agree exactly with
+    PodBatch.has_affinity (derived from valid term rows, group_present): a
+    present-but-EMPTY affinity stanza compiles zero terms, and a mismatch
+    here would admit an anti-affinity prev batch to the chain tail while
+    _dispatch_batch ships a group-free carry (silently dropping its terms)."""
+    aff = p.spec.affinity
+    if aff is None:
+        return False
+    pa, paa = aff.pod_affinity, aff.pod_anti_affinity
+    return bool(pa and (pa.required or pa.preferred)) or bool(
+        paa and (paa.required or paa.preferred))
 
 
 @dataclass
@@ -234,6 +249,14 @@ class _InFlight:
     # candidate mask (the lazy bind-phase call must see the SAME pod set
     # the record's dsnap was built from, not a later sync's)
     cand_levels: object = None
+    # batch carries pod-(anti)affinity terms: chainable only under a batch
+    # that also builds an InterPodAffinity aux (see schedule_cycle's gate)
+    has_aff: bool = False
+    # assignment engine this batch ran ("batch" | "scan" | "extender") and
+    # the engine round count fetched with the decisions — feeds
+    # scheduler_assignment_rounds_total at bind time
+    engine: str = "batch"
+    rounds_np: object = None
 
 
 class TPUScheduler:
@@ -256,6 +279,7 @@ class TPUScheduler:
         serialize_extender_callouts: str = "auto",
         pipeline_depth: int = 3,
         nominated_fast_bind: bool = True,
+        chain_affinity: object = "auto",
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -279,9 +303,25 @@ class TPUScheduler:
         if not 1 <= pipeline_depth <= 3:
             raise ValueError(f"pipeline_depth must be 1..3, got {pipeline_depth}")
         self.pipeline_depth = pipeline_depth
+        # Deep-chain (anti)affinity batches (InterPodAffinityPlugin.chain_prev
+        # + PrevBatch term-group carry)?  The chain's cross-batch einsums are
+        # near-free MXU work on an accelerator but REAL added compute on the
+        # CPU backend, where there is no dispatch latency to hide — measured
+        # on a 1-core CPU container: the scaled anti suite LOST ~2× chained.
+        # "auto" = chain whenever the backend isn't plain CPU; parity tests
+        # force True so the accelerator path stays proven either way.
+        if chain_affinity == "auto":
+            chain_affinity = jax.default_backend() != "cpu"
+        self.chain_affinity = bool(chain_affinity)
         # per-profile EMA of the batch failure fraction — drives the
         # speculative candidate-mask dispatch (see _dispatch_batch)
         self._fail_ema: Dict[str, float] = {}
+        # per-phase wall accumulators, snapshotted by the perf harness per
+        # measured window so suite regressions are attributable to a phase
+        # (host_prepare / partition / dispatch / fetch / bind / …)
+        self.phase_wall: Dict[str, float] = {
+            k: 0.0 for k in ("snapshot", "compile", "host_prepare",
+                             "partition", "dispatch", "fetch", "bind")}
         # batch-formation hysteresis: when the active queue holds less than
         # half a batch but a backoff wave (e.g. 256 preemptors nominated
         # together) expires within this window, wait for it — the wave then
@@ -518,9 +558,18 @@ class TPUScheduler:
         )
         # fixed scatter buckets: steady cycles fit in 256 rows per group;
         # larger bursts (preemption victim storms) overflow to the full
-        # upload inside to_device_deferred instead of growing the bucket
-        self.encoder._scatter_bucket.setdefault("node_valid", max(256, _pow2(self.batch_size, 32)))
-        self.encoder._scatter_bucket.setdefault("pod_valid", max(256, _pow2(2 * self.batch_size, 32)))
+        # upload inside to_device_deferred instead of growing the bucket.
+        # Sized from the LIVE extent, not a 5k-cluster constant: a small
+        # cluster's bucket is capped at its own tier (and node tiers ≤1024
+        # skip scatter entirely — encoding._SMALL_NODE_TIER — so a 500-node
+        # run never pays 5000-node dispatch overhead or scatter machinery).
+        self.encoder._scatter_bucket.setdefault(
+            "node_valid",
+            min(_pow2(n_nodes, 32), max(256, _pow2(self.batch_size, 32))))
+        self.encoder._scatter_bucket.setdefault(
+            "pod_valid",
+            min(_pow2(max(n_pods, 1), 32),
+                max(256, _pow2(2 * self.batch_size, 32))))
 
     # --- framework / jit management ------------------------------------------
 
@@ -588,7 +637,7 @@ class TPUScheduler:
 
         n_filters = len(fw.filter_names)
 
-        def diagnostics(batch, dsnap, dyn, auxes, node_row):
+        def diagnostics(batch, dsnap, dyn, auxes, node_row, rounds):
             # FitError diagnosis bits in the SAME program (XLA CSEs the
             # filter planes) — the eager fallback paid a ~100ms pacing round
             # per plugin per batch.  The preemption candidate mask
@@ -609,7 +658,12 @@ class TPUScheduler:
                     << jnp.arange(n_filters, dtype=jnp.int32)[None, :],
                     axis=1,
                 )
-                return jnp.stack([node_row.astype(jnp.int32), packed_bits])
+                # row 2: the engine's round count, broadcast — rides the
+                # same one-round fetch so assignment_rounds_total costs no
+                # extra device→host trip
+                rrow = jnp.full_like(packed_bits, jnp.asarray(rounds, jnp.int32))
+                return jnp.stack(
+                    [node_row.astype(jnp.int32), packed_bits, rrow])
             return bits  # >31 filter plugins: unpacked legacy shape
 
         # gang all-or-nothing: a segment-sum pass over per-pod gang ids
@@ -632,7 +686,7 @@ class TPUScheduler:
             res = res._replace(
                 node_row=gang_all_or_nothing(res.node_row, gang_seg))
             return res, auxes, dsnap, dyn, diagnostics(
-                batch, dsnap, dyn, auxes, res.node_row)
+                batch, dsnap, dyn, auxes, res.node_row, res.rounds)
 
         def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prevs,
                         host_auxes, order, gang_seg, coupling, key):
@@ -647,7 +701,7 @@ class TPUScheduler:
             res = res._replace(
                 node_row=gang_all_or_nothing(res.node_row, gang_seg))
             return res, auxes, dsnap, dyn, diagnostics(
-                batch, dsnap, dyn, auxes, res.node_row)
+                batch, dsnap, dyn, auxes, res.node_row, res.rounds)
 
         def cand_mask(batch, dsnap, dyn, auxes, levels):
             static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
@@ -731,8 +785,12 @@ class TPUScheduler:
         if infos and self.gangs.active:
             infos = self._gang_prefilter(infos, stats)
         next_interacts = self._infos_block_deep(infos) if infos else True
+        # an affinity-carrying in-flight batch can only be chained under a
+        # batch that will itself build an InterPodAffinity aux (otherwise
+        # the prev batch's anti/score terms would have no tables to land in)
+        next_has_aff = any(_pod_has_affinity(qi.pod) for qi in infos)
         # Deep chain tail: the newest run of in-flight batches this dispatch
-        # can chain on device (each must be constraint-free and predate no
+        # can chain on device (each must be chainable and predate no
         # node delete — a freed encoder row that THIS dispatch's sync reuses
         # would make the in-flight delta rows charge the wrong node).  Depth
         # D keeps up to D-1; a depth-3 steady state completes batches TWO
@@ -744,6 +802,7 @@ class TPUScheduler:
             limit = self.pipeline_depth - 1
             for fl in reversed(inflight):
                 if (tail >= limit or fl.interacts
+                        or (fl.has_aff and not next_has_aff)
                         or fl.node_del_gen != self._node_del_gen):
                     break
                 tail += 1
@@ -880,6 +939,7 @@ class TPUScheduler:
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
+        self.phase_wall["snapshot"] += self.clock() - t0
         # fast-bound nominations whose assume this refresh now carries: the
         # reservation would double-count from here on — release it.  Marks
         # from the bind phase that ran after the PREVIOUS dispatch carry
@@ -894,7 +954,9 @@ class TPUScheduler:
         # fixed padding: every cycle compiles to ONE (batch_size, tier)
         # program instead of one per pow-2 backlog size — partial batches
         # reuse the warm executable (first compile is tens of seconds)
+        t_c = self.clock()
         batch = self.compiler.compile(pods, pad_to=self.batch_size)
+        self.phase_wall["compile"] += self.clock() - t_c
         trace.step("Batch compile")
         profile = self._profile_of(infos[0].pod)  # queue groups by profile
         fw = self._framework(profile)
@@ -905,9 +967,11 @@ class TPUScheduler:
         # in-batch all-or-nothing mask
         self.gangs.stage_batch(pods)
         gang_seg = self.gangs.gang_segments(pods, batch.size)
+        t_hp = self.clock()
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
+        self.phase_wall["host_prepare"] += self.clock() - t_hp
         if self.extenders:
             # round-based cycles: each pod's decision lands at its own
             # round, so per-attempt latency must not absorb later pods'
@@ -932,11 +996,14 @@ class TPUScheduler:
                     np.zeros(batch.size, dtype=bool),
                     np.zeros(batch.size, dtype=np.int32),
                 )
-            node_row, algo_lat = self._assign_with_extenders(
+            t_d = self.clock()
+            node_row, algo_lat, ext_rounds = self._assign_with_extenders(
                 fw, jt, batch, dsnap, dyn, auxes, pods, t0, packed0=packed0
             )
+            self.phase_wall["dispatch"] += self.clock() - t_d
             fl = _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
-                           t0, cycle, profile=profile, fw=fw)
+                           t0, cycle, profile=profile, fw=fw,
+                           engine="extender", rounds_np=ext_rounds)
             fl.name_of = dict(self.encoder.row_to_name())
             return fl
         dsnap, upd = self.encoder.to_device_deferred()
@@ -945,30 +1012,52 @@ class TPUScheduler:
         if prevs:
             from .framework.runtime import PrevBatch
 
+            # the four term groups ride the carry only when THIS batch has
+            # affinity content (it then surely builds an IPA aux to chain
+            # into; plain workloads keep the group-free pytree variant)
+            def _groups_of(pb):
+                if not (batch.has_affinity and self.chain_affinity):
+                    return {}
+                return {
+                    name: getattr(pb, name)
+                    for name in ("req_affinity", "req_anti_affinity",
+                                 "pref_affinity", "pref_anti_affinity")
+                }
+
             deltas = [
                 PrevBatch(
                     rows=p.node_row_dev, req=p.batch.request,
                     nz=p.batch.non_zero, valid=p.batch.valid,
                     label_keys=p.batch.label_keys,
                     label_vals=p.batch.label_vals, ns=p.batch.ns,
+                    **_groups_of(p.batch),
                 )
                 for p in prevs
             ]
-        res, auxes, dsnap_out, dyn_out, diag = self._run_assignment(
+        t_d = self.clock()
+        part0 = self.phase_wall["partition"]
+        (res, auxes, dsnap_out, dyn_out, diag), engine = self._run_assignment(
             jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes,
             deltas=deltas, gang_seg=gang_seg,
         )
+        # dispatch wall excludes the partition slice timed inside
+        self.phase_wall["dispatch"] += (self.clock() - t_d) - (
+            self.phase_wall["partition"] - part0)
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
         trace.log_if_long(0.1)
         fl = _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
-                       None, t0, cycle, profile=profile, fw=fw, diag_dev=diag)
+                       None, t0, cycle, profile=profile, fw=fw, diag_dev=diag,
+                       engine=engine, has_aff=bool(batch.has_affinity))
         # Row→name capture at DISPATCH (not complete): a deep-pipelined
         # batch is completed only after the NEXT dispatch's encoder.sync,
         # which may reuse rows of nodes deleted in between — resolving
         # through the live map then would bind to the wrong node.
         fl.name_of = dict(self.encoder.row_to_name())
-        fl.interacts = interacts if interacts is not None else _pods_block_deep(pods)
+        fl.interacts = interacts if interacts is not None else (
+            _pods_block_deep(pods)
+            or (not self.chain_affinity
+                and any(_pod_has_affinity(p) for p in pods)))
         fl.node_del_gen = self._node_del_gen
         fl.chained = bool(prevs)
         # Speculative candidate mask: when this profile's recent cycles were
@@ -1008,14 +1097,16 @@ class TPUScheduler:
             # ~0.1ms, so the thread's GIL footprint stays negligible.
             try:
                 if packed_mode and diag_dev is not None:
-                    # packed [2, B] i32 (node_row; diagnosis bitmask):
-                    # decisions + diagnosis land in ONE device→host round
+                    # packed [3, B] i32 (node_row; diagnosis bitmask; engine
+                    # rounds): decisions + diagnosis + the rounds metric
+                    # land in ONE device→host round
                     if hasattr(diag_dev, "is_ready"):
                         while not diag_dev.is_ready():
                             time.sleep(0.004)
                     packed = np.asarray(diag_dev)
                     rec.fetched = packed[0]
                     rec.diag_np = _unpack_diag(packed[1], n_filters)
+                    rec.rounds_np = int(packed[2, 0])
                     rec.fetched_at = clk()
                     if rec.cand_dev is not None:
                         try:  # speculative cand mask: land it off-path too,
@@ -1047,6 +1138,7 @@ class TPUScheduler:
                 elif packed_mode:
                     raw = np.asarray(diag_dev)
                     rec.diag_np = _unpack_diag(raw[1], n_filters)
+                    rec.rounds_np = int(raw[2, 0])
                 else:
                     rec.diag_np = np.asarray(diag_dev)
             except Exception:
@@ -1066,6 +1158,7 @@ class TPUScheduler:
         # (Round 3's copy_to_host_async + is_ready polling measured 100-200ms
         # SLOWER than a plain blocking fetch on the current backend —
         # tools/bench_cycle.py — so the fallback is the simple one.)
+        t_f = self.clock()
         if fl.fetch_thread is not None:
             fl.fetch_thread.join()
         if fl.fetched is not None:
@@ -1075,6 +1168,7 @@ class TPUScheduler:
             jax.block_until_ready(dev)
             node_row = np.asarray(dev)
             fl.fetched_at = self.clock()
+        self.phase_wall["fetch"] += self.clock() - t_f
         if fl.algo_lat is None:
             # decision became available when the background fetch landed,
             # not when the (possibly later) _complete joined it
@@ -1108,6 +1202,7 @@ class TPUScheduler:
         """The binding cycle for a completed batch: reserve → permit → bind
         per scheduled pod, diagnosis + preemption per unschedulable pod."""
         stats = CycleStats(attempted=len(fl.infos))
+        t_bind = self.clock()
         fw = fl.fw
         batch, dsnap, dyn, auxes = fl.batch, fl.dsnap, fl.dyn, fl.auxes
         diag_np = cand_np = min_sched_prio = None
@@ -1174,6 +1269,8 @@ class TPUScheduler:
                     nf = len(fw.filter_names)
                     diag_np = (_unpack_diag(raw[1], nf)
                                if nf <= 31 else raw)
+                    if nf <= 31 and fl.rounds_np is None:
+                        fl.rounds_np = int(raw[2, 0])
                 diag_row = None if diag_np is None else diag_np[i]
                 if diag_row is not None and bool(np.all(diag_row)) \
                         and self.gangs.is_member(qi.pod):
@@ -1318,6 +1415,11 @@ class TPUScheduler:
             if uid in self._nominated:
                 self._fastbound_noms[uid] = self._dispatch_seq
         stats.batch_seconds = self.clock() - fl.t0
+        self.phase_wall["bind"] += self.clock() - t_bind
+        # engine observability: the round count rode the packed decision
+        # fetch (row 2); the extender path counted its rounds host-side
+        if fl.rounds_np is not None:
+            m.assignment_rounds.inc((fl.engine,), by=int(fl.rounds_np))
         if stats.attempted:
             # the EMA drives the speculative candidate-mask dispatch, so it
             # must count attempts that NEEDED preemption — fast-bound pods
@@ -1451,26 +1553,44 @@ class TPUScheduler:
 
     def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req,
                         host_auxes, deltas=None, gang_seg=None):
-        """Dispatch between the parallel batch engine and the exact serial
-        scan (the parity oracle).  "auto" uses the batch engine unless too
-        much of the batch is cross-pod coupled — a mostly-anti-affinity batch
-        serializes into one commit per round there, and the row-sliced scan
-        is cheaper per step than the dense per-round recompute.
+        """Dispatch between the conflict-partitioned batch engine and the
+        exact serial scan (the parity oracle).  "auto" partitions the batch
+        into pod–pod interaction components (framework/conflict.py: affinity
+        term matches + shared spread constraints + gang membership) and uses
+        the batch engine unless ONE component dominates the batch — the
+        auction then serializes one commit per round against a dense
+        per-round recompute, where the row-sliced scan is cheaper per step.
+        Independent components and all uncoupled pods commit in parallel
+        rounds regardless of the batch's total coupled fraction (the old
+        all-or-nothing mode flip serialized those too).
 
-        ``deltas`` are the deep pipeline's in-flight-batch resource carries
+        ``deltas`` are the deep pipeline's in-flight-batch carries
         (≤2 PrevBatch, oldest first) — see apply_prev_delta; the program
         always receives exactly two slots, noop-padded, so every depth
         shares one compiled executable.
 
-        Returns (AssignResult, auxes, updated dsnap, dyn) from ONE fused
-        dispatch (snapshot scatter + nominations + prepare + assign)."""
+        Returns ((AssignResult, auxes, updated dsnap, dyn, diag), engine)
+        from ONE fused dispatch (snapshot scatter + nominations + prepare +
+        assign); ``engine`` is "batch" | "scan" for the rounds metric."""
+        from .framework.conflict import conflict_components
         from .framework.runtime import coupling_flags
 
         # slot count is fixed per scheduler config (depth-1 chained carries;
         # none in sync mode) so every cycle of an instance shares one
         # compiled executable and shallow configs pay no noop passes
         n_slots = self.pipeline_depth - 1 if self.pipeline else 0
-        noop = self._noop_delta(batch)
+        # noop carries mirror the real ones: an affinity batch's slots ALWAYS
+        # carry (possibly zeroed) term groups, so its chained and unchained
+        # cycles share ONE compiled variant — the harness's template warmups
+        # then cover the deep-chained affinity program too (a groups-only-
+        # when-chained pytree compiled on the window's first deep dispatch:
+        # measured one ~5s in-window compile collapsing the scaled anti
+        # suite 792 → 19.5 pods/s)
+        noop = self._noop_delta(
+            batch,
+            with_groups=(self.chain_affinity
+                         and bool(getattr(batch, "has_affinity", False)))
+            or any(d.req_affinity is not None for d in (deltas or [])))
         deltas = list(deltas or [])
         delta = tuple((deltas + [noop] * n_slots)[:n_slots])
         # numpy, NOT jnp.arange: an eager jnp op is its own device program,
@@ -1480,29 +1600,62 @@ class TPUScheduler:
             gang_seg = self.gangs.gang_segments([], batch.valid.shape[0])
         mode = self.assign_mode
         if mode in ("auto", "batch"):
-            coupling = coupling_flags(batch)
+            t_part = self.clock()
+            info = conflict_components(
+                batch.pods, batch.size,
+                namespace_labels=self.namespace_labels,
+            )
+            coupling = coupling_flags(batch, info=info)
+            self.phase_wall["partition"] += self.clock() - t_part
+            for s in info.sizes:
+                m.coupled_component_size.observe(s)
             n_valid = max(int(batch.valid.sum()), 1)
-            frac = float(coupling.reads[: batch.size][batch.valid].sum()) / n_valid
-            if mode == "batch" or frac <= self.coupled_fraction_threshold:
+            # serial work in the auction is bounded by the LARGEST component,
+            # so that — not the coupled fraction — is what the threshold
+            # compares; a batch that is one giant chain still takes the scan
+            if mode == "batch" or info.max_multi <= max(
+                    1, int(self.coupled_fraction_threshold * n_valid)):
                 return jt["batch"](
                     batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
                     order, gang_seg, coupling, self.rng_key,
-                )
+                ), "batch"
         return jt["greedy"](
             batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order,
             gang_seg, self.rng_key,
-        )
+        ), "scan"
 
-    def _noop_delta(self, like_batch):
+    def _noop_delta(self, like_batch, with_groups: bool = False):
         """No-op PrevBatch (all rows -1) with the SAME array shapes as a
         real one built from ``like_batch``, so shallow and deep cycles share
-        one compiled program per batch shape."""
+        one compiled program per batch shape.  ``with_groups`` zero-fills
+        the four affinity term groups too (all-invalid terms — semantically
+        inert) so a cycle mixing real affinity carries with noop padding
+        keeps ONE pytree structure instead of compiling per slot-combination."""
         from .framework.runtime import PrevBatch
 
-        key = (like_batch.request.shape, like_batch.label_keys.shape)
+        group_names = ("req_affinity", "req_anti_affinity",
+                       "pref_affinity", "pref_anti_affinity")
+        gshapes = None
+        if with_groups:
+            gshapes = tuple(
+                np.asarray(leaf).shape
+                for name in group_names
+                for leaf in jax.tree_util.tree_leaves(getattr(like_batch, name))
+            )
+        key = (like_batch.request.shape, like_batch.label_keys.shape, gshapes)
         cached = getattr(self, "_noop_prev_cache", None)
         if cached is None or cached[0] != key:
             b = like_batch.valid.shape[0]
+            groups = {}
+            if with_groups:
+                # zeroed groups are semantically inert: every term row is
+                # invalid (valid=False gates all matching)
+                groups = {
+                    name: jax.tree_util.tree_map(
+                        lambda a: np.zeros_like(np.asarray(a)),
+                        getattr(like_batch, name))
+                    for name in group_names
+                }
             cached = (key, PrevBatch(
                 rows=np.full(b, -1, dtype=np.int32),
                 req=np.zeros_like(like_batch.request),
@@ -1511,6 +1664,7 @@ class TPUScheduler:
                 label_keys=np.full_like(like_batch.label_keys, -1),
                 label_vals=np.full_like(like_batch.label_vals, -1),
                 ns=np.full(b, -1, dtype=np.int32),
+                **groups,
             ))
             self._noop_prev_cache = cached
         return cached[1]
@@ -1533,11 +1687,14 @@ class TPUScheduler:
         node-local filters checked against round-start state stay valid; a
         host-side resource ledger re-checks the fit with the round's earlier
         accepts applied, deferring pods that no longer fit to the next round;
-        a cross-pod-coupled pod (affinity/spread) commits only as the
-        round's FIRST accept — exact greedy state, as in batch_assign.
+        a cross-pod-coupled pod commits only as its CONFLICT COMPONENT's
+        first accept of the round (framework/conflict.py — pods in other
+        components never write its tables), and a required-anti-affinity
+        commit closes only its own component — exact greedy state relative
+        to the component, as in batch_assign.
 
         Returns (node_row, per-pod algorithm latency measured from t0 to the
-        pod's own round's decision)."""
+        pod's own round's decision, rounds executed)."""
         from .extender import ExtenderError
         from .framework.runtime import coupling_flags
 
@@ -1546,8 +1703,9 @@ class TPUScheduler:
         algo_lat = np.zeros(b)
         name_of = self.encoder.row_to_name()
         row_of = self.encoder.node_rows
-        _cpl = coupling_flags(batch)
+        _cpl = coupling_flags(batch, namespace_labels=self.namespace_labels)
         reads, solo = _cpl.reads, _cpl.solo
+        cpl_comp, cpl_multi = _cpl.comp, _cpl.multi
         alloc = np.asarray(dsnap.allocatable, dtype=np.float64)  # [N, R]
         requested = np.array(np.asarray(dyn.requested), dtype=np.float64)
         req_pod = np.asarray(batch.request, dtype=np.float64)  # [B, R]
@@ -1567,6 +1725,8 @@ class TPUScheduler:
             # walk's dominant term at B=512)
             claimed_mask = np.zeros(alloc.shape[0], dtype=bool)
             n_claimed = 0
+            claimed_comps: Set[int] = set()  # components with a commit this round
+            closed_comps: Set[int] = set()  # components a solo commit closed
             commit = np.zeros(b, dtype=bool)
             choice = np.zeros(b, dtype=np.int32)
             still: List[int] = []
@@ -1614,8 +1774,6 @@ class TPUScheduler:
                 except ExtenderError as e:
                     return None, None, e  # non-ignorable → pod unschedulable
 
-            from concurrent.futures import ThreadPoolExecutor
-
             # serialize_extender_callouts (see __init__): a stateful extender
             # (managedResources) must see requests in commit order, AFTER
             # earlier accepts — callouts then run lazily inside the walk
@@ -1629,20 +1787,21 @@ class TPUScheduler:
             if serialize or len(unresolved) <= 1:
                 results = {}  # filled on demand, in commit order
             else:
-                with ThreadPoolExecutor(max_workers=16) as pool:
-                    results = dict(zip(unresolved, pool.map(callout, unresolved)))
+                results = dict(zip(
+                    unresolved, self._ext_pool().map(callout, unresolved)))
 
-            round_closed = False
             for i in unresolved:
                 pod = pods[i]
-                # batch_assign rule (c): once a required-anti-affinity pod
-                # commits, its tables invalidate every later row this round
-                if round_closed:
+                # batch_assign rule (c), per component: a required-anti
+                # commit invalidates its COMPONENT-mates' later rows this
+                # round (other components never read its tables)
+                if cpl_multi[i] and int(cpl_comp[i]) in closed_comps:
                     still.append(i)
                     continue
-                # a coupled pod's row is only exact when nothing committed
-                # before it this round
-                if reads[i] and n_claimed:
+                # a reader's row is only exact when no COMPONENT-mate
+                # committed before it this round
+                if reads[i] and cpl_multi[i] \
+                        and int(cpl_comp[i]) in claimed_comps:
                     still.append(i)
                     continue
                 approved, ranked, err = (
@@ -1696,8 +1855,10 @@ class TPUScheduler:
                 algo_lat[i] = self.clock() - t0
                 m.scheduling_algorithm_duration.observe(algo_lat[i])
                 deferred_only = False
-                if solo[i]:
-                    round_closed = True  # rule (c): end the round
+                if cpl_multi[i]:
+                    claimed_comps.add(int(cpl_comp[i]))
+                    if solo[i]:
+                        closed_comps.add(int(cpl_comp[i]))  # rule (c)
             if commit.any() and still:
                 # the committed state only feeds LATER rounds; the final
                 # round's device update would be dead weight (the next
@@ -1706,14 +1867,40 @@ class TPUScheduler:
                     batch, dsnap, dyn, auxes, commit, choice
                 )
             # progress invariant: `still` non-empty implies a commit happened
-            # this round (deferral requires claims/round_closed), so the
-            # rounds loop always advances; the rounds <= b condition is the
-            # hard bound
+            # this round (deferral requires same-component claims/closure or
+            # node claims), so the rounds loop always advances; the
+            # rounds <= b condition is the hard bound
             unresolved = still
         for i in unresolved:  # pods left at the rounds bound
             algo_lat[i] = self.clock() - t0
             m.scheduling_algorithm_duration.observe(algo_lat[i])
-        return out, algo_lat
+        return out, algo_lat, rounds
+
+    def _ext_pool(self):
+        """Persistent extender-callout thread pool.  The previous per-round
+        ``with ThreadPoolExecutor(16)`` spawned and JOINED 16 threads every
+        round on the extender suite's critical path; a long-lived pool keeps
+        the workers (and their warmed keep-alive sockets in the extender's
+        connection pool) across rounds and batches.  Released by close()."""
+        pool = getattr(self, "_ext_pool_obj", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._ext_pool_obj = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="extender-callout")
+        return pool
+
+    def close(self) -> None:
+        """Release long-lived resources: the store watch and the persistent
+        extender-callout pool (its 16 workers otherwise live to interpreter
+        exit — processes that build many schedulers, e.g. the perf harness
+        or the chaos soak, must not accumulate them).  Idempotent."""
+        unwatch, self._unwatch = getattr(self, "_unwatch", None), None
+        if unwatch is not None:
+            unwatch()
+        pool, self._ext_pool_obj = getattr(self, "_ext_pool_obj", None), None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str,
                               qi: Optional[QueuedPodInfo] = None):
@@ -1873,6 +2060,8 @@ class TPUScheduler:
             p = qi.pod
             if _pod_blocks_static(p):
                 return True
+            if not self.chain_affinity and _pod_has_affinity(p):
+                return True  # chain disabled (CPU backend): stay shallow
             if (p.spec.priority or 0) > 0 and p.spec.preemption_policy != "Never":
                 # pop_batch already counted this attempt: >1 means a retry
                 if qi.attempts > 1 or qi.unschedulable_plugins:

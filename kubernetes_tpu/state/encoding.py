@@ -112,6 +112,16 @@ class DeviceSnapshot:
     pod_priority: jnp.ndarray  # i32[P]
     pod_request: jnp.ndarray  # i32[P, R]
     pod_non_zero: jnp.ndarray  # i32[P, 2]
+    # incremental existing-pod affinity groups (state/affinity_index.py):
+    # deduplicated term signatures with per-domain count tables, maintained
+    # by scatter deltas at assume/forget/bind/node-delete time — the
+    # device-resident replacement for InterPodAffinity.host_prepare's
+    # per-cycle rebuild walk
+    aff_valid: jnp.ndarray  # bool[G]
+    aff_kind: jnp.ndarray  # i32[G] (0 = anti-affinity block, 1 = score)
+    aff_weight: jnp.ndarray  # f32[G]
+    aff_slot: jnp.ndarray  # i32[G] topology-key slot (MISSING = unset row)
+    aff_counts: jnp.ndarray  # f32[G, D] owner-term count per domain value
     # dictionary numeric side-table
     numeric: jnp.ndarray  # f32[num_ids]
 
@@ -137,6 +147,7 @@ class PendingScatter:
 
     node_rows: object = None
     pod_rows: object = None
+    aff_rows: object = None
     numeric: object = None
 
 
@@ -147,8 +158,9 @@ def apply_scatter(dsnap: DeviceSnapshot, upd: Optional[PendingScatter]) -> Devic
     """Apply a PendingScatter inside a jitted program (pure, traceable)."""
     if upd is None:
         return dsnap
-    out = {k: getattr(dsnap, k) for k in _NODE_ARRAYS + _POD_ARRAYS}
-    for names, group in ((_NODE_ARRAYS, upd.node_rows), (_POD_ARRAYS, upd.pod_rows)):
+    out = {k: getattr(dsnap, k) for k in _NODE_ARRAYS + _POD_ARRAYS + _AFF_ARRAYS}
+    for names, group in ((_NODE_ARRAYS, upd.node_rows), (_POD_ARRAYS, upd.pod_rows),
+                         (_AFF_ARRAYS, upd.aff_rows)):
         if group is None:
             continue
         rows, vals = group
@@ -182,13 +194,44 @@ class ClusterEncoder:
         self._n = self.cfg.min_nodes
         self._p = self.cfg.min_pods
         self._alloc_arrays()
+        # incremental existing-pod affinity groups (see state/affinity_index)
+        from .affinity_index import AffinityIndex
+
+        self.aff = AffinityIndex(self)
         self._device: Optional[DeviceSnapshot] = None
         self._uploaded_numeric_len = -1
         self._dirty_node_rows: set = set()
         self._dirty_pod_rows: set = set()
         self._scatter_bucket: Dict[str, int] = {}
+        # affinity-group scatter rows are few (one per dirtied signature) and
+        # each carries a [D] count row — a 256 floor would upload megabytes
+        # of unchanged tables per cycle
+        self._scatter_bucket.setdefault("aff_valid", 8)
         self._numeric_min = 1024  # floor for the numeric side-table pow2 size
         self._shape_changed = True
+
+    # affinity-group arrays live on the index; exposed here so the generic
+    # array-group upload machinery (_gather_rows / to_device) reads them by
+    # name exactly like the node/pod mirrors
+    @property
+    def aff_valid(self):
+        return self.aff.aff_valid
+
+    @property
+    def aff_kind(self):
+        return self.aff.aff_kind
+
+    @property
+    def aff_weight(self):
+        return self.aff.aff_weight
+
+    @property
+    def aff_slot(self):
+        return self.aff.aff_slot
+
+    @property
+    def aff_counts(self):
+        return self.aff.aff_counts
 
     # --- allocation ---------------------------------------------------------
 
@@ -490,6 +533,7 @@ class ClusterEncoder:
     def _remove_pod_row(self, uid: str):
         row = self.pod_rows.pop(uid, None)
         self._pod_owner.pop(uid, None)
+        self.aff.remove_pod(uid)
         if row is None:
             return
         self.pod_valid[row] = False
@@ -518,6 +562,9 @@ class ClusterEncoder:
             for pi in info.pods:
                 self._encode_pod(pi.pod, row)
                 self._pod_owner[pi.pod.uid] = name
+                # incremental affinity-table delta: O(changed pods), replaces
+                # the per-cycle host_prepare walk over ALL scheduled pods
+                self.aff.set_pod(pi, row)
             self._pods_by_node[name] = list(new_uids)
 
     def full_sync(self, snapshot: Snapshot):
@@ -549,6 +596,16 @@ class ClusterEncoder:
         if getattr(self, "_force_full_once", False):
             self._force_full_once = False
             return self.to_device(force_full=True), None
+        # Small-cluster fast path: when the node tier is small (≤1024 rows) a
+        # typical batch's dirty set spans a sizeable fraction of it, so the
+        # row-scatter payload approaches the whole-buffer upload — take the
+        # precompiled full-upload path instead, which also compiles the
+        # fused cycle program WITHOUT the in-program scatter (one variant,
+        # no per-size recompiles: the decision depends only on the tier
+        # size, which presize fixes up front).  A 500-node cluster then
+        # stops paying the 5k-sized scatter-bucket dispatch overhead.
+        if self._n <= _SMALL_NODE_TIER:
+            return self.to_device(force_full=True), None
         numeric, use_scatter = self._upload_gate()
         # A dirty burst past the scatter bucket (preemption victim storms)
         # takes the FULL-upload path — already compiled — rather than
@@ -557,9 +614,11 @@ class ClusterEncoder:
         # (a 1024-row floor measured ~130ms/cycle of upload on the tunnel).
         bucket = self._scatter_bucket.get("node_valid", 256)
         pbucket = self._scatter_bucket.get("pod_valid", 256)
+        abucket = self._scatter_bucket.get("aff_valid", 8)
         force_full = (
             len(self._dirty_node_rows) > bucket
             or len(self._dirty_pod_rows) > pbucket
+            or len(self.aff.dirty) > abucket
         )
         if not use_scatter or force_full:
             # force_full bypasses to_device's own scatter gate: a burst must
@@ -575,11 +634,13 @@ class ClusterEncoder:
         upd = PendingScatter(
             node_rows=self._gather_rows(_NODE_ARRAYS, self._dirty_node_rows),
             pod_rows=self._gather_rows(_POD_ARRAYS, self._dirty_pod_rows),
+            aff_rows=self._gather_rows(_AFF_ARRAYS, self.aff.dirty),
             numeric=numeric,
         )
         self._uploaded_numeric_len = len(self.dic)
         self._dirty_node_rows.clear()
         self._dirty_pod_rows.clear()
+        self.aff.dirty.clear()
         return d, upd
 
     def _upload_gate(self):
@@ -636,13 +697,15 @@ class ClusterEncoder:
         if not use_scatter:
             put = (lambda x: jax.device_put(x, sharding)) if sharding else jnp.asarray
             self._device = DeviceSnapshot(
-                **{k: put(getattr(self, k)) for k in _NODE_ARRAYS + _POD_ARRAYS},
+                **{k: put(getattr(self, k))
+                   for k in _NODE_ARRAYS + _POD_ARRAYS + _AFF_ARRAYS},
                 numeric=jnp.asarray(numeric),
             )
         else:
             d = self._device
             upd = self._scatter_group(d, _NODE_ARRAYS, self._dirty_node_rows)
             upd.update(self._scatter_group(d, _POD_ARRAYS, self._dirty_pod_rows))
+            upd.update(self._scatter_group(d, _AFF_ARRAYS, self.aff.dirty))
             # ids interned since the last upload need a fresh numeric side-table
             # (same padded size ⇒ same shapes; the table is small)
             num = jnp.asarray(numeric) if numeric_stale else d.numeric
@@ -650,6 +713,7 @@ class ClusterEncoder:
         self._uploaded_numeric_len = len(self.dic)
         self._dirty_node_rows.clear()
         self._dirty_pod_rows.clear()
+        self.aff.dirty.clear()
         self._shape_changed = False
         return self._device
 
@@ -705,3 +769,10 @@ _POD_ARRAYS = [
     "pod_valid", "pod_node", "pod_ns", "pod_label_keys", "pod_label_vals",
     "pod_priority", "pod_request", "pod_non_zero",
 ]
+_AFF_ARRAYS = [
+    "aff_valid", "aff_kind", "aff_weight", "aff_slot", "aff_counts",
+]
+
+# node tiers at or below this take the always-full upload path in
+# to_device_deferred (see the small-cluster note there)
+_SMALL_NODE_TIER = 1024

@@ -1,0 +1,179 @@
+"""Incremental affinity-table parity (state/affinity_index.py).
+
+Contract: after ANY sequence of assume/forget/bind/delete/node-delete churn
+— including deep-pipelined in-flight batches and gang atomic withdrawal —
+the incrementally maintained per-signature count tables must equal a
+from-scratch rebuild from the snapshot BIT-FOR-BIT (rebuild() is the
+resync/repair oracle).  Also covers the device upload (DeviceSnapshot.aff_*
+mirrors the host arrays) and the hybrid host_prepare plumbing.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+def _snapshot_index_parity(sched):
+    """Assert incremental arrays == from-scratch rebuild, bit-for-bit."""
+    enc = sched.encoder
+    # refresh the snapshot view the rebuild oracle reads
+    changed = sched.cache.update_snapshot(sched.snapshot)
+    enc.sync(sched.snapshot, changed)
+    idx = enc.aff
+    inc_counts = idx.aff_counts.copy()
+    inc_totals = list(idx._row_total)
+    inc_valid = idx.aff_valid.copy()
+    inc_kind = idx.aff_kind.copy()
+    inc_slot = idx.aff_slot.copy()
+    idx.rebuild(sched.snapshot)
+    assert np.array_equal(inc_counts, idx.aff_counts), (
+        "incremental counts diverged from rebuild:\n"
+        f"inc={inc_counts[inc_valid]}\nreb={idx.aff_counts[idx.aff_valid]}")
+    assert inc_totals == idx._row_total
+    assert np.array_equal(inc_valid, idx.aff_valid)
+    assert np.array_equal(inc_kind, idx.aff_kind)
+    assert np.array_equal(inc_slot, idx.aff_slot)
+
+
+def _mixed_pod(rng, i):
+    kind = rng.integers(0, 5)
+    p = (make_pod().name(f"p{i:04d}").uid(f"p{i:04d}").namespace("default")
+         .req({"cpu": "100m"}).label("color", ["green", "blue"][i % 2]))
+    if kind == 0:
+        p = p.pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                           anti=True)
+    elif kind == 1:
+        p = p.pod_affinity("zone", {"color": "blue"})
+    elif kind == 2:
+        p = p.pod_affinity("zone", {"color": "green"}, weight=2)
+    elif kind == 3:
+        p = p.pod_affinity("kubernetes.io/hostname", {"color": "blue"},
+                           weight=5, anti=True)
+    # kind 4: plain pod
+    return p.obj()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_index_parity_under_randomized_churn(seed):
+    """Random create/schedule/delete/node-delete churn; after every wave the
+    incremental tables equal the rebuild oracle exactly."""
+    rng = np.random.default_rng(seed)
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, pipeline=True, pipeline_depth=3)
+    sched.presize(32, 128)
+    for i in range(16):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:03d}")
+            .label("kubernetes.io/hostname", f"n{i:03d}")
+            .label("zone", f"z{i % 4}")
+            .capacity({"cpu": "16", "memory": "32Gi", "pods": "110"}).obj(),
+        )
+    created = 0
+    for wave in range(6):
+        for _ in range(int(rng.integers(4, 10))):
+            store.create("Pod", _mixed_pod(rng, created))
+            created += 1
+        sched.run_until_idle(max_cycles=40)
+        _snapshot_index_parity(sched)
+        # delete a random subset of bound pods
+        pods, _ = store.list("Pod")
+        bound = [p for p in pods if p.spec.node_name]
+        for p in rng.choice(bound, size=min(3, len(bound)), replace=False):
+            store.delete("Pod", p.namespace, p.metadata.name)
+        _snapshot_index_parity(sched)
+        if wave == 3:
+            # node delete mid-run: its pods' contributions must unwind
+            store.delete("Node", "", "n003")
+        if wave == 4:
+            store.create(
+                "Node",
+                make_node().name("n103")
+                .label("kubernetes.io/hostname", "n103")
+                .label("zone", "z9")
+                .capacity({"cpu": "16", "memory": "32Gi", "pods": "110"})
+                .obj(),
+            )
+        sched.run_until_idle(max_cycles=40)
+        _snapshot_index_parity(sched)
+
+
+def test_index_parity_with_gang_withdrawal():
+    """A gang below quorum parks at PreFilter and an expired gang rolls its
+    assumes back (forget) — the index must track both directions."""
+    import kubernetes_tpu.api.objects as v1
+
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, pipeline=True)
+    sched.presize(16, 64)
+    for i in range(8):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:03d}")
+            .label("kubernetes.io/hostname", f"n{i:03d}")
+            .capacity({"cpu": "4", "memory": "8Gi", "pods": "110"}).obj(),
+        )
+    store.create("PodGroup", v1.PodGroup(
+        metadata=v1.ObjectMeta(name="pg-a", namespace="default"),
+        min_member=3, schedule_timeout_seconds=60))
+    from kubernetes_tpu.gang import POD_GROUP_LABEL
+
+    for i in range(3):
+        store.create(
+            "Pod",
+            make_pod().name(f"g{i}").uid(f"g{i}").namespace("default")
+            .label(POD_GROUP_LABEL, "pg-a").label("color", "green")
+            .pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                          anti=True)
+            .req({"cpu": "1"}).obj(),
+        )
+    sched.run_until_idle(max_cycles=30)
+    _snapshot_index_parity(sched)
+    pods, _ = store.list("Pod")
+    assert all(p.spec.node_name for p in pods), "gang should fully place"
+    # delete one member (post-bind): contributions must decrement
+    store.delete("Pod", "default", "g1")
+    sched.run_until_idle(max_cycles=10)
+    _snapshot_index_parity(sched)
+
+
+def test_device_tables_mirror_host_arrays():
+    """The uploaded DeviceSnapshot.aff_* arrays equal the host mirrors after
+    scatter-deferred cycles (the fused program applied the deltas)."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, pipeline=False)
+    sched.presize(16, 32)
+    for i in range(8):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:03d}")
+            .label("kubernetes.io/hostname", f"n{i:03d}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+        )
+    for i in range(6):
+        store.create(
+            "Pod",
+            make_pod().name(f"a{i}").uid(f"a{i}").namespace("default")
+            .req({"cpu": "100m"}).label("color", "green")
+            .pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                          anti=True).obj(),
+        )
+    sched.run_until_idle(max_cycles=20)
+    # one more REAL dispatch (a fresh pod) so the last binds' deltas sync
+    # and upload — the index is maintained at dispatch-time snapshot syncs
+    store.create("Pod", make_pod().name("tail").uid("tail")
+                 .namespace("default").req({"cpu": "1m"}).obj())
+    sched.run_until_idle(max_cycles=10)
+    sched.cache.update_snapshot(sched.snapshot)
+    enc = sched.encoder
+    d = enc._device
+    assert d is not None
+    assert np.array_equal(np.asarray(d.aff_valid), enc.aff_valid)
+    assert np.array_equal(np.asarray(d.aff_kind), enc.aff_kind)
+    assert np.array_equal(np.asarray(d.aff_slot), enc.aff_slot)
+    assert np.array_equal(np.asarray(d.aff_counts), enc.aff_counts)
+    # and the index actually recorded the six bound anti pods
+    assert sum(enc.aff._row_total) == 6

@@ -96,16 +96,18 @@ def test_constraint_pods_block_deep():
     plain = make_pod().name("p").uid("p").namespace("default").req(
         {"cpu": "100m"}
     ).obj()
-    assert _pods_block_deep([anti])
-    # spread pods are CHAINABLE (PodTopologySpreadPlugin.chain_prev)
+    # spread AND (anti)affinity pods are CHAINABLE since round 6
+    # (PodTopologySpreadPlugin.chain_prev / InterPodAffinityPlugin.chain_prev)
+    assert not _pods_block_deep([anti])
     assert not _pods_block_deep([spread])
     assert _pods_block_deep([ported])
     assert not _pods_block_deep([plain])
-    assert _pods_block_deep([plain, anti])
+    assert not _pods_block_deep([plain, anti])
+    assert _pods_block_deep([plain, ported])
 
 
 def test_deep_pipeline_with_constraint_batches_matches_sync():
-    """Interleaved anti-affinity pods force shallow cycles mid-run; results
+    """Interleaved anti-affinity pods deep-chain since round 6; results
     must still equal the synchronous path."""
 
     def build(pipeline):
@@ -138,6 +140,67 @@ def test_deep_pipeline_with_constraint_batches_matches_sync():
         return _bindings(store)
 
     assert build(True) == build(False)
+
+
+@pytest.mark.parametrize("kind", ["anti", "affinity", "preferred"])
+def test_deep_pipeline_affinity_batches_match_sync(kind):
+    """Affinity-carrying batches now ride the DEEP pipeline
+    (InterPodAffinityPlugin.chain_prev): bindings must equal the synchronous
+    path exactly — the chained count tables + the prev batch's own-term
+    block/score planes reproduce what the snapshot would have fed a shallow
+    cycle — and the deep path must actually be exercised."""
+
+    def build(pipeline):
+        store = ObjectStore()
+        # chain_affinity forced ON: "auto" disables the chain on the CPU
+        # backend tests run under, but the parity proof targets the
+        # accelerator path where it is the default
+        sched = TPUScheduler(store, batch_size=8, pipeline=pipeline,
+                             pipeline_depth=3, chain_affinity=True)
+        sched.presize(32, 96)
+        for i in range(24):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .label("kubernetes.io/hostname", f"n{i:03d}")
+                .label("zone", f"z{i % 3}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+            )
+        for i in range(20):
+            p = (make_pod().name(f"a{i:03d}").uid(f"a{i:03d}")
+                 .namespace("default")
+                 .req({"cpu": "200m"}).label("color", "green"))
+            if kind == "anti":
+                p = p.pod_affinity("kubernetes.io/hostname",
+                                   {"color": "green"}, anti=True)
+            elif kind == "affinity":
+                p = p.pod_affinity("zone", {"color": "green"})
+            else:
+                p = p.pod_affinity("kubernetes.io/hostname",
+                                   {"color": "green"}, weight=3)
+            store.create("Pod", p.obj())
+        deep_dispatches = 0
+        orig = TPUScheduler._dispatch_batch
+
+        def counting(self, infos, prevs=None, **kw):
+            nonlocal deep_dispatches
+            if prevs:
+                deep_dispatches += 1
+            return orig(self, infos, prevs=prevs, **kw)
+
+        TPUScheduler._dispatch_batch = counting
+        try:
+            sched.run_until_idle()
+        finally:
+            TPUScheduler._dispatch_batch = orig
+        return _bindings(store), deep_dispatches
+
+    deep, deep_count = build(True)
+    sync, _ = build(False)
+    assert deep_count > 0, "affinity batches never deep-chained"
+    assert deep == sync
+    if kind != "anti":  # anti: 20 pods > 24 hostnames is satisfiable too
+        assert all(v for v in deep.values())
 
 
 def test_deep_pipeline_spread_batches_match_sync():

@@ -28,8 +28,13 @@ def test_workload_basic_with_metrics():
     steady = by_metric["attempt_duration_steady_state"]
     assert steady.data["TotalCount"] >= steady.data["Count"] >= 0
     assert by_metric["XLACompilesInWindow"].data["Count"] >= 0
+    # per-phase wall breakdown (round 6): every phase present, none negative
+    phases = by_metric["PhaseWallBreakdown"].data
+    for k in ("snapshot", "compile", "host_prepare", "partition",
+              "dispatch", "fetch", "bind"):
+        assert phases[k] >= 0.0, (k, phases)
     doc = json.loads(data_items_to_json(items))
-    assert doc["version"] == "v1" and len(doc["dataItems"]) == 5
+    assert doc["version"] == "v1" and len(doc["dataItems"]) == 6
 
 
 def test_workload_churn():

@@ -1,0 +1,49 @@
+"""Microbench: per-cycle InterPodAffinity.host_prepare wall vs scheduled
+anti-affinity pod count — the round-6 tentpole's core host-path claim (the
+old per-cycle rebuild walk is O(all scheduled affinity pods); the
+incremental AffinityIndex is O(batch delta)).  Run in both a pre-round-6
+worktree and the current tree to produce the `host_prepare_scaling_ms`
+section of BENCH_r06_AB.json (tools/build_r6_ab.py AB_HOSTPREP env):
+
+    JAX_PLATFORMS=cpu python tools/bench_host_prepare.py
+
+Prints one JSON object {scheduled_pod_count: ms_per_call} (20-rep mean,
+64-pod anti-affinity batch, hostname topology)."""
+
+import json, sys, time
+import numpy as np
+from kubernetes_tpu.state.cache import Cache, Snapshot
+from kubernetes_tpu.state.encoding import ClusterEncoder
+from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+from kubernetes_tpu.framework.runtime import BatchedFramework
+from kubernetes_tpu.scheduler import default_plugins
+from kubernetes_tpu.testutil import make_node, make_pod
+
+out = {}
+for K in (500, 2000, 8000):
+    N = max(1000, K)
+    cache = Cache()
+    for i in range(N):
+        cache.add_node(make_node().name(f"node-{i:06d}")
+                       .capacity({"cpu": "64", "memory": "256Gi", "pods": "400"})
+                       .label("kubernetes.io/hostname", f"node-{i:06d}").obj())
+    def apod(i, ns):
+        return (make_pod().name(f"anti-{ns}-{i:06d}").uid(f"anti-{ns}-{i:06d}")
+                .namespace(ns).req({"cpu": "100m"}).label("color", "green")
+                .pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                              anti=True, namespaces=["sched-0", "sched-1"]).obj())
+    for i in range(K):
+        p = apod(i, "sched-0"); p.spec.node_name = f"node-{i % N:06d}"; cache.add_pod(p)
+    snap = Snapshot(); cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    comp = PodBatchCompiler(enc)
+    batch = comp.compile([apod(10_000_000 + i, "sched-1") for i in range(64)], pad_to=64)
+    enc.full_sync(snap)
+    fw = BatchedFramework(default_plugins(enc.domain_cap, None))
+    fw.host_prepare(batch, snap, enc)  # warm caches
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        fw.host_prepare(batch, snap, enc)
+    out[K] = round((time.perf_counter() - t0) / reps * 1e3, 3)
+print(json.dumps(out))

@@ -156,14 +156,83 @@ def render_baseline(bench):
     return "\n".join(lines)
 
 
-def splice(path, block):
+AB_BEGIN = ("<!-- GENERATED:PERF:R6AB:BEGIN (tools/render_perf_docs.py — "
+            "edit BENCH_r06_AB.json, not this block) -->")
+AB_END = "<!-- GENERATED:PERF:R6AB:END -->"
+
+
+def render_r6_ab(ab):
+    """Round-6 same-hardware A/B table (BENCH_r06_AB.json): pre-change HEAD
+    vs the incremental-affinity + hybrid-assignment build, both arms run in
+    THIS repo's container.  Rendered, not transcribed, like every other
+    perf block."""
+    env = ab["environment"]
+    lines = [
+        AB_BEGIN,
+        "",
+        f"Environment: `{env['backend']}` backend, {env['cpus']} CPU core(s)"
+        f" — {env['note']}",
+        "",
+        ab["scale_note"],
+        "",
+        "| Suite (scale) | baseline pods/s (passes) | round 6 pods/s "
+        "(passes) | speedup | r6 p99 ms | r6 compiles | "
+        "host_prepare+partition wall (s) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+
+    def band(vals):
+        return "/".join(f"{v:.0f}" for v in vals)
+
+    for r in ab["rows"]:
+        b, n = r["baseline"], r["round6"]
+        pw = n.get("phase_wall_s", {})
+        hp = pw.get("host_prepare", 0.0) + pw.get("partition", 0.0)
+        lines.append(
+            f"| {r['suite']} (×{r['scale']}) | "
+            f"{b['throughput_pods_per_s']:.1f} "
+            f"({band(r['baseline_passes_pods_per_s'])}) | "
+            f"{n['throughput_pods_per_s']:.1f} "
+            f"({band(r['round6_passes_pods_per_s'])}) | "
+            f"**{r['speedup']:.2f}×** | "
+            f"{n['attempt_ms']['p99']:.0f} | "
+            f"{int(n['xla_compiles_in_window']['count'])} | "
+            f"{hp:.3f} |"
+        )
+    hp = ab.get("host_prepare_scaling_ms")
+    if hp:
+        b, n = hp["baseline"], hp["round6"]
+        ks = sorted(b, key=int)
+        lines += [
+            "",
+            "Per-cycle `InterPodAffinity.host_prepare` wall vs scheduled "
+            "anti-affinity pod count (the tentpole's core claim — the old "
+            "per-cycle rebuild walk is O(all scheduled affinity pods), the "
+            "incremental index is O(batch delta); same-hardware microbench, "
+            f"{hp['note']}):",
+            "",
+            "| scheduled affinity pods | " + " | ".join(ks) + " |",
+            "|---|" + "---|" * len(ks),
+            "| baseline (ms/cycle) | "
+            + " | ".join(f"{b[k]:.2f}" for k in ks) + " |",
+            "| round 6 (ms/cycle) | "
+            + " | ".join(f"{n[k]:.2f}" for k in ks) + " |",
+            "| speedup | "
+            + " | ".join(f"**{b[k] / n[k]:.0f}×**" for k in ks) + " |",
+        ]
+    lines += ["", AB_END]
+    return "\n".join(lines)
+
+
+def splice(path, block, begin=BEGIN, end=END):
     p = os.path.join(REPO, path)
     text = open(p).read()
-    if BEGIN not in text or END not in text:
-        print(f"ERROR: {path} lacks the GENERATED:PERF sentinels", file=sys.stderr)
+    if begin not in text or end not in text:
+        print(f"ERROR: {path} lacks the {begin.split(' ')[0]} sentinels",
+              file=sys.stderr)
         return False
-    head, rest = text.split(BEGIN, 1)
-    _, tail = rest.split(END, 1)
+    head, rest = text.split(begin, 1)
+    _, tail = rest.split(end, 1)
     open(p, "w").write(head + block + tail)
     print(f"rendered {path}")
     return True
@@ -182,6 +251,12 @@ def main() -> int:
         density = None
     ok = splice("COMPONENTS.md", render_components(suites, bench, density))
     ok &= splice("BASELINE.md", render_baseline(bench))
+    try:
+        ab = load_bench("BENCH_r06_AB.json")
+    except (OSError, json.JSONDecodeError):
+        ab = None  # pre-round-6 trees have no A/B artifact
+    if ab is not None:
+        ok &= splice("COMPONENTS.md", render_r6_ab(ab), AB_BEGIN, AB_END)
     return 0 if ok else 1
 
 

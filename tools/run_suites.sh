@@ -31,13 +31,37 @@ run() {
     echo "$line" >> "$OUT"
   fi
 }
+
+# fail-fast compile gate for the coupled-affinity suites: their round-6 wins
+# (incremental device-resident affinity tables + affinity deep-chaining) are
+# only real at xla_compiles_in_window == 0 — a stray in-window compile means
+# a program variant escaped the warmups and the whole pass's numbers for
+# that suite are compile-tainted, so abort the pass loudly instead of
+# committing a poisoned artifact
+gate_zero_compiles() {
+  local suite="$1" line
+  line=$(grep "\"workload\": \"$suite/" "$OUT" | tail -1)
+  if [ -z "$line" ]; then
+    echo "FAILED: compile gate found no row for $suite" >> suites_run.log
+    exit 1
+  fi
+  python - "$line" <<'PYEOF' || { echo "FAILED: $suite in-window compiles != 0" >> suites_run.log; exit 1; }
+import json, sys
+d = json.loads(sys.argv[1])
+n = d["detail"]["xla_compiles_in_window"]["count"]
+sys.exit(0 if n == 0 else 1)
+PYEOF
+}
 run SchedulingBasic 5000Nodes
 run SchedulingPodAntiAffinity 5000Nodes
+gate_zero_compiles SchedulingPodAntiAffinity
 run SchedulingPodAffinity 5000Nodes
+gate_zero_compiles SchedulingPodAffinity
 run TopologySpreading 5000Nodes
 run PreferredTopologySpreading 5000Nodes
 run SchedulingNodeAffinity 5000Nodes
 run SchedulingPreferredPodAffinity 5000Nodes
+gate_zero_compiles SchedulingPreferredPodAffinity
 run Unschedulable 5000Nodes/200InitPods
 run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
