@@ -662,6 +662,35 @@ class PodDisruptionBudget:
         )
 
 
+@dataclass
+class Eviction:
+    """policy/v1 Eviction — the pods/{name}/eviction subresource body.
+
+    Reference: staging/src/k8s.io/api/policy/v1/types.go Eviction.  The
+    metadata names the pod to evict; deleteOptions passes through to the
+    delete (only gracePeriodSeconds is modeled — the sim terminates pods
+    instantly either way).  Handled by descheduler/evictions.py (the gate)
+    and served at POST pods/{name}/eviction by the apiserver (429
+    TooManyRequests when a matching PDB has no budget, exactly the
+    reference handler's contract)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    grace_period_seconds: Optional[int] = None  # deleteOptions.gracePeriodSeconds
+
+    kind = "Eviction"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Eviction":
+        opts = d.get("deleteOptions") or {}
+        # both the wire form (deleteOptions.gracePeriodSeconds) and the
+        # generic serializer's flat camelCase field round-trip
+        gps = opts.get("gracePeriodSeconds", d.get("gracePeriodSeconds"))
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            grace_period_seconds=(None if gps is None else int(gps)),
+        )
+
+
 # PodGroup phases (the coscheduling CRD's PodGroupStatus.Phase subset the
 # gang subsystem drives; see kubernetes_tpu/gang/).
 POD_GROUP_PENDING = "Pending"

@@ -135,6 +135,8 @@ def default_scheme() -> Scheme:
     s.add_known_type("storage.k8s.io", "v1", v1.StorageClass)
     s.add_known_type("storage.k8s.io", "v1", v1.CSINode)
     s.add_known_type("policy", "v1", v1.PodDisruptionBudget)
+    # the eviction subresource body (descheduler/evictions.py is the gate)
+    s.add_known_type("policy", "v1", v1.Eviction)
     s.add_known_type("scheduling.k8s.io", "v1", v1.PriorityClass)
     # coscheduling CRD (sigs.k8s.io/scheduler-plugins) — the gang unit
     s.add_known_type("scheduling.x-k8s.io", "v1alpha1", v1.PodGroup)

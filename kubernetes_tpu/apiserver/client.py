@@ -231,6 +231,19 @@ class HTTPApiClient:
             "target": {"kind": "Node", "name": node_name},
         })
 
+    def evict_pod(self, namespace: str, name: str) -> dict:
+        """POST the eviction subresource — the SERVER-side gate decides
+        (PDB check + budget drain under the server's own lock), so remote
+        callers never race it with a client-local check-then-delete.
+        Raises HTTPError 429 when the disruption budget refuses (after
+        the transport's retries), 404 when the pod is already gone."""
+        url = (self.base_url + f"/api/v1/namespaces/{namespace}"
+               f"/pods/{name}/eviction")
+        return self._request("POST", url, {
+            "apiVersion": "policy/v1", "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        })
+
 
 class HTTPStoreFacade:
     """ObjectStore-shaped facade over HTTPApiClient — the CRUD subset
@@ -281,6 +294,12 @@ class HTTPStoreFacade:
             if e.code == 404:
                 return None
             raise
+
+    def evict_pod(self, namespace: str, name: str) -> dict:
+        """Server-side eviction gate (POST pods/{name}/eviction) — remote
+        drains MUST use this instead of a client-local PDB check + delete,
+        which would race the server's budget lock."""
+        return self._client.evict_pod(namespace, name)
 
     def watch(self, handler, since_rv: int = 0):
         raise NotImplementedError(

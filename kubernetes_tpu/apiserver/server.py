@@ -188,6 +188,12 @@ class APIServer:
         for entry in self.scheme.recognized():
             kind = entry.split(":", 1)[1]
             self.kinds_by_resource[resource_of(kind)] = kind
+        # the shared eviction gate behind POST pods/{name}/eviction
+        # (pkg/registry/core/pod eviction REST analog): PDB-consulting,
+        # 429 TooManyRequests when budget is exhausted
+        from ..descheduler.evictions import EvictionAPI
+
+        self.evictions = EvictionAPI(store)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -548,6 +554,49 @@ def _make_handler(api: APIServer):
                                           "status": "Success"})
                 else:
                     self._status_err(404, "NotFound", f"pod {ns}/{name}")
+                return
+            if kind == "Pod" and name and sub == "eviction":
+                # the Eviction subresource (policy/v1): the shared gate
+                # decides; an exhausted PodDisruptionBudget answers 429
+                # TooManyRequests exactly like the reference handler
+                if not self._check("delete", "Pod", ns):
+                    return
+                body = self._body()
+                if body:
+                    try:
+                        eviction = api.scheme.decode(body)
+                    except (SchemeError, ValueError) as e:
+                        self._status_err(400, "BadRequest", str(e))
+                        return
+                    if eviction.metadata.name and \
+                            eviction.metadata.name != name:
+                        self._status_err(
+                            400, "BadRequest",
+                            f"eviction names pod "
+                            f"{eviction.metadata.name!r}, URL names "
+                            f"{name!r}")
+                        return
+                    # deleteOptions.gracePeriodSeconds decodes but is
+                    # ignored: sim pods terminate instantly (documented
+                    # deviation on api.objects.Eviction)
+                pod = api.store.get("Pod", ns, name)
+                if pod is None:
+                    self._status_err(404, "NotFound", f"pod {ns}/{name}")
+                    return
+                result = api.evictions.evict(pod, reason="api eviction",
+                                             policy="api")
+                if result.evicted:
+                    self._send_json(201, {"kind": "Status",
+                                          "status": "Success"})
+                elif not result.allowed:
+                    self._status_err(429, "TooManyRequests", result.reason)
+                elif result.reason == "pod already gone":
+                    # a concurrent eviction won the race: same 404 the
+                    # sequential retry gets from the pre-check above
+                    self._status_err(404, "NotFound", f"pod {ns}/{name}")
+                else:
+                    self._status_err(409, "Conflict",
+                                     result.reason or "eviction failed")
                 return
             if not self._check("create", kind, ns):
                 return

@@ -48,6 +48,8 @@ class Kubectl:
     # --- get / describe -------------------------------------------------------
 
     def get(self, kind: str, namespace: Optional[str] = None) -> str:
+        if kind.lower() in ("slice", "slices"):
+            return self.get_slices()
         kind = KIND_ALIASES.get(kind.lower(), kind)
         objs, _ = self.store.list(kind)
         if namespace:
@@ -272,15 +274,138 @@ class Kubectl:
         self.store.update("Node", node)
         return f"node/{name} tainted"
 
-    def drain(self, name: str) -> str:
-        self.cordon(name, True)
+    def drain(self, name: str, dry_run: bool = False) -> str:
+        """``kubectl drain``: cordon + evict every pod through the shared
+        eviction gate (descheduler/evictions.py) — PDB-refused pods stay
+        put and are reported, never force-deleted.  ``--dry-run`` evaluates
+        the gate without cordoning or deleting anything."""
+        from .descheduler.evictions import EvictionAPI
+
+        node = self.store.get("Node", "", name)
+        if node is None:
+            return f"node {name} not found"
+        if not dry_run:
+            self.cordon(name, True)
+        gate = EvictionAPI(self.store)
         pods, _ = self.store.list("Pod")
         n = 0
+        blocked: List[str] = []
+        failed: List[str] = []
+        # --server mode: the store is an HTTP facade — route REAL evictions
+        # through the server's eviction subresource so the PDB gate runs
+        # under the SERVER's budget lock (a client-local check-then-delete
+        # would race every other server-side eviction path); dry-run stays
+        # a read-only client-side preview either way
+        evict_remote = (getattr(self.store, "evict_pod", None)
+                        if not dry_run else None)
         for p in pods:
-            if p.spec.node_name == name:
-                self.store.delete("Pod", p.namespace, p.metadata.name)
+            if p.spec.node_name != name:
+                continue
+            if evict_remote is not None:
+                import urllib.error
+
+                try:
+                    evict_remote(p.namespace, p.metadata.name)
+                    n += 1
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        blocked.append(f"{p.namespace}/{p.metadata.name} "
+                                       f"(disruption budget)")
+                    elif e.code != 404:  # already gone is not a failure
+                        failed.append(f"{p.namespace}/{p.metadata.name} "
+                                      f"(HTTP {e.code})")
+                continue
+            r = gate.evict(p, reason=f"drain node {name}", policy="drain",
+                           dry_run=dry_run)
+            if r.evicted or (dry_run and r.allowed):
                 n += 1
-        return f"node/{name} drained ({n} pods evicted)"
+            elif not r.allowed:
+                blocked.append(f"{p.namespace}/{p.metadata.name} "
+                               f"(pdb {r.blocking_pdb})")
+            else:
+                # allowed but not evicted: store fault (or already gone) —
+                # never report it as drained
+                failed.append(f"{p.namespace}/{p.metadata.name} "
+                              f"({r.reason})")
+        verb = "would evict" if dry_run else "evicted"
+        out = f"node/{name} drained ({n} pods {verb})"
+        if blocked:
+            out += "; blocked by disruption budget: " + ", ".join(blocked)
+        if failed:
+            out += "; failed: " + ", ".join(failed)
+        return out
+
+    # --- slice fragmentation view ---------------------------------------------
+
+    def get_slices(self, slice_label: Optional[str] = None,
+                   chip_resource: str = "google.com/tpu") -> str:
+        """``ktpu get slices``: free-chips-per-slice — what the
+        defragmenter sees.  FREE-CHIPS sums per-host free chips (the
+        ``google.com/tpu`` extended resource when a host advertises it,
+        whole CPUs otherwise); FRAGMENTATION is the share of those free
+        chips stranded on partially-occupied hosts — the capacity a
+        whole-slice gang cannot use until the descheduler compacts it."""
+        from .api.resource import compute_pod_resource_request
+        from .gang import SLICE_LABEL
+
+        slice_label = slice_label or SLICE_LABEL
+        nodes, _ = self.store.list("Node")
+        pods, _ = self.store.list("Pod")
+        used_by_node: Dict[str, float] = {}
+        pods_by_node: Dict[str, int] = {}
+        node_chip = {}
+        for node in nodes:
+            alloc = node.status.allocatable
+            if chip_resource in alloc:
+                node_chip[node.metadata.name] = ("ext", chip_resource)
+            else:
+                node_chip[node.metadata.name] = ("cpu", "cpu")
+        for p in pods:
+            nn = p.spec.node_name
+            if not nn or nn not in node_chip:
+                continue
+            r = compute_pod_resource_request(p)
+            kind_, res = node_chip[nn]
+            chips = (float(r.scalar_resources.get(res, 0)) if kind_ == "ext"
+                     else r.milli_cpu / 1000.0)
+            used_by_node[nn] = used_by_node.get(nn, 0.0) + chips
+            pods_by_node[nn] = pods_by_node.get(nn, 0) + 1
+        slices: Dict[str, List[v1.Node]] = {}
+        for node in nodes:
+            val = node.metadata.labels.get(slice_label)
+            if val is not None:
+                slices.setdefault(val, []).append(node)
+        from .api.resource import parse_quantity
+
+        rows = [["NAME", "HOSTS", "FREE-HOSTS", "FREE-CHIPS",
+                 "FRAGMENTATION"]]
+        for name in sorted(slices):
+            free_total = 0.0
+            free_on_empty = 0.0
+            empty_hosts = 0
+            for node in slices[name]:
+                kind_, res = node_chip[node.metadata.name]
+                alloc = node.status.allocatable
+                cap = (float(parse_quantity(alloc.get(res, 0)))
+                       if kind_ == "ext"
+                       else float(parse_quantity(alloc.get("cpu", 0))))
+                free = max(cap - used_by_node.get(node.metadata.name, 0.0),
+                           0.0)
+                free_total += free
+                if pods_by_node.get(node.metadata.name, 0) == 0:
+                    empty_hosts += 1
+                    free_on_empty += free
+            frag = (1.0 - free_on_empty / free_total) if free_total > 0 \
+                else 0.0
+            rows.append([
+                name, str(len(slices[name])), str(empty_hosts),
+                f"{free_total:g}", f"{frag:.0%}",
+            ])
+        widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+        return "\n".join(
+            "  ".join(cell.ljust(w) for cell, w in zip(r, widths))
+            for r in rows
+        )
 
 
 def main(argv=None):  # pragma: no cover - thin shell wrapper
@@ -315,6 +440,13 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     p.add_argument("action", choices=["status"])
     p.add_argument("kind"); p.add_argument("name")
     p.add_argument("-n", "--namespace", default="default")
+    p = sub.add_parser("drain")
+    p.add_argument("node")
+    p.add_argument("--dry-run", action="store_true",
+                   help="evaluate the eviction gate, evict nothing")
+    for verb in ("cordon", "uncordon"):
+        p = sub.add_parser(verb)
+        p.add_argument("node")
     args = ap.parse_args(argv)
     if args.server:
         from .apiserver import HTTPApiClient
@@ -348,6 +480,10 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         print(k.patch(args.kind, args.namespace, args.name, args.patch))
     elif args.verb == "rollout":
         print(k.rollout_status(args.kind, args.namespace, args.name))
+    elif args.verb == "drain":
+        print(k.drain(args.node, dry_run=args.dry_run))
+    elif args.verb in ("cordon", "uncordon"):
+        print(k.cordon(args.node, on=args.verb == "cordon"))
     return 0
 
 

@@ -32,10 +32,19 @@ def _set_condition(node: v1.Node, cond_type: str, status: str):
 
 class NodeLifecycleController:
     def __init__(self, store: ObjectStore, grace_period: float = DEFAULT_GRACE_PERIOD,
-                 clock=time.monotonic):
+                 clock=time.monotonic, eviction_api=None):
+        from ..descheduler.evictions import EvictionAPI
+
         self.store = store
         self.grace = grace_period
         self.clock = clock
+        # every pod-killing path goes through the shared eviction gate
+        # (descheduler/evictions.py): a not-ready node's sync can no longer
+        # zero out a PDB-protected workload in one pass.  DOCUMENTED
+        # DEVIATION from the reference taint manager, which deletes
+        # NoExecute-evicted pods unconditionally; refused pods survive this
+        # sync and retry on later syncs as budget replenishes.
+        self.evictions = eviction_api or EvictionAPI(store, clock=clock)
 
     def sync_once(self) -> bool:
         changed = False
@@ -53,6 +62,11 @@ class NodeLifecycleController:
                 self.store.update("Node", node)
                 self._evict_pods(node.metadata.name)
                 changed = True
+            elif stale and tainted:
+                # retry PDB-refused evictions from earlier syncs: budget
+                # replenishes as replacements schedule, and a still-down
+                # node must eventually drain without ever violating a PDB
+                changed = self._evict_pods(node.metadata.name) or changed
             elif not stale and tainted:
                 node.spec.taints = [
                     t for t in node.spec.taints if t.key != UNREACHABLE_TAINT
@@ -62,10 +76,15 @@ class NodeLifecycleController:
                 changed = True
         return changed
 
-    def _evict_pods(self, node_name: str):
-        """NoExecute taint-manager eviction: pods without a matching toleration
-        are deleted; controllers recreate them → rescheduled elsewhere."""
+    def _evict_pods(self, node_name: str) -> bool:
+        """NoExecute taint-manager eviction THROUGH the shared gate: pods
+        without a matching toleration are evicted (controllers recreate
+        them → rescheduled elsewhere), but a pod whose PodDisruptionBudget
+        is exhausted is refused and retried on a later sync — one not-ready
+        node can never zero out a protected workload in one pass."""
         pods, _ = self.store.list("Pod")
+        evicted = False
+        pdbs = None
         for p in pods:
             if p.spec.node_name != node_name:
                 continue
@@ -76,4 +95,10 @@ class NodeLifecycleController:
                 for t in p.spec.tolerations
             )
             if not tolerated:
-                self.store.delete("Pod", p.namespace, p.metadata.name)
+                if pdbs is None:
+                    pdbs = self.store.list("PodDisruptionBudget")[0]
+                result = self.evictions.evict(
+                    p, reason=f"node {node_name} not ready",
+                    policy="nodelifecycle", pdbs=pdbs)
+                evicted = evicted or result.evicted
+        return evicted

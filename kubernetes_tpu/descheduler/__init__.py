@@ -1,0 +1,40 @@
+"""Descheduler subsystem: PDB-aware eviction gate + device-resident
+defragmentation planner + policy controller loop.
+
+Layer map (COMPONENTS.md has the upstream-analogue table):
+  evictions.py  — the single gate every pod-killing path goes through
+                  (Eviction subresource analog, PDB-consulting)
+  planner.py    — counterfactual batched assignment over a forked
+                  DeviceSnapshot (DryRunPreemption analog, one pod×node
+                  solve per plan)
+  policies.py   — slice defragmentation / spread-violation repair /
+                  node drain candidate enumeration
+  controller.py — the rate-limited propose→score→apply loop
+"""
+
+from .controller import DeschedulerController, ScoredPlan
+from .evictions import EvictionAPI, EvictionResult
+from .planner import Prediction, WhatIfPlanner
+from .policies import (
+    DRAIN_ANNOTATION,
+    CandidatePlan,
+    NodeDrainPolicy,
+    SliceDefragmentation,
+    SpreadViolationRepair,
+    default_policies,
+)
+
+__all__ = [
+    "DeschedulerController",
+    "ScoredPlan",
+    "EvictionAPI",
+    "EvictionResult",
+    "Prediction",
+    "WhatIfPlanner",
+    "DRAIN_ANNOTATION",
+    "CandidatePlan",
+    "NodeDrainPolicy",
+    "SliceDefragmentation",
+    "SpreadViolationRepair",
+    "default_policies",
+]

@@ -1,0 +1,270 @@
+"""Descheduler controller loop: propose → score on device → apply.
+
+The run-once interface matches the other controllers (sync_once), so the
+loop can ride ControllerManager or be driven directly by tests/harness.
+Unlike the pure-store controllers it holds a scheduler reference: the
+what-if planner reuses the scheduler's encoder and compiled assignment
+programs for its counterfactual solves, and MUST therefore run while the
+scheduler is quiescent (between cycles; the sim's drivers alternate
+scheduler cycles and controller syncs on one thread, where that holds).
+
+Plan application is fail-stop: victims are evicted one gate call at a
+time, and the FIRST refusal or store fault abandons the remainder of the
+plan (metric outcome "abandoned") — a mid-plan fault leaves every
+surviving victim in place and the cluster schedulable; the next sync
+re-plans from the actual state instead of resuming a stale victim list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import objects as v1
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from .evictions import EvictionAPI
+from .planner import Prediction, WhatIfPlanner
+from .policies import CandidatePlan, PolicyContext, default_policies
+
+
+@dataclass
+class ScoredPlan:
+    plan: CandidatePlan
+    viable: bool
+    prediction: Optional[Prediction] = None
+    slices_freed: int = 0
+    replacements_found: int = 0
+
+    @property
+    def displaced(self) -> int:
+        return len(self.plan.victims)
+
+
+class DeschedulerController:
+    name = "descheduler"
+
+    def __init__(self, store, scheduler, eviction_api: Optional[EvictionAPI] = None,
+                 policies: Optional[List[object]] = None,
+                 dry_run: bool = False,
+                 max_evictions_per_sync: int = 16,
+                 min_interval: float = 0.0,
+                 clock=None,
+                 slice_label: Optional[str] = None):
+        from ..gang import SLICE_LABEL
+
+        self.store = store
+        self.scheduler = scheduler
+        self.clock = clock or getattr(scheduler, "clock", time.monotonic)
+        self.evictions = eviction_api or EvictionAPI(
+            store, recorder=getattr(scheduler, "recorder", None),
+            clock=self.clock)
+        self.planner = WhatIfPlanner(scheduler)
+        self.policies = list(policies) if policies is not None \
+            else default_policies()
+        self.dry_run = dry_run
+        # rate limiting: a hard per-sync eviction cap plus a minimum
+        # spacing between eviction-performing syncs — a descheduler must
+        # disrupt at a bounded pace, never storm a recovering cluster
+        self.max_evictions_per_sync = max_evictions_per_sync
+        self.min_interval = min_interval
+        self._last_active = float("-inf")
+        self.slice_label = slice_label or SLICE_LABEL
+        # dry-run observability: last sync's scored plans per policy
+        self.last_plans: Dict[str, ScoredPlan] = {}
+        # per-sync cache of the slice → bound-pod-uids occupancy map
+        # (see _slices_freed); None = rebuild on next use
+        self._occupancy: Optional[Dict[str, List[str]]] = None
+
+    # --- scoring --------------------------------------------------------------
+
+    def score(self, plan: CandidatePlan) -> ScoredPlan:
+        """Score one candidate: the parity-grade pending-only solve decides
+        viability (and the predicted placements the dry-run reports); the
+        plan's scoreboard is (slices freed, pods displaced, replacement
+        placements found) per the dry-run contract."""
+        if plan.no_solve:
+            return ScoredPlan(plan=plan, viable=bool(plan.victims),
+                              slices_freed=self._slices_freed(plan))
+        prediction = self.planner.predict(plan.pending, plan.victims)
+        if prediction is None:
+            return ScoredPlan(plan=plan, viable=False)
+        viable = True
+        if plan.require_all_pending and prediction.unplaced:
+            viable = False
+        if viable and plan.post_check is not None:
+            viable = bool(plan.post_check(prediction.placements))
+        return ScoredPlan(
+            plan=plan, viable=viable, prediction=prediction,
+            slices_freed=self._slices_freed(plan),
+        )
+
+    def _score_replacements(self, scored: ScoredPlan) -> None:
+        """Second solve on the WINNING plan only: pending + victim clones,
+        counting how many displaced workloads find a home elsewhere.  Kept
+        out of the viability solve so clone placement can never perturb
+        the parity-grade prediction."""
+        plan = scored.plan
+        if not plan.replacements:
+            return
+        combined = self.planner.predict(
+            list(plan.pending) + list(plan.replacements), plan.victims)
+        if combined is None:
+            return
+        scored.replacements_found = sum(
+            1 for clone in plan.replacements
+            if combined.placements.get(clone.uid) is not None)
+
+    def _slices_freed(self, plan: CandidatePlan) -> int:
+        """Slices whose every bound pod is in the victim set — what the
+        plan turns into whole-free slice groups.  The occupancy map is
+        plan-independent and rebuilt at most once per sync (sync_once
+        invalidates it; a sync can score dozens of candidates over the
+        same store state)."""
+        victims = {v.uid for v in plan.victims}
+        occupants = self._occupancy
+        if occupants is None:
+            nodes, _ = self.store.list("Node")
+            pods, _ = self.store.list("Pod")
+            occupants = {}
+            slice_of: Dict[str, str] = {}
+            for node in nodes:
+                val = node.metadata.labels.get(self.slice_label)
+                if val is not None:
+                    slice_of[node.metadata.name] = val
+                    occupants.setdefault(val, [])
+            for p in pods:
+                sl = slice_of.get(p.spec.node_name or "")
+                if sl is not None:
+                    occupants[sl].append(p.uid)
+            self._occupancy = occupants
+        return sum(
+            1 for sl, uids in occupants.items()
+            if uids and all(uid in victims for uid in uids)
+        )
+
+    # --- the loop -------------------------------------------------------------
+
+    def sync_once(self) -> bool:
+        now = self.clock()
+        if now - self._last_active < self.min_interval:
+            return False
+        # planner quiescence: a pipelined scheduler may return from
+        # schedule_cycle with batches still in flight — complete them
+        # (empty-queue cycles fetch + bind without new dispatch work)
+        # before any counterfactual solve; if the pipeline won't drain,
+        # skip this sync rather than plan against invisible placements
+        for _ in range(4):
+            if not getattr(self.scheduler, "_inflight_q", None):
+                break
+            self.scheduler.schedule_cycle()
+        if getattr(self.scheduler, "_inflight_q", None):
+            return False
+        budget = self.max_evictions_per_sync
+        self.last_plans = {}
+        self._occupancy = None  # fresh store state this sync
+        changed = False
+        for policy in self.policies:
+            if budget <= 0:
+                break
+            try:
+                plans = policy.propose(PolicyContext(
+                    self.store, self.scheduler.gangs, self.evictions,
+                    self.clock, dry_run=self.dry_run))
+            except Exception as e:
+                # one broken policy must not take the loop down
+                klog.V(1).info_s("Descheduler policy propose failed",
+                                 policy=policy.name,
+                                 error=f"{type(e).__name__}: {e}")
+                continue
+            # plans sharing a group compete; the cheapest VIABLE plan per
+            # group applies, so one sync serves several independent
+            # demands (one slice per waiting gang, one repair per drifted
+            # constraint, one drain per annotated node) within the budget
+            by_group: Dict[str, List[CandidatePlan]] = {}
+            for i, plan in enumerate(plans):
+                by_group.setdefault(plan.group or f"#{i}", []).append(plan)
+            any_viable = False
+            budget_limited = False
+            for group in by_group.values():
+                if budget <= 0:
+                    budget_limited = True
+                    break
+                group.sort(key=lambda pl: len(pl.victims))
+                best: Optional[ScoredPlan] = None
+                for plan in group:
+                    if plan.no_solve and len(plan.victims) > budget:
+                        # drain evictions are independent (no all-or-
+                        # nothing placement to enable): chunk to the
+                        # budget so a big node drains across syncs
+                        # instead of never
+                        plan = dataclasses.replace(
+                            plan, victims=plan.victims[:budget])
+                    if len(plan.victims) > budget:
+                        budget_limited = True
+                        continue
+                    scored = self.score(plan)
+                    if scored.viable:
+                        # cost-ordered scan: the first viable plan is the
+                        # group's minimal victim set — later (costlier)
+                        # candidates never run their device solve
+                        best = scored
+                        break
+                if best is None:
+                    continue
+                any_viable = True
+                self._score_replacements(best)
+                self.last_plans[policy.name] = best
+                if self.dry_run:
+                    m.descheduler_plans.inc((policy.name, "dry_run"))
+                    klog.V(2).info_s(
+                        "Descheduler dry-run plan", policy=policy.name,
+                        note=best.plan.note, victims=best.displaced,
+                        slices_freed=best.slices_freed,
+                        replacements=best.replacements_found)
+                    continue
+                applied = self._apply(best)
+                changed = changed or applied > 0
+                budget -= applied
+                if applied:
+                    self._last_active = now
+            if plans and not any_viable and not budget_limited:
+                # only genuine no-placement outcomes count as no_fit —
+                # plans skipped by the rate limiter were never solved
+                m.descheduler_plans.inc((policy.name, "no_fit"))
+        return changed
+
+    def _apply(self, scored: ScoredPlan) -> int:
+        """Evict the plan's victims through the gate; fail-stop on the
+        first refusal or fault (outcome "abandoned")."""
+        plan = scored.plan
+        applied = 0
+        for victim in plan.victims:
+            try:
+                result = self.evictions.evict(
+                    victim, reason=plan.note, policy=plan.policy)
+            except Exception as e:
+                klog.V(1).info_s("Descheduler eviction fault; plan abandoned",
+                                 policy=plan.policy, pod=victim.key(),
+                                 error=f"{type(e).__name__}: {e}")
+                m.descheduler_plans.inc((plan.policy, "abandoned"))
+                return applied
+            if not result.evicted:
+                # a refusal mid-plan (budget raced since scoring) or a
+                # store fault surfaced as a result: stop here — the next
+                # sync re-plans from live state
+                klog.V(1).info_s("Descheduler plan abandoned",
+                                 policy=plan.policy, pod=victim.key(),
+                                 reason=result.reason)
+                m.descheduler_plans.inc((plan.policy, "abandoned"))
+                return applied
+            applied += 1
+        self._occupancy = None  # evictions changed the occupancy map
+        m.descheduler_plans.inc((plan.policy, "applied"))
+        klog.V(2).info_s("Descheduler plan applied", policy=plan.policy,
+                         note=plan.note, victims=applied,
+                         slices_freed=scored.slices_freed,
+                         replacements=scored.replacements_found)
+        return applied

@@ -1,0 +1,215 @@
+"""The shared eviction gate — every pod-killing path goes through here.
+
+Reference: pkg/registry/core/pod/storage/eviction.go (the Eviction
+subresource REST handler): an eviction request checks every matching
+PodDisruptionBudget's ``status.disruptionsAllowed``, and either deletes the
+pod (atomically draining one unit of budget so a burst of evictions within
+one disruption-controller resync interval cannot overshoot) or refuses with
+429 TooManyRequests.  The disruption controller
+(controllers/disruption.py) replenishes budgets as replacements schedule.
+
+Callers in-tree:
+  - controllers/nodelifecycle.py — NoExecute taint eviction (refused pods
+    survive the sync and retry when budget replenishes; upstream's taint
+    manager deletes unconditionally — documented deviation, see ISSUE 5's
+    one-sync-zeroes-a-PDB bug),
+  - scheduler preemption (_run_post_filter) — ``override_pdb=True``: the
+    dry-run already *minimized* PDB violations in ranking, and upstream
+    preemption may violate budgets as a last resort, so the gate records
+    the violation ("overridden") instead of refusing,
+  - descheduler policies (descheduler/controller.py),
+  - ``ktpu drain`` and the apiserver's POST pods/{name}/eviction route.
+
+Exactly-once: the pod delete is the store's atomic pop — a pod already
+gone returns result "missing" and consumes no budget, so two racing paths
+can never both count an eviction for the same pod.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from ..analysis import lockcheck
+from ..api import objects as v1
+from ..api.labels import match_label_selector
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+
+
+@dataclass
+class EvictionResult:
+    """Outcome of one gate pass.
+
+    ``allowed`` is the PDB-gate verdict (True in dry-run when the eviction
+    WOULD proceed); ``evicted`` is whether the pod was actually deleted;
+    ``reason`` explains a refusal; ``blocking_pdb`` names the exhausted
+    budget ("ns/name") when refused or overridden."""
+
+    allowed: bool
+    evicted: bool = False
+    reason: str = ""
+    blocking_pdb: Optional[str] = None
+
+
+# ONE process-wide budget lock shared by every EvictionAPI instance: the
+# callers each construct their own gate over the same store (scheduler,
+# apiserver, nodelifecycle, descheduler, CLI), and the read-modify-write
+# on a PDB's disruptionsAllowed must serialize ACROSS them — two paths
+# both observing disruptionsAllowed == 1 must not evict two pods against
+# a budget of one.  (Per-instance locks would only serialize a caller
+# against itself.)
+_BUDGET_LOCK = lockcheck.maybe_wrap(threading.Lock(),
+                                    "EvictionAPI._budget_lock")
+
+
+class EvictionAPI:
+    """PDB-consulting eviction gate over an ObjectStore-shaped store."""
+
+    def __init__(self, store, recorder=None, clock=time.monotonic):
+        self._store = store
+        self._recorder = recorder
+        self._clock = clock
+        self._lock = _BUDGET_LOCK
+
+    # --- gate queries ---------------------------------------------------------
+
+    def matching_pdbs(
+        self, pod: v1.Pod,
+        pdbs: Optional[Sequence[v1.PodDisruptionBudget]] = None,
+    ) -> List[v1.PodDisruptionBudget]:
+        if pdbs is None:
+            pdbs = self._store.list("PodDisruptionBudget")[0]
+        return [
+            p for p in pdbs
+            if p.metadata.namespace == pod.namespace
+            and p.selector is not None
+            and match_label_selector(p.selector, pod.metadata.labels)
+        ]
+
+    def blocking_pdb(
+        self, pod: v1.Pod,
+        pdbs: Optional[Sequence[v1.PodDisruptionBudget]] = None,
+    ) -> Optional[v1.PodDisruptionBudget]:
+        """The first matching PDB with no disruption budget left, else None."""
+        for p in self.matching_pdbs(pod, pdbs):
+            if p.disruptions_allowed <= 0:
+                return p
+        return None
+
+    def can_evict(
+        self, pod: v1.Pod,
+        pdbs: Optional[Sequence[v1.PodDisruptionBudget]] = None,
+    ) -> bool:
+        return self.blocking_pdb(pod, pdbs) is None
+
+    # --- the gate -------------------------------------------------------------
+
+    def evict(
+        self,
+        pod: v1.Pod,
+        reason: str = "",
+        policy: str = "api",
+        dry_run: bool = False,
+        override_pdb: bool = False,
+        pdbs: Optional[Sequence[v1.PodDisruptionBudget]] = None,
+    ) -> EvictionResult:
+        """One eviction through the gate.
+
+        ``pdbs`` lets batch callers (preemption's per-victim loop) reuse
+        one PDB list instead of re-listing per pod; the budget write-back
+        still goes through the store.  ``override_pdb`` proceeds past an
+        exhausted budget but records it (result "overridden").
+        """
+        with self._lock:
+            if self._store.get("Pod", pod.namespace,
+                               pod.metadata.name) is None:
+                # the reference 404s before any PDB math; this is also the
+                # exactly-once guard for racing eviction paths
+                m.descheduler_evictions.inc((policy, "missing"))
+                return EvictionResult(allowed=True, evicted=False,
+                                      reason="pod already gone")
+            if pdbs is None:
+                # ONE list per eviction, shared by the gate check and the
+                # budget drain — both run under the budget lock
+                pdbs = self._store.list("PodDisruptionBudget")[0]
+            blocking = self.blocking_pdb(pod, pdbs)
+            if blocking is not None and not override_pdb:
+                why = (f"Cannot evict pod as it would violate the pod's "
+                       f"disruption budget "
+                       f"{blocking.metadata.namespace}/"
+                       f"{blocking.metadata.name}")
+                m.descheduler_evictions.inc((policy, "refused"))
+                self._event(pod, "Warning", "EvictionBlocked",
+                            f"{why} ({reason})" if reason else why)
+                return EvictionResult(
+                    allowed=False, reason=why,
+                    blocking_pdb=blocking.metadata.namespace + "/"
+                    + blocking.metadata.name)
+            if dry_run:
+                m.descheduler_evictions.inc((policy, "dry_run"))
+                return EvictionResult(allowed=True)
+            # drain one budget unit from every matching PDB NOW (the
+            # reference decrements disruptionsAllowed in the same
+            # GuaranteedUpdate as the delete): a burst inside one
+            # disruption-controller resync interval sees the drained value
+            self._consume_budget(pod, pdbs)
+            try:
+                gone = self._store.delete(
+                    "Pod", pod.namespace, pod.metadata.name)
+            except Exception as e:
+                # store fault past the client's own retries: surface it as
+                # a result (callers abandon their plan) — the budget unit
+                # stays drained until the next disruption-controller sync,
+                # which recomputes it from live pods (safe: under-, never
+                # over-admits disruptions)
+                m.descheduler_evictions.inc((policy, "error"))
+                klog.V(1).info_s("Eviction store delete failed",
+                                 pod=pod.key(), policy=policy,
+                                 error=f"{type(e).__name__}: {e}")
+                return EvictionResult(
+                    allowed=True, evicted=False,
+                    reason=f"store delete failed: {type(e).__name__}: {e}")
+            if gone is None:
+                m.descheduler_evictions.inc((policy, "missing"))
+                return EvictionResult(allowed=True, evicted=False,
+                                      reason="pod already gone")
+            result = "overridden" if blocking is not None else "evicted"
+            m.descheduler_evictions.inc((policy, result))
+            self._event(pod, "Normal", "Evicted",
+                        f"Evicted by {policy}: {reason}" if reason
+                        else f"Evicted by {policy}")
+            return EvictionResult(
+                allowed=True, evicted=True,
+                blocking_pdb=(blocking.metadata.namespace + "/"
+                              + blocking.metadata.name)
+                if blocking is not None else None)
+
+    def _consume_budget(self, pod: v1.Pod, pdbs) -> None:
+        for pdb in self.matching_pdbs(pod, pdbs):
+            if pdb.disruptions_allowed <= 0:
+                continue  # overridden eviction: nothing left to drain
+            pdb.disruptions_allowed -= 1
+            try:
+                self._store.update("PodDisruptionBudget", pdb)
+            except Exception as e:
+                # best-effort write-back: the disruption controller's next
+                # sync recomputes the status from live pods either way
+                klog.V(2).info_s("PDB budget write-back failed",
+                                 pdb=f"{pdb.metadata.namespace}/"
+                                     f"{pdb.metadata.name}",
+                                 error=f"{type(e).__name__}: {e}")
+
+    def _event(self, pod: v1.Pod, etype: str, evreason: str, msg: str) -> None:
+        if self._recorder is None:
+            return
+        try:
+            self._recorder.eventf(pod, etype, evreason, msg)
+        except Exception as e:
+            # the recorder is best-effort by contract (client/events.py);
+            # an event write must never fail the eviction itself
+            klog.V(2).info_s("Eviction event drop",
+                             pod=pod.key(),
+                             error=f"{type(e).__name__}: {e}")

@@ -40,6 +40,12 @@ class Counter(Metric):
     def value(self, labels: Tuple = ()) -> float:
         return self._v.get(labels, 0.0)
 
+    def items(self) -> Dict[Tuple, float]:
+        """Snapshot of every labeled series (collectors summing across an
+        unbounded label dimension, e.g. per-policy eviction counts)."""
+        with self._lock:
+            return dict(self._v)
+
 
 class Gauge(Metric):
     def __init__(self, name, help_=""):

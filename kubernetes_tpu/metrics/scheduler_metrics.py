@@ -138,3 +138,36 @@ leader_election_status = default_registry.register(
     # leader_election_master_status)
     Gauge("leader_election_master_status")
 )
+
+# --- descheduler subsystem (kubernetes_tpu/descheduler/) ---------------------
+# Emitted at the real decision points: every pod-killing path's verdict at
+# the shared eviction gate, each policy plan's end state in the controller
+# loop, and the device what-if solve latency in the planner.
+
+descheduler_evictions = default_registry.register(
+    # labels: (policy, result) — policy names the calling path
+    # ("defrag" | "spread" | "drain" | "nodelifecycle" | "preemption" |
+    # "api" | ...); result is the gate verdict: "evicted" (gate passed,
+    # pod deleted) | "refused" (a matching PDB had no budget) |
+    # "overridden" (budget exhausted but the caller may violate —
+    # preemption's last-resort contract) | "dry_run" (gate evaluated,
+    # nothing deleted) | "missing" (pod already gone — the exactly-once
+    # guard) | "error" (store fault mid-eviction)
+    Counter("descheduler_evictions_total",
+            "Eviction-gate verdicts, by calling policy")
+)
+descheduler_plans = default_registry.register(
+    # labels: (policy, outcome) — "applied" (every victim evicted) |
+    # "dry_run" (planned + scored, nothing evicted) | "abandoned" (a
+    # mid-plan refusal/fault stopped the plan; remaining victims kept) |
+    # "no_fit" (no candidate plan survived the counterfactual solve)
+    Counter("descheduler_plans_total",
+            "Descheduler plan outcomes, by policy")
+)
+descheduler_planner_duration = default_registry.register(
+    # one observation per counterfactual batched solve (victims masked out
+    # of the forked DeviceSnapshot, assignment program re-run)
+    Histogram("descheduler_planner_solve_duration_seconds",
+              exponential_buckets(0.001, 2, 15),
+              "Device what-if planner solve latency")
+)

@@ -62,6 +62,11 @@ class Workload:
     # gang suites: members per PodGroup — turns on the gangs/s +
     # time-to-full-slice collectors over the measured window
     gang_size: Optional[int] = None
+    # (store, sched) -> controller with sync_once(): a descheduler driven
+    # once per measured cycle (the Defrag suite) — turns on the
+    # evictions/s collector; with gang_size set, TimeToFullSlice doubles
+    # as time-to-free-slice (the window spans defrag + gang bind)
+    make_descheduler: Optional[Callable] = None
 
 
 @dataclass
@@ -117,10 +122,11 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
     from ..utils.compilemon import monitor
 
     monitor.install()
+    desched = (w.make_descheduler(store, sched)
+               if w.make_descheduler is not None else None)
     items: List[DataItem] = []
     node_idx = 0
     pod_idx = 0
-    obj_idx = 0
     for op in w.ops:
         if op.opcode == "createNodes":
             tmpl = op.node_template or default_node
@@ -128,10 +134,13 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 store.create("Node", tmpl(node_idx))
                 node_idx += 1
         elif op.opcode == "createObjects":
-            for _ in range(op.count):
-                kind, obj = op.object_template(obj_idx)
+            # per-OP indices: a workload stacking several createObjects ops
+            # (Defrag: stragglers then PodGroups) numbers each template
+            # from 0, so cross-referencing templates (gang pods naming
+            # their pg-{i}) line up
+            for j in range(op.count):
+                kind, obj = op.object_template(j)
                 store.create(kind, obj)
-                obj_idx += 1
         elif op.opcode == "createPods":
             tmpl = op.pod_template or default_pod
             if op.collect_metrics:
@@ -348,12 +357,18 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         # they diverge once the histogram drops samples
                         n_samp = len(hist.samples())
                         c_pre = monitor.snapshot()[0]
+                        done_pre = done
                         t_cyc = clock()
                         stats = sched.schedule_cycle()
+                        if desched is not None:
+                            desched.sync_once()
                         cycle_durs.append(clock() - t_cyc)
                         if monitor.snapshot()[0] == c_pre:
                             steady.extend(hist.samples()[n_samp:])
-                        if stats.attempted == 0 and stats.in_flight == 0:
+                        if done > done_pre:
+                            t_last_progress = clock()
+                        if stats.attempted == 0 and stats.in_flight == 0 \
+                                and done == done_pre:
                             # queue drained this instant, but pods may be waiting
                             # out their backoff (1s→10s) or the unschedulableQ
                             # flush — the reference's flush goroutines just tick;
@@ -366,7 +381,11 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                             waited += 0.02
                             continue
                         cycle += 1
-                        if stats.scheduled == 0:
+                        # progress = binds observed by the watcher, not
+                        # just this call's own stats: a descheduler's
+                        # quiescence-flush cycles (sync_once) bind pods
+                        # whose stats the harness never sees
+                        if stats.scheduled == 0 and done == done_pre:
                             stall += 1
                             # permanently unschedulable backlog (e.g. the
                             # Unschedulable suite's 9-cpu fillers) — give up
@@ -390,6 +409,21 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         data={"Average": round(throughput, 1)},
                         unit="pods/s",
                     ))
+                    if desched is not None:
+                        evicted = sum(
+                            v for (labels, v)
+                            in m.descheduler_evictions.items().items()
+                            if len(labels) == 2
+                            and labels[1] in ("evicted", "overridden")
+                        )
+                        items.append(DataItem(
+                            labels={"Name": w.name,
+                                    "Metric": "DeschedulerEvictions"},
+                            data={"Count": float(evicted),
+                                  "PerSecond": (round(evicted / total_s, 2)
+                                                if total_s > 0 else 0.0)},
+                            unit="evictions/s",
+                        ))
                     if w.gang_size:
                         gd = sorted(gang_done_t)
 

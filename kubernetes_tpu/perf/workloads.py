@@ -438,6 +438,78 @@ def _gang_basic(n, p, mp) -> Workload:
     )
 
 
+def straggler_per_host() -> Callable[[int], v1.Pod]:
+    """Straggler i lands PRE-BOUND on host i (a 2-cpu pod on a 4-cpu
+    host): with one on EVERY host no slice — and no cross-slice set of
+    hosts — can take a 3-cpu gang member, so the gangs are genuinely
+    blocked until the descheduler frees whole slices.  Pre-binding keeps
+    the fragmentation pattern deterministic and affinity-free (the
+    what-if planner refuses affinity-carrying victims by contract).
+    Warmup indices (≥9M) yield tiny UNBOUND pods that fit beside any
+    straggler — warms must exercise the normal bind path."""
+
+    def tmpl(i: int) -> v1.Pod:
+        if i >= 9_000_000:
+            return (_base_pod(i, "stragwarm", "default")
+                    .req({"cpu": "1m"}).obj())
+        return (
+            _base_pod(i, "strag", "default")
+            .req({"cpu": "2000m", "memory": "500Mi"})
+            .label("strag", "1")
+            .node(f"node-{i:06d}")
+            .obj()
+        )
+
+    return tmpl
+
+
+def _defrag(n, p, mp) -> Workload:
+    """Defrag: every host starts fragmented by a pre-bound straggler; the
+    gangs are unschedulable until the descheduler's slice-defrag policy
+    evicts whole straggler sets (each plan scored by ONE device what-if
+    solve) — measures time-to-free-slice (TimeToFullSlice spans defrag +
+    gang bind) and evictions/s (DeschedulerEvictions)."""
+    from ..descheduler import DeschedulerController, SliceDefragmentation
+
+    gs = GANG_SIZE if mp >= GANG_SIZE else max(2, mp)
+    n_slices = max(1, n // gs)
+    ngangs = max(1, min(mp // gs, n_slices))
+    stragglers = min(p, n) if p else n
+    strag_tmpl = straggler_per_host()
+    gang_tmpl = pod_gang(gs)
+
+    def make_descheduler(store, sched):
+        # 16 gangs served per sync keeps the 5k size (312 waiting gangs)
+        # inside the harness's cycle budget; each freed slice costs gs
+        # straggler evictions
+        return DeschedulerController(
+            store, sched,
+            policies=[SliceDefragmentation(max_gangs_per_sync=16)],
+            max_evictions_per_sync=16 * gs,
+        )
+
+    return Workload(
+        name="Defrag",
+        ops=[
+            Op("createNodes", n, node_template=node_sliced(gs)),
+            # stragglers ride createPods (presize counts them into the pod
+            # tier — no mid-window growth recompile); pre-bound, so the
+            # post-op run_until_idle is a no-op
+            Op("createPods", stragglers, pod_template=strag_tmpl),
+            Op("createObjects", ngangs, object_template=podgroup_template(gs)),
+            # the harness's global pod index continues past the
+            # stragglers: shift so gang pod i still references pg-{i//gs}
+            Op("createPods", ngangs * gs,
+               pod_template=lambda i: gang_tmpl(
+                   i if i >= 9_000_000 else i - stragglers),
+               collect_metrics=True),
+        ],
+        batch_size=64,
+        gang_size=gs,
+        make_descheduler=make_descheduler,
+    )
+
+
 def _mixed_churn(n, p, mp) -> Workload:
     def churn(store, cycle: int):
         # recreate-mode churn (SchedulingWithMixedChurn): one node, one
@@ -527,6 +599,13 @@ SUITES: Dict[str, Suite] = {
         Suite("GangBasic", _gang_basic,
               {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
                "5000Nodes": (5000, 0, 4800)},
+              batch_size={"5000Nodes": 512}),
+        # Descheduler: every HOST fragmented by a pre-bound straggler,
+        # gangs blocked until the defrag policy frees whole slices — see
+        # _defrag
+        Suite("Defrag", _defrag,
+              {"64Nodes": (64, 64, 32), "500Nodes": (512, 512, 256),
+               "5000Nodes": (5000, 5000, 2496)},
               batch_size={"5000Nodes": 512}),
         # extender batch 384: large enough to amortize the per-batch fixed
         # tunnel rounds (fused prepare+first-plane), but UNDER the node

@@ -428,6 +428,15 @@ class TPUScheduler:
         # Scheduled / FailedScheduling events through the store-backed
         # recorder (scheduler.go:386,488)
         self.recorder = EventRecorder(store)
+        from .descheduler.evictions import EvictionAPI
+
+        # preemption victim deletes flow through the shared eviction gate
+        # (descheduler/evictions.py) with override_pdb: the dry-run already
+        # minimized PDB violations in ranking, and the reference's
+        # preemption may violate budgets as a last resort — the gate
+        # records the violation and drains the budget instead of refusing
+        self.eviction_api = EvictionAPI(store, recorder=self.recorder,
+                                        clock=clock)
         self._unwatch = store.watch(self._on_event)
 
     # --- event handlers (eventhandlers.go:251+) ------------------------------
@@ -2152,7 +2161,18 @@ class TPUScheduler:
         if cand is None:
             return None
         for victim in cand.victims:
-            self.store.delete("Pod", victim.namespace, victim.metadata.name)
+            # through the single eviction gate (events + metrics + budget
+            # drain), override_pdb per the preemption last-resort contract;
+            # pdbs reuses the batch-hoisted list — no per-victim store list
+            result = self.eviction_api.evict(
+                victim, reason=f"Preempted by {pod.key()}",
+                policy="preemption", override_pdb=True, pdbs=pdbs)
+            if result.allowed and not result.evicted and result.reason \
+                    and result.reason.startswith("store delete failed"):
+                # transient store fault mid-preemption: surface it to the
+                # call site's degrade-to-nominate-nothing guard, exactly as
+                # the raw store.delete used to
+                raise RuntimeError(result.reason)
         m.preemption_victims.observe(len(cand.victims))
         pod.status.nominated_node_name = cand.node_name
         self._nominated[pod.uid] = (

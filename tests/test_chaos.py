@@ -799,3 +799,164 @@ def test_soak_full_500_pod_acceptance():
     r2 = run_soak(**kw)
     _assert_soak(r2)
     assert r1.determinism_signature() == r2.determinism_signature()
+
+
+# --- descheduler under faults -------------------------------------------------
+
+
+def _fragmented_for_defrag(store, gang_size=4):
+    """2 slices × 4 hosts, every slice fragmented by stragglers so a
+    4-member gang (3 cpu/host vs 2-cpu stragglers on 4-cpu hosts) cannot
+    place anywhere without evictions."""
+    from kubernetes_tpu.api import objects as v1
+    from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
+
+    for i in range(8):
+        store.create("Node", make_node().name(f"n{i:02d}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, f"s{i // 4}").obj())
+    stragglers = []
+    for i, node in enumerate(["n00", "n01", "n04", "n05", "n06"]):
+        name = f"str-{i}"
+        store.create("Pod", make_pod().name(name).uid(name)
+                     .namespace("default").req({"cpu": "2"})
+                     .node(node).obj())
+        stragglers.append(name)
+    pg = v1.PodGroup(
+        metadata=v1.ObjectMeta(name="g", namespace="default"),
+        min_member=gang_size, schedule_timeout_seconds=2)
+    store.create("PodGroup", pg)
+    for i in range(gang_size):
+        store.create("Pod", make_pod().name(f"g-{i}").uid(f"g-{i}")
+                     .namespace("default").label(POD_GROUP_LABEL, "g")
+                     .req({"cpu": "3"}).obj())
+    return stragglers
+
+
+def test_descheduler_converges_under_watch_drops_and_429_storm():
+    """Descheduler convergence under the chaos battery: watch drops + a
+    429/500 write storm may delay evictions and requeues, but the end
+    state converges — each straggler is evicted EXACTLY once (no pod is
+    ever evicted twice), the freed slice is bound by the waiting gang
+    all-or-nothing, and no partial gang placement survives."""
+    from kubernetes_tpu.descheduler import (
+        DeschedulerController,
+        SliceDefragmentation,
+    )
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    fault = FaultSchedule(
+        21, watch_drop_rate=0.1, write_429_rate=0.3, write_500_rate=0.1,
+        conflict_rate=0.1, retry_after=0.0, max_faults_per_key=3,
+    )
+    raw = ObjectStore(fault_injector=fault)
+    store = RetryingStore(raw, sleep=_no_sleep)
+    delete_counts = {}
+
+    def on_ev(ev):
+        from kubernetes_tpu.sim.store import DELETED
+
+        if ev.kind == "Pod" and ev.type == DELETED:
+            delete_counts[ev.obj.uid] = delete_counts.get(ev.obj.uid, 0) + 1
+
+    raw.watch(on_ev)
+    sched = TPUScheduler(store, batch_size=4, pod_initial_backoff=0.01,
+                         pod_max_backoff=0.05, batch_wait=0)
+    stragglers = _fragmented_for_defrag(store)
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SliceDefragmentation()])
+    deadline = time.monotonic() + 60.0
+    done = 0
+    while time.monotonic() < deadline:
+        s = sched.run_until_idle(max_cycles=50, backoff_wait=0.5)
+        ctrl.sync_once()
+        done = sum(
+            1 for i in range(4)
+            if raw.get("Pod", "default", f"g-{i}").spec.node_name
+        )
+        if done == 4 and s.waiting == 0:
+            break
+        time.sleep(0.02)
+    assert done == 4
+    # all-or-nothing into ONE slice
+    from kubernetes_tpu.gang import SLICE_LABEL
+
+    slices = {
+        raw.get("Node", "",
+                raw.get("Pod", "default", f"g-{i}").spec.node_name)
+        .metadata.labels[SLICE_LABEL]
+        for i in range(4)
+    }
+    assert len(slices) == 1
+    # exactly-once evictions: every deleted straggler saw ONE delete event
+    evicted = [s_ for s_ in stragglers
+               if raw.get("Pod", "default", s_) is None]
+    assert evicted, "defrag never evicted anything"
+    for name in evicted:
+        assert delete_counts.get(name, 0) == 1, (name, delete_counts)
+    injected = fault.injected_counts()
+    assert sum(injected.values()) > 0  # the storm actually fired
+
+
+def test_descheduler_mid_plan_fault_abandons_plan():
+    """A store fault mid-plan (delete blows through the client's retries)
+    abandons the remainder of the plan instead of half-applying it: the
+    surviving victims stay put, the outcome is counted 'abandoned', and
+    the NEXT sync re-plans from live state and converges — the cluster
+    ends schedulable."""
+    from kubernetes_tpu.descheduler import (
+        DeschedulerController,
+        EvictionAPI,
+        SliceDefragmentation,
+    )
+    from kubernetes_tpu.metrics import scheduler_metrics as m
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    store = ObjectStore()
+
+    class FlakyDeleteStore:
+        """Raises once on the delete of each named pod — the shape of a
+        429 storm outlasting RetryingStore's max_retries."""
+
+        def __init__(self, inner, fail_once):
+            self._inner = inner
+            self.fail_once = set(fail_once)
+
+        def delete(self, kind, namespace, name):
+            if kind == "Pod" and name in self.fail_once:
+                self.fail_once.discard(name)
+                raise TransientApiError(429, message="injected storm")
+            return self._inner.delete(kind, namespace, name)
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    sched = TPUScheduler(store, batch_size=4, pod_initial_backoff=0.01,
+                         pod_max_backoff=0.05, batch_wait=0)
+    stragglers = _fragmented_for_defrag(store)
+    # the cheapest plan is slice s0 (2 stragglers); fault its SECOND victim
+    flaky = FlakyDeleteStore(store, ["str-1"])
+    ctrl = DeschedulerController(
+        store, sched, policies=[SliceDefragmentation()],
+        eviction_api=EvictionAPI(flaky))
+    before = m.descheduler_plans.value(("defrag", "abandoned"))
+    sched.run_until_idle(max_cycles=20, backoff_wait=0.2)
+    ctrl.sync_once()
+    assert m.descheduler_plans.value(("defrag", "abandoned")) == before + 1.0
+    # not half-applied: the faulted victim survived, and no further victim
+    # of the plan was touched after the fault
+    assert store.get("Pod", "default", "str-1") is not None
+    # the cluster stays schedulable: later syncs re-plan from live state
+    deadline = time.monotonic() + 30.0
+    done = 0
+    while time.monotonic() < deadline:
+        s = sched.run_until_idle(max_cycles=50, backoff_wait=0.5)
+        ctrl.sync_once()
+        done = sum(
+            1 for i in range(4)
+            if store.get("Pod", "default", f"g-{i}").spec.node_name
+        )
+        if done == 4 and s.waiting == 0:
+            break
+        time.sleep(0.02)
+    assert done == 4

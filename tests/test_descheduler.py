@@ -1,0 +1,837 @@
+"""Descheduler subsystem: eviction gate, what-if planner parity, policies,
+controller loop, retrofitted callers, CLI, and the fragmented-cluster
+acceptance scenario (ISSUE 5)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import roundtrips, to_manifest
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.controllers.disruption import sync_pdbs
+from kubernetes_tpu.descheduler import (
+    DRAIN_ANNOTATION,
+    DeschedulerController,
+    EvictionAPI,
+    NodeDrainPolicy,
+    SliceDefragmentation,
+    SpreadViolationRepair,
+    WhatIfPlanner,
+)
+from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _pod(name, labels=None, node="", cpu="2", ns="default", created=None):
+    w = make_pod().name(name).uid(name).namespace(ns).req({"cpu": cpu})
+    for k, v_ in (labels or {}).items():
+        w = w.label(k, v_)
+    if node:
+        w = w.node(node)
+    p = w.obj()
+    if created is not None:
+        p.metadata.creation_timestamp = created
+    return p
+
+
+def _protected(store, match, allowed_now=True, name="pdb"):
+    """A PDB over ``match`` whose budget is exhausted (minAvailable =
+    matching count) unless ``allowed_now``."""
+    pods, _ = store.list("Pod")
+    n = sum(1 for p in pods
+            if all(p.metadata.labels.get(k) == v for k, v in match.items()))
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        selector=v1.LabelSelector(match_labels=match),
+        min_available=(n - 1 if allowed_now else n),
+    )
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    return store.get("PodDisruptionBudget", "default", name)
+
+
+# --- L0: the eviction gate ---------------------------------------------------
+
+
+def test_evict_refused_when_budget_exhausted():
+    store = ObjectStore()
+    p = _pod("p0", {"app": "web"}, node="n0")
+    store.create("Pod", p)
+    pdb = _protected(store, {"app": "web"}, allowed_now=False)
+    assert pdb.disruptions_allowed == 0
+    gate = EvictionAPI(store)
+    r = gate.evict(p, reason="test", policy="drain")
+    assert not r.allowed and not r.evicted
+    assert r.blocking_pdb == "default/pdb"
+    assert "disruption budget" in r.reason
+    assert store.get("Pod", "default", "p0") is not None
+    assert m.descheduler_evictions.value(("drain", "refused")) >= 1.0
+
+
+def test_evict_consumes_budget_within_one_sync_interval():
+    """Two pods allowed, then the drained budget refuses the third — a
+    burst inside one disruption-controller resync cannot overshoot."""
+    store = ObjectStore()
+    for i in range(4):
+        store.create("Pod", _pod(f"p{i}", {"app": "web"}, node="n0"))
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=2)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    gate = EvictionAPI(store)
+    pods, _ = store.list("Pod")
+    results = [gate.evict(p, policy="drain") for p in pods]
+    assert sum(1 for r in results if r.evicted) == 2
+    assert sum(1 for r in results if not r.allowed) == 2
+    # the budget was drained in-object, without waiting for a resync
+    assert store.get("PodDisruptionBudget", "default",
+                     "pdb").disruptions_allowed == 0
+
+
+def test_evict_dry_run_touches_nothing():
+    store = ObjectStore()
+    p = _pod("p0", {"app": "web"}, node="n0")
+    store.create("Pod", p)
+    _protected(store, {"app": "web"}, allowed_now=True)
+    gate = EvictionAPI(store)
+    before = store.get("PodDisruptionBudget", "default",
+                       "pdb").disruptions_allowed
+    r = gate.evict(p, policy="drain", dry_run=True)
+    assert r.allowed and not r.evicted
+    assert store.get("Pod", "default", "p0") is not None
+    assert store.get("PodDisruptionBudget", "default",
+                     "pdb").disruptions_allowed == before
+
+
+def test_evict_override_records_violation():
+    store = ObjectStore()
+    p = _pod("p0", {"app": "web"}, node="n0")
+    store.create("Pod", p)
+    _protected(store, {"app": "web"}, allowed_now=False)
+    gate = EvictionAPI(store)
+    r = gate.evict(p, policy="preemption", override_pdb=True)
+    assert r.allowed and r.evicted
+    assert r.blocking_pdb == "default/pdb"  # the violation is recorded
+    assert store.get("Pod", "default", "p0") is None
+    assert m.descheduler_evictions.value(("preemption", "overridden")) >= 1.0
+
+
+def test_evict_missing_pod_is_not_an_eviction():
+    """Exactly-once: a racing second eviction of the same pod reports
+    'missing' and consumes no budget."""
+    store = ObjectStore()
+    p = _pod("p0", {"app": "web"}, node="n0")
+    store.create("Pod", p)
+    _protected(store, {"app": "web"}, allowed_now=True)
+    gate = EvictionAPI(store)
+    assert gate.evict(p, policy="drain").evicted
+    budget = store.get("PodDisruptionBudget", "default",
+                       "pdb").disruptions_allowed
+    r = gate.evict(p, policy="drain")
+    assert not r.evicted and r.reason == "pod already gone"
+    assert store.get("PodDisruptionBudget", "default",
+                     "pdb").disruptions_allowed == budget
+
+
+def test_evict_emits_events():
+    from kubernetes_tpu.client.events import EventRecorder
+
+    store = ObjectStore()
+    p = _pod("p0", {"app": "web"}, node="n0")
+    store.create("Pod", p)
+    _protected(store, {"app": "web"}, allowed_now=False)
+    rec = EventRecorder(store, source="descheduler")
+    gate = EvictionAPI(store, recorder=rec)
+    gate.evict(p, reason="maintenance", policy="drain")
+    reasons = [e.reason for e in rec.events_for(p)]
+    assert "EvictionBlocked" in reasons
+    # free the budget → the eviction lands and the Evicted event follows
+    pdb = store.get("PodDisruptionBudget", "default", "pdb")
+    pdb.min_available = 0
+    store.update("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    gate.evict(p, reason="maintenance", policy="drain")
+    assert "Evicted" in [e.reason for e in rec.events_for(p)]
+
+
+def test_eviction_object_scheme_roundtrip():
+    scheme = default_scheme()
+    ev = scheme.decode({
+        "apiVersion": "policy/v1", "kind": "Eviction",
+        "metadata": {"name": "p0", "namespace": "ml"},
+        "deleteOptions": {"gracePeriodSeconds": 30},
+    })
+    assert ev.metadata.name == "p0" and ev.grace_period_seconds == 30
+    assert roundtrips(ev, scheme)
+    assert to_manifest(ev, scheme)["apiVersion"] == "policy/v1"
+
+
+# --- L1: retrofitted callers -------------------------------------------------
+
+
+def test_nodelifecycle_eviction_respects_pdb():
+    """The ISSUE 5 bugfix: a not-ready node's sync evicts unprotected pods
+    but can never zero out a PDB-protected workload in one pass; refused
+    pods drain on LATER syncs as budget replenishes."""
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController,
+        UNREACHABLE_TAINT,
+    )
+
+    clock = FakeClock()
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "8", "pods": "10"}).obj())
+    store.create("Lease", _lease("n0", renew_time=0.0))
+    for i in range(3):
+        store.create("Pod", _pod(f"web-{i}", {"app": "web"}, node="n0"))
+    store.create("Pod", _pod("loose", {}, node="n0"))
+    # budget allows exactly ONE web disruption
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=2)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    ctrl = NodeLifecycleController(store, grace_period=1.0, clock=clock)
+    clock.advance(10.0)  # lease stale
+    assert ctrl.sync_once()
+    node = store.get("Node", "", "n0")
+    assert any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+    # the unprotected pod and exactly ONE protected pod were evicted
+    assert store.get("Pod", "default", "loose") is None
+    survivors = [i for i in range(3)
+                 if store.get("Pod", "default", f"web-{i}") is not None]
+    assert len(survivors) == 2
+    # later sync with ONE budget unit replenished (a replacement came up
+    # elsewhere): exactly one more survivor drains
+    store.create("Pod", _pod("web-new", {"app": "web"}, node="n1"))
+    sync_pdbs(store)
+    ctrl.sync_once()
+    left = [i for i in survivors
+            if store.get("Pod", "default", f"web-{i}") is not None]
+    assert len(left) == 1  # one more drained; budget still respected
+
+
+def _lease(node, renew_time):
+    from kubernetes_tpu.client.leaderelection import Lease
+
+    return Lease(metadata=v1.ObjectMeta(name=node,
+                                        namespace="kube-node-lease"),
+                 renew_time=renew_time)
+
+
+def test_preemption_victims_flow_through_gate():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    store.create("Pod", _pod("low", {"app": "low"}, cpu="3"))
+    sched.run_until_idle(backoff_wait=1.0)
+    assert store.get("Pod", "default", "low").spec.node_name == "n0"
+    before = m.descheduler_evictions.value(("preemption", "evicted"))
+    high = make_pod().name("high").uid("high").namespace("default") \
+        .req({"cpu": "3"}).priority(10).obj()
+    store.create("Pod", high)
+    sched.run_until_idle(backoff_wait=1.0)
+    assert store.get("Pod", "default", "low") is None
+    assert store.get("Pod", "default", "high").spec.node_name == "n0"
+    assert m.descheduler_evictions.value(("preemption", "evicted")) \
+        >= before + 1.0
+
+
+def test_apiserver_eviction_subresource():
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = ObjectStore()
+    store.create("Pod", _pod("p0", {"app": "web"}, node="n0"))
+    store.create("Pod", _pod("p1", {"app": "web"}, node="n0"))
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=1)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    srv = APIServer(store).start()
+    try:
+        import urllib.request
+
+        def post_eviction(name):
+            body = json.dumps({
+                "apiVersion": "policy/v1", "kind": "Eviction",
+                "metadata": {"name": name, "namespace": "default"},
+            }).encode()
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods/{name}/eviction",
+                data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert post_eviction("p0") == 201
+        assert store.get("Pod", "default", "p0") is None
+        # budget exhausted now (1 healthy = minAvailable) → 429
+        assert post_eviction("p1") == 429
+        assert store.get("Pod", "default", "p1") is not None
+        assert post_eviction("p0") == 404
+    finally:
+        srv.stop()
+
+
+# --- L2: the what-if planner -------------------------------------------------
+
+
+def _fragmented_cluster(clock, batch_size=8):
+    """3 slices × 4 hosts; s0 fully occupied by PDB-protected stragglers,
+    s1 half-occupied (cheapest viable defrag), s2 fully occupied by loose
+    stragglers; a 4-member gang (cpu 3/host) waits unschedulable — only 2
+    whole-free hosts exist cluster-wide."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=batch_size, clock=clock,
+                         batch_wait=0)
+    for i in range(12):
+        store.create("Node", make_node().name(f"n{i:02d}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, f"s{i // 4}").obj())
+    for i in range(4):
+        store.create("Pod",
+                     _pod(f"prot-{i}", {"app": "prot"}, node=f"n{i:02d}"))
+    store.create("Pod", _pod("str-1a", {}, node="n04"))
+    store.create("Pod", _pod("str-1b", {}, node="n05"))
+    for i in range(4):
+        store.create("Pod",
+                     _pod(f"str-2{chr(97 + i)}", {}, node=f"n{8 + i:02d}"))
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="prot", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "prot"}),
+        min_available=4)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name="g", namespace="default"),
+                     min_member=4, schedule_timeout_seconds=30)
+    pg.metadata.creation_timestamp = 1000.0
+    store.create("PodGroup", pg)
+    for i in range(4):
+        store.create("Pod", _pod(f"g-{i}", {POD_GROUP_LABEL: "g"}, cpu="3",
+                                 created=1000.0))
+    return store, sched
+
+
+def _drive_to_unschedulable(store, sched, clock):
+    for _ in range(6):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    clock.advance(40.0)  # fail any Permit hold so nothing stays assumed
+    sched.schedule_cycle()
+    assert not any(store.get("Pod", "default", f"g-{i}").spec.node_name
+                   for i in range(4))
+
+
+def test_e2e_defrag_parity_and_minimal_victims():
+    """THE acceptance scenario: a fragmented cluster where a waiting gang
+    is Unschedulable converges — the defrag policy evicts a minimal
+    victim set (never violating a PDB), the freed slice is bound by the
+    gang all-or-nothing, and the dry-run planner's predicted placements
+    match the scheduler's actual post-eviction bindings bit-for-bit."""
+    clock = FakeClock()
+    store, sched = _fragmented_cluster(clock)
+    _drive_to_unschedulable(store, sched, clock)
+
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SliceDefragmentation()])
+    assert ctrl.sync_once() is True
+    scored = ctrl.last_plans["defrag"]
+    # minimal victim set: slice s1's two stragglers, NOT the protected s0
+    # single... s0 needs 4 evictions and is PDB-blocked anyway
+    assert sorted(p.metadata.name for p in scored.plan.victims) == \
+        ["str-1a", "str-1b"]
+    assert scored.slices_freed == 1
+    assert scored.replacements_found == 2  # both stragglers re-place
+    assert store.get("Pod", "default", "str-1a") is None
+    assert store.get("Pod", "default", "str-1b") is None
+    assert m.descheduler_plans.value(("defrag", "applied")) >= 1.0
+
+    sched.run_until_idle(backoff_wait=2.0)
+    # PDB never violated: every protected pod survived
+    assert all(store.get("Pod", "default", f"prot-{i}") is not None
+               for i in range(4))
+    # the gang bound all-or-nothing into the freed slice
+    slices = set()
+    for i in range(4):
+        node = store.get("Pod", "default", f"g-{i}").spec.node_name
+        assert node, f"g-{i} unbound"
+        slices.add(store.get("Node", "", node).metadata.labels[SLICE_LABEL])
+    assert slices == {"s1"}
+    # parity: predicted placements == actual bindings, bit for bit
+    pred = scored.prediction
+    assert pred is not None and pred.unplaced == 0
+    for pod in pred.pods:
+        actual = store.get("Pod", "default", pod.metadata.name).spec.node_name
+        assert actual == pred.placements[pod.uid], (
+            pod.metadata.name, actual, pred.placements[pod.uid])
+    assert store.get("PodGroup", "default", "g").phase == \
+        v1.POD_GROUP_SCHEDULED
+
+
+def test_dry_run_mode_scores_but_evicts_nothing():
+    clock = FakeClock()
+    store, sched = _fragmented_cluster(clock)
+    _drive_to_unschedulable(store, sched, clock)
+    pods_before = {p.metadata.name for p in store.list("Pod")[0]}
+    ctrl = DeschedulerController(store, sched, dry_run=True,
+                                 policies=[SliceDefragmentation()])
+    assert ctrl.sync_once() is False  # nothing changed
+    scored = ctrl.last_plans["defrag"]
+    assert scored.prediction is not None and scored.prediction.placed == 4
+    assert {p.metadata.name for p in store.list("Pod")[0]} == pods_before
+    assert m.descheduler_plans.value(("defrag", "dry_run")) >= 1.0
+
+
+def test_planner_refuses_affinity_victims():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    vic = (make_pod().name("vic").uid("vic").namespace("default")
+           .req({"cpu": "1"}).label("color", "g")
+           .pod_affinity("kubernetes.io/hostname", {"color": "g"}, anti=True)
+           .node("n0").obj())
+    store.create("Pod", vic)
+    pending = _pod("pend", {}, cpu="1")  # what-if only, never created
+    planner = WhatIfPlanner(sched)
+    # aff_* tables are not masked: the planner must refuse, not mispredict
+    assert planner.predict([pending], [vic]) is None
+
+
+def test_planner_does_not_disturb_live_state():
+    """A predict() must not change what the real scheduler then does with
+    NO evictions applied: the fork is never committed."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    for i in range(2):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    vic = _pod("vic", {}, node="n0", cpu="3")
+    store.create("Pod", vic)
+    sched.schedule_cycle()
+    planner = WhatIfPlanner(sched)
+    pend = _pod("pend", {}, cpu="3")
+    pred = planner.predict([pend], [vic])
+    assert pred is not None
+    # counterfactually the pending pod may take n0 (victim masked)…
+    assert pred.placements["pend"] in ("n0", "n1")
+    # …but live state still has the victim: scheduling `pend` for real
+    # must land it on n1 (n0's 3 cpu are still taken)
+    store.create("Pod", pend)
+    sched.run_until_idle(backoff_wait=1.0)
+    assert store.get("Pod", "default", "vic") is not None
+    assert store.get("Pod", "default", "pend").spec.node_name == "n1"
+
+
+# --- L3: policies + controller ----------------------------------------------
+
+
+def test_spread_violation_repair():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "8", "pods": "10"})
+                     .label("topology.kubernetes.io/zone",
+                            "za" if i < 2 else "zb").obj())
+
+    def spread_pod(name, node, created):
+        p = (make_pod().name(name).uid(name).namespace("default")
+             .req({"cpu": "1"}).label("app", "s")
+             .topology_spread(1, "topology.kubernetes.io/zone",
+                              labels={"app": "s"})
+             .obj())
+        p.spec.node_name = node
+        p.metadata.creation_timestamp = created
+        return p
+
+    # drifted: 3 matching pods in za, 0 in zb → skew 3 > maxSkew 1
+    for i in range(3):
+        store.create("Pod", spread_pod(f"s{i}", f"n{i % 2}", 100.0 + i))
+    sched.schedule_cycle()  # snapshot the bound pods
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SpreadViolationRepair()])
+    assert ctrl.sync_once() is True
+    scored = ctrl.last_plans["spread"]
+    # the youngest crowded-domain pod was evicted
+    assert [p.metadata.name for p in scored.plan.victims] == ["s2"]
+    assert store.get("Pod", "default", "s2") is None
+    # its what-if replacement landed OUTSIDE the crowded domain
+    clone_uid = scored.plan.pending[0].uid
+    target = scored.prediction.placements[clone_uid]
+    assert target in ("n2", "n3")
+
+
+def test_spread_repair_noop_when_within_skew():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    for i in range(2):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "8", "pods": "10"})
+                     .label("topology.kubernetes.io/zone", f"z{i}").obj())
+    p = (make_pod().name("s0").uid("s0").namespace("default")
+         .req({"cpu": "1"}).label("app", "s")
+         .topology_spread(1, "topology.kubernetes.io/zone",
+                          labels={"app": "s"}).obj())
+    p.spec.node_name = "n0"
+    store.create("Pod", p)
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SpreadViolationRepair()])
+    assert ctrl.sync_once() is False
+    assert store.get("Pod", "default", "s0") is not None
+
+
+def test_drain_policy_cordons_and_defers_protected():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    node = make_node().name("n0").capacity({"cpu": "8", "pods": "10"}).obj()
+    node.metadata.annotations[DRAIN_ANNOTATION] = "true"
+    store.create("Node", node)
+    store.create("Pod", _pod("loose", {}, node="n0"))
+    store.create("Pod", _pod("web-0", {"app": "web"}, node="n0"))
+    store.create("Pod", _pod("web-1", {"app": "web"}, node="n1"))
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=2)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    ctrl = DeschedulerController(store, sched, policies=[NodeDrainPolicy()])
+    assert ctrl.sync_once() is True
+    assert store.get("Node", "", "n0").spec.unschedulable  # cordoned
+    assert store.get("Pod", "default", "loose") is None
+    # the protected pod is DEFERRED (policy pre-filter), not violated
+    assert store.get("Pod", "default", "web-0") is not None
+    # budget replenishes → a later sync finishes the drain
+    store.create("Pod", _pod("web-2", {"app": "web"}, node="n1"))
+    sync_pdbs(store)
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "web-0") is None
+
+
+def test_controller_rate_limit_caps_evictions_per_sync():
+    clock = FakeClock()
+    store, sched = _fragmented_cluster(clock)
+    _drive_to_unschedulable(store, sched, clock)
+    ctrl = DeschedulerController(store, sched, max_evictions_per_sync=1,
+                                 policies=[SliceDefragmentation()])
+    # the cheapest plan needs 2 evictions > cap 1: nothing may be applied
+    # (a partial slice eviction would disrupt without freeing anything)
+    assert ctrl.sync_once() is False
+    assert store.get("Pod", "default", "str-1a") is not None
+    assert store.get("Pod", "default", "str-1b") is not None
+
+
+def test_controller_min_interval_spaces_active_syncs():
+    clock = FakeClock()
+    store, sched = _fragmented_cluster(clock)
+    _drive_to_unschedulable(store, sched, clock)
+    ctrl = DeschedulerController(store, sched, min_interval=100.0,
+                                 policies=[SliceDefragmentation()])
+    assert ctrl.sync_once() is True
+    # a second gang's worth of demand appears immediately — but the rate
+    # limiter holds until the interval elapses
+    assert ctrl.sync_once() is False
+    clock.advance(101.0)
+    ctrl.sync_once()  # allowed again (no demand left is fine)
+
+
+def test_mid_plan_refusal_abandons_plan():
+    """A victim refused mid-plan (budget raced away between scoring and
+    apply) stops the plan: remaining victims stay, outcome 'abandoned'."""
+    clock = FakeClock()
+    store, sched = _fragmented_cluster(clock)
+    _drive_to_unschedulable(store, sched, clock)
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SliceDefragmentation()])
+    before = m.descheduler_plans.value(("defrag", "abandoned"))
+
+    # race: after scoring, a PDB claims the s1 stragglers with zero budget
+    real_score = ctrl.score
+
+    def score_then_protect(plan):
+        scored = real_score(plan)
+        if scored.viable and not store.get(
+                "PodDisruptionBudget", "default", "race"):
+            for v_ in plan.victims:
+                v_.metadata.labels["raced"] = "1"
+                store.update("Pod", v_)
+            pdb = v1.PodDisruptionBudget(
+                metadata=v1.ObjectMeta(name="race", namespace="default"),
+                selector=v1.LabelSelector(match_labels={"raced": "1"}),
+                min_available=len(plan.victims))
+            store.create("PodDisruptionBudget", pdb)
+            sync_pdbs(store)
+        return scored
+
+    ctrl.score = score_then_protect
+    ctrl.sync_once()
+    assert m.descheduler_plans.value(("defrag", "abandoned")) == before + 1.0
+    # not half-applied: both stragglers still present, cluster intact
+    assert store.get("Pod", "default", "str-1a") is not None
+    assert store.get("Pod", "default", "str-1b") is not None
+
+
+# --- L4: CLI -----------------------------------------------------------------
+
+
+def test_cli_drain_dry_run_and_pdb_block():
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "8", "pods": "10"}).obj())
+    store.create("Pod", _pod("loose", {}, node="n0"))
+    store.create("Pod", _pod("web-0", {"app": "web"}, node="n0"))
+    _protected(store, {"app": "web"}, allowed_now=False)
+    k = Kubectl(store)
+    out = k.drain("n0", dry_run=True)
+    assert "1 pods would evict" in out
+    assert "default/web-0 (pdb default/pdb)" in out
+    assert store.get("Pod", "default", "loose") is not None
+    assert not store.get("Node", "", "n0").spec.unschedulable
+    out = k.drain("n0")
+    assert "1 pods evicted" in out and "blocked by disruption budget" in out
+    assert store.get("Node", "", "n0").spec.unschedulable
+    assert store.get("Pod", "default", "loose") is None
+    assert store.get("Pod", "default", "web-0") is not None
+
+
+def test_cli_get_slices_fragmentation_view():
+    store = ObjectStore()
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, f"s{i // 2}").obj())
+    # s0: one host half-used (stranded free cpu), s1: whole-free
+    store.create("Pod", _pod("p0", {}, node="n0", cpu="2"))
+    k = Kubectl(store)
+    out = k.get("slices")
+    lines = out.splitlines()
+    assert lines[0].split() == ["NAME", "HOSTS", "FREE-HOSTS", "FREE-CHIPS",
+                                "FRAGMENTATION"]
+    rows = {ln.split()[0]: ln.split() for ln in lines[1:]}
+    # s0: 2 hosts, 1 empty; free = 2 + 4 = 6, stranded = 2 → 33%
+    assert rows["s0"] == ["s0", "2", "1", "6", "33%"]
+    # s1: all free on empty hosts → 0% fragmentation
+    assert rows["s1"] == ["s1", "2", "2", "8", "0%"]
+
+
+def test_cli_main_drain_and_slices(capsys):
+    from kubernetes_tpu import cli
+
+    # in-process store per invocation: just verify the verbs parse + print
+    rc = cli.main(["drain", "missing-node"])
+    assert rc == 0
+    assert "not found" in capsys.readouterr().out
+    rc = cli.main(["get", "slices"])
+    assert rc == 0
+    assert "FRAGMENTATION" in capsys.readouterr().out
+
+
+def test_drain_plan_chunks_to_eviction_budget():
+    """A drain bigger than max_evictions_per_sync drains in chunks across
+    syncs (drain evictions are independent) instead of never."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    node = make_node().name("n0").capacity({"cpu": "32", "pods": "20"}).obj()
+    node.metadata.annotations[DRAIN_ANNOTATION] = "true"
+    store.create("Node", node)
+    for i in range(5):
+        store.create("Pod", _pod(f"p{i}", {}, node="n0", cpu="1"))
+    ctrl = DeschedulerController(store, sched, max_evictions_per_sync=2,
+                                 policies=[NodeDrainPolicy()])
+    assert ctrl.sync_once() is True
+    remaining = [i for i in range(5)
+                 if store.get("Pod", "default", f"p{i}") is not None]
+    assert len(remaining) == 3  # chunked to the budget, not skipped
+    ctrl.sync_once()
+    ctrl.sync_once()
+    assert all(store.get("Pod", "default", f"p{i}") is None
+               for i in range(5))
+
+
+def test_drain_policy_dry_run_does_not_cordon():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
+    node = make_node().name("n0").capacity({"cpu": "8", "pods": "10"}).obj()
+    node.metadata.annotations[DRAIN_ANNOTATION] = "true"
+    store.create("Node", node)
+    store.create("Pod", _pod("p0", {}, node="n0"))
+    ctrl = DeschedulerController(store, sched, dry_run=True,
+                                 policies=[NodeDrainPolicy()])
+    assert ctrl.sync_once() is False
+    # the preview must not cordon the node or touch the pod
+    assert not store.get("Node", "", "n0").spec.unschedulable
+    assert store.get("Pod", "default", "p0") is not None
+
+
+def test_defrag_never_evicts_another_gangs_members():
+    """A slice hosting a PLACED gang is disqualified outright: destroying
+    a running gang to seat a waiting one is never a plan."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    for i in range(8):
+        store.create("Node", make_node().name(f"n{i:02d}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, f"s{i // 4}").obj())
+    # gang A placed across slice s0 (bound members)
+    pga = v1.PodGroup(metadata=v1.ObjectMeta(name="ga", namespace="default"),
+                      min_member=4)
+    pga.phase = v1.POD_GROUP_SCHEDULED
+    store.create("PodGroup", pga)
+    for i in range(4):
+        store.create("Pod", _pod(f"ga-{i}", {POD_GROUP_LABEL: "ga"},
+                                 node=f"n{i:02d}", cpu="3"))
+    # slice s1 fragmented by plain stragglers
+    for i in range(4):
+        store.create("Pod", _pod(f"str-{i}", {}, node=f"n{4 + i:02d}"))
+    # gang B waits
+    pgb = v1.PodGroup(metadata=v1.ObjectMeta(name="gb", namespace="default"),
+                      min_member=4, schedule_timeout_seconds=30)
+    pgb.metadata.creation_timestamp = 1000.0
+    store.create("PodGroup", pgb)
+    for i in range(4):
+        store.create("Pod", _pod(f"gb-{i}", {POD_GROUP_LABEL: "gb"},
+                                 cpu="3", created=1000.0))
+    for _ in range(4):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    clock.advance(40.0)
+    sched.schedule_cycle()
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SliceDefragmentation()])
+    ctrl.sync_once()
+    # gang A untouched — the only viable plan was s1's plain stragglers
+    assert all(store.get("Pod", "default", f"ga-{i}") is not None
+               for i in range(4))
+    assert all(store.get("Pod", "default", f"str-{i}") is None
+               for i in range(4))
+    sched.run_until_idle(backoff_wait=2.0)
+    assert all(store.get("Pod", "default", f"gb-{i}").spec.node_name
+               for i in range(4))
+
+
+def test_defrag_ignores_undersized_free_slice():
+    """A straggler-free slice TOO SMALL to seat the gang must not satisfy
+    the free-slice short-circuit — the evictable fix still applies."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    # slice s0: only 2 hosts (undersized, empty); slice s1: 4 fragmented
+    for i in range(2):
+        store.create("Node", make_node().name(f"small-{i}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, "s0").obj())
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, "s1").obj())
+        store.create("Pod", _pod(f"str-{i}", {}, node=f"n{i}"))
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name="g", namespace="default"),
+                     min_member=4, schedule_timeout_seconds=30)
+    pg.metadata.creation_timestamp = 1000.0
+    store.create("PodGroup", pg)
+    for i in range(4):
+        store.create("Pod", _pod(f"g-{i}", {POD_GROUP_LABEL: "g"}, cpu="3",
+                                 created=1000.0))
+    for _ in range(4):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    clock.advance(40.0)
+    sched.schedule_cycle()
+    ctrl = DeschedulerController(store, sched,
+                                 policies=[SliceDefragmentation()])
+    assert ctrl.sync_once() is True  # s0 (2 hosts) must not block the plan
+    assert all(store.get("Pod", "default", f"str-{i}") is None
+               for i in range(4))
+    sched.run_until_idle(backoff_wait=2.0)
+    assert all(store.get("Pod", "default", f"g-{i}").spec.node_name
+               for i in range(4))
+
+
+def test_apiserver_eviction_body_name_mismatch_400():
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = ObjectStore()
+    store.create("Pod", _pod("p0", {}, node="n0"))
+    srv = APIServer(store).start()
+    try:
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps({
+            "apiVersion": "policy/v1", "kind": "Eviction",
+            "metadata": {"name": "other-pod", "namespace": "default"},
+        }).encode()
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/namespaces/default/pods/p0/eviction",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+        assert store.get("Pod", "default", "p0") is not None
+    finally:
+        srv.stop()
+
+
+def test_cli_drain_over_server_uses_eviction_subresource():
+    """--server drains route through the SERVER's gate (POST eviction),
+    so a PDB with zero budget answers 429 and the pod survives — no
+    client-local check-then-delete race."""
+    from kubernetes_tpu.apiserver import APIServer, HTTPApiClient
+    from kubernetes_tpu.apiserver.client import HTTPStoreFacade
+
+    store = ObjectStore()
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "8", "pods": "10"}).obj())
+    store.create("Pod", _pod("loose", {}, node="n0"))
+    store.create("Pod", _pod("web-0", {"app": "web"}, node="n0"))
+    _protected(store, {"app": "web"}, allowed_now=False)
+    srv = APIServer(store).start()
+    try:
+        facade = HTTPStoreFacade(HTTPApiClient(srv.url, max_retries=1))
+        k = Kubectl(facade)
+        out = k.drain("n0")
+        assert "1 pods evicted" in out
+        assert "disruption budget" in out
+        assert store.get("Pod", "default", "loose") is None
+        assert store.get("Pod", "default", "web-0") is not None
+        assert store.get("Node", "", "n0").spec.unschedulable
+    finally:
+        srv.stop()

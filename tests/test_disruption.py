@@ -1,0 +1,177 @@
+"""Disruption controller edge cases: _parse_intstr scaling/rounding and
+sync_pdbs over percentage forms, maxUnavailable vs minAvailable, zero
+replicas, and PDBs matching no pods."""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.controllers.disruption import (
+    DisruptionController,
+    _parse_intstr,
+    sync_pdbs,
+)
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_pod
+
+
+# --- _parse_intstr -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("value,total,expected", [
+    (None, 10, 0),            # absent → 0
+    (3, 10, 3),               # plain int passthrough, total ignored
+    (3, 0, 3),
+    ("3", 10, 3),             # numeric string
+    ("50%", 3, 2),            # ceil(1.5) — GetScaledValueFromIntOrPercent roundUp
+    ("50%", 4, 2),            # exact
+    ("0%", 7, 0),
+    ("100%", 7, 7),
+    ("100%", 0, 0),           # zero total: any percent scales to 0
+    ("33%", 1, 1),            # ceil(0.33)
+    (" 25% ", 8, 2),          # whitespace tolerated
+])
+def test_parse_intstr(value, total, expected):
+    assert _parse_intstr(value, total) == expected
+
+
+# --- sync_pdbs ----------------------------------------------------------------
+
+
+def _pdb(name, match, min_available=None, max_unavailable=None):
+    return v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        selector=v1.LabelSelector(match_labels=match),
+        min_available=min_available, max_unavailable=max_unavailable,
+    )
+
+
+def _pod(name, labels, node=""):
+    w = make_pod().name(name).uid(name).namespace("default")
+    for k, v_ in labels.items():
+        w = w.label(k, v_)
+    if node:
+        w = w.node(node)
+    return w.obj()
+
+
+def _status(store, name):
+    p = store.get("PodDisruptionBudget", "default", name)
+    return (p.expected_pods, p.current_healthy, p.desired_healthy,
+            p.disruptions_allowed)
+
+
+def test_min_available_int_and_unbound_pods_unhealthy():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             min_available=2))
+    for i in range(3):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"},
+                                 node="n0" if i < 2 else ""))
+    assert sync_pdbs(store) == 1
+    # 3 expected, 2 healthy (bound), desired 2 → 0 allowed
+    assert _status(store, "b") == (3, 2, 2, 0)
+
+
+def test_min_available_percentage_rounds_up():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             min_available="50%"))
+    for i in range(3):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"}, node="n0"))
+    sync_pdbs(store)
+    # desired = ceil(1.5) = 2 → allowed = 3 - 2 = 1 (roundUp protects pods)
+    assert _status(store, "b") == (3, 3, 2, 1)
+
+
+def test_max_unavailable_int():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             max_unavailable=1))
+    for i in range(4):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"}, node="n0"))
+    sync_pdbs(store)
+    # desired = 4 - 1 = 3 → allowed = 1
+    assert _status(store, "b") == (4, 4, 3, 1)
+
+
+def test_max_unavailable_percentage():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             max_unavailable="50%"))
+    for i in range(3):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"}, node="n0"))
+    sync_pdbs(store)
+    # scaled = ceil(1.5) = 2, desired = 3 - 2 = 1 → allowed = 2
+    assert _status(store, "b") == (3, 3, 1, 2)
+
+
+def test_pdb_matching_no_pods():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "nothing"},
+                                             min_available=1))
+    store.create("Pod", _pod("p0", {"app": "other"}, node="n0"))
+    sync_pdbs(store)
+    # zero-replica selector: expected 0, desired max(0, 1) = 1, allowed 0
+    assert _status(store, "b") == (0, 0, 1, 0)
+
+
+def test_pdb_zero_replicas_max_unavailable_percent():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "none"},
+                                             max_unavailable="50%"))
+    sync_pdbs(store)
+    # expected 0 → desired max(0, 0 - 0) = 0, allowed 0 (never negative)
+    assert _status(store, "b") == (0, 0, 0, 0)
+
+
+def test_pdb_without_spec_allows_all_healthy():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"}))
+    for i in range(2):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"}, node="n0"))
+    sync_pdbs(store)
+    # neither minAvailable nor maxUnavailable: desired 0 → all disruptible
+    assert _status(store, "b") == (2, 2, 0, 2)
+
+
+def test_pdb_none_selector_matches_nothing():
+    store = ObjectStore()
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="b", namespace="default"),
+        selector=None, min_available=1)
+    store.create("PodDisruptionBudget", pdb)
+    store.create("Pod", _pod("p0", {"app": "a"}, node="n0"))
+    sync_pdbs(store)
+    assert _status(store, "b") == (0, 0, 1, 0)
+
+
+def test_namespace_isolation():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             min_available=1))
+    other = _pod("p-other", {"app": "a"}, node="n0")
+    other.metadata.namespace = "elsewhere"
+    store.create("Pod", other)
+    sync_pdbs(store)
+    # the other-namespace pod must not count toward this PDB
+    assert _status(store, "b") == (0, 0, 1, 0)
+
+
+def test_sync_idempotent_and_replenishes():
+    store = ObjectStore()
+    store.create("PodDisruptionBudget", _pdb("b", {"app": "a"},
+                                             min_available=2))
+    for i in range(3):
+        store.create("Pod", _pod(f"p{i}", {"app": "a"}, node="n0"))
+    ctrl = DisruptionController(store)
+    assert ctrl.sync_once() is True
+    assert ctrl.sync_once() is False  # no further updates: stable status
+    assert _status(store, "b") == (3, 3, 2, 1)
+    # a victim disappears → budget drains on the next sync
+    store.delete("Pod", "default", "p0")
+    assert ctrl.sync_once() is True
+    assert _status(store, "b") == (2, 2, 2, 0)
+    # replacement arrives bound → budget replenishes
+    store.create("Pod", _pod("p3", {"app": "a"}, node="n1"))
+    assert ctrl.sync_once() is True
+    assert _status(store, "b") == (3, 3, 2, 1)

@@ -57,3 +57,19 @@ def test_all_reference_sizes_listed():
     # the two north-star-relevant entries exist with reference params
     assert SUITES["SchedulingBasic"].sizes["5000Nodes"] == (5000, 1000, 1000)
     assert SUITES["NorthStar"].sizes["5000Nodes/10000Pods"] == (5000, 2000, 10000)
+
+
+def test_defrag_suite_frees_slices_and_counts_evictions():
+    """Defrag: every slice fragmented by a pre-bound straggler; the
+    descheduler must evict straggler sets so the gangs assemble — the
+    suite reports evictions/s plus time-to-free-slice (TimeToFullSlice
+    spans defrag + gang bind)."""
+    w = build_workload("Defrag", "64Nodes")
+    w.batch_size = 8
+    items = run_workload(w)
+    by_metric = {i.labels["Metric"]: i for i in items}
+    ev = by_metric["DeschedulerEvictions"].data
+    assert ev["Count"] >= 1.0  # defrag actually evicted stragglers
+    assert by_metric["GangThroughput"].data["Gangs"] >= 1
+    ttfs = by_metric["TimeToFullSlice"].data
+    assert ttfs["Max"] >= ttfs["Perc50"] >= 0.0

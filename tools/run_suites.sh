@@ -18,6 +18,11 @@ python tools/analyze.py --check > /dev/null || { echo "FAILED: static analysis g
 # the expensive suites, same rationale as the analyzer gate above
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_gang.py tests/test_permit.py -q \
   || { echo "FAILED: gang test gate" >> suites_run.log; exit 1; }
+# descheduler gate: the eviction-API + planner-parity + disruption battery
+# is cheap and conclusive — the Defrag suite below is meaningless if the
+# planner's predictions or the PDB gate are broken
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_descheduler.py tests/test_disruption.py -q \
+  || { echo "FAILED: descheduler test gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -66,6 +71,7 @@ run Unschedulable 5000Nodes/200InitPods
 run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
 run GangBasic 5000Nodes
+run Defrag 5000Nodes
 run SchedulingExtender 500Nodes
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
