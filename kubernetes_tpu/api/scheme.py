@@ -140,6 +140,10 @@ def default_scheme() -> Scheme:
     s.add_known_type("scheduling.k8s.io", "v1", v1.PriorityClass)
     # coscheduling CRD (sigs.k8s.io/scheduler-plugins) — the gang unit
     s.add_known_type("scheduling.x-k8s.io", "v1alpha1", v1.PodGroup)
+    # cluster-autoscaler capacity unit (kubernetes_tpu/autoscaler)
+    from ..autoscaler.api import NodeGroup
+
+    s.add_known_type("autoscaling.x-k8s.io", "v1alpha1", NodeGroup)
     for typ in (v1.ReplicaSet, v1.Deployment, v1.StatefulSet, v1.DaemonSet):
         s.add_known_type("apps", "v1", typ)
     s.add_known_type("batch", "v1", v1.Job)

@@ -256,6 +256,17 @@ def _spec_status(obj) -> Dict[str, Any]:
                 ]}
     if isinstance(obj, v1.ServiceAccount):
         return {"secrets": list(obj.secrets)}
+    if obj.__class__.__name__ == "NodeGroup":
+        # name-based dispatch like the HPA below: the type lives in the
+        # autoscaler package and importing it here would cycle
+        tmpl: Dict[str, Any] = {"capacity": dict(obj.capacity),
+                                "labels": dict(obj.labels),
+                                "taints": _ser(obj.taints)}
+        if obj.slice_size:
+            tmpl["sliceSize"] = obj.slice_size
+        return {"spec": {"minSize": obj.min_size, "maxSize": obj.max_size,
+                         "costPerNode": obj.cost_per_node,
+                         "template": tmpl}}
     if obj.__class__.__name__ == "HorizontalPodAutoscaler":
         return {"spec": {
             "scaleTargetRef": {"kind": obj.target_kind,

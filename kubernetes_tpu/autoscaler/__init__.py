@@ -1,0 +1,30 @@
+"""Cluster autoscaler on the unified whatif engine.
+
+Layer map (COMPONENTS.md has the upstream-analogue table):
+  api.py        — NodeGroup API object (min/max size, template node shape
+                  incl. the ``tpu.kubernetes.io/slice`` topology) +
+                  deterministic node materialization
+  controller.py — demand watch (starved PodGroups + unschedulableQ),
+                  vmapped scale-up simulation, eviction-gated scale-down
+"""
+
+from .api import (
+    NODE_GROUP_LABEL,
+    NodeGroup,
+    materialize_nodes,
+    member_nodes,
+    next_node_index,
+    next_slice_index,
+)
+from .controller import ClusterAutoscaler, ScaleDecision
+
+__all__ = [
+    "NODE_GROUP_LABEL",
+    "NodeGroup",
+    "materialize_nodes",
+    "member_nodes",
+    "next_node_index",
+    "next_slice_index",
+    "ClusterAutoscaler",
+    "ScaleDecision",
+]

@@ -1,0 +1,131 @@
+"""NodeGroup: the cluster-autoscaler's scalable capacity unit.
+
+Reference: kubernetes/autoscaler cluster-autoscaler — a NodeGroup is the
+provider-side "set of nodes with the same template" (cloudprovider.NodeGroup:
+MinSize/MaxSize/TemplateNodeInfo); the simulator builds template NodeInfos
+from it to what-if scale-ups.  Here the group is a first-class API object
+(served at autoscaling.x-k8s.io/v1alpha1 like the PodGroup CRD) whose
+template carries the TPU host shape — capacity, labels, taints, and the
+``tpu.kubernetes.io/slice`` topology: ``slice_size`` > 0 batches new hosts
+into fresh whole slices so a scaled-up group is immediately gang-anchorable.
+
+Membership: live nodes carry ``autoscaler.tpu.kubernetes.io/node-group`` =
+group name (the analog of the provider's instance-group tagging); the
+controller derives current size from that label, never from a stored
+status counter — exactly-once under chaos falls out of deterministic node
+names plus live-state recount.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from ..api import objects as v1
+
+# Live nodes are tied to their group via this label (provider tag analog).
+NODE_GROUP_LABEL = "autoscaler.tpu.kubernetes.io/node-group"
+
+
+@dataclass
+class NodeGroup:
+    """autoscaling.x-k8s.io/v1alpha1 NodeGroup — min/max size + the
+    template node shape scale-ups materialize."""
+
+    metadata: v1.ObjectMeta = field(default_factory=v1.ObjectMeta)
+    min_size: int = 0
+    max_size: int = 1
+    # template node shape
+    capacity: Dict[str, object] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[v1.Taint] = field(default_factory=list)
+    # >0: new hosts are batched into fresh ``tpu.kubernetes.io/slice``
+    # groups of this many (one multi-host TPU slice per batch)
+    slice_size: int = 0
+    # relative cost unit for "cheapest group that fits" ranking (the
+    # expander's price analog); scale-up cost = count × cost_per_node
+    cost_per_node: float = 1.0
+
+    kind = "NodeGroup"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "NodeGroup":
+        spec = d.get("spec") or {}
+        tmpl = spec.get("template") or {}
+        return cls(
+            metadata=v1.ObjectMeta.from_dict(d.get("metadata") or {}),
+            min_size=int(spec.get("minSize", 0)),
+            max_size=int(spec.get("maxSize", 1)),
+            capacity=dict(tmpl.get("capacity") or {}),
+            labels=dict(tmpl.get("labels") or {}),
+            taints=[v1.Taint.from_dict(t) for t in tmpl.get("taints") or []],
+            slice_size=int(tmpl.get("sliceSize", 0)),
+            cost_per_node=float(spec.get("costPerNode", 1.0)),
+        )
+
+
+def member_nodes(group: NodeGroup, nodes: List[v1.Node]) -> List[v1.Node]:
+    """Live nodes belonging to the group (label-tagged membership)."""
+    return [n for n in nodes
+            if n.metadata.labels.get(NODE_GROUP_LABEL) == group.name]
+
+
+def _trailing_index(name: str, prefix: str) -> int:
+    """Parse the numeric suffix of ``{prefix}{i}``; -1 when not ours."""
+    if not name.startswith(prefix):
+        return -1
+    tail = name[len(prefix):]
+    return int(tail) if tail.isdigit() else -1
+
+
+def next_node_index(group: NodeGroup, nodes: List[v1.Node]) -> int:
+    """1 + the highest ``{group}-{i}`` node index in the cluster.
+
+    Deterministic naming is the exactly-once mechanism: a scale-up retried
+    after a store fault proposes the SAME names, and already-created nodes
+    are detected instead of duplicated.  Scans ALL nodes by name pattern —
+    not just labeled members — so a same-named node without the group
+    label (operator-created, label stripped) is skipped over instead of
+    colliding with the simulation's template encode."""
+    prefix = f"{group.name}-"
+    return 1 + max(
+        (_trailing_index(n.metadata.name, prefix) for n in nodes),
+        default=-1,
+    )
+
+
+def next_slice_index(group: NodeGroup, nodes: List[v1.Node],
+                     slice_label: str) -> int:
+    prefix = f"{group.name}-slice-"
+    return 1 + max(
+        (_trailing_index(n.metadata.labels.get(slice_label, ""), prefix)
+         for n in nodes),
+        default=-1,
+    )
+
+
+def materialize_nodes(group: NodeGroup, count: int, start_index: int,
+                      start_slice: int, slice_label: str) -> List[v1.Node]:
+    """``count`` template nodes with deterministic names/slice labels —
+    the SAME objects the simulation forks and the apply creates, so a
+    simulated placement on an added node names the real node it becomes."""
+    out: List[v1.Node] = []
+    for i in range(count):
+        idx = start_index + i
+        labels = dict(group.labels)
+        labels[NODE_GROUP_LABEL] = group.name
+        if group.slice_size > 0:
+            sl = start_slice + i // group.slice_size
+            labels[slice_label] = f"{group.name}-slice-{sl}"
+        out.append(v1.Node(
+            metadata=v1.ObjectMeta(name=f"{group.name}-{idx}",
+                                   labels=labels),
+            spec=v1.NodeSpec(taints=list(group.taints)),
+            status=v1.NodeStatus(capacity=dict(group.capacity),
+                                 allocatable=dict(group.capacity)),
+        ))
+    return out

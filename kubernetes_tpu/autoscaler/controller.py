@@ -1,0 +1,487 @@
+"""Cluster-autoscaler controller: demand → simulate → scale.
+
+Reference: kubernetes/autoscaler cluster-autoscaler core —
+  ScaleUp (core/scaleup): unschedulable pods are binpacked against each
+    group's template NodeInfo (estimator/binpacking) and the expander
+    picks the cheapest option;
+  ScaleDown (core/scaledown): underutilized nodes are eligible only when
+    every resident pod provably reschedules elsewhere (simulator/drain),
+    then the node drains and is removed.
+
+This build runs both halves through the unified whatif engine
+(kubernetes_tpu/whatif): a scale-up candidate set {add M₁, M₂, …} is ONE
+vmapped [K, B, N] solve over K node-add forks, and a scale-down candidate
+is a node-remove + victim-mask fork whose pending set is the displaced
+pods' replacement clones.  Applying a scale-down goes through the shared
+PDB-aware ``EvictionAPI`` drain path (descheduler/evictions.py) — a
+blocked budget refuses the scale-down outright, never half-drains.
+
+Exactly-once under chaos: scale-ups materialize deterministically-named
+nodes (autoscaler/api.py) and recount live membership each sync, so a
+store fault mid-apply resumes exactly where it stopped — the decision's
+node set is created once, never duplicated (pinned in
+tests/test_autoscaler.py's watch-drop/429 storm).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import objects as v1
+from ..api.resource import compute_pod_resource_request, parse_quantity
+from ..component_base import logging as klog
+from ..descheduler import clone_for_replacement
+from ..descheduler.evictions import EvictionAPI
+from ..gang import POD_GROUP_LABEL, SLICE_LABEL
+from ..metrics import scheduler_metrics as m
+from ..whatif import ForkSpec, WhatIfEngine
+from .api import (
+    NODE_GROUP_LABEL,
+    NodeGroup,
+    materialize_nodes,
+    member_nodes,
+    next_node_index,
+    next_slice_index,
+)
+
+
+@dataclass
+class ScaleDecision:
+    """One sync's verdict for observability (CLI status, tests)."""
+
+    direction: str  # "up" | "down"
+    group: str
+    result: str  # the metric result label
+    count: int = 0  # nodes added / removed
+    note: str = ""
+
+
+class ClusterAutoscaler:
+    name = "cluster-autoscaler"
+
+    def __init__(self, store, scheduler,
+                 eviction_api: Optional[EvictionAPI] = None,
+                 clock=None,
+                 dry_run: bool = False,
+                 max_scale_downs_per_sync: int = 1,
+                 scale_down_utilization_threshold: float = 0.5,
+                 max_simulated_sizes: int = 6,
+                 min_interval: float = 0.0,
+                 slice_label: Optional[str] = None):
+        self.store = store
+        self.scheduler = scheduler
+        self.clock = clock or getattr(scheduler, "clock", time.monotonic)
+        self.evictions = eviction_api or EvictionAPI(
+            store, recorder=getattr(scheduler, "recorder", None),
+            clock=self.clock)
+        self.engine = WhatIfEngine(scheduler)
+        self.dry_run = dry_run
+        # disruption pacing, same rationale as the descheduler's limits: a
+        # scale-down drains workloads, so at most this many nodes leave per
+        # sync, spaced by min_interval between ACTIVE syncs
+        self.max_scale_downs_per_sync = max_scale_downs_per_sync
+        self.scale_down_utilization_threshold = scale_down_utilization_threshold
+        # cap on the K of one vmapped scale-up solve (candidate sizes per
+        # group ramp est → 2·est → … → headroom)
+        self.max_simulated_sizes = max_simulated_sizes
+        self.min_interval = min_interval
+        self.slice_label = slice_label or SLICE_LABEL
+        self._last_active = float("-inf")
+        self.last_decisions: List[ScaleDecision] = []
+
+    # --- demand ---------------------------------------------------------------
+
+    def _demand(self) -> List[v1.Pod]:
+        """Unschedulable demand: starved PodGroups' unbound members (the
+        gang directory's phase writes + the queue's unschedulableQ both
+        feed this — phase writes are lossy under chaos by contract, the
+        queue signal survives) plus plain parked pods.  Only pods the
+        scheduler has actually FAILED count — a transiently pending pod on
+        a roomy cluster must not trigger a scale-up."""
+        parked = {p.uid: p for p in self.scheduler.queue.unschedulable_pods()}
+        groups, _ = self.store.list("PodGroup")
+        pods, _ = self.store.list("Pod")
+        # one pass over pods, not one scan per PodGroup
+        members_by_group: Dict[Tuple[str, str], List[v1.Pod]] = {}
+        for p in pods:
+            g = p.metadata.labels.get(POD_GROUP_LABEL)
+            if g:
+                members_by_group.setdefault((p.namespace, g), []).append(p)
+        demand: Dict[str, v1.Pod] = {}
+        for pg in groups:
+            members = members_by_group.get((pg.namespace, pg.name), [])
+            if len(members) < pg.min_member:
+                continue  # below quorum: capacity can't help yet
+            unbound = [p for p in members if not p.spec.node_name]
+            if not unbound:
+                continue
+            starved = (pg.phase == v1.POD_GROUP_UNSCHEDULABLE
+                       or any(p.uid in parked for p in unbound))
+            if starved:
+                # the WHOLE unbound remainder is the demand: a gang binds
+                # all-or-nothing, so capacity must fit every member
+                for p in unbound:
+                    demand[p.uid] = p
+        for uid, p in parked.items():
+            if uid not in demand and POD_GROUP_LABEL not in p.metadata.labels:
+                demand[uid] = p
+        ordered = self.engine.order_pending(list(demand.values()))
+        batch = self.scheduler.batch_size
+        if len(ordered) <= batch:
+            return ordered
+        # the engine solves at most one batch — truncate on a GANG
+        # boundary: a gang split by a plain prefix cut can never satisfy
+        # the solve's all-or-nothing mask, which would read as "no fit"
+        # for capacity the real scheduler could use (the queue-order sort
+        # keeps whole gangs adjacent, so only the boundary gang drops;
+        # later syncs serve it once the prefix demand binds)
+        prefix = ordered[:batch]
+        gangs = self.scheduler.gangs
+        full_c: Dict[str, int] = {}
+        for p in ordered:
+            k = gangs.group_key_of(p)
+            if k is not None:
+                full_c[k] = full_c.get(k, 0) + 1
+        pre_c: Dict[str, int] = {}
+        for p in prefix:
+            k = gangs.group_key_of(p)
+            if k is not None:
+                pre_c[k] = pre_c.get(k, 0) + 1
+        return [p for p in prefix
+                if gangs.group_key_of(p) is None
+                or pre_c[gangs.group_key_of(p)]
+                == full_c[gangs.group_key_of(p)]]
+
+    # --- the loop -------------------------------------------------------------
+
+    def sync_once(self) -> bool:
+        now = self.clock()
+        if now - self._last_active < self.min_interval:
+            return False
+        # engine quiescence: flush in-flight pipelined batches first (same
+        # precondition as the descheduler controller)
+        for _ in range(4):
+            if not getattr(self.scheduler, "_inflight_q", None):
+                break
+            self.scheduler.schedule_cycle()
+        if getattr(self.scheduler, "_inflight_q", None):
+            return False
+        groups, _ = self.store.list("NodeGroup")
+        if not groups:
+            return False
+        self.last_decisions = []
+        demand = self._demand()
+        if demand:
+            # zero-add baseline first: when the demand already fits the
+            # CURRENT cluster (a prior sync's scale-up landed, the pods
+            # just haven't re-attempted yet), adding more nodes would
+            # over-provision — let the scheduler bind instead
+            baseline = self.engine.evaluate_one(
+                demand, ForkSpec(note="baseline"))
+            if baseline is None:
+                return False  # engine refused; retry next sync
+            if baseline.unplaced == 0:
+                return False
+            changed = self._scale_up(groups, demand, baseline.placed)
+        else:
+            # never shrink while ANY pod is queued (active/backoff/
+            # unschedulable or holding a gang Permit wait): a scale-up's
+            # fresh empty nodes would otherwise read as underutilized and
+            # flap right back down before the pods bind
+            a, b, u = self.scheduler.queue.pending_count()
+            if a or b or u or getattr(self.scheduler, "_waiting_binds", None):
+                return False
+            changed = self._scale_down(groups)
+        if changed:
+            self._last_active = now
+        return changed
+
+    # --- scale-up -------------------------------------------------------------
+
+    def _estimate_nodes(self, group: NodeGroup,
+                        pending: List[v1.Pod]) -> int:
+        """Binpacking lower bound (estimator/ analog): per resource dim,
+        total pending demand over one template node's capacity."""
+        need_cpu = need_mem = 0
+        for p in pending:
+            r = compute_pod_resource_request(p)
+            need_cpu += r.milli_cpu
+            need_mem += r.memory
+        scalar_need: Dict[str, float] = {}
+        for p in pending:
+            for res, amt in \
+                    compute_pod_resource_request(p).scalar_resources.items():
+                scalar_need[res] = scalar_need.get(res, 0.0) + float(amt)
+        est = 1
+        cap_cpu = float(parse_quantity(group.capacity.get("cpu", 0))) * 1000.0
+        if cap_cpu > 0:
+            est = max(est, -(-need_cpu // int(cap_cpu)))
+        cap_mem = float(parse_quantity(group.capacity.get("memory", 0)))
+        if cap_mem > 0:
+            est = max(est, -(-need_mem // int(cap_mem)))
+        cap_pods = int(parse_quantity(group.capacity.get("pods", 0)) or 0)
+        if cap_pods > 0:
+            est = max(est, -(-len(pending) // cap_pods))
+        # extended/device resources (the dominant dimension on a TPU
+        # cluster: chips-per-pod over chips-per-host): without them the
+        # doubling ramp starts far below the true need and the first
+        # viable candidate over-provisions by a whole rounding step
+        for res, need in scalar_need.items():
+            cap = float(parse_quantity(group.capacity.get(res, 0)))
+            if cap > 0:
+                est = max(est, -(-int(need) // int(cap)))
+        return int(est)
+
+    def _candidate_counts(self, group: NodeGroup, est: int,
+                          headroom: int) -> List[int]:
+        """Candidate node counts for one group's vmapped solve: the
+        binpacking estimate rounded up to whole slices, doubling toward
+        the group's headroom (an infeasible estimate — fragmentation,
+        gang shapes — still converges in O(log) candidates)."""
+        s = max(group.slice_size, 1)
+        cands: List[int] = []
+        cur = max(est, 1)
+        while len(cands) < self.max_simulated_sizes:
+            rounded = min(-(-cur // s) * s, headroom)
+            if rounded >= 1 and rounded not in cands:
+                cands.append(rounded)
+            if rounded >= headroom:
+                break
+            cur = max(cur * 2, rounded + 1)
+        return sorted(cands)
+
+    def _scale_up(self, groups: List[NodeGroup], demand: List[v1.Pod],
+                  base_placed: int = 0) -> bool:
+        """Cheapest group/count whose fork places the WHOLE demand; when
+        none does (one unplaceable pod must not starve everyone —
+        upstream scales up for a helped subset too), fall back to the
+        candidate placing the MOST pods beyond the zero-add baseline,
+        cheapest cost breaking ties."""
+        nodes, _ = self.store.list("Node")
+        best: Optional[Tuple[float, NodeGroup, List[v1.Node]]] = None
+        best_partial = None  # (placed, cost, group, nodes)
+        any_headroom = False
+        for group in sorted(groups, key=lambda g: (g.cost_per_node,
+                                                   g.metadata.name)):
+            size = len(member_nodes(group, nodes))
+            headroom = group.max_size - size
+            if headroom <= 0:
+                continue
+            any_headroom = True
+            counts = self._candidate_counts(
+                group, self._estimate_nodes(group, demand), headroom)
+            start_idx = next_node_index(group, nodes)
+            start_slice = next_slice_index(group, nodes, self.slice_label)
+            forks = [
+                ForkSpec(
+                    add_nodes=materialize_nodes(
+                        group, count, start_idx, start_slice,
+                        self.slice_label),
+                    note=f"scale-up {group.name}+{count}")
+                for count in counts
+            ]
+            try:
+                preds = self.engine.evaluate(demand, forks)
+            except Exception as e:
+                # one group's unbuildable fork (residual name collision,
+                # encoding-capacity overflow) must not take the controller
+                # loop down — the engine rolled its scratch state back
+                m.autoscaler_scale_decisions.inc(("up", "error"))
+                self.last_decisions.append(ScaleDecision(
+                    "up", group.name, "error",
+                    note=f"{type(e).__name__}: {e}"))
+                klog.V(1).info_s("Scale-up simulation failed",
+                                 group=group.name,
+                                 error=f"{type(e).__name__}: {e}")
+                continue
+            if preds is None:
+                return False  # engine refused (pipeline not quiescent)
+            for count, fork, pred in zip(counts, forks, preds):
+                cost = count * group.cost_per_node
+                if pred.unplaced == 0:
+                    if best is None or cost < best[0]:
+                        best = (cost, group, fork.add_nodes)
+                    break  # ascending counts: first viable is this
+                    # group's cheapest
+                if pred.placed > base_placed and (
+                        best_partial is None
+                        or (pred.placed, -cost)
+                        > (best_partial[0], -best_partial[1])):
+                    best_partial = (pred.placed, cost, group, fork.add_nodes)
+        if best is not None:
+            _cost, group, new_nodes = best
+            note = (f"add {len(new_nodes)} × {group.name} for "
+                    f"{len(demand)} pending pods")
+        elif best_partial is not None:
+            placed, _cost, group, new_nodes = best_partial
+            note = (f"add {len(new_nodes)} × {group.name}: places "
+                    f"{placed}/{len(demand)} pending pods (partial)")
+        else:
+            result = "no_fit" if any_headroom else "at_max"
+            m.autoscaler_scale_decisions.inc(("up", result))
+            self.last_decisions.append(ScaleDecision(
+                "up", "", result, note=f"{len(demand)} pods unplaceable"))
+            return False
+        decision = ScaleDecision(
+            "up", group.name, "applied", count=len(new_nodes), note=note)
+        if self.dry_run:
+            decision.result = "dry_run"
+            self.last_decisions.append(decision)
+            return False
+        created = 0
+        for node in new_nodes:
+            if self.store.get("Node", "", node.metadata.name) is not None:
+                continue  # a prior (faulted) apply created it: exactly-once
+            try:
+                self.store.create("Node", node)
+                created += 1
+            except ValueError:
+                continue  # raced into existence — same exactly-once guard
+            except Exception as e:
+                # transient store fault mid-apply: stop here; the next sync
+                # recounts live membership and resumes with the SAME
+                # deterministic names, so the decision still applies
+                # exactly once overall
+                m.autoscaler_scale_decisions.inc(("up", "error"))
+                decision.result = "error"
+                decision.count = created
+                self.last_decisions.append(decision)
+                klog.V(1).info_s("Scale-up apply fault; will resume",
+                                 group=group.name, created=created,
+                                 error=f"{type(e).__name__}: {e}")
+                return created > 0
+        m.autoscaler_scale_decisions.inc(("up", "applied"))
+        decision.count = created
+        self.last_decisions.append(decision)
+        klog.V(2).info_s("Scale-up applied", group=group.name,
+                         nodes=created, note=decision.note)
+        return created > 0
+
+    # --- scale-down -----------------------------------------------------------
+
+    def _utilization(self, node: v1.Node, pods_on: List[v1.Pod]) -> float:
+        cap = float(parse_quantity(node.status.allocatable.get("cpu", 0)))
+        if cap <= 0:
+            return 1.0
+        used = sum(compute_pod_resource_request(p).milli_cpu
+                   for p in pods_on) / 1000.0
+        return used / cap
+
+    def _scale_down(self, groups: List[NodeGroup]) -> bool:
+        nodes, _ = self.store.list("Node")
+        pods, _ = self.store.list("Pod")
+        by_node: Dict[str, List[v1.Pod]] = {}
+        for p in pods:
+            if p.spec.node_name:
+                by_node.setdefault(p.spec.node_name, []).append(p)
+        downs = 0
+        changed = False
+        for group in groups:
+            members = member_nodes(group, nodes)
+            spare = len(members) - group.min_size
+            cands = []
+            for node in members:
+                pods_on = by_node.get(node.metadata.name, [])
+                if any(POD_GROUP_LABEL in p.metadata.labels
+                       for p in pods_on):
+                    continue  # never break a placed gang for capacity
+                util = self._utilization(node, pods_on)
+                if util < self.scale_down_utilization_threshold:
+                    cands.append((util, node, pods_on))
+            cands.sort(key=lambda t: (t[0], t[1].metadata.name))
+            for util, node, pods_on in cands:
+                if downs >= self.max_scale_downs_per_sync or spare <= 0:
+                    break
+                verdict = self._try_scale_down(group, node, pods_on)
+                self.last_decisions.append(verdict)
+                if verdict.result in ("applied", "dry_run"):
+                    downs += 1
+                    spare -= 1
+                    changed = changed or verdict.result == "applied"
+        return changed
+
+    def _try_scale_down(self, group: NodeGroup, node: v1.Node,
+                        pods_on: List[v1.Pod]) -> ScaleDecision:
+        name = node.metadata.name
+        decision = ScaleDecision("down", group.name, "", count=1, note=name)
+        # JOINT budget pre-check: a drain evicts every resident pod, so
+        # each matching PDB must afford the node's whole matching count at
+        # once — per-pod blocking_pdb would pass two pods sharing a
+        # budget of one, evict the first, and abort the drain mid-way
+        # (a pod killed for a scale-down that never happens)
+        pdbs = self.store.list("PodDisruptionBudget")[0]
+        pdb_load: Dict[str, Tuple[object, int]] = {}
+        for p in pods_on:
+            for pdb in self.evictions.matching_pdbs(p, pdbs):
+                key = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+                pdb_load[key] = (pdb, pdb_load.get(key, (pdb, 0))[1] + 1)
+        blocked = next((key for key, (pdb, cnt) in pdb_load.items()
+                        if pdb.disruptions_allowed < cnt), None)
+        if blocked is not None:
+            m.autoscaler_scale_decisions.inc(("down", "blocked"))
+            decision.result = "blocked"
+            decision.note = (f"{name}: pdb {blocked} cannot afford "
+                             f"{pdb_load[blocked][1]} disruptions")
+            return decision
+        if pods_on:
+            # what-if proof: every displaced pod's replacement clone
+            # re-places with the node removed and its pods masked out
+            clones = [clone_for_replacement(p) for p in pods_on]
+            pred = self.engine.evaluate_one(clones, ForkSpec(
+                victims=list(pods_on), remove_nodes=[name],
+                note=f"scale-down {name}"))
+            if pred is None or pred.unplaced:
+                m.autoscaler_scale_decisions.inc(("down", "no_replacement"))
+                decision.result = "no_replacement"
+                decision.note = (
+                    f"{name}: "
+                    f"{pred.unplaced if pred else len(clones)} displaced "
+                    f"pods don't re-place")
+                return decision
+        if self.dry_run:
+            decision.result = "dry_run"
+            return decision
+        # apply: cordon → drain through the shared eviction gate → delete.
+        # A refusal or fault mid-drain aborts (uncordon back) — the gate's
+        # budget math already drained what it drained; surviving pods stay.
+        try:
+            node.spec.unschedulable = True
+            self.store.update("Node", node)
+            for p in pods_on:
+                r = self.evictions.evict(
+                    p, reason=f"scale-down {name}", policy="autoscaler")
+                if not r.evicted:
+                    node.spec.unschedulable = False
+                    self.store.update("Node", node)
+                    m.autoscaler_scale_decisions.inc(("down", "blocked"))
+                    decision.result = "blocked"
+                    decision.note = f"{name}: drain refused ({r.reason})"
+                    return decision
+            self.store.delete("Node", "", name)
+        except Exception as e:
+            m.autoscaler_scale_decisions.inc(("down", "error"))
+            decision.result = "error"
+            decision.note = f"{name}: {type(e).__name__}: {e}"
+            klog.V(1).info_s("Scale-down fault", node=name,
+                             error=f"{type(e).__name__}: {e}")
+            # best-effort uncordon (same restore as the drain-refused
+            # path): a node stranded cordoned-but-undeleted would leak
+            # capacity while its displaced pods re-trigger scale-ups
+            try:
+                live = self.store.get("Node", "", name)
+                if live is not None and live.spec.unschedulable:
+                    live.spec.unschedulable = False
+                    self.store.update("Node", live)
+            except Exception as e2:
+                # next sync's what-if re-evaluates from live state
+                klog.V(1).info_s("Scale-down uncordon restore failed",
+                                 node=name,
+                                 error=f"{type(e2).__name__}: {e2}")
+            return decision
+        m.autoscaler_scale_decisions.inc(("down", "applied"))
+        decision.result = "applied"
+        klog.V(2).info_s("Scale-down applied", group=group.name, node=name,
+                         displaced=len(pods_on))
+        return decision

@@ -25,6 +25,7 @@ KIND_ALIASES = {
     "sc": "StorageClass", "pdb": "PodDisruptionBudget",
     "pc": "PriorityClass", "priorityclass": "PriorityClass",
     "pg": "PodGroup", "podgroup": "PodGroup", "podgroups": "PodGroup",
+    "ng": "NodeGroup", "nodegroup": "NodeGroup", "nodegroups": "NodeGroup",
     "ev": "Event", "events": "Event",
 }
 
@@ -41,6 +42,16 @@ def _scheme():
     return _scheme_cache[0]
 
 
+def _render_table(rows: List[List[str]]) -> str:
+    """Column-aligned table (header first) — the one place that owns the
+    width/ljust/join formatting for get/get_slices/autoscaler_status."""
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(r, widths))
+        for r in rows
+    )
+
+
 class Kubectl:
     def __init__(self, store: ObjectStore):
         self.store = store
@@ -54,13 +65,12 @@ class Kubectl:
         objs, _ = self.store.list(kind)
         if namespace:
             objs = [o for o in objs if getattr(o.metadata, "namespace", "") == namespace]
-        rows = [self._row(kind, o) for o in sorted(objs, key=lambda o: o.metadata.name)]
-        header = self._header(kind)
-        widths = [max(len(r[c]) for r in [header] + rows) for c in range(len(header))]
-        return "\n".join(
-            "  ".join(cell.ljust(w) for cell, w in zip(r, widths))
-            for r in [header] + rows
-        )
+        # one Node scan shared by every NodeGroup row's SIZE column (a
+        # per-row list would be G full scans on a 5k-node cluster)
+        nodes = self.store.list("Node")[0] if kind == "NodeGroup" else None
+        rows = [self._row(kind, o, nodes)
+                for o in sorted(objs, key=lambda o: o.metadata.name)]
+        return _render_table([self._header(kind)] + rows)
 
     def _header(self, kind: str) -> List[str]:
         return {
@@ -70,9 +80,10 @@ class Kubectl:
             "Deployment": ["NAME", "REPLICAS"],
             "Job": ["NAME", "COMPLETIONS", "SUCCEEDED", "DONE"],
             "PodGroup": ["NAME", "MIN-MEMBER", "PHASE", "TIMEOUT"],
+            "NodeGroup": ["NAME", "SIZE", "MIN", "MAX", "TEMPLATE"],
         }.get(kind, ["NAME"])
 
-    def _row(self, kind: str, o) -> List[str]:
+    def _row(self, kind: str, o, nodes: Optional[List[v1.Node]] = None) -> List[str]:
         if kind == "Pod":
             return [o.metadata.name, o.status.phase, o.spec.node_name or "<none>",
                     str(o.spec.priority)]
@@ -97,6 +108,15 @@ class Kubectl:
             timeout = o.schedule_timeout_seconds
             return [o.metadata.name, str(o.min_member), o.phase,
                     f"{timeout}s" if timeout is not None else "<default>"]
+        if kind == "NodeGroup":
+            from .autoscaler import member_nodes
+
+            size = len(member_nodes(o, nodes or []))
+            tmpl = ",".join(f"{k}={v}" for k, v in sorted(o.capacity.items()))
+            if o.slice_size:
+                tmpl += f",slice={o.slice_size}"
+            return [o.metadata.name, str(size), str(o.min_size),
+                    str(o.max_size), tmpl or "<none>"]
         return [o.metadata.name]
 
     def describe(self, kind: str, namespace: str, name: str) -> str:
@@ -335,6 +355,36 @@ class Kubectl:
             out += "; failed: " + ", ".join(failed)
         return out
 
+    # --- autoscaler status ----------------------------------------------------
+
+    def autoscaler_status(self, controller=None) -> str:
+        """``ktpu autoscaler status``: per-group size vs bounds, current
+        unschedulable demand, and (when an in-process controller is
+        given) its last sync's scale decisions."""
+        from .autoscaler import member_nodes
+        from .gang import POD_GROUP_LABEL
+
+        groups, _ = self.store.list("NodeGroup")
+        nodes, _ = self.store.list("Node")
+        pods, _ = self.store.list("Pod")
+        unbound = [p for p in pods if not p.spec.node_name]
+        gang_unbound = sum(
+            1 for p in unbound if POD_GROUP_LABEL in p.metadata.labels)
+        rows = [["GROUP", "SIZE", "MIN", "MAX", "HEADROOM"]]
+        for g in sorted(groups, key=lambda g: g.metadata.name):
+            size = len(member_nodes(g, nodes))
+            rows.append([g.metadata.name, str(size), str(g.min_size),
+                         str(g.max_size), str(max(g.max_size - size, 0))])
+        out = _render_table(rows)
+        out += (f"\npending: {len(unbound)} unbound pods "
+                f"({gang_unbound} gang members)")
+        if controller is not None and controller.last_decisions:
+            out += "\nlast sync:"
+            for d in controller.last_decisions:
+                out += (f"\n  {d.direction} {d.group or '-'} "
+                        f"{d.result} ({d.note})")
+        return out
+
     # --- slice fragmentation view ---------------------------------------------
 
     def get_slices(self, slice_label: Optional[str] = None,
@@ -401,11 +451,7 @@ class Kubectl:
                 name, str(len(slices[name])), str(empty_hosts),
                 f"{free_total:g}", f"{frag:.0%}",
             ])
-        widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
-        return "\n".join(
-            "  ".join(cell.ljust(w) for cell, w in zip(r, widths))
-            for r in rows
-        )
+        return _render_table(rows)
 
 
 def main(argv=None):  # pragma: no cover - thin shell wrapper
@@ -444,6 +490,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     p.add_argument("node")
     p.add_argument("--dry-run", action="store_true",
                    help="evaluate the eviction gate, evict nothing")
+    p = sub.add_parser("autoscaler")
+    p.add_argument("action", choices=["status"])
     for verb in ("cordon", "uncordon"):
         p = sub.add_parser(verb)
         p.add_argument("node")
@@ -482,6 +530,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         print(k.rollout_status(args.kind, args.namespace, args.name))
     elif args.verb == "drain":
         print(k.drain(args.node, dry_run=args.dry_run))
+    elif args.verb == "autoscaler":
+        print(k.autoscaler_status())
     elif args.verb in ("cordon", "uncordon"):
         print(k.cordon(args.node, on=args.verb == "cordon"))
     return 0

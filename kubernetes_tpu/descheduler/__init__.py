@@ -21,6 +21,7 @@ from .policies import (
     NodeDrainPolicy,
     SliceDefragmentation,
     SpreadViolationRepair,
+    clone_for_replacement,
     default_policies,
 )
 
@@ -36,5 +37,6 @@ __all__ = [
     "NodeDrainPolicy",
     "SliceDefragmentation",
     "SpreadViolationRepair",
+    "clone_for_replacement",
     "default_policies",
 ]

@@ -1,223 +1,62 @@
 """Device-resident what-if planner: counterfactual batched assignment.
 
-The dry-run analogue of the preemption evaluator's ``DryRunPreemption``
-(framework/preemption, preemption.go:546) — but instead of per-node host
-loops cloning NodeInfos, a candidate eviction set is masked out of a
-FORKED ``DeviceSnapshot`` by one small scatter program and the scheduler's
-existing fused batched-assignment program re-runs against the fork: one
-pod×node solve per plan answers "if these victims were evicted, where
-would the waiting pods land?" for a whole pending batch at once.
+Since the whatif unification this module is a thin port: the fork-and-
+resolve machinery (snapshot forking, engine routing, the vmapped solve)
+lives in ``kubernetes_tpu/whatif`` — ONE engine shared with the cluster
+autoscaler and preemption's dry-run fan-out — and ``WhatIfPlanner`` keeps
+the descheduler-facing contract on top of it.
 
-Parity contract (tests/test_descheduler.py): because the solve reuses the
-EXACT jitted cycle program (same engine routing, same gang all-or-nothing
-mask, same deterministic tie-breaks) over a fork that matches what the
-encoder will contain once the victims are really evicted, the predicted
-placements equal the scheduler's actual post-eviction bindings
-bit-for-bit — provided the cluster doesn't change in between and the
-planner runs while the scheduler is quiescent (no in-flight pipelined
-batches; the descheduler controller loop runs between cycles, where that
-holds by construction).
+Parity contract (tests/test_descheduler.py): because the engine re-runs
+the scheduler's exact assignment semantics (same engine routing, same
+gang all-or-nothing mask, same deterministic tie-breaks) over a fork that
+matches what the encoder will contain once the victims are really
+evicted, the predicted placements equal the scheduler's actual
+post-eviction bindings bit-for-bit — provided the cluster doesn't change
+in between and the planner runs while the scheduler is quiescent (no
+in-flight pipelined batches; the descheduler controller loop runs between
+cycles, where that holds by construction).
 
-Known fidelity limit (documented, not silent): the incremental affinity
-tables (DeviceSnapshot.aff_*) are NOT masked — a victim that carries
-pod-(anti)affinity terms leaves its term counts in the fork, so plans
-whose victims anchor affinity state can mispredict.  The in-tree policies
-only pick affinity-free victims; ``predict`` refuses otherwise.
+Affinity-carrying victims are SUPPORTED (the historical WhatIfPlanner
+refused them): the fork masks the victim's term-count contributions out
+of the incremental ``aff_*`` tables (state/affinity_index.py), exactly
+the delta a real eviction's encoder sync applies — parity pinned in
+test_planner_masks_affinity_victims.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
-
-import jax
-import jax.numpy as jnp
-import numpy as np
+from typing import List, Optional, Sequence
 
 from ..api import objects as v1
 from ..metrics import scheduler_metrics as m
-from ..state.units import pow2_round_up as _pow2
+from ..whatif import ForkSpec, Prediction, WhatIfEngine
 
-
-@dataclass
-class Prediction:
-    """One counterfactual solve's outcome."""
-
-    placements: Dict[str, Optional[str]]  # pod uid → node name (None = no fit)
-    pods: List[v1.Pod] = field(default_factory=list)  # solve order (= queue order)
-    masked_victims: int = 0
-
-    @property
-    def placed(self) -> int:
-        return sum(1 for n in self.placements.values() if n is not None)
-
-    @property
-    def unplaced(self) -> int:
-        return sum(1 for n in self.placements.values() if n is None)
-
-
-@jax.jit
-def _fork_snapshot(dsnap, vic_pod_rows, vic_node_rows):
-    """Mask a victim set out of a DeviceSnapshot (pure; originals survive —
-    the scatters are not donated, so the scheduler's live buffers are
-    untouched).
-
-    ``vic_pod_rows`` i32[K] (-1 pad) are scheduled-pod rows to invalidate;
-    ``vic_node_rows`` i32[K] (0 pad, ignored where pod row is -1) are each
-    victim's host node row, whose ``requested``/``non_zero_requested``
-    drop by the victim's own request vector — exactly the state the
-    encoder reaches after a real eviction's cache sync (per-pod unit
-    vectors are exact integers, so subtraction equals re-encoding).
-    Duplicate pad rows are safe: the validity mask is a scatter-max and
-    the resource deltas are zero-weighted where the pod row is padding.
-    """
-    p = dsnap.pod_valid.shape[0]
-    n = dsnap.requested.shape[0]
-    ok = vic_pod_rows >= 0
-    prow = jnp.clip(vic_pod_rows, 0, p - 1)
-    nrow = jnp.clip(vic_node_rows, 0, n - 1)
-    vic_mask = jnp.zeros(p, dtype=bool).at[prow].max(ok)
-    pod_valid = dsnap.pod_valid & ~vic_mask
-    okc = ok[:, None]
-    requested = dsnap.requested.at[nrow].add(
-        jnp.where(okc, -dsnap.pod_request[prow], 0))
-    non_zero = dsnap.non_zero_requested.at[nrow].add(
-        jnp.where(okc, -dsnap.pod_non_zero[prow], 0))
-    return dataclasses.replace(
-        dsnap, pod_valid=pod_valid, requested=requested,
-        non_zero_requested=non_zero)
-
-
-class _MaskedEncoderView:
-    """Read-only encoder facade with the victim set masked in the HOST
-    mirrors — handed to ``host_prepare`` so host-side plugin state (the
-    Coscheduling anchor-slice plane's free-capacity scan, any host reader
-    of ``requested``/``pod_valid``) sees the same counterfactual the
-    device fork encodes.  Everything else delegates to the live encoder."""
-
-    def __init__(self, encoder, vic_pod_rows: Sequence[int],
-                 vic_node_rows: Sequence[int]):
-        self._enc = encoder
-        requested = encoder.requested.copy()
-        non_zero = encoder.non_zero_requested.copy()
-        pod_valid = encoder.pod_valid.copy()
-        for pr, nr in zip(vic_pod_rows, vic_node_rows):
-            requested[nr] -= encoder.pod_request[pr]
-            non_zero[nr] -= encoder.pod_non_zero[pr]
-            pod_valid[pr] = False
-        self.requested = requested
-        self.non_zero_requested = non_zero
-        self.pod_valid = pod_valid
-
-    def __getattr__(self, name):
-        return getattr(self._enc, name)
-
-
-class _QueueShim:
-    """Just enough QueuedPodInfo surface for the gang less-fn."""
-
-    __slots__ = ("pod", "initial_attempt_timestamp")
-
-    def __init__(self, pod: v1.Pod):
-        self.pod = pod
-        self.initial_attempt_timestamp = pod.metadata.creation_timestamp or 0.0
+__all__ = ["Prediction", "WhatIfPlanner"]
 
 
 class WhatIfPlanner:
     """Counterfactual solver bound to a live TPUScheduler (shares its
-    cache/encoder/compiler and — critically — its compiled programs)."""
+    cache/encoder/compiler through the whatif engine)."""
 
     def __init__(self, scheduler):
         self.sched = scheduler
+        self.engine = WhatIfEngine(scheduler)
 
     def order_pending(self, pods: Sequence[v1.Pod]) -> List[v1.Pod]:
         """The queue's pop order (gang-cohesive priority sort) so the
         counterfactual batch matches what the real scheduler will pop."""
-        import functools
-
-        less = self.sched.gangs.less
-        shims = [_QueueShim(p) for p in pods]
-        shims.sort(key=functools.cmp_to_key(
-            lambda a, b: -1 if less(a, b) else (1 if less(b, a) else 0)))
-        return [s.pod for s in shims]
+        return self.engine.order_pending(pods)
 
     def predict(self, pending: Sequence[v1.Pod],
                 victims: Sequence[v1.Pod]) -> Optional[Prediction]:
         """One batched pod×node solve: where would ``pending`` land if
         ``victims`` were evicted?  Returns None when the solve cannot be
-        trusted (affinity-carrying victim, batch overflow) — callers must
+        trusted (batch overflow, in-flight pipelined work) — callers must
         treat that as "no plan", never as "no fit"."""
-        sched = self.sched
-        if not pending or len(pending) > sched.batch_size:
-            return None
-        if getattr(sched, "_inflight_q", None):
-            # quiescence precondition (module doc): an in-flight pipelined
-            # batch holds placements the fork can't see (device-resident
-            # deltas, assumes not yet snapshotted) — refuse rather than
-            # mispredict; the controller flushes in-flight work first
-            return None
-        t0 = sched.clock()
-        changed = sched.cache.update_snapshot(sched.snapshot)
-        sched.encoder.sync(sched.snapshot, changed)
-        enc = sched.encoder
-        vic_pod_rows: List[int] = []
-        vic_node_rows: List[int] = []
-        for vic in victims:
-            if _has_affinity_terms(vic):
-                return None  # aff_* tables are not masked — see module doc
-            pr = enc.pod_rows.get(vic.uid)
-            nr = enc.node_rows.get(vic.spec.node_name)
-            if pr is None or nr is None:
-                continue  # not encoded (already gone / never bound): no-op
-            vic_pod_rows.append(pr)
-            vic_node_rows.append(nr)
-        # compile BEFORE the device upload (same order as _dispatch_batch):
-        # first-seen topology keys register at compile time and backfill
-        # node_topo rows the upload must carry
-        pods = self.order_pending(pending)
-        batch = sched.compiler.compile(pods, pad_to=sched.batch_size)
-        profile = sched._profile_of(pods[0])
-        fw = sched._framework(profile)
-        jt = sched._jitted_by[profile]
-        dsnap = enc.to_device()
-        k = _pow2(max(len(vic_pod_rows), 1), 8)
-        prow = np.full(k, -1, dtype=np.int32)
-        nrow = np.zeros(k, dtype=np.int32)
-        if vic_pod_rows:
-            prow[: len(vic_pod_rows)] = vic_pod_rows
-            nrow[: len(vic_node_rows)] = vic_node_rows
-        forked = _fork_snapshot(dsnap, prow, nrow)
-        view = _MaskedEncoderView(enc, vic_pod_rows, vic_node_rows)
-        sched.gangs.stage_batch(pods)
-        gang_seg = sched.gangs.gang_segments(pods, batch.size)
-        host_auxes = fw.host_prepare(
-            batch, sched.snapshot, view,
-            namespace_labels=sched.namespace_labels)
-        nom_rows, nom_req = sched._nominated_arrays({p.uid for p in pods})
-        (res, _auxes, _dsnap_out, _dyn_out, _diag), _engine = \
-            sched._run_assignment(
-                jt, batch, forked, None, nom_rows, nom_req, host_auxes,
-                gang_seg=gang_seg,
-            )
-        # the forked dsnap is NEVER committed back to the encoder — the
-        # scheduler's real device state is untouched by the what-if
-        rows = np.asarray(res.node_row)[: len(pods)]
-        name_of = enc.row_to_name()
-        placements: Dict[str, Optional[str]] = {}
-        for pod, row in zip(pods, rows):
-            placements[pod.uid] = (
-                name_of.get(int(row)) if int(row) >= 0 else None)
-        m.descheduler_planner_duration.observe(
-            max(sched.clock() - t0, 0.0))
-        return Prediction(placements=placements, pods=pods,
-                          masked_victims=len(vic_pod_rows))
-
-
-def _has_affinity_terms(pod: v1.Pod) -> bool:
-    aff = pod.spec.affinity
-    if aff is None:
-        return False
-    pa, paa = aff.pod_affinity, aff.pod_anti_affinity
-    return bool(pa and (pa.required or pa.preferred)) or bool(
-        paa and (paa.required or paa.preferred))
+        t0 = self.sched.clock()
+        pred = self.engine.evaluate_one(pending, ForkSpec(
+            victims=list(victims), note="descheduler"))
+        if pred is not None:
+            m.descheduler_planner_duration.observe(
+                max(self.sched.clock() - t0, 0.0))
+        return pred

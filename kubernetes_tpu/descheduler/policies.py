@@ -81,15 +81,18 @@ class PolicyContext:
         return self._pdbs
 
 
-def _clone_for_replacement(pod: v1.Pod) -> v1.Pod:
-    """A what-if stand-in for an evicted pod's controller-recreated
-    replacement: same spec/labels, fresh identity, unbound."""
+def clone_for_replacement(pod: v1.Pod) -> v1.Pod:
+    """A what-if stand-in for an evicted/displaced pod's
+    controller-recreated replacement: same spec/labels, fresh identity,
+    unbound.  Public: the cluster autoscaler's scale-down proof uses the
+    same stand-in (exported via descheduler/__init__)."""
     clone = copy.deepcopy(pod)
     clone.metadata.uid = f"whatif-{pod.uid}"
     clone.metadata.name = f"whatif-{pod.metadata.name}"
     clone.spec.node_name = ""
     clone.status.nominated_node_name = ""
     return clone
+
 
 
 def _evictable(ctx: PolicyContext, pod: v1.Pod) -> bool:
@@ -195,7 +198,7 @@ class SliceDefragmentation:
                     target=slice_name,
                     victims=list(stragglers),
                     pending=[p for p in members if not p.spec.node_name],
-                    replacements=[_clone_for_replacement(p)
+                    replacements=[clone_for_replacement(p)
                                   for p in stragglers],
                     note=f"slice {slice_name} for gang {group_key}",
                 ))
@@ -297,7 +300,7 @@ class SpreadViolationRepair:
         if not candidates:
             return None
         victim = candidates[0]
-        clone = _clone_for_replacement(victim)
+        clone = clone_for_replacement(victim)
         crowded_nodes = {
             n.metadata.name for n in node_by_name.values()
             if n.metadata.labels.get(tsc.topology_key) == max_dom

@@ -171,3 +171,26 @@ descheduler_planner_duration = default_registry.register(
               exponential_buckets(0.001, 2, 15),
               "Device what-if planner solve latency")
 )
+
+# --- unified counterfactual engine + cluster autoscaler -----------------------
+# Emitted at the real decision points: every fork the whatif engine solves
+# (WhatIfEngine.evaluate — descheduler plans, autoscaler simulations), and
+# each autoscaler scale decision's end state in the controller loop.
+
+whatif_forks = default_registry.register(
+    # incremented by K per evaluate() call — K candidate plans ride one
+    # vmapped [K, B, N] solve, so forks/solve is the fan-out observability
+    Counter("whatif_forks_evaluated_total",
+            "Counterfactual forks evaluated by the whatif engine")
+)
+autoscaler_scale_decisions = default_registry.register(
+    # labels: (direction, result) — direction "up" | "down"; result
+    # "applied" (nodes created / node drained+deleted) | "no_fit" (no
+    # simulated candidate made the demand placeable) | "at_max" (demand
+    # exists but every group is at max_size) | "blocked" (scale-down
+    # refused: a PDB blocks a victim or the drain was refused mid-way) |
+    # "no_replacement" (scale-down refused: displaced pods don't re-place
+    # in the what-if) | "error" (store fault mid-apply)
+    Counter("autoscaler_scale_decisions_total",
+            "Cluster-autoscaler scale decisions, by direction and outcome")
+)

@@ -67,6 +67,9 @@ class Workload:
     # evictions/s collector; with gang_size set, TimeToFullSlice doubles
     # as time-to-free-slice (the window spans defrag + gang bind)
     make_descheduler: Optional[Callable] = None
+    # the driven controller is a cluster-autoscaler (AutoscaleGang):
+    # collect scale-decision + whatif-fork items instead of evictions/s
+    autoscaler: bool = False
 
 
 @dataclass
@@ -409,7 +412,28 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         data={"Average": round(throughput, 1)},
                         unit="pods/s",
                     ))
-                    if desched is not None:
+                    if desched is not None and w.autoscaler:
+                        ups = sum(
+                            v for (labels, v)
+                            in m.autoscaler_scale_decisions.items().items()
+                            if len(labels) == 2 and labels[0] == "up"
+                            and labels[1] == "applied"
+                        )
+                        items.append(DataItem(
+                            labels={"Name": w.name,
+                                    "Metric": "AutoscalerScaleUps"},
+                            data={"Count": float(ups)},
+                            unit="decisions",
+                        ))
+                        forks = m.whatif_forks.value(())
+                        items.append(DataItem(
+                            labels={"Name": w.name, "Metric": "WhatIfForks"},
+                            data={"Count": float(forks),
+                                  "PerSecond": (round(forks / total_s, 2)
+                                                if total_s > 0 else 0.0)},
+                            unit="forks/s",
+                        ))
+                    elif desched is not None:
                         evicted = sum(
                             v for (labels, v)
                             in m.descheduler_evictions.items().items()

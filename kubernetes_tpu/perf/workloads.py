@@ -510,6 +510,51 @@ def _defrag(n, p, mp) -> Workload:
     )
 
 
+def _autoscale_gang(n, p, mp) -> Workload:
+    """AutoscaleGang: gang demand outnumbers the initial capacity — only
+    the first slices' worth of gangs can seat; the rest starve until the
+    cluster-autoscaler simulates and applies scale-ups from a NodeGroup
+    (whole fresh slices per decision, whatif node-add forks).  Measures
+    time-to-capacity (TimeToFullSlice spans starve → scale-up → bind),
+    scale decisions applied, and whatif plans/s.  Mid-window node-tier
+    growth (and its recompiles) is the measured cost by design — a
+    scale-up on a live cluster pays exactly that."""
+    from ..autoscaler import ClusterAutoscaler, NodeGroup
+
+    gs = GANG_SIZE if mp >= GANG_SIZE else max(2, mp)
+    ngangs = max(1, mp // gs)
+    need = ngangs * gs
+
+    def nodegroup_template(i: int):
+        ng = NodeGroup(
+            metadata=v1.ObjectMeta(name="asg", namespace="default"),
+            min_size=0, max_size=need + gs,
+            capacity={"cpu": "4", "memory": "32Gi", "pods": "110"},
+            slice_size=gs,
+        )
+        return ("NodeGroup", ng)
+
+    def make_autoscaler(store, sched):
+        # one sync per measured cycle; candidate-size fan-out capped so a
+        # sync's vmapped solve stays a handful of forks
+        return ClusterAutoscaler(store, sched, max_simulated_sizes=4)
+
+    return Workload(
+        name="AutoscaleGang",
+        ops=[
+            Op("createNodes", n, node_template=node_sliced(gs)),
+            Op("createObjects", 1, object_template=nodegroup_template),
+            Op("createObjects", ngangs, object_template=podgroup_template(gs)),
+            Op("createPods", ngangs * gs, pod_template=pod_gang(gs),
+               collect_metrics=True),
+        ],
+        batch_size=64,
+        gang_size=gs,
+        make_descheduler=make_autoscaler,
+        autoscaler=True,
+    )
+
+
 def _mixed_churn(n, p, mp) -> Workload:
     def churn(store, cycle: int):
         # recreate-mode churn (SchedulingWithMixedChurn): one node, one
@@ -599,6 +644,15 @@ SUITES: Dict[str, Suite] = {
         Suite("GangBasic", _gang_basic,
               {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
                "5000Nodes": (5000, 0, 4800)},
+              batch_size={"5000Nodes": 512}),
+        # Cluster autoscaler: initial capacity seats ~1/4 of the gangs;
+        # the rest starve until simulated-then-applied scale-ups add
+        # whole slices — see _autoscale_gang.  Sizes are (initial nodes,
+        # 0, measured gang pods); the autoscaler grows the cluster toward
+        # the pod count's host demand.
+        Suite("AutoscaleGang", _autoscale_gang,
+              {"64Nodes": (16, 0, 56), "500Nodes": (120, 0, 480),
+               "5000Nodes": (1200, 0, 4800)},
               batch_size={"5000Nodes": 512}),
         # Descheduler: every HOST fragmented by a pre-bound straggler,
         # gangs blocked until the defrag policy frees whole slices — see

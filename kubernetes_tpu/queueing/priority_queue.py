@@ -122,6 +122,16 @@ class PriorityQueue:
         self.flush()
         return len(self._active)
 
+    def unschedulable_pods(self) -> List[v1.Pod]:
+        """Pods parked in unschedulableQ — the cluster-autoscaler's demand
+        signal (upstream reads the same queue via the scheduler's
+        nominator/listers).  Pending event moves apply first (like
+        pending_count): a pod a recorded cluster event — e.g. NODE_ADD
+        from the autoscaler's own scale-up — has already queued back to
+        active must not still read as parked demand."""
+        self._apply_pending_moves()
+        return [info.pod for info in self._unschedulable.values()]
+
     def pending_count(self) -> Tuple[int, int, int]:
         self._apply_pending_moves()
         return len(self._active), len(self._backoff), len(self._unschedulable)

@@ -1581,9 +1581,6 @@ class TPUScheduler:
         Returns ((AssignResult, auxes, updated dsnap, dyn, diag), engine)
         from ONE fused dispatch (snapshot scatter + nominations + prepare +
         assign); ``engine`` is "batch" | "scan" for the rounds metric."""
-        from .framework.conflict import conflict_components
-        from .framework.runtime import coupling_flags
-
         # slot count is fixed per scheduler config (depth-1 chained carries;
         # none in sync mode) so every cycle of an instance shares one
         # compiled executable and shallow configs pay no noop passes
@@ -1607,31 +1604,47 @@ class TPUScheduler:
         order = np.arange(batch.size, dtype=np.int32)
         if gang_seg is None:
             gang_seg = self.gangs.gang_segments([], batch.valid.shape[0])
-        mode = self.assign_mode
-        if mode in ("auto", "batch"):
-            t_part = self.clock()
-            info = conflict_components(
-                batch.pods, batch.size,
-                namespace_labels=self.namespace_labels,
-            )
-            coupling = coupling_flags(batch, info=info)
-            self.phase_wall["partition"] += self.clock() - t_part
+        t_part = self.clock()
+        mode, coupling, info = self.engine_choice(batch)
+        self.phase_wall["partition"] += self.clock() - t_part
+        if info is not None:
             for s in info.sizes:
                 m.coupled_component_size.observe(s)
-            n_valid = max(int(batch.valid.sum()), 1)
-            # serial work in the auction is bounded by the LARGEST component,
-            # so that — not the coupled fraction — is what the threshold
-            # compares; a batch that is one giant chain still takes the scan
-            if mode == "batch" or info.max_multi <= max(
-                    1, int(self.coupled_fraction_threshold * n_valid)):
-                return jt["batch"](
-                    batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
-                    order, gang_seg, coupling, self.rng_key,
-                ), "batch"
+        if mode == "batch":
+            return jt["batch"](
+                batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
+                order, gang_seg, coupling, self.rng_key,
+            ), "batch"
         return jt["greedy"](
             batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order,
             gang_seg, self.rng_key,
         ), "scan"
+
+    def engine_choice(self, batch):
+        """The auto/batch/scan routing decision as ONE shared predicate:
+        (mode, coupling, partition info).  The whatif engine routes its
+        fork solves through this SAME method — the bit-for-bit parity
+        contract (predicted == actual bindings) depends on the two paths
+        never drifting, so the decision must not be duplicated."""
+        from .framework.conflict import conflict_components
+        from .framework.runtime import coupling_flags
+
+        mode = self.assign_mode
+        if mode not in ("auto", "batch"):
+            return "scan", None, None
+        info = conflict_components(
+            batch.pods, batch.size,
+            namespace_labels=self.namespace_labels,
+        )
+        coupling = coupling_flags(batch, info=info)
+        n_valid = max(int(np.asarray(batch.valid).sum()), 1)
+        # serial work in the auction is bounded by the LARGEST component,
+        # so that — not the coupled fraction — is what the threshold
+        # compares; a batch that is one giant chain still takes the scan
+        if mode == "batch" or info.max_multi <= max(
+                1, int(self.coupled_fraction_threshold * n_valid)):
+            return "batch", coupling, info
+        return "scan", coupling, info
 
     def _noop_delta(self, like_batch, with_groups: bool = False):
         """No-op PrevBatch (all rows -1) with the SAME array shapes as a

@@ -246,6 +246,13 @@ class AffinityIndex:
         if old:
             self._apply(old, -1)
 
+    def contributions(self, uid: str) -> Tuple[Tuple[int, int], ...]:
+        """A scheduled pod's live (group_row, domain_val) contributions —
+        what remove_pod would subtract.  The what-if engine masks exactly
+        these cells out of a forked ``aff_counts`` so an affinity-carrying
+        victim's fork equals the post-eviction state bit-for-bit."""
+        return self._contrib.get(uid, ())
+
     def rebuild(self, snapshot) -> None:
         """Resync/repair path: recompute every count from the snapshot's
         sparse affinity lists into the SAME registry rows (registry stays

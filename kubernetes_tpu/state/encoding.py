@@ -773,6 +773,13 @@ _AFF_ARRAYS = [
     "aff_valid", "aff_kind", "aff_weight", "aff_slot", "aff_counts",
 ]
 
+# Public alias for the node array group: the whatif fork engine
+# (whatif/fork.py) captures scratch-encoded template rows and re-activates
+# them inside forked DeviceSnapshots aligned with exactly this list — a
+# new node-plane array added above is automatically carried by node-add
+# forks (and by the scatter upload paths) with no further wiring.
+NODE_ARRAYS = _NODE_ARRAYS
+
 # node tiers at or below this take the always-full upload path in
 # to_device_deferred (see the small-cluster note there)
 _SMALL_NODE_TIER = 1024

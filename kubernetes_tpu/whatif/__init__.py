@@ -1,0 +1,36 @@
+"""Unified multi-fork counterfactual engine.
+
+The ONE fork-and-resolve machine in the tree (ROADMAP item 2): K candidate
+plans — victim masks, template-node adds, host removals — evaluated as a
+single ``[K, B, N]`` vmapped solve over K forked DeviceSnapshots, with the
+``aff_*`` affinity tables masked so no victim class is refused.
+
+Layer map (COMPONENTS.md has the upstream-analogue table):
+  fork.py   — ForkSpec/ForkPayload + the pure traceable ``apply_fork``
+              (cluster-autoscaler simulator snapshot / DryRunPreemption
+              NodeInfo clone analog)
+  engine.py — WhatIfEngine: queue-order staging, fork payload build,
+              scheduler-identical engine routing, the vmapped solve
+  dryrun.py — preemption's batched dry-run primitives
+              (candidate_mask_device, sweep_and_rank)
+
+Consumers: descheduler/planner.py (WhatIfPlanner is a thin wrapper),
+autoscaler/controller.py (scale-up/scale-down simulation), preemption.py
+(dry-run fan-out).
+"""
+
+from .dryrun import PRIORITY_LEVEL_CAP, candidate_mask_device, sweep_and_rank
+from .engine import Prediction, WhatIfEngine
+from .fork import ForkPayload, ForkSpec, ForkedEncoderView, apply_fork
+
+__all__ = [
+    "PRIORITY_LEVEL_CAP",
+    "candidate_mask_device",
+    "sweep_and_rank",
+    "Prediction",
+    "WhatIfEngine",
+    "ForkPayload",
+    "ForkSpec",
+    "ForkedEncoderView",
+    "apply_fork",
+]

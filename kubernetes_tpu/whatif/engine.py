@@ -1,0 +1,365 @@
+"""The unified multi-fork counterfactual engine.
+
+One evaluator for every fork-and-resolve consumer in the tree — the
+descheduler's WhatIfPlanner, the cluster autoscaler's scale-up/scale-down
+simulations, and (via whatif/dryrun.py) preemption's dry-run fan-out.
+Upstream analogs: cluster-autoscaler's simulator (SchedulePod against a
+cluster snapshot with template nodes) and the scheduler framework's
+DryRunPreemption.
+
+K candidate plans are evaluated as ONE ``[K, B, N]`` vmapped solve: each
+fork (victim-mask / node-add / node-remove, whatif/fork.py) is applied to
+the live DeviceSnapshot inside the program, and the scheduler's own
+assignment semantics — same engine routing (conflict-partitioned batch
+auction vs exact greedy scan), same gang all-or-nothing mask, same
+deterministic tie-breaks — re-run per fork.  The vmapped K-fork solve is
+bit-for-bit equal to K sequential single-fork solves (pinned in
+tests/test_whatif.py), and a single victim-mask fork is bit-for-bit equal
+to the scheduler's actual post-eviction bindings (the descheduler parity
+contract, tests/test_descheduler.py).
+
+Quiescence precondition (same as the pre-unification planner): an
+in-flight pipelined batch holds placements the fork can't see —
+``evaluate`` refuses rather than mispredict; controllers flush the
+pipeline first.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import objects as v1
+from ..metrics import scheduler_metrics as m
+from ..state.encoding import NODE_ARRAYS as _NODE_ARRAYS
+from ..state.units import pow2_round_up as _pow2
+from .fork import ForkedEncoderView, ForkPayload, ForkSpec, apply_fork, stack_payloads
+
+
+@dataclass
+class Prediction:
+    """One counterfactual solve's outcome."""
+
+    placements: Dict[str, Optional[str]]  # pod uid → node name (None = no fit)
+    pods: List[v1.Pod] = field(default_factory=list)  # solve order (= queue order)
+    masked_victims: int = 0
+    fork: Optional[ForkSpec] = None
+
+    @property
+    def placed(self) -> int:
+        return sum(1 for n in self.placements.values() if n is not None)
+
+    @property
+    def unplaced(self) -> int:
+        return sum(1 for n in self.placements.values() if n is None)
+
+
+class _QueueShim:
+    """Just enough QueuedPodInfo surface for the gang less-fn."""
+
+    __slots__ = ("pod", "initial_attempt_timestamp")
+
+    def __init__(self, pod: v1.Pod):
+        self.pod = pod
+        self.initial_attempt_timestamp = pod.metadata.creation_timestamp or 0.0
+
+
+class WhatIfEngine:
+    """Counterfactual solver bound to a live TPUScheduler (shares its
+    cache/encoder/compiler; fork programs are its own, compiled once per
+    (profile, engine) and reused across every consumer)."""
+
+    def __init__(self, scheduler):
+        self.sched = scheduler
+        # (profile, mode) → (framework instance, jitted program); rebuilt
+        # when the scheduler's framework for the profile is replaced
+        # (domain growth clears TPUScheduler._fws)
+        self._programs: Dict[Tuple[str, str], Tuple[object, object]] = {}
+
+    # --- queue-order staging --------------------------------------------------
+
+    def order_pending(self, pods: Sequence[v1.Pod]) -> List[v1.Pod]:
+        """The queue's pop order (gang-cohesive priority sort) so the
+        counterfactual batch matches what the real scheduler will pop."""
+        less = self.sched.gangs.less
+        shims = [_QueueShim(p) for p in pods]
+        shims.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if less(a, b) else (1 if less(b, a) else 0)))
+        return [s.pod for s in shims]
+
+    # --- the solve ------------------------------------------------------------
+
+    def evaluate_one(self, pending: Sequence[v1.Pod],
+                     fork: ForkSpec) -> Optional[Prediction]:
+        out = self.evaluate(pending, [fork], vmapped=False)
+        return out[0] if out else None
+
+    def evaluate(self, pending: Sequence[v1.Pod],
+                 forks: Sequence[ForkSpec],
+                 vmapped: bool = True) -> Optional[List[Prediction]]:
+        """Where would ``pending`` land under each of K candidate forks?
+
+        Returns one Prediction per fork, or None when no solve can be
+        trusted (empty/oversize batch, in-flight pipelined work) — callers
+        must treat that as "no plan", never as "no fit".  ``vmapped=False``
+        runs K sequential single-fork solves instead of the stacked vmap —
+        the parity oracle (tests/test_whatif.py pins both paths equal
+        bit-for-bit).
+        """
+        sched = self.sched
+        if not pending or not forks or len(pending) > sched.batch_size:
+            return None
+        if getattr(sched, "_inflight_q", None):
+            # quiescence precondition (module doc): refuse rather than
+            # mispredict; controllers flush in-flight work first
+            return None
+        changed = sched.cache.update_snapshot(sched.snapshot)
+        sched.encoder.sync(sched.snapshot, changed)
+        enc = sched.encoder
+        # compile BEFORE template-node encoding and the device upload (same
+        # order as _dispatch_batch): first-seen topology keys register at
+        # compile time and backfill node_topo rows both must carry
+        pods = self.order_pending(pending)
+        batch = sched.compiler.compile(pods, pad_to=sched.batch_size)
+        payloads, views, added_names = self._build_forks(forks)
+        # the framework is resolved AFTER fork building: scratch template
+        # encodes may grow the topology domain, and _framework rebuilds the
+        # plugin programs against the final domain_cap
+        profile = sched._profile_of(pods[0])
+        fw = sched._framework(profile)
+        dsnap = enc.to_device()
+        sched.gangs.stage_batch(pods)
+        gang_seg = sched.gangs.gang_segments(pods, batch.size)
+        host_auxes = [
+            fw.host_prepare(batch, sched.snapshot, view,
+                            namespace_labels=sched.namespace_labels)
+            for view in views
+        ]
+        nom_rows, nom_req = sched._nominated_arrays({p.uid for p in pods})
+        mode, coupling = self._route(batch)
+        progs = self._programs_for(profile, fw, mode)
+        order = np.arange(batch.size, dtype=np.int32)
+        args = (nom_rows, nom_req, order, gang_seg)
+        if vmapped and len(forks) > 1:
+            stacked_aux = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *host_auxes)
+            rows_k = np.asarray(progs["k"](
+                batch, dsnap, stack_payloads(payloads), stacked_aux,
+                coupling, sched.rng_key, *args))
+        else:
+            rows_k = np.stack([
+                np.asarray(progs["one"](
+                    batch, dsnap, payload, aux, coupling, sched.rng_key,
+                    *args))
+                for payload, aux in zip(payloads, host_auxes)
+            ])
+        # the forked snapshots are NEVER committed back to the encoder —
+        # the scheduler's real device state is untouched by the what-if
+        m.whatif_forks.inc(by=len(forks))
+        name_of = enc.row_to_name()
+        out: List[Prediction] = []
+        for k, (fork, payload) in enumerate(zip(forks, payloads)):
+            rows = rows_k[k][: len(pods)]
+            placements: Dict[str, Optional[str]] = {}
+            for pod, row in zip(pods, rows):
+                r = int(row)
+                name = None
+                if r >= 0:
+                    name = added_names[k].get(r) or name_of.get(r)
+                placements[pod.uid] = name
+            out.append(Prediction(
+                placements=placements, pods=pods,
+                masked_victims=int((payload.vic_pod_rows >= 0).sum()),
+                fork=fork))
+        return out
+
+    # --- fork payload construction -------------------------------------------
+
+    def _build_forks(self, forks: Sequence[ForkSpec]):
+        """Resolve each ForkSpec against the (just-synced) encoder into
+        fixed-shape payloads, host views, and per-fork added-row→name maps.
+
+        Template nodes are encoded into SCRATCH encoder rows (growing the
+        tiers/dictionary exactly as the real scale-up will), their array
+        rows captured, then rolled back — the mirrors uploaded to device
+        carry the rows invalid, and each fork's payload re-activates only
+        its own adds."""
+        from ..state.node_info import NodeInfo
+
+        enc = self.sched.encoder
+        any_adds = any(f.add_nodes for f in forks)
+        scratch: Dict[int, List[Tuple[int, str]]] = {}
+        captured_vals: Dict[int, list] = {}
+        captured_view: Dict[int, dict] = {}
+        if any_adds:
+            scratch_names: set = set()
+            encode_order: List[Tuple[int, str]] = []
+            try:
+                for fi, f in enumerate(forks):
+                    rows = []
+                    for node in f.add_nodes:
+                        name = node.metadata.name
+                        if name in enc.node_rows and \
+                                name not in scratch_names:
+                            raise ValueError(
+                                f"whatif node-add: node {name!r} "
+                                f"already exists")
+                        if name not in scratch_names:
+                            scratch_names.add(name)
+                            row = enc.encode_node(NodeInfo.of(node))
+                            encode_order.append((row, name))
+                        else:
+                            row = enc.node_rows[name]
+                        rows.append((row, name))
+                    scratch[fi] = rows
+            except Exception:
+                # mid-build failure (name collision, encoding-capacity
+                # overflow): already-encoded scratch rows MUST leave the
+                # live encoder, or the scheduler's next cycle could place
+                # real pods on phantom nodes
+                for row, name in reversed(encode_order):
+                    enc.remove_node(name)
+                raise
+            # capture AFTER all encodes: a later encode may grow the node
+            # tier, reallocating the mirrors the capture must read
+            for rows in scratch.values():
+                for row, _name in rows:
+                    if row in captured_vals:
+                        continue
+                    captured_vals[row] = [
+                        np.copy(getattr(enc, name)[row])
+                        for name in _NODE_ARRAYS
+                    ]
+                    captured_view[row] = {
+                        "allocatable": np.copy(enc.allocatable[row]),
+                        "requested": np.copy(enc.requested[row]),
+                        "non_zero_requested":
+                            np.copy(enc.non_zero_requested[row]),
+                    }
+            # roll back in REVERSE encode order: the encoder's free-row
+            # list is a LIFO, so this leaves it positioned to hand the
+            # SAME rows back to the same template names on an identical
+            # rebuild — two evaluate() calls over one fork set then
+            # tie-break identically (the vmapped==sequential parity
+            # battery compares exactly that)
+            for row, name in reversed(encode_order):
+                enc.remove_node(name)
+
+        per_fork: List[dict] = []
+        for fi, f in enumerate(forks):
+            vic: List[Tuple[int, int]] = []
+            aff: List[Tuple[int, int]] = []
+            for v in f.victims:
+                pr = enc.pod_rows.get(v.uid)
+                nr = enc.node_rows.get(v.spec.node_name)
+                if pr is None or nr is None:
+                    continue  # not encoded (already gone / never bound): no-op
+                vic.append((pr, nr))
+                aff.extend(enc.aff.contributions(v.uid))
+            dels = [enc.node_rows[n] for n in f.remove_nodes
+                    if n in enc.node_rows]
+            adds = scratch.get(fi, [])
+            per_fork.append({"vic": vic, "aff": aff, "del": dels,
+                             "add": adds})
+
+        vcap = _pow2(max((len(p["vic"]) for p in per_fork), default=1), 8)
+        acap = _pow2(max((len(p["aff"]) for p in per_fork), default=1), 8)
+        dcap = _pow2(max((len(p["del"]) for p in per_fork), default=1), 8)
+        mcap = (_pow2(max((len(p["add"]) for p in per_fork), default=1), 4)
+                if any_adds else 0)
+
+        payloads: List[ForkPayload] = []
+        views: List[ForkedEncoderView] = []
+        added_names: List[Dict[int, str]] = []
+        for p in per_fork:
+            vic_p = np.full(vcap, -1, dtype=np.int32)
+            vic_n = np.zeros(vcap, dtype=np.int32)
+            for i, (pr, nr) in enumerate(p["vic"]):
+                vic_p[i], vic_n[i] = pr, nr
+            aff_r = np.full(acap, -1, dtype=np.int32)
+            aff_v = np.zeros(acap, dtype=np.int32)
+            for i, (gr, dv) in enumerate(p["aff"]):
+                aff_r[i], aff_v[i] = gr, dv
+            del_r = np.full(dcap, -1, dtype=np.int32)
+            for i, r in enumerate(p["del"]):
+                del_r[i] = r
+            add_rows = add_ok = add_vals = None
+            if any_adds:
+                add_rows = np.zeros(mcap, dtype=np.int32)
+                add_ok = np.zeros(mcap, dtype=bool)
+                for i, (row, _name) in enumerate(p["add"]):
+                    add_rows[i], add_ok[i] = row, True
+                add_vals = tuple(
+                    np.stack([
+                        (captured_vals[p["add"][i][0]][ai]
+                         if i < len(p["add"])
+                         else np.asarray(getattr(enc, name)[0]))
+                        for i in range(mcap)
+                    ])
+                    for ai, name in enumerate(_NODE_ARRAYS)
+                )
+                # pad rows point at row 0 with ok=False — apply_fork
+                # rewrites current values there (exact no-op)
+            payloads.append(ForkPayload(
+                vic_pod_rows=vic_p, vic_node_rows=vic_n,
+                aff_rows=aff_r, aff_vals=aff_v, del_rows=del_r,
+                add_rows=add_rows, add_ok=add_ok, add_vals=add_vals))
+            views.append(ForkedEncoderView(
+                enc, p["vic"], p["del"],
+                [row for row, _ in p["add"]], captured_view))
+            added_names.append({row: name for row, name in p["add"]})
+        return payloads, views, added_names
+
+    # --- engine routing + compiled programs -----------------------------------
+
+    def _route(self, batch):
+        """Route through the scheduler's OWN engine-choice predicate — a
+        fork's solve must provably route exactly like the real dispatch
+        will (the parity contract depends on one implementation)."""
+        mode, coupling, _info = self.sched.engine_choice(batch)
+        return ("batch", coupling) if mode == "batch" else ("greedy", None)
+
+    def _programs_for(self, profile: str, fw, mode: str):
+        key = (profile, mode)
+        cached = self._programs.get(key)
+        if cached is not None and cached[0] is fw:
+            return cached[1]
+        from ..framework.runtime import initial_dynamic_state
+        from ..gang import gang_all_or_nothing
+
+        def reserve_nominated(dsnap, nom_rows, nom_req):
+            dyn = initial_dynamic_state(dsnap)
+            rows = jnp.clip(nom_rows, 0, dsnap.requested.shape[0] - 1)
+            add = jnp.where((nom_rows >= 0)[:, None], nom_req, 0)
+            return dyn._replace(
+                requested=dyn.requested.at[rows].add(
+                    add.astype(dyn.requested.dtype)))
+
+        def body(batch, dsnap, payload, host_auxes, coupling, key,
+                 nom_rows, nom_req, order, gang_seg):
+            fsnap = apply_fork(dsnap, payload)
+            dyn = reserve_nominated(fsnap, nom_rows, nom_req)
+            auxes = fw.prepare(batch, fsnap, dyn, host_auxes)
+            if mode == "batch":
+                res = fw.batch_assign(batch, fsnap, dyn, auxes, order,
+                                      coupling, key)
+            else:
+                res = fw.greedy_assign(batch, fsnap, dyn, auxes, order, key)
+            return gang_all_or_nothing(res.node_row, gang_seg)
+
+        def k_body(batch, dsnap, payloads, host_auxes, coupling, key,
+                   nom_rows, nom_req, order, gang_seg):
+            def one(payload, aux):
+                return body(batch, dsnap, payload, aux, coupling, key,
+                            nom_rows, nom_req, order, gang_seg)
+
+            return jax.vmap(one)(payloads, host_auxes)
+
+        progs = {"one": jax.jit(body), "k": jax.jit(k_body)}
+        self._programs[key] = (fw, progs)
+        return progs

@@ -1,0 +1,193 @@
+"""DeviceSnapshot forks: the counterfactual state a what-if solve runs on.
+
+A fork is the device analog of upstream cluster-autoscaler's simulator
+snapshot (simulator/clustersnapshot) and DryRunPreemption's cloned
+NodeInfos: a COPY of cluster state with a hypothetical change applied,
+never committed back.  Three capabilities compose freely in one fork:
+
+  - victim-mask: scheduled pods invalidated, their request vectors
+    subtracted from their hosts, AND their (anti)affinity term-count
+    contributions subtracted from the incremental ``aff_*`` tables
+    (state/affinity_index.py) — so affinity-carrying victims fork to
+    exactly the state the encoder reaches after a real eviction, and no
+    victim class is refused (the pre-whatif WhatIfPlanner's documented
+    limitation);
+  - node-add: template node rows (capacity/labels/taints, pre-encoded by
+    the engine into scratch encoder rows) activated in the fork — the
+    cluster-autoscaler "simulate against template nodes" primitive;
+  - node-remove: host rows invalidated (callers pair this with a
+    victim-mask of the host's pods for scale-down what-ifs).
+
+``apply_fork`` is pure and traceable: the engine vmaps it (plus the whole
+assignment program) over K stacked payloads for one ``[K, B, N]`` solve.
+All payload groups are fixed-shape with -1 row padding so every fork of a
+set shares one compiled program; pads are exact no-ops (masked adds,
+scatter-max of False, ``.add`` of 0) and leave the result bit-identical
+to a fork built without them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import objects as v1
+from ..state.encoding import NODE_ARRAYS as _NODE_ARRAYS
+
+
+@dataclass
+class ForkSpec:
+    """One candidate plan, host-side: what to change before the solve."""
+
+    victims: List[v1.Pod] = field(default_factory=list)
+    add_nodes: List[v1.Node] = field(default_factory=list)
+    remove_nodes: List[str] = field(default_factory=list)
+    note: str = ""  # plan label for logs/metrics
+
+
+class ForkPayload(NamedTuple):
+    """Device-side fork arguments (one fork; the engine stacks K of these
+    leaf-wise for the vmapped solve).  ``add_vals`` is aligned with
+    ``state.encoding._NODE_ARRAYS``; the add group is None when no fork in
+    the evaluated set adds nodes, so victim-only consumers (the
+    descheduler) keep the cheaper compiled variant."""
+
+    vic_pod_rows: np.ndarray  # i32[V] (-1 pad)
+    vic_node_rows: np.ndarray  # i32[V]
+    aff_rows: np.ndarray  # i32[A] (-1 pad) victim term-group rows
+    aff_vals: np.ndarray  # i32[A] domain value per contribution
+    del_rows: np.ndarray  # i32[D] (-1 pad) node rows to invalidate
+    add_rows: object = None  # i32[M] | None — scratch rows to activate
+    add_ok: object = None  # bool[M] | None
+    add_vals: object = None  # tuple[np.ndarray[M, ...]] | None
+
+
+def apply_fork(dsnap, p: ForkPayload):
+    """Apply one fork payload to a DeviceSnapshot (pure, traceable).
+
+    The scatters are not donated: the live snapshot survives — a what-if
+    is NEVER committed back (same contract the descheduler planner pinned
+    in test_planner_does_not_disturb_live_state).
+    """
+    n = dsnap.requested.shape[0]
+    pcap = dsnap.pod_valid.shape[0]
+    # --- node-add: activate pre-encoded template rows -----------------------
+    if p.add_rows is not None:
+        rows = jnp.clip(p.add_rows, 0, n - 1)
+        updates = {}
+        for name, val in zip(_NODE_ARRAYS, p.add_vals):
+            cur = getattr(dsnap, name)
+            okb = p.add_ok.reshape((-1,) + (1,) * (val.ndim - 1))
+            # pad rows (ok=False) rewrite their current values — exact no-op
+            updates[name] = cur.at[rows].set(jnp.where(okb, val, cur[rows]))
+        dsnap = dataclasses.replace(dsnap, **updates)
+    # --- node-remove --------------------------------------------------------
+    ok_d = p.del_rows >= 0
+    drow = jnp.clip(p.del_rows, 0, n - 1)
+    dead = jnp.zeros(n, dtype=bool).at[drow].max(ok_d)
+    node_valid = dsnap.node_valid & ~dead
+    # --- victim-mask (pods + host resources; duplicates/pads are safe:
+    # the validity mask is a scatter-max and the resource deltas are
+    # zero-weighted where the pod row is padding) ----------------------------
+    ok_v = p.vic_pod_rows >= 0
+    prow = jnp.clip(p.vic_pod_rows, 0, pcap - 1)
+    nrow = jnp.clip(p.vic_node_rows, 0, n - 1)
+    vic_mask = jnp.zeros(pcap, dtype=bool).at[prow].max(ok_v)
+    pod_valid = dsnap.pod_valid & ~vic_mask
+    okc = ok_v[:, None]
+    requested = dsnap.requested.at[nrow].add(
+        jnp.where(okc, -dsnap.pod_request[prow], 0))
+    non_zero = dsnap.non_zero_requested.at[nrow].add(
+        jnp.where(okc, -dsnap.pod_non_zero[prow], 0))
+    # --- affinity-table mask: subtract each victim term contribution from
+    # its (group row, domain value) count cell — exactly the delta
+    # AffinityIndex.remove_pod applies on a real eviction, so the forked
+    # tables equal the post-eviction rebuild bit-for-bit ---------------------
+    ok_a = p.aff_rows >= 0
+    g = dsnap.aff_counts.shape[0]
+    d = dsnap.aff_counts.shape[1]
+    arow = jnp.clip(p.aff_rows, 0, g - 1)
+    aval = jnp.clip(p.aff_vals, 0, d - 1)
+    aff_counts = dsnap.aff_counts.at[arow, aval].add(
+        -ok_a.astype(dsnap.aff_counts.dtype))
+    return dataclasses.replace(
+        dsnap, node_valid=node_valid, pod_valid=pod_valid,
+        requested=requested, non_zero_requested=non_zero,
+        aff_counts=aff_counts)
+
+
+class ForkedEncoderView:
+    """Read-only encoder facade with one fork applied to the HOST mirrors —
+    handed to ``host_prepare`` so host-side plugin state (the Coscheduling
+    anchor-slice plane's free-capacity scan, any host reader of
+    ``requested``/``pod_valid``/``node_valid``) sees the same
+    counterfactual the device fork encodes.  Everything else delegates to
+    the live encoder.
+
+    Fidelity note (node-add forks): added template nodes are visible in
+    the mirrors here, but store-derived host state (the gang slice-domain
+    plane reads Node objects from the store) cannot see nodes that do not
+    exist yet — score-level preferences may therefore differ from the
+    post-scale-up cluster.  Placeability (filters, resources) is exact;
+    victim-mask and node-remove forks are bit-for-bit.
+    """
+
+    def __init__(self, encoder, vic_rows: Sequence[Tuple[int, int]],
+                 del_rows: Sequence[int],
+                 add_rows: Sequence[int],
+                 add_captured: Optional[Dict[int, dict]] = None):
+        self._enc = encoder
+        requested = encoder.requested.copy()
+        non_zero = encoder.non_zero_requested.copy()
+        pod_valid = encoder.pod_valid.copy()
+        node_valid = encoder.node_valid.copy()
+        allocatable = encoder.allocatable
+        if add_rows:
+            allocatable = allocatable.copy()
+            for row in add_rows:
+                cap = (add_captured or {}).get(row)
+                node_valid[row] = True
+                if cap is not None:
+                    allocatable[row] = cap["allocatable"]
+                    requested[row] = cap["requested"]
+                    non_zero[row] = cap["non_zero_requested"]
+        for pr, nr in vic_rows:
+            requested[nr] -= encoder.pod_request[pr]
+            non_zero[nr] -= encoder.pod_non_zero[pr]
+            pod_valid[pr] = False
+        for row in del_rows:
+            node_valid[row] = False
+        self.requested = requested
+        self.non_zero_requested = non_zero
+        self.pod_valid = pod_valid
+        self.node_valid = node_valid
+        self.allocatable = allocatable
+
+    def __getattr__(self, name):
+        return getattr(self._enc, name)
+
+
+def stack_payloads(payloads: Sequence[ForkPayload]) -> ForkPayload:
+    """K same-shape payloads → one [K, ...]-leading payload for vmap."""
+    first = payloads[0]
+    if first.add_rows is None:
+        add_rows = add_ok = add_vals = None
+    else:
+        add_rows = np.stack([p.add_rows for p in payloads])
+        add_ok = np.stack([p.add_ok for p in payloads])
+        add_vals = tuple(
+            np.stack([p.add_vals[i] for p in payloads])
+            for i in range(len(first.add_vals))
+        )
+    return ForkPayload(
+        vic_pod_rows=np.stack([p.vic_pod_rows for p in payloads]),
+        vic_node_rows=np.stack([p.vic_node_rows for p in payloads]),
+        aff_rows=np.stack([p.aff_rows for p in payloads]),
+        aff_vals=np.stack([p.aff_vals for p in payloads]),
+        del_rows=np.stack([p.del_rows for p in payloads]),
+        add_rows=add_rows, add_ok=add_ok, add_vals=add_vals,
+    )

@@ -1,0 +1,451 @@
+"""Cluster autoscaler: NodeGroup API, scale-up e2e (starved gang →
+simulated → applied → all-or-nothing bind), scale-down gating (PDB,
+replacement proof, min-size), chaos exactly-once, and the CLI surface."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import roundtrips
+from kubernetes_tpu.autoscaler import (
+    NODE_GROUP_LABEL,
+    ClusterAutoscaler,
+    NodeGroup,
+    member_nodes,
+)
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.controllers.disruption import sync_pdbs
+from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _group(name="tpu", min_size=0, max_size=8, cpu="4", slice_size=4,
+           cost=1.0):
+    return NodeGroup(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        min_size=min_size, max_size=max_size,
+        capacity={"cpu": cpu, "pods": "10"}, slice_size=slice_size,
+        cost_per_node=cost)
+
+
+def _gang(store, name="g", members=4, cpu="3", created=100.0):
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name=name, namespace="default"),
+                     min_member=members, schedule_timeout_seconds=30)
+    pg.metadata.creation_timestamp = created
+    store.create("PodGroup", pg)
+    for i in range(members):
+        p = (make_pod().name(f"{name}-{i}").uid(f"{name}-{i}")
+             .namespace("default").label(POD_GROUP_LABEL, name)
+             .req({"cpu": cpu}).obj())
+        p.metadata.creation_timestamp = created
+        store.create("Pod", p)
+
+
+def _starve(store, sched, clock, cycles=4):
+    for _ in range(cycles):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    clock.advance(40.0)  # fail any Permit hold so nothing stays assumed
+    sched.schedule_cycle()
+
+
+def _member_node(store, group_name, idx, cpu="4", slice_name="s0"):
+    store.create("Node", make_node().name(f"{group_name}-{idx}")
+                 .capacity({"cpu": cpu, "pods": "10"})
+                 .label(NODE_GROUP_LABEL, group_name)
+                 .label(SLICE_LABEL, slice_name).obj())
+
+
+# --- API object ---------------------------------------------------------------
+
+
+def test_nodegroup_scheme_roundtrip():
+    s = default_scheme()
+    ng = _group()
+    ng.taints = [v1.Taint(key="tpu", value="1")]
+    assert roundtrips(ng, s)
+    # served under the autoscaling group; wrong group is rejected
+    from kubernetes_tpu.api.serialize import to_manifest
+
+    man = to_manifest(ng, s)
+    assert man["apiVersion"] == "autoscaling.x-k8s.io/v1alpha1"
+    assert man["spec"]["template"]["sliceSize"] == 4
+
+
+# --- scale-up -----------------------------------------------------------------
+
+
+def test_scale_up_starved_gang_binds_all_or_nothing():
+    """THE acceptance scenario: a starved multi-host gang goes from
+    Unschedulable to fully bound via a simulated-then-applied scale-up —
+    the nodes the simulation forked are the nodes the apply creates."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    # an existing slice too small for the gang (2 hosts; gang needs 4)
+    for i in range(2):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"})
+                     .label(SLICE_LABEL, "s0").obj())
+    store.create("NodeGroup", _group(max_size=8, slice_size=4))
+    _gang(store, "g", members=4, cpu="3")
+    _starve(store, sched, clock)
+    assert len(sched.queue.unschedulable_pods()) == 4
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert (d.direction, d.result, d.count) == ("up", "applied", 4)
+    assert m.autoscaler_scale_decisions.value(("up", "applied")) >= 1.0
+    # one whole fresh slice materialized with deterministic names
+    added = member_nodes(store.get("NodeGroup", "default", "tpu"),
+                         store.list("Node")[0])
+    assert sorted(n.metadata.name for n in added) == \
+        ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert {n.metadata.labels[SLICE_LABEL] for n in added} == \
+        {"tpu-slice-0"}
+    # a consecutive sync BEFORE the scheduler retries must not
+    # over-provision: the zero-add baseline proves the demand now fits
+    assert ca.sync_once() is False
+    assert len(member_nodes(store.get("NodeGroup", "default", "tpu"),
+                            store.list("Node")[0])) == 4
+    sched.run_until_idle(backoff_wait=2.0)
+    bound = [store.get("Pod", "default", f"g-{i}").spec.node_name
+             for i in range(4)]
+    assert all(bound), bound  # all-or-nothing: every member bound
+    assert set(bound) == {n.metadata.name for n in added}
+    assert store.get("PodGroup", "default", "g").phase == \
+        v1.POD_GROUP_SCHEDULED
+    # demand satisfied: the next sync decides nothing
+    assert ca.sync_once() is False
+    assert ca.last_decisions == []
+
+
+def test_scale_up_bounded_by_max_size():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    # max_size 2 < the 4 hosts the gang needs: no viable candidate
+    store.create("NodeGroup", _group(max_size=2, slice_size=1))
+    _gang(store, "g", members=4, cpu="3")
+    _starve(store, sched, clock)
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is False
+    [d] = ca.last_decisions
+    assert d.result == "no_fit"
+    assert m.autoscaler_scale_decisions.value(("up", "no_fit")) >= 1.0
+    assert all(NODE_GROUP_LABEL not in n.metadata.labels
+               for n in store.list("Node")[0])
+    # a group already at max reports at_max
+    store.delete("NodeGroup", "default", "tpu")
+    g0 = _group(name="full", max_size=1, slice_size=1)
+    store.create("NodeGroup", g0)
+    _member_node(store, "full", 0)
+    sched.schedule_cycle()
+    assert ca.sync_once() is False
+    assert ca.last_decisions[-1].result == "at_max"
+
+
+def test_scale_up_picks_cheapest_group():
+    """Expander analog: two groups can seat the demand; the cheaper total
+    cost (count × cost_per_node) wins."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "1", "pods": "10"}).obj())
+    # big hosts: 2 nodes × cost 4 = 8; small hosts: 4 nodes × cost 1 = 4
+    store.create("NodeGroup", _group(name="big", cpu="8", slice_size=1,
+                                     cost=4.0, max_size=8))
+    store.create("NodeGroup", _group(name="small", cpu="4", slice_size=1,
+                                     cost=1.0, max_size=8))
+    _gang(store, "g", members=4, cpu="3")
+    _starve(store, sched, clock)
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert d.group == "small" and d.result == "applied"
+    sched.run_until_idle(backoff_wait=2.0)
+    assert all(store.get("Pod", "default", f"g-{i}").spec.node_name
+               for i in range(4))
+
+
+def test_scale_up_dry_run_creates_nothing():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    store.create("NodeGroup", _group(max_size=8, slice_size=1))
+    _gang(store, "g", members=2, cpu="3")
+    _starve(store, sched, clock)
+    nodes_before = {n.metadata.name for n in store.list("Node")[0]}
+    ca = ClusterAutoscaler(store, sched, dry_run=True)
+    assert ca.sync_once() is False
+    assert ca.last_decisions[0].result == "dry_run"
+    assert {n.metadata.name for n in store.list("Node")[0]} == nodes_before
+
+
+# --- scale-down ---------------------------------------------------------------
+
+
+def _scaled_cluster(clock, idle_cpu="1"):
+    """A 3-member group (min 1): two busy hosts (3/4 cpu) and one
+    underutilized host carrying a single small pod."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("NodeGroup", _group(min_size=1, max_size=8, slice_size=0))
+    for i in range(3):
+        _member_node(store, "tpu", i)
+    for i in range(2):
+        store.create("Pod", make_pod().name(f"busy-{i}").uid(f"busy-{i}")
+                     .namespace("default").req({"cpu": "3"})
+                     .node(f"tpu-{i}").obj())
+    store.create("Pod", make_pod().name("idle").uid("idle")
+                 .namespace("default").label("app", "idle")
+                 .req({"cpu": idle_cpu}).node("tpu-2").obj())
+    sched.schedule_cycle()
+    return store, sched
+
+
+def test_scale_down_drains_underutilized_node():
+    clock = FakeClock()
+    store, sched = _scaled_cluster(clock)
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert (d.direction, d.result) == ("down", "applied")
+    assert store.get("Node", "", "tpu-2") is None
+    assert store.get("Pod", "default", "idle") is None  # drained via gate
+    assert m.descheduler_evictions.value(("autoscaler", "evicted")) >= 1.0
+    assert m.autoscaler_scale_decisions.value(("down", "applied")) >= 1.0
+
+
+def test_scale_down_refused_when_pdb_blocks():
+    clock = FakeClock()
+    store, sched = _scaled_cluster(clock)
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="prot", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "idle"}),
+        min_available=1)
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is False
+    [d] = ca.last_decisions
+    assert d.result == "blocked" and "pdb" in d.note
+    assert store.get("Node", "", "tpu-2") is not None
+    assert store.get("Pod", "default", "idle") is not None
+    assert m.autoscaler_scale_decisions.value(("down", "blocked")) >= 1.0
+
+
+def test_scale_down_joint_pdb_budget_refuses_before_any_eviction():
+    """Two pods on the candidate node share ONE PDB with budget 1: each
+    alone would pass a per-pod check, but draining the node needs both —
+    the joint pre-check refuses WITHOUT killing either pod."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("NodeGroup", _group(min_size=0, max_size=8, slice_size=0))
+    for i in range(3):
+        _member_node(store, "tpu", i)
+    for i in range(2):  # busy hosts: not scale-down candidates
+        store.create("Pod", make_pod().name(f"busy-{i}").uid(f"busy-{i}")
+                     .namespace("default").req({"cpu": "3"})
+                     .node(f"tpu-{i}").obj())
+    for i in range(2):
+        store.create("Pod", make_pod().name(f"pair-{i}").uid(f"pair-{i}")
+                     .namespace("default").label("app", "pair")
+                     .req({"cpu": "500m"}).node("tpu-2").obj())
+    pdb = v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pair", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "pair"}),
+        min_available=1)  # budget 1 < the 2 the drain needs
+    store.create("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    sched.schedule_cycle()
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is False
+    [d] = ca.last_decisions
+    assert d.result == "blocked" and "afford" in d.note
+    # nothing was evicted — the drain never started
+    assert store.get("Pod", "default", "pair-0") is not None
+    assert store.get("Pod", "default", "pair-1") is not None
+    assert store.get("Node", "", "tpu-2") is not None
+
+
+def test_scale_up_skips_unlabeled_name_squatter():
+    """A node named like a group member but WITHOUT the membership label
+    (operator-created) must not collide with the simulation's template
+    encode — the next index skips past it."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    # name-squatter: tpu-0 exists, unlabeled, and is fully occupied
+    store.create("Node", make_node().name("tpu-0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    store.create("Pod", make_pod().name("squat").uid("squat")
+                 .namespace("default").req({"cpu": "4"}).node("tpu-0").obj())
+    store.create("NodeGroup", _group(max_size=8, slice_size=2))
+    _gang(store, "g", members=2, cpu="3")
+    _starve(store, sched, clock)
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert d.result == "applied"
+    names = {n.metadata.name for n in store.list("Node")[0]}
+    assert "tpu-1" in names and "tpu-2" in names  # skipped past tpu-0
+    sched.run_until_idle(backoff_wait=2.0)
+    assert all(store.get("Pod", "default", f"g-{i}").spec.node_name
+               for i in range(2))
+
+
+def test_scale_down_refused_when_displaced_pods_dont_replace():
+    clock = FakeClock()
+    # the idle host's pod needs 1.5 cpu (util 0.375 < threshold); the
+    # surviving hosts have only 1 cpu free each — the what-if proves no
+    # re-placement, so no scale-down
+    store, sched = _scaled_cluster(clock, idle_cpu="1500m")
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is False
+    [d] = ca.last_decisions
+    assert d.result == "no_replacement"
+    assert store.get("Node", "", "tpu-2") is not None
+    assert store.get("Pod", "default", "idle") is not None
+
+
+def test_scale_down_respects_min_size():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("NodeGroup", _group(min_size=2, max_size=8, slice_size=0))
+    for i in range(2):
+        _member_node(store, "tpu", i)  # both empty (util 0) but size == min
+    sched.schedule_cycle()
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.sync_once() is False
+    assert len(store.list("Node")[0]) == 2
+
+
+def test_scale_down_never_breaks_a_placed_gang():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("NodeGroup", _group(min_size=0, max_size=8, slice_size=0))
+    for i in range(2):
+        _member_node(store, "tpu", i)
+    # a bound gang member with a tiny request (utilization far below the
+    # threshold) — still never a scale-down victim
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name="g", namespace="default"),
+                     min_member=1)
+    store.create("PodGroup", pg)
+    store.create("Pod", make_pod().name("g-0").uid("g-0")
+                 .namespace("default").label(POD_GROUP_LABEL, "g")
+                 .req({"cpu": "100m"}).node("tpu-0").obj())
+    sched.schedule_cycle()
+    ca = ClusterAutoscaler(store, sched, max_scale_downs_per_sync=4)
+    ca.sync_once()
+    assert store.get("Node", "", "tpu-0") is not None
+    assert store.get("Pod", "default", "g-0") is not None
+
+
+# --- chaos: exactly-once ------------------------------------------------------
+
+
+def test_scale_up_applies_exactly_once_under_watch_drop_and_429_storm():
+    """Chaos coverage: under watch drops and a 429/conflict write storm
+    the scale decision applies exactly once — the group converges to
+    EXACTLY the simulated node set (no duplicates, no overshoot) and the
+    gang binds all-or-nothing."""
+    from kubernetes_tpu.chaos.faults import FaultSchedule
+    from kubernetes_tpu.chaos.retry import RetryingStore
+
+    fault = FaultSchedule(
+        13, watch_drop_rate=0.15, write_429_rate=0.35, conflict_rate=0.1,
+        retry_after=0.0, max_faults_per_key=3,
+    )
+    raw = ObjectStore(fault_injector=fault)
+    store = RetryingStore(raw, sleep=lambda _s: None)
+    node_adds = {}
+
+    def on_ev(ev):
+        if ev.kind == "Node" and ev.type == "ADDED":
+            node_adds[ev.obj.metadata.name] = \
+                node_adds.get(ev.obj.metadata.name, 0) + 1
+
+    raw.watch(on_ev)
+    sched = TPUScheduler(store, batch_size=8, pod_initial_backoff=0.01,
+                         pod_max_backoff=0.05, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    store.create("NodeGroup", _group(max_size=8, slice_size=4))
+    _gang(store, "g", members=4, cpu="3", created=time.monotonic())
+    ca = ClusterAutoscaler(store, sched)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        sched.run_until_idle(max_cycles=50, backoff_wait=1.0)
+        ca.sync_once()
+        done = sum(1 for i in range(4)
+                   if raw.get("Pod", "default", f"g-{i}").spec.node_name)
+        if done == 4:
+            break
+        time.sleep(0.02)
+    assert all(raw.get("Pod", "default", f"g-{i}").spec.node_name
+               for i in range(4))
+    # exactly once: the minimal viable slice (4 hosts), each created once
+    group_nodes = [n for n in raw.list("Node")[0]
+                   if n.metadata.labels.get(NODE_GROUP_LABEL) == "tpu"]
+    assert sorted(n.metadata.name for n in group_nodes) == \
+        ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    assert all(c == 1 for name, c in node_adds.items()
+               if name.startswith("tpu-")), node_adds
+    assert sum(fault.injected_counts().values()) > 0  # the storm fired
+
+
+# --- CLI ----------------------------------------------------------------------
+
+
+def test_cli_get_nodegroups_and_status():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("NodeGroup", _group(min_size=1, max_size=8, slice_size=4))
+    for i in range(2):
+        _member_node(store, "tpu", i)
+    store.create("Pod", make_pod().name("loose").uid("loose")
+                 .namespace("default").req({"cpu": "1"}).obj())
+    k = Kubectl(store)
+    out = k.get("nodegroups")
+    assert out.splitlines()[0].split() == \
+        ["NAME", "SIZE", "MIN", "MAX", "TEMPLATE"]
+    row = out.splitlines()[1].split()
+    assert row[:4] == ["tpu", "2", "1", "8"]
+    assert "slice=4" in row[4]
+    status = k.autoscaler_status()
+    assert "HEADROOM" in status and "tpu" in status
+    assert "pending: 1 unbound pods" in status
+
+
+def test_cli_main_autoscaler_status(capsys):
+    from kubernetes_tpu.cli import main
+
+    rc = main(["autoscaler", "status"])
+    assert rc == 0
+    assert "GROUP" in capsys.readouterr().out

@@ -410,21 +410,43 @@ def test_dry_run_mode_scores_but_evicts_nothing():
     assert m.descheduler_plans.value(("defrag", "dry_run")) >= 1.0
 
 
-def test_planner_refuses_affinity_victims():
+def test_planner_masks_affinity_victims():
+    """The historical WhatIfPlanner refused affinity-carrying victims
+    (aff_* tables were not masked in the fork).  The whatif engine masks
+    the victim's term-count contributions, so the prediction is trusted —
+    and equals the scheduler's actual post-eviction bindings bit-for-bit.
+
+    Setup: the victim on n0 carries required anti-affinity against
+    color=g; n1 is nearly full.  With the victim in place the pending
+    color=g pod fits NOWHERE (n0 blocked by the existing-pod anti term,
+    n1 out of cpu); with the victim evicted it lands on n0.  An unmasked
+    fork would mispredict "no fit"."""
     clock = FakeClock()
     store = ObjectStore()
     sched = TPUScheduler(store, batch_size=4, clock=clock, batch_wait=0)
-    store.create("Node", make_node().name("n0")
-                 .capacity({"cpu": "4", "pods": "10"}).obj())
+    for i in range(2):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
     vic = (make_pod().name("vic").uid("vic").namespace("default")
            .req({"cpu": "1"}).label("color", "g")
            .pod_affinity("kubernetes.io/hostname", {"color": "g"}, anti=True)
            .node("n0").obj())
     store.create("Pod", vic)
-    pending = _pod("pend", {}, cpu="1")  # what-if only, never created
+    store.create("Pod", _pod("filler", {}, node="n1", cpu="3"))
+    sched.schedule_cycle()  # sync the pre-bound pods into cache/encoder
+    pend = (make_pod().name("pend").uid("pend").namespace("default")
+            .req({"cpu": "2"}).label("color", "g").obj())
     planner = WhatIfPlanner(sched)
-    # aff_* tables are not masked: the planner must refuse, not mispredict
-    assert planner.predict([pending], [vic]) is None
+    pred = planner.predict([pend], [vic])
+    assert pred is not None and pred.masked_victims == 1
+    assert pred.placements["pend"] == "n0"
+    # now evict for real and schedule: actual binding == prediction
+    gate = EvictionAPI(store)
+    assert gate.evict(vic, policy="test").evicted
+    store.create("Pod", pend)
+    sched.run_until_idle(backoff_wait=1.0)
+    assert store.get("Pod", "default", "pend").spec.node_name == \
+        pred.placements["pend"]
 
 
 def test_planner_does_not_disturb_live_state():

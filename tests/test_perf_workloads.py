@@ -59,6 +59,22 @@ def test_all_reference_sizes_listed():
     assert SUITES["NorthStar"].sizes["5000Nodes/10000Pods"] == (5000, 2000, 10000)
 
 
+def test_autoscale_gang_suite_scales_to_capacity():
+    """AutoscaleGang: gang demand exceeds the initial capacity; the
+    cluster-autoscaler's simulated-then-applied scale-ups add whole
+    slices until every gang binds — the suite reports scale decisions,
+    whatif forks/s, and time-to-capacity."""
+    w = build_workload("AutoscaleGang", "64Nodes", scale=0.5)
+    w.batch_size = 8
+    items = run_workload(w)
+    by_metric = {i.labels["Metric"]: i for i in items}
+    assert by_metric["AutoscalerScaleUps"].data["Count"] >= 1.0
+    assert by_metric["WhatIfForks"].data["Count"] >= 1.0
+    assert by_metric["GangThroughput"].data["Gangs"] >= 1
+    ttfs = by_metric["TimeToFullSlice"].data
+    assert ttfs["Max"] >= ttfs["Perc50"] >= 0.0
+
+
 def test_defrag_suite_frees_slices_and_counts_evictions():
     """Defrag: every slice fragmented by a pre-bound straggler; the
     descheduler must evict straggler sets so the gangs assemble — the

@@ -1,0 +1,261 @@
+"""Unified counterfactual engine: K-fork vmapped==sequential parity
+(victim-mask / node-add / node-remove forks, randomized churn), the
+ported-path contracts (preemption + descheduler route through whatif/),
+and the engine's refusal conditions."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.autoscaler import NodeGroup, materialize_nodes
+from kubernetes_tpu.gang import SLICE_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+from kubernetes_tpu.whatif import ForkSpec, WhatIfEngine
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _pod(name, cpu="2", node="", labels=None):
+    w = (make_pod().name(name).uid(name).namespace("default")
+         .req({"cpu": cpu}))
+    for k, val in (labels or {}).items():
+        w = w.label(k, val)
+    if node:
+        w = w.node(node)
+    return w.obj()
+
+
+def _cluster(n_nodes=6, batch_size=8):
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=batch_size, clock=clock,
+                         batch_wait=0)
+    for i in range(n_nodes):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "10"}).obj())
+    return clock, store, sched
+
+
+def _assert_forks_equal(vm, seq):
+    assert vm is not None and seq is not None
+    assert len(vm) == len(seq)
+    for a, b in zip(vm, seq):
+        assert a.placements == b.placements, (a.fork.note, a.placements,
+                                              b.placements)
+
+
+# --- the parity battery -------------------------------------------------------
+
+
+def test_kfork_vmapped_equals_sequential_under_randomized_churn():
+    """THE engine contract: the vmapped [K, B, N] solve over K stacked
+    forks equals K sequential single-fork solves bit-for-bit — for
+    victim-mask forks (incl. an affinity-carrying victim whose aff_*
+    contributions the fork masks), node-add forks, and node-remove forks,
+    stable across randomized cluster churn."""
+    clock, store, sched = _cluster()
+    rng = np.random.default_rng(7)
+    # an affinity-carrying bound pod: its fork masks aff_* contributions
+    aff = (make_pod().name("affv").uid("affv").namespace("default")
+           .req({"cpu": "1"}).label("color", "g")
+           .pod_affinity("kubernetes.io/hostname", {"color": "g"}, anti=True)
+           .node("n0").obj())
+    store.create("Pod", aff)
+    for i in range(4):
+        store.create("Pod", _pod(f"b{i}", cpu="2", node=f"n{i % 3}"))
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    group = NodeGroup(metadata=v1.ObjectMeta(name="ng"), max_size=8,
+                      capacity={"cpu": "4", "pods": "10"}, slice_size=2)
+    churn_seq = 0
+    for rnd in range(3):
+        pend = [_pod(f"pend-{rnd}-{i}", cpu="3",
+                     labels={"color": "g"} if i == 0 else None)
+                for i in range(3)]
+        bound = [p for p in store.list("Pod")[0] if p.spec.node_name]
+        victims = list(rng.choice(bound, size=min(2, len(bound)),
+                                  replace=False))
+        if aff.uid not in {v.uid for v in victims} and \
+                store.get("Pod", "default", "affv") is not None:
+            victims.append(store.get("Pod", "default", "affv"))
+        live_nodes = [n.metadata.name for n in store.list("Node")[0]]
+        forks = [
+            ForkSpec(victims=victims, note="victims"),
+            ForkSpec(add_nodes=materialize_nodes(
+                group, 2, 10 * rnd, rnd, SLICE_LABEL), note="adds"),
+            ForkSpec(remove_nodes=[str(rng.choice(live_nodes))],
+                     note="removes"),
+            ForkSpec(victims=victims[:1],
+                     remove_nodes=[str(rng.choice(live_nodes))],
+                     add_nodes=materialize_nodes(
+                         group, 1, 100 + 10 * rnd, 100 + rnd, SLICE_LABEL),
+                     note="mixed"),
+        ]
+        before = m.whatif_forks.value(())
+        vm = engine.evaluate(pend, forks, vmapped=True)
+        seq = engine.evaluate(pend, forks, vmapped=False)
+        _assert_forks_equal(vm, seq)
+        assert m.whatif_forks.value(()) >= before + 2 * len(forks)
+        # randomized churn between rounds: bind a pod, delete a pod
+        churn_seq += 1
+        store.create("Pod", _pod(f"churn-{churn_seq}", cpu="1",
+                                 node=f"n{churn_seq % 3}"))
+        doomed = rng.choice([p for p in store.list("Pod")[0]
+                             if p.spec.node_name])
+        store.delete("Pod", "default", doomed.metadata.name)
+        sched.schedule_cycle()
+
+
+def test_victim_fork_matches_post_eviction_bindings():
+    """Ported-path regression (descheduler contract at the engine level):
+    a victim-mask fork's prediction equals the scheduler's actual
+    post-eviction bindings."""
+    clock, store, sched = _cluster(n_nodes=3)
+    for i in range(3):
+        store.create("Pod", _pod(f"v{i}", cpu="3", node=f"n{i}"))
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    victims = [store.get("Pod", "default", f"v{i}") for i in range(3)]
+    pend = [_pod(f"p{i}", cpu="3") for i in range(3)]
+    pred = engine.evaluate_one(pend, ForkSpec(victims=victims))
+    assert pred is not None and pred.unplaced == 0
+    assert pred.masked_victims == 3
+    for i in range(3):
+        store.delete("Pod", "default", f"v{i}")
+    for p in pend:
+        store.create("Pod", p)
+    sched.run_until_idle(backoff_wait=1.0)
+    for p in pend:
+        actual = store.get("Pod", "default", p.metadata.name).spec.node_name
+        assert actual == pred.placements[p.uid], (p.metadata.name, actual)
+
+
+def test_node_add_fork_matches_post_scale_up_bindings():
+    """A node-add fork simulates with the SAME deterministic node names a
+    real scale-up creates — predicted placements name the nodes the pods
+    actually bind to once the nodes exist."""
+    clock, store, sched = _cluster(n_nodes=1)
+    store.create("Pod", _pod("filler", cpu="4", node="n0"))
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    group = NodeGroup(metadata=v1.ObjectMeta(name="ng"), max_size=4,
+                      capacity={"cpu": "4", "pods": "10"}, slice_size=2)
+    adds = materialize_nodes(group, 2, 0, 0, SLICE_LABEL)
+    pend = [_pod(f"p{i}", cpu="3") for i in range(2)]
+    pred = engine.evaluate_one(pend, ForkSpec(add_nodes=adds))
+    assert pred is not None and pred.unplaced == 0
+    assert all(n in {"ng-0", "ng-1"} for n in pred.placements.values())
+    # the simulation touched nothing real
+    assert store.get("Node", "", "ng-0") is None
+    for node in adds:
+        store.create("Node", node)
+    for p in pend:
+        store.create("Pod", p)
+    sched.run_until_idle(backoff_wait=1.0)
+    for p in pend:
+        actual = store.get("Pod", "default", p.metadata.name).spec.node_name
+        assert actual == pred.placements[p.uid], (p.metadata.name, actual)
+
+
+def test_node_remove_fork_masks_host():
+    clock, store, sched = _cluster(n_nodes=2)
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    pend = [_pod(f"p{i}", cpu="3") for i in range(2)]
+    pred = engine.evaluate_one(pend, ForkSpec(remove_nodes=["n1"]))
+    assert pred is not None
+    # only n0 survives the fork; a 4-cpu host seats one 3-cpu pod
+    assert sorted(pred.placements.values(), key=str) == [None, "n0"]
+    # live state untouched: both nodes still seat pods for real
+    pred2 = engine.evaluate_one(pend, ForkSpec())
+    assert pred2.unplaced == 0
+
+
+def test_scale_down_shaped_fork_remove_plus_displace():
+    """The autoscaler's scale-down fork: remove a host AND mask its pods,
+    pending = the displaced pods' clones — viable iff they re-place on the
+    surviving hosts."""
+    clock, store, sched = _cluster(n_nodes=3)
+    store.create("Pod", _pod("d0", cpu="2", node="n2"))
+    store.create("Pod", _pod("big", cpu="3", node="n0"))
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    displaced = store.get("Pod", "default", "d0")
+    clone = _pod("whatif-d0", cpu="2")
+    pred = engine.evaluate_one(clone and [clone], ForkSpec(
+        victims=[displaced], remove_nodes=["n2"]))
+    assert pred is not None and pred.unplaced == 0
+    assert pred.placements["whatif-d0"] in ("n0", "n1")
+
+
+# --- refusal conditions -------------------------------------------------------
+
+
+def test_engine_refuses_inflight_pipeline():
+    clock, store, sched = _cluster(n_nodes=2)
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    sched._inflight_q.append(object())
+    try:
+        assert engine.evaluate([_pod("p0")], [ForkSpec()]) is None
+    finally:
+        sched._inflight_q.clear()
+
+
+def test_engine_refuses_oversize_and_empty():
+    clock, store, sched = _cluster(n_nodes=2, batch_size=2)
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    assert engine.evaluate([], [ForkSpec()]) is None
+    assert engine.evaluate([_pod(f"p{i}") for i in range(3)],
+                           [ForkSpec()]) is None
+    assert engine.evaluate([_pod("p0")], []) is None
+
+
+def test_node_add_refuses_existing_node_name():
+    clock, store, sched = _cluster(n_nodes=2)
+    sched.schedule_cycle()
+    engine = WhatIfEngine(sched)
+    clash = make_node().name("n0").capacity({"cpu": "4"}).obj()
+    with pytest.raises(ValueError):
+        engine.evaluate([_pod("p0")], [ForkSpec(add_nodes=[clash])])
+
+
+# --- ported-path contracts ----------------------------------------------------
+
+
+def test_preemption_dry_run_routes_through_whatif():
+    """No remaining private fork-and-resolve copies: preemption's device
+    fan-out IS the whatif module's (identity, not a parallel copy), and
+    the scheduler's candidate-mask program uses it."""
+    from kubernetes_tpu import preemption, scheduler
+    from kubernetes_tpu.whatif import dryrun
+
+    assert preemption.candidate_mask_device is dryrun.candidate_mask_device
+    assert preemption._sweep_and_rank is dryrun.sweep_and_rank
+    assert preemption.PRIORITY_LEVEL_CAP is dryrun.PRIORITY_LEVEL_CAP
+    assert scheduler.candidate_mask_device is dryrun.candidate_mask_device
+
+
+def test_descheduler_planner_routes_through_whatif():
+    from kubernetes_tpu.descheduler import planner as planner_mod
+    from kubernetes_tpu.descheduler.planner import WhatIfPlanner
+
+    clock, store, sched = _cluster(n_nodes=2)
+    p = WhatIfPlanner(sched)
+    assert isinstance(p.engine, WhatIfEngine)
+    # the pre-unification private fork machinery is gone
+    assert not hasattr(planner_mod, "_fork_snapshot")
+    assert not hasattr(planner_mod, "_MaskedEncoderView")

@@ -23,6 +23,12 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_gang.py tests/test_per
 # planner's predictions or the PDB gate are broken
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_descheduler.py tests/test_disruption.py -q \
   || { echo "FAILED: descheduler test gate" >> suites_run.log; exit 1; }
+# autoscaler gate: the whatif engine parity battery (vmapped K-fork ==
+# sequential, victim/node-add/node-remove forks) + the autoscaler e2e/chaos
+# battery — the AutoscaleGang suite below is meaningless if the engine's
+# predictions or the scale decisions are broken
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_whatif.py tests/test_autoscaler.py -q \
+  || { echo "FAILED: autoscaler test gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -72,6 +78,7 @@ run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
 run GangBasic 5000Nodes
 run Defrag 5000Nodes
+run AutoscaleGang 5000Nodes
 run SchedulingExtender 500Nodes
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
