@@ -1,17 +1,25 @@
-"""Project-native static invariant checker + runtime lock instrumentation.
+"""Project-native static invariant checkers + runtime lock instrumentation.
 
-The reproduction's hack/verify-* analog: AST checks over this codebase's
-real failure modes (trace safety at the jit boundary, recompile hazards,
-lock discipline, exception hygiene, metrics registration), ratcheted
-against a committed baseline so tier-1 fails only on NEW violations, plus
-an opt-in runtime lock-order monitor (lockcheck) the chaos battery runs
-under.
+The reproduction's hack/verify-* analog, two engines deep: per-module AST
+checks over this codebase's real failure modes (trace safety at the jit
+boundary, recompile hazards, lock discipline, exception hygiene, metrics
+registration) and an interprocedural device-boundary dataflow pass
+(call graph + two-level device-taint lattice) behind the host-sync /
+vmap-purity / donation-aliasing / shape-drift / blocking-in-cycle checks.
+The committed baseline is EMPTY — every finding fails tier-1 outright; the
+sanctioned escapes are the FETCH_BOUNDARIES config and justified
+``ktpu-analysis: ignore[check] -- why`` comments (which the engine lints).
+An opt-in runtime lock-order monitor (lockcheck) runs under the chaos,
+descheduler, and autoscaler batteries.
 
 Entry points:
-  tools/analyze.py           CLI (human/JSON reports, --check gate,
+  tools/analyze.py           CLI (human/JSON reports, --check all gate,
+                             --diff REF changed-files gate,
                              --write-baseline)
   analysis.registry          check registry (default_checks)
-  analysis.core              engine (load_project / run_checks)
+  analysis.core              engine 1 (load_project / run_checks /
+                             suppressions)
+  analysis.dataflow          engine 2 (DataflowAnalysis / analysis_for)
   analysis.baseline          ratchet (load / diff / write)
   analysis.lockcheck         runtime lock wrapper (maybe_wrap / activate)
 

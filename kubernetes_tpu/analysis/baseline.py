@@ -33,12 +33,19 @@ def load(path: str) -> Dict[str, int]:
 
 
 def write(findings: List[Finding], path: str) -> None:
+    counts = dict(sorted(baseline_counts(findings).items()))
+    comment = ("Grandfathered static-analysis findings — shrink this "
+               "file (fix sites, rerun tools/analyze.py "
+               "--write-baseline), never grow it.")
+    if not counts:
+        comment = ("EMPTY ratchet: the grandfathered baseline was burned "
+                   "to zero — keep it empty.  Every finding now fails CI "
+                   "outright; fix the site or add a justified "
+                   "`ktpu-analysis: ignore[check] -- why` suppression.")
     data = {
         "version": 1,
-        "comment": ("Grandfathered static-analysis findings — shrink this "
-                    "file (fix sites, rerun tools/analyze.py "
-                    "--write-baseline), never grow it."),
-        "findings": dict(sorted(baseline_counts(findings).items())),
+        "comment": comment,
+        "findings": counts,
     }
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(data, fh, indent=1, sort_keys=False)

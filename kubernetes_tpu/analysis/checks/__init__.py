@@ -1,5 +1,6 @@
 """Check modules register themselves on import (plugins/__init__.py idiom)."""
 
+from . import device_boundary  # noqa: F401
 from . import exception_hygiene  # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import metrics_registration  # noqa: F401

@@ -1,0 +1,682 @@
+"""Device-boundary checks on the interprocedural dataflow engine.
+
+Five checks over analysis/dataflow.py's taint lattice + call graph — the
+machine-checkable form of the bug class PR 4 kept rediscovering by hand
+(mid-window recompiles from lazy table growth, accidental device→host
+syncs on the cycle path, impure traced closures):
+
+  host-sync          a tainted (device) value concretized on host —
+                     ``bool()/int()/float()``, ``np.asarray``, ``.item()``,
+                     iteration, or branching — outside an explicit
+                     ``block_until_ready``/fetch site
+  vmap-purity        functions reachable from vmap/jit/shard_map call
+                     sites that mutate captured state, write globals, do
+                     I/O, or call a known-impure function
+  donation-aliasing  donated jit arguments re-used after the call, and
+                     jitted-program builders rebuilt per call across
+                     module boundaries (PR 2's uncached-builder rule,
+                     interprocedural)
+  shape-drift        device arrays whose shape derives from a Python
+                     ``len()``/container size inside a loop — the lazy-
+                     growth recompile hazard (pow2_round_up-bucketized
+                     shapes are exempt: that IS the mitigation)
+  blocking-in-cycle  any call-graph path from the scheduling cycle to a
+                     synchronous fetch not routed through the packed
+                     decision-fetch
+
+Deliberate device→host crossings are enumerated in FETCH_BOUNDARIES below
+(reviewable config, the analog of trace_safety.TRACED_SEEDS) — NOT
+inline-suppressed: the acceptance contract is that hot-cycle modules are
+clean with zero suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..dataflow import DataflowAnalysis, FunctionNode, analysis_for
+from ..registry import Check, register_check
+from .recompile_hazard import RecompileHazardCheck
+from .trace_safety import _close_over_calls, _jit_roots, _seeded
+
+# --- sanctioned fetch sites --------------------------------------------------
+# (path suffix, qualname, why this function is ALLOWED to cross the device
+# boundary).  A function listed here — and anything nested inside it — is
+# an explicit fetch site: host-sync skips it, and blocking-in-cycle's
+# reachability does not traverse INTO it.  Keep each entry justified; this
+# list is the design's fetch surface, so growth here is a review event the
+# same way a suppression is.
+FETCH_BOUNDARIES: Tuple[Tuple[str, str, str], ...] = (
+    ("scheduler.py", "TPUScheduler._dispatch_batch._bg_fetch",
+     "THE packed decision-fetch: the background thread that owns the "
+     "device→host round so the cycle never blocks on it"),
+    ("scheduler.py", "TPUScheduler._complete",
+     "decision-fetch join: normally consumes the background fetch's host "
+     "copy; the blocking fallback is the documented degraded path"),
+    ("scheduler.py", "TPUScheduler._bind_phase",
+     "runs AFTER decisions are host-side; its failure-diagnosis fetch is "
+     "one sync per FAILING batch by design (not fused into every cycle)"),
+    ("scheduler.py", "TPUScheduler._assign_with_extenders",
+     "round-based extender protocol: each round's packed mask+scores "
+     "fetch IS the callout input — synchronous by contract"),
+    ("scheduler.py", "TPUScheduler._run_post_filter",
+     "preemption post-filter for a failed pod — off the dispatch "
+     "critical path, one fetch per preemption attempt"),
+    ("scheduler.py", "TPUScheduler._try_nominated_fast_bind",
+     "nominated-node fast path re-check: single-pod feasibility fetch "
+     "after a preemption nomination, not in the batched cycle"),
+    ("scheduler.py", "TPUScheduler._diagnose",
+     "per-pod failure diagnosis (unschedulable reporting) — explicitly "
+     "the slow path"),
+    ("whatif/engine.py", "WhatIfEngine.evaluate",
+     "the counterfactual solve's single result fetch; controllers "
+     "consume host-side Predictions"),
+    ("whatif/dryrun.py", "sweep_and_rank",
+     "preemption dry-run fan-out: ranks candidate sets on host from one "
+     "batched device sweep — the fetch is the API"),
+    ("preemption.py", "",
+     "preemption orchestration is host-side triage of fetched "
+     "candidates; its device work goes through whatif/dryrun"),
+)
+
+SYNC_METHODS = {"item", "tolist"}
+IMPURE_HEADS = {"time", "random"}
+IO_CALLS = {"print", "open", "input"}
+IO_HEADS = {"klog", "logging", "warnings"}
+# shape constructors whose first/`shape=` argument is a (re)compile key
+SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+# shape bucketing helpers — routing a len() through one of these is the
+# FIX for shape drift, not an instance of it
+POW2_HELPERS = {"pow2_round_up", "_pow2"}
+
+
+def _boundary_quals(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for suffix, qual, _why in FETCH_BOUNDARIES:
+        if not mod.path.endswith(suffix):
+            continue
+        if qual == "":
+            out.update(mod.functions)
+        else:
+            out.update(q for q in mod.functions
+                       if q == qual or q.startswith(qual + "."))
+    return out
+
+
+def _traced_quals(mod: ModuleInfo) -> Set[str]:
+    """Same-module traced closure (trace_safety's definition): these run
+    under trace, where host-sync is trace-safety's business, not ours."""
+    roots = _jit_roots(mod) | _seeded(mod)
+    return _close_over_calls(mod, roots) if roots else set()
+
+
+def _block_until_ready_names(fn_node: ast.AST) -> Set[str]:
+    """Names explicitly synchronized via jax.block_until_ready within the
+    function: subsequent host reads of them are explicit fetch sites."""
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call) and \
+                dotted_name(node.func).endswith("block_until_ready"):
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+# --- host-sync ---------------------------------------------------------------
+
+
+@register_check
+class HostSyncCheck(Check):
+    name = "host-sync"
+    description = ("device values concretized on host (bool/int/float/"
+                   "np.asarray/.item()/iteration/branch) outside an "
+                   "explicit block_until_ready/fetch site")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        dfa = analysis_for(project)
+        findings: List[Finding] = []
+        for mod in project.modules:
+            boundaries = _boundary_quals(mod)
+            traced = _traced_quals(mod)
+            table = dfa.imports.get(mod.path)
+            np_aliases = table.np_aliases() if table else set()
+            for (path, qual), fn in dfa.functions.items():
+                if path != mod.path:
+                    continue
+                if qual in traced or any(
+                        qual == b or qual.startswith(b + ".")
+                        for b in boundaries):
+                    continue
+                findings.extend(
+                    self._scan(dfa, fn, np_aliases))
+        return findings
+
+    def _scan(self, dfa: DataflowAnalysis, fn: FunctionNode,
+              np_aliases: Set[str]) -> Iterable[Finding]:
+        mod, qual = fn.mod, fn.qual
+        fetched = _block_until_ready_names(fn.node)
+
+        def tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name) and e.id in fetched:
+                return False  # explicitly synchronized upstream
+            return dfa.expr_device(fn, e)
+
+        for node in ast.walk(fn.node):
+            if mod.scope_of(node) != qual:
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                head = name.split(".")[0] if name else ""
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in SYNC_METHODS and \
+                        tainted(node.func.value):
+                    yield mod.finding(
+                        self.name, "sync-method", node,
+                        f".{node.func.attr}() on a device value in "
+                        f"`{qual}` forces a device→host sync outside any "
+                        f"fetch site")
+                elif head in np_aliases and \
+                        name.rsplit(".", 1)[-1] in ("asarray", "array") \
+                        and node.args and tainted(node.args[0]):
+                    yield mod.finding(
+                        self.name, "implicit-transfer", node,
+                        f"{name}(...) on a device value in `{qual}` is a "
+                        f"hidden blocking transfer — fetch at a "
+                        f"sanctioned fetch site or keep the value on "
+                        f"device")
+                elif name in ("bool", "int", "float") and node.args and \
+                        tainted(node.args[0]):
+                    yield mod.finding(
+                        self.name, "concretize", node,
+                        f"{name}(...) on a device value in `{qual}` "
+                        f"blocks on the device — hoist the fetch to an "
+                        f"explicit site")
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if tainted(node.iter):
+                    yield mod.finding(
+                        self.name, "iterate-device", node.iter,
+                        f"iterating a device array in `{qual}` syncs one "
+                        f"element per step — fetch once, then iterate "
+                        f"the host copy")
+            elif isinstance(node, (ast.If, ast.While)):
+                if tainted(node.test):
+                    yield mod.finding(
+                        self.name, "branch-on-device", node.test,
+                        f"branching on a device value in `{qual}` forces "
+                        f"a sync at the branch — fetch explicitly or "
+                        f"fold the predicate into the program")
+            elif isinstance(node, ast.comprehension):
+                if tainted(node.iter):
+                    yield mod.finding(
+                        self.name, "iterate-device", node.iter,
+                        f"comprehension over a device array in `{qual}` "
+                        f"syncs per element — fetch once first")
+
+
+# --- vmap-purity -------------------------------------------------------------
+
+
+def _transform_roots(dfa: DataflowAnalysis) -> Set[Tuple[str, str]]:
+    """(path, qual) of every function passed to vmap/jit/shard_map/pmap —
+    including functools.partial-wrapped and aliased forms — project-wide."""
+    wrap_names = {"jax.jit", "jit", "jax.vmap", "vmap", "shard_map",
+                  "jax.pmap", "pmap"}
+    roots: Set[Tuple[str, str]] = set()
+
+    def unwrap(e: ast.AST) -> Optional[ast.AST]:
+        # functools.partial(f, ...) → f
+        if isinstance(e, ast.Call) and \
+                dotted_name(e.func).rsplit(".", 1)[-1] == "partial" and \
+                e.args:
+            return e.args[0]
+        return e
+
+    # decorator forms: @jax.jit / @partial(jax.jit, ...) / @alias
+    for (path, qual), fn in dfa.functions.items():
+        for dec in getattr(fn.node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            names = {dotted_name(target)}
+            if isinstance(dec, ast.Call):
+                names |= {dotted_name(a) for a in dec.args}
+            if names & wrap_names:
+                roots.add((path, qual))
+    # call forms, ANYWHERE in the module (incl. module-level program
+    # tables): jax.vmap(f) / jit(partial(f, ...)) / partial(jax.jit,
+    # **opts)(f) / jax.jit(alias_of_f)
+    for mod in dfa.project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func_name = dotted_name(node.func)
+            is_wrap = func_name in wrap_names
+            if not is_wrap and isinstance(node.func, ast.Call):
+                # partial(jax.jit, **opts)(f)
+                inner = dotted_name(node.func.func)
+                if inner.rsplit(".", 1)[-1] == "partial" and any(
+                        dotted_name(a) in wrap_names
+                        for a in node.func.args):
+                    is_wrap = True
+            if not is_wrap or not node.args:
+                continue
+            qual = mod.scope_of(node)
+            arg = unwrap(node.args[0])
+            if isinstance(arg, ast.Lambda):
+                roots.add((mod.path, mod.scope_of(arg)))
+            elif arg is not None:
+                fake = ast.Call(func=arg, args=[], keywords=[])
+                for key in dfa.resolve_call(mod, qual, fake):
+                    roots.add(key)
+                if isinstance(arg, ast.Name):
+                    # alias: g = f; jax.jit(g) — resolve through a
+                    # straight rebind in the enclosing function
+                    host = dfa.functions.get((mod.path, qual))
+                    if host is not None:
+                        tgt = _alias_target(mod, host, arg.id)
+                        if tgt is not None:
+                            fake = ast.Call(func=tgt, args=[], keywords=[])
+                            for key in dfa.resolve_call(mod, qual, fake):
+                                roots.add(key)
+    return roots
+
+
+def _alias_target(mod: ModuleInfo, fn: FunctionNode,
+                  name: str) -> Optional[ast.AST]:
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name and \
+                isinstance(node.value, (ast.Name, ast.Attribute)):
+            return node.value
+    return None
+
+
+@register_check
+class VmapPurityCheck(Check):
+    name = "vmap-purity"
+    description = ("captured-state mutation, global writes, I/O, and "
+                   "impure calls in functions reachable from "
+                   "vmap/jit/shard_map call sites (interprocedural)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        dfa = analysis_for(project)
+        roots = _transform_roots(dfa)
+        traced = dfa.reachable_from(roots)
+        findings: List[Finding] = []
+        for key in sorted(traced):
+            fn = dfa.functions.get(key)
+            if fn is not None:
+                findings.extend(self._scan(dfa, fn))
+        return findings
+
+    def _scan(self, dfa: DataflowAnalysis,
+              fn: FunctionNode) -> Iterable[Finding]:
+        mod, qual = fn.mod, fn.qual
+        locals_: Set[str] = set(fn.params)
+        for node in ast.walk(fn.node):
+            if mod.scope_of(node) != qual:
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    # only BARE name targets bind locals — a name reached
+                    # through a subscript/attribute target is the mutated
+                    # container itself, not a new binding
+                    if isinstance(t, ast.Name):
+                        locals_.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for e in t.elts:
+                            if isinstance(e, ast.Starred):
+                                e = e.value
+                            if isinstance(e, ast.Name):
+                                locals_.add(e.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        locals_.add(n.id)
+            elif isinstance(node, ast.comprehension):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        locals_.add(n.id)
+        params = set(fn.params)
+        for node in ast.walk(fn.node):
+            if mod.scope_of(node) != qual:
+                continue
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                yield mod.finding(
+                    self.name, "global-write", node,
+                    f"`{qual}` is traced (reachable from a vmap/jit call "
+                    f"site) but declares "
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    f" state — the write happens once at trace time")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute):
+                        root = base
+                        while isinstance(root, ast.Attribute):
+                            root = root.value
+                        if isinstance(root, ast.Name):
+                            yield mod.finding(
+                                self.name, "captured-mutation", t,
+                                f"`{qual}` is traced but mutates "
+                                f"`{dotted_name(base)}` — object state "
+                                f"written under trace is applied once at "
+                                f"trace time, then silently never again")
+                    elif isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id not in locals_ and \
+                            t.value.id not in params:
+                        yield mod.finding(
+                            self.name, "captured-mutation", t,
+                            f"`{qual}` is traced but writes into captured "
+                            f"container `{t.value.id}` — a trace-time "
+                            f"side effect invisible to later calls")
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                head = name.split(".")[0] if name else ""
+                if name in IO_CALLS or head in IO_HEADS:
+                    yield mod.finding(
+                        self.name, "io", node,
+                        f"{name}(...) in traced `{qual}` runs only at "
+                        f"trace time — I/O under vmap/jit never fires "
+                        f"per call")
+                elif head in IMPURE_HEADS and not name.startswith(
+                        ("jax.random", "random_")):
+                    yield mod.finding(
+                        self.name, "impure-call", node,
+                        f"{name}() in traced `{qual}` executes once at "
+                        f"trace time and bakes that value into the "
+                        f"compiled program")
+
+
+# --- donation-aliasing -------------------------------------------------------
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int))
+    return ()
+
+
+@register_check
+class DonationAliasingCheck(Check):
+    name = "donation-aliasing"
+    description = ("donated jit arguments re-used after the call; jitted "
+                   "program builders invoked uncached across module "
+                   "boundaries")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        dfa = analysis_for(project)
+        findings: List[Finding] = []
+        for mod in project.modules:
+            findings.extend(self._scan_donation(mod))
+        findings.extend(self._scan_cross_module_builders(dfa))
+        return findings
+
+    def _scan_donation(self, mod: ModuleInfo) -> Iterable[Finding]:
+        # local name → donated positions, per enclosing function
+        for qual, fn in mod.functions.items():
+            donated: Dict[str, Tuple[int, ...]] = {}
+            for node in ast.walk(fn):
+                if mod.scope_of(node) != qual:
+                    continue
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        dotted_name(node.value.func) in ("jax.jit", "jit"):
+                    pos = _donate_positions(node.value)
+                    if pos and isinstance(node.targets[0], ast.Name):
+                        donated[node.targets[0].id] = pos
+            if not donated:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in donated):
+                    continue
+                # a read is "after the call" only past the call's LAST
+                # line, and never a node of the call itself — a donated
+                # argument formatted onto its own line must not read as
+                # its own use-after-donate
+                call_nodes = {id(n) for n in ast.walk(node)}
+                call_end = getattr(node, "end_lineno", node.lineno)
+                for pos in donated[node.func.id]:
+                    if pos >= len(node.args) or not isinstance(
+                            node.args[pos], ast.Name):
+                        continue
+                    arg = node.args[pos].id
+                    for later in ast.walk(fn):
+                        if isinstance(later, ast.Name) and \
+                                later.id == arg and \
+                                id(later) not in call_nodes and \
+                                isinstance(later.ctx, ast.Load) and \
+                                later.lineno > call_end:
+                            yield mod.finding(
+                                self.name, "donated-reuse", later,
+                                f"`{arg}` was donated to "
+                                f"`{node.func.id}` (donate_argnums) at "
+                                f"line {node.lineno} — its buffer may "
+                                f"already be aliased; this read is "
+                                f"use-after-donate")
+                            break
+
+    def _scan_cross_module_builders(
+            self, dfa: DataflowAnalysis) -> Iterable[Finding]:
+        """PR 2's uncached-builder rule, across module boundaries: a
+        function in module A that builds-and-returns jit programs, called
+        from module B without an init-time cache.  Builders that memoize
+        INTO self state before returning are their own cache — exempt."""
+        builders: Dict[Tuple[str, str], str] = {}
+        for (path, qual), fn in dfa.functions.items():
+            mod = fn.mod
+            jit_locals: Set[str] = set()
+            escapes = False
+            for node in ast.walk(fn.node):
+                if not (isinstance(node, ast.Call) and
+                        dotted_name(node.func) in ("jax.jit", "jit")):
+                    continue
+                if mod.scope_of(node) != qual:
+                    continue
+                if RecompileHazardCheck._escapes_via_return(
+                        mod, node, fn.node):
+                    escapes = True
+                parent = mod.parents.get(node)
+                # track locals holding the jit result or a container of it
+                while isinstance(parent, (ast.Dict, ast.List, ast.Tuple)):
+                    parent = mod.parents.get(parent)
+                if isinstance(parent, ast.Assign) and \
+                        isinstance(parent.targets[0], ast.Name):
+                    jit_locals.add(parent.targets[0].id)
+            if not escapes:
+                continue
+            self_caching = False
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and mod.scope_of(
+                        node) == qual:
+                    tgt = node.targets[0]
+                    base = tgt
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    if isinstance(base, ast.Attribute) and any(
+                            isinstance(n, ast.Name) and n.id in jit_locals
+                            for n in ast.walk(node.value)):
+                        self_caching = True
+            if not self_caching:
+                builders[(path, qual)] = qual.rsplit(".", 1)[-1]
+        if not builders:
+            return
+        for (cpath, cqual), fn in dfa.functions.items():
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call) or \
+                        fn.mod.scope_of(node) != cqual:
+                    continue
+                for key in dfa.resolve_call(fn.mod, cqual, node):
+                    if key not in builders or key[0] == cpath:
+                        continue  # same-module sites are PR 2's check
+                    if not RecompileHazardCheck._cached_at_init(
+                            fn.mod, node):
+                        yield fn.mod.finding(
+                            self.name, "uncached-builder", node,
+                            f"`{builders[key]}` (defined in {key[0]}) "
+                            f"builds jax.jit programs; this cross-module "
+                            f"call site does not cache the result at "
+                            f"init — every call compiles fresh "
+                            f"executables")
+
+
+# --- shape-drift -------------------------------------------------------------
+
+
+def _contains_len(expr: ast.AST) -> Optional[ast.Call]:
+    """The len()/size-derived subexpression, skipping pow2-bucketized
+    ones (routing through pow2_round_up IS the mitigation)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func).rsplit(".", 1)[-1]
+            if name in POW2_HELPERS:
+                return None  # bucketized: exempt the whole expression
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                dotted_name(node.func) == "len":
+            return node
+    return None
+
+
+@register_check
+class ShapeDriftCheck(Check):
+    name = "shape-drift"
+    description = ("device arrays shaped by a Python len()/container "
+                   "size inside a loop — every growth step recompiles "
+                   "(bucketize via pow2_round_up)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        dfa = analysis_for(project)
+        findings: List[Finding] = []
+        for mod in project.modules:
+            table = dfa.imports.get(mod.path)
+            aliases = (table.jnp_aliases() | table.np_aliases()) \
+                if table else {"jnp"}
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                head, _, tail = name.partition(".")
+                if head not in aliases or tail not in SHAPE_CTORS:
+                    continue
+                if not self._in_loop(mod, node):
+                    continue
+                shape_args: List[ast.AST] = list(node.args[:1])
+                shape_args += [kw.value for kw in node.keywords
+                               if kw.arg == "shape"]
+                for arg in shape_args:
+                    ln = _contains_len(arg)
+                    if ln is not None:
+                        findings.append(mod.finding(
+                            self.name, "loop-grown-shape", node,
+                            f"{name}(...) inside a loop takes its shape "
+                            f"from len(...) — each growth step is a new "
+                            f"compile key (the lazy-table mid-window "
+                            f"recompile); bucketize with pow2_round_up "
+                            f"or hoist the allocation"))
+                        break
+        return findings
+
+    @staticmethod
+    def _in_loop(mod: ModuleInfo, node: ast.AST) -> bool:
+        for a in mod.ancestors(node):
+            if isinstance(a, (ast.For, ast.While)):
+                return True
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+
+# --- blocking-in-cycle -------------------------------------------------------
+
+# roots: the hot scheduling cycle (the DEEP pipeline lives inside it)
+CYCLE_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("scheduler.py", "TPUScheduler.schedule_cycle"),
+    ("scheduler.py", "TPUScheduler.run_until_idle"),
+)
+
+
+@register_check
+class BlockingInCycleCheck(Check):
+    name = "blocking-in-cycle"
+    description = ("synchronous device fetches reachable from the "
+                   "scheduling cycle outside the packed decision-fetch "
+                   "boundaries")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        dfa = analysis_for(project)
+        roots = []
+        for suffix, qual in CYCLE_ROOTS:
+            key = dfa.find_function(suffix, qual)
+            if key is not None:
+                roots.append(key)
+        if not roots:
+            return []
+        # ONE boundary-matching rule for both checks: host-sync's skip set
+        # and this check's traversal stops must never drift apart
+        stop: Set[Tuple[str, str]] = set()
+        for mod in project.modules:
+            stop |= {(mod.path, q) for q in _boundary_quals(mod)}
+        reach = dfa.reachable_from(roots, stop=stop)
+        traced_by_path: Dict[str, Set[str]] = {}
+        findings: List[Finding] = []
+        for key in sorted(reach - stop):
+            fn = dfa.functions.get(key)
+            if fn is None:
+                continue
+            traced = traced_by_path.get(fn.path)
+            if traced is None:  # per MODULE, not per reached function
+                traced = traced_by_path[fn.path] = _traced_quals(fn.mod)
+            if fn.qual in traced:
+                continue  # traced code can't host-block; trace-safety's turf
+            findings.extend(self._scan(dfa, fn))
+        return findings
+
+    def _scan(self, dfa: DataflowAnalysis,
+              fn: FunctionNode) -> Iterable[Finding]:
+        mod, qual = fn.mod, fn.qual
+        table = dfa.imports.get(mod.path)
+        np_aliases = table.np_aliases() if table else set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call) or \
+                    mod.scope_of(node) != qual:
+                continue
+            name = dotted_name(node.func)
+            head = name.split(".")[0] if name else ""
+            blocking = None
+            if name.endswith("block_until_ready"):
+                blocking = "jax.block_until_ready"
+            elif name == "jax.device_get":
+                blocking = "jax.device_get"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in SYNC_METHODS and \
+                    dfa.expr_device(fn, node.func.value):
+                blocking = f".{node.func.attr}()"
+            elif head in np_aliases and \
+                    name.rsplit(".", 1)[-1] in ("asarray", "array") and \
+                    node.args and dfa.expr_device(fn, node.args[0]):
+                blocking = f"{name}(device value)"
+            if blocking:
+                yield mod.finding(
+                    self.name, "sync-fetch", node,
+                    f"`{qual}` is reachable from the scheduling cycle "
+                    f"and performs a synchronous fetch ({blocking}) "
+                    f"outside the packed decision-fetch boundaries — "
+                    f"route it through _bg_fetch/_complete or move it "
+                    f"off the cycle path")

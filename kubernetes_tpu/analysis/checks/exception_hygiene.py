@@ -1,17 +1,23 @@
 """exception-hygiene: broad excepts that swallow errors silently.
 
 An ``except Exception`` (or bare ``except``) whose handler neither
-re-raises, logs (klog/logging/print), nor records a metric hides real
-failures — the class of bug PR 1's chaos harness exists to surface.  The
-fix is one of: narrow the exception type to what the code actually
-tolerates, add a klog line, or let it propagate.  Sites that are genuinely
-best-effort get grandfathered in the baseline (shrink it, never grow it).
+re-raises, logs (klog/logging/print), records a metric, nor hands the
+CAUGHT EXCEPTION to a same-module function that (transitively) logs or
+records one — the interprocedural upgrade that recognizes
+``schedule_cycle``'s ``self._handle_cycle_failure(infos, e)`` while
+still flagging a bare ``self.helper()`` whose helper merely bumps a
+success metric — hides real failures, the class of bug PR 1's chaos
+harness exists to surface.  The fix is one of:
+narrow the exception type to what the code actually tolerates, add a
+klog line/metric, or let it propagate.  Sites that are genuinely
+best-effort carry a ``ktpu-analysis: ignore`` suppression with a
+justification (core.py lints the justification itself).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Dict, Iterable, List, Set
 
 from ..core import Finding, ModuleInfo, Project, dotted_name
 from ..registry import Check, register_check
@@ -38,7 +44,81 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
     return False
 
 
-def _is_silent(handler: ast.ExceptHandler) -> bool:
+def _logs_directly(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if not name:
+                continue
+            if name.startswith(LOGGING_PREFIXES):
+                return True
+            if name.rsplit(".", 1)[-1] in LOGGING_TAILS:
+                return True
+    return False
+
+
+def _surfacing_functions(mod: ModuleInfo) -> Set[str]:
+    """Qualnames that log or record a metric, directly or via same-module
+    calls (transitive closure over bare-name and ``self.X`` edges)."""
+    surfaces: Set[str] = set()
+    calls: Dict[str, Set[str]] = {}
+    by_bare: Dict[str, List[str]] = {}
+    for q in mod.functions:
+        by_bare.setdefault(q.rsplit(".", 1)[-1], []).append(q)
+    for q, fn in mod.functions.items():
+        body_logs = False
+        callees: Set[str] = set()
+        for n in ast.walk(fn):
+            if mod.scope_of(n) != q:
+                continue
+            # note: a bare `raise` elsewhere in a helper does NOT make it
+            # surfacing — only an actual log/metric does; almost any
+            # function can raise on some branch
+            if isinstance(n, ast.Call):
+                name = dotted_name(n.func)
+                if name.startswith(LOGGING_PREFIXES) or (
+                        name and name.rsplit(".", 1)[-1] in LOGGING_TAILS):
+                    body_logs = True
+                callees.update(_callee_quals(mod, q, n, by_bare))
+        if body_logs:
+            surfaces.add(q)
+        calls[q] = callees
+    changed = True
+    while changed:
+        changed = False
+        for q, callees in calls.items():
+            if q not in surfaces and callees & surfaces:
+                surfaces.add(q)
+                changed = True
+    return surfaces
+
+
+def _callee_quals(mod: ModuleInfo, caller_qual: str, call: ast.Call,
+                  by_bare: Dict[str, List[str]]) -> List[str]:
+    """Resolve one call to candidate qualnames.  ``self.X`` binds to the
+    CALLER'S OWN class when that class defines X — another class's
+    same-named (surfacing) method must not exempt this one."""
+    if isinstance(call.func, ast.Name):
+        name = call.func.id
+        if name in mod.functions:  # module-level def: exact
+            return [name]
+        return by_bare.get(name, [])
+    if (isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"):
+        meth = call.func.attr
+        cls = caller_qual.split(".")[0] if "." in caller_qual else ""
+        own = f"{cls}.{meth}"
+        if own in mod.functions:
+            return [own]
+        return by_bare.get(meth, [])
+    return []
+
+
+def _is_silent(mod: ModuleInfo, handler: ast.ExceptHandler,
+               caller_qual: str, surfaces: Set[str],
+               by_bare: Dict[str, List[str]]) -> bool:
+    exc_name = handler.name  # the `as e` binding, if any
     for node in ast.walk(handler):
         if isinstance(node, ast.Raise):
             return False
@@ -50,6 +130,20 @@ def _is_silent(handler: ast.ExceptHandler) -> bool:
                 return False
             if name.rsplit(".", 1)[-1] in LOGGING_TAILS:
                 return False
+            # delegation: calling a same-module function that itself
+            # surfaces (logs/metrics, transitively) counts ONLY when the
+            # caught exception object is actually handed to it — a bare
+            # `self.helper()` whose helper increments a success metric
+            # must not exempt the swallow
+            if exc_name is None or not any(
+                    isinstance(n, ast.Name) and n.id == exc_name
+                    for a in (list(node.args)
+                              + [kw.value for kw in node.keywords])
+                    for n in ast.walk(a)):
+                continue
+            if any(q in surfaces
+                   for q in _callee_quals(mod, caller_qual, node, by_bare)):
+                return False
     return True
 
 
@@ -57,14 +151,21 @@ def _is_silent(handler: ast.ExceptHandler) -> bool:
 class ExceptionHygieneCheck(Check):
     name = "exception-hygiene"
     description = ("`except Exception` handlers that swallow without "
-                   "re-raise, log, or metric")
+                   "re-raise, log, metric, or delegation to a function "
+                   "that does")
 
     def run(self, project: Project) -> Iterable[Finding]:
         findings: List[Finding] = []
         for mod in project.modules:
+            surfaces = _surfacing_functions(mod)
+            by_bare: Dict[str, List[str]] = {}
+            for q in mod.functions:
+                by_bare.setdefault(q.rsplit(".", 1)[-1], []).append(q)
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.ExceptHandler) and \
-                        _is_broad(node) and _is_silent(node):
+                        _is_broad(node) and _is_silent(
+                            mod, node, mod.scope_of(node), surfaces,
+                            by_bare):
                     scope = mod.scope_of(node) or "<module>"
                     findings.append(mod.finding(
                         self.name, "silent-swallow", node,

@@ -201,6 +201,13 @@ class TraceSafetyCheck(Check):
     def _may_be_traced(arg: ast.AST) -> bool:
         if isinstance(arg, ast.Constant):
             return False
+        # ALL_CAPS names are module constants by convention
+        # (MAX_NODE_SCORE et al.) — float()/int() of one is trace-safe
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            bare = dotted_name(arg).rsplit(".", 1)[-1]
+            if bare and bare == bare.upper() and any(
+                    c.isalpha() for c in bare):
+                return False
         # len(...) and *.shape[...] are static under trace
         if isinstance(arg, ast.Call) and dotted_name(arg.func) == "len":
             return False

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -44,6 +45,25 @@ class Finding:
         return f"{self.path}:{self.line}{sym}"
 
 
+# Per-finding suppression: at the end of the flagged line, or standalone on
+# the line directly above it.  The justification after ``--`` is REQUIRED
+# and is itself linted (missing/unknown-check/unused → findings that cannot
+# be suppressed).
+SUPPRESSION_RE = re.compile(
+    r"#\s*ktpu-analysis:\s*ignore\[([^\]]*)\]\s*(?:--\s*(\S.*))?\s*$")
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# ktpu-analysis: ignore[check] -- why`` comment."""
+
+    line: int  # 1-based line the comment sits on
+    target_line: int  # line a finding must sit on to match
+    checks: Tuple[str, ...]
+    justification: str
+    used: bool = False
+
+
 class ModuleInfo:
     """One parsed source file: AST + source lines + scope/parent maps."""
 
@@ -55,6 +75,7 @@ class ModuleInfo:
         self.parents: Dict[ast.AST, ast.AST] = {}
         self.scopes: Dict[ast.AST, str] = {self.tree: ""}
         self._index(self.tree, "")
+        self.suppressions: List[Suppression] = self._parse_suppressions()
         # every FunctionDef/AsyncFunctionDef/Lambda keyed by qualname; nested
         # functions use dotted names ("TPUScheduler._build_jitted.fused_greedy")
         self.functions: Dict[str, ast.AST] = {
@@ -72,6 +93,33 @@ class ModuleInfo:
                 sub = scope
             self.scopes[child] = sub
             self._index(child, sub)
+
+    def _parse_suppressions(self) -> List[Suppression]:
+        """Real COMMENT tokens only (tokenize, not a line regex): the
+        marker's own documentation would otherwise read as a suppression."""
+        import io
+        import tokenize
+
+        out: List[Suppression] = []
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            return out
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESSION_RE.search(tok.string)
+            if not m:
+                continue
+            i = tok.start[0]
+            checks = tuple(c.strip() for c in m.group(1).split(",")
+                           if c.strip())
+            standalone = self.line_text(i).startswith("#")
+            out.append(Suppression(
+                line=i, target_line=i + 1 if standalone else i,
+                checks=checks, justification=(m.group(2) or "").strip()))
+        return out
 
     def scope_of(self, node: ast.AST) -> str:
         return self.scopes.get(node, "")
@@ -171,9 +219,64 @@ def project_from_sources(sources: Dict[str, str]) -> Project:
     return Project(modules=[ModuleInfo(p, s) for p, s in sources.items()])
 
 
+def apply_suppressions(project: Project, findings: List[Finding],
+                       run_names: Iterable[str]) -> List[Finding]:
+    """Drop findings covered by a ``ktpu-analysis: ignore`` comment and
+    emit the suppression lint: a justification is REQUIRED, check names
+    must be real, and a suppression that matches nothing (for a check
+    that actually ran) is stale.  Lint findings carry check name
+    ``suppression`` and are never themselves suppressible — the escape
+    hatch must not be able to hide its own misuse."""
+    from .registry import CHECK_REGISTRY, default_checks
+
+    default_checks()  # ensure the registry is populated
+    known = set(CHECK_REGISTRY) | {"suppression"}
+    ran = set(run_names)
+    kept: List[Finding] = []
+    by_mod: Dict[str, ModuleInfo] = project.by_path()
+    for f in findings:
+        mod = by_mod.get(f.path)
+        sup = None
+        if mod is not None:
+            for s in mod.suppressions:
+                if s.target_line == f.line and f.check in s.checks:
+                    sup = s
+                    break
+        if sup is None:
+            kept.append(f)
+        else:
+            sup.used = True
+    for mod in project.modules:
+        for s in mod.suppressions:
+            loc = ast.Module(body=[], type_ignores=[])  # line carrier
+            loc.lineno = s.line
+            if not s.justification:
+                kept.append(mod.finding(
+                    "suppression", "missing-justification", loc,
+                    f"suppression of [{', '.join(s.checks)}] carries no "
+                    f"`-- justification`; every ignore must say why"))
+            for c in s.checks:
+                if c not in known:
+                    kept.append(mod.finding(
+                        "suppression", "unknown-check", loc,
+                        f"suppression names unknown check `{c}` "
+                        f"(registered: {sorted(known)})"))
+            if (s.justification and not s.used
+                    and s.checks and set(s.checks) <= ran
+                    and all(c in known for c in s.checks)):
+                kept.append(mod.finding(
+                    "suppression", "unused", loc,
+                    f"suppression of [{', '.join(s.checks)}] matched no "
+                    f"finding — the violation was fixed; delete the "
+                    f"comment so it cannot mask a future one"))
+    return kept
+
+
 def run_checks(project: Project, checks) -> List[Finding]:
     findings: List[Finding] = []
     for check in checks:
         findings.extend(check.run(project))
+    findings = apply_suppressions(project, findings,
+                                  [c.name for c in checks])
     findings.sort(key=lambda f: (f.path, f.line, f.check, f.rule))
     return findings

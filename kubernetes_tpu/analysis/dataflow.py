@@ -1,0 +1,613 @@
+"""Interprocedural device-boundary dataflow: call graph + taint lattice.
+
+The second analysis engine (the first, core.py, is per-module AST
+invariants).  This one answers the cross-module questions PR 2's checker
+could not: "did this value silently leave the device?" and "is this
+closure safe under vmap/jit/shard_map?".  The pipeline:
+
+  1. module graph    — repo-relative paths resolved to dotted module
+                       names; per-module import tables (``from ..x
+                       import y as z`` → alias → (module, symbol)).
+  2. call graph      — every FunctionDef is a node keyed
+                       (path, qualname); call sites resolve through
+                       local defs, self-methods, imported symbols,
+                       imported-module attributes, and (for methods
+                       whose bare name is UNIQUE project-wide) duck-
+                       typed ``obj.meth()`` receivers.
+  3. taint fixpoint  — device-array taint seeded from known producers
+                       (``jax.numpy`` results, jitted-callable returns,
+                       ``DeviceSnapshot``/``PendingScatter`` values,
+                       ``.to_device()``) and propagated through
+                       assignments, calls (args → params, returns →
+                       call sites), attribute loads, container packing
+                       (tuple/list/dict), and dataclass/self fields —
+                       iterated project-wide until stable, so summaries
+                       converge even across call-graph cycles.
+
+The lattice has TWO tainted levels, which is what keeps the checks
+quiet on idiomatic host code:
+
+  DEVICE  the value IS a device array — branching on it, iterating it,
+          or np.asarray-ing it blocks on the device;
+  LOOSE   a host object/container HOLDING device values (an _InFlight
+          record, a list of PrevBatch carries, a jit-program table) —
+          iterating or branching on it is free, but its attribute loads
+          and the results of CALLING it (jitted callables) are DEVICE.
+
+Checks built on top live in checks/device_boundary.py.  The analysis is
+deliberately may-taint (over-approximate) at each level, and the
+sanctioned fetch-site list plus suppression comments (core.py) handle
+the deliberate crossings.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import ModuleInfo, Project, dotted_name
+
+# taint levels
+NONE, LOOSE, DEVICE = 0, 1, 2
+
+# ---------------------------------------------------------------------------
+# seeds: names / types whose values live on device
+# ---------------------------------------------------------------------------
+
+# class names whose instances hold device arrays in their fields: the
+# instances themselves are LOOSE, their attribute loads DEVICE
+DEVICE_CLASSES = {"DeviceSnapshot", "PendingScatter", "DynamicState",
+                  "ForkPayload", "PrevBatch"}
+# parameter / variable names conventionally bound to DEVICE values across
+# the codebase (the DeviceSnapshot threading idiom) — a name-based seed is
+# how the analysis crosses untyped boundaries
+DEVICE_VALUE_NAMES = {"dsnap", "fsnap", "dsnap_out", "dyn", "dyn_out",
+                      "diag_dev", "node_row_dev", "cand_dev", "packed0"}
+# methods whose RESULT holds device values regardless of receiver
+DEVICE_PRODUCER_METHODS = {"to_device", "to_device_deferred"}
+# calls that move a device value to host (the result is NOT tainted —
+# they are the sync operations themselves, judged by the checks)
+HOST_TRANSFER_CALLS = {"np.asarray", "np.array", "jax.device_get",
+                       "float", "int", "bool", "len"}
+# static array metadata: reading these never blocks on the device, so a
+# branch on `arr.shape[0]` or `int(arr.ndim)` is host work
+ARRAY_METADATA_ATTRS = {"shape", "ndim", "dtype", "size"}
+# jax.* entry points whose result stays on device
+JAX_DEVICE_RESULTS = {"jax.device_put", "jax.block_until_ready"}
+# wrapping these returns a callable whose RESULTS are device arrays; the
+# callable value itself is LOOSE so that calling through a variable or a
+# program-table subscript yields DEVICE
+JIT_WRAPPERS = {"jax.jit", "jit", "jax.vmap", "vmap", "shard_map",
+                "jax.pmap", "pmap"}
+
+# receiver method names too generic to duck-type across classes
+_COMMON_METHODS = {"get", "put", "pop", "append", "extend", "update", "add",
+                   "items", "keys", "values", "copy", "clear", "sort",
+                   "join", "split", "strip", "read", "write", "close",
+                   "setdefault", "remove", "insert", "index", "count",
+                   "inc", "observe", "set", "info", "error", "warning",
+                   "debug", "info_s", "error_s", "release", "acquire",
+                   "start", "run", "stop", "name", "format", "encode",
+                   "decode", "list", "create", "delete", "obj"}
+
+
+def module_name_of(path: str) -> str:
+    """'kubernetes_tpu/whatif/engine.py' → 'kubernetes_tpu.whatif.engine'."""
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+@dataclass
+class ImportTable:
+    """One module's imported names."""
+
+    # local alias → dotted module ("jnp" → "jax.numpy")
+    modules: Dict[str, str] = field(default_factory=dict)
+    # local alias → (dotted module, symbol) ("apply_fork" →
+    # ("kubernetes_tpu.whatif.fork", "apply_fork"))
+    symbols: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+    def jnp_aliases(self) -> Set[str]:
+        return {a for a, m in self.modules.items() if m == "jax.numpy"} | {
+            a for a, (m, s) in self.symbols.items()
+            if m == "jax" and s == "numpy"}
+
+    def np_aliases(self) -> Set[str]:
+        return {a for a, m in self.modules.items() if m == "numpy"}
+
+
+def build_import_table(mod: ModuleInfo, pkg: str) -> ImportTable:
+    """Resolve imports, including package-relative ones, against ``pkg``
+    (the module's own dotted name)."""
+    table = ImportTable()
+    parts = pkg.split(".")
+    # In a package __init__, ``pkg`` IS the containing package (the
+    # '/__init__' segment was stripped), so level-1 imports resolve
+    # against pkg itself, not its parent — getting this wrong drops every
+    # re-export edge package modules contribute to the call graph
+    is_pkg = mod.path.endswith("/__init__.py")
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    table.modules[a.asname] = a.name
+                else:
+                    root = a.name.split(".")[0]
+                    table.modules.setdefault(root, root)
+                    table.modules[a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                strip = node.level - 1 if is_pkg else node.level
+                base = parts[: len(parts) - strip] if strip else parts
+                src = ".".join(base + ([node.module] if node.module else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                table.symbols[a.asname or a.name] = (src, a.name)
+    return table
+
+
+@dataclass
+class FunctionNode:
+    """One function in the project-wide graph."""
+
+    path: str
+    qual: str
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    mod: ModuleInfo
+    params: List[str] = field(default_factory=list)
+    # taint state (mutated by the fixpoint): name → level
+    taint: Dict[str, int] = field(default_factory=dict)
+    param_taint: Dict[str, int] = field(default_factory=dict)
+    returns: int = NONE
+    callees: Set[Tuple[str, str]] = field(default_factory=set)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qual)
+
+
+def _raise_to(levels: Dict[str, int], name: str, lvl: int) -> bool:
+    if lvl > levels.get(name, NONE):
+        levels[name] = lvl
+        return True
+    return False
+
+
+class DataflowAnalysis:
+    """The shared project-wide model every device-boundary check reads.
+
+    Build once per run (checks/device_boundary.py caches one instance per
+    Project identity) — the fixpoint over ~160 modules runs in well under
+    a second, but five checks re-deriving it would still quintuple the
+    gate's cost.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.mod_by_name: Dict[str, ModuleInfo] = {}
+        self.imports: Dict[str, ImportTable] = {}  # path → table
+        self.functions: Dict[Tuple[str, str], FunctionNode] = {}
+        # bare method name → every (path, qual) defining it on a class
+        self._methods_by_bare: Dict[str, List[Tuple[str, str]]] = {}
+        # (path, ClassName) → field name → level
+        self.class_fields: Dict[Tuple[str, str], Dict[str, int]] = {}
+        self._index()
+        self._solve()
+
+    # --- indexing -------------------------------------------------------------
+
+    def _index(self) -> None:
+        for mod in self.project.modules:
+            name = module_name_of(mod.path)
+            self.mod_by_name[name] = mod
+            self.imports[mod.path] = build_import_table(mod, name)
+            for qual, fn in mod.functions.items():
+                node = FunctionNode(
+                    path=mod.path, qual=qual, node=fn, mod=mod,
+                    params=[a.arg for a in fn.args.posonlyargs
+                            + fn.args.args + fn.args.kwonlyargs])
+                self.functions[node.key] = node
+                bare = qual.rsplit(".", 1)[-1]
+                if "." in qual:  # a method (or nested def)
+                    self._methods_by_bare.setdefault(bare, []).append(
+                        node.key)
+
+    # --- call resolution ------------------------------------------------------
+
+    def resolve_call(self, mod: ModuleInfo, caller_qual: str,
+                     call: ast.Call) -> List[Tuple[str, str]]:
+        """Possible (path, qual) targets of one call expression."""
+        func = call.func
+        out: List[Tuple[str, str]] = []
+        table = self.imports.get(mod.path)
+        if isinstance(func, ast.Name):
+            name = func.id
+            # local def: prefer the caller's own nesting chain outward
+            scope = caller_qual
+            while scope:
+                nested = f"{scope}.{name}"
+                if nested in mod.functions:
+                    return [(mod.path, nested)]
+                scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+            if name in mod.functions:
+                return [(mod.path, name)]
+            # imported symbol
+            if table and name in table.symbols:
+                src, sym = table.symbols[name]
+                tgt = self._function_in(src, sym)
+                if tgt:
+                    return [tgt]
+            return out
+        if isinstance(func, ast.Attribute):
+            recv, meth = func.value, func.attr
+            if isinstance(recv, ast.Name):
+                if recv.id == "self":
+                    # method on the caller's class (same module)
+                    cls = caller_qual.split(".")[0] if "." in caller_qual \
+                        else ""
+                    cand = f"{cls}.{meth}"
+                    if cand in mod.functions:
+                        return [(mod.path, cand)]
+                    for q in mod.functions:
+                        if q.rsplit(".", 1)[-1] == meth and "." in q:
+                            out.append((mod.path, q))
+                    return out
+                if table and recv.id in table.modules:
+                    tgt = self._function_in(table.modules[recv.id], meth)
+                    return [tgt] if tgt else []
+                if table and recv.id in table.symbols:
+                    # symbol import of a module: from .. import whatif
+                    src, sym = table.symbols[recv.id]
+                    tgt = self._function_in(f"{src}.{sym}", meth)
+                    if tgt:
+                        return [tgt]
+            # duck-typed receiver: resolve only when the method name is
+            # defined exactly once project-wide and is not a common verb
+            if meth not in _COMMON_METHODS:
+                defs = self._methods_by_bare.get(meth, [])
+                if len(defs) == 1:
+                    return list(defs)
+        return out
+
+    def _function_in(self, module: str, sym: str) -> Optional[Tuple[str, str]]:
+        mod = self.mod_by_name.get(module)
+        if mod is None:
+            return None
+        if sym in mod.functions:
+            return (mod.path, sym)
+        return None
+
+    # --- the taint fixpoint ---------------------------------------------------
+
+    def _solve(self) -> None:
+        for _ in range(20):  # converges in 3-5 passes on this tree
+            changed = False
+            for fn in self.functions.values():
+                changed |= self._analyze_function(fn)
+            if not changed:
+                break
+
+    def _seed_taint(self, fn: FunctionNode) -> Dict[str, int]:
+        taint: Dict[str, int] = dict(fn.param_taint)
+        for p in fn.params:
+            if p in DEVICE_VALUE_NAMES:
+                taint[p] = DEVICE
+        # annotated params: ``def f(snap: DeviceSnapshot)`` → LOOSE object
+        # (its attribute loads become DEVICE)
+        for a in fn.node.args.args + fn.node.args.kwonlyargs:
+            ann = a.annotation
+            if ann is not None and \
+                    dotted_name(ann).rsplit(".", 1)[-1] in DEVICE_CLASSES:
+                _raise_to(taint, a.arg, LOOSE)
+        return taint
+
+    def _analyze_function(self, fn: FunctionNode) -> bool:
+        """One intra-procedural pass under current summaries; returns True
+        when any project-visible fact (param/return/class-field taint,
+        local levels) changed."""
+        taint = self._seed_taint(fn)
+        cls_key = self._class_key(fn)
+        changed = False
+        # iterate the body to a local fixpoint (loops can taint backwards)
+        for _ in range(8):
+            grew = False
+            for stmt in ast.walk(fn.node):
+                if fn.mod.scope_of(stmt) != fn.qual:
+                    continue
+                grew |= self._transfer(fn, stmt, taint, cls_key)
+            if not grew:
+                break
+        for name, lvl in taint.items():
+            changed |= _raise_to(fn.taint, name, lvl)
+        # return taint
+        for stmt in ast.walk(fn.node):
+            if isinstance(stmt, ast.Return) and stmt.value is not None \
+                    and fn.mod.scope_of(stmt) == fn.qual:
+                lvl = self.level_of(fn, stmt.value, taint)
+                if lvl > fn.returns:
+                    fn.returns = lvl
+                    changed = True
+        # call-site propagation: tainted args taint callee params
+        for call in ast.walk(fn.node):
+            if not isinstance(call, ast.Call) or \
+                    fn.mod.scope_of(call) != fn.qual:
+                continue
+            targets = self.resolve_call(fn.mod, fn.qual, call)
+            for key in targets:
+                callee = self.functions.get(key)
+                if callee is None:
+                    continue
+                fn.callees.add(key)
+                params = callee.params
+                skip = 1 if params[:1] == ["self"] else 0
+                for i, arg in enumerate(call.args):
+                    pi = i + skip
+                    if pi >= len(params):
+                        break
+                    lvl = self.level_of(fn, arg, taint)
+                    if lvl:
+                        changed |= _raise_to(
+                            callee.param_taint, params[pi], lvl)
+                for kw in call.keywords:
+                    if kw.arg and kw.arg in params:
+                        lvl = self.level_of(fn, kw.value, taint)
+                        if lvl:
+                            changed |= _raise_to(
+                                callee.param_taint, kw.arg, lvl)
+        return changed
+
+    def _class_key(self, fn: FunctionNode) -> Optional[Tuple[str, str]]:
+        if "." not in fn.qual:
+            return None
+        return (fn.path, fn.qual.split(".")[0])
+
+    def _transfer(self, fn: FunctionNode, stmt: ast.AST,
+                  taint: Dict[str, int], cls_key) -> bool:
+        """Apply one statement's taint transfer; True if levels grew."""
+        grew = False
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is None:
+                return False
+            lvl = self.level_of(fn, value, taint)
+            if not lvl:
+                return False
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    grew |= _raise_to(taint, tgt.id, lvl)
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    # tuple-unpack of a tainted producer: each target gets
+                    # LOOSE (which element is the array is not tracked)
+                    for e in tgt.elts:
+                        if isinstance(e, ast.Starred):
+                            e = e.value
+                        if isinstance(e, ast.Name):
+                            grew |= _raise_to(taint, e.id, LOOSE)
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and cls_key is not None:
+                    # self-field taint: device state stored on the object
+                    # carries across method boundaries
+                    fields = self.class_fields.setdefault(cls_key, {})
+                    grew |= _raise_to(fields, tgt.attr, lvl)
+                elif isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Attribute) and \
+                        isinstance(tgt.value.value, ast.Name) and \
+                        tgt.value.value.id == "self" and cls_key is not None:
+                    # self._table[key] = <tainted> → the table is a LOOSE
+                    # container of it
+                    fields = self.class_fields.setdefault(cls_key, {})
+                    grew |= _raise_to(fields, tgt.value.attr, LOOSE)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            lvl = self.level_of(fn, stmt.iter, taint)
+            if lvl:
+                # iterating a DEVICE array yields DEVICE rows; iterating a
+                # LOOSE container yields its (loose) members
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        grew |= _raise_to(taint, n.id, lvl)
+        elif isinstance(stmt, ast.comprehension):
+            lvl = self.level_of(fn, stmt.iter, taint)
+            if lvl:
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        grew |= _raise_to(taint, n.id, lvl)
+        elif isinstance(stmt, ast.withitem) and stmt.optional_vars is not None:
+            lvl = self.level_of(fn, stmt.context_expr, taint)
+            if lvl and isinstance(stmt.optional_vars, ast.Name):
+                grew |= _raise_to(taint, stmt.optional_vars.id, lvl)
+        return grew
+
+    # --- expression taint -----------------------------------------------------
+
+    def level_of(self, fn: FunctionNode, expr: ast.AST,
+                 taint: Optional[Dict[str, int]] = None) -> int:
+        """NONE / LOOSE / DEVICE for one expression."""
+        t = fn.taint if taint is None else taint
+
+        def walk(e: ast.AST) -> int:
+            if isinstance(e, ast.Name):
+                if e.id in DEVICE_VALUE_NAMES:
+                    return DEVICE
+                return t.get(e.id, NONE)
+            if isinstance(e, ast.Attribute):
+                if e.attr in ARRAY_METADATA_ATTRS:
+                    return NONE
+                if e.attr in DEVICE_VALUE_NAMES:
+                    return DEVICE
+                if isinstance(e.value, ast.Name) and e.value.id == "self":
+                    cls_key = self._class_key(fn)
+                    if cls_key:
+                        return self.class_fields.get(cls_key, {}).get(
+                            e.attr, NONE)
+                    return NONE
+                base = walk(e.value)
+                # a field of a device-holding object is (may be) an array
+                return DEVICE if base else NONE
+            if isinstance(e, ast.Subscript):
+                base = walk(e.value)
+                # a row of a DEVICE array is DEVICE; an element of a LOOSE
+                # container stays LOOSE (which member is hot is untracked)
+                return base
+            if isinstance(e, ast.Call):
+                return self.call_level(fn, e, t)
+            if isinstance(e, ast.BinOp):
+                return max(walk(e.left), walk(e.right))
+            if isinstance(e, ast.UnaryOp):
+                return walk(e.operand)
+            if isinstance(e, ast.Compare):
+                # identity checks never touch the device
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                    return NONE
+                lvl = max([walk(e.left)] + [walk(c) for c in e.comparators])
+                # an elementwise compare OF a device array is a device
+                # array; comparing LOOSE host objects is host work
+                return DEVICE if lvl == DEVICE else NONE
+            if isinstance(e, ast.BoolOp):
+                return max(walk(v) for v in e.values)
+            if isinstance(e, ast.IfExp):
+                return max(walk(e.body), walk(e.orelse))
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                lvl = max([walk(v) for v in e.elts], default=NONE)
+                return LOOSE if lvl else NONE
+            if isinstance(e, ast.Dict):
+                lvl = max([walk(v) for v in e.values if v is not None],
+                          default=NONE)
+                return LOOSE if lvl else NONE
+            if isinstance(e, ast.Starred):
+                return walk(e.value)
+            if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+                lvl = walk(e.elt)
+                return LOOSE if lvl else NONE
+            if isinstance(e, ast.NamedExpr):
+                return walk(e.value)
+            return NONE
+
+        return walk(expr)
+
+    def call_level(self, fn: FunctionNode, call: ast.Call,
+                   taint: Optional[Dict[str, int]] = None) -> int:
+        """Taint level of this call's RESULT."""
+        t = fn.taint if taint is None else taint
+        table = self.imports.get(fn.mod.path)
+        name = dotted_name(call.func)
+        head = name.split(".")[0] if name else ""
+        # jnp.* results are device arrays; np.* (and int()/float()/
+        # device_get) move to host
+        if table is not None:
+            if head in table.jnp_aliases():
+                return DEVICE
+            if head in table.np_aliases():
+                return NONE
+        elif head == "jnp":
+            return DEVICE
+        if name in HOST_TRANSFER_CALLS:
+            return NONE
+        if name in JAX_DEVICE_RESULTS:
+            return DEVICE if (call.args and self.level_of(
+                fn, call.args[0], t)) else NONE
+        if name in JIT_WRAPPERS:
+            # the jitted callable itself: LOOSE, so calling through a
+            # variable / program-table subscript yields DEVICE below
+            return LOOSE
+        if name.startswith("jax.tree_util") or name.startswith("jax.tree"):
+            # tree_map/tree_leaves over tainted pytrees keep their level
+            lvl = max([self.level_of(fn, a, t) for a in call.args],
+                      default=NONE)
+            return lvl
+        if isinstance(call.func, ast.Attribute):
+            meth = call.func.attr
+            if meth in DEVICE_PRODUCER_METHODS:
+                return LOOSE  # DeviceSnapshot / (dsnap, upd) object
+            if meth in ("item", "tolist"):
+                return NONE
+            if meth == "_replace":
+                return self.level_of(fn, call.func.value, t)
+        if isinstance(call.func, ast.Name):
+            if call.func.id in DEVICE_CLASSES:
+                return LOOSE
+            # calling a local bound to a jitted program:
+            #   prog = jax.jit(f); ... ; out = prog(x)
+            if t.get(call.func.id, NONE):
+                return DEVICE
+        # calling through a jit-table subscript or tainted attribute:
+        # jt["fused"](...) / self._progs[key](...)
+        if isinstance(call.func, (ast.Subscript, ast.Attribute)) and \
+                self.level_of(fn, call.func, t):
+            return DEVICE
+        # interprocedural: any resolved callee's return summary
+        lvl = NONE
+        for key in self.resolve_call(fn.mod, fn.qual, call):
+            callee = self.functions.get(key)
+            if callee is not None:
+                lvl = max(lvl, callee.returns)
+        return lvl
+
+    # convenience predicates used by the checks ------------------------------
+
+    def expr_tainted(self, fn: FunctionNode, expr: ast.AST) -> bool:
+        return self.level_of(fn, expr) >= LOOSE
+
+    def expr_device(self, fn: FunctionNode, expr: ast.AST) -> bool:
+        return self.level_of(fn, expr) == DEVICE
+
+    # --- reachability (for cycle-path checks) ---------------------------------
+
+    def reachable_from(self, roots: Iterable[Tuple[str, str]],
+                       stop: Iterable[Tuple[str, str]] = ()) -> \
+            Set[Tuple[str, str]]:
+        """Transitive callees of ``roots``; traversal does not descend
+        INTO ``stop`` nodes (sanctioned fetch boundaries), though the
+        boundary nodes themselves are listed as reached."""
+        stop_set = set(stop)
+        seen: Set[Tuple[str, str]] = set()
+        work = [k for k in roots if k in self.functions]
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            if key in stop_set:
+                continue
+            fn = self.functions[key]
+            # callees recorded during the fixpoint cover resolved calls;
+            # nested defs are implicit callees (the enclosing function
+            # builds and usually invokes or schedules them)
+            for k2 in fn.callees:
+                if k2 not in seen:
+                    work.append(k2)
+            for q2 in fn.mod.functions:
+                if q2.startswith(fn.qual + ".") and \
+                        (fn.path, q2) not in seen:
+                    work.append((fn.path, q2))
+        return seen
+
+    def find_function(self, path_suffix: str,
+                      qual: str) -> Optional[Tuple[str, str]]:
+        for (path, q) in self.functions:
+            if q == qual and path.endswith(path_suffix):
+                return (path, q)
+        return None
+
+
+_CACHE: Dict[int, DataflowAnalysis] = {}
+
+
+def analysis_for(project: Project) -> DataflowAnalysis:
+    """One shared DataflowAnalysis per Project instance (checks run back
+    to back over the same project; the fixpoint is the expensive part)."""
+    key = id(project)
+    hit = _CACHE.get(key)
+    if hit is not None and hit.project is project:
+        return hit
+    _CACHE.clear()  # never hold more than one project alive
+    _CACHE[key] = DataflowAnalysis(project)
+    return _CACHE[key]

@@ -69,7 +69,8 @@ class ClusterAutoscaler:
                  scale_down_utilization_threshold: float = 0.5,
                  max_simulated_sizes: int = 6,
                  min_interval: float = 0.0,
-                 slice_label: Optional[str] = None):
+                 slice_label: Optional[str] = None,
+                 expander: str = "least-cost"):
         self.store = store
         self.scheduler = scheduler
         self.clock = clock or getattr(scheduler, "clock", time.monotonic)
@@ -88,6 +89,15 @@ class ClusterAutoscaler:
         self.max_simulated_sizes = max_simulated_sizes
         self.min_interval = min_interval
         self.slice_label = slice_label or SLICE_LABEL
+        # expander strategy (upstream expander/ analog): how to pick among
+        # groups whose simulated scale-up places the whole demand —
+        #   least-cost   cheapest (count × costPerNode), the original rule
+        #   least-waste  minimize the unused fraction of the ADDED template
+        #                capacity (upstream expander/waste), tie-break cost
+        if expander not in ("least-cost", "least-waste"):
+            raise ValueError(f"unknown expander {expander!r}; "
+                             f"expected 'least-cost' or 'least-waste'")
+        self.expander = expander
         self._last_active = float("-inf")
         self.last_decisions: List[ScaleDecision] = []
 
@@ -200,39 +210,62 @@ class ClusterAutoscaler:
 
     # --- scale-up -------------------------------------------------------------
 
+    @staticmethod
+    def _demand_totals(pending: List[v1.Pod]) -> Dict[str, float]:
+        """Total pending demand per resource dim (cpu in milli; extended/
+        device resources included — the dominant dimension on a TPU
+        cluster is chips-per-pod over chips-per-host)."""
+        need: Dict[str, float] = {"cpu": 0.0, "memory": 0.0,
+                                  "pods": float(len(pending))}
+        for p in pending:
+            r = compute_pod_resource_request(p)
+            need["cpu"] += r.milli_cpu
+            need["memory"] += r.memory
+            for res, amt in r.scalar_resources.items():
+                need[res] = need.get(res, 0.0) + float(amt)
+        return need
+
+    @staticmethod
+    def _template_caps(group: NodeGroup) -> Dict[str, float]:
+        """One template node's capacity per dim (cpu in milli), zero/
+        absent dims dropped."""
+        caps: Dict[str, float] = {}
+        for res, q in group.capacity.items():
+            v = float(parse_quantity(q))
+            if res == "cpu":
+                v *= 1000.0
+            if v > 0:
+                caps[res] = v
+        return caps
+
     def _estimate_nodes(self, group: NodeGroup,
                         pending: List[v1.Pod]) -> int:
         """Binpacking lower bound (estimator/ analog): per resource dim,
         total pending demand over one template node's capacity."""
-        need_cpu = need_mem = 0
-        for p in pending:
-            r = compute_pod_resource_request(p)
-            need_cpu += r.milli_cpu
-            need_mem += r.memory
-        scalar_need: Dict[str, float] = {}
-        for p in pending:
-            for res, amt in \
-                    compute_pod_resource_request(p).scalar_resources.items():
-                scalar_need[res] = scalar_need.get(res, 0.0) + float(amt)
+        need = self._demand_totals(pending)
+        caps = self._template_caps(group)
         est = 1
-        cap_cpu = float(parse_quantity(group.capacity.get("cpu", 0))) * 1000.0
-        if cap_cpu > 0:
-            est = max(est, -(-need_cpu // int(cap_cpu)))
-        cap_mem = float(parse_quantity(group.capacity.get("memory", 0)))
-        if cap_mem > 0:
-            est = max(est, -(-need_mem // int(cap_mem)))
-        cap_pods = int(parse_quantity(group.capacity.get("pods", 0)) or 0)
-        if cap_pods > 0:
-            est = max(est, -(-len(pending) // cap_pods))
-        # extended/device resources (the dominant dimension on a TPU
-        # cluster: chips-per-pod over chips-per-host): without them the
-        # doubling ramp starts far below the true need and the first
-        # viable candidate over-provisions by a whole rounding step
-        for res, need in scalar_need.items():
-            cap = float(parse_quantity(group.capacity.get(res, 0)))
-            if cap > 0:
-                est = max(est, -(-int(need) // int(cap)))
+        for res, n in need.items():
+            cap = caps.get(res, 0.0)
+            if cap > 0 and n > 0:
+                est = max(est, -(-int(n) // int(cap)))
         return int(est)
+
+    def _waste_of(self, group: NodeGroup, count: int,
+                  need: Dict[str, float]) -> float:
+        """Unused fraction of the ADDED capacity, averaged over the dims
+        the template declares (upstream expander/waste's 1 - utilization,
+        extended to device resources).  0.0 = the demand exactly fills the
+        new nodes; 1.0 = they'd sit empty."""
+        caps = self._template_caps(group)
+        fracs = []
+        for res, cap in caps.items():
+            total = cap * count
+            if total <= 0:
+                continue
+            fracs.append(max(0.0, 1.0 - min(need.get(res, 0.0) / total,
+                                            1.0)))
+        return sum(fracs) / len(fracs) if fracs else 1.0
 
     def _candidate_counts(self, group: NodeGroup, est: int,
                           headroom: int) -> List[int]:
@@ -260,7 +293,8 @@ class ClusterAutoscaler:
         candidate placing the MOST pods beyond the zero-add baseline,
         cheapest cost breaking ties."""
         nodes, _ = self.store.list("Node")
-        best: Optional[Tuple[float, NodeGroup, List[v1.Node]]] = None
+        need = self._demand_totals(demand)
+        best = None  # (expander sort key, group, nodes)
         best_partial = None  # (placed, cost, group, nodes)
         any_headroom = False
         for group in sorted(groups, key=lambda g: (g.cost_per_node,
@@ -301,17 +335,24 @@ class ClusterAutoscaler:
             for count, fork, pred in zip(counts, forks, preds):
                 cost = count * group.cost_per_node
                 if pred.unplaced == 0:
-                    if best is None or cost < best[0]:
-                        best = (cost, group, fork.add_nodes)
+                    if self.expander == "least-waste":
+                        # minimize stranded template capacity; an equal
+                        # fit goes to the cheaper group
+                        key = (self._waste_of(group, count, need), cost,
+                               group.name)
+                    else:
+                        key = (cost, group.name)
+                    if best is None or key < best[0]:
+                        best = (key, group, fork.add_nodes)
                     break  # ascending counts: first viable is this
-                    # group's cheapest
+                    # group's cheapest AND least-waste option
                 if pred.placed > base_placed and (
                         best_partial is None
                         or (pred.placed, -cost)
                         > (best_partial[0], -best_partial[1])):
                     best_partial = (pred.placed, cost, group, fork.add_nodes)
         if best is not None:
-            _cost, group, new_nodes = best
+            _key, group, new_nodes = best
             note = (f"add {len(new_nodes)} × {group.name} for "
                     f"{len(demand)} pending pods")
         elif best_partial is not None:

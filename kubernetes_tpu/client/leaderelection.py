@@ -98,6 +98,7 @@ class LeaderElector:
         except ValueError:
             # create raced another candidate's create (AlreadyExists)
             leading = False
+        # ktpu-analysis: ignore[exception-hygiene] -- the failure IS surfaced: renew_failures increments below and _set_leading(False) flips the leader_election_master_status metric; a log line per failed tick would spam under chaos storms
         except Exception:
             # transient control-plane failure (chaos 429/500, network):
             # we cannot prove the lease is ours — release, reacquire later

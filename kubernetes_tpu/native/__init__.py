@@ -50,6 +50,7 @@ class _NativeLib:
                 lib = ctypes.CDLL(self._so)
                 self._configure(lib)
                 self._lib = lib
+            # ktpu-analysis: ignore[exception-hygiene] -- best-effort capability probe: no compiler/toolchain is a SUPPORTED configuration (callers fall back to the pure-python interner on _lib None); failing loudly would break every toolchain-less install
             except Exception:
                 self._lib = None
             return self._lib
@@ -95,6 +96,7 @@ class NativeInterner:
     def __del__(self):
         try:
             self._lib.ktpu_interner_free(self._h)
+        # ktpu-analysis: ignore[exception-hygiene] -- __del__ during interpreter teardown: ctypes globals may already be torn down and raising in __del__ prints unraisable-exception noise; there is nothing to surface to
         except Exception:
             pass
 

@@ -744,6 +744,12 @@ class TPUScheduler:
             # fetches mask+scores in ONE tunnel round
             "compute_packed": jax.jit(fw.compute_packed),
             "apply_commits": jax.jit(fw.apply_commits),
+            # whole-batch FitError diagnosis for the extender path (whose
+            # round programs carry no packed diag plane): ONE fused
+            # program + one [B, K] fetch per failing batch — the previous
+            # eager per-plugin loop in _diagnose paid one device program
+            # per plugin per failing POD (host-sync dataflow finding)
+            "diag_bits": jax.jit(fw.diagnose_bits),
             # one device round per FAILING batch (not fused into every cycle:
             # its freed-resources einsum is ~200 TFLOP at 5k/16k shapes)
             "cand": jax.jit(cand_mask),
@@ -901,7 +907,10 @@ class TPUScheduler:
         try:
             exists = self.store.get(
                 "Pod", qi.pod.namespace, qi.pod.metadata.name) is not None
-        except Exception:
+        except Exception as e:
+            klog.V(2).info_s("Ghost probe failed; requeueing anyway",
+                             pod=qi.pod.key(),
+                             error=f"{type(e).__name__}: {e}")
             exists = True
         if exists:
             self.queue.requeue_after_error(qi)
@@ -980,7 +989,12 @@ class TPUScheduler:
         host_auxes = fw.host_prepare(
             batch, self.snapshot, self.encoder, namespace_labels=self.namespace_labels
         )
-        self.phase_wall["host_prepare"] += self.clock() - t_hp
+        dt_hp = self.clock() - t_hp
+        self.phase_wall["host_prepare"] += dt_hp
+        # the reference's per-extension-point histogram (:130): host_prepare
+        # is this build's PreFilter/PreScore analog, the fused dispatch its
+        # Filter+Score (observed below) — was registered-but-unemitted
+        m.framework_extension_point_duration.observe(dt_hp, ("host_prepare",))
         if self.extenders:
             # round-based cycles: each pod's decision lands at its own
             # round, so per-attempt latency must not absorb later pods'
@@ -1005,9 +1019,14 @@ class TPUScheduler:
                     np.zeros(batch.size, dtype=bool),
                     np.zeros(batch.size, dtype=np.int32),
                 )
+                # the failing-batch diagnosis program too: its first use
+                # is inside _bind_phase, and a cold compile there is the
+                # same mid-window stall this block exists to prevent
+                jt["diag_bits"](batch, dsnap, dyn, auxes)
             t_d = self.clock()
             node_row, algo_lat, ext_rounds = self._assign_with_extenders(
-                fw, jt, batch, dsnap, dyn, auxes, pods, t0, packed0=packed0
+                fw, jt, batch, dsnap, dyn, auxes, pods, t0, packed0=packed0,
+                nom=(nom_rows, nom_req),
             )
             self.phase_wall["dispatch"] += self.clock() - t_d
             fl = _InFlight(infos, batch, dsnap, dyn, auxes, node_row, algo_lat,
@@ -1050,8 +1069,10 @@ class TPUScheduler:
             deltas=deltas, gang_seg=gang_seg,
         )
         # dispatch wall excludes the partition slice timed inside
-        self.phase_wall["dispatch"] += (self.clock() - t_d) - (
+        dt_disp = (self.clock() - t_d) - (
             self.phase_wall["partition"] - part0)
+        self.phase_wall["dispatch"] += dt_disp
+        m.framework_extension_point_duration.observe(dt_disp, ("dispatch",))
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
         trace.log_if_long(0.1)
@@ -1127,6 +1148,9 @@ class TPUScheduler:
                                     time.sleep(0.004)
                             rec.cand_np = np.asarray(rec.cand_dev)
                         except Exception:
+                            # degraded, not lost: _bind_phase refetches the
+                            # cand mask synchronously — count the miss
+                            m.scheduler_retries.inc(("bg_cand_fetch_error",))
                             rec.cand_np = None
                     return
                 if hasattr(dev, "is_ready"):
@@ -1134,7 +1158,10 @@ class TPUScheduler:
                         time.sleep(0.004)
                 rec.fetched = np.asarray(dev)
             except Exception:
-                rec.fetched = None  # _complete falls back to a sync fetch
+                # _complete falls back to a sync fetch; the fallback costs
+                # a full blocking device round, so make the rate visible
+                m.scheduler_retries.inc(("bg_fetch_error",))
+                rec.fetched = None
             rec.fetched_at = clk()
             # prefetch the diagnosis bits too (tiny [B, K] bool): a failing
             # batch's bind phase then pays no extra device round trip.  In
@@ -1151,6 +1178,9 @@ class TPUScheduler:
                 else:
                     rec.diag_np = np.asarray(diag_dev)
             except Exception:
+                # diagnosis prefetch is advisory — _bind_phase refetches
+                # per failing batch; count the miss rather than hide it
+                m.scheduler_retries.inc(("bg_diag_fetch_error",))
                 rec.diag_np = None
 
         fl.fetch_thread = threading.Thread(target=_bg_fetch, daemon=True)
@@ -1280,6 +1310,12 @@ class TPUScheduler:
                                if nf <= 31 else raw)
                     if nf <= 31 and fl.rounds_np is None:
                         fl.rounds_np = int(raw[2, 0])
+                if diag_np is None:
+                    # extender batches carry no fused diag plane: run the
+                    # whole-batch diagnosis program ONCE for this failing
+                    # batch (bool[B, K] — every failing pod shares it)
+                    diag_np = np.asarray(self._jitted_by[fl.profile][
+                        "diag_bits"](batch, dsnap, dyn, auxes))
                 diag_row = None if diag_np is None else diag_np[i]
                 if diag_row is not None and bool(np.all(diag_row)) \
                         and self.gangs.is_member(qi.pod):
@@ -1290,8 +1326,7 @@ class TPUScheduler:
                     qi.unschedulable_plugins = {"Coscheduling"}
                 else:
                     qi.unschedulable_plugins = self._diagnose(
-                        fw, batch, dsnap, dyn, auxes, i, diag_row=diag_row,
-                    )
+                        fw, diag_row=diag_row)
                 # repeat-offender cost cap: the preemption candidate program
                 # (full-pod-tier einsum + its own device round) only runs
                 # when SOME scheduled pod could actually be a victim — a
@@ -1692,7 +1727,8 @@ class TPUScheduler:
         return cached[1]
 
     def _assign_with_extenders(
-        self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float, packed0=None
+        self, fw, jt, batch, dsnap, dyn, auxes, pods, t0: float, packed0=None,
+        nom=None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """ROUND-BASED extender assignment (findNodesThatPassExtenders
         scheduler.go:1035 + extender prioritize merge :1146-1185).
@@ -1728,8 +1764,21 @@ class TPUScheduler:
         _cpl = coupling_flags(batch, namespace_labels=self.namespace_labels)
         reads, solo = _cpl.reads, _cpl.solo
         cpl_comp, cpl_multi = _cpl.comp, _cpl.multi
-        alloc = np.asarray(dsnap.allocatable, dtype=np.float64)  # [N, R]
-        requested = np.array(np.asarray(dyn.requested), dtype=np.float64)
+        # The round ledger reads the ENCODER's host mirrors, not the device
+        # snapshot: dsnap.allocatable/requested are the device copies OF
+        # those mirrors (synced this same dispatch), so fetching them back
+        # was two [N, R] device→host transfers per extender batch — the
+        # blocking-in-cycle dataflow pass flagged both.  Nominated
+        # reservations are re-applied exactly as reserve_nominated does on
+        # device (same clip + masked add), keeping the ledger bit-for-bit.
+        alloc = np.asarray(self.encoder.allocatable, dtype=np.float64)  # [N, R]
+        requested = np.array(self.encoder.requested, dtype=np.float64)
+        if nom is not None:
+            nom_rows = np.asarray(nom[0])
+            nom_req = np.asarray(nom[1], dtype=np.float64)
+            rows_ = np.clip(nom_rows, 0, requested.shape[0] - 1)
+            np.add.at(requested, rows_,
+                      np.where((nom_rows >= 0)[:, None], nom_req, 0.0))
         req_pod = np.asarray(batch.request, dtype=np.float64)  # [B, R]
         unresolved = [i for i in range(len(pods)) if bool(batch.valid[i])]
         rounds = 0
@@ -2291,24 +2340,20 @@ class TPUScheduler:
         self.cache.finish_binding(pod)
         return True
 
-    def _diagnose(self, fw, batch, dsnap, dyn, auxes, i: int, diag_row=None) -> Set[str]:
-        """Which plugins reject pod i everywhere (FitError.Diagnosis analog).
+    def _diagnose(self, fw, diag_row=None) -> Set[str]:
+        """Which plugins reject the pod everywhere (FitError.Diagnosis
+        analog) — a pure host-side decode of one diag-plane row.
 
-        ``diag_row`` (bool[K], from the fused program) answers without any
-        device work; the eager per-plugin loop remains for the extender path.
-        """
-        if diag_row is not None:
-            names = fw.filter_names
-            failing = {names[k] for k in range(len(names)) if not bool(diag_row[k])}
-            return failing or set(names)
-        failing = set()
-        for pw, aux in zip(fw.plugins, auxes):
-            if not hasattr(pw.plugin, "filter"):
-                continue
-            mask = pw.plugin.filter(batch, dsnap, dyn, aux)
-            if not bool(np.asarray(jnp.any(mask[i] & dsnap.node_valid))):
-                failing.add(pw.plugin.name)
-        return failing or {p.plugin.name for p in fw.plugins if hasattr(p.plugin, "filter")}
+        ``diag_row`` (bool[K]) comes from the fused cycle program's packed
+        plane or, on the extender path, the batched diag_bits program —
+        _bind_phase always supplies one now.  The eager per-plugin loop
+        this replaced ran one device program per plugin per failing pod
+        (flagged by the host-sync dataflow pass)."""
+        names = fw.filter_names
+        if diag_row is None:
+            return set(names)  # no diagnosis plane: attribute to all
+        failing = {names[k] for k in range(len(names)) if not bool(diag_row[k])}
+        return failing or set(names)
 
     def run_until_idle(self, max_cycles: int = 1000,
                        backoff_wait: Optional[float] = None) -> CycleStats:

@@ -152,12 +152,17 @@ class WhatIfEngine:
                 batch, dsnap, stack_payloads(payloads), stacked_aux,
                 coupling, sched.rng_key, *args))
         else:
-            rows_k = np.stack([
-                np.asarray(progs["one"](
-                    batch, dsnap, payload, aux, coupling, sched.rng_key,
-                    *args))
+            # dispatch ALL K programs before fetching ANY result: jax
+            # dispatch is async, so fork k+1's device solve overlaps fork
+            # k's fetch round instead of serializing K round-trips
+            # (surfaced by the host-sync dataflow pass — the fetch sat
+            # inside the dispatch loop)
+            devs = [
+                progs["one"](batch, dsnap, payload, aux, coupling,
+                             sched.rng_key, *args)
                 for payload, aux in zip(payloads, host_auxes)
-            ])
+            ]
+            rows_k = np.stack([np.asarray(d) for d in devs])
         # the forked snapshots are NEVER committed back to the encoder —
         # the scheduler's real device state is untouched by the what-if
         m.whatif_forks.inc(by=len(forks))

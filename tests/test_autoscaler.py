@@ -15,6 +15,7 @@ from kubernetes_tpu.autoscaler import (
     NodeGroup,
     member_nodes,
 )
+from kubernetes_tpu.analysis import lockcheck
 from kubernetes_tpu.cli import Kubectl
 from kubernetes_tpu.controllers.disruption import sync_pdbs
 from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
@@ -22,6 +23,22 @@ from kubernetes_tpu.metrics import scheduler_metrics as m
 from kubernetes_tpu.scheduler import TPUScheduler
 from kubernetes_tpu.sim.store import ObjectStore
 from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    """Same contract as the chaos battery's autouse monitor: autoscaler
+    syncs run under runtime lock-order instrumentation — the controller
+    drives whatif solves, the eviction gate, store writes, and metrics in
+    one call stack, so every lock constructed during the test (EvictionAPI,
+    ObjectStore, reflectors, metric registries) reports acquired-after
+    inversions at teardown."""
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
 
 
 class FakeClock:
@@ -201,6 +218,75 @@ def test_scale_up_dry_run_creates_nothing():
     assert ca.sync_once() is False
     assert ca.last_decisions[0].result == "dry_run"
     assert {n.metadata.name for n in store.list("Node")[0]} == nodes_before
+
+
+# --- expander strategies ------------------------------------------------------
+
+
+def test_waste_of_unit():
+    """Waste = mean unused fraction of the ADDED capacity over declared
+    dims; more nodes for the same demand is strictly more waste."""
+    group = _group(name="g", cpu="4")  # caps: cpu 4000m, pods 10
+    need = {"cpu": 4000.0, "pods": 4.0}
+    ca = object.__new__(ClusterAutoscaler)
+    w1 = ClusterAutoscaler._waste_of(ca, group, 1, need)
+    assert w1 == pytest.approx((0.0 + 0.6) / 2)
+    w2 = ClusterAutoscaler._waste_of(ca, group, 2, need)
+    assert w2 > w1
+    # over-demand clamps at full utilization, never negative waste
+    w0 = ClusterAutoscaler._waste_of(ca, group, 1,
+                                     {"cpu": 99999.0, "pods": 99.0})
+    assert w0 == 0.0
+
+
+def test_unknown_expander_rejected():
+    with pytest.raises(ValueError):
+        ClusterAutoscaler(ObjectStore(), TPUScheduler(ObjectStore()),
+                          expander="cheapest")
+
+
+def _two_group_env():
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "1", "pods": "10"}).obj())
+    # 'small' is cheaper in TOTAL cost (8 × 1.0) but strands 90% of its
+    # pods capacity; 'big' costs more (1 × 10.0) yet its one template
+    # node is exactly filled by the demand
+    store.create("NodeGroup", _group(name="small", cpu="2", slice_size=1,
+                                     cost=1.0, max_size=8))
+    store.create("NodeGroup", _group(name="big", cpu="16", slice_size=1,
+                                     cost=10.0, max_size=8))
+    _gang(store, "g", members=8, cpu="2")
+    _starve(store, sched, clock)
+    return store, sched
+
+
+def test_expander_least_cost_default_picks_cheapest_total():
+    store, sched = _two_group_env()
+    ca = ClusterAutoscaler(store, sched)
+    assert ca.expander == "least-cost"
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert (d.group, d.result, d.count) == ("small", "applied", 8)
+
+
+def test_expander_least_waste_prefers_filled_template():
+    """ROADMAP item-2 follow-on: least-waste picks the group whose added
+    nodes the demand actually fills, tie-breaking on cost — here the
+    8×2cpu demand exactly fills ONE 16-cpu template, so 'big' wins even
+    though its total cost is higher."""
+    store, sched = _two_group_env()
+    ca = ClusterAutoscaler(store, sched, expander="least-waste")
+    assert ca.sync_once() is True
+    [d] = ca.last_decisions
+    assert (d.group, d.result, d.count) == ("big", "applied", 1)
+    # and the demand then binds onto the new node
+    sched.run_until_idle(backoff_wait=2.0)
+    bound = {store.get("Pod", "default", f"g-{i}").spec.node_name
+             for i in range(8)}
+    assert bound == {"big-0"}
 
 
 # --- scale-down ---------------------------------------------------------------

@@ -21,11 +21,28 @@ from kubernetes_tpu.descheduler import (
     SpreadViolationRepair,
     WhatIfPlanner,
 )
+from kubernetes_tpu.analysis import lockcheck
 from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
 from kubernetes_tpu.metrics import scheduler_metrics as m
 from kubernetes_tpu.scheduler import TPUScheduler
 from kubernetes_tpu.sim.store import ObjectStore
 from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    """Same contract as the chaos battery's autouse monitor: every
+    descheduler test runs with runtime lock-order instrumentation, so
+    EvictionAPI._lock, the store/reflector locks, and metric locks
+    constructed during the test report any acquired-after inversion at
+    teardown (controllers call through eviction → store → recorder →
+    metrics, a four-deep lock chain the static check cannot order)."""
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
 
 
 class FakeClock:

@@ -3,15 +3,25 @@
 
   python tools/analyze.py                 human report of all findings
   python tools/analyze.py --json          JSON report (machine consumers)
-  python tools/analyze.py --check         gate mode: exit 1 on findings NOT
+  python tools/analyze.py --check all     gate mode: exit 1 on findings NOT
                                           grandfathered in
-                                          analysis_baseline.json, or on
-                                          stale baseline entries (the
-                                          ratchet only shrinks)
+                                          analysis_baseline.json (which is
+                                          ZERO findings — the ratchet was
+                                          burned empty), or on stale
+                                          baseline entries (the ratchet
+                                          only shrinks).  ``--check`` alone
+                                          means ``--check all``; a comma
+                                          list gates that subset only.
+  python tools/analyze.py --diff REF      analyze the FULL tree (the
+                                          interprocedural checks need
+                                          whole-project context) but gate/
+                                          report only findings in files
+                                          changed vs merge-base(HEAD, REF)
+                                          — the fast pre-commit signal
   python tools/analyze.py --write-baseline  rewrite the baseline from the
                                           current findings (do this after
                                           FIXING sites, never to absorb
-                                          new violations)
+                                          new violations — keep it EMPTY)
   --checks a,b  run a subset; --paths P ...  scan other roots (fixtures)
 """
 
@@ -20,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from collections import Counter
 
@@ -39,11 +50,20 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def human_report(findings, checks) -> str:
     lines = []
     by_check = Counter(f.check for f in findings)
-    for check in checks:
-        n = by_check.get(check.name, 0)
-        lines.append(f"== {check.name}: {n} finding(s) — {check.description}")
+    names = [c.name for c in checks]
+    # engine-level findings (the suppression lint) have no Check object —
+    # give them their own section instead of hiding them in the total
+    extra = sorted(set(by_check) - set(names))
+    descr = {c.name: c.description for c in checks}
+    descr.setdefault("suppression",
+                     "ktpu-analysis ignore-comment lint (justification "
+                     "required; no unknown checks; no stale ignores)")
+    for name in names + extra:
+        n = by_check.get(name, 0)
+        lines.append(f"== {name}: {n} finding(s) — "
+                     f"{descr.get(name, '(engine)')}")
         for f in findings:
-            if f.check == check.name:
+            if f.check == name:
                 lines.append(f"  {f.location()} [{f.rule}]")
                 lines.append(f"      {f.message}")
                 if f.snippet:
@@ -53,11 +73,45 @@ def human_report(findings, checks) -> str:
     return "\n".join(lines)
 
 
+def changed_files(ref: str):
+    """Repo-relative .py paths changed vs merge-base(HEAD, ref), plus
+    untracked ones; None when git can't answer (caller falls back to the
+    full-tree gate — fail CLOSED, not open)."""
+    def git(*args):
+        return subprocess.run(
+            ["git", *args], capture_output=True, text=True, cwd=REPO_ROOT)
+
+    mb = git("merge-base", "HEAD", ref)
+    base = mb.stdout.strip() if mb.returncode == 0 else None
+    if base is None:
+        # the ref may still be a valid commit without a merge-base query
+        # (shallow clone): try it directly
+        if git("rev-parse", "--verify", ref).returncode != 0:
+            return None
+        base = ref
+    diff = git("diff", "--name-only", base, "--")
+    if diff.returncode != 0:
+        return None
+    out = {ln.strip() for ln in diff.stdout.splitlines() if ln.strip()}
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    if untracked.returncode == 0:
+        out |= {ln.strip() for ln in untracked.stdout.splitlines()
+                if ln.strip()}
+    return {p for p in out if p.endswith(".py")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", action="store_true", help="JSON report")
-    ap.add_argument("--check", action="store_true",
-                    help="gate against the committed baseline")
+    ap.add_argument("--check", nargs="?", const="all", default=None,
+                    metavar="all|c1,c2",
+                    help="gate against the committed baseline; 'all' "
+                         "(default) gates every registered check, a comma "
+                         "list gates that subset")
+    ap.add_argument("--diff", metavar="REF",
+                    help="report/gate only findings in files changed vs "
+                         "merge-base(HEAD, REF); analysis still runs over "
+                         "the full tree for interprocedural context")
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--baseline",
                     default=os.path.join(REPO_ROOT,
@@ -69,15 +123,32 @@ def main(argv=None) -> int:
                          % (DEFAULT_SCAN_PATHS,))
     args = ap.parse_args(argv)
 
-    checks = default_checks(
-        [c for c in args.checks.split(",") if c] if args.checks else ())
+    subset = [c for c in args.checks.split(",") if c]
+    if args.check not in (None, "all"):
+        if subset:
+            print("--check <subset> and --checks are mutually exclusive; "
+                  "pick one spelling.", file=sys.stderr)
+            return 2
+        subset = [c for c in args.check.split(",") if c]
+    checks = default_checks(subset)
     project = load_project(REPO_ROOT, args.paths or DEFAULT_SCAN_PATHS)
     findings = run_checks(project, checks)
 
+    scoped = findings
+    diff_scope = None
+    if args.diff:
+        diff_scope = changed_files(args.diff)
+        if diff_scope is None:
+            print(f"--diff {args.diff}: git could not resolve a merge "
+                  f"base; falling back to the FULL-tree gate.",
+                  file=sys.stderr)
+        else:
+            scoped = [f for f in findings if f.path in diff_scope]
+
     if args.write_baseline:
-        if args.checks or args.paths:
-            print("refusing --write-baseline with --checks/--paths: a "
-                  "subset run would clobber every other check's "
+        if subset or args.paths or args.diff:
+            print("refusing --write-baseline with --checks/--paths/--diff: "
+                  "a partial run would clobber every other check's "
                   "grandfathered entries; rerun without subset flags.",
                   file=sys.stderr)
             return 2
@@ -89,25 +160,31 @@ def main(argv=None) -> int:
 
     if args.json:
         print(json.dumps({
-            "findings": [f.__dict__ for f in findings],
-            "by_check": dict(Counter(f.check for f in findings)),
+            "findings": [f.__dict__ for f in scoped],
+            "by_check": dict(Counter(f.check for f in scoped)),
+            "diff_scope": sorted(diff_scope) if diff_scope is not None
+            else None,
         }, indent=1))
     else:
-        print(human_report(findings, checks))
+        print(human_report(scoped, checks))
 
-    if not args.check:
+    if args.check is None:
         return 0
 
     base = baseline_mod.load(args.baseline)
     # a subset run must not misread the rest of the baseline as stale:
-    # restrict the comparison to the checks actually run, and skip stale
-    # enforcement entirely on a partial --paths scan (live counts for
-    # unscanned files are legitimately zero)
-    run_names = {c.name for c in checks}
+    # restrict the comparison to the checks actually run (plus the
+    # engine-level suppression lint, which always runs), and skip stale
+    # enforcement entirely on partial --paths/--diff scans (live counts
+    # for unscanned/unchanged files are legitimately zero)
+    run_names = {c.name for c in checks} | {"suppression"}
     base = {k: v for k, v in base.items()
             if k.split("::", 1)[0] in run_names}
-    new, stale = baseline_mod.diff(findings, base)
-    if args.paths:
+    if diff_scope is not None:
+        base = {k: v for k, v in base.items()
+                if k.split("::")[1] in diff_scope}
+    new, stale = baseline_mod.diff(scoped, base)
+    if args.paths or diff_scope is not None:
         stale = []
     if new:
         print(f"\nFAIL: {len(new)} NEW violation(s) beyond the baseline:",
@@ -115,8 +192,9 @@ def main(argv=None) -> int:
         for f in new:
             print(f"  {f.location()} [{f.check}/{f.rule}] {f.message}",
                   file=sys.stderr)
-        print("fix them (preferred), or consciously re-baseline with "
-              "--write-baseline and justify it in the PR.", file=sys.stderr)
+        print("fix them (preferred), or add a `ktpu-analysis: "
+              "ignore[check] -- justification` suppression and defend it "
+              "in the PR; the baseline stays EMPTY.", file=sys.stderr)
         return 1
     if stale:
         print(f"\nFAIL: {len(stale)} STALE baseline entr(ies) — the "
@@ -125,8 +203,9 @@ def main(argv=None) -> int:
         for k in stale:
             print(f"  {k}", file=sys.stderr)
         return 1
-    print(f"\nOK: all {len(findings)} finding(s) grandfathered; "
-          f"baseline is tight.")
+    scope_note = (f" in {len(diff_scope)} changed file(s)"
+                  if diff_scope is not None else "")
+    print(f"\nOK: {len(scoped)} finding(s){scope_note}; baseline is tight.")
     return 0
 
 

@@ -8,11 +8,15 @@ set -u
 OUT=suites_5k.out
 FAILED=0
 : > "$OUT"
-# static invariant gate first: new analyzer violations abort the whole pass
-# before any expensive suite runs (same ratchet tier-1 enforces via
-# tests/test_static_analysis.py) — a failure here is conclusive in seconds,
-# so don't burn hours of 5k-node suites on a known-bad tree
-python tools/analyze.py --check > /dev/null || { echo "FAILED: static analysis gate" >> suites_run.log; exit 1; }
+# static invariant gates first: new analyzer violations abort the whole
+# pass before any expensive suite runs — a failure here is conclusive in
+# seconds, so don't burn hours of 5k-node suites on a known-bad tree.
+# Gate 1 is the DIFF-scoped run (files changed vs the merge base — the
+# pre-commit-speed signal, and the one that names your own change);
+# gate 2 is the authoritative full-tree ratchet (zero-finding baseline),
+# the same one tier-1 enforces via tests/test_static_analysis.py.
+python tools/analyze.py --diff origin/main --check all > /dev/null || { echo "FAILED: static analysis diff gate" >> suites_run.log; exit 1; }
+python tools/analyze.py --check all > /dev/null || { echo "FAILED: static analysis gate" >> suites_run.log; exit 1; }
 # gang-subsystem gate: the coscheduling battery (all-or-nothing, Permit
 # holds, timeout requeue, CLI) is cheap and conclusive — fail fast before
 # the expensive suites, same rationale as the analyzer gate above
