@@ -29,8 +29,11 @@ Layout (host control plane mirrors reference layers from SURVEY.md §1):
   perf/           — scheduler_perf-style benchmark harness
   controllers/    — control loops (ReplicaSet, Deployment, Job, GC,
                     NodeLifecycle, …)
-  client/         — reflector/informer, workqueue, leader election, events
-  component_base/ — feature gates, healthz, configz, tracing
+  client/         — reflector/informer, workqueue, leader election (with
+                    fencing tokens), events (bounded-loss recorder)
+  component_base/ — feature gates, healthz, readyz, configz, tracing
+  chaos/          — seeded fault injection + deterministic crash points
+  recovery/       — cold-start reconstruction, drift repair, failover soak
 """
 
 __version__ = "0.2.0"

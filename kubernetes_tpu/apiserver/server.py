@@ -167,8 +167,15 @@ class APIServer:
         mutating_admission: Optional[list] = None,
         validating_admission: Optional[list] = None,
         fault_injector=None,
+        readyz=None,
     ):
         self.store = store
+        # readiness source (component_base.healthz.Readyz or None): when
+        # set, /readyz serves 503 + per-component rebuild progress while a
+        # cold-start reconstruction is in flight — a recovering replica
+        # never takes traffic mid-rebuild.  /healthz and /livez stay 200
+        # (the process is alive either way).
+        self.readyz = readyz
         self.scheme = scheme or default_scheme()
         self.authorizer = authorizer
         # chaos hook (chaos.faults.FaultSchedule-shaped, or None): write
@@ -363,8 +370,18 @@ def _make_handler(api: APIServer):
             url = urlparse(self.path)
             q = parse_qs(url.query)
             if url.path in ("/healthz", "/readyz", "/livez"):
-                body = b"ok"
-                self.send_response(200)
+                code, body = 200, b"ok"
+                if url.path == "/readyz" and api.readyz is not None:
+                    # readiness is gated on the wired Readyz: NotReady
+                    # (mid-reconstruction) is 503 with the per-component
+                    # progress as the body, the reference's verbose
+                    # /readyz failure rendering.  ONE render() call is the
+                    # single snapshot — a separate ready check could
+                    # disagree with the body it ships.
+                    rendered = api.readyz.render()
+                    if rendered != "ok":
+                        code, body = 503, rendered.encode()
+                self.send_response(code)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -378,6 +378,13 @@ class ClusterAutoscaler:
             try:
                 self.store.create("Node", node)
                 created += 1
+                # kill-point: some of the decision's nodes created, the
+                # process dies — deterministic node names make the resume
+                # exactly-once: the successor's next sync recounts live
+                # membership and creates only the missing names
+                from ..chaos.faults import maybe_crash
+
+                maybe_crash("crash.mid_scaleup")
             except ValueError:
                 continue  # raced into existence — same exactly-once guard
             except Exception as e:

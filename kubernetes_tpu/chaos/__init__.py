@@ -2,7 +2,10 @@
 
 Layout:
   - faults.py — FaultSchedule (deterministic per-key fault decisions),
-    TransientApiError / InjectedConflict / WatchDropped, steal_lease
+    TransientApiError / InjectedConflict / WatchDropped, steal_lease,
+    and the deterministic crash-point framework (CRASH_POINTS catalog,
+    ProcessCrash, maybe_crash hooks wired at the real call sites — the
+    recovery layer's kill switch)
   - retry.py  — RetryingStore (Retry-After-honoring write retries)
   - soak.py   — the convergence-under-failure workload driver
     (tests/test_chaos.py battery + tools/chaos_soak.py share it)
@@ -12,19 +15,29 @@ primitives stay importable from stdlib-only contexts (subprocess servers).
 """
 
 from .faults import (  # noqa: F401
+    CRASH_POINTS,
     FaultSchedule,
     InjectedConflict,
+    ProcessCrash,
     TransientApiError,
     WatchDropped,
+    crash_schedule,
+    install_crash_schedule,
+    maybe_crash,
     steal_lease,
 )
 from .retry import RetryingStore  # noqa: F401
 
 __all__ = [
+    "CRASH_POINTS",
     "FaultSchedule",
     "InjectedConflict",
+    "ProcessCrash",
     "TransientApiError",
     "WatchDropped",
     "RetryingStore",
+    "crash_schedule",
+    "install_crash_schedule",
+    "maybe_crash",
     "steal_lease",
 ]

@@ -36,9 +36,83 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, Optional, Tuple
 
 from ..sim.store import StaleResourceVersion
+
+# --- deterministic crash points (kubernetes_tpu/recovery/) --------------------
+#
+# The registered kill-point catalog.  Each name is hard-wired at ONE real
+# call site; maybe_crash(name) at that site raises ProcessCrash when an
+# installed FaultSchedule armed the point — simulating process death at the
+# exact state the site leaves behind (in-memory state discarded by the
+# harness, store untouched).  Recovery (recovery/rebuild.cold_start) must
+# converge from every one of these states; tests/test_recovery.py drives
+# each point in turn.
+CRASH_AFTER_ASSUME = "crash.after_assume"      # scheduler._complete: batch assumed, nothing bound
+CRASH_MID_BIND = "crash.mid_bind"              # scheduler._finish_bind: store bind landed, bookkeeping lost
+CRASH_PERMIT_HELD = "crash.permit_held"        # gang/directory.note_waiting: member holds its Permit
+CRASH_MID_PLAN_APPLY = "crash.mid_plan_apply"  # descheduler/controller._apply: some victims evicted
+CRASH_MID_SCALEUP = "crash.mid_scaleup"        # autoscaler/controller._scale_up: some nodes created
+CRASH_POST_LEASE_RENEW = "crash.post_lease_renew"  # leaderelection._tick: lease renewed, holder dies
+
+CRASH_POINTS = (
+    CRASH_AFTER_ASSUME,
+    CRASH_MID_BIND,
+    CRASH_PERMIT_HELD,
+    CRASH_MID_PLAN_APPLY,
+    CRASH_MID_SCALEUP,
+    CRASH_POST_LEASE_RENEW,
+)
+
+
+class ProcessCrash(BaseException):
+    """Simulated process death at a registered crash point.
+
+    BaseException ON PURPOSE: the resilience machinery this repo grew
+    (cycle failure handlers, best-effort writes, eviction fail-stop) all
+    catch ``Exception`` — a real SIGKILL is not catchable, so neither is
+    this.  Only the crash harness (recovery/failover, test batteries)
+    catches it, then discards the dead replica's in-memory state.
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated process death at {point}")
+        self.point = point
+
+
+# The installed schedule consulted by maybe_crash().  Module-level on
+# purpose: the call sites (scheduler binding cycle, gang directory,
+# controllers, leader election) have no shared config object, and a crash
+# harness controls one process at a time.  None (the default) costs one
+# global read per site.
+_active_crash_schedule: Optional["FaultSchedule"] = None
+
+
+def install_crash_schedule(schedule: Optional["FaultSchedule"]) -> None:
+    global _active_crash_schedule
+    _active_crash_schedule = schedule
+
+
+@contextmanager
+def crash_schedule(schedule: "FaultSchedule"):
+    """Scoped install — the harness form, so a raising test can never leak
+    an armed schedule into the next test's scheduler."""
+    install_crash_schedule(schedule)
+    try:
+        yield schedule
+    finally:
+        install_crash_schedule(None)
+
+
+def maybe_crash(point: str) -> None:
+    """The call-site hook: raise ProcessCrash when the installed schedule
+    armed this point for the current hit.  No-op (one global read) when no
+    schedule is installed."""
+    s = _active_crash_schedule
+    if s is not None:
+        s.crash_fault(point)
 
 
 class TransientApiError(RuntimeError):
@@ -94,6 +168,7 @@ class FaultSchedule:
         retry_after: float = 0.02,
         max_faults_per_key: int = 3,
         exempt_kinds=frozenset({"Event"}),
+        crash_points: Optional[Dict[str, int]] = None,
     ):
         self.seed = seed
         self.watch_drop_rate = watch_drop_rate
@@ -117,6 +192,13 @@ class FaultSchedule:
         # fault class → total injected; equal across same-seed runs whenever
         # each key's op sequence is deterministic (the soak's assertion)
         self.injected: Dict[str, int] = {}
+        # point name → 1-based hit at which the point fires (ONCE; the
+        # armed entry then moves to _crash_fired).  Hit counters ride the
+        # same per-key _seq machinery as every other fault class, so a
+        # crash at "the 3rd completed batch" replays at the 3rd completed
+        # batch in every same-seed run — wall clock never enters it.
+        self.crash_points: Dict[str, int] = dict(crash_points or {})
+        self._crash_fired: Dict[str, int] = {}  # point → seq it fired at
 
     # --- deterministic primitives -------------------------------------------
 
@@ -149,6 +231,46 @@ class FaultSchedule:
         """Snapshot of fault-class → injected count (the determinism probe)."""
         with self._lock:
             return dict(self.injected)
+
+    # --- deterministic crash points (consumed via maybe_crash) ---------------
+
+    def arm_crash(self, point: str, at_hit: int = 1) -> None:
+        """Arm ``point`` to fire at its ``at_hit``-th FUTURE hit (relative
+        to hits already consumed), once.  The failover soak arms points one
+        at a time — each epoch's kill is still a pure function of the
+        per-point hit sequence, so same-seed replays kill at the same op."""
+        if point not in CRASH_POINTS:
+            raise ValueError(f"unknown crash point {point!r}; "
+                             f"registered: {CRASH_POINTS}")
+        with self._lock:
+            seen = self._counters.get(("crashpoint", point), 0)
+            self.crash_points[point] = seen + at_hit
+
+    def crash_fault(self, point: str) -> None:
+        """Raise ProcessCrash when ``point`` is armed for this hit.
+
+        Counts the hit EVERY call (armed or not) so arming decisions made
+        later still address a deterministic sequence position.  Fires
+        once per armed point; the firing is recorded in ``injected`` under
+        ``crash:<point>`` (part of the determinism signature)."""
+        seq = self._seq("crashpoint", point)
+        with self._lock:
+            at = self.crash_points.get(point)
+            if at is None or seq + 1 != at:
+                return
+            del self.crash_points[point]
+            self._crash_fired[point] = seq
+            self.injected[f"crash:{point}"] = (
+                self.injected.get(f"crash:{point}", 0) + 1)
+        from ..metrics import scheduler_metrics as m
+
+        m.chaos_faults_injected.inc((f"crash:{point}",))
+        raise ProcessCrash(point)
+
+    def crashes_fired(self) -> Dict[str, int]:
+        """point → hit seq it fired at (empty until points fire)."""
+        with self._lock:
+            return dict(self._crash_fired)
 
     # --- hooks consumed by sim/store.py -------------------------------------
 
@@ -281,5 +403,10 @@ def steal_lease(store, namespace: str, name: str,
     lease.metadata = copy.copy(lease.metadata)
     lease.holder_identity = usurper
     lease.renew_time = clock()
+    # a holder change IS a lease transition: bumping it invalidates the
+    # victim's fencing token (client/leaderelection.py check_fence), so a
+    # stolen-from leader's in-flight binding cycles refuse at bind time
+    # instead of racing the usurper's cycles
+    lease.lease_transitions = getattr(lease, "lease_transitions", 0) + 1
     store.update("Lease", lease)
     return True

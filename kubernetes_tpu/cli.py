@@ -385,6 +385,26 @@ class Kubectl:
                         f"{d.result} ({d.note})")
         return out
 
+    # --- readiness view -------------------------------------------------------
+
+    def readyz_status(self, readyz=None) -> str:
+        """``ktpu readyz``: the scheduler replica's readiness, with
+        per-component cold-start rebuild progress while a reconstruction is
+        in flight (component_base.healthz.Readyz — the same source the
+        apiserver's /readyz serves).  Without an in-process Readyz there is
+        nothing rebuilding: ready."""
+        if readyz is None:
+            return "ok"
+        ok, comps = readyz.check()
+        rows = [["COMPONENT", "PROGRESS", "READY"]]
+        for name in sorted(comps):
+            done, total = comps[name]
+            rows.append([name, f"{done}/{total}",
+                         "true" if done >= total else "false"])
+        out = _render_table(rows) if len(rows) > 1 else ""
+        head = "ok" if ok else "NotReady"
+        return f"{head}\n{out}" if out else head
+
     # --- slice fragmentation view ---------------------------------------------
 
     def get_slices(self, slice_label: Optional[str] = None,
@@ -492,6 +512,7 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                    help="evaluate the eviction gate, evict nothing")
     p = sub.add_parser("autoscaler")
     p.add_argument("action", choices=["status"])
+    sub.add_parser("readyz")
     for verb in ("cordon", "uncordon"):
         p = sub.add_parser(verb)
         p.add_argument("node")
@@ -532,6 +553,19 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         print(k.drain(args.node, dry_run=args.dry_run))
     elif args.verb == "autoscaler":
         print(k.autoscaler_status())
+    elif args.verb == "readyz":
+        if args.server:
+            # the apiserver's /readyz carries the wired Readyz's rendering
+            import urllib.error
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(f"{args.server}/readyz") as r:
+                    print(r.read().decode())
+            except urllib.error.HTTPError as e:  # 503 NotReady body
+                print(e.read().decode())
+        else:
+            print(k.readyz_status())
     elif args.verb in ("cordon", "uncordon"):
         print(k.cordon(args.node, on=args.verb == "cordon"))
     return 0

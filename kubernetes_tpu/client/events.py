@@ -3,6 +3,16 @@
 The scheduler emits FailedScheduling/Scheduled events (scheduler.go:386,488);
 events are aggregated by (object, reason) with a count, like the reference's
 correlator.
+
+Durability contract: event writes stay best-effort (a flaky control plane
+must never turn a Scheduled notification into a binding-cycle crash), but
+the loss is BOUNDED instead of silent — a failed store write parks the
+event in a retained-retry buffer (cap ``RETAIN_CAP``) that ``flush()``
+drains on shutdown (TPUScheduler.close) or whenever the caller asks.  An
+event is only counted into ``events_dropped_total`` when it is truly lost:
+evicted from a full buffer, or still failing at flush time — so a soak can
+assert the loss bound (zero after a clean-shutdown flush against a healthy
+store).
 """
 
 from __future__ import annotations
@@ -13,7 +23,12 @@ from typing import Dict, List, Tuple
 
 from ..api.objects import ObjectMeta
 from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
 from ..sim.store import ObjectStore
+
+# retained failed writes beyond this evict oldest-first (each eviction IS a
+# drop and counts); keeps a long outage from growing the buffer unboundedly
+RETAIN_CAP = 256
 
 
 @dataclass
@@ -37,6 +52,10 @@ class EventRecorder:
         self.source = source
         self.clock = clock
         self._index: Dict[Tuple[str, str], Event] = {}
+        # failed store writes retained for flush(): (op name, event).
+        # Single-writer by contract (the scheduler thread), like _index.
+        self._pending: List[Tuple[str, Event]] = []
+        self.dropped = 0  # truly lost events (mirror of the counter)
 
     def eventf(self, obj, event_type: str, reason: str, message: str) -> Event:
         ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"
@@ -47,7 +66,7 @@ class EventRecorder:
             ev.count += 1
             ev.last_timestamp = now
             ev.message = message
-            self._write(self.store.update, ev)
+            self._write("update", ev)
             return ev
         ev = Event(
             involved_object=ref, reason=reason, message=message, type=event_type,
@@ -56,24 +75,62 @@ class EventRecorder:
         ev.metadata.namespace = obj.metadata.namespace or "default"
         ev.metadata.name = f"{obj.metadata.name}.{int(now * 1e6):x}"
         self._index[key] = ev
-        self._write(self.store.create, ev)
+        self._write("create", ev)
         return ev
 
-    @staticmethod
-    def _write(op, ev) -> None:
+    def _write(self, op: str, ev: Event) -> None:
         """Best-effort store write: events are observability, never
         load-bearing — the reference's recorder drops events rather than
         fail the caller (client-go tools/record broadcaster semantics), so
         a flaky control plane must not turn a Scheduled notification into
-        a binding-cycle crash.  The local aggregate keeps counting."""
+        a binding-cycle crash.  A failed write is RETAINED for flush();
+        only buffer eviction (and flush-time failure) counts as dropped."""
         try:
-            op("Event", ev)
+            (self.store.create if op == "create" else self.store.update)(
+                "Event", ev)
         except Exception as e:
-            # still best-effort (never fail the caller), but a dropped
-            # event is visible at debug verbosity instead of vanishing
-            klog.V(2).info_s("event recorder dropped store write",
+            klog.V(2).info_s("event recorder retained failed store write",
                              reason=ev.reason, obj=ev.involved_object,
                              err=f"{type(e).__name__}: {e}")
+            self._pending.append((op, ev))
+            while len(self._pending) > RETAIN_CAP:
+                old_op, old_ev = self._pending.pop(0)
+                self._drop(old_ev, "retain buffer full")
+
+    def _drop(self, ev: Event, why: str) -> None:
+        self.dropped += 1
+        m.events_dropped.inc()
+        klog.V(2).info_s("event dropped", reason=ev.reason,
+                         obj=ev.involved_object, why=why)
+
+    def flush(self) -> int:
+        """Retry every retained failed write once (the shutdown hook —
+        TPUScheduler.close calls this); events that STILL fail are counted
+        dropped.  Returns the number of events lost by this flush, so the
+        chaos/failover soaks can assert the loss bound."""
+        pending, self._pending = self._pending, []
+        lost = 0
+        for op, ev in pending:
+            try:
+                if op == "create":
+                    # the original create may have half-raced a retry: an
+                    # existing object downgrades to an update
+                    if self.store.get("Event", ev.metadata.namespace,
+                                      ev.metadata.name) is None:
+                        self.store.create("Event", ev)
+                    else:
+                        self.store.update("Event", ev)
+                else:
+                    self.store.update("Event", ev)
+            except Exception as e:
+                self._drop(ev, f"flush retry failed: {type(e).__name__}: {e}")
+                lost += 1
+        return lost
+
+    @property
+    def pending_writes(self) -> int:
+        """Retained-but-not-yet-lost failed writes (the bounded backlog)."""
+        return len(self._pending)
 
     def events_for(self, obj) -> List[Event]:
         ref = f"{getattr(obj, 'kind', type(obj).__name__)}/{obj.metadata.namespace}/{obj.metadata.name}"

@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..api.objects import ObjectMeta
+from ..component_base import logging as klog
 from ..metrics import scheduler_metrics as m
 from ..sim.store import ObjectStore, StaleResourceVersion
 
@@ -34,6 +35,12 @@ class Lease:
     holder_identity: str = ""
     lease_duration_seconds: float = 15.0
     renew_time: float = 0.0
+    # incremented on every holder CHANGE (coordination.k8s.io/v1
+    # leaseTransitions) — never on a self-renewal.  Doubles as the fencing
+    # token: a holder captures it at acquire time and refuses shared-state
+    # writes once the stored value moved on (a successor acquired, or
+    # chaos.steal_lease usurped) — the classic fencing-token construction.
+    lease_transitions: int = 0
 
     kind = "Lease"
 
@@ -77,9 +84,34 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
         self.renew_failures = 0  # consecutive failed acquire/renew ticks
+        # fencing token: the lease's transition count captured when THIS
+        # identity last acquired/renewed; -1 while not leading
+        self.fence_token = -1
 
     def is_leader(self) -> bool:
         return self._leading
+
+    def check_fence(self) -> bool:
+        """Fencing-token check for shared-state writes (the bind fence).
+
+        Reads the LIVE lease and verifies this identity still holds it at
+        the SAME transition count as when leadership was captured.  Any
+        failure to prove that — lease gone, holder changed, transitions
+        bumped (steal_lease), or a store fault mid-read — returns False:
+        an unprovable fence is a failed fence, exactly like a failed
+        renewal releases leadership."""
+        if not self._leading:
+            return False
+        try:
+            lease = self.lock.get()
+        except Exception as e:
+            klog.V(2).info_s("fence check store read failed",
+                             identity=self.identity,
+                             error=f"{type(e).__name__}: {e}")
+            return False
+        return (lease is not None
+                and lease.holder_identity == self.identity
+                and lease.lease_transitions == self.fence_token)
 
     def try_acquire_or_renew(self) -> bool:
         """One tick of the acquire/renew loop; returns current leadership.
@@ -113,6 +145,8 @@ class LeaderElector:
     def _tick(self, now: float) -> bool:
         import copy
 
+        from ..chaos.faults import maybe_crash
+
         lease = self.lock.get()
         if lease is None:
             lease = Lease(
@@ -121,6 +155,7 @@ class LeaderElector:
                 renew_time=now,
             )
             self.lock.create(lease)
+            self.fence_token = lease.lease_transitions
             return True
         # mutate a private copy: in-process stores hand out the LIVE object,
         # and a write that fails (CAS conflict, injected fault) must not
@@ -132,15 +167,29 @@ class LeaderElector:
         if lease.holder_identity == self.identity:
             lease.renew_time = now
             self.lock.update(lease, expected_rv=rv)
+            self.fence_token = lease.lease_transitions
+            # process death right after a successful renewal: the worst
+            # takeover latency — successors must wait out a FRESH full
+            # lease_duration before stealing (recovery-time upper bound)
+            maybe_crash("crash.post_lease_renew")
             return True
         if expired:
             lease.holder_identity = self.identity
             lease.renew_time = now
+            # holder change = lease transition (fences out the old holder)
+            lease.lease_transitions += 1
             self.lock.update(lease, expected_rv=rv)
+            self.fence_token = lease.lease_transitions
             return True
         return False
 
     def _set_leading(self, leading: bool):
+        if not leading:
+            # a released (or never-held) leadership has no valid fence; the
+            # token resets BEFORE on_stopped_leading so the callback's
+            # stop-work path (scheduler.abandon_inflight) already sees a
+            # fenced-out elector
+            self.fence_token = -1
         if leading and not self._leading and self.on_started_leading:
             self.on_started_leading()
         if not leading and self._leading and self.on_stopped_leading:

@@ -1,6 +1,6 @@
 """Observability/config substrate (reference LX: staging/.../component-base)."""
 
 from .featuregate import FeatureGate, default_feature_gate  # noqa: F401
-from .healthz import Healthz  # noqa: F401
+from .healthz import Healthz, Readyz  # noqa: F401
 from .configz import Configz  # noqa: F401
 from .trace import Trace  # noqa: F401

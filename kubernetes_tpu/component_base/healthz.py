@@ -1,9 +1,11 @@
-"""healthz checks (reference: apiserver/pkg/server/healthz; every binary serves
-/healthz with named checks)."""
+"""healthz/readyz checks (reference: apiserver/pkg/server/healthz; every
+binary serves /healthz with named checks, and /readyz separately so a live
+process that cannot take traffic yet — informers unsynced, state rebuilding
+— is restarted by nobody but routed to by nobody either)."""
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 
 class Healthz:
@@ -22,3 +24,73 @@ class Healthz:
             except Exception:
                 results[name] = False
         return all(results.values()), results
+
+
+class Readyz:
+    """Readiness DISTINCT from liveness: a recovering replica is alive (its
+    /healthz checks pass) but must report NotReady until cold-start state
+    reconstruction completes, with per-component rebuild progress — the
+    reference's informer-HasSynced gating on /readyz
+    (apiserver/pkg/server/healthz informer-sync checks).
+
+    Components register with ``begin(name, total)``, advance with
+    ``progress``, and finish with ``complete``; the instance is ready when
+    every registered component is complete.  A fresh instance with no
+    components is ready (nothing is rebuilding).  Single-writer (the
+    recovering thread) with GIL-atomic dict reads — scrapers (HTTP handler,
+    CLI) only snapshot.
+    """
+
+    def __init__(self):
+        # name -> (done, total); complete iff done >= total
+        self._progress: Dict[str, Tuple[int, int]] = {}
+
+    def begin(self, name: str, total: int = 1) -> None:
+        self._progress[name] = (0, max(int(total), 0))
+
+    def begin_all(self, names, total: int = 1) -> None:
+        """Enter a rebuild atomically: every component lands NotReady in ONE
+        dict assignment, so a concurrent scrape can never observe the empty
+        (= ready) window between a reset and the first begin()."""
+        self._progress = {name: (0, max(int(total), 0)) for name in names}
+
+    def progress(self, name: str, done: int,
+                 total: Optional[int] = None) -> None:
+        cur = self._progress.get(name, (0, 1))
+        self._progress[name] = (int(done),
+                                cur[1] if total is None else int(total))
+
+    def complete(self, name: str) -> None:
+        _, total = self._progress.get(name, (0, 1))
+        self._progress[name] = (total, total)
+
+    def reset(self) -> None:
+        """Back to no-components — which is READY (nothing is rebuilding).
+        A replica entering a fresh reconstruction must use ``begin_all``
+        (one atomic assignment), never reset-then-begin: the in-between
+        empty dict would serve a ready /readyz mid-rebuild."""
+        self._progress = {}
+
+    @property
+    def ready(self) -> bool:
+        return self.check()[0]
+
+    def check(self) -> Tuple[bool, Dict[str, Tuple[int, int]]]:
+        # snapshot FIRST (one reference read, atomic under the GIL), then
+        # iterate the snapshot — iterating the live dict races concurrent
+        # begin()/progress() writers from the recovering thread
+        snap = dict(self._progress)
+        return (all(d >= t for d, t in snap.values()), snap)
+
+    def render(self) -> str:
+        """Text form for /readyz and the CLI: ``ok`` when ready, else one
+        line per incomplete component with its rebuild progress."""
+        ok, comps = self.check()
+        if ok:
+            return "ok"
+        lines = ["NotReady"]
+        for name in sorted(comps):
+            done, total = comps[name]
+            if done < total:
+                lines.append(f"  {name}: {done}/{total}")
+        return "\n".join(lines)

@@ -261,6 +261,13 @@ class DeschedulerController:
                 m.descheduler_plans.inc((plan.policy, "abandoned"))
                 return applied
             applied += 1
+            # kill-point: some victims evicted, the rest of the plan (and
+            # the whole controller) dies — the fail-stop contract means a
+            # recovered process re-plans from live state and never resumes
+            # this victim list; already-evicted pods are gone exactly once
+            from ..chaos.faults import maybe_crash
+
+            maybe_crash("crash.mid_plan_apply")
         self._occupancy = None  # evictions changed the occupancy map
         m.descheduler_plans.inc((plan.policy, "applied"))
         klog.V(2).info_s("Descheduler plan applied", policy=plan.policy,

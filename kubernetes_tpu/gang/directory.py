@@ -255,6 +255,13 @@ class GangDirectory:
         if g.first_wait_ts is None:
             g.first_wait_ts = self._clock()
         self._set_phase(g, v1.POD_GROUP_SCHEDULING)
+        # kill-point: a gang member holds its Permit (assumed + reserved,
+        # NOTHING bound in the store) — process death here must expire the
+        # held permits into an atomic gang requeue on the successor, never
+        # a half-bound gang (no store bind has happened for any waiter)
+        from ..chaos.faults import maybe_crash
+
+        maybe_crash("crash.permit_held")
 
     def note_wait_rejected(self, pod: v1.Pod, reason: str) -> None:
         """Flush-path context for the unreserve that follows: was this a

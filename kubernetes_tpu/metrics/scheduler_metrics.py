@@ -139,6 +139,34 @@ leader_election_status = default_registry.register(
     Gauge("leader_election_master_status")
 )
 
+# --- crash-restart resilience (kubernetes_tpu/recovery/) ----------------------
+# Emitted at the real decision points: the event recorder's flush/eviction
+# path when an event is truly lost, the drift detector on every divergent
+# component it finds, and the cold-start reconstructor once per recovery.
+
+events_dropped = default_registry.register(
+    # truly lost events only: evicted from the recorder's bounded retain
+    # buffer, or still failing at the shutdown flush — retained-and-later-
+    # flushed events never count (client/events.py)
+    Counter("events_dropped_total",
+            "Events lost after the recorder's bounded retry/flush")
+)
+state_drift = default_registry.register(
+    # labels: (component,) — "cache_pods" | "encoder_nodes" |
+    # "encoder_pods" | "affinity" — one increment per divergent key found
+    # by recovery/drift.py's live-vs-from-scratch-store diff (before repair)
+    Counter("scheduler_state_drift_total",
+            "Divergent keys between live scheduler state and a "
+            "from-scratch store rebuild, by component")
+)
+cold_starts = default_registry.register(
+    # labels: (outcome,) — "clean" (post-rebuild drift check found
+    # nothing) | "repaired" (divergence found and repaired) | "degraded"
+    # (divergence survived repair — the replica should stay NotReady)
+    Counter("scheduler_cold_starts_total",
+            "Cold-start state reconstructions, by drift outcome")
+)
+
 # --- descheduler subsystem (kubernetes_tpu/descheduler/) ---------------------
 # Emitted at the real decision points: every pod-killing path's verdict at
 # the shared eviction gate, each policy plan's end state in the controller

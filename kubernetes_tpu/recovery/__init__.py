@@ -1,0 +1,36 @@
+"""Crash-restart resilience: cold-start reconstruction, drift repair, failover.
+
+The reference control plane survives process death because scheduler state
+is SOFT — informers relist (SURVEY §5 checkpoint/resume), the assume cache
+expires (pkg/scheduler/internal/cache), leader election hands over.  This
+tree carries hard device-adjacent state (DeviceSnapshot mirrors,
+AffinityIndex count tables, gang Permit holds, nominated reservations,
+half-applied controller plans) that a successor must REBUILD from the
+store, then prove equal to a from-scratch encode.
+
+Layout:
+  - drift.py    — canonical_state/diff oracle + DriftDetector (periodic and
+    on-recovery live-vs-from-scratch diff, repair on divergence,
+    scheduler_state_drift_total)
+  - rebuild.py  — cold_start: fresh-replica state reconstruction with
+    readiness gating (component_base.healthz.Readyz) and a post-rebuild
+    drift verification
+  - failover.py — two-replica leader-election soak killing the leader at
+    every registered crash point (chaos.faults.CRASH_POINTS) across a
+    pod/gang churn; deterministic-replay discipline like chaos/soak.py
+
+The kill switches live in chaos/faults.py (maybe_crash at the real call
+sites); this package is the recovery side.
+"""
+
+from .drift import DriftDetector, DriftReport, canonical_state, diff_canonical  # noqa: F401
+from .rebuild import RecoveryResult, cold_start  # noqa: F401
+
+__all__ = [
+    "DriftDetector",
+    "DriftReport",
+    "RecoveryResult",
+    "canonical_state",
+    "cold_start",
+    "diff_canonical",
+]

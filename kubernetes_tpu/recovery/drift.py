@@ -1,0 +1,321 @@
+"""State-drift detection and repair: live scheduler state vs a from-scratch
+store rebuild.
+
+The scheduler's device-adjacent state (cache NodeInfos, ClusterEncoder
+mirrors, AffinityIndex count tables) is derived incrementally from the
+watch stream; a missed event, an in-place corruption, or a recovery bug
+leaves it silently diverged from what a fresh replica would build.  The
+detector re-derives everything from the store (plus the live scheduler's
+own assumed pods — legitimate scheduler-local state a fresh build cannot
+know) into a scratch Cache/ClusterEncoder and diffs CANONICAL forms: keyed
+by node name / pod uid / affinity-term signature with dictionary ids and
+row numbers decoded away, so two encoders that interned strings or
+assigned rows in different orders still compare exactly — and any value
+difference is a real divergence, bit-for-bit at the canonical key.
+
+Repair = re-derive: reconcile the cache's bound-pod membership from store
+truth (assumes untouched), re-add every node, rebuild the snapshot, drop
+ghost encoder rows, full re-encode, and restore the affinity tables via
+the existing ``AffinityIndex.rebuild`` repair path.  Divergence counts
+emit ``scheduler_state_drift_total{component}`` BEFORE repair, so a soak
+asserting "zero unrepaired divergence" still sees every incident.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from ..state import units
+from ..state.cache import Cache, Snapshot
+from ..state.dictionary import MISSING
+from ..state.encoding import ClusterEncoder
+
+# canonical-state components, in report order
+COMPONENTS = ("cache_pods", "encoder_nodes", "encoder_pods", "affinity")
+
+
+def _canon_vec(vec: np.ndarray, extended_index: Dict[str, int]) -> tuple:
+    """i32[R] resource vector → (base-dim tuple, sorted nonzero extended
+    (name, value) pairs) — extended-dim SLOT order differs between encoders
+    that met extended resources in different orders."""
+    base = tuple(int(v) for v in vec[: units.NUM_BASE_DIMS])
+    ext = tuple(sorted(
+        (name, int(vec[idx]))
+        for name, idx in extended_index.items() if int(vec[idx]) != 0
+    ))
+    return (base, ext)
+
+
+def _canon_labels(enc: ClusterEncoder, keys: np.ndarray,
+                  vals: np.ndarray) -> tuple:
+    out = []
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        if k != MISSING:
+            out.append((enc.dic.string(k), enc.dic.string(v)))
+    return tuple(sorted(out))
+
+
+def canonical_state(scheduler) -> Dict[str, dict]:
+    """The live scheduler's rebuildable state in canonical form; also used
+    on scratch schedulers, so recovered-vs-from-scratch parity is one dict
+    comparison (tests/test_recovery.py pins it exactly).
+
+    Runs the scheduler's own steady-state snapshot refresh first (the same
+    ``update_snapshot`` → ``encoder.sync`` every dispatch runs): the
+    encoder is DELIBERATELY stale between a bind phase and the next
+    dispatch, and that staleness is pipeline slack, not drift."""
+    snapshot = getattr(scheduler, "snapshot", None)
+    if snapshot is not None:
+        changed = scheduler.cache.update_snapshot(snapshot)
+        scheduler.encoder.sync(snapshot, changed)
+    enc = scheduler.encoder
+    cache = scheduler.cache
+    nodes: Dict[str, tuple] = {}
+    for name, row in enc.node_rows.items():
+        if not bool(enc.node_valid[row]):
+            continue
+        taints = tuple(sorted(
+            (enc.dic.string(tk), enc.dic.string(tv), int(te))
+            for tk, tv, te in zip(enc.taint_keys[row].tolist(),
+                                  enc.taint_vals[row].tolist(),
+                                  enc.taint_effects[row].tolist())
+            if tk != MISSING
+        ))
+        nodes[name] = (
+            _canon_vec(enc.allocatable[row], enc.extended_index),
+            _canon_vec(enc.requested[row], enc.extended_index),
+            tuple(int(v) for v in enc.non_zero_requested[row]),
+            bool(enc.unschedulable[row]),
+            _canon_labels(enc, enc.node_label_keys[row],
+                          enc.node_label_vals[row]),
+            taints,
+        )
+    pods: Dict[str, tuple] = {}
+    row_name = enc.row_to_name()
+    for uid, row in enc.pod_rows.items():
+        if not bool(enc.pod_valid[row]):
+            continue
+        pods[uid] = (
+            row_name.get(int(enc.pod_node[row])),
+            _canon_vec(enc.pod_request[row], enc.extended_index),
+            int(enc.pod_priority[row]),
+            enc.dic.string(int(enc.pod_ns[row])),
+            _canon_labels(enc, enc.pod_label_keys[row],
+                          enc.pod_label_vals[row]),
+        )
+    aff: Dict[tuple, tuple] = {}
+    idx = enc.aff
+    for sig, row in idx._sig_row.items():
+        if idx._row_total[row] <= 0:
+            continue
+        slot = int(idx.aff_slot[row])
+        # invert the compact-domain map so counts key on label VALUES
+        inv = {i: v for v, i in enc.topo_value_maps[slot].items()}
+        counts = tuple(sorted(
+            (inv.get(d, f"#{d}"), float(c))
+            for d, c in enumerate(idx.aff_counts[row].tolist()) if c != 0.0
+        ))
+        # sig already carries (kind, weight, term signature) — pure strings
+        aff[sig] = counts
+    cache_pods = {
+        uid: st.pod.spec.node_name
+        for uid, st in cache._pod_states.items()
+    }
+    return {"cache_pods": cache_pods, "encoder_nodes": nodes,
+            "encoder_pods": pods, "affinity": aff}
+
+
+def diff_canonical(live: Dict[str, dict],
+                   scratch: Dict[str, dict]) -> Dict[str, int]:
+    """component → number of divergent keys (missing either side, or value
+    mismatch); empty dict == no drift."""
+    out: Dict[str, int] = {}
+    for comp in COMPONENTS:
+        a, b = live.get(comp, {}), scratch.get(comp, {})
+        n = sum(1 for k in set(a) | set(b) if a.get(k) != b.get(k))
+        if n:
+            out[comp] = n
+    return out
+
+
+@dataclass
+class DriftReport:
+    divergent: Dict[str, int] = field(default_factory=dict)  # pre-repair
+    unrepaired: Dict[str, int] = field(default_factory=dict)  # post-repair
+    repaired: bool = False  # a repair pass ran
+    # a small sample of divergent keys per component, for the log line
+    samples: Dict[str, List[str]] = field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return sum(self.divergent.values())
+
+    @property
+    def clean(self) -> bool:
+        return not self.divergent
+
+    @property
+    def converged(self) -> bool:
+        """No divergence survived (either none found, or repair erased it)."""
+        return not self.unrepaired
+
+
+def _scratch_build(store, assumed_pods) -> Tuple[Cache, Snapshot,
+                                                 ClusterEncoder]:
+    """From-scratch rebuild of cache + snapshot + encoder from the store,
+    overlaid with the live scheduler's assumed pods (copies carrying their
+    assumed node) — what a fresh replica plus the in-flight assumes would
+    build."""
+    cache = Cache()
+    nodes, _ = store.list("Node")
+    for n in nodes:
+        cache.add_node(n)
+    pods, _ = store.list("Pod")
+    seen = set()
+    for p in pods:
+        if p.spec.node_name:
+            cache.add_pod(p)
+            seen.add(p.uid)
+    for p in assumed_pods:
+        if p.uid not in seen and p.spec.node_name:
+            cache.add_pod(p)
+    snap = Snapshot()
+    changed = cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    enc.sync(snap, changed)
+    return cache, snap, enc
+
+
+class _ScratchView:
+    """Duck-typed scheduler facade so canonical_state serves both sides."""
+
+    def __init__(self, cache: Cache, encoder: ClusterEncoder):
+        self.cache = cache
+        self.encoder = encoder
+
+
+class DriftDetector:
+    """Periodic (and on-recovery) diff of the live scheduler state against a
+    from-scratch store rebuild, with repair on divergence.
+
+    Precondition: the scheduler must be QUIESCENT (no in-flight pipelined
+    batches) — ``check`` flushes the pipeline like the controller loops do
+    and returns None when it will not drain.  Gang Permit holds are fine:
+    their assumes overlay the scratch build.
+    """
+
+    def __init__(self, scheduler, min_interval: float = 0.0, clock=None):
+        self.scheduler = scheduler
+        self.min_interval = min_interval
+        self.clock = clock or getattr(scheduler, "clock", time.monotonic)
+        self._last_check = float("-inf")
+
+    def maybe_check(self, repair: bool = True) -> Optional[DriftReport]:
+        """Rate-limited entry for a controller-loop cadence."""
+        now = self.clock()
+        if now - self._last_check < self.min_interval:
+            return None
+        report = self.check_and_repair() if repair else self.check()
+        if report is not None:
+            self._last_check = now
+        return report
+
+    def _quiescent(self) -> bool:
+        for _ in range(4):
+            if not getattr(self.scheduler, "_inflight_q", None):
+                return True
+            self.scheduler.schedule_cycle()
+        return not getattr(self.scheduler, "_inflight_q", None)
+
+    def _diff_now(self) -> Dict[str, int]:
+        sched = self.scheduler
+        assumed = [sched.cache._pod_states[uid].pod
+                   for uid in sched.cache._assumed_pods
+                   if uid in sched.cache._pod_states]
+        cache, _snap, enc = _scratch_build(sched.store, assumed)
+        live = canonical_state(sched)
+        scratch = canonical_state(_ScratchView(cache, enc))
+        return diff_canonical(live, scratch)
+
+    def check(self) -> Optional[DriftReport]:
+        """Detect only; None when the pipeline will not drain."""
+        if not self._quiescent():
+            return None
+        divergent = self._diff_now()
+        for comp, n in divergent.items():
+            m.state_drift.inc((comp,), by=n)
+        if divergent:
+            klog.V(1).info_s("Scheduler state drift detected",
+                             components=dict(divergent))
+        return DriftReport(divergent=divergent, unrepaired=dict(divergent))
+
+    def check_and_repair(self) -> Optional[DriftReport]:
+        report = self.check()
+        if report is None or report.clean:
+            return report
+        self.repair()
+        report.repaired = True
+        report.unrepaired = self._diff_now()
+        if report.unrepaired:
+            klog.error_s(None, "Scheduler state drift SURVIVED repair",
+                         components=dict(report.unrepaired))
+        else:
+            klog.V(1).info_s("Scheduler state drift repaired",
+                             components=dict(report.divergent))
+        return report
+
+    def repair(self) -> None:
+        """Re-derive the live scheduler's rebuildable state from the store.
+
+        Assumed pods are preserved untouched (they are truth the store does
+        not know yet); everything else — cache bound-pod membership, node
+        objects, encoder rows, affinity tables — is rebuilt from a relist,
+        the same path cold_start takes."""
+        sched = self.scheduler
+        cache = sched.cache
+        store_pods = {p.uid: p for p in sched.store.list("Pod")[0]
+                      if p.spec.node_name}
+        # bound-pod membership: drop cached pods the store no longer has
+        # (assumes excluded), adopt store pods the cache missed or misplaced
+        for uid in list(cache._pod_states):
+            if uid in cache._assumed_pods:
+                continue
+            if uid not in store_pods:
+                cache.remove_pod(cache._pod_states[uid].pod)
+        for uid, p in store_pods.items():
+            st = cache._pod_states.get(uid)
+            if st is None:
+                cache.add_pod(p)
+            elif uid not in cache._assumed_pods and \
+                    st.pod.spec.node_name != p.spec.node_name:
+                cache.update_pod(st.pod, p)
+        # nodes: re-add every store node (bumps generations → full
+        # re-encode below), drop cache nodes the store no longer has
+        store_nodes = {n.metadata.name: n for n in sched.store.list("Node")[0]}
+        for n in store_nodes.values():
+            cache.add_node(n)
+        for name in list(cache._nodes):
+            if name not in store_nodes:
+                cache.remove_node(name)
+        # fresh snapshot + full re-encode; ghost encoder rows dropped first
+        sched.snapshot = Snapshot()
+        changed = cache.update_snapshot(sched.snapshot)
+        enc = sched.encoder
+        for name in list(enc.node_rows):
+            if name not in sched.snapshot.node_info_map:
+                enc.remove_node(name)
+        live_uids = {pi.pod.uid
+                     for info in sched.snapshot.node_info_list
+                     for pi in info.pods}
+        for uid in list(enc.pod_rows):
+            if uid not in live_uids:
+                enc._remove_pod_row(uid)
+        enc.sync(sched.snapshot, changed)
+        # affinity tables through the existing repair path (parity oracle)
+        enc.aff.rebuild(sched.snapshot)

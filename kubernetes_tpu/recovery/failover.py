@@ -1,0 +1,484 @@
+"""Failover soak: two scheduler replicas under leader election, the leader
+killed at EVERY registered crash point in turn across a pod/gang churn.
+
+The chaos soak (chaos/soak.py) proves convergence when the control plane is
+faulty but the scheduler process survives; this soak kills the process —
+at the exact states the kill-point catalog marks (chaos/faults.py
+CRASH_POINTS) — and proves the successor reconstructs and converges:
+
+  - every pod binds exactly once PER INCARNATION (a descheduler-evicted
+    pod's harness-created replacement is a new incarnation, like a
+    ReplicaSet's replacement — no pod is ever double-bound without an
+    intervening delete);
+  - gangs stay all-or-nothing end to end (a crash while members hold
+    Permit leaves ZERO store binds; a crash mid-flush completes on the
+    successor — never a lingering half-bound gang);
+  - recovery is bounded (lease expiry + cold-start, measured in driver
+    iterations on the injected clock);
+  - the drift detector reports zero unrepaired divergence after every
+    recovery and on a periodic cadence;
+  - deterministic replay: the same seed kills at the same per-point hit
+    sequence and converges to the same signature (chaos/faults.py
+    determinism contract — crash decisions ride the same per-key op
+    counters as every other fault class).
+
+Single-threaded by design, on one injected clock: lease expiry, pod
+backoff, and gang deadlines all advance deterministically with the driver
+loop, never with the wall clock.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..chaos.faults import FaultSchedule, ProcessCrash, crash_schedule
+from ..chaos.retry import RetryingStore
+from ..client.leaderelection import LeaderElector, LeaseLock
+from ..component_base import logging as klog
+from ..component_base.healthz import Readyz
+from ..descheduler.policies import DRAIN_ANNOTATION
+from ..sim.store import DELETED, ObjectStore
+from .drift import DriftDetector
+from .rebuild import cold_start
+
+LEASE_NS, LEASE_NAME = "kube-system", "tpu-scheduler"
+SOAK_LABEL = "failover-soak/workload"
+
+# The kill order is part of the soak's contract: each point is armed only
+# when its trigger still has supply (gangs pending before permit_held,
+# overflow demand before mid_scaleup, a drain annotation before
+# mid_plan_apply), so "killed at every registered crash point" is a real
+# guarantee, not best-effort.
+KILL_ORDER = (
+    "crash.permit_held",
+    "crash.after_assume",
+    "crash.mid_bind",
+    "crash.mid_scaleup",
+    "crash.mid_plan_apply",
+    "crash.post_lease_renew",
+)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclass
+class FailoverResult:
+    pods: int  # live pods at the end (originals + replacements - evicted)
+    bound: int
+    unbound: List[str]
+    duplicate_binds: int  # bind transitions beyond one per incarnation
+    crashes: List[str]  # points fired, in firing order
+    recoveries: int
+    max_recovery_iterations: int  # worst crash → leader-ready gap
+    gangs_partial: List[str]  # gangs not all-or-nothing at the end
+    drift_divergent: int  # divergence incidents (pre-repair) across the run
+    drift_unrepaired: int  # divergence surviving repair (must be 0)
+    events_lost: int  # final leader's flush losses
+    injected: Dict[str, int]
+    store_rv: int
+    iterations: int
+    wall_seconds: float
+
+    @property
+    def converged(self) -> bool:
+        return (self.bound == self.pods and not self.unbound
+                and self.duplicate_binds == 0 and not self.gangs_partial
+                and self.drift_unrepaired == 0)
+
+    def determinism_signature(self) -> Dict[str, object]:
+        """The replay-stable part of a run: fault+crash decisions, the op
+        count they produced, and the converged shape.  Wall time excluded."""
+        return {
+            "injected": dict(self.injected),
+            "crashes": list(self.crashes),
+            "bound": self.bound,
+            "store_rv": self.store_rv,
+            "iterations": self.iterations,
+        }
+
+
+class _Replica:
+    """One simulated scheduler process: elector + (lazily built) scheduler
+    and controllers.  A crash discards the whole object; a restart is a NEW
+    _Replica with a fresh identity generation — the lease held by the dead
+    identity must expire before anyone (including the restart) leads."""
+
+    def __init__(self, soak: "_Soak", identity: str):
+        self.soak = soak
+        self.identity = identity
+        self.readyz = Readyz()
+        self.sched = None
+        self.autoscaler = None
+        self.desched = None
+        self.drift: Optional[DriftDetector] = None
+        self.elector = LeaderElector(
+            LeaseLock(soak.store, LEASE_NS, LEASE_NAME),
+            identity=identity,
+            lease_duration=soak.lease_duration,
+            clock=soak.clock,
+            on_stopped_leading=self._on_stopped_leading,
+        )
+
+    def _on_stopped_leading(self):
+        # upstream exits the scheduler binary on a lost lease
+        # (cmd/kube-scheduler server.go:204-215); the sim analog: abandon
+        # mid-cycle work, shut down cleanly, and rebuild state from the
+        # store if leadership ever comes back
+        if self.sched is not None:
+            self.sched.abandon_inflight()
+            self.sched.close()  # clean shutdown: events flush
+            self.sched = None
+            self.autoscaler = self.desched = self.drift = None
+
+
+class _Soak:
+    def __init__(self, *, seed: int, n_nodes: int, batch_size: int,
+                 lease_duration: float, tick: float,
+                 write_429_rate: float, conflict_rate: float,
+                 drift_every: int, max_iterations: int):
+        self.fault = FaultSchedule(
+            seed, write_429_rate=write_429_rate, conflict_rate=conflict_rate,
+            retry_after=0.0,
+        )
+        self.raw = ObjectStore(fault_injector=self.fault)
+        self.store = RetryingStore(self.raw, jitter_seed=seed,
+                                   sleep=lambda _s: None)
+        self.clock = _FakeClock()
+        self.batch_size = batch_size
+        self.n_nodes = n_nodes
+        self.lease_duration = lease_duration
+        self.tick = tick
+        self.drift_every = drift_every
+        self.max_iterations = max_iterations
+        self.iteration = 0
+        self.crashes: List[str] = []
+        self.recoveries = 0
+        self.max_recovery_iterations = 0
+        self._crash_iter: Optional[int] = None
+        self.drift_divergent = 0
+        self.drift_unrepaired = 0
+        self.run_controllers = False
+        self._gen = 0
+        self._log_pos = 0  # raw._log read cursor (replacement recreation)
+        self._replaced: Counter = Counter()
+        self.replicas = [self._spawn("a"), self._spawn("b")]
+
+    # --- replica lifecycle ----------------------------------------------------
+
+    def _spawn(self, base: str) -> _Replica:
+        self._gen += 1
+        return _Replica(self, f"sched-{base}#{self._gen}")
+
+    def _sched_factory(self, store, **kw):
+        from ..scheduler import TPUScheduler
+
+        s = TPUScheduler(store, clock=self.clock, **kw)
+        # headroom for autoscaled nodes + replacement pods: tier growth
+        # mid-run would recompile every program per recovery epoch
+        s.presize(4 * self.n_nodes, 512)
+        return s
+
+    def _ensure_leader_state(self, rep: _Replica) -> None:
+        if rep.sched is not None:
+            return
+        res = cold_start(
+            self.store, readyz=rep.readyz, clock=self.clock,
+            scheduler_factory=self._sched_factory,
+            batch_size=self.batch_size,
+            pod_initial_backoff=0.05, pod_max_backoff=0.2, batch_wait=0,
+            fence=rep.elector.check_fence,
+        )
+        rep.sched = res.scheduler
+        if res.drift is not None:
+            self.drift_divergent += res.drift.total
+            self.drift_unrepaired += sum(res.drift.unrepaired.values())
+        from ..autoscaler.controller import ClusterAutoscaler
+        from ..descheduler.controller import DeschedulerController
+
+        rep.autoscaler = ClusterAutoscaler(
+            self.store, rep.sched, clock=self.clock,
+            scale_down_utilization_threshold=0.0)  # soak never shrinks
+        rep.desched = DeschedulerController(self.store, rep.sched,
+                                            clock=self.clock)
+        rep.drift = DriftDetector(rep.sched, clock=self.clock)
+        self.recoveries += 1
+        if self._crash_iter is not None:
+            self.max_recovery_iterations = max(
+                self.max_recovery_iterations,
+                self.iteration - self._crash_iter)
+            self._crash_iter = None
+
+    def _kill(self, rep: _Replica, crash: ProcessCrash) -> None:
+        self.crashes.append(crash.point)
+        self._crash_iter = self.iteration
+        sched, rep.sched = rep.sched, None
+        if sched is not None:
+            # process death: the watch detaches, NOTHING flushes — retained
+            # events and every in-memory table die with the process
+            sched.close(flush_events=False)
+        idx = self.replicas.index(rep)
+        base = "a" if idx == 0 else "b"
+        self.replicas[idx] = self._spawn(base)
+        klog.V(1).info_s("Replica killed", point=crash.point,
+                         identity=rep.identity, iteration=self.iteration)
+
+    # --- driver ---------------------------------------------------------------
+
+    def leader(self) -> Optional[_Replica]:
+        for rep in self.replicas:
+            if rep.elector.is_leader():
+                return rep
+        return None
+
+    def step(self) -> None:
+        self.iteration += 1
+        for rep in list(self.replicas):
+            try:
+                rep.elector.try_acquire_or_renew()
+            except ProcessCrash as crash:
+                self._kill(rep, crash)
+        rep = self.leader()
+        if rep is not None:
+            try:
+                self._ensure_leader_state(rep)
+                rep.sched.schedule_cycle()
+                if self.run_controllers:
+                    rep.autoscaler.sync_once()
+                    rep.desched.sync_once()
+                if self.drift_every and \
+                        self.iteration % self.drift_every == 0:
+                    report = rep.drift.check_and_repair()
+                    if report is not None:
+                        self.drift_divergent += report.total
+                        self.drift_unrepaired += sum(
+                            report.unrepaired.values())
+                if self.iteration % 20 == 0:
+                    # unschedulableQ parks otherwise wait the 60s flush;
+                    # fixed cadence keeps the re-drive deterministic
+                    unbound = [p for p in self.raw.list("Pod")[0]
+                               if not p.spec.node_name]
+                    if unbound:
+                        rep.sched.queue.activate(unbound)
+            except ProcessCrash as crash:
+                self._kill(rep, crash)
+        self.clock.advance(self.tick)
+        self._recreate_evicted()
+
+    def _recreate_evicted(self) -> None:
+        """ReplicaSet stand-in: every DELETED workload pod gets exactly one
+        replacement incarnation (same spec + labels, deterministic name) so
+        descheduler/autoscaler evictions don't shrink the workload and the
+        exactly-once-per-incarnation accounting stays meaningful."""
+        log = self.raw._log
+        while self._log_pos < len(log):
+            ev = log[self._log_pos]
+            self._log_pos += 1
+            if ev.type != DELETED or ev.kind != "Pod":
+                continue
+            pod = ev.obj
+            if pod.metadata.labels.get(SOAK_LABEL) != "true":
+                continue
+            self._replaced[pod.metadata.name] += 1
+            clone = copy.deepcopy(pod)
+            clone.metadata.name = f"{pod.metadata.name}-r{self._replaced[pod.metadata.name]}"
+            clone.metadata.uid = clone.metadata.name
+            clone.metadata.resource_version = None
+            clone.spec.node_name = ""
+            clone.status.nominated_node_name = None
+            self.store.create("Pod", clone)
+
+    def run_until(self, pred, cap: int) -> bool:
+        """Drive steps until ``pred()`` or the per-phase cap; False = cap."""
+        for _ in range(cap):
+            if pred():
+                return True
+            if self.iteration >= self.max_iterations:
+                return False
+            self.step()
+        return pred()
+
+
+def run_failover_soak(
+    n_plain: int = 16,
+    n_gangs: int = 2,
+    gang_size: int = 4,
+    overflow_gang_size: int = 6,
+    n_nodes: int = 8,
+    seed: int = 7,
+    batch_size: int = 8,
+    *,
+    group_max_size: int = 8,
+    kill_order=KILL_ORDER,
+    lease_duration: float = 0.6,
+    tick: float = 0.05,
+    write_429_rate: float = 0.02,
+    conflict_rate: float = 0.02,
+    drift_every: int = 40,
+    phase_cap: int = 400,
+    max_iterations: int = 6000,
+) -> FailoverResult:
+    """The failover acceptance workload.  Per phase: create that kill
+    point's trigger supply, arm the point, run until it fires, run until a
+    successor is leader + Ready, then move on; finally converge everything.
+    Defaults are the fast battery's size — tests/test_recovery.py's slow
+    marker scales it to the 500-pod acceptance shape."""
+    from ..api import objects as v1
+    from ..gang import POD_GROUP_LABEL
+    from ..testutil import make_node, make_pod
+
+    t0 = time.monotonic()
+    soak = _Soak(seed=seed, n_nodes=n_nodes, batch_size=batch_size,
+                 lease_duration=lease_duration, tick=tick,
+                 write_429_rate=write_429_rate, conflict_rate=conflict_rate,
+                 drift_every=drift_every, max_iterations=max_iterations)
+    store, raw, fault = soak.store, soak.raw, soak.fault
+
+    for i in range(n_nodes):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "pods": "32"}).obj())
+    # the scale-up phase's capacity: one NodeGroup with headroom
+    from ..autoscaler.api import NodeGroup
+
+    group = NodeGroup(
+        metadata=v1.ObjectMeta(name="pool"),
+        min_size=0, max_size=group_max_size,
+        capacity={"cpu": "4", "pods": "32"},
+        cost_per_node=1.0,
+    )
+    store.create("NodeGroup", group)
+
+    def mk_pod(name: str, cpu: str, labels: Dict[str, str]):
+        b = (make_pod().name(name).uid(name).namespace("default")
+             .req({"cpu": cpu}).label(SOAK_LABEL, "true"))
+        for k, v in labels.items():
+            b = b.label(k, v)
+        store.create("Pod", b.obj())
+
+    def mk_gang(gname: str, size: int, cpu: str, timeout: float = 5.0):
+        store.create("PodGroup", v1.PodGroup(
+            metadata=v1.ObjectMeta(name=gname, namespace="default"),
+            min_member=size, schedule_timeout_seconds=timeout))
+        for i in range(size):
+            mk_pod(f"{gname}-{i}", cpu, {POD_GROUP_LABEL: gname})
+
+    def crashed(point):
+        return lambda: f"crash:{point}" in fault.injected
+
+    def leader_ready():
+        rep = soak.leader()
+        return (rep is not None and rep.sched is not None
+                and rep.readyz.ready)
+
+    with crash_schedule(fault):
+        for point in kill_order:
+            # phase stimuli: keep the point's trigger supplied
+            if point == "crash.permit_held":
+                for g in range(n_gangs):
+                    mk_gang(f"gang{g}", gang_size, "1")
+            elif point == "crash.after_assume":
+                for i in range(n_plain // 2):
+                    mk_pod(f"plain-a{i}", "1", {})
+            elif point == "crash.mid_bind":
+                for i in range(n_plain - n_plain // 2):
+                    mk_pod(f"plain-b{i}", "1", {})
+            elif point == "crash.mid_scaleup":
+                # overflow gang: cannot fully place on current capacity —
+                # parks unschedulable, the autoscaler must scale up
+                mk_gang("overflow", overflow_gang_size, "3")
+                soak.run_controllers = True
+            elif point == "crash.mid_plan_apply":
+                node = raw.get("Node", "", "n0")
+                node.metadata.annotations[DRAIN_ANNOTATION] = "true"
+                store.update("Node", node)
+            fault.arm_crash(point, at_hit=2 if point == "crash.mid_bind"
+                            else 1)
+            fired = soak.run_until(crashed(point), phase_cap)
+            if not fired:
+                klog.error_s(None, "Failover soak: crash point never fired",
+                             point=point, iteration=soak.iteration)
+                break
+            soak.run_until(leader_ready, phase_cap)
+        # convergence: controllers keep running (the drain must finish its
+        # re-plans); stop only when every live pod is bound
+        def all_bound():
+            pods, _ = raw.list("Pod")
+            return bool(pods) and all(p.spec.node_name for p in pods)
+
+        soak.run_until(all_bound, max_iterations)
+
+    # --- final accounting -----------------------------------------------------
+    pods, _ = raw.list("Pod")
+    bound = sum(1 for p in pods if p.spec.node_name)
+    unbound = [p.metadata.name for p in pods if not p.spec.node_name]
+    # exactly-once per INCARNATION, from the store's own event history:
+    # count unbound→bound transitions keyed by (name, incarnation), where
+    # a DELETE closes the incarnation — so a deleted-then-recreated name
+    # (legitimate churn) is two incarnations with one bind each, while a
+    # second bind or a node change within one incarnation is a duplicate
+    node_of: Dict[str, Optional[str]] = {}
+    incarnation: Counter = Counter()
+    binds: Counter = Counter()
+    duplicates = 0
+    for ev in raw._log:
+        if ev.kind != "Pod":
+            continue
+        name = ev.obj.metadata.name
+        if ev.type == DELETED:
+            node_of.pop(name, None)
+            incarnation[name] += 1
+            continue
+        nn = ev.obj.spec.node_name or None
+        prev = node_of.get(name)
+        if nn is not None and prev is None:
+            binds[(name, incarnation[name])] += 1
+        elif nn is not None and prev is not None and nn != prev:
+            duplicates += 1  # re-bound to a different node without delete
+        node_of[name] = nn
+    duplicates += sum(c - 1 for c in binds.values() if c > 1)
+    # gang all-or-nothing at the end: every group fully bound or fully not
+    partial: List[str] = []
+    for pg in raw.list("PodGroup")[0]:
+        members = [p for p in pods
+                   if p.metadata.labels.get(POD_GROUP_LABEL) == pg.name
+                   and p.namespace == pg.namespace]
+        n_bound = sum(1 for p in members if p.spec.node_name)
+        if 0 < n_bound < pg.min_member:
+            partial.append(pg.key())
+    events_lost = 0
+    for r in soak.replicas:
+        if r.sched is not None:
+            events_lost += r.sched.recorder.flush()
+            r.sched.close()
+    result = FailoverResult(
+        pods=len(pods), bound=bound, unbound=unbound,
+        duplicate_binds=duplicates, crashes=list(soak.crashes),
+        recoveries=soak.recoveries,
+        max_recovery_iterations=soak.max_recovery_iterations,
+        gangs_partial=partial,
+        drift_divergent=soak.drift_divergent,
+        drift_unrepaired=soak.drift_unrepaired,
+        events_lost=events_lost,
+        injected=fault.injected_counts(),
+        store_rv=raw.current_rv(),
+        iterations=soak.iteration,
+        wall_seconds=time.monotonic() - t0,
+    )
+    klog.V(1).info_s(
+        "Failover soak complete", pods=result.pods, bound=result.bound,
+        crashes=result.crashes, recoveries=result.recoveries,
+        max_recovery_iterations=result.max_recovery_iterations,
+        duplicates=result.duplicate_binds, iterations=result.iterations)
+    return result

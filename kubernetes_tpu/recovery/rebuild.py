@@ -1,0 +1,173 @@
+"""Cold-start state reconstruction: a fresh scheduler replica rebuilds every
+piece of in-memory state from the store, then proves it.
+
+What upstream gets for free (SURVEY §5: the informer ListAndWatch restart
+loop is a checkpoint/resume; the scheduler cache is rebuilt by replay and
+assume-cache entries simply expire), this tree must do explicitly because
+it carries hard state:
+
+  - ClusterEncoder/DeviceSnapshot mirrors: rebuilt from a store relist by
+    replaying every bound pod through ``Cache.update_snapshot`` →
+    ``encoder.sync`` (the exact steady-state path, so recovered ==
+    from-scratch bit-for-bit at the canonical keys);
+  - AffinityIndex count tables: restored via the existing ``rebuild()``
+    repair path;
+  - gang phase/permit state: re-derived from PodGroup objects + live
+    membership.  A dead leader's Permit holds are pure memory — no waiter
+    was ever bound in the store — so the holds "expire" instantly into an
+    atomic gang requeue (the unbound members re-enter the queue whole);
+    never a half-bound gang.  Phases that claim more than the store shows
+    are rewritten;
+  - nominated-preemption reservations: STALE by definition (the evictions
+    already happened; the dead process's claim map is gone) — cleared from
+    pod status so the preemptor re-runs a clean attempt;
+  - half-applied descheduler/autoscaler plans: fail-stop by design — the
+    controllers re-plan from live state every sync, and scale-ups resume
+    exactly-once through deterministic node names (autoscaler/api.py).
+    Recovery constructs fresh controllers and touches nothing.
+
+Readiness: progress lands in a ``component_base.healthz.Readyz`` so a
+recovering replica reports NotReady (with per-component rebuild progress)
+until the final drift verification passes — it never takes traffic
+mid-rebuild.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..api import objects as v1
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from .drift import DriftDetector, DriftReport
+
+# Readyz component names, in rebuild order
+READYZ_COMPONENTS = ("relist", "replay", "encode", "gangs", "nominations",
+                     "verify")
+
+
+@dataclass
+class RecoveryResult:
+    scheduler: object
+    nodes: int = 0
+    bound_pods: int = 0
+    pending_pods: int = 0
+    gang_phase_repairs: int = 0
+    nominations_dropped: int = 0
+    drift: Optional[DriftReport] = None
+    outcome: str = "clean"  # clean | repaired | degraded
+    seconds: float = 0.0
+    # gangs whose store state was partially bound at recovery time (bound
+    # members exist but below minMember) — they must complete, not unwind
+    partial_gangs: List[str] = field(default_factory=list)
+
+
+def cold_start(store, *, readyz=None, clock=time.monotonic,
+               scheduler_factory=None, verify=True,
+               **sched_kwargs) -> RecoveryResult:
+    """Build a scheduler replica from nothing but the store and prove its
+    state.  ``sched_kwargs`` pass through to the scheduler constructor
+    (batch_size, fence, clock, ...); ``scheduler_factory`` overrides the
+    class for tests.  ``verify=False`` skips the final drift check (the
+    failover soak runs its own periodic detector)."""
+    from ..scheduler import TPUScheduler
+
+    factory = scheduler_factory or TPUScheduler
+    t0 = clock()
+    if readyz is not None:
+        # one atomic assignment: no scrape can see the empty-(=ready)
+        # window a reset-then-begin sequence would open
+        readyz.begin_all(READYZ_COMPONENTS)
+    # 1. relist: the authoritative recount (the constructor's watch replay
+    # below is the informer path; the relist pins the counts the report
+    # and the gang/nomination passes work from)
+    nodes, _ = store.list("Node")
+    pods, _ = store.list("Pod")
+    pgs, _ = store.list("PodGroup")
+    bound = [p for p in pods if p.spec.node_name]
+    pending = [p for p in pods if not p.spec.node_name]
+    if readyz is not None:
+        readyz.complete("relist")
+    # 2. replay: constructing the scheduler replays the store's history
+    # through the watch hook — bound pods land in the cache, pending pods
+    # in the queue, PodGroups in the gang directory (ListAndWatch resume)
+    sched = factory(store, **sched_kwargs)
+    if readyz is not None:
+        readyz.complete("replay")
+    # 3. encode: bound pods through Cache.update_snapshot → encoder.sync
+    # (the steady-state path), then the AffinityIndex repair rebuild
+    changed = sched.cache.update_snapshot(sched.snapshot)
+    sched.encoder.sync(sched.snapshot, changed)
+    sched.encoder.aff.rebuild(sched.snapshot)
+    if readyz is not None:
+        readyz.complete("encode")
+    # 4. gangs: re-derive phase from live membership; a fresh process holds
+    # no permits, so phases claiming otherwise are rewritten and the
+    # unbound members (already queued by replay) retry as one gang
+    repairs = 0
+    partial: List[str] = []
+    for i, pg in enumerate(pgs):
+        key = pg.key()
+        g = sched.gangs._state(key)
+        n_bound = len(g.bound)
+        if n_bound >= pg.min_member:
+            phase = v1.POD_GROUP_SCHEDULED
+        elif n_bound > 0:
+            # partially bound in the STORE (a crash mid-flush): the gang
+            # must complete — members already bound stay, the rest
+            # reschedule; phase goes back to Scheduling
+            phase = v1.POD_GROUP_SCHEDULING
+            partial.append(key)
+        else:
+            phase = v1.POD_GROUP_PENDING
+        if pg.phase != phase:
+            repairs += 1
+            sched.gangs._set_phase(g, phase)
+        if readyz is not None:
+            readyz.progress("gangs", i + 1, len(pgs) or 1)
+    if readyz is not None:
+        readyz.complete("gangs")
+    # 5. nominations: the dead process's claim map is gone and its victims
+    # were already evicted — a stale nominatedNodeName would make the
+    # successor reserve capacity for a claim nobody holds
+    dropped = 0
+    for p in pending:
+        if getattr(p.status, "nominated_node_name", None):
+            p.status.nominated_node_name = None
+            dropped += 1
+            try:
+                store.update("Pod", p)
+            except Exception as e:
+                # best-effort: a failed clear leaves only a cosmetic field
+                # (this replica's nominator starts empty regardless)
+                klog.V(2).info_s("stale nomination clear failed",
+                                 pod=p.key(),
+                                 error=f"{type(e).__name__}: {e}")
+    if readyz is not None:
+        readyz.complete("nominations")
+    # 6. verify: the rebuilt state must equal a from-scratch store encode;
+    # divergence here means the rebuild itself is wrong — repair and stay
+    # NotReady if it survives
+    drift = None
+    outcome = "clean"
+    if verify:
+        drift = DriftDetector(sched).check_and_repair()
+        if drift is not None and not drift.clean:
+            outcome = "repaired" if drift.converged else "degraded"
+    m.cold_starts.inc((outcome,))
+    if readyz is not None and outcome != "degraded":
+        readyz.complete("verify")
+    # a degraded replica keeps "verify" incomplete: /readyz stays NotReady
+    seconds = clock() - t0
+    klog.V(1).info_s(
+        "Cold-start reconstruction complete", outcome=outcome,
+        nodes=len(nodes), bound=len(bound), pending=len(pending),
+        gang_phase_repairs=repairs, nominations_dropped=dropped,
+        seconds=round(seconds, 4))
+    return RecoveryResult(
+        scheduler=sched, nodes=len(nodes), bound_pods=len(bound),
+        pending_pods=len(pending), gang_phase_repairs=repairs,
+        nominations_dropped=dropped, drift=drift, outcome=outcome,
+        seconds=seconds, partial_gangs=partial)

@@ -280,6 +280,7 @@ class TPUScheduler:
         pipeline_depth: int = 3,
         nominated_fast_bind: bool = True,
         chain_affinity: object = "auto",
+        fence=None,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -403,6 +404,12 @@ class TPUScheduler:
         # attempt (see _try_nominated_fast_bind); off = always nominate and
         # requeue, the pre-round-5 cadence
         self.nominated_fast_bind = nominated_fast_bind
+        # fencing predicate consulted immediately before every store bind
+        # write (LeaderElector.check_fence under leader election): False
+        # refuses the bind and rolls the cycle back — a replica whose lease
+        # moved on can no longer race the new leader's binding cycles.
+        # None (the default, single-replica deployments) costs nothing.
+        self.fence = fence
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
@@ -1235,6 +1242,12 @@ class TPUScheduler:
                 fl.node_names[i] = name
                 self._nominated.pop(qi.pod.uid, None)
                 self.cache.assume_pod(qi.pod, name)
+        # kill-point: the whole batch is assumed in the cache, nothing is
+        # bound in the store — process death here loses every assume (soft
+        # state); recovery must reschedule the batch from the store's truth
+        from .chaos.faults import maybe_crash
+
+        maybe_crash("crash.after_assume")
         return node_row
 
     def _bind_phase(self, fl: _InFlight, node_row: np.ndarray) -> CycleStats:
@@ -1961,14 +1974,63 @@ class TPUScheduler:
                 max_workers=16, thread_name_prefix="extender-callout")
         return pool
 
-    def close(self) -> None:
+    def _fence_ok(self) -> bool:
+        """Evaluate the bind fence; an unprovable fence (predicate raised)
+        is a failed fence, mirroring LeaderElector's release-on-doubt."""
+        try:
+            return bool(self.fence())
+        except Exception as e:
+            klog.V(1).info_s("Bind fence predicate failed; treating as "
+                             "fenced out", error=f"{type(e).__name__}: {e}")
+            return False
+
+    def abandon_inflight(self) -> None:
+        """Outgoing-leader stop-work hook (wire as the elector's
+        ``on_stopped_leading``): a replica that lost its lease mid-cycle
+        must not carry dispatched-but-unbound work into a window where a
+        new leader schedules the same pods.  Drops every in-flight batch
+        (pods requeue through the failure handler; their device decisions
+        are never fetched), rolls back binding cycles held open at Permit
+        (the gang group-failure hook requeues whole gangs atomically), and
+        clears cross-cycle nominated reservations — the new leader
+        re-derives its own.  The bind-time fence (``fence``) covers the
+        race this hook cannot: work already past Permit when the lease was
+        lost."""
+        inflight, self._inflight_q = self._inflight_q, []
+        for fl in inflight:
+            if fl.fetch_thread is not None:
+                fl.fetch_thread.join()  # let the bg fetch land before discard
+            for qi in fl.infos:
+                self._requeue_after_failure(qi)
+        if inflight:
+            m.scheduler_retries.inc(
+                ("leadership_lost",),
+                by=sum(len(fl.infos) for fl in inflight))
+        for uid in list(self._waiting_binds):
+            wb = self._waiting_binds.get(uid)
+            self._cancel_waiting_bind(uid)
+            if wb is not None:
+                self._requeue_after_failure(wb.qi)
+        self._nominated.clear()
+        self._fastbound_noms.clear()
+        klog.V(1).info_s("Leadership lost; in-flight scheduling work "
+                         "abandoned", batches=len(inflight))
+
+    def close(self, flush_events: bool = True) -> None:
         """Release long-lived resources: the store watch and the persistent
         extender-callout pool (its 16 workers otherwise live to interpreter
         exit — processes that build many schedulers, e.g. the perf harness
-        or the chaos soak, must not accumulate them).  Idempotent."""
+        or the chaos soak, must not accumulate them).  Flushes the event
+        recorder's retained failed writes (client/events.py) so a CLEAN
+        shutdown bounds event loss; ``flush_events=False`` is the simulated
+        process DEATH form (recovery/failover) — a dead process writes
+        nothing, its retained events are simply lost.  Idempotent."""
         unwatch, self._unwatch = getattr(self, "_unwatch", None), None
         if unwatch is not None:
             unwatch()
+        recorder = getattr(self, "recorder", None)
+        if recorder is not None and flush_events:
+            recorder.flush()
         pool, self._ext_pool_obj = getattr(self, "_ext_pool_obj", None), None
         if pool is not None:
             pool.shutdown(wait=False)
@@ -2052,6 +2114,17 @@ class TPUScheduler:
             if status is not None and not status.is_success():
                 rollback()
                 return False
+        if self.fence is not None and not self._fence_ok():
+            # fencing token moved on (leadership lost/stolen since this
+            # cycle dispatched): refuse the shared-state write.  Transient
+            # semantics on purpose — the pod requeues to backoff, and only
+            # a replica that actually holds the lease will retry the bind.
+            m.scheduler_retries.inc(("fence_reject",))
+            klog.V(1).info_s("Bind refused by leadership fence",
+                             pod=pod.key(), node=node_name)
+            rollback()
+            raise _TransientBindError("fencing check failed: not the "
+                                      "current leader")
         try:
             ok = self.store.bind_pod(pod.namespace, pod.metadata.name,
                                      node_name)
@@ -2072,6 +2145,13 @@ class TPUScheduler:
             # else VolumeBinding assume-state leaks (scheduler.go:676-689)
             rollback()
             return False
+        # kill-point: the store bind LANDED but every in-memory consequence
+        # (finish_binding TTL, gang on_bound, events, queue bookkeeping) is
+        # lost — the nastiest restart state: recovery must treat the pod as
+        # bound (store truth) and never bind it again
+        from .chaos.faults import maybe_crash
+
+        maybe_crash("crash.mid_bind")
         for pw in fw.post_bind_plugins:
             pw.plugin.post_bind(None, pod, node_name)
         return True

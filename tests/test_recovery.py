@@ -1,0 +1,818 @@
+"""Crash-restart resilience: deterministic kill-points, cold-start state
+reconstruction, drift detection/repair, leader failover with exactly-once
+binding.
+
+Reference behaviors exercised: the informer ListAndWatch restart as
+checkpoint/resume (SURVEY §5), the scheduler assume-cache's
+soft-state-rebuild property (pkg/scheduler/internal/cache), leader-election
+handover with fencing (client-go tools/leaderelection + the classic
+fencing-token construction), and kube-scheduler's exit-on-lost-lease
+(cmd/kube-scheduler app/server.go:204-215).
+"""
+
+import traceback
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.chaos import (
+    CRASH_POINTS,
+    FaultSchedule,
+    ProcessCrash,
+    crash_schedule,
+    maybe_crash,
+    steal_lease,
+)
+from kubernetes_tpu.client.events import RETAIN_CAP, EventRecorder
+from kubernetes_tpu.client.leaderelection import LeaderElector, LeaseLock
+from kubernetes_tpu.component_base.healthz import Readyz
+from kubernetes_tpu.gang import POD_GROUP_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.recovery import (
+    DriftDetector,
+    canonical_state,
+    cold_start,
+    diff_canonical,
+)
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import DELETED, ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    """Same contract as the chaos battery's autouse monitor: recovery code
+    paths (crash points under store locks, drift repair, failover) run with
+    lock-order inversion detection, failing the test at teardown."""
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _mk_cluster(store, n_nodes=4, cpu="4"):
+    for i in range(n_nodes):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": cpu, "pods": "32"}).obj())
+
+
+def _mk_pods(store, n, prefix="p", cpu="1", labels=None):
+    for i in range(n):
+        b = (make_pod().name(f"{prefix}{i}").uid(f"{prefix}{i}")
+             .namespace("default").req({"cpu": cpu}))
+        for k, val in (labels or {}).items():
+            b = b.label(k, val)
+        store.create("Pod", b.obj())
+
+
+def _mk_gang(store, name, size, cpu="1", timeout=30.0):
+    store.create("PodGroup", v1.PodGroup(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        min_member=size, schedule_timeout_seconds=timeout))
+    _mk_pods(store, size, prefix=f"{name}-", cpu=cpu,
+             labels={POD_GROUP_LABEL: name})
+
+
+def _bound(store):
+    pods, _ = store.list("Pod")
+    return [p for p in pods if p.spec.node_name]
+
+
+def _bind_transitions(store):
+    """(name, incarnation) → count of unbound→bound transitions in the
+    store's own event history; DELETE closes the incarnation, so a
+    recreated name is a fresh key — the exactly-once probe."""
+    node_of, incarnation, counts = {}, {}, {}
+    for ev in store._log:
+        if ev.kind != "Pod":
+            continue
+        name = ev.obj.metadata.name
+        if ev.type == DELETED:
+            node_of.pop(name, None)
+            incarnation[name] = incarnation.get(name, 0) + 1
+            continue
+        nn = ev.obj.spec.node_name or None
+        if nn is not None and node_of.get(name) is None:
+            key = (name, incarnation.get(name, 0))
+            counts[key] = counts.get(key, 0) + 1
+        node_of[name] = nn
+    return counts
+
+
+def _crash_frames(excinfo):
+    return [f.name for f in traceback.extract_tb(excinfo.value.__traceback__
+                                                 if hasattr(excinfo, "value")
+                                                 else excinfo.__traceback__)]
+
+
+def _assert_recovery_parity(store, recovered):
+    """Post-recovery state == a from-scratch store encode, exactly (the
+    canonical keys decode dictionary ids and row numbers away; any value
+    difference fails)."""
+    scratch = TPUScheduler(store, batch_size=recovered.batch_size)
+    try:
+        assert diff_canonical(canonical_state(recovered),
+                              canonical_state(scratch)) == {}
+    finally:
+        scratch.close()
+
+
+# --- crash-point framework ----------------------------------------------------
+
+
+def test_crash_point_catalog_and_arming():
+    fault = FaultSchedule(0)
+    with pytest.raises(ValueError):
+        fault.arm_crash("crash.not_a_point")
+    # unarmed schedule: hits count but nothing fires
+    with crash_schedule(fault):
+        maybe_crash("crash.mid_bind")
+    assert fault.crashes_fired() == {}
+    # no schedule installed: no-op even for armed-looking points
+    maybe_crash("crash.mid_bind")
+
+
+def test_crash_fires_at_exact_hit_once():
+    fault = FaultSchedule(0, crash_points={"crash.mid_bind": 3})
+    with crash_schedule(fault):
+        maybe_crash("crash.mid_bind")
+        maybe_crash("crash.mid_bind")
+        with pytest.raises(ProcessCrash) as ei:
+            maybe_crash("crash.mid_bind")
+        assert ei.value.point == "crash.mid_bind"
+        # fired once: later hits pass
+        maybe_crash("crash.mid_bind")
+    assert fault.injected_counts()["crash:crash.mid_bind"] == 1
+    assert fault.crashes_fired() == {"crash.mid_bind": 2}
+
+
+def test_arm_crash_is_relative_to_consumed_hits():
+    fault = FaultSchedule(0)
+    with crash_schedule(fault):
+        maybe_crash("crash.after_assume")
+        maybe_crash("crash.after_assume")
+        fault.arm_crash("crash.after_assume", at_hit=1)
+        with pytest.raises(ProcessCrash):
+            maybe_crash("crash.after_assume")
+
+
+def test_process_crash_passes_through_resilience_handlers():
+    """ProcessCrash is BaseException: the scheduler's own cycle-failure
+    handler (``except Exception``) must NOT absorb a simulated process
+    death — it propagates to the harness like a real SIGKILL would."""
+    assert not issubclass(ProcessCrash, Exception)
+    assert issubclass(ProcessCrash, BaseException)
+    for point in CRASH_POINTS:
+        assert point.startswith("crash.")
+
+
+# --- per-kill-point battery: fires where registered, recovery converges -------
+
+
+def test_crash_after_assume_fires_in_complete_and_recovers():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 6)
+    fault = FaultSchedule(0, crash_points={"crash.after_assume": 1})
+    sched = TPUScheduler(store, batch_size=8)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            sched.run_until_idle(max_cycles=5)
+    assert "_complete" in _crash_frames(ei)
+    # assumes are memory only: the store saw ZERO binds
+    assert len(_bound(store)) == 0
+    sched.close(flush_events=False)
+    res = cold_start(store, batch_size=8)
+    assert res.outcome == "clean" and res.drift is not None
+    res.scheduler.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 6
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    _assert_recovery_parity(store, res.scheduler)
+    res.scheduler.close()
+
+
+def test_crash_mid_bind_fires_in_finish_bind_and_recovers():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 6)
+    fault = FaultSchedule(0, crash_points={"crash.mid_bind": 3})
+    sched = TPUScheduler(store, batch_size=8)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            sched.run_until_idle(max_cycles=5)
+    assert "_finish_bind" in _crash_frames(ei)
+    # the 3rd bind's store write landed before the death
+    assert len(_bound(store)) == 3
+    sched.close(flush_events=False)
+    res = cold_start(store, batch_size=8)
+    res.scheduler.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 6
+    # already-bound pods were NEVER re-bound by the successor
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    _assert_recovery_parity(store, res.scheduler)
+    res.scheduler.close()
+
+
+def test_crash_permit_held_never_half_binds_a_gang():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_gang(store, "g0", 4)
+    fault = FaultSchedule(0, crash_points={"crash.permit_held": 2})
+    sched = TPUScheduler(store, batch_size=8)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            sched.run_until_idle(max_cycles=5)
+    assert "note_waiting" in _crash_frames(ei)
+    # two members held Permits (assumed in the dead cache) — the store
+    # must show ZERO binds: held permits die with the process, the gang
+    # requeues whole on the successor
+    assert len(_bound(store)) == 0
+    sched.close(flush_events=False)
+    res = cold_start(store, batch_size=8)
+    assert res.partial_gangs == []
+    res.scheduler.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 4
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    pg = store.get("PodGroup", "default", "g0")
+    assert pg.phase == v1.POD_GROUP_SCHEDULED
+    _assert_recovery_parity(store, res.scheduler)
+    res.scheduler.close()
+
+
+def test_crash_mid_plan_apply_evicts_exactly_once():
+    from kubernetes_tpu.descheduler.controller import DeschedulerController
+    from kubernetes_tpu.descheduler.policies import DRAIN_ANNOTATION
+
+    store = ObjectStore()
+    _mk_cluster(store, n_nodes=3)
+    _mk_pods(store, 4)
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=5)
+    assert len(_bound(store)) == 4
+    # drain the node hosting at least one pod
+    victim_node = _bound(store)[0].spec.node_name
+    node = store.get("Node", "", victim_node)
+    node.metadata.annotations[DRAIN_ANNOTATION] = "true"
+    store.update("Node", node)
+    fault = FaultSchedule(0, crash_points={"crash.mid_plan_apply": 1})
+    desched = DeschedulerController(store, sched)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            desched.sync_once()
+    assert "_apply" in _crash_frames(ei)
+    deleted = [ev.obj.metadata.name for ev in store._log
+               if ev.kind == "Pod" and ev.type == DELETED]
+    assert len(deleted) == 1  # exactly one victim left before the death
+    sched.close(flush_events=False)
+    # recovery: fresh replica re-plans from live state — fail-stop means
+    # the old victim list is never resumed, and nothing is evicted twice
+    res = cold_start(store, batch_size=8)
+    desched2 = DeschedulerController(store, res.scheduler)
+    for _ in range(6):
+        desched2.sync_once()
+        res.scheduler.run_until_idle(max_cycles=5)
+    pods, _ = store.list("Pod")
+    on_drained = [p for p in pods if p.spec.node_name == victim_node]
+    assert on_drained == []  # drain completed across restarts
+    all_deleted = [ev.obj.metadata.name for ev in store._log
+                   if ev.kind == "Pod" and ev.type == DELETED]
+    assert len(all_deleted) == len(set(all_deleted))  # exactly once each
+    res.scheduler.close()
+
+
+def test_crash_mid_scaleup_resumes_exactly_once():
+    from kubernetes_tpu.autoscaler.api import NODE_GROUP_LABEL, NodeGroup
+    from kubernetes_tpu.autoscaler.controller import ClusterAutoscaler
+
+    store = ObjectStore()
+    _mk_cluster(store, n_nodes=1, cpu="1")  # nearly no capacity
+    store.create("NodeGroup", NodeGroup(
+        metadata=v1.ObjectMeta(name="pool"), min_size=0, max_size=6,
+        capacity={"cpu": "4", "pods": "32"}))
+    _mk_gang(store, "g0", 4, cpu="3")  # needs the scale-up
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=6)
+    assert len(_bound(store)) == 0  # parked: no capacity yet
+    fault = FaultSchedule(0, crash_points={"crash.mid_scaleup": 1})
+    autoscaler = ClusterAutoscaler(store, sched)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            autoscaler.sync_once()
+    assert "_scale_up" in _crash_frames(ei)
+    nodes_mid = [n.metadata.name for n in store.list("Node")[0]
+                 if n.metadata.labels.get(NODE_GROUP_LABEL) == "pool"]
+    assert nodes_mid == ["pool-0"]  # exactly the first deterministic name
+    sched.close(flush_events=False)
+    res = cold_start(store, batch_size=8)
+    autoscaler2 = ClusterAutoscaler(store, res.scheduler)
+    for _ in range(6):
+        autoscaler2.sync_once()
+        res.scheduler.run_until_idle(max_cycles=6)
+        if len(_bound(store)) == 4:
+            break
+    assert len(_bound(store)) == 4  # gang placed on the resumed scale-up
+    names = [n.metadata.name for n in store.list("Node")[0]
+             if n.metadata.labels.get(NODE_GROUP_LABEL) == "pool"]
+    # deterministic names resumed without duplication or gaps
+    assert len(names) == len(set(names))
+    assert "pool-0" in names and len(names) <= 6
+    res.scheduler.close()
+
+
+def test_crash_post_lease_renew_successor_waits_out_lease():
+    clock = FakeClock()
+    store = ObjectStore()
+    lock = LeaseLock(store, "kube-system", "tpu-scheduler")
+    a = LeaderElector(lock, "a", lease_duration=1.0, clock=clock)
+    assert a.try_acquire_or_renew()
+    fault = FaultSchedule(0, crash_points={"crash.post_lease_renew": 1})
+    with crash_schedule(fault):
+        clock.advance(0.1)
+        with pytest.raises(ProcessCrash) as ei:
+            a.try_acquire_or_renew()
+    assert "_tick" in _crash_frames(ei)
+    # the dead holder's fresh renewal pins the lease: a successor cannot
+    # acquire until a FULL lease_duration elapses
+    b = LeaderElector(lock, "b", lease_duration=1.0, clock=clock)
+    assert not b.try_acquire_or_renew()
+    clock.advance(0.5)
+    assert not b.try_acquire_or_renew()
+    clock.advance(0.61)
+    assert b.try_acquire_or_renew()
+    lease = lock.get()
+    assert lease.holder_identity == "b"
+    assert lease.lease_transitions == 1  # holder change bumped the fence
+    assert b.fence_token == 1 and b.check_fence()
+
+
+# --- cold-start reconstruction ------------------------------------------------
+
+
+def test_cold_start_readyz_gates_until_verified():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 4)
+    rz = Readyz()
+    seen_during = {}
+
+    def factory(st, **kw):
+        # mid-rebuild: relist done, later components still pending
+        seen_during["ready"] = rz.ready
+        return TPUScheduler(st, **kw)
+
+    res = cold_start(store, readyz=rz, scheduler_factory=factory,
+                     batch_size=8)
+    assert seen_during["ready"] is False  # NotReady while rebuilding
+    assert rz.ready is True  # ready only after the verify pass
+    assert res.outcome == "clean"
+    res.scheduler.close()
+
+
+def test_cold_start_rederives_gang_phase_and_completes_partial_gang():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_gang(store, "g0", 3)
+    # simulate a crash mid-flush: one member bound in the store, the
+    # PodGroup phase left claiming Scheduled
+    store.bind_pod("default", "g0-0", "n0")
+    pg = store.get("PodGroup", "default", "g0")
+    pg.phase = v1.POD_GROUP_SCHEDULED
+    store.update("PodGroup", pg)
+    res = cold_start(store, batch_size=8)
+    assert res.partial_gangs == ["default/g0"]
+    assert res.gang_phase_repairs >= 1
+    assert store.get("PodGroup", "default", "g0").phase == \
+        v1.POD_GROUP_SCHEDULING
+    # the gang COMPLETES (bound members stay, the rest join them) —
+    # never unwinds, never stays half-bound
+    res.scheduler.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 3
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    assert store.get("PodGroup", "default", "g0").phase == \
+        v1.POD_GROUP_SCHEDULED
+    res.scheduler.close()
+
+
+def test_cold_start_drops_stale_nominations():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 2)
+    pod = store.get("Pod", "default", "p0")
+    pod.status.nominated_node_name = "n1"  # the dead leader's stale claim
+    store.update("Pod", pod)
+    res = cold_start(store, batch_size=8)
+    assert res.nominations_dropped == 1
+    assert store.get("Pod", "default", "p0").status.nominated_node_name \
+        is None
+    res.scheduler.close()
+
+
+def test_cold_start_parity_after_churn():
+    """Recovered snapshot == from-scratch store encode, bit-for-bit at the
+    canonical keys, after a run with binds, deletes, and affinity terms."""
+    store = ObjectStore()
+    _mk_cluster(store, n_nodes=5)
+    for i in range(4):
+        store.create("Pod", make_pod().name(f"a{i}").uid(f"a{i}")
+                     .namespace("default").req({"cpu": "1"})
+                     .label("app", "web").obj())
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=5)
+    store.delete("Pod", "default", "a3")
+    store.delete("Node", "", "n4")
+    _mk_pods(store, 2, prefix="late-")
+    sched.run_until_idle(max_cycles=5)
+    sched.close()
+    res = cold_start(store, batch_size=8)
+    assert res.outcome == "clean"
+    _assert_recovery_parity(store, res.scheduler)
+    res.scheduler.close()
+
+
+# --- drift detector / repairer ------------------------------------------------
+
+
+def test_drift_detector_clean_on_healthy_scheduler():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 4)
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=5)
+    report = DriftDetector(sched).check()
+    assert report is not None and report.clean
+    sched.close()
+
+
+def test_drift_detector_repairs_each_component():
+    store = ObjectStore()
+    _mk_cluster(store)
+    for i in range(3):
+        store.create("Pod", make_pod().name(f"p{i}").uid(f"p{i}")
+                     .namespace("default").req({"cpu": "1"})
+                     .label("app", "web").obj())
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=5)
+    before = m.state_drift.value(("encoder_nodes",))
+    det = DriftDetector(sched)
+    assert det.check().clean  # settle the post-bind encoder sync first
+    # corrupt the encoder's requested plane behind the scheduler's back
+    sched.encoder.requested[sched.encoder.node_rows["n0"], 0] += 13
+    report = det.check_and_repair()
+    assert report.divergent == {"encoder_nodes": 1}
+    assert report.unrepaired == {} and report.repaired
+    assert m.state_drift.value(("encoder_nodes",)) == before + 1
+    # cache-level corruption: drop a bound pod from the cache
+    pod = _bound(store)[0]
+    sched.cache.remove_pod(pod)
+    report = det.check_and_repair()
+    assert "cache_pods" in report.divergent
+    assert report.unrepaired == {}
+    # post-repair: clean, and scheduling still works on the repaired state
+    assert det.check().clean
+    _mk_pods(store, 1, prefix="extra-")
+    sched.run_until_idle(max_cycles=5)
+    assert len(_bound(store)) == 4
+    sched.close()
+
+
+def test_drift_detector_repairs_affinity_tables():
+    store = ObjectStore()
+    _mk_cluster(store)
+    aff = v1.Affinity(pod_anti_affinity=v1.PodAffinity(required=[
+        v1.PodAffinityTerm(
+            label_selector=v1.LabelSelector(match_labels={"app": "web"}),
+            topology_key="kubernetes.io/hostname")]))
+    for i in range(3):
+        p = (make_pod().name(f"w{i}").uid(f"w{i}").namespace("default")
+             .req({"cpu": "1"}).label("app", "web").obj())
+        p.spec.affinity = aff
+        store.create("Pod", p)
+    sched = TPUScheduler(store, batch_size=8)
+    sched.run_until_idle(max_cycles=5)
+    assert len(_bound(store)) == 3
+    det = DriftDetector(sched)
+    assert det.check().clean  # settle the post-bind encoder/affinity sync
+    idx = sched.encoder.aff
+    assert idx.live_groups > 0
+    idx.aff_counts[0] += 5.0  # corrupt a count table
+    report = det.check_and_repair()
+    assert "affinity" in report.divergent
+    assert report.unrepaired == {}
+    sched.close()
+
+
+# --- leader-election handover: fencing + stop-work ----------------------------
+
+
+def test_fencing_token_refuses_bind_after_steal_lease():
+    """Two live replicas + steal_lease: the outgoing leader's already-
+    dispatched work must not produce binds racing the new leader."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_cluster(store)
+    lock = LeaseLock(store, "kube-system", "tpu-scheduler")
+    el_a = LeaderElector(lock, "a", lease_duration=5.0, clock=clock)
+    assert el_a.try_acquire_or_renew()
+    sched_a = TPUScheduler(store, batch_size=4, clock=clock, pipeline=True,
+                           fence=el_a.check_fence, batch_wait=0)
+    el_a.on_stopped_leading = sched_a.abandon_inflight
+    _mk_pods(store, 4)
+    sched_a.schedule_cycle()  # dispatches a batch; pipeline → nothing bound
+    assert sched_a._inflight_q
+    assert steal_lease(store, "kube-system", "tpu-scheduler", usurper="b",
+                       clock=clock)
+    before = m.scheduler_retries.value(("fence_reject",))
+    # completing the in-flight batch hits the bind fence: zero binds
+    sched_a.schedule_cycle()
+    sched_a.schedule_cycle()
+    assert len(_bound(store)) == 0
+    assert m.scheduler_retries.value(("fence_reject",)) > before
+    # A's next renewal sees the foreign holder: releases + stops work
+    assert not el_a.try_acquire_or_renew()
+    assert not sched_a._inflight_q
+    sched_a.close()
+    # successor (fresh replica for "b") binds everything exactly once
+    clock.advance(6.0)
+    el_b = LeaderElector(lock, "b2", lease_duration=5.0, clock=clock)
+    assert el_b.try_acquire_or_renew()
+    res = cold_start(store, batch_size=4, clock=clock,
+                     fence=el_b.check_fence, batch_wait=0)
+    res.scheduler.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 4
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    res.scheduler.close()
+
+
+def test_abandon_inflight_requeues_and_rolls_back_holds():
+    store = ObjectStore()
+    _mk_cluster(store)
+    sched = TPUScheduler(store, batch_size=4, pipeline=True, batch_wait=0)
+    _mk_pods(store, 3)
+    sched.schedule_cycle()
+    assert sched._inflight_q
+    sched.abandon_inflight()
+    assert sched._inflight_q == []
+    assert sched._nominated == {} and sched._waiting_binds == {}
+    a, b, u = sched.queue.pending_count()
+    assert a + b + u == 3  # every in-flight pod requeued, none lost
+    # the abandoned work reschedules cleanly
+    sched.run_until_idle(max_cycles=10)
+    assert len(_bound(store)) == 3
+    assert all(c == 1 for c in _bind_transitions(store).values())
+    sched.close()
+
+
+def test_fence_predicate_failure_is_fenced_out():
+    store = ObjectStore()
+    _mk_cluster(store)
+    _mk_pods(store, 1)
+
+    def broken_fence():
+        raise RuntimeError("lease store down")
+
+    sched = TPUScheduler(store, batch_size=4, fence=broken_fence)
+    sched.run_until_idle(max_cycles=3)
+    assert len(_bound(store)) == 0  # unprovable fence = failed fence
+    sched.close()
+
+
+# --- event-recorder durability ------------------------------------------------
+
+
+class _FlakyEventStore:
+    """Store wrapper failing Event writes while ``down`` is True."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.down = False
+
+    def create(self, kind, obj):
+        if kind == "Event" and self.down:
+            raise RuntimeError("control plane down")
+        return self._inner.create(kind, obj)
+
+    def update(self, kind, obj, expected_rv=None):
+        if kind == "Event" and self.down:
+            raise RuntimeError("control plane down")
+        return self._inner.update(kind, obj, expected_rv=expected_rv)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_event_recorder_retains_then_flushes():
+    raw = ObjectStore()
+    store = _FlakyEventStore(raw)
+    rec = EventRecorder(store)
+    pod = make_pod().name("p0").uid("p0").namespace("default").obj()
+    store.down = True
+    rec.eventf(pod, "Warning", "FailedScheduling", "no nodes")
+    assert rec.pending_writes == 1 and rec.dropped == 0
+    store.down = False
+    assert rec.flush() == 0  # retained write lands; nothing lost
+    events, _ = raw.list("Event")
+    assert len(events) == 1 and events[0].reason == "FailedScheduling"
+
+
+def test_event_recorder_bounds_loss_and_counts_drops():
+    raw = ObjectStore()
+    store = _FlakyEventStore(raw)
+    rec = EventRecorder(store)
+    before = m.events_dropped.value()
+    store.down = True
+    pod = make_pod().name("p0").uid("p0").namespace("default").obj()
+    n = RETAIN_CAP + 5
+    for i in range(n):
+        rec.eventf(pod, "Normal", f"R{i}", "msg")  # distinct reasons
+    # the buffer is bounded: overflow evictions are counted drops
+    assert rec.pending_writes == RETAIN_CAP
+    assert rec.dropped == 5
+    # flush against a still-down store: the rest are counted lost too
+    lost = rec.flush()
+    assert lost == RETAIN_CAP
+    assert rec.dropped == 5 + RETAIN_CAP
+    assert m.events_dropped.value() == before + rec.dropped
+    assert rec.pending_writes == 0
+
+
+def test_scheduler_close_flushes_events():
+    raw = ObjectStore()
+    store = _FlakyEventStore(raw)
+    _mk_cluster(raw)
+    _mk_pods(raw, 1)
+    store.down = True
+    sched = TPUScheduler(store, batch_size=4)
+    sched.run_until_idle(max_cycles=5)
+    assert sched.recorder.pending_writes > 0  # Scheduled event retained
+    store.down = False
+    sched.close()  # clean shutdown: flush lands the retained events
+    assert sched.recorder.pending_writes == 0
+    events, _ = raw.list("Event")
+    assert any(e.reason == "Scheduled" for e in events)
+
+
+# --- readiness gating ---------------------------------------------------------
+
+
+def test_readyz_progress_and_render():
+    rz = Readyz()
+    assert rz.ready and rz.render() == "ok"
+    rz.begin("encode", 10)
+    rz.begin("gangs", 2)
+    assert not rz.ready
+    rz.progress("encode", 4)
+    assert "encode: 4/10" in rz.render()
+    assert "NotReady" in rz.render()
+    rz.complete("encode")
+    rz.complete("gangs")
+    assert rz.ready and rz.render() == "ok"
+    rz.reset()
+    assert rz.ready
+
+
+def test_apiserver_readyz_distinct_from_healthz():
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = ObjectStore()
+    rz = Readyz()
+    rz.begin("encode", 3)
+    server = APIServer(store, readyz=rz).start()
+    try:
+        base = server.url
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert r.status == 200  # alive regardless of readiness
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/readyz")
+        assert ei.value.code == 503
+        body = ei.value.read().decode()
+        assert "NotReady" in body and "encode: 0/3" in body
+        rz.complete("encode")
+        with urllib.request.urlopen(f"{base}/readyz") as r:
+            assert r.status == 200 and r.read() == b"ok"
+    finally:
+        server.stop()
+
+
+def test_cli_readyz_status():
+    from kubernetes_tpu.cli import Kubectl
+
+    store = ObjectStore()
+    k = Kubectl(store)
+    assert k.readyz_status() == "ok"
+    rz = Readyz()
+    rz.begin("encode", 4)
+    rz.progress("encode", 1)
+    out = k.readyz_status(rz)
+    assert "NotReady" in out and "encode" in out and "1/4" in out
+    rz.complete("encode")
+    assert k.readyz_status(rz).startswith("ok")
+
+
+# --- TTLAfterFinished restart path --------------------------------------------
+
+
+def test_ttl_after_finished_restart_counts_from_first_observation():
+    from kubernetes_tpu.controllers.ttlafterfinished import (
+        TTLAfterFinishedController,
+    )
+
+    store = ObjectStore()
+    job = v1.Job(metadata=v1.ObjectMeta(name="j0", namespace="default"),
+                 ttl_seconds_after_finished=10, completed=True)
+    assert job.completion_time is None  # finished before the field existed
+    store.create("Job", job)
+    now = {"t": 100.0}
+    # first controller observes it, stamps completion_time=now — the TTL
+    # counts from FIRST OBSERVATION, not from some long-gone finish
+    tc1 = TTLAfterFinishedController(store, clock=lambda: now["t"])
+    assert tc1.sync_once()
+    assert store.get("Job", "default", "j0").completion_time == 100.0
+    # RESTART: a fresh controller instance must not re-stamp or delete early
+    tc2 = TTLAfterFinishedController(store, clock=lambda: now["t"])
+    assert not tc2.sync_once()
+    assert store.get("Job", "default", "j0").completion_time == 100.0
+    now["t"] = 109.9
+    assert not tc2.sync_once()
+    assert store.get("Job", "default", "j0") is not None
+    now["t"] = 110.0
+    assert tc2.sync_once()
+    assert store.get("Job", "default", "j0") is None
+
+
+# --- failover soak ------------------------------------------------------------
+
+
+def test_failover_soak_fast():
+    """The acceptance shape at battery size: leader killed at every
+    registered crash point in turn, every pod bound exactly once per
+    incarnation, no half-bound gang, bounded recovery, zero unrepaired
+    drift."""
+    from kubernetes_tpu.recovery.failover import KILL_ORDER, run_failover_soak
+
+    r = run_failover_soak(seed=7)
+    assert r.crashes == list(KILL_ORDER)  # every point fired, in turn
+    assert r.converged, (r.unbound, r.duplicate_binds, r.gangs_partial,
+                         r.drift_unrepaired)
+    assert r.bound == r.pods and r.duplicate_binds == 0
+    assert r.gangs_partial == []
+    assert r.drift_unrepaired == 0
+    assert r.recoveries >= len(KILL_ORDER)
+    # bounded recovery: lease expiry + cold start, in driver iterations
+    assert r.max_recovery_iterations <= 60
+
+
+def test_failover_soak_deterministic_replay():
+    """Same seed → same kill sequence, same fault decisions, same converged
+    signature (kill decisions ride the per-key op counters, so replays
+    cannot depend on wall clock)."""
+    from kubernetes_tpu.recovery.failover import run_failover_soak
+
+    kill_order = ("crash.permit_held", "crash.mid_bind",
+                  "crash.post_lease_renew")
+    runs = [
+        run_failover_soak(
+            n_plain=6, n_gangs=1, gang_size=3, n_nodes=4, seed=11,
+            kill_order=kill_order, drift_every=0,
+        ).determinism_signature()
+        for _ in range(2)
+    ]
+    assert runs[0] == runs[1]
+    assert runs[0]["crashes"] == list(kill_order)
+
+
+@pytest.mark.slow
+def test_failover_soak_full_500():
+    """The full acceptance soak: 500-pod churn, leader killed at every
+    registered crash point, exactly-once binding, all-or-nothing gangs,
+    post-recovery snapshot == from-scratch encode (drift detector reports
+    zero unrepaired divergence)."""
+    from kubernetes_tpu.recovery.failover import KILL_ORDER, run_failover_soak
+
+    r = run_failover_soak(
+        n_plain=472, n_gangs=3, gang_size=4, overflow_gang_size=16,
+        n_nodes=124, seed=7, batch_size=64, group_max_size=16,
+        phase_cap=1500, max_iterations=20000,
+    )
+    assert r.pods >= 500
+    assert r.crashes == list(KILL_ORDER)
+    assert r.converged, (r.unbound[:10], r.duplicate_binds,
+                         r.gangs_partial, r.drift_unrepaired)
+    assert r.duplicate_binds == 0 and r.gangs_partial == []
+    assert r.drift_unrepaired == 0

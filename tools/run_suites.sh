@@ -33,6 +33,13 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_descheduler.py tests/t
 # predictions or the scale decisions are broken
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_whatif.py tests/test_autoscaler.py -q \
   || { echo "FAILED: autoscaler test gate" >> suites_run.log; exit 1; }
+# crash-restart gate: the kill-point battery + cold-start reconstruction +
+# the fast failover soak (leader killed at every registered crash point,
+# exactly-once binding, zero unrepaired drift) — perf numbers from a tree
+# whose recovery layer is broken would ship an un-survivable scheduler, so
+# fail fast here; the full 500-pod soak runs behind the slow marker
+JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_recovery.py -q -m 'not slow' \
+  || { echo "FAILED: recovery test gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
