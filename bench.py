@@ -54,13 +54,25 @@ def run_named(suite: str, size: str, scale: float):
     return w, {i.labels["Metric"]: i.data for i in items}, wall
 
 
+def oracle_node_cap(n_nodes: int) -> int:
+    """The oracle comparator's actual cluster size (see oracle_per_pod_ms)."""
+    return min(n_nodes, int(os.environ.get("BENCH_ORACLE_NODES", "8192")))
+
+
 def oracle_per_pod_ms(n_nodes: int, sample: int) -> float:
     """Mean per-pod algorithm time of the sequential Python oracle on a
-    fresh same-shape cluster (cloned state, unit-exact quantities)."""
+    fresh same-shape cluster (cloned state, unit-exact quantities).
+
+    The oracle's scoring walk is O(N) Python per pod — ~10 MINUTES per pod
+    at a 100k-node cluster — so the comparator cluster is capped at
+    BENCH_ORACLE_NODES (default 8192; every 500/5k suite stays exact).
+    Oracle cost grows ~linearly in N, so at capped sizes vs_baseline
+    UNDERSTATES the device path's win — conservative, never inflated."""
     from kubernetes_tpu.oracle import Oracle
     from kubernetes_tpu.perf.workloads import node_default, pod_default
     from kubernetes_tpu.state.cache import Cache, Snapshot
 
+    n_nodes = oracle_node_cap(n_nodes)
     cache = Cache()
     for i in range(n_nodes):
         cache.add_node(node_default(i))
@@ -170,6 +182,9 @@ def main():
                 "LOWER-BOUNDS its times — ratios <1 mean the envelope wins"
             ),
             "oracle_per_pod_ms": round(o_ms, 2),
+            # the oracle comparator's actual cluster size (capped — see
+            # oracle_per_pod_ms; == nodes for every non-huge suite)
+            "oracle_nodes": oracle_node_cap(n_nodes),
             "go_envelope": {
                 "sampled": env_sampled,
                 "dense_all_nodes": env_dense,

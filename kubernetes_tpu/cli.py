@@ -383,6 +383,43 @@ class Kubectl:
             for d in controller.last_decisions:
                 out += (f"\n  {d.direction} {d.group or '-'} "
                         f"{d.result} ({d.note})")
+        if controller is not None:
+            out += "\n" + self._shard_topology_line(
+                getattr(controller, "scheduler", None))
+        return out
+
+    # --- device / shard topology ----------------------------------------------
+
+    def _shard_topology_line(self, scheduler=None) -> str:
+        """One-line shard summary shared by autoscaler status + topology."""
+        mesh = getattr(scheduler, "mesh", None)
+        if mesh is None:
+            return "node-axis sharding: off"
+        enc = scheduler.encoder
+        n_dev = int(mesh.devices.size)
+        axis = ",".join(mesh.axis_names)
+        return (f"node-axis sharding: on — {n_dev} devices over axis "
+                f"'{axis}', node tier {enc._n} rows "
+                f"({enc._n // n_dev}/shard)")
+
+    def topology(self, scheduler=None) -> str:
+        """``ktpu topology``: the device mesh view — backend devices, the
+        node-axis shard spec in effect, and node-tier rows per shard (what
+        the production-scale path actually partitions)."""
+        import jax
+
+        rows = [["DEVICE", "PLATFORM", "PROCESS"]]
+        for d in jax.devices():
+            rows.append([str(d.id), d.platform,
+                         str(getattr(d, "process_index", 0))])
+        out = _render_table(rows)
+        nodes, _ = self.store.list("Node")
+        out += f"\n{len(nodes)} Node objects"
+        out += "\n" + self._shard_topology_line(scheduler)
+        if scheduler is None:
+            out += (" (no in-process scheduler: pass one for the live "
+                    "mesh/tier view; KubeSchedulerConfiguration "
+                    "nodeAxisSharding selects the policy)")
         return out
 
     # --- readiness view -------------------------------------------------------
@@ -512,6 +549,7 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                    help="evaluate the eviction gate, evict nothing")
     p = sub.add_parser("autoscaler")
     p.add_argument("action", choices=["status"])
+    sub.add_parser("topology")
     sub.add_parser("readyz")
     for verb in ("cordon", "uncordon"):
         p = sub.add_parser(verb)
@@ -553,6 +591,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         print(k.drain(args.node, dry_run=args.dry_run))
     elif args.verb == "autoscaler":
         print(k.autoscaler_status())
+    elif args.verb == "topology":
+        print(k.topology())
     elif args.verb == "readyz":
         if args.server:
             # the apiserver's /readyz carries the wired Readyz's rendering

@@ -112,6 +112,14 @@ class KubeSchedulerConfiguration:
     percentage_of_nodes_to_score: int = 0  # types.go:70 (compat only)
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
+    # node-axis sharding of the device path (no upstream analog — the
+    # structural replacement for percentageOfNodesToScore sampling: instead
+    # of scoring fewer nodes, score all of them across more chips).
+    # "auto" (default) shards on multi-device accelerators only; "on"
+    # forces it (tests use the virtual CPU mesh); "off" disables; an int
+    # shards over the first n devices.  Mirrors chain_affinity's
+    # backend-gating pattern (TPUScheduler sharding=).
+    node_axis_sharding: object = "auto"
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "KubeSchedulerConfiguration":
@@ -123,12 +131,24 @@ class KubeSchedulerConfiguration:
         ]
         if not profiles:
             profiles = [KubeSchedulerProfile()]
+        sharding = d.get("nodeAxisSharding", "auto")
+        if not (sharding in ("auto", "on", "off", True, False)
+                or isinstance(sharding, int)):
+            raise ValueError(f"unsupported nodeAxisSharding {sharding!r}")
+        if (isinstance(sharding, int) and not isinstance(sharding, bool)
+                and sharding > 1 and sharding & (sharding - 1)):
+            # fail at parse time with the constraint named, not at
+            # scheduler construction inside ClusterEncoder.set_mesh
+            raise ValueError(
+                f"nodeAxisSharding {sharding} is not a power of two (the "
+                "node-axis mesh requires a power-of-two device count)")
         return cls(
             profiles=profiles,
             parallelism=int(d.get("parallelism", 16)),
             percentage_of_nodes_to_score=int(d.get("percentageOfNodesToScore", 0)),
             pod_initial_backoff_seconds=float(d.get("podInitialBackoffSeconds", 1)),
             pod_max_backoff_seconds=float(d.get("podMaxBackoffSeconds", 10)),
+            node_axis_sharding=sharding,
         )
 
     def profile(self, scheduler_name: str = DEFAULT_SCHEDULER_NAME) -> KubeSchedulerProfile:
@@ -239,6 +259,7 @@ def scheduler_from_config(store, cfg: "KubeSchedulerConfiguration", **kwargs):
         )
         for p in cfg.profiles
     }
+    kwargs.setdefault("sharding", cfg.node_axis_sharding)
     return TPUScheduler(
         store, profiles=profiles,
         pod_initial_backoff=cfg.pod_initial_backoff_seconds,

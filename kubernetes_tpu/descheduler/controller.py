@@ -88,7 +88,12 @@ class DeschedulerController:
         if plan.no_solve:
             return ScoredPlan(plan=plan, viable=bool(plan.victims),
                               slices_freed=self._slices_freed(plan))
-        prediction = self.planner.predict(plan.pending, plan.victims)
+        return self._scored(plan, self.planner.predict(plan.pending,
+                                                       plan.victims))
+
+    def _scored(self, plan: CandidatePlan,
+                prediction: Optional[Prediction]) -> ScoredPlan:
+        """Viability verdict from a (possibly group-vmapped) prediction."""
         if prediction is None:
             return ScoredPlan(plan=plan, viable=False)
         viable = True
@@ -100,6 +105,80 @@ class DeschedulerController:
             plan=plan, viable=viable, prediction=prediction,
             slices_freed=self._slices_freed(plan),
         )
+
+    def _best_in_group(self, group: List[CandidatePlan],
+                       budget: int):
+        """Cheapest viable plan of one competing group →
+        ``(ScoredPlan | None, budget_limited)``.
+
+        The pre-round-9 cost-ordered scan ran ONE device solve per
+        candidate — candidate k+1's solve only launched after candidate
+        k's verdict came home, a full device round per candidate.  A
+        group's solvable candidates share a pending set by construction
+        (same waiting gang / same drifted constraint), so they now go
+        through ONE vmapped ``WhatIfEngine.evaluate`` ([K, B, N]) and the
+        verdicts are read back in cost order — same winner, one device
+        round per group.  ``no_solve`` plans (drain) and groups whose
+        candidates somehow carry different pending sets keep the
+        sequential path."""
+        group = sorted(group, key=lambda pl: len(pl.victims))
+        budget_limited = False
+        prepared: List[CandidatePlan] = []
+        for plan in group:
+            if plan.no_solve and len(plan.victims) > budget:
+                # drain evictions are independent (no all-or-nothing
+                # placement to enable): chunk to the budget so a big node
+                # drains across syncs instead of never
+                plan = dataclasses.replace(
+                    plan, victims=plan.victims[:budget])
+            if len(plan.victims) > budget:
+                budget_limited = True
+                continue
+            prepared.append(plan)
+        solvable = [p for p in prepared if not p.no_solve and p.pending]
+        preds: Dict[int, Prediction] = {}
+        if len(solvable) > 1 and all(
+            [q.uid for q in p.pending] == [q.uid for q in solvable[0].pending]
+            for p in solvable[1:]
+        ):
+            got = self._predict_group(solvable)
+            if got is not None:
+                preds = got
+        for plan in prepared:
+            if plan.no_solve:
+                scored = ScoredPlan(plan=plan, viable=bool(plan.victims),
+                                    slices_freed=self._slices_freed(plan))
+            elif id(plan) in preds:
+                scored = self._scored(plan, preds[id(plan)])
+            else:
+                scored = self.score(plan)
+            if scored.viable:
+                # cost-ordered verdict walk: the first viable plan is the
+                # group's minimal victim set — costlier candidates' (already
+                # computed) predictions are simply never consulted
+                return scored, budget_limited
+        return None, budget_limited
+
+    def _predict_group(
+        self, solvable: List[CandidatePlan]
+    ) -> Optional[Dict[int, Prediction]]:
+        """All of a group's candidate victim sets as ONE vmapped K-fork
+        evaluate over the shared pending batch; None when the engine
+        refuses (in-flight work, oversize batch) — callers fall back to
+        per-plan scoring, which will refuse identically."""
+        from ..whatif import ForkSpec
+
+        t0 = self.clock()
+        preds = self.planner.engine.evaluate(
+            list(solvable[0].pending),
+            [ForkSpec(victims=list(p.victims), note="descheduler")
+             for p in solvable],
+        )
+        if preds is None:
+            return None
+        m.descheduler_planner_duration.observe(
+            max(self.clock() - t0, 0.0))
+        return {id(p): pr for p, pr in zip(solvable, preds)}
 
     def _score_replacements(self, scored: ScoredPlan) -> None:
         """Second solve on the WINNING plan only: pending + victim clones,
@@ -192,26 +271,8 @@ class DeschedulerController:
                 if budget <= 0:
                     budget_limited = True
                     break
-                group.sort(key=lambda pl: len(pl.victims))
-                best: Optional[ScoredPlan] = None
-                for plan in group:
-                    if plan.no_solve and len(plan.victims) > budget:
-                        # drain evictions are independent (no all-or-
-                        # nothing placement to enable): chunk to the
-                        # budget so a big node drains across syncs
-                        # instead of never
-                        plan = dataclasses.replace(
-                            plan, victims=plan.victims[:budget])
-                    if len(plan.victims) > budget:
-                        budget_limited = True
-                        continue
-                    scored = self.score(plan)
-                    if scored.viable:
-                        # cost-ordered scan: the first viable plan is the
-                        # group's minimal victim set — later (costlier)
-                        # candidates never run their device solve
-                        best = scored
-                        break
+                best, limited = self._best_in_group(group, budget)
+                budget_limited = budget_limited or limited
                 if best is None:
                     continue
                 any_viable = True

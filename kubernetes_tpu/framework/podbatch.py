@@ -168,6 +168,68 @@ class PodBatch:
     def has_topology_spread(self) -> bool:
         return bool(self.tsc_valid.any())
 
+    def take(self, rows) -> "PodBatch":
+        """Row-gather along the pod axis: a PodBatch whose pod i is this
+        batch's pod ``rows[i]`` (static pytree aux copied unchanged).
+
+        Works on host numpy and inside traced programs (``rows`` may be a
+        traced i32 vector) — the identity-class dedup path gathers the
+        class REPRESENTATIVES' rows this way, so the dense filter/score
+        planes compute at ``[C, N]`` instead of ``[B, N]``.  The compiled
+        selector structs hold content-deduplicated unique rows plus a
+        per-pod ``index`` map, so gathering a selector batch is just
+        gathering ``index``; per-pod-flattened selector batches (B*T
+        row-major) gather whole T-blocks."""
+        import dataclasses
+
+        b = self.valid.shape[0]
+
+        def g(a):  # plain pod-dim array
+            return a[rows]
+
+        def sel_take(cs, per_pod: int):
+            idx = cs.index.reshape(b, per_pod)[rows].reshape(-1)
+            return dataclasses.replace(cs, index=idx)
+
+        def group_take(grp: "AffinityTermGroup"):
+            t = grp.valid.shape[1]
+            return AffinityTermGroup(
+                valid=g(grp.valid), topo_key=g(grp.topo_key),
+                weight=g(grp.weight), ns_ids=g(grp.ns_ids),
+                all_namespaces=g(grp.all_namespaces),
+                selectors=sel_take(grp.selectors, t),
+            )
+
+        return dataclasses.replace(
+            self,
+            pods=[],  # host pod objects are not gatherable by traced rows
+            valid=g(self.valid), request=g(self.request),
+            non_zero=g(self.non_zero), ns=g(self.ns),
+            label_keys=g(self.label_keys), label_vals=g(self.label_vals),
+            priority=g(self.priority), node_name_id=g(self.node_name_id),
+            nominated_row=g(self.nominated_row),
+            ports=g(self.ports), ports_ip=g(self.ports_ip),
+            image_ids=g(self.image_ids),
+            tol_valid=g(self.tol_valid), tol_key=g(self.tol_key),
+            tol_val=g(self.tol_val), tol_op=g(self.tol_op),
+            tol_effect=g(self.tol_effect),
+            node_selector=sel_take(self.node_selector, 1),
+            node_affinity=sel_take(self.node_affinity, 1),
+            pref_valid=g(self.pref_valid), pref_weight=g(self.pref_weight),
+            pref_req_key=g(self.pref_req_key), pref_req_op=g(self.pref_req_op),
+            pref_req_vals=g(self.pref_req_vals),
+            pref_req_num=g(self.pref_req_num),
+            tsc_valid=g(self.tsc_valid), tsc_key=g(self.tsc_key),
+            tsc_max_skew=g(self.tsc_max_skew), tsc_when=g(self.tsc_when),
+            tsc_min_domains=g(self.tsc_min_domains),
+            tsc_selectors=sel_take(self.tsc_selectors,
+                                   self.tsc_valid.shape[1]),
+            req_affinity=group_take(self.req_affinity),
+            req_anti_affinity=group_take(self.req_anti_affinity),
+            pref_affinity=group_take(self.pref_affinity),
+            pref_anti_affinity=group_take(self.pref_anti_affinity),
+        )
+
 
 from ..utils.pytrees import register_pytree_dataclass as _reg  # noqa: E402
 
@@ -522,6 +584,63 @@ class PodBatchCompiler:
             all_namespaces=all_namespaces,
             selectors=self._compile_ls(f"{group}_sel", sel_list),
         )
+
+
+def identity_classes(batch: PodBatch):
+    """Host-side exact-content pod classes over a compiled batch.
+
+    Two pods share a class iff every compiled pod-row that feeds the
+    filter/score planes is byte-identical — so their ``[N]`` plane rows are
+    provably equal and the dense compute can run once per class
+    (``batch_assign``'s dedup path) instead of once per pod.  The compiled
+    selector structs are content-deduplicated at compile time, so comparing
+    their per-pod ``index`` rows compares selector CONTENT.
+    ``nominated_row`` is excluded on purpose: it steers host selection, not
+    the planes.  Returns ``(class_of i32[B], rep_rows i32[C])`` with
+    ``rep_rows[class_of[b]]`` the first batch row of b's class.
+
+    Templated scheduler_perf workloads collapse to a handful of classes
+    (measured C=2 at B=256 on the basic suites: one pod template plus the
+    padding rows), which turns the ``[B, N]`` dense planes — 18s/batch at
+    131k nodes on the 1-core CI host — into a ``[C, N]`` compute (0.26s).
+    """
+    b = batch.size
+
+    def flat(a):
+        return np.ascontiguousarray(np.asarray(a)).reshape(b, -1)
+
+    cols = [
+        flat(a) for a in (
+            batch.valid, batch.request, batch.non_zero, batch.ns,
+            batch.label_keys, batch.label_vals, batch.priority,
+            batch.node_name_id, batch.ports, batch.ports_ip,
+            batch.image_ids, batch.tol_valid, batch.tol_key, batch.tol_val,
+            batch.tol_op, batch.tol_effect, batch.pref_valid,
+            batch.pref_weight, batch.pref_req_key, batch.pref_req_op,
+            batch.pref_req_vals, batch.pref_req_num, batch.tsc_valid,
+            batch.tsc_key, batch.tsc_max_skew, batch.tsc_when,
+            batch.tsc_min_domains,
+            batch.node_selector.index, batch.node_affinity.index,
+            batch.tsc_selectors.index,
+        )
+    ]
+    for grp in (batch.req_affinity, batch.req_anti_affinity,
+                batch.pref_affinity, batch.pref_anti_affinity):
+        cols += [flat(grp.valid), flat(grp.topo_key), flat(grp.weight),
+                 flat(grp.ns_ids), flat(grp.all_namespaces),
+                 flat(grp.selectors.index)]
+    blob = np.concatenate(cols, axis=1)
+    seen: Dict[bytes, int] = {}
+    class_of = np.zeros(b, dtype=np.int32)
+    rep_rows: List[int] = []
+    for i in range(b):
+        key = blob[i].tobytes()
+        c = seen.get(key)
+        if c is None:
+            c = seen[key] = len(rep_rows)
+            rep_rows.append(i)
+        class_of[i] = c
+    return class_of, np.asarray(rep_rows, dtype=np.int32)
 
 
 def _pod_host_ports(pod: v1.Pod):

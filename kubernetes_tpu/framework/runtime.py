@@ -436,9 +436,18 @@ class BatchedFramework:
     # --- parallel batch assignment (round-based prefix commits) ---------------
 
     def batch_assign(
-        self, batch, snap, dyn, auxes, order, coupling: CouplingFlags, key=None
+        self, batch, snap, dyn, auxes, order, coupling: CouplingFlags, key=None,
+        classes=None,
     ) -> AssignResult:
         """Whole-batch parallel assignment replacing the serial scan.
+
+        ``classes`` selects the identity-class DEDUP path (see
+        ``_batch_assign_dedup``): ``(class_of i32[B], rep_batch PodBatch[C],
+        rep_auxes)`` — the dense planes compute once per exact-content pod
+        class at ``[C, N]`` instead of ``[B, N]``, bit-for-bit equal to the
+        full computation (templated batches collapse to C≈2).  Callers gate
+        it to batches with no cross-pod reads and no pod-indexed auxes
+        (TPUScheduler's dedup gate).
 
         The serialized assume loop the reference runs one pod at a time
         (pkg/scheduler/scheduler.go:496,571) becomes rounds of ONE dense
@@ -480,6 +489,12 @@ class BatchedFramework:
         ONE giant component should use the scan (the TPUScheduler router
         compares the largest component against its threshold).
         """
+        if classes is not None and key is None:
+            # key=None only: per-(pod, node) tie noise is pod-distinct by
+            # design, which the class-shared planes cannot carry — the
+            # scheduler's dedup gate already requires a keyless instance
+            return self._batch_assign_dedup(
+                batch, snap, dyn, auxes, order, coupling, classes)
         b = batch.valid.shape[0]
         batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
         reads = jnp.asarray(coupling.reads)
@@ -562,7 +577,6 @@ class BatchedFramework:
                 eff = jnp.where(mask, eff + tie_noise, -jnp.inf)
             nom = jnp.clip(batch.nominated_row, 0, n_cap - 1)
             nom_ok = (batch.nominated_row >= 0) & mask[jnp.arange(b), nom]
-            cols = jnp.arange(n_cap)
 
             # --- component heads: the only slot a reader may commit in -------
             act_pos = jnp.where(active & multi, pos_of, b)
@@ -609,15 +623,17 @@ class BatchedFramework:
                 prop = jnp.where(take_nom, nom, prop)
                 has_bid = effm[jnp.arange(b), prop] > -jnp.inf
                 bidder = unresolved & has_bid
-                prop_oh = (prop[:, None] == cols[None, :]) & bidder[:, None]
-                minpos = jnp.min(
-                    jnp.where(prop_oh, pos_of[:, None], b), axis=0
-                )  # [N]
-                winpos = jnp.min(jnp.where(prop_oh, minpos[None, :], b), axis=1)
-                win = bidder & (winpos == pos_of)
+                # winner per contested node by scatter-min of the bidders'
+                # serial positions (exact-equivalent to the previous [B, N]
+                # one-hot reduction, which materialized 33MB/iteration at
+                # 131k nodes — the dominant term of the 100k auction's 613s
+                # one-shot artifact)
+                posb = jnp.where(bidder, pos_of, b)
+                minpos_n = jnp.full(n_cap, b, pos_of.dtype).at[prop].min(posb)
+                win = bidder & (minpos_n[prop] == posb)
                 commit = commit | win
                 choice = jnp.where(win, prop, choice)
-                used = used | jnp.any(prop_oh & win[:, None], axis=0)
+                used = used.at[prop].max(win)
                 # pods with no feasible unused node left drop out of the round
                 return unresolved & ~win & has_bid, used, commit, choice
 
@@ -713,6 +729,201 @@ class BatchedFramework:
             jnp.asarray(0, jnp.int32),
         )
         dyn, _, assigned, _, _, feas_n, rounds = jax.lax.while_loop(cond, body, init)
+        return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn,
+                            rounds=rounds)
+
+    def _batch_assign_dedup(self, batch, snap, dyn, auxes, order,
+                            coupling: CouplingFlags, classes) -> AssignResult:
+        """batch_assign with identity-class-deduplicated dense planes.
+
+        ``classes = (class_of i32[B], rep_batch PodBatch[C], rep_auxes)``
+        (framework/podbatch.py identity_classes): pods of one class have
+        byte-identical compiled rows, so their ``[N]`` filter/score rows are
+        equal — each round computes the dense planes ONCE PER CLASS
+        (``[C, N]``) and every pod proposes from its class's top-B candidate
+        list instead of a full ``[B, N]`` argmax.
+
+        Bit-for-bit exactness vs the full path:
+          * plane rows: pure functions of (pod row content, snap, dyn) —
+            equal inputs, equal rows (the caller's gate excludes pod-indexed
+            auxes and cross-pod reads, so no other state feeds them);
+          * top-B candidate truncation: within one round at most B-1 OTHER
+            pods commit (one node each), so a pod's best unused feasible
+            node is always inside its class's top-B list — every node
+            ranked above the list's best unused entry is used, and
+            ``lax.top_k`` orders ties by ascending node row exactly like
+            the full path's first-max argmax;
+          * dynamic-plugin aux state: the gate admits only update-free
+            dynamic auxes (checked at trace time below), so round planes
+            depend on ``dyn`` alone and the carried aux state of the full
+            path is vacuous.
+
+        Pinned by tests/test_batch_assign.py::test_dedup_* (deduped ==
+        full-path bindings under contention, failure rows, nominated rows).
+        """
+        class_of, rep_batch, rep_auxes = classes
+        b = batch.valid.shape[0]
+        batch, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, dyn))
+        rep_batch, rep_auxes = jax.tree_util.tree_map(
+            jnp.asarray, (rep_batch, rep_auxes))
+        class_of = jnp.asarray(class_of, jnp.int32)
+        for pw, aux in zip(self.plugins, rep_auxes):
+            if pw.plugin.dynamic and aux is not None and (
+                    getattr(pw.plugin, "update", None) is not None
+                    or getattr(pw.plugin, "update_batch", None) is not None):
+                raise ValueError(
+                    "identity-class dedup requires update-free dynamic "
+                    f"auxes; {pw.plugin.name} carries one — the caller's "
+                    "dedup gate should have routed this batch to the full "
+                    "path")
+        reads = jnp.asarray(coupling.reads)
+        solo = jnp.asarray(coupling.solo)
+        if coupling.comp is None:
+            comp = jnp.zeros(b, jnp.int32)
+            multi = jnp.ones(b, bool)
+        else:
+            comp = jnp.asarray(coupling.comp, jnp.int32)
+            multi = jnp.asarray(coupling.multi, bool)
+        reader = reads & multi
+        order = order.astype(jnp.int32)
+        n_cap = snap.node_valid.shape[0]
+        kcand = min(b, n_cap)
+
+        # static planes once, at CLASS granularity
+        static_mask = snap.node_valid[None, :] & rep_batch.valid[:, None]
+        static_raw: List = []
+        for pw, aux in zip(self.plugins, rep_auxes):
+            p = pw.plugin
+            if not p.dynamic and hasattr(p, "filter"):
+                static_mask = static_mask & p.filter(rep_batch, snap, dyn, aux)
+            if hasattr(p, "score") and not p.dynamic:
+                static_raw.append((pw, p.score(rep_batch, snap, dyn, aux)))
+        dyn_plugins = [
+            (pw, idx) for idx, pw in enumerate(self.plugins) if pw.plugin.dynamic
+        ]
+        dyn_rep_auxes = tuple(rep_auxes[idx] for _, idx in dyn_plugins)
+
+        def dense_rep(dyn):
+            mask = static_mask
+            for (pw, _), aux in zip(dyn_plugins, dyn_rep_auxes):
+                if hasattr(pw.plugin, "filter"):
+                    mask = mask & pw.plugin.filter(rep_batch, snap, dyn, aux)
+            total = jnp.zeros(mask.shape, jnp.float32)
+            for pw, plane in static_raw:
+                total = total + pw.weight * jnp.floor(
+                    pw.plugin.normalize(plane, mask))
+            for (pw, _), aux in zip(dyn_plugins, dyn_rep_auxes):
+                if not hasattr(pw.plugin, "score"):
+                    continue
+                raw = pw.plugin.score(rep_batch, snap, dyn, aux, mask=mask)
+                total = total + pw.weight * jnp.floor(
+                    pw.plugin.normalize(raw, mask))
+            return mask, jnp.where(mask, total, -jnp.inf)
+
+        pos_of = jnp.zeros(b, jnp.int32).at[order].set(
+            jnp.arange(b, dtype=jnp.int32))
+        nom = jnp.clip(batch.nominated_row, 0, n_cap - 1)
+
+        def auction_commits(active, feasible, mask_r, scores_r):
+            eff_r = jnp.where(mask_r, scores_r, -jnp.inf)  # [C, N]
+            cand_val, cand_idx = jax.lax.top_k(eff_r, kcand)  # [C, K]
+            cv = cand_val[class_of]  # [B, K] (a gather, not a recompute)
+            ci = cand_idx[class_of].astype(jnp.int32)
+            nom_ok = (batch.nominated_row >= 0) & mask_r[class_of, nom]
+
+            # component heads — identical rules to the full path
+            act_pos = jnp.where(active & multi, pos_of, b)
+            comp_oh = comp[:, None] == jnp.arange(b)[None, :]  # [B, C]
+            minpos_c = jnp.min(
+                jnp.where(comp_oh, act_pos[:, None], b), axis=0)
+            is_head = active & multi & (pos_of == minpos_c[comp])
+            head_reader = is_head & reader
+            head_unsched = head_reader & ~feasible
+            closed_c = jnp.max(
+                jnp.where(comp_oh, (head_reader & feasible & solo)[:, None],
+                          False), axis=0)
+            comp_closed = multi & closed_c[comp] & ~is_head
+
+            unresolved0 = active & feasible & (~reader | is_head) & ~comp_closed
+            commit0 = jnp.zeros(b, bool)
+            choice0 = jnp.zeros(b, jnp.int32)
+            used0 = jnp.zeros(n_cap, bool)
+
+            def pcond(c):
+                unresolved, _, _, _ = c
+                return jnp.any(unresolved)
+
+            def pbody(c):
+                unresolved, used, commit, choice = c
+                # best UNUSED candidate from the pod's class list: the
+                # first (value-desc, row-asc) entry not yet claimed — the
+                # full path's argmax-over-unused, at [B, K] cost
+                ok = (cv > -jnp.inf) & ~used[ci]
+                first = jnp.argmax(ok, axis=1)
+                prop = ci[jnp.arange(b), first]
+                has_cand = jnp.any(ok, axis=1)
+                take_nom = nom_ok & ~used[nom]
+                prop = jnp.where(take_nom, nom, prop)
+                has_bid = jnp.where(take_nom, True, has_cand)
+                bidder = unresolved & has_bid
+                posb = jnp.where(bidder, pos_of, b)
+                minpos_n = jnp.full(n_cap, b, pos_of.dtype).at[prop].min(posb)
+                win = bidder & (minpos_n[prop] == posb)
+                commit = commit | win
+                choice = jnp.where(win, prop, choice)
+                used = used.at[prop].max(win)
+                return unresolved & ~win & has_bid, used, commit, choice
+
+            _, _, commit, choice = jax.lax.while_loop(
+                pcond, pbody, (unresolved0, used0, commit0, choice0)
+            )
+            unsched = (active & ~reader & ~feasible) | head_unsched
+            return commit, choice, unsched
+
+        def apply_dyn(dyn, commit, choice):
+            # scatter-add instead of the full path's [B, N] one-hot einsum:
+            # integer adds to distinct rows (one commit per node per round),
+            # bit-identical and O(B·R) instead of O(B·N·R)
+            rows = jnp.clip(choice, 0, n_cap - 1)
+            addm = commit[:, None]
+            req = dyn.requested.at[rows].add(
+                jnp.where(addm, batch.request, 0).astype(dyn.requested.dtype))
+            nz = dyn.non_zero.at[rows].add(
+                jnp.where(addm, batch.non_zero, 0).astype(dyn.non_zero.dtype))
+            return DynamicState(requested=req, non_zero=nz)
+
+        def cond(state):
+            _, _, active, _, _, rounds = state
+            return jnp.any(active) & (rounds <= b)
+
+        def body(state):
+            dyn, assigned, active, unsched, feas_n, rounds = state
+            mask_r, scores_r = dense_rep(dyn)
+            feasible = jnp.any(mask_r, axis=1)[class_of]
+            commit, choice, new_unsched = auction_commits(
+                active, feasible, mask_r, scores_r
+            )
+            dyn = apply_dyn(dyn, commit, choice)
+            resolved = commit | new_unsched
+            feas_n = jnp.where(
+                resolved & active,
+                jnp.sum(mask_r, axis=1).astype(jnp.int32)[class_of], feas_n
+            )
+            assigned = jnp.where(commit, choice, assigned)
+            active = active & ~resolved
+            unsched = unsched | new_unsched
+            return dyn, assigned, active, unsched, feas_n, rounds + 1
+
+        init = (
+            dyn,
+            jnp.full(b, -1, jnp.int32),
+            batch.valid,
+            jnp.zeros(b, bool),
+            jnp.zeros(b, jnp.int32),
+            jnp.asarray(0, jnp.int32),
+        )
+        dyn, assigned, _, _, feas_n, rounds = jax.lax.while_loop(
+            cond, body, init)
         return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn,
                             rounds=rounds)
 

@@ -90,6 +90,13 @@ class CoschedulingPlugin(Plugin):
     def prepare(self, batch, snap, dyn, host_aux):
         return host_aux
 
+    def host_aux_take(self, aux, rows):
+        """Row-gather the pod-indexed half of the host aux (identity-class
+        dedup builds a class-representative view; the slice-domain plane is
+        node-indexed and shared)."""
+        slice_dom, anchor = aux
+        return (slice_dom, anchor[rows])
+
     def score(self, batch, snap, dyn, aux, mask=None):
         slice_dom, anchor = aux
         match = (anchor[:, None] == slice_dom[None, :]) & (anchor[:, None] >= 0)

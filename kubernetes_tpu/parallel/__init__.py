@@ -2,6 +2,9 @@
 
 from .mesh import (  # noqa: F401
     node_sharded_mesh,
+    node_sharding,
+    shard_divisible,
+    shard_host_auxes,
     shard_snapshot,
     replicate,
     NODE_AXIS,

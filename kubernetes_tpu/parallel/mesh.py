@@ -39,6 +39,21 @@ def _node_spec(ndim: int) -> P:
     return P(NODE_AXIS, *([None] * (ndim - 1)))
 
 
+def node_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Dim-0 node-axis NamedSharding for an array of the given rank — the
+    placement ClusterEncoder uses for every node-tier array when it owns a
+    mesh (state/encoding.py set_mesh)."""
+    return NamedSharding(mesh, _node_spec(ndim))
+
+
+def shard_divisible(n: int, mesh: Mesh) -> bool:
+    """Does a tier of n rows split evenly over the mesh's node axis?  The
+    pow-2 tier/bucket discipline guarantees this for power-of-two device
+    counts (set_mesh validates that), so padding shapes stay recompile-
+    stable per shard count — this predicate exists for tests and guards."""
+    return n % mesh.devices.size == 0
+
+
 def shard_snapshot(snap, mesh: Mesh):
     """device_put every per-node array with dim-0 node sharding; the pod tables
     and the dictionary side-table are replicated (they are small and read by
@@ -68,27 +83,27 @@ def shard_dynamic_state(dyn, mesh: Mesh):
 
 def shard_host_auxes(host_auxes, mesh: Mesh, n_nodes: int):
     """Shard host-prepared aux planes: any array whose LAST dim equals the
-    node tier (volume masks, IPA exist-anti-block / static-score planes, all
-    ``[B, N]``) gets node sharding on that axis; everything else replicates.
+    node tier (volume masks, IPA exist-anti-block / static-score planes, the
+    Coscheduling slice-domain vector — ``[..., N]``) gets node sharding on
+    that axis; everything else replicates.
 
-    host_auxes is the dict host_prepare returns: plugin name → None | dict of
-    numpy arrays.
+    Accepts the full host_prepare pytree (plugin name → None | dict | tuple
+    | array) — generalized beyond dicts so the Coscheduling
+    ``(slice_dom[N], anchor[B])`` tuple and any stacked ``[K, ..., N]``
+    whatif fork aux ride the same shard spec instead of silently falling
+    back to replicated.  The node tier is pow-2 padded (shard-divisible for
+    power-of-two meshes), so the sharded shapes are exactly the unsharded
+    ones — no recompile-relevant padding is introduced per shard count.
     """
     if host_auxes is None:
         return None
 
     def put(arr):
-        if hasattr(arr, "shape") and arr.ndim >= 1 and arr.shape[-1] == n_nodes:
+        if not hasattr(arr, "shape"):
+            return arr
+        if arr.ndim >= 1 and arr.shape[-1] == n_nodes:
             spec = P(*([None] * (arr.ndim - 1) + [NODE_AXIS]))
             return jax.device_put(arr, NamedSharding(mesh, spec))
         return jax.device_put(arr, replicate(mesh))
 
-    out = {}
-    for name, aux in host_auxes.items():
-        if aux is None:
-            out[name] = None
-        elif isinstance(aux, dict):
-            out[name] = {k: put(v) for k, v in aux.items()}
-        else:
-            out[name] = put(aux)
-    return out
+    return jax.tree_util.tree_map(put, host_auxes)

@@ -70,6 +70,16 @@ class Workload:
     # the driven controller is a cluster-autoscaler (AutoscaleGang):
     # collect scale-decision + whatif-fork items instead of evictions/s
     autoscaler: bool = False
+    # warm-variant trims for suites whose window provably never runs them:
+    # warm_coupled=False skips the synthetic anti-affinity warm (the greedy
+    # SCAN variant — minutes of compile at a 131k-node tier the 100k basic
+    # suite never routes to); warm_preemption=False keeps the failure-path
+    # warm pod at priority 0 (diagnosis still warms; the preemption
+    # candidate program — a [K, N, R] level table + [B, N, R] freed tensor,
+    # multi-GB at 100k shapes — never compiles because the window's
+    # priority-0 pods can never preempt)
+    warm_coupled: bool = True
+    warm_preemption: bool = True
 
 
 @dataclass
@@ -158,6 +168,10 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # reference has no compile phase to exclude
                 warm_keys = []  # (namespace, name) — suite templates may be namespaced
                 for wi in range(4):
+                    if wi == 2 and not w.warm_coupled:
+                        # suite window provably never routes to the coupled
+                        # scan engine (Workload.warm_coupled)
+                        continue
                     warm = (
                         make_pod().name(f"warmup-pod{wi}").uid(f"warmup-pod{wi}")
                         .namespace("default").req({"cpu": "1m"})
@@ -183,7 +197,13 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                         # the 100000-cpu request can't fit any node even
                         # with every victim evicted, so the warm preemption
                         # nominates nothing and disturbs nothing.
-                        warm = warm.req({"cpu": "100000"}).priority(1)
+                        # warm_preemption=False keeps priority 0: the
+                        # failure/diagnosis path still warms, the candidate
+                        # program (multi-GB at a 131k tier) never compiles —
+                        # sound only when the window can never preempt.
+                        warm = warm.req({"cpu": "100000"})
+                        if w.warm_preemption:
+                            warm = warm.priority(1)
                     warm = warm.obj()
                     store.create("Pod", warm)
                     sched.schedule_cycle()

@@ -128,6 +128,23 @@ def _unpack_diag(bits: np.ndarray, n_filters: int) -> np.ndarray:
     ).astype(bool)
 
 
+def _host_aux_take(fw, host_auxes, rows):
+    """Row-gather the pod-indexed host auxes for the identity-class rep
+    view: a plugin owning a pod-indexed host aux exposes ``host_aux_take``
+    (Coscheduling's anchor vector); auxes without the hook pass through —
+    the dedup gate only admits None or class-uniform values for them."""
+    host_auxes = host_auxes or {}
+    out = {}
+    for pw in fw.plugins:
+        name = pw.plugin.name
+        if name not in host_auxes:
+            continue
+        aux = host_auxes[name]
+        fn = getattr(pw.plugin, "host_aux_take", None)
+        out[name] = aux if aux is None or fn is None else fn(aux, rows)
+    return out
+
+
 def _pods_block_deep(pods: Sequence[v1.Pod]) -> bool:
     """True when any pod carries state the deep pipeline cannot chain
     between batches: host-port sets and volume bindings live in host-side
@@ -281,6 +298,7 @@ class TPUScheduler:
         nominated_fast_bind: bool = True,
         chain_affinity: object = "auto",
         fence=None,
+        sharding: object = "auto",
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -343,6 +361,42 @@ class TPUScheduler:
         self.cache = Cache(clock=clock)
         self.snapshot = Snapshot()
         self.encoder = ClusterEncoder()
+        # Node-axis sharding (parallel/mesh.py): the DeviceSnapshot's node
+        # tier partitions across a device mesh, the fused cycle program runs
+        # over the sharded arrays (GSPMD inserts the cross-shard reductions
+        # — row max/min, argmax/top-k merges, domain scatter-adds — so
+        # sharded == unsharded bindings bit-for-bit, pinned in
+        # tests/test_sharding_runtime.py), and the incremental scatter/sync
+        # path updates shards in place without re-replicating the tier.
+        # "auto" mirrors chain_affinity's backend gate: on for multi-device
+        # accelerators, off on plain CPU where partitioning one core is pure
+        # overhead; "on"/True shards over the largest pow-2 device prefix
+        # (tests force this on the virtual CPU mesh), an int shards over
+        # the first n (n must be a power of two).
+        self.mesh = None
+        if sharding == "auto":
+            # auto never crashes on an odd topology: the mesh requires a
+            # power-of-two device count, so shard over the largest pow-2
+            # prefix (6 GPUs -> 4) and stay unsharded on 1.
+            n_dev = len(jax.devices())
+            n_pow2 = 1 << (n_dev.bit_length() - 1)
+            sharding = (n_pow2 if n_pow2 > 1
+                        and jax.default_backend() != "cpu" else False)
+        if sharding is True or sharding == "on":
+            # largest pow-2 device prefix (the mesh requires pow-2): "on"
+            # means "shard", not "crash on a 6-GPU host"
+            all_dev = jax.devices()
+            devices = all_dev[: 1 << (len(all_dev).bit_length() - 1)]
+        elif isinstance(sharding, int) and not isinstance(sharding, bool) \
+                and sharding > 1:
+            devices = jax.devices()[: sharding]
+        else:
+            devices = None
+        if devices:
+            from .parallel import node_sharded_mesh
+
+            self.mesh = node_sharded_mesh(devices)
+            self.encoder.set_mesh(self.mesh)
         self.namespace_labels = namespace_labels or {}
         self.compiler = PodBatchCompiler(self.encoder, self.namespace_labels)
         from .plugins.volumes import StoreVolumeListers
@@ -653,6 +707,18 @@ class TPUScheduler:
 
         n_filters = len(fw.filter_names)
 
+        def pack_diag(bits, node_row, rounds):
+            if n_filters <= 31:
+                packed_bits = jnp.sum(
+                    bits.astype(jnp.int32)
+                    << jnp.arange(n_filters, dtype=jnp.int32)[None, :],
+                    axis=1,
+                )
+                rrow = jnp.full_like(packed_bits, jnp.asarray(rounds, jnp.int32))
+                return jnp.stack(
+                    [node_row.astype(jnp.int32), packed_bits, rrow])
+            return bits  # >31 filter plugins: unpacked legacy shape
+
         def diagnostics(batch, dsnap, dyn, auxes, node_row, rounds):
             # FitError diagnosis bits in the SAME program (XLA CSEs the
             # filter planes) — the eager fallback paid a ~100ms pacing round
@@ -667,20 +733,8 @@ class TPUScheduler:
             # device→host fetch on the tunnel pays its own ~100ms round, so
             # fetching decisions and diagnosis separately doubled the
             # per-cycle fetch cost (measured in the r4 preemption suite).
-            bits = fw.diagnose_bits(batch, dsnap, dyn, auxes)
-            if n_filters <= 31:
-                packed_bits = jnp.sum(
-                    bits.astype(jnp.int32)
-                    << jnp.arange(n_filters, dtype=jnp.int32)[None, :],
-                    axis=1,
-                )
-                # row 2: the engine's round count, broadcast — rides the
-                # same one-round fetch so assignment_rounds_total costs no
-                # extra device→host trip
-                rrow = jnp.full_like(packed_bits, jnp.asarray(rounds, jnp.int32))
-                return jnp.stack(
-                    [node_row.astype(jnp.int32), packed_bits, rrow])
-            return bits  # >31 filter plugins: unpacked legacy shape
+            return pack_diag(
+                fw.diagnose_bits(batch, dsnap, dyn, auxes), node_row, rounds)
 
         # gang all-or-nothing: a segment-sum pass over per-pod gang ids
         # withdraws every member of a gang with ANY unplaced member, INSIDE
@@ -705,7 +759,8 @@ class TPUScheduler:
                 batch, dsnap, dyn, auxes, res.node_row, res.rounds)
 
         def fused_batch(batch, dsnap, upd, nom_rows, nom_req, prevs,
-                        host_auxes, order, gang_seg, coupling, key):
+                        host_auxes, order, gang_seg, coupling, key,
+                        classes=None):
             dsnap = apply_scatter(dsnap, upd)
             dyn = reserve_nominated(dsnap, nom_rows, nom_req)
             for prev in prevs:
@@ -713,11 +768,35 @@ class TPUScheduler:
             auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
             for prev in prevs:
                 auxes = fw.chain_prev(batch, dsnap, auxes, prev)
-            res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling, key)
+            if classes is None:
+                res = fw.batch_assign(batch, dsnap, dyn, auxes, order,
+                                      coupling, key)
+                res = res._replace(
+                    node_row=gang_all_or_nothing(res.node_row, gang_seg))
+                return res, auxes, dsnap, dyn, diagnostics(
+                    batch, dsnap, dyn, auxes, res.node_row, res.rounds)
+            # identity-class dedup (TPUScheduler._dedup_classes gate): the
+            # dense planes and the diagnosis bits compute once per
+            # exact-content pod class ([C, N] instead of [B, N]) — at 131k
+            # nodes this is the difference between 18s and 0.26s of device
+            # compute per cycle, bit-for-bit equal (runtime.py
+            # _batch_assign_dedup).  The full `auxes` above stay in the
+            # output pytree for the bind-phase consumers (candidate mask);
+            # under the gate they are all None, so nothing is materialized.
+            class_of, rep_rows = classes
+            rep_batch = batch.take(rep_rows)
+            rep_host = _host_aux_take(fw, host_auxes, rep_rows)
+            rep_auxes = fw.prepare(rep_batch, dsnap, dyn, rep_host)
+            for prev in prevs:
+                rep_auxes = fw.chain_prev(rep_batch, dsnap, rep_auxes, prev)
+            res = fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling,
+                                  key, classes=(class_of, rep_batch,
+                                                rep_auxes))
             res = res._replace(
                 node_row=gang_all_or_nothing(res.node_row, gang_seg))
-            return res, auxes, dsnap, dyn, diagnostics(
-                batch, dsnap, dyn, auxes, res.node_row, res.rounds)
+            bits = fw.diagnose_bits(rep_batch, dsnap, dyn, rep_auxes)[class_of]
+            return res, auxes, dsnap, dyn, pack_diag(
+                bits, res.node_row, res.rounds)
 
         def cand_mask(batch, dsnap, dyn, auxes, levels):
             static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
@@ -1002,6 +1081,20 @@ class TPUScheduler:
         # is this build's PreFilter/PreScore analog, the fused dispatch its
         # Filter+Score (observed below) — was registered-but-unemitted
         m.framework_extension_point_duration.observe(dt_hp, ("host_prepare",))
+        gate_auxes = None
+        if self.mesh is not None:
+            # pre-place host aux planes with node-axis sharding on their
+            # node dim: device_put here is the explicit analog of the
+            # snapshot's sharded upload — without it GSPMD would replicate
+            # the [B, N] planes onto every shard at dispatch.  The dedup
+            # gate reads the Coscheduling anchor, so it keeps the pre-put
+            # host arrays: the same read on the placed copy would be a
+            # blocking device round every cycle
+            from .parallel.mesh import shard_host_auxes
+
+            gate_auxes = host_auxes
+            host_auxes = shard_host_auxes(
+                host_auxes, self.mesh, self.encoder._n)
         if self.extenders:
             # round-based cycles: each pod's decision lands at its own
             # round, so per-attempt latency must not absorb later pods'
@@ -1073,7 +1166,7 @@ class TPUScheduler:
         part0 = self.phase_wall["partition"]
         (res, auxes, dsnap_out, dyn_out, diag), engine = self._run_assignment(
             jt, batch, dsnap, upd, nom_rows, nom_req, host_auxes,
-            deltas=deltas, gang_seg=gang_seg,
+            deltas=deltas, gang_seg=gang_seg, gate_auxes=gate_auxes,
         )
         # dispatch wall excludes the partition slice timed inside
         dt_disp = (self.clock() - t_d) - (
@@ -1609,7 +1702,8 @@ class TPUScheduler:
         m.pending_pods.set(len(self._waiting_binds), ("gated",))
 
     def _run_assignment(self, jt, batch, dsnap, upd, nom_rows, nom_req,
-                        host_auxes, deltas=None, gang_seg=None):
+                        host_auxes, deltas=None, gang_seg=None,
+                        gate_auxes=None):
         """Dispatch between the conflict-partitioned batch engine and the
         exact serial scan (the parity oracle).  "auto" partitions the batch
         into pod–pod interaction components (framework/conflict.py: affinity
@@ -1662,11 +1756,60 @@ class TPUScheduler:
             return jt["batch"](
                 batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes,
                 order, gang_seg, coupling, self.rng_key,
+                self._dedup_classes(
+                    batch,
+                    host_auxes if gate_auxes is None else gate_auxes),
             ), "batch"
         return jt["greedy"](
             batch, dsnap, upd, nom_rows, nom_req, delta, host_auxes, order,
             gang_seg, self.rng_key,
         ), "scan"
+
+    def _dedup_classes(self, batch, host_auxes):
+        """Identity-class dedup gate + sticky-padded classes for the batch
+        engine (framework/podbatch.py identity_classes).
+
+        Dedup is sound only when every input to a pod's filter/score planes
+        is carried by its compiled batch rows: no (anti)affinity or spread
+        content (their auxes carry cross-pod state the rep planes couldn't
+        see), no pod-indexed host aux (volume masks encode per-pod PVC
+        state that is NOT in the batch arrays), and no per-pod tie noise
+        (rng_key).  Coscheduling's host aux is admitted when no batch pod
+        anchors a gang (the anchor vector is then uniformly negative — the
+        plugin's ``host_aux_take`` builds the rep view; under a mesh the
+        caller passes the pre-device_put host arrays so this read never
+        costs a device round).  Returns ``(class_of i32[B],
+        rep_rows i32[Cp])`` or None (full path).
+
+        Cp is the pow-2 bucket of the class count (floor 4, repeated first
+        rep — duplicate classes compute redundant but harmless plane rows)
+        so class-count jitter inside a bucket never changes compiled
+        shapes; a heterogeneous batch (C > B/2: Cp would be ~B, the dedup
+        planes as wide as the full path's plus gather overhead) takes the
+        full path instead.
+        """
+        if self.rng_key is not None:
+            return None
+        if getattr(batch, "has_affinity", False) or \
+                getattr(batch, "has_spread", False):
+            return None
+        for name, aux in (host_auxes or {}).items():
+            if aux is None:
+                continue
+            if name == "Coscheduling":
+                anchor = np.asarray(aux[1])
+                if anchor.size == 0 or int(anchor.max()) < 0:
+                    continue
+            return None
+        from .framework.podbatch import identity_classes
+
+        class_of, reps = identity_classes(batch)
+        if len(reps) * 2 > batch.size:
+            return None
+        cpad = _pow2(len(reps), 4)
+        padded = np.full(cpad, reps[0], dtype=np.int32)
+        padded[: len(reps)] = reps
+        return class_of, padded
 
     def engine_choice(self, batch):
         """The auto/batch/scan routing decision as ONE shared predicate:

@@ -209,6 +209,48 @@ class ClusterEncoder:
         self._scatter_bucket.setdefault("aff_valid", 8)
         self._numeric_min = 1024  # floor for the numeric side-table pow2 size
         self._shape_changed = True
+        # optional node-axis device mesh (parallel/mesh.py): when set, every
+        # full upload places node-tier arrays with dim-0 NamedSharding over
+        # the mesh and replicates the pod/aff/numeric tables; the scatter
+        # paths update the sharded buffers in place (GSPMD keeps the output
+        # sharding of a row-scatter into a sharded operand), so steady-state
+        # incremental sync never re-replicates the node tier.
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> None:
+        """Adopt a node-axis mesh for device uploads (ClusterEncoder owns
+        the sharding decision so every upload path — full, eager scatter,
+        deferred scatter — agrees).  Requires a power-of-two device count:
+        the pow-2 tier growth discipline then keeps every node tier
+        shard-divisible for free (pow2 ≥ mesh size divides evenly)."""
+        n_dev = mesh.devices.size
+        if n_dev & (n_dev - 1):
+            raise ValueError(
+                f"node-axis mesh needs a power-of-two device count, got "
+                f"{n_dev} — pow2 tier growth cannot stay shard-divisible")
+        if self._n % n_dev:
+            # pre-mesh tiers are pow2 ≥ min_nodes(64); only a mesh larger
+            # than the tier can fail this — grow to cover it
+            self._grow_nodes(n_dev)
+        self.mesh = mesh
+        self._shape_changed = True  # next upload must (re-)place per shard
+
+    def _puts(self):
+        """(put_node, put_other) placement fns for the current mesh."""
+        if self.mesh is None:
+            return jnp.asarray, jnp.asarray
+        import jax
+        from ..parallel.mesh import node_sharding, replicate
+
+        repl = replicate(self.mesh)
+
+        def put_node(arr):
+            return jax.device_put(arr, node_sharding(self.mesh, arr.ndim))
+
+        def put_other(arr):
+            return jax.device_put(arr, repl)
+
+        return put_node, put_other
 
     # affinity-group arrays live on the index; exposed here so the generic
     # array-group upload machinery (_gather_rows / to_device) reads them by
@@ -687,19 +729,35 @@ class ClusterEncoder:
 
     def to_device(self, sharding=None, force_full: bool = False) -> DeviceSnapshot:
         """Upload: full device_put when shapes changed or dirt is large, else
-        row-scatter updates into the existing buffers."""
-        import jax
+        row-scatter updates into the existing buffers.
 
+        ``sharding``: a jax.sharding.Mesh adopts node-axis sharding for THIS
+        and every later upload (equivalent to set_mesh); any other
+        jax.sharding.Sharding is applied uniformly to all arrays (the raw
+        escape hatch).  With a mesh installed, node-tier arrays get dim-0
+        NamedSharding and everything else replicates."""
+        import jax
+        from jax.sharding import Mesh
+
+        if isinstance(sharding, Mesh):
+            if sharding is not self.mesh:
+                self.set_mesh(sharding)
+            sharding = None
         numeric, use_scatter = self._upload_gate()
         if force_full:
             use_scatter = False
         numeric_stale = len(self.dic) != self._uploaded_numeric_len
         if not use_scatter:
-            put = (lambda x: jax.device_put(x, sharding)) if sharding else jnp.asarray
+            if sharding is not None:
+                put_node = put_other = (lambda x: jax.device_put(x, sharding))
+            else:
+                put_node, put_other = self._puts()
+            node_set = set(_NODE_ARRAYS)
             self._device = DeviceSnapshot(
-                **{k: put(getattr(self, k))
+                **{k: (put_node if k in node_set else put_other)(
+                    getattr(self, k))
                    for k in _NODE_ARRAYS + _POD_ARRAYS + _AFF_ARRAYS},
-                numeric=jnp.asarray(numeric),
+                numeric=put_other(numeric),
             )
         else:
             d = self._device

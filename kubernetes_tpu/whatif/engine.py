@@ -148,6 +148,14 @@ class WhatIfEngine:
         if vmapped and len(forks) > 1:
             stacked_aux = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *host_auxes)
+            if getattr(sched, "mesh", None) is not None:
+                # the [K, ..., N] stacked fork planes ride the same node-axis
+                # shard spec as the snapshot — without this the vmapped solve
+                # would silently replicate them onto every shard
+                from ..parallel.mesh import shard_host_auxes
+
+                stacked_aux = shard_host_auxes(stacked_aux, sched.mesh,
+                                               enc._n)
             rows_k = np.asarray(progs["k"](
                 batch, dsnap, stack_payloads(payloads), stacked_aux,
                 coupling, sched.rng_key, *args))

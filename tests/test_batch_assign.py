@@ -371,3 +371,97 @@ def test_coupled_batch_divergence_bounded():
     # what the auction DID place is valid: one green pod per hostname domain
     placed = p[p >= 0]
     assert len(set(placed.tolist())) == len(placed)
+
+
+# --- identity-class dedup (round 9): [C, N] planes, bit-exact ---------------
+
+
+def _run_dedup(fw, batch, snap_host, enc, dsnap, dyn, auxes):
+    """batch_assign through the dedup path, the way the scheduler's fused
+    program wires it: rep batch gathered inside the traced program, rep
+    auxes from a rep-view prepare."""
+    from kubernetes_tpu.framework.podbatch import identity_classes
+
+    host_auxes = fw.host_prepare(batch, snap_host, enc)
+    assert all(v is None for v in host_auxes.values())
+    class_of, reps = identity_classes(batch)
+
+    def run(batch, dsnap, dyn, auxes, order, coupling, class_of, reps):
+        rb = batch.take(reps)
+        ra = fw.prepare(rb, dsnap, dyn, host_auxes)
+        return fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling,
+                               classes=(class_of, rb, ra))
+
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    return jax.jit(run)(batch, dsnap, dyn, auxes, order, coupling,
+                        class_of, reps), len(reps)
+
+
+def test_dedup_matches_full_path_under_contention():
+    """20 identical + 4 second-template pods over 24 nodes: multi-round
+    contention where every node is claimed — deduped class planes must
+    reproduce the full path's rows, feasible counts, and dyn bit-for-bit."""
+    rng = np.random.default_rng(7)
+    cache = build_cluster(rng, n_nodes=24, n_sched=8)
+    pods = [make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+            .req({"cpu": "1", "memory": "1Gi"}).label("app", "web").obj()
+            for i in range(20)]
+    pods += [make_pod().name(f"q{i}").uid(f"q{i}").namespace("default")
+             .req({"cpu": "2", "memory": "1Gi"}).label("app", "db").obj()
+             for i in range(4)]
+    fw, batch, snap, enc, dsnap, dyn, auxes = device_pipeline(cache, pods)
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    full = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling)
+    dedup, n_classes = _run_dedup(fw, batch, snap, enc, dsnap, dyn, auxes)
+    assert n_classes <= 3  # two templates + padding collapse
+    assert np.array_equal(np.asarray(full.node_row),
+                          np.asarray(dedup.node_row))
+    assert np.array_equal(np.asarray(full.feasible_count),
+                          np.asarray(dedup.feasible_count))
+    assert np.array_equal(np.asarray(full.dyn.requested),
+                          np.asarray(dedup.dyn.requested))
+
+
+def test_dedup_matches_full_path_failures_and_nominated():
+    """Unschedulable rows (-1) and the nominated-node fast path must agree
+    with the full path too — not just the happy placements."""
+    cache = _uniform_cluster(n_nodes=6, cpu="4")
+    pods = [make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+            .req({"cpu": "3", "memory": "1Gi"}).obj() for i in range(8)]
+    # a template that fits nowhere → every instance resolves unschedulable
+    pods += [make_pod().name(f"x{i}").uid(f"x{i}").namespace("default")
+             .req({"cpu": "64", "memory": "1Gi"}).obj() for i in range(3)]
+    nom = make_pod().name("nom").uid("nom").namespace("default") \
+        .req({"cpu": "1", "memory": "1Gi"}).obj()
+    nom.status.nominated_node_name = "n04"
+    pods.append(nom)
+    # sync BEFORE compile (the scheduler's dispatch order) so the nominated
+    # node name resolves to its encoder row at batch-compile time
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+    from tests.test_parity import default_framework
+
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    enc.full_sync(snap)
+    batch = PodBatchCompiler(enc).compile(pods)
+    fw = default_framework(enc)
+    host_auxes = fw.host_prepare(batch, snap, enc)
+    dsnap = enc.to_device()
+    dyn = initial_dynamic_state(dsnap)
+    auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+    assert int(np.asarray(batch.nominated_row).max()) >= 0  # nom resolved
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    full = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling)
+    dedup, _ = _run_dedup(fw, batch, snap, enc, dsnap, dyn, auxes)
+    rows_full = np.asarray(full.node_row)
+    rows_dedup = np.asarray(dedup.node_row)
+    assert np.array_equal(rows_full, rows_dedup)
+    assert (rows_full[8:11] == -1).all()  # the 64-cpu template fits nowhere
+    assert rows_full[11] == enc.node_rows["n04"]  # nominated fast path held
+    assert np.array_equal(np.asarray(full.feasible_count),
+                          np.asarray(dedup.feasible_count))

@@ -151,3 +151,35 @@ def test_label_annotate_patch_rollout_and_json():
     rs.status_ready_replicas = 2
     store.update("ReplicaSet", rs)
     assert "successfully rolled out" in k.rollout_status("deploy", "default", "web")
+
+
+def test_topology_verb():
+    """ktpu topology: device table + shard line, live mesh view when an
+    in-process scheduler owns one."""
+    import jax
+
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    store = ObjectStore()
+    k = Kubectl(store)
+    store.create("Node", make_node().name("n0").obj())
+    out = k.topology()
+    assert "DEVICE" in out and "node-axis sharding: off" in out
+    assert "1 Node objects" in out
+    if len(jax.devices()) >= 2:
+        sched = TPUScheduler(store, sharding=2)
+        out = k.topology(scheduler=sched)
+        assert "node-axis sharding: on — 2 devices" in out
+        rows_per_shard = sched.encoder._n // 2
+        assert f"{rows_per_shard}/shard" in out
+        status = k.autoscaler_status(controller=type(
+            "C", (), {"last_decisions": [], "scheduler": sched})())
+        assert "node-axis sharding: on" in status
+        sched.close()
+
+
+def test_cli_main_topology(capsys):
+    from kubernetes_tpu.cli import main
+
+    main(["topology"])
+    assert "node-axis sharding" in capsys.readouterr().out

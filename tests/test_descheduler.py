@@ -618,11 +618,14 @@ def test_mid_plan_refusal_abandons_plan():
                                  policies=[SliceDefragmentation()])
     before = m.descheduler_plans.value(("defrag", "abandoned"))
 
-    # race: after scoring, a PDB claims the s1 stragglers with zero budget
-    real_score = ctrl.score
+    # race: after scoring, a PDB claims the s1 stragglers with zero budget.
+    # Hook the verdict seam (_scored) — the round-9 vmapped group scan
+    # solves all candidates in one evaluate, so per-plan score() no longer
+    # runs for grouped candidates, but every verdict still passes here.
+    real_scored = ctrl._scored
 
-    def score_then_protect(plan):
-        scored = real_score(plan)
+    def scored_then_protect(plan, prediction):
+        scored = real_scored(plan, prediction)
         if scored.viable and not store.get(
                 "PodDisruptionBudget", "default", "race"):
             for v_ in plan.victims:
@@ -636,7 +639,7 @@ def test_mid_plan_refusal_abandons_plan():
             sync_pdbs(store)
         return scored
 
-    ctrl.score = score_then_protect
+    ctrl._scored = scored_then_protect
     ctrl.sync_once()
     assert m.descheduler_plans.value(("defrag", "abandoned")) == before + 1.0
     # not half-applied: both stragglers still present, cluster intact

@@ -224,6 +224,57 @@ def render_r6_ab(ab):
     return "\n".join(lines)
 
 
+R9_BEGIN = ("<!-- GENERATED:PERF:R9100K:BEGIN (tools/render_perf_docs.py — "
+            "edit BENCH_r09_100K.json, not this block) -->")
+R9_END = "<!-- GENERATED:PERF:R9100K:END -->"
+
+
+def render_r9_100k(ab):
+    """Round-9 live-100k vs one-shot A/B table (BENCH_r09_100K.json).
+
+    A --skip-baseline artifact (no baseline_one_shot, null ratio) still
+    renders: the live row alone, no ratio sentence."""
+    live = ab["live_suite"]["detail"]
+    base = ab.get("baseline_one_shot")
+    ratio = ab.get("throughput_ratio")
+    lines = [
+        R9_BEGIN,
+        "",
+        "| arm | pods/s | note |",
+        "|---|---|---|",
+    ]
+    if base is not None:
+        lines.append(
+            f"| one-shot baseline | {base['warm_assign_pods_per_s']} | "
+            f"{base.get('config', 'one-shot')}: warm "
+            f"{base.get('pending_batch', '?')}-pod greedy assign step, "
+            "virtual 8-device mesh |")
+    lines += [
+        (f"| live NorthStar/100kNodes | "
+         f"{live['throughput_pods_per_s']} | end to end "
+         f"(store → sync → dedup cycle → bind) at {live['nodes']} nodes, "
+         f"backend {live.get('backend', '?')} |"),
+        "",
+    ]
+    if ratio is not None:
+        lines.append(
+            f"Live end-to-end throughput is **{ratio}×** the "
+            "one-shot warm ASSIGNMENT rate re-measured on the same hardware"
+            + (f" ({ab['vs_committed_SCALE_100K_EXEC']}× vs the committed "
+               "SCALE_100K_EXEC rate)"
+               if "vs_committed_SCALE_100K_EXEC" in ab else "")
+            + " — and the live number additionally pays snapshot sync, "
+              "queue, binding and store writes the one-shot never did.")
+    lines += [
+        (f"Attempt p50/p99 {live['attempt_ms']['p50']:.1f}/"
+         f"{live['attempt_ms']['p99']:.1f} ms; in-window compiles: "
+         f"{live['xla_compiles_in_window']['count']}."),
+        "",
+        R9_END,
+    ]
+    return "\n".join(lines)
+
+
 def splice(path, block, begin=BEGIN, end=END):
     p = os.path.join(REPO, path)
     text = open(p).read()
@@ -257,6 +308,12 @@ def main() -> int:
         ab = None  # pre-round-6 trees have no A/B artifact
     if ab is not None:
         ok &= splice("COMPONENTS.md", render_r6_ab(ab), AB_BEGIN, AB_END)
+    try:
+        r9 = load_bench("BENCH_r09_100K.json")
+    except (OSError, json.JSONDecodeError):
+        r9 = None  # pre-round-9 trees have no live-100k artifact
+    if r9 is not None:
+        ok &= splice("COMPONENTS.md", render_r9_100k(r9), R9_BEGIN, R9_END)
     return 0 if ok else 1
 
 

@@ -40,6 +40,14 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_whatif.py tests/test_a
 # fail fast here; the full 500-pod soak runs behind the slow marker
 JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_recovery.py -q -m 'not slow' \
   || { echo "FAILED: recovery test gate" >> suites_run.log; exit 1; }
+# sharding-parity gate: the node-sharded live runtime and the
+# identity-class dedup path (round 9) must bind bit-for-bit with the
+# unsharded/full paths — perf rows from a diverging program would be
+# measuring a different scheduler, so fail fast before any suite runs
+JAX_PLATFORMS=cpu timeout 900 python -m pytest \
+  tests/test_sharding.py tests/test_sharding_runtime.py \
+  tests/test_batch_assign.py -q -m 'not slow' \
+  || { echo "FAILED: sharding parity gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -93,6 +101,12 @@ run AutoscaleGang 5000Nodes
 run SchedulingExtender 500Nodes
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
+# the production-scale row (ROADMAP item 1): 100,352 nodes scheduled LIVE
+# end to end; the zero-compile gate holds it to the same warm discipline
+# as the 5k table — an in-window compile at a 131k-node tier is minutes
+# of stall and taints the whole row
+run NorthStar 100kNodes
+gate_zero_compiles NorthStar
 dline=$(BENCH_SUITE=Density BENCH_SIZE=1000Nodes/30000Pods BENCH_ORACLE_SAMPLE=4 \
   timeout 3000 python bench.py 2>> suites_run.log | tail -1)
 if [ -n "$dline" ] && python -c "import json,sys; json.loads(sys.argv[1])" "$dline" 2>/dev/null; then
