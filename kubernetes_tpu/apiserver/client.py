@@ -104,6 +104,24 @@ class HTTPApiClient:
         objs = [self.scheme.decode(m) for m in payload.get("items", [])]
         return objs, rv
 
+    def list_page(self, kind: str, limit: int = 0,
+                  continue_: Optional[str] = None
+                  ) -> Tuple[List[object], int, str]:
+        """One rv-consistent page: (objects, rv, continue token; '' at the
+        end).  The server pins every page of a walk to the first page's rv
+        (watch-cache pagination); an expired token surfaces as HTTPError
+        410 — the caller restarts its walk from a fresh LIST (the
+        reflector's paged-relist retry loop does)."""
+        query = f"limit={limit}" if limit else ""
+        if continue_:
+            query += f"&continue={continue_}" if query \
+                else f"continue={continue_}"
+        payload = self._request("GET", self._url(kind, query=query))
+        meta = payload.get("metadata", {})
+        rv = int(meta.get("resourceVersion", "0"))
+        objs = [self.scheme.decode(m) for m in payload.get("items", [])]
+        return objs, rv, meta.get("continue", "")
+
     def for_kind(self, kind: str) -> "_KindClient":
         """A (list, watch) view of ONE kind — the shape Reflector expects.
         In-process stores multiplex kinds on one watch; HTTP serves one
@@ -318,6 +336,9 @@ class _KindClient:
 
     def list(self, kind: str):
         return self._client.list(kind)
+
+    def list_page(self, kind: str, limit: int = 0, continue_=None):
+        return self._client.list_page(kind, limit=limit, continue_=continue_)
 
     def watch(self, handler, since_rv: int = 0, on_bookmark=None,
               on_error=None):

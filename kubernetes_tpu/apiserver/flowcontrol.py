@@ -1,0 +1,195 @@
+"""APF-style request flow control: split max-inflight pools + per-user
+fairness queues answering 429 + Retry-After.
+
+Reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol
+(apf_controller.go) and the older --max-requests-inflight /
+--max-mutating-requests-inflight filters.  The properties kept:
+
+  - MUTATING and READONLY requests draw from SEPARATE seat pools, so a
+    flood of greedy readers can exhaust every readonly seat without
+    delaying a single write — the "mutating never starves" contract the
+    flood test pins;
+  - when a pool is full, requests WAIT in bounded per-user queues and
+    seats hand off round-robin ACROSS USERS (the fair-queuing half of
+    APF): one user's thousand queued lists cannot starve another user's
+    one;
+  - a queue past its per-user bound, or a wait past the queue timeout,
+    answers 429 + Retry-After — which the PR-1 retrying transports
+    (HTTPApiClient, chaos.RetryingStore) already honor, so a shed request
+    is retried-to-success, never lost.
+
+WATCH requests occupy a readonly seat only through the handshake (routing,
+authn/z, subscription): the apiserver releases the seat before entering the
+stream loop, matching APF's treatment of long-running requests.
+
+Observability: ``apiserver_inflight_requests{kind}`` tracks seats held per
+pool; ``apiserver_rejected_requests_total{reason}`` counts sheds by
+``{mutating,readonly}_{queue_full,timeout}``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from ..analysis import lockcheck
+from ..metrics import scheduler_metrics as m
+
+
+class RequestRejected(Exception):
+    """This request was shed (429 TooManyRequests + Retry-After)."""
+
+    def __init__(self, reason: str, retry_after: float, message: str = ""):
+        super().__init__(message or f"request rejected: {reason}")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class _Waiter:
+    __slots__ = ("event", "granted")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.granted = False
+
+
+class _Seat:
+    """A held inflight seat; ``release`` is idempotent (the handler's
+    finally always runs it, and the watch path releases early)."""
+
+    __slots__ = ("_gate", "_released")
+
+    def __init__(self, gate: "_InflightGate"):
+        self._gate = gate
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._gate._release()
+
+
+class _InflightGate:
+    """One seat pool (mutating OR readonly) with per-user fair queuing."""
+
+    def __init__(self, kind: str, max_inflight: int, max_queue_per_user: int,
+                 queue_timeout: float, retry_after: float,
+                 max_queued_total: Optional[int] = None):
+        self.kind = kind
+        self.max_inflight = max_inflight
+        self.max_queue_per_user = max_queue_per_user
+        self.queue_timeout = queue_timeout
+        self.retry_after = retry_after
+        # TOTAL queued bound across all users: the per-user bound alone is
+        # bypassable by rotating the (unauthenticated) fairness identity —
+        # a flooder minting a fresh user per request would otherwise grow
+        # queues and handler threads without ever seeing a 429 (APF bounds
+        # total seats+queues the same way).  Default: 8 queued per seat.
+        self.max_queued_total = (max_queued_total if max_queued_total
+                                 is not None else max_inflight * 8)
+        self._lock = lockcheck.maybe_wrap(
+            threading.Lock(), f"FlowGate[{kind}]._lock")
+        self._inflight = 0
+        self._queues: Dict[str, Deque[_Waiter]] = {}
+        self._queued_total = 0
+        self._rr = 0  # round-robin cursor over users with waiters
+
+    def acquire(self, user: str) -> _Seat:
+        with self._lock:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                m.apiserver_inflight.set(float(self._inflight), (self.kind,))
+                return _Seat(self)
+            q = self._queues.get(user)
+            if (q is not None and len(q) >= self.max_queue_per_user) or \
+                    self._queued_total >= self.max_queued_total:
+                m.apiserver_rejected.inc((f"{self.kind}_queue_full",))
+                raise RequestRejected(
+                    f"{self.kind}_queue_full", self.retry_after,
+                    f"too many queued {self.kind} requests for {user!r}")
+            w = _Waiter()
+            if q is None:
+                q = self._queues[user] = deque()
+            q.append(w)
+            self._queued_total += 1
+        if w.event.wait(self.queue_timeout):
+            return _Seat(self)  # seat handed over by a releaser
+        with self._lock:
+            if w.granted:
+                # granted exactly at the deadline: the seat is ours
+                return _Seat(self)
+            q = self._queues.get(user)
+            if q is not None:
+                try:
+                    q.remove(w)
+                    self._queued_total -= 1
+                except ValueError:
+                    pass  # a concurrent grant raced the timeout path above
+                if not q:
+                    del self._queues[user]
+        m.apiserver_rejected.inc((f"{self.kind}_timeout",))
+        raise RequestRejected(
+            f"{self.kind}_timeout", self.retry_after,
+            f"{self.kind} request queued past "
+            f"{self.queue_timeout:g}s for {user!r}")
+
+    def _release(self) -> None:
+        wake: Optional[_Waiter] = None
+        with self._lock:
+            # hand the seat to the next user's head waiter, round-robin
+            # across users — the fair-queuing guarantee: seat handoffs
+            # rotate over DISTINCT users, not FIFO over one user's flood
+            users = [u for u, q in self._queues.items() if q]
+            if users:
+                u = users[self._rr % len(users)]
+                self._rr += 1
+                q = self._queues[u]
+                wake = q.popleft()
+                self._queued_total -= 1
+                wake.granted = True
+                if not q:
+                    del self._queues[u]
+                # seat transfers: _inflight unchanged
+            else:
+                self._inflight -= 1
+                m.apiserver_inflight.set(float(self._inflight), (self.kind,))
+        if wake is not None:
+            wake.event.set()
+
+    def queued(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+
+class FlowController:
+    """Split mutating/readonly gates behind one ``admit`` entry point.
+
+    Defaults are deliberately generous (invisible to well-behaved
+    in-process traffic); flood tests construct tighter ones.  The
+    classification matches the reference filters: GET/LIST/WATCH are
+    readonly, everything else mutating.
+    """
+
+    def __init__(self, max_mutating_inflight: int = 32,
+                 max_readonly_inflight: int = 64,
+                 max_queue_per_user: int = 64,
+                 queue_timeout: float = 2.0,
+                 retry_after: float = 0.1,
+                 max_queued_total: Optional[int] = None):
+        self.mutating = _InflightGate(
+            "mutating", max_mutating_inflight, max_queue_per_user,
+            queue_timeout, retry_after, max_queued_total=max_queued_total)
+        self.readonly = _InflightGate(
+            "readonly", max_readonly_inflight, max_queue_per_user,
+            queue_timeout, retry_after, max_queued_total=max_queued_total)
+
+    def admit(self, user: str, mutating: bool) -> _Seat:
+        """Acquire a seat (possibly after a fair-queued wait) or raise
+        RequestRejected — the caller answers 429 + Retry-After."""
+        gate = self.mutating if mutating else self.readonly
+        return gate.acquire(user or "system:anonymous")

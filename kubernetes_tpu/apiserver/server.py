@@ -53,6 +53,8 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.scheme import Scheme, SchemeError, default_scheme
 from ..api.serialize import to_manifest
+from ..metrics import registry as metrics_registry
+from ..metrics import scheduler_metrics as m
 from ..sim.store import (
     ADDED,
     DELETED,
@@ -62,6 +64,8 @@ from ..sim.store import (
     QuotaExceeded,
     StaleResourceVersion,
 )
+from ..sim.watchcache import TooOldResourceVersion, WatchCache
+from .flowcontrol import FlowController, RequestRejected
 
 
 class UserInfo:
@@ -168,6 +172,8 @@ class APIServer:
         validating_admission: Optional[list] = None,
         fault_injector=None,
         readyz=None,
+        watch_cache="auto",
+        flow_control="auto",
     ):
         self.store = store
         # readiness source (component_base.healthz.Readyz or None): when
@@ -201,6 +207,29 @@ class APIServer:
         from ..descheduler.evictions import EvictionAPI
 
         self.evictions = EvictionAPI(store)
+        # versioned watch cache (sim/watchcache.py): lists, pagination, and
+        # since_rv watch replays are served from it WITHOUT the store lock;
+        # "auto" (default) builds one — pass False to read the store
+        # directly (the pre-cache behavior), or a WatchCache to share one
+        # across servers.
+        if watch_cache == "auto" or watch_cache is True:
+            self.watch_cache: Optional[WatchCache] = WatchCache(
+                store, scheme=self.scheme)
+            self._owns_watch_cache = True
+        else:
+            self.watch_cache = watch_cache or None
+            # a shared cache outlives this server: stop() must not close
+            # it out from under the other servers reading it
+            self._owns_watch_cache = False
+        # APF-style flow control (apiserver/flowcontrol.py): split
+        # mutating/readonly inflight pools + per-user fairness queues;
+        # every resource request holds a seat for its duration (watches:
+        # handshake only).  "auto" builds generous defaults; False
+        # disables; a FlowController tunes the pools (flood tests do).
+        if flow_control == "auto":
+            self.flow: Optional[FlowController] = FlowController()
+        else:
+            self.flow = flow_control or None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -224,6 +253,8 @@ class APIServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=2)
+        if self.watch_cache is not None and self._owns_watch_cache:
+            self.watch_cache.close()
 
     # --- path handling ------------------------------------------------------
 
@@ -303,12 +334,40 @@ def _make_handler(api: APIServer):
             code, retry_after = hit
             reason = {429: "TooManyRequests", 503: "ServiceUnavailable"}.get(
                 code, "InternalError")
+            m.apiserver_rejected.inc(("chaos_shed",))
             self._status_err(
                 code, reason, f"chaos: shed {verb} {kind}/{name}",
                 headers=(("Retry-After", f"{retry_after:.3f}"),)
                 if retry_after else (),
             )
             return True
+
+        # --- flow control (apiserver/flowcontrol.py) ------------------------
+
+        def _flow_admit(self, mutating: bool) -> bool:
+            """Acquire an inflight seat (APF position: before authn, after
+            routing — shedding must stay cheap under flood).  False when
+            the request was already answered 429 + Retry-After.  Fairness
+            is keyed by the cheap header identity; the full authn chain
+            still runs afterwards as before."""
+            self._flow_seat = None
+            if api.flow is None:
+                return True
+            user = self.headers.get("X-Remote-User") or "system:anonymous"
+            try:
+                self._flow_seat = api.flow.admit(user, mutating=mutating)
+            except RequestRejected as e:
+                self._status_err(
+                    429, "TooManyRequests", str(e),
+                    headers=(("Retry-After", f"{e.retry_after:.3f}"),))
+                return False
+            return True
+
+        def _flow_release(self):
+            seat = getattr(self, "_flow_seat", None)
+            if seat is not None:
+                seat.release()
+                self._flow_seat = None
 
         def _body(self) -> dict:
             length = int(self.headers.get("Content-Length") or 0)
@@ -368,7 +427,21 @@ def _make_handler(api: APIServer):
 
         def do_GET(self):
             url = urlparse(self.path)
-            q = parse_qs(url.query)
+            # health/discovery/metrics are EXEMPT from flow control: the
+            # probes and the observability that diagnose a flood must not
+            # be shed by it (the reference exempts non-resource paths too)
+            if url.path in ("/healthz", "/readyz", "/livez", "/api", "/apis",
+                            "/metrics"):
+                self._nonresource(url)
+                return
+            if not self._flow_admit(mutating=False):
+                return
+            try:
+                self._get_resource(url)
+            finally:
+                self._flow_release()
+
+        def _nonresource(self, url):
             if url.path in ("/healthz", "/readyz", "/livez"):
                 code, body = 200, b"ok"
                 if url.path == "/readyz" and api.readyz is not None:
@@ -387,17 +460,28 @@ def _make_handler(api: APIServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if url.path == "/metrics":
+                # text exposition of the process registry — what `ktpu
+                # controlplane status --server` reads
+                body = metrics_registry.render_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if url.path == "/api":
                 self._send_json(200, {"kind": "APIVersions",
                                       "versions": ["v1"]})
                 return
-            if url.path == "/apis":
-                groups = sorted({e.split(":")[0] for e in
-                                 api.scheme.recognized() if "/" in e})
-                self._send_json(200, {"kind": "APIGroupList",
-                                      "groups": [{"name": g.split("/")[0]}
-                                                 for g in groups]})
-                return
+            groups = sorted({e.split(":")[0] for e in
+                             api.scheme.recognized() if "/" in e})
+            self._send_json(200, {"kind": "APIGroupList",
+                                  "groups": [{"name": g.split("/")[0]}
+                                             for g in groups]})
+
+        def _get_resource(self, url):
+            q = parse_qs(url.query)
             r = api.route(url.path)
             if r is None:
                 self._status_err(404, "NotFound", url.path)
@@ -416,7 +500,33 @@ def _make_handler(api: APIServer):
             if q.get("watch", ["false"])[0] == "true":
                 self._watch(kind, ns, q)
                 return
-            objs, rv = api.store.list(kind)
+            # LIST: served from the watch cache (zero store-lock reads),
+            # with rv-consistent limit/continue pagination; a continue
+            # token or resourceVersion older than the cache's ring answers
+            # 410 Gone (reason Expired) — the client restarts its walk
+            # from a fresh LIST, the reference pagination contract.
+            limit = int(q.get("limit", ["0"])[0] or 0)
+            cont = q.get("continue", [None])[0]
+            # resourceVersion="0" (and "") means "serve current from cache"
+            # in the reference LIST contract (client-go reflectors send it)
+            # — NOT an exact rollback to the pre-history world
+            rv_param = q.get("resourceVersion", [None])[0]
+            exact_rv = int(rv_param) if rv_param and rv_param != "0" else None
+            next_token = ""
+            if api.watch_cache is not None:
+                try:
+                    objs, rv, next_token = api.watch_cache.list_page(
+                        kind, limit=limit, continue_=cont,
+                        resource_version=exact_rv)
+                except TooOldResourceVersion as e:
+                    m.apiserver_rejected.inc(("watch_expired",))
+                    self._status_err(410, "Expired", str(e))
+                    return
+                except ValueError as e:  # malformed continue token / rv
+                    self._status_err(400, "BadRequest", str(e))
+                    return
+            else:
+                objs, rv = api.store.list(kind)
             sel = q.get("labelSelector", [None])[0]
             fsel = q.get("fieldSelector", [None])[0]
             items = []
@@ -428,9 +538,15 @@ def _make_handler(api: APIServer):
                 if fsel and not _match_field_selector(fsel, o):
                     continue
                 items.append(to_manifest(o, api.scheme))
+            meta = {"resourceVersion": str(rv)}
+            if next_token:
+                # like the reference: selectors filter WITHIN the page, so
+                # a page may carry fewer than `limit` items while continue
+                # is still set — clients walk until continue is empty
+                meta["continue"] = next_token
             self._send_json(200, {
                 "kind": f"{kind}List", "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(rv)},
+                "metadata": meta,
                 "items": items,
             })
 
@@ -462,7 +578,23 @@ def _make_handler(api: APIServer):
                     # the dropped event, so bookmarks stop for good
                     lossy[0] = True
 
-            unwatch = api.store.watch(on_event, since_rv=since)
+            # subscribe through the watch cache when present: the ring
+            # serves the since_rv replay without the store lock, and a
+            # too-old rv answers 410 Gone (reason Expired) so the client
+            # relists — the reference cacher contract.  Without a cache,
+            # the store's full-history replay serves any rv (legacy path).
+            source = api.watch_cache if api.watch_cache is not None \
+                else api.store
+            try:
+                unwatch = source.watch(on_event, since_rv=since)
+            except TooOldResourceVersion as e:
+                m.apiserver_rejected.inc(("watch_expired",))
+                self._status_err(410, "Expired", str(e))
+                return
+            # the watch handshake is over: release the flow-control seat
+            # so a long-lived stream never pins the readonly pool (APF's
+            # long-running-request exemption)
+            self._flow_release()
 
             def write_line(payload: dict) -> bool:
                 line = json.dumps(payload).encode() + b"\n"
@@ -491,13 +623,17 @@ def _make_handler(api: APIServer):
                         break
                     if bookmarks and time.monotonic() >= next_bookmark:
                         next_bookmark = time.monotonic() + 1.0
-                        # correctness order: read the rv under the store
-                        # lock FIRST (all events ≤ it have been emitted to
-                        # this watcher's callback), THEN require the queue
-                        # drained — the bookmark then provably covers only
-                        # events already written to the wire (cacher.go
-                        # bookmarks cover progress sent to that watcher)
-                        rv = api.store.current_rv()
+                        # correctness order: read the fully-fanned-out rv
+                        # FIRST (all events ≤ it have been emitted to this
+                        # watcher's callback — the cache's fanned_rv
+                        # watermark, or the store's under-lock rv), THEN
+                        # require the queue drained — the bookmark then
+                        # provably covers only events already written to
+                        # the wire (cacher.go bookmarks cover progress
+                        # sent to that watcher)
+                        rv = (api.watch_cache.fanned_rv()
+                              if api.watch_cache is not None
+                              else api.store.current_rv())
                         if not lossy[0] and events.empty():
                             if not write_line({
                                 "type": "BOOKMARK",
@@ -541,6 +677,38 @@ def _make_handler(api: APIServer):
                 unwatch()
 
         def do_POST(self):
+            if not self._flow_admit(mutating=True):
+                return
+            try:
+                self._post()
+            finally:
+                self._flow_release()
+
+        def do_PUT(self):
+            if not self._flow_admit(mutating=True):
+                return
+            try:
+                self._put()
+            finally:
+                self._flow_release()
+
+        def do_PATCH(self):
+            if not self._flow_admit(mutating=True):
+                return
+            try:
+                self._patch()
+            finally:
+                self._flow_release()
+
+        def do_DELETE(self):
+            if not self._flow_admit(mutating=True):
+                return
+            try:
+                self._delete()
+            finally:
+                self._flow_release()
+
+        def _post(self):
             url = urlparse(self.path)
             r = api.route(url.path)
             if r is None:
@@ -637,7 +805,7 @@ def _make_handler(api: APIServer):
                 return
             self._send_json(201, to_manifest(obj, api.scheme))
 
-        def do_PUT(self):
+        def _put(self):
             url = urlparse(self.path)
             r = api.route(url.path)
             if r is None or not r[2]:
@@ -690,7 +858,7 @@ def _make_handler(api: APIServer):
                 return False
             return True
 
-        def do_PATCH(self):
+        def _patch(self):
             url = urlparse(self.path)
             r = api.route(url.path)
             if r is None or not r[2]:
@@ -744,7 +912,7 @@ def _make_handler(api: APIServer):
                 "operation cannot be fulfilled: the object has been modified",
             )
 
-        def do_DELETE(self):
+        def _delete(self):
             url = urlparse(self.path)
             r = api.route(url.path)
             if r is None or not r[2]:

@@ -16,6 +16,8 @@ primitives stay importable from stdlib-only contexts (subprocess servers).
 
 from .faults import (  # noqa: F401
     CRASH_POINTS,
+    CRASH_PRE_WAL_FSYNC,
+    CRASH_TORN_WAL_WRITE,
     FaultSchedule,
     InjectedConflict,
     ProcessCrash,
@@ -24,12 +26,15 @@ from .faults import (  # noqa: F401
     crash_schedule,
     install_crash_schedule,
     maybe_crash,
+    maybe_torn_write,
     steal_lease,
 )
 from .retry import RetryingStore  # noqa: F401
 
 __all__ = [
     "CRASH_POINTS",
+    "CRASH_PRE_WAL_FSYNC",
+    "CRASH_TORN_WAL_WRITE",
     "FaultSchedule",
     "InjectedConflict",
     "ProcessCrash",
@@ -39,5 +44,6 @@ __all__ = [
     "crash_schedule",
     "install_crash_schedule",
     "maybe_crash",
+    "maybe_torn_write",
     "steal_lease",
 ]

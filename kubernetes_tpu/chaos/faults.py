@@ -56,6 +56,11 @@ CRASH_PERMIT_HELD = "crash.permit_held"        # gang/directory.note_waiting: me
 CRASH_MID_PLAN_APPLY = "crash.mid_plan_apply"  # descheduler/controller._apply: some victims evicted
 CRASH_MID_SCALEUP = "crash.mid_scaleup"        # autoscaler/controller._scale_up: some nodes created
 CRASH_POST_LEASE_RENEW = "crash.post_lease_renew"  # leaderelection._tick: lease renewed, holder dies
+CRASH_PRE_WAL_FSYNC = "crash.pre_wal_fsync"    # sim/wal.append: record written, fsync never ran
+# Not in CRASH_POINTS (armed via arm_torn_write, not crash_points): the
+# torn-write fault writes a PREFIX of the record before dying, so the point
+# name only identifies the ProcessCrash it raises.
+CRASH_TORN_WAL_WRITE = "crash.torn_wal_write"  # sim/wal.append: record half-written, then death
 
 CRASH_POINTS = (
     CRASH_AFTER_ASSUME,
@@ -64,6 +69,7 @@ CRASH_POINTS = (
     CRASH_MID_PLAN_APPLY,
     CRASH_MID_SCALEUP,
     CRASH_POST_LEASE_RENEW,
+    CRASH_PRE_WAL_FSYNC,
 )
 
 
@@ -113,6 +119,23 @@ def maybe_crash(point: str) -> None:
     s = _active_crash_schedule
     if s is not None:
         s.crash_fault(point)
+
+
+def maybe_torn_write(nbytes: int):
+    """WAL torn-write hook (sim/wal.append): when the installed schedule
+    armed a torn write for this append, returns the number of bytes of the
+    ``nbytes``-long record to actually write (a strict prefix — the tail
+    record the crash leaves behind fails its checksum, which is exactly
+    what replay's truncation path must handle); None to write normally.
+    The WAL raises ProcessCrash(CRASH_TORN_WAL_WRITE) after the partial
+    write — a torn record only ever exists because the process died
+    mid-append."""
+    s = _active_crash_schedule
+    if s is None:
+        return None
+    return s.torn_write_fault(nbytes)
+
+
 
 
 class TransientApiError(RuntimeError):
@@ -169,6 +192,7 @@ class FaultSchedule:
         max_faults_per_key: int = 3,
         exempt_kinds=frozenset({"Event"}),
         crash_points: Optional[Dict[str, int]] = None,
+        wal_error_rate: float = 0.0,
     ):
         self.seed = seed
         self.watch_drop_rate = watch_drop_rate
@@ -176,6 +200,7 @@ class FaultSchedule:
         self.write_500_rate = write_500_rate
         self.write_503_rate = write_503_rate
         self.conflict_rate = conflict_rate
+        self.wal_error_rate = wal_error_rate
         self.slow_rate = slow_rate
         self.slow_seconds = slow_seconds
         self.retry_after = retry_after
@@ -199,6 +224,11 @@ class FaultSchedule:
         # batch in every same-seed run — wall clock never enters it.
         self.crash_points: Dict[str, int] = dict(crash_points or {})
         self._crash_fired: Dict[str, int] = {}  # point → seq it fired at
+        # 1-based WAL-append hit at which a torn write fires (once), and
+        # the fraction of the record that survives; armed via
+        # arm_torn_write, consumed by maybe_torn_write from sim/wal.append
+        self._torn_write_at: Optional[int] = None
+        self._torn_keep_fraction = 0.5
 
     # --- deterministic primitives -------------------------------------------
 
@@ -271,6 +301,58 @@ class FaultSchedule:
         """point → hit seq it fired at (empty until points fire)."""
         with self._lock:
             return dict(self._crash_fired)
+
+    # --- WAL fault shapes (consumed by sim/wal.py + sim/store.py) -------------
+
+    def arm_torn_write(self, at_append: int = 1,
+                       keep_fraction: float = 0.5) -> None:
+        """Arm a torn WAL write at the ``at_append``-th FUTURE append
+        (relative to appends already consumed), once: the record is cut to
+        ``keep_fraction`` of its bytes and the process dies — the
+        deterministic reproduction of power loss mid-append, so the replay
+        path's checksum truncation is exercised on a known record."""
+        if not (0.0 < keep_fraction < 1.0):
+            raise ValueError("keep_fraction must leave a strict prefix")
+        with self._lock:
+            seen = self._counters.get(("walappend",), 0)
+            self._torn_write_at = seen + at_append
+            self._torn_keep_fraction = keep_fraction
+
+    def torn_write_fault(self, nbytes: int) -> Optional[int]:
+        """Bytes of this ``nbytes``-long record to write (a strict prefix)
+        when the torn write is armed for this append; None to write whole.
+        Counts every append (armed or not) so later arming still addresses
+        a deterministic sequence position, mirroring crash_fault."""
+        seq = self._seq("walappend")
+        with self._lock:
+            at = self._torn_write_at
+            if at is None or seq + 1 != at:
+                return None
+            self._torn_write_at = None
+            keep = max(1, min(nbytes - 1, int(nbytes
+                                              * self._torn_keep_fraction)))
+            self.injected["wal_torn_write"] = (
+                self.injected.get("wal_torn_write", 0) + 1)
+        from ..metrics import scheduler_metrics as m
+
+        m.chaos_faults_injected.inc(("wal_torn_write",))
+        return keep
+
+    def wal_fault(self, op: str, kind: str, name: str) -> None:
+        """Raise a retryable 500 when this write's durable-log commit is
+        scheduled to fail (the apiserver's mapping of an etcd commit
+        error).  Consulted by ObjectStore just before the WAL append, so
+        the mutation never half-applies and a client resend is safe."""
+        if self.wal_error_rate <= 0 or kind in self.exempt_kinds:
+            return
+        seq = self._seq("wal", op, kind, name)
+        key = ("wal", op, kind, name)
+        if self._exhausted(key):
+            return
+        if self._roll("wal", op, kind, name, seq) < self.wal_error_rate:
+            self._record("wal_error", key)
+            raise TransientApiError(
+                500, 0.0, f"chaos: wal commit failed on {op} {kind}/{name}")
 
     # --- hooks consumed by sim/store.py -------------------------------------
 

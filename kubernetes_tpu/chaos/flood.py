@@ -1,0 +1,194 @@
+"""Flood + churn drivers: the workload side of the flow-control and
+watch-cache batteries (tests/test_flowcontrol.py, tests/test_watchcache.py,
+tools/watch_soak.py share these).
+
+Two shapes:
+
+  - ``run_reader_flood``: N greedy readers hammer an apiserver's LIST path
+    concurrently with a mutating writer — the APF acceptance scenario:
+    readonly seats exhaust, rejected readers back off per Retry-After (the
+    HTTP transport's retry loop), every request eventually completes, and
+    mutating throughput stays unaffected because the pools are split.
+  - ``watch_churn_soak``: thousands of concurrent watchers on one watch
+    cache under object churn, then a 10× object-count growth — asserting
+    the two scale properties ROADMAP item 2 names: ZERO store-lock
+    acquisitions on the list/watch-replay path, and flat resync cost as
+    the world grows (a dropped watcher resumes by ring replay of its gap,
+    never by an O(objects) relist).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..component_base import logging as klog
+
+
+@dataclass
+class FloodStats:
+    requests: int = 0           # reader requests that completed (after retries)
+    failures: int = 0           # reader requests that exhausted retries
+    per_reader: Dict[str, int] = field(default_factory=dict)
+
+
+def run_reader_flood(base_url: str, kind: str = "Pod", n_readers: int = 12,
+                     duration: float = 1.5, max_retries: int = 50,
+                     retry_backoff: float = 0.01) -> FloodStats:
+    """Greedy readers list ``kind`` in a closed loop until ``duration``
+    elapses; each reader is its own flow-control user (X-Remote-User), so
+    the per-user fairness queues are actually exercised.  A request counts
+    as failed only when the transport exhausted its retries — the flood
+    acceptance requires zero of those (shed ≠ lost)."""
+    from ..apiserver.client import HTTPApiClient
+
+    stats = FloodStats()
+    lock = threading.Lock()
+    deadline = time.monotonic() + duration
+
+    def reader(i: int):
+        client = HTTPApiClient(base_url, user=f"flood-reader-{i}",
+                               max_retries=max_retries,
+                               retry_backoff=retry_backoff,
+                               jitter_seed=i)
+        ok = 0
+        while time.monotonic() < deadline:
+            try:
+                client.list(kind)
+                ok += 1
+            except Exception as e:
+                # a retries-exhausted request IS the flood test's failure
+                # signal: counted (the battery asserts zero) and logged
+                klog.V(2).info_s("flood reader request lost", reader=i,
+                                 error=f"{type(e).__name__}: {e}")
+                with lock:
+                    stats.failures += 1
+        with lock:
+            stats.requests += ok
+            stats.per_reader[f"flood-reader-{i}"] = ok
+
+    threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+               for i in range(n_readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration + 30)
+    return stats
+
+
+def timed_writes(base_url: str, namespace: str, names: List[str],
+                 rounds: int = 3, user: str = "writer") -> float:
+    """Wall seconds for ``rounds`` update sweeps over ``names`` (labels
+    bumped through PATCH) — the mutating-throughput probe run once
+    unloaded and once under a reader flood; the flood acceptance bound is
+    loaded ≤ 2× unloaded."""
+    from ..apiserver.client import HTTPApiClient
+
+    client = HTTPApiClient(base_url, user=user)
+    t0 = time.monotonic()
+    for r in range(rounds):
+        for n in names:
+            client._request(
+                "PATCH",
+                client._url("Pod", namespace, n),
+                {"metadata": {"labels": {"flood-round": str(r)}}})
+    return time.monotonic() - t0
+
+
+# --- watch-cache churn soak ----------------------------------------------------
+
+
+def _churn(store, pods, rounds: int) -> None:
+    """``rounds`` update sweeps over pre-fetched pod objects — no store
+    reads (the zero-store-lock assertion brackets this)."""
+    for _ in range(rounds):
+        for p in pods:
+            store.update("Pod", p)
+
+
+def watch_churn_soak(n_watchers: int = 1000, n_objects: int = 100,
+                     growth: int = 10, churn_rounds: int = 2,
+                     resyncs: int = 30, resync_window: int = 64,
+                     ring_size: int = 1 << 16) -> dict:
+    """The thousand-watcher churn soak (ISSUE 11 acceptance): watchers
+    ride one WatchCache while objects churn and the object count grows
+    ``growth``×.  Returns the measurements; callers assert:
+
+      - ``store_read_ops_delta`` == 0: every watch replay/resume and every
+        paginated list during the soak was served by the cache;
+      - ``resync_ratio`` stays ~flat (< 3): resuming a watcher from a
+        bookmark-fresh rv costs ring replay of its GAP — the same wall
+        time at 10× the objects — never an O(objects) relist;
+      - every watcher saw every churn event (no fan-out loss).
+    """
+    from ..sim.store import ObjectStore
+    from ..sim.watchcache import WatchCache
+    from ..testutil import make_pod
+
+    store = ObjectStore()
+    cache = WatchCache(store, ring_size=ring_size)
+    pods = []
+    for i in range(n_objects):
+        p = (make_pod().name(f"soak-{i}").uid(f"soak-{i}")
+             .namespace("default").req({"cpu": "1"}).obj())
+        store.create("Pod", p)
+        pods.append(p)
+
+    counts = [0] * n_watchers
+    start_rv = cache.current_rv()
+
+    def handler_for(i):
+        def h(ev):
+            counts[i] += 1
+        return h
+
+    unwatchers = [cache.watch(handler_for(i), since_rv=start_rv)
+                  for i in range(n_watchers)]
+
+    def measure_resync() -> float:
+        """Median-free total: ``resyncs`` watcher resumes from an rv
+        ``resync_window`` events back — the bookmark-resume shape (the
+        gap is bounded by churn, not by object count)."""
+        rv = cache.current_rv()
+        t0 = time.monotonic()
+        for _ in range(resyncs):
+            got = []
+            un = cache.watch(got.append, since_rv=rv - resync_window)
+            un()
+        return time.monotonic() - t0
+
+    read0 = store.read_ops
+    _churn(store, pods, churn_rounds)
+    small_events = n_objects * churn_rounds
+    small_resync = measure_resync()
+    small_reads = store.read_ops - read0
+
+    # grow the world 10×, churn the ORIGINAL cohort again (same event
+    # volume), and re-measure: resync cost must not follow object count
+    for i in range(n_objects, n_objects * growth):
+        store.create("Pod", (make_pod().name(f"soak-{i}").uid(f"soak-{i}")
+                             .namespace("default").req({"cpu": "1"}).obj()))
+    read1 = store.read_ops
+    _churn(store, pods, churn_rounds)
+    big_resync = measure_resync()
+    big_reads = store.read_ops - read1
+
+    for un in unwatchers:
+        un()
+    cache.close()
+    expected = small_events + n_objects * (growth - 1) + small_events
+    return {
+        "n_watchers": n_watchers,
+        "objects_small": n_objects,
+        "objects_big": n_objects * growth,
+        "events_per_watcher": counts[0],
+        "events_expected": expected,
+        "watchers_complete": sum(1 for c in counts if c == expected),
+        "resync_seconds_small": small_resync,
+        "resync_seconds_big": big_resync,
+        "resync_ratio": (big_resync / small_resync
+                         if small_resync > 0 else 0.0),
+        "store_read_ops_delta": small_reads + big_reads,
+    }

@@ -442,6 +442,71 @@ class Kubectl:
         head = "ok" if ok else "NotReady"
         return f"{head}\n{out}" if out else head
 
+    # --- control-plane durability / flow-control view --------------------------
+
+    def controlplane_status(self, wal=None, watch_cache=None, flow=None,
+                            metrics=None) -> str:
+        """``ktpu controlplane status``: the durable-control-plane gauges —
+        WAL size/records/last-fsync-rv (how much survives kill -9), watch
+        cache ring occupancy/oldest-rv (what a watcher can resume from
+        without a relist), and the flow-control inflight/rejected counts
+        (who is being shed, and why).
+
+        Reads live objects when given (in-process wiring); otherwise the
+        metric series they emit — ``metrics`` accepts a pre-parsed
+        {(name, labels): value} dict (the --server path feeds /metrics
+        through ``metrics.registry.parse_text``), else the in-process
+        default registry serves."""
+        if metrics is None:
+            from .metrics.registry import default_registry, parse_text, render_text
+
+            metrics = parse_text(render_text(default_registry))
+
+        def series(name, label=None):
+            return metrics.get((name, (label,) if label else ()), 0.0)
+
+        rows = [["COMPONENT", "FIELD", "VALUE"]]
+        if wal is not None:
+            rows.append(["wal", "size-bytes", str(wal.size_bytes)])
+            rows.append(["wal", "records", str(wal.records_appended)])
+            rows.append(["wal", "last-fsync-rv", str(wal.last_fsync_rv)])
+        else:
+            rows.append(["wal", "size-bytes",
+                         f"{series('wal_size_bytes'):g}"])
+            total = sum(v for (n, _), v in metrics.items()
+                        if n == "wal_records_total")
+            rows.append(["wal", "records", f"{total:g}"])
+            rows.append(["wal", "last-fsync-rv",
+                         f"{series('wal_last_fsync_rv'):g}"])
+        if watch_cache is not None:
+            rows.append(["watch-cache", "ring-occupancy",
+                         str(watch_cache.ring_occupancy)])
+            rows.append(["watch-cache", "oldest-rv",
+                         str(watch_cache.oldest_rv)])
+            rows.append(["watch-cache", "current-rv",
+                         str(watch_cache.current_rv())])
+        else:
+            rows.append(["watch-cache", "ring-occupancy",
+                         f"{series('watch_cache_ring_occupancy'):g}"])
+            rows.append(["watch-cache", "oldest-rv",
+                         f"{series('watch_cache_oldest_rv'):g}"])
+        for kind in ("mutating", "readonly"):
+            if flow is not None:
+                gate = getattr(flow, kind)
+                rows.append([f"flow-{kind}", "inflight",
+                             str(gate.inflight())])
+                rows.append([f"flow-{kind}", "queued", str(gate.queued())])
+            else:
+                rows.append([f"flow-{kind}", "inflight",
+                             f"{series('apiserver_inflight_requests', kind):g}"])
+        rejected = {lab[0]: v for (n, lab), v in metrics.items()
+                    if n == "apiserver_rejected_requests_total" and lab}
+        for reason in sorted(rejected):
+            rows.append(["flow-rejected", reason, f"{rejected[reason]:g}"])
+        if not rejected:
+            rows.append(["flow-rejected", "total", "0"])
+        return _render_table(rows)
+
     # --- slice fragmentation view ---------------------------------------------
 
     def get_slices(self, slice_label: Optional[str] = None,
@@ -549,6 +614,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                    help="evaluate the eviction gate, evict nothing")
     p = sub.add_parser("autoscaler")
     p.add_argument("action", choices=["status"])
+    p = sub.add_parser("controlplane")
+    p.add_argument("action", choices=["status"])
     sub.add_parser("topology")
     sub.add_parser("readyz")
     for verb in ("cordon", "uncordon"):
@@ -591,6 +658,19 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         print(k.drain(args.node, dry_run=args.dry_run))
     elif args.verb == "autoscaler":
         print(k.autoscaler_status())
+    elif args.verb == "controlplane":
+        if args.server:
+            # the server process owns the WAL/cache/flow objects; its
+            # /metrics exposition carries their series
+            import urllib.request
+
+            from .metrics.registry import parse_text
+
+            with urllib.request.urlopen(f"{args.server}/metrics") as r:
+                print(k.controlplane_status(
+                    metrics=parse_text(r.read().decode())))
+        else:
+            print(k.controlplane_status())
     elif args.verb == "topology":
         print(k.topology())
     elif args.verb == "readyz":

@@ -43,7 +43,9 @@ class Reflector:
     def __init__(self, store: ObjectStore, kind: str,
                  relist_backoff_initial: float = 0.05,
                  relist_backoff_max: float = 5.0,
-                 sleep=time.sleep, jitter_seed: int = 0):
+                 sleep=time.sleep, jitter_seed: int = 0,
+                 relist_page_size: int = 0,
+                 rewatch_on_error: bool = False):
         self.store = store
         self.kind = kind
         self.items: Dict[Tuple[str, str], object] = {}
@@ -57,6 +59,24 @@ class Reflector:
         self._backoff_max = relist_backoff_max
         self._sleep = sleep
         self._jitter = random.Random(jitter_seed)
+        # paginated relists: when > 0 and the store serves rv-consistent
+        # pages (sim/watchcache.list_page; HTTPApiClient.list_page), every
+        # relist walks limit/continue pages at ONE rv instead of one
+        # whole-world LIST — informer_relists_total{kind="paged"} counts
+        # them.  An expired continue token (410) surfaces as an exception
+        # and the retry loop starts a fresh walk.
+        self.relist_page_size = relist_page_size
+        # watch-cache resume: on a broken stream, try re-watching from
+        # last_rv FIRST (the cache's ring replays the gap — including the
+        # very event whose fan-out dropped us) and fall back to a full
+        # relist only when the server answers 410 (rv compacted away).
+        # Off by default: against a plain store the chaos batteries pin
+        # relist-on-drop semantics.
+        self.rewatch_on_error = rewatch_on_error
+        # True while last_rv's freshness came from a BOOKMARK rather than a
+        # delivered event — a resync that starts from such an rv is a
+        # relist the bookmark saved (informer_relists_total{kind="bookmark"})
+        self._bookmark_fresh = False
         # serializes relists: a drop callback and a stream-end callback from
         # two transports must not diff against the same cache concurrently
         self._relist_lock = lockcheck.maybe_wrap(
@@ -82,10 +102,38 @@ class Reflector:
         cache concurrently (found by the lock-discipline static check —
         run() was the one unlocked caller of _apply_relist)."""
         self._stopped = False
-        objs, rv = self.store.list(self.kind)
+        objs, rv = self._list_snapshot()
         with self._relist_lock:
             self._apply_relist(objs, rv)
         self._synced = True
+
+    def _list_snapshot(self, count_paged: bool = False):
+        """One consistent (objects, rv) snapshot — paginated when
+        ``relist_page_size`` is set and the store serves rv-pinned pages
+        (the watch cache / HTTP chunked-list contract), whole-world LIST
+        otherwise.  Paged walks keep per-call memory and store work bounded
+        at thousands of watchers; the continue token pins every page to the
+        first page's rv, so the snapshot cannot tear across writes.
+
+        ``count_paged`` marks this walk as a RELIST for the metric: the
+        error-driven relist path sets it (each paged relist then counts
+        once under {kind} and once under the "paged" mechanism tag); the
+        initial run() sync is not a relist and never counts."""
+        list_page = getattr(self.store, "list_page", None)
+        if not self.relist_page_size or list_page is None:
+            return self.store.list(self.kind)
+        objs: List[object] = []
+        token = None
+        while True:
+            page, rv, token = list_page(self.kind,
+                                        limit=self.relist_page_size,
+                                        continue_=token)
+            objs.extend(page)
+            if not token:
+                break
+        if count_paged:
+            m.informer_relists.inc(("paged",))
+        return objs, rv
 
     def _apply_relist(self, objs, rv: int):
         """Diff a fresh snapshot against the cache, deliver the synthetic
@@ -144,8 +192,11 @@ class Reflector:
         self._unwatch = watch(self._on_event, since_rv=rv, **kwargs)
 
     def _on_bookmark(self, rv: int):
-        # ktpu-analysis: ignore[lock-discipline] -- bookmark delivery is serialized by the store's emit path; the monotonic max() makes a lost race harmless (rv only advances)
-        self.last_rv = max(self.last_rv, rv)
+        if rv > self.last_rv:
+            # ktpu-analysis: ignore[lock-discipline] -- bookmark delivery is serialized by the store's emit path; the monotonic max() makes a lost race harmless (rv only advances)
+            self.last_rv = rv
+            # ktpu-analysis: ignore[lock-discipline] -- same single-streamed delivery as last_rv above; the flag only routes metric accounting, a lost race miscounts one series by one
+            self._bookmark_fresh = True
 
     def _on_watch_error(self, exc: Optional[Exception] = None):
         """The watch stream ended.  ``exc`` None means a CLEAN end (the
@@ -169,11 +220,27 @@ class Reflector:
             if exc is None:
                 try:
                     self._subscribe(self.last_rv)
+                    self._note_bookmark_resync()
                     self._unwatch_if_stopped()
                     return
                 except Exception as e:  # resubscribe failed → full relist
                     klog.V(2).info_s("Re-watch failed; relisting",
                                      kind=self.kind,
+                                     error=f"{type(e).__name__}: {e}")
+            elif self.rewatch_on_error and self.last_rv > 0:
+                # watch-cache resume: the broken stream's gap is replayed
+                # from the cache's ring (since_rv semantics recover the
+                # very event whose fan-out dropped us) — only a 410
+                # (TooOldResourceVersion over HTTP or in-process: events
+                # compacted past last_rv) falls through to the full relist
+                try:
+                    self._subscribe(self.last_rv)
+                    self._note_bookmark_resync()
+                    self._unwatch_if_stopped()
+                    return
+                except Exception as e:
+                    klog.V(2).info_s("Resume-from-rv failed; relisting",
+                                     kind=self.kind, last_rv=self.last_rv,
                                      error=f"{type(e).__name__}: {e}")
             attempt = 0
             while not self._stopped:
@@ -184,7 +251,7 @@ class Reflector:
                 # only the LIST retries here — apply/deliver exceptions are
                 # handler bugs and propagate (see _apply_relist)
                 try:
-                    objs, rv = self.store.list(self.kind)
+                    objs, rv = self._list_snapshot(count_paged=True)
                 except Exception as e:
                     klog.V(2).info_s("Relist LIST failed; backing off",
                                      kind=self.kind, attempt=attempt,
@@ -196,6 +263,16 @@ class Reflector:
                 m.informer_relists.inc((self.kind,))
                 self._unwatch_if_stopped()
                 return
+
+    def _note_bookmark_resync(self):
+        """A resync just started from an rv a BOOKMARK advanced: that
+        freshness is a relist the bookmark saved — counted as
+        informer_relists_total{kind="bookmark"} (the series the watch-cache
+        soak asserts grows while true relists stay flat).  Runs under
+        _relist_lock (both resubscribe paths hold it)."""
+        if self._bookmark_fresh:
+            self._bookmark_fresh = False
+            m.informer_relists.inc(("bookmark",))
 
     def _unwatch_if_stopped(self):
         """Close the race where stop() ran while a relist/rewatch was in
@@ -231,6 +308,8 @@ class Reflector:
         # store write behind relist backoff sleeps.
         # ktpu-analysis: ignore[lock-discipline] -- single-streamed watch delivery; relists unsubscribe first (see comment)
         self.last_rv = ev.resource_version
+        # ktpu-analysis: ignore[lock-discipline] -- single-streamed watch delivery; relists unsubscribe first (see comment)
+        self._bookmark_fresh = False
         key = self._key(ev.obj)
         old = self.items.get(key)
         if ev.type == DELETED:

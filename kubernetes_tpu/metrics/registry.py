@@ -58,6 +58,9 @@ class Gauge(Metric):
     def value(self, labels: Tuple = ()) -> float:
         return self._v.get(labels, 0.0)
 
+    def items(self) -> Dict[Tuple, float]:
+        return dict(self._v)
+
 
 class Histogram(Metric):
     def __init__(self, name, buckets: List[float], help_=""):
@@ -162,3 +165,58 @@ class Registry:
 
 
 default_registry = Registry()
+
+
+def render_text(registry: Optional[Registry] = None) -> str:
+    """Prometheus-style text exposition of a registry (the apiserver's
+    /metrics body; ``ktpu controlplane status --server`` parses it back).
+
+    Sim-grade format: the registry stores label VALUE tuples without label
+    names, so every labeled series renders one synthetic ``label`` key
+    holding the comma-joined values — ``name{label="a,b"} 3``.  Histograms
+    emit ``_count``/``_sum`` only (bucket vectors are an in-process
+    concern; the quantile helpers read them directly)."""
+    reg = registry or default_registry
+    lines: List[str] = []
+    for name in sorted(reg.metrics):
+        metric = reg.metrics[name]
+        if isinstance(metric, Histogram):
+            with metric._lock:
+                series = [(f"{name}_count", labels, float(n))
+                          for labels, n in metric._n.items()]
+                series += [(f"{name}_sum", labels, s)
+                           for labels, s in metric._sum.items()]
+        elif isinstance(metric, (Counter, Gauge)):
+            series = [(name, labels, v) for labels, v in metric.items().items()]
+        else:
+            continue
+        for sname, labels, v in sorted(series, key=lambda t: (t[0], t[1])):
+            if labels:
+                joined = ",".join(str(x) for x in labels)
+                lines.append(f'{sname}{{label="{joined}"}} {v:g}')
+            else:
+                lines.append(f"{sname} {v:g}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_text(body: str) -> Dict[Tuple[str, Tuple], float]:
+    """Inverse of render_text: {(series name, label tuple) → value}."""
+    out: Dict[Tuple[str, Tuple], float] = {}
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        if not head:
+            continue
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            joined = rest.rstrip("}").partition('label="')[2].rstrip('"')
+            labels: Tuple = tuple(joined.split(",")) if joined else ()
+        else:
+            name, labels = head, ()
+        try:
+            out[(name, labels)] = float(val)
+        except ValueError:
+            continue
+    return out

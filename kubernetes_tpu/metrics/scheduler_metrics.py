@@ -117,7 +117,12 @@ extender_circuit_state = default_registry.register(
           "Per-extender circuit breaker state (0 closed, 1 open, 2 half-open)")
 )
 informer_relists = default_registry.register(
-    # labels: (kind,)
+    # labels: (kind,) — one series per OBJECT KIND relisted, plus two
+    # mechanism tags in the same dimension (ISSUE-11 contract): "paged"
+    # counts relists that walked rv-pinned limit/continue pages (in
+    # ADDITION to their kind series — sum kinds, not the whole dimension,
+    # for a total), "bookmark" counts resyncs whose restart rv came from a
+    # BOOKMARK (relists avoided, not performed)
     Counter("informer_relists_total",
             "Reflector full relists after a watch drop/error")
 )
@@ -137,6 +142,51 @@ leader_election_status = default_registry.register(
     # labels: (identity,) — 1 while leading (the reference's
     # leader_election_master_status)
     Gauge("leader_election_master_status")
+)
+
+# --- durable, flood-proof control plane (sim/wal.py, sim/watchcache.py,
+# apiserver/flowcontrol.py) ----------------------------------------------------
+# Emitted at the real decision points: every WAL append/fsync, every watch
+# cache ring apply/compaction, and every flow-control admit/reject — the
+# series `ktpu controlplane status` renders.
+
+apiserver_inflight = default_registry.register(
+    # labels: (kind,) — "mutating" | "readonly": current seats held in each
+    # split inflight pool (the APF max-inflight gates)
+    Gauge("apiserver_inflight_requests",
+          "In-flight API requests by request class")
+)
+apiserver_rejected = default_registry.register(
+    # labels: (reason,) — "mutating_queue_full" | "mutating_timeout" |
+    # "readonly_queue_full" | "readonly_timeout" (flow-control sheds,
+    # answered 429 + Retry-After) | "chaos_shed" (injected APF-shaped shed)
+    # | "watch_expired" (410 Gone: requested rv older than the watch
+    # cache's ring)
+    Counter("apiserver_rejected_requests_total",
+            "API requests rejected before storage, by reason")
+)
+wal_records = default_registry.register(
+    # labels: (op,) — create | update | delete | bind
+    Counter("wal_records_total",
+            "Mutations appended to the write-ahead log")
+)
+wal_size_bytes = default_registry.register(
+    Gauge("wal_size_bytes", "Current write-ahead log file size")
+)
+wal_last_fsync_rv = default_registry.register(
+    # the durability watermark: every rv ≤ this survives kill -9
+    Gauge("wal_last_fsync_rv",
+          "Highest resourceVersion known fsynced to the WAL")
+)
+watch_cache_ring_occupancy = default_registry.register(
+    Gauge("watch_cache_ring_occupancy",
+          "Events currently held in the watch cache ring")
+)
+watch_cache_oldest_rv = default_registry.register(
+    # watch/list-at-rv requests BELOW this answer 410 Gone (ring compacted
+    # past them) — the reference cacher's too-old-resourceVersion contract
+    Gauge("watch_cache_oldest_rv",
+          "Oldest resourceVersion the watch cache can still replay from")
 )
 
 # --- crash-restart resilience (kubernetes_tpu/recovery/) ----------------------

@@ -24,7 +24,11 @@ sites); this package is the recovery side.
 """
 
 from .drift import DriftDetector, DriftReport, canonical_state, diff_canonical  # noqa: F401
-from .rebuild import RecoveryResult, cold_start  # noqa: F401
+from .rebuild import (  # noqa: F401
+    RecoveryResult,
+    cold_start,
+    cold_start_from_wal,
+)
 
 __all__ = [
     "DriftDetector",
@@ -32,5 +36,6 @@ __all__ = [
     "RecoveryResult",
     "canonical_state",
     "cold_start",
+    "cold_start_from_wal",
     "diff_canonical",
 ]

@@ -171,3 +171,34 @@ def cold_start(store, *, readyz=None, clock=time.monotonic,
         pending_pods=len(pending), gang_phase_repairs=repairs,
         nominations_dropped=dropped, drift=drift, outcome=outcome,
         seconds=seconds, partial_gangs=partial)
+
+
+def cold_start_from_wal(wal_path: str, *, scheme=None, readyz=None,
+                        attach_wal=True, wal_fsync_every: int = 1,
+                        **kwargs):
+    """REAL process death recovery: PR-8's cold_start assumed a surviving
+    store to relist from; this path has only the write-ahead log.  The
+    store is reconstructed first (sim/wal.replay_on_boot — torn tail
+    checksum-truncated, watch history re-emitted), then the standard
+    cold-start reconstruction runs on it unchanged, so every PR-8 proof
+    (exactly-once binds, drift verification, gang phase repair) holds from
+    a bare file.
+
+    ``attach_wal`` reopens the (truncated) log on the replayed store so the
+    successor's own writes keep appending where the dead process stopped;
+    ``wal_fsync_every`` sets its cadence and defaults to 1 (every append) —
+    a successor must never SILENTLY run a looser durability contract than
+    the deployment that just died proved it needs; callers relax it
+    explicitly.  Returns (RecoveryResult, ReplayResult)."""
+    from ..sim.wal import WriteAheadLog, replay_on_boot
+
+    replay = replay_on_boot(wal_path, scheme=scheme)
+    if attach_wal:
+        replay.store.wal = WriteAheadLog(wal_path, scheme=scheme,
+                                         fsync_every=wal_fsync_every)
+    result = cold_start(replay.store, readyz=readyz, **kwargs)
+    klog.V(1).info_s("Cold start from WAL", path=wal_path,
+                     records=replay.records_applied,
+                     truncated_tail=replay.truncated_tail,
+                     outcome=result.outcome)
+    return result, replay

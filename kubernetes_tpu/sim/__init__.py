@@ -7,3 +7,8 @@ apiserver role for the scheduler harness (SURVEY §7 step 2).
 """
 
 from .store import ObjectStore, WatchEvent  # noqa: F401
+
+# wal.py (WriteAheadLog, replay_on_boot) and watchcache.py (WatchCache,
+# TooOldResourceVersion) are imported by module path, not re-exported here:
+# wal pulls in chaos.faults (whose crash points it hooks), which imports
+# sim.store — an eager import here would be circular.

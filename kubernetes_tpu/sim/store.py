@@ -47,12 +47,22 @@ class WatchEvent:
 class ObjectStore:
     """Thread-safe store; watchers receive events synchronously in rv order."""
 
-    def __init__(self, fault_injector=None):
+    def __init__(self, fault_injector=None, wal=None):
         # instrumented under an active lockcheck monitor (chaos tests run
         # with lock-order inversion detection); raw RLock otherwise
         self._lock = lockcheck.maybe_wrap(threading.RLock(),
                                           "ObjectStore._lock")
         self._rv = 0
+        # optional write-ahead log (sim/wal.WriteAheadLog): every mutation
+        # appends its record BEFORE the in-memory apply, so a process death
+        # at any point loses at most unacknowledged writes — replay_on_boot
+        # reconstructs this store from the file.  None (default) costs one
+        # attribute check per write.
+        self.wal = wal
+        # store-lock READ acquisitions (list/get/watch/current_rv): the
+        # watch cache's zero-store-lock contract on the list/watch-replay
+        # path is asserted against deltas of this counter
+        self.read_ops = 0
         self._objects: Dict[Tuple[str, str, str], object] = {}
         self._log: List[WatchEvent] = []  # full event history (bounded use: sim)
         self._watchers: List[Callable[[WatchEvent], None]] = []
@@ -203,6 +213,8 @@ class ObjectStore:
             # writers; raising HERE means the mutation never half-applied,
             # so a client retry is always safe
             self.fault.write_fault("create", kind, obj.metadata.name)
+            if self.wal is not None:
+                self.fault.wal_fault("create", kind, obj.metadata.name)
         with self._locked_emit() as deferred:
             if kind == "Pod":
                 self._admit_pod(obj)
@@ -213,6 +225,12 @@ class ObjectStore:
                 raise ValueError(f"{key} already exists")
             self._rv += 1
             obj.metadata.resource_version = self._rv
+            if self.wal is not None:
+                # durable before visible: a raising append aborts the write
+                # (object never stored); the log can only run AHEAD of
+                # memory — replay treats the logged write as committed, the
+                # etcd commit-unknown outcome a retrying client tolerates
+                self.wal.append("create", kind, obj=obj, rv=self._rv)
             self._objects[key] = obj
             if kind == "ResourceQuota":
                 self._quota_namespaces.add(key[1])
@@ -231,6 +249,8 @@ class ObjectStore:
         check-then-act would race concurrent writers)."""
         if self.fault is not None:
             self.fault.write_fault("update", kind, obj.metadata.name)
+            if self.wal is not None:
+                self.fault.wal_fault("update", kind, obj.metadata.name)
         with self._locked_emit() as deferred:
             key = self._key(kind, obj)
             if key not in self._objects:
@@ -243,6 +263,8 @@ class ObjectStore:
                         f"current {cur_rv}")
             self._rv += 1
             obj.metadata.resource_version = self._rv
+            if self.wal is not None:
+                self.wal.append("update", kind, obj=obj, rv=self._rv)
             self._objects[key] = obj
             if kind == "PriorityClass":
                 cached = self._default_priority_class
@@ -264,10 +286,16 @@ class ObjectStore:
             namespace = ""
         if self.fault is not None:
             self.fault.write_fault("delete", kind, name)
+            if self.wal is not None:
+                self.fault.wal_fault("delete", kind, name)
         with self._locked_emit() as deferred:
-            obj = self._objects.pop((kind, namespace, name), None)
+            obj = self._objects.get((kind, namespace, name))
             if obj is None:
                 return None
+            if self.wal is not None:
+                self.wal.append("delete", kind, namespace=namespace,
+                                name=name, rv=self._rv + 1)
+            self._objects.pop((kind, namespace, name))
             if kind == "ResourceQuota" and not any(
                 k == "ResourceQuota" and ns == namespace
                 for (k, ns, _) in self._objects
@@ -290,16 +318,19 @@ class ObjectStore:
         delivered to watch callbacks (the watch-bookmark correctness
         condition)."""
         with self._lock:
+            self.read_ops += 1
             return self._rv
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
         if kind in self.CLUSTER_SCOPED:
             namespace = ""
         with self._lock:
+            self.read_ops += 1
             return self._objects.get((kind, namespace, name))
 
     def list(self, kind: str) -> Tuple[List[object], int]:
         with self._lock:
+            self.read_ops += 1
             objs = [o for (k, _, _), o in self._objects.items() if k == kind]
             return objs, self._rv
 
@@ -308,10 +339,59 @@ class ObjectStore:
         namespace controller's deletion-cascade view (reference:
         pkg/controller/namespace/deletion listing served group resources)."""
         with self._lock:
+            self.read_ops += 1
             return [
                 (k, o) for (k, ns, _), o in self._objects.items()
                 if ns == namespace and k not in self.CLUSTER_SCOPED
             ]
+
+    # --- WAL replay (sim/wal.replay_on_boot) ----------------------------------
+
+    def replay_record(self, op: str, kind: str, *, obj=None, namespace="",
+                      name="", node_name="", rv: int = 0) -> None:
+        """Apply one WAL record verbatim: no admission (the original write
+        was admitted before it was logged — re-running quota math against a
+        half-rebuilt world would diverge), no fault injection, no WAL
+        re-append.  The record's rv is authoritative; the watch history is
+        re-emitted so a scheduler cold-starting on the replayed store sees
+        the same event stream a live replica did."""
+        with self._locked_emit() as deferred:
+            if op == "create" or op == "update":
+                key = self._key(kind, obj)
+                obj.metadata.resource_version = rv
+                self._objects[key] = obj
+                self._rv = rv
+                self._emit(WatchEvent(
+                    ADDED if op == "create" else MODIFIED, kind, obj, rv),
+                    deferred)
+            elif op == "delete":
+                if kind in self.CLUSTER_SCOPED:
+                    namespace = ""
+                old = self._objects.pop((kind, namespace, name), None)
+                self._rv = rv
+                if old is not None:
+                    self._emit(WatchEvent(DELETED, kind, old, rv), deferred)
+            elif op == "bind":
+                pod = self._objects.get(("Pod", namespace, name))
+                self._rv = rv
+                if pod is not None:
+                    pod.spec.node_name = node_name
+                    pod.metadata.resource_version = rv
+                    self._emit(WatchEvent(MODIFIED, "Pod", pod, rv), deferred)
+            else:
+                raise ValueError(f"unknown WAL op {op!r}")
+
+    def rebuild_admission_caches(self) -> None:
+        """Recompute the derived admission caches (quota-namespace set,
+        default PriorityClass) from the object map — replay applies records
+        verbatim and fixes the caches once at the end."""
+        with self._lock:
+            self._quota_namespaces = {
+                ns for (k, ns, _) in self._objects if k == "ResourceQuota"}
+            self._default_priority_class = next(
+                (o for (k, _, _), o in self._objects.items()
+                 if k == "PriorityClass"
+                 and getattr(o, "global_default", False)), None)
 
     # --- watch ---------------------------------------------------------------
 
@@ -325,6 +405,7 @@ class ObjectStore:
         (client/informer.py Reflector does).  Watchers without one are never
         dropped — a synchronous in-process callback has no stream."""
         with self._lock:
+            self.read_ops += 1
             for ev in self._log:
                 if ev.resource_version > since_rv:
                     handler(ev)
@@ -411,10 +492,19 @@ class ObjectStore:
     def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
         if self.fault is not None:
             self.fault.write_fault("bind", "Pod", name)
+            if self.wal is not None:
+                self.fault.wal_fault("bind", "Pod", name)
         with self._locked_emit() as deferred:
             pod = self.get("Pod", namespace, name)
             if pod is None:
                 return False
+            if self.wal is not None:
+                # logged before the in-place mutation: a crash between the
+                # append and the apply replays the bind — exactly once, to
+                # the same node — instead of losing an acknowledged binding
+                self.wal.append("bind", "Pod", namespace=namespace,
+                                name=name, node_name=node_name,
+                                rv=self._rv + 1)
             pod.spec.node_name = node_name
             self._rv += 1
             pod.metadata.resource_version = self._rv

@@ -1,0 +1,276 @@
+"""Append-only write-ahead log for the object store (the durability layer).
+
+Reference analog: etcd's raft WAL + bbolt backend (server/storage/wal) — the
+property this buys is the same one upstream's control plane rests on: a
+mutation is durable BEFORE it is visible, so a kill -9 at any instruction
+boundary loses at most un-acknowledged writes, never acknowledged ones, and
+a fresh process reconstructs the exact store by replay.
+
+Record format (length-prefixed + checksummed, wal/decoder.go shape):
+
+    ``>I`` payload length | ``>I`` crc32(payload) | payload (JSON)
+
+The payload carries the op (``create``/``update``/``delete``/``bind``), the
+final resourceVersion the store assigned, and — for create/update — the
+object's wire manifest (api/serialize.to_manifest; WAL fidelity is
+wire-manifest fidelity, the same form etcd stores).  ``replay_on_boot``
+re-applies records through ``ObjectStore.replay_record`` and TRUNCATES a
+torn tail record (a crash mid-append leaves a prefix whose length or crc
+cannot verify — everything before it is intact by construction).
+
+fsync cadence is configurable (``fsync_every``: 1 = every append, the
+acknowledged-implies-durable contract; N = every N appends — bounded loss
+window, tier-1-fast; 0 = never, OS-buffered only) because a per-append
+fsync is ~1ms of wall per write and the test tiers must stay fast.
+
+Crash points wired here (chaos/faults.py):
+  - ``crash.pre_wal_fsync``: after the record bytes reach the file, before
+    fsync — the acknowledged-but-not-yet-durable window;
+  - torn write (``arm_torn_write``): a strict prefix of the record is
+    written, then death — replay must checksum-truncate it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..analysis import lockcheck
+from ..chaos.faults import (
+    CRASH_PRE_WAL_FSYNC,
+    CRASH_TORN_WAL_WRITE,
+    ProcessCrash,
+    maybe_crash,
+    maybe_torn_write,
+)
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+
+_HEADER = struct.Struct(">II")  # payload length, crc32(payload)
+
+
+@dataclass
+class WALRecord:
+    op: str            # create | update | delete | bind
+    kind: str
+    namespace: str
+    name: str
+    rv: int
+    manifest: Optional[dict] = None  # create/update: the object's wire form
+    node_name: str = ""              # bind: the target node
+
+    def payload(self) -> bytes:
+        body = {"op": self.op, "kind": self.kind, "ns": self.namespace,
+                "name": self.name, "rv": self.rv}
+        if self.manifest is not None:
+            body["obj"] = self.manifest
+        if self.node_name:
+            body["node"] = self.node_name
+        return json.dumps(body, separators=(",", ":")).encode()
+
+    @classmethod
+    def from_payload(cls, raw: bytes) -> "WALRecord":
+        body = json.loads(raw)
+        return cls(op=body["op"], kind=body["kind"], namespace=body["ns"],
+                   name=body["name"], rv=body["rv"],
+                   manifest=body.get("obj"), node_name=body.get("node", ""))
+
+
+class WriteAheadLog:
+    """One log file, append-only; thread-safe (the store appends under its
+    own lock, but the CLI/status path reads sizes concurrently)."""
+
+    def __init__(self, path: str, scheme=None, fsync_every: int = 64,
+                 exempt_kinds=frozenset({"Event"})):
+        self.path = path
+        self._scheme = scheme  # lazy: default_scheme pulls in controllers
+        self.fsync_every = fsync_every
+        # kinds NOT logged (their appends are silent no-ops): Events are
+        # best-effort by contract (client/events.py retains-and-flushes,
+        # losses are counted, the reference keeps them in a dedicated
+        # short-TTL etcd) and the wire scheme does not serve them — a
+        # replayed store starts event-empty, exactly like a reference boot
+        self.exempt_kinds = frozenset(exempt_kinds)
+        self._lock = lockcheck.maybe_wrap(threading.Lock(),
+                                          "WriteAheadLog._lock")
+        self._f = open(path, "ab")
+        self._records = 0           # appended this process
+        self._since_fsync = 0
+        self._last_fsync_rv = 0
+        self._size = self._f.tell()
+
+    # --- write side -----------------------------------------------------------
+
+    def scheme(self):
+        if self._scheme is None:
+            from ..api.scheme import default_scheme
+
+            self._scheme = default_scheme()
+        return self._scheme
+
+    def append(self, op: str, kind: str, *, obj=None, namespace: str = "",
+               name: str = "", node_name: str = "", rv: int = 0) -> None:
+        """Durably log one mutation BEFORE the store applies it in memory.
+
+        Raises on any failure (I/O error, injected torn write) — the store
+        treats a raising append as a failed write and never applies the
+        mutation, so the log can only ever be AHEAD of memory (replay then
+        treats the logged write as committed — the etcd "commit unknown"
+        outcome a client retry must tolerate)."""
+        if kind in self.exempt_kinds:
+            return
+        if obj is not None:
+            from ..api.serialize import to_manifest
+
+            manifest = to_manifest(obj, self.scheme())
+            meta = obj.metadata
+            namespace = namespace or getattr(meta, "namespace", "")
+            name = name or meta.name
+        else:
+            manifest = None
+        rec = WALRecord(op=op, kind=kind, namespace=namespace, name=name,
+                        rv=rv, manifest=manifest, node_name=node_name)
+        payload = rec.payload()
+        blob = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        keep = maybe_torn_write(len(blob))
+        with self._lock:
+            if keep is not None:
+                # torn write: a strict prefix reaches the disk, then the
+                # process dies — flush+fsync makes the TORN state durable
+                # (that is the fault being modeled; replay truncates it)
+                self._f.write(blob[:keep])
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                raise ProcessCrash(CRASH_TORN_WAL_WRITE)
+            self._f.write(blob)
+            self._f.flush()
+            self._size += len(blob)
+            self._records += 1
+            self._since_fsync += 1
+            m.wal_records.inc((op,))
+            m.wal_size_bytes.set(float(self._size))
+        # the acknowledged-but-unsynced window: record bytes are in the OS
+        # buffer, fsync has not run — the registered kill-point sits exactly
+        # here so the crash battery exercises replay from this state
+        maybe_crash(CRASH_PRE_WAL_FSYNC)
+        if self.fsync_every and self._since_fsync >= self.fsync_every:
+            self.sync(rv)
+
+    def sync(self, rv: int = 0) -> None:
+        """fsync the file; ``rv`` (when known) records the durability
+        watermark served by ``ktpu controlplane status``."""
+        with self._lock:
+            os.fsync(self._f.fileno())
+            self._since_fsync = 0
+            if rv:
+                self._last_fsync_rv = rv
+                m.wal_last_fsync_rv.set(float(rv))
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._f.close()
+
+    # --- status (CLI / metrics) ----------------------------------------------
+
+    @property
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._size
+
+    @property
+    def records_appended(self) -> int:
+        with self._lock:
+            return self._records
+
+    @property
+    def last_fsync_rv(self) -> int:
+        with self._lock:
+            return self._last_fsync_rv
+
+
+@dataclass
+class ReplayResult:
+    store: object
+    records_applied: int = 0
+    last_rv: int = 0
+    truncated_tail: bool = False
+    truncated_at: int = 0  # byte offset the torn tail was cut at
+    errors: List[str] = field(default_factory=list)
+
+
+def read_records(path: str):
+    """Yield (offset, WALRecord) for every verifiable record; returns the
+    byte offset where a torn/corrupt tail begins (== file size when the
+    whole log verifies).  Used by replay and by forensic tooling."""
+    good_end = 0
+    records = []
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, off)
+        start = off + _HEADER.size
+        end = start + length
+        if end > len(data):
+            break  # torn: header promises more bytes than exist
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            break  # torn/corrupt: checksum fails
+        try:
+            records.append((off, WALRecord.from_payload(payload)))
+        except (ValueError, KeyError):
+            break  # undecodable payload that passed crc: treat as tail
+        off = end
+        good_end = end
+    return records, good_end
+
+
+def replay_on_boot(path: str, *, store=None, scheme=None,
+                   truncate: bool = True) -> ReplayResult:
+    """Reconstruct an ObjectStore from the WAL (the boot path after real
+    process death).  A torn tail record — crash mid-append — is detected by
+    length/crc and TRUNCATED from the file (when ``truncate``) so the
+    reopened log appends cleanly; every record before it applies.
+
+    The replayed store's watch history (``_log``) is rebuilt too, so the
+    PR-8 cold-start reconstruction (scheduler constructor watch replay)
+    runs on it unchanged."""
+    from ..api.scheme import default_scheme
+    from .store import ObjectStore
+
+    if store is None:
+        store = ObjectStore()
+    scheme = scheme or default_scheme()
+    result = ReplayResult(store=store)
+    if not os.path.exists(path):
+        return result
+    records, good_end = read_records(path)
+    size = os.path.getsize(path)
+    if good_end < size:
+        result.truncated_tail = True
+        result.truncated_at = good_end
+        if truncate:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+        klog.V(1).info_s("WAL torn tail truncated", path=path,
+                         at=good_end, lost_bytes=size - good_end)
+    for _, rec in records:
+        obj = scheme.decode(rec.manifest) if rec.manifest is not None else None
+        store.replay_record(rec.op, rec.kind, obj=obj,
+                            namespace=rec.namespace, name=rec.name,
+                            node_name=rec.node_name, rv=rec.rv)
+        result.records_applied += 1
+        result.last_rv = rec.rv
+    store.rebuild_admission_caches()
+    klog.V(1).info_s("WAL replay complete", path=path,
+                     records=result.records_applied, last_rv=result.last_rv,
+                     truncated=result.truncated_tail)
+    return result

@@ -1,0 +1,392 @@
+"""Versioned watch cache: bounded event ring + object snapshot in front of
+the store, serving lists and watch replays WITHOUT the store lock.
+
+Reference: staging/src/k8s.io/apiserver/pkg/storage/cacher/cacher.go — the
+layer that lets one apiserver fan a write out to thousands of watchers while
+etcd sees exactly one watch.  Mirrored behaviors:
+
+  - LIST and ``since_rv`` watch replay are served from the cache's own
+    snapshot + ring under the cache's own lock: zero store-lock
+    acquisitions on the read path (asserted against ``ObjectStore.read_ops``
+    deltas — the scale property ROADMAP item 2 names);
+  - resourceVersion-consistent pagination (``limit``/``continue``): every
+    page of one list is served AT THE SAME rv — the ring's per-event
+    pre-state manifests roll the snapshot back, so concurrent writes can
+    never tear a paginated relist (etcd3 pagination contract);
+  - a watch/list at an rv older than the ring answers
+    ``TooOldResourceVersion`` (410 Gone) → the client relists, exactly the
+    reference's too-old-resourceVersion contract (cacher.go:161-185);
+  - periodic BOOKMARK delivery (``bookmark_now``/``start_bookmarks``) keeps
+    idle watchers' restart points fresh so a reconnect replays almost
+    nothing instead of relisting the world.
+
+Ring sizing: each entry holds the event plus the PREVIOUS wire manifest of
+the object (captured at apply time — the only moment the pre-state exists;
+in-process callers that mutate objects in place carry the same
+elided-history caveat client/informer.py documents).  A ring of R events
+serves any watcher or continue token that lags by < R writes; older ones
+pay one relist.  Default 4096 ≈ a few MB of manifests under churn.
+"""
+
+from __future__ import annotations
+
+import base64
+import bisect
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis import lockcheck
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from .store import ADDED, DELETED, MODIFIED, ObjectStore, WatchEvent
+
+
+class TooOldResourceVersion(ValueError):
+    """Requested rv is older than the ring can replay (410 Gone analog):
+    the caller must relist from a fresh LIST and re-watch from its rv."""
+
+
+@dataclass
+class _RingEntry:
+    ev: WatchEvent
+    # the object's wire form BEFORE this event applied (None for ADDED):
+    # what list-at-rv rollback restores
+    prev_manifest: Optional[dict]
+
+
+class _CacheWatcher:
+    __slots__ = ("handler", "on_error", "on_bookmark", "syncing", "pending")
+
+    def __init__(self, handler, on_error, on_bookmark):
+        self.handler = handler
+        self.on_error = on_error
+        self.on_bookmark = on_bookmark
+        # True while the initial ring replay is still being delivered:
+        # concurrent live events buffer in ``pending`` (appended under the
+        # cache lock) and drain IN ORDER before the watcher goes live — the
+        # no-gap, no-reorder handoff the store gets from replaying under
+        # its big lock, without holding any lock across handler calls
+        self.syncing = True
+        self.pending: List[WatchEvent] = []
+
+
+def _encode_continue(rv: int, after: Tuple[str, str]) -> str:
+    raw = json.dumps({"rv": rv, "after": list(after)},
+                     separators=(",", ":")).encode()
+    return base64.urlsafe_b64encode(raw).decode()
+
+
+def _decode_continue(token: str) -> Tuple[int, Tuple[str, str]]:
+    try:
+        body = json.loads(base64.urlsafe_b64decode(token.encode()))
+        return int(body["rv"]), (body["after"][0], body["after"][1])
+    except (ValueError, KeyError, IndexError, TypeError) as e:
+        raise ValueError(f"malformed continue token: {e}")
+
+
+class WatchCache:
+    """One cache per store; construct AFTER the store holds its seed state
+    or before — the constructor's subscription replays full history."""
+
+    def __init__(self, store: ObjectStore, scheme=None,
+                 ring_size: int = 4096):
+        self._store = store
+        self._scheme = scheme
+        self.ring_size = ring_size
+        self._lock = lockcheck.maybe_wrap(threading.RLock(),
+                                          "WatchCache._lock")
+        self._objects: Dict[Tuple[str, str, str], object] = {}
+        # rv-ascending event ring: a plain list + parallel rv index so
+        # since_rv replay BISECTS to its start instead of scanning (a
+        # thousand-watcher resync must cost its GAP, not the ring length);
+        # compaction drops the oldest half-chunk when length exceeds
+        # 2×ring_size — O(1) amortized, retained window ∈ [ring_size, 2×]
+        self._ring: List[_RingEntry] = []
+        self._ring_rvs: List[int] = []
+        self._rv = 0
+        # highest rv whose fan-out to live watchers has COMPLETED: _apply
+        # advances _rv under the lock but delivers outside it, so a
+        # bookmark must never claim an rv whose event a watcher has not
+        # been handed yet — bookmarks read this watermark, lists read _rv
+        self._fanned_rv = 0
+        # rv of the NEWEST event compacted out of the ring: since_rv below
+        # this cannot be served (events after it are gone) → 410
+        self._compacted_rv = 0
+        self._watchers: List[_CacheWatcher] = []
+        self._stopped = False
+        self._bookmark_thread: Optional[threading.Thread] = None
+        # single-entry page memo: (rv, kind) → (snapshot, sorted keys).
+        # A paginated walk hits list_page once per page at ONE rv — without
+        # this, every page re-copies and re-sorts the whole kind
+        # (O(N²/limit) per walk); with it, the walk costs one snapshot
+        # total.  One entry suffices (walks are sequential per token) and
+        # a stale entry is just replaced.
+        self._page_memo: Optional[Tuple[int, str, dict, list]] = None
+        # subscribing replays the store's full history through _apply under
+        # the store lock — the cache is consistent from its first instant.
+        # No on_error: an in-process synchronous subscriber is never
+        # chaos-dropped (store contract), so the cache itself cannot lose
+        # the stream it re-serves.
+        self._unwatch = store.watch(self._apply)
+
+    def scheme(self):
+        if self._scheme is None:
+            from ..api.scheme import default_scheme
+
+            self._scheme = default_scheme()
+        return self._scheme
+
+    # --- write side: the store's fan-out ------------------------------------
+
+    def _key(self, ev: WatchEvent) -> Tuple[str, str, str]:
+        meta = ev.obj.metadata
+        ns = ("" if ev.kind in ObjectStore.CLUSTER_SCOPED
+              else getattr(meta, "namespace", ""))
+        return (ev.kind, ns, meta.name)
+
+    def _apply(self, ev: WatchEvent) -> None:
+        """Apply one store event to snapshot + ring, then fan out.
+
+        Runs on the writer's thread under the STORE lock (we are a store
+        watcher) — but handler/callback invocation happens OUTSIDE the
+        cache lock, so no lock order cache→anything is ever created."""
+        from ..api.serialize import to_manifest
+
+        key = self._key(ev)
+        with self._lock:
+            prev = self._objects.get(key)
+            prev_manifest = (to_manifest(prev, self.scheme())
+                             if prev is not None else None)
+            if ev.type == DELETED:
+                self._objects.pop(key, None)
+            else:
+                self._objects[key] = ev.obj
+            self._rv = ev.resource_version
+            self._ring.append(_RingEntry(ev, prev_manifest))
+            self._ring_rvs.append(ev.resource_version)
+            if len(self._ring) > 2 * self.ring_size:
+                drop = len(self._ring) - self.ring_size
+                self._compacted_rv = self._ring_rvs[drop - 1]
+                del self._ring[:drop]
+                del self._ring_rvs[:drop]
+                m.watch_cache_oldest_rv.set(float(self._compacted_rv))
+            m.watch_cache_ring_occupancy.set(float(len(self._ring)))
+            live: List[_CacheWatcher] = []
+            dropped: List[_CacheWatcher] = []
+            drop = False
+            fault = self._store.fault
+            if fault is not None and any(w.on_error is not None
+                                         for w in self._watchers):
+                name = getattr(ev.obj.metadata, "name", "")
+                # memoized by (kind, name, rv): the cache layer reaches the
+                # SAME deterministic decision as the store/apiserver layers
+                drop = fault.should_drop_watch(ev.kind, name,
+                                               rv=ev.resource_version)
+            for w in self._watchers:
+                if w.syncing:
+                    # still mid-attach: buffer instead of dropping — its
+                    # watch() call has not returned, so an on_error fired
+                    # now would race the caller's own handle assignment
+                    w.pending.append(ev)
+                elif drop and w.on_error is not None:
+                    dropped.append(w)
+                else:
+                    live.append(w)
+            for w in dropped:
+                self._watchers.remove(w)
+        for w in dropped:
+            from ..chaos.faults import WatchDropped
+
+            w.on_error(WatchDropped(
+                f"chaos: watch dropped at {ev.kind} "
+                f"rv={ev.resource_version}"))
+        for w in live:
+            w.handler(ev)
+        with self._lock:
+            # fan-out complete: bookmarks may now cover this rv (store
+            # emits are serialized under its lock, so no later event's
+            # watermark can be overtaken by an earlier in-flight one)
+            self._fanned_rv = ev.resource_version
+
+    # --- read side: served with ZERO store-lock acquisitions ------------------
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def fanned_rv(self) -> int:
+        """Highest rv every live watcher has been handed (the rv a
+        BOOKMARK may safely carry — see _apply)."""
+        with self._lock:
+            return self._fanned_rv
+
+    @property
+    def ring_occupancy(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def oldest_rv(self) -> int:
+        """Oldest since_rv still servable (410 below this)."""
+        with self._lock:
+            return self._compacted_rv
+
+    def list(self, kind: str) -> Tuple[List[object], int]:
+        """The store's (objects, rv) list contract, from the snapshot."""
+        with self._lock:
+            objs = [o for (k, _, _), o in self._objects.items() if k == kind]
+            return objs, self._rv
+
+    def _objects_at(self, rv: int) -> Dict[Tuple[str, str, str], object]:
+        """Snapshot as of ``rv``: the current map rolled back through the
+        ring's pre-state manifests.  Caller holds the cache lock."""
+        if rv >= self._rv:
+            return dict(self._objects)
+        if rv < self._compacted_rv:
+            raise TooOldResourceVersion(
+                f"resourceVersion {rv} is too old "
+                f"(oldest replayable: {self._compacted_rv})")
+        out = dict(self._objects)
+        start = bisect.bisect_right(self._ring_rvs, rv)
+        for entry in reversed(self._ring[start:]):
+            key = self._key(entry.ev)
+            if entry.prev_manifest is None:  # ADDED: did not exist before
+                out.pop(key, None)
+            else:
+                obj = self.scheme().decode(entry.prev_manifest)
+                # decode drops resourceVersion on purpose (server write
+                # paths re-stamp it); a rolled-back object must carry the
+                # rv it HAD, or list-at-rv would not be bit-faithful
+                prev_rv = (entry.prev_manifest.get("metadata") or {}) \
+                    .get("resourceVersion")
+                if prev_rv:
+                    obj.metadata.resource_version = int(prev_rv)
+                out[key] = obj
+        return out
+
+    def list_page(self, kind: str, limit: int = 0,
+                  continue_: Optional[str] = None,
+                  resource_version: Optional[int] = None
+                  ) -> Tuple[List[object], int, str]:
+        """rv-consistent pagination: (objects, rv, continue token; '' when
+        exhausted).  Every page of one walk is served at the token's rv —
+        writes between pages cannot add, drop, or duplicate items.  A token
+        whose rv has been compacted out of the ring raises
+        TooOldResourceVersion (the 410 the reference returns for an expired
+        continue token)."""
+        after: Tuple[str, str] = ("", "")
+        with self._lock:
+            if continue_:
+                rv, after = _decode_continue(continue_)
+            elif resource_version is not None:
+                rv = resource_version
+            else:
+                rv = self._rv
+            if rv < self._compacted_rv:
+                # the 410 horizon is the RING's, deterministically — a
+                # memoized snapshot must not keep an expired continue
+                # token alive past it (clients would see expiry depend on
+                # cache-internal eviction timing)
+                raise TooOldResourceVersion(
+                    f"resourceVersion {rv} is too old "
+                    f"(oldest replayable: {self._compacted_rv})")
+            memo = self._page_memo
+            if memo is not None and memo[0] == rv and memo[1] == kind:
+                snapshot, keys = memo[2], memo[3]
+            else:
+                snapshot = self._objects_at(rv)
+                keys = sorted(k for k in snapshot if k[0] == kind)
+                self._page_memo = (rv, kind, snapshot, keys)
+        if after != ("", ""):
+            lo = bisect.bisect_right(keys, (kind,) + after)
+            keys = keys[lo:]
+        if limit and len(keys) > limit:
+            page, rest = keys[:limit], keys[limit:]
+            token = _encode_continue(rv, (page[-1][1], page[-1][2]))
+        else:
+            page, rest, token = keys, [], ""
+        return [snapshot[k] for k in page], rv, (token if rest else "")
+
+    # --- watch side -----------------------------------------------------------
+
+    def watch(self, handler: Callable[[WatchEvent], None], since_rv: int = 0,
+              on_error: Optional[Callable[[Exception], None]] = None,
+              on_bookmark: Optional[Callable[[int], None]] = None):
+        """Replay ring events after ``since_rv``, then subscribe — the
+        store's watch contract, without its lock.  ``since_rv`` 0 means
+        "from the beginning", which the ring can only serve while nothing
+        has been compacted; callers starting cold should LIST first and
+        watch from the returned rv (the reflector already does).
+
+        Raises TooOldResourceVersion when events after ``since_rv`` have
+        been compacted away — the 410 that tells the client to relist."""
+        w = _CacheWatcher(handler, on_error, on_bookmark)
+        with self._lock:
+            if since_rv < self._compacted_rv:
+                raise TooOldResourceVersion(
+                    f"resourceVersion {since_rv} is too old "
+                    f"(oldest replayable: {self._compacted_rv})")
+            start = bisect.bisect_right(self._ring_rvs, since_rv)
+            backlog = [e.ev for e in self._ring[start:]]
+            self._watchers.append(w)
+        # deliver the backlog OUTSIDE the lock; live events that raced in
+        # buffered to w.pending (under the lock) and drain in order below —
+        # then the watcher goes live atomically
+        for ev in backlog:
+            handler(ev)
+        while True:
+            with self._lock:
+                if not w.pending:
+                    w.syncing = False
+                    break
+                batch, w.pending = w.pending, []
+            for ev in batch:
+                handler(ev)
+
+        def unwatch():
+            with self._lock:
+                if w in self._watchers:
+                    self._watchers.remove(w)
+
+        return unwatch
+
+    # --- bookmarks ------------------------------------------------------------
+
+    def bookmark_now(self) -> int:
+        """Deliver the current rv to every bookmark-consuming watcher (the
+        cacher's bookmarkFrequency tick, callable on demand so tests are
+        deterministic).  Returns the rv delivered."""
+        with self._lock:
+            rv = self._fanned_rv
+            targets = [w for w in self._watchers
+                       if w.on_bookmark is not None and not w.syncing]
+        for w in targets:
+            w.on_bookmark(rv)
+        return rv
+
+    def start_bookmarks(self, interval: float = 1.0) -> None:
+        """Background bookmark cadence (idempotent)."""
+        if self._bookmark_thread is not None:
+            return
+
+        def run():
+            while not self._stopped:
+                time.sleep(interval)
+                if self._stopped:
+                    return
+                self.bookmark_now()
+
+        self._bookmark_thread = threading.Thread(
+            target=run, name="watchcache-bookmarks", daemon=True)
+        self._bookmark_thread.start()
+
+    def close(self) -> None:
+        self._stopped = True
+        if self._unwatch is not None:
+            self._unwatch()
+            self._unwatch = None
+        klog.V(2).info_s("watch cache closed",
+                         ring=len(self._ring), rv=self._rv)

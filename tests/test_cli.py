@@ -183,3 +183,35 @@ def test_cli_main_topology(capsys):
 
     main(["topology"])
     assert "node-axis sharding" in capsys.readouterr().out
+
+
+def test_cli_main_controlplane_status(capsys):
+    from kubernetes_tpu.cli import main
+
+    main(["controlplane", "status"])
+    out = capsys.readouterr().out
+    assert "wal" in out and "watch-cache" in out and "flow-" in out
+
+
+def test_cli_controlplane_status_over_server():
+    """--server path: the verb reads the apiserver's /metrics exposition
+    and renders the same table the in-process path does."""
+    import urllib.request
+
+    from kubernetes_tpu.cli import Kubectl
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.metrics.registry import parse_text
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.testutil import make_pod
+
+    store = ObjectStore()
+    api = APIServer(store).start()
+    try:
+        store.create("Pod", make_pod().name("cp0").uid("cp0")
+                     .namespace("default").obj())
+        with urllib.request.urlopen(f"{api.url}/metrics") as r:
+            metrics = parse_text(r.read().decode())
+        out = Kubectl(store).controlplane_status(metrics=metrics)
+        assert "ring-occupancy" in out and "last-fsync-rv" in out
+    finally:
+        api.stop()

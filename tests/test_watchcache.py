@@ -1,0 +1,346 @@
+"""Watch cache: snapshot/ring consistency, rv-pinned pagination, 410 Gone
+→ clean relist, bookmark resyncs, and the zero-store-lock contract.
+
+Reference behaviors exercised: storage/cacher/cacher.go (lists and watch
+replays served from the cache, bookmarks, too-old-resourceVersion → 410)
+and the etcd3 pagination contract (every page of one LIST walk at one rv).
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import to_manifest
+from kubernetes_tpu.chaos import FaultSchedule
+from kubernetes_tpu.chaos.flood import watch_churn_soak
+from kubernetes_tpu.client.informer import Reflector
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.sim.watchcache import TooOldResourceVersion, WatchCache
+from kubernetes_tpu.testutil import make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    """Cache fan-out runs under the store lock and its readers under the
+    cache lock — every battery here runs with inversion detection."""
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
+
+
+SCHEME = default_scheme()
+
+
+def _pod(i, ns="default"):
+    return (make_pod().name(f"p{i:03d}").uid(f"p{i:03d}").namespace(ns)
+            .req({"cpu": "1"}).creation_timestamp(100.0 + i).obj())
+
+
+def _fresh_update(store, name, label_val):
+    """Update through a DECODED copy — a fresh object per write, so the
+    pre-state genuinely exists (the in-place-mutation caveat the informer
+    documents does not apply) and rollback equality is exact."""
+    cur = store.get("Pod", "default", name)
+    obj = SCHEME.decode(to_manifest(cur, SCHEME))
+    obj.metadata.labels["v"] = label_val
+    store.update("Pod", obj)
+
+
+def _names(objs):
+    return [o.metadata.name for o in objs]
+
+
+def _mans(objs):
+    return {o.metadata.name: to_manifest(o, SCHEME) for o in objs}
+
+
+# --- snapshot + list-at-rv consistency ----------------------------------------
+
+
+def test_cache_mirrors_store_and_serves_reads_lock_free():
+    store = ObjectStore()
+    cache = WatchCache(store)
+    for i in range(6):
+        store.create("Pod", _pod(i))
+    store.delete("Pod", "default", "p003")
+    store_names = sorted(_names(store.list("Pod")[0]))
+    reads0 = store.read_ops
+    objs, rv = cache.list("Pod")
+    assert sorted(_names(objs)) == store_names
+    assert rv == store._rv
+    page, prv, tok = cache.list_page("Pod", limit=100)
+    assert _names(page) == sorted(_names(objs)) and tok == ""
+    assert store.read_ops == reads0, "cache reads touched the store lock"
+
+
+def test_list_at_rv_equals_store_list_at_that_rv():
+    """The consistency oracle: capture the store's list at rv R, churn,
+    then ask the cache for rv R — bit-identical manifests."""
+    store = ObjectStore()
+    cache = WatchCache(store)
+    for i in range(5):
+        store.create("Pod", _pod(i))
+    _fresh_update(store, "p001", "one")
+    at_rv = store.current_rv()
+    captured = _mans(store.list("Pod")[0])
+    # churn past the capture: adds, fresh-object updates, deletes
+    store.create("Pod", _pod(7))
+    _fresh_update(store, "p001", "two")
+    _fresh_update(store, "p004", "x")
+    store.delete("Pod", "default", "p002")
+    objs, rv, tok = cache.list_page("Pod", resource_version=at_rv)
+    assert rv == at_rv and tok == ""
+    assert _mans(objs) == captured
+    # and the live list reflects the churn
+    live, _, _ = cache.list_page("Pod")
+    assert _mans(live) == _mans(store.list("Pod")[0])
+
+
+def test_pagination_stable_across_concurrent_writes():
+    store = ObjectStore()
+    cache = WatchCache(store)
+    for i in range(9):
+        store.create("Pod", _pod(i))
+    page, rv0, tok = cache.list_page("Pod", limit=3)
+    walked = _names(page)
+    # interleave every mutation class between pages
+    store.create("Pod", _pod(20))          # sorts after the walk window
+    store.delete("Pod", "default", "p005")  # not yet visited at rv0
+    _fresh_update(store, "p007", "mid-walk")
+    while tok:
+        page, rv, tok = cache.list_page("Pod", limit=3, continue_=tok)
+        assert rv == rv0  # every page pinned to the walk's rv
+        walked += _names(page)
+    assert walked == [f"p{i:03d}" for i in range(9)]
+    # a FRESH walk sees the post-churn world
+    fresh, _, tok = cache.list_page("Pod", limit=100)
+    assert "p005" not in _names(fresh) and "p020" in _names(fresh)
+
+
+def test_too_old_rv_answers_410_for_list_watch_and_continue():
+    store = ObjectStore()
+    cache = WatchCache(store, ring_size=4)
+    for i in range(3):
+        store.create("Pod", _pod(i))
+    _, _, tok = cache.list_page("Pod", limit=1)
+    early_rv = store.current_rv()
+    for _ in range(12):  # churn past 2×ring_size → compaction
+        _fresh_update(store, "p000", "churn")
+    assert cache.oldest_rv > 0
+    with pytest.raises(TooOldResourceVersion):
+        cache.list_page("Pod", resource_version=early_rv - 1)
+    with pytest.raises(TooOldResourceVersion):
+        cache.watch(lambda ev: None, since_rv=1)
+    with pytest.raises(TooOldResourceVersion):
+        cache.list_page("Pod", limit=1, continue_=tok)  # expired token
+    # a fresh LIST + watch-from-its-rv recovers (the 410 contract)
+    objs, rv = cache.list("Pod")
+    got = []
+    un = cache.watch(got.append, since_rv=rv)
+    _fresh_update(store, "p001", "after")
+    assert [ev.obj.metadata.name for ev in got] == ["p001"]
+    un()
+
+
+def test_watch_replay_has_no_gaps_or_reorders_under_concurrent_writes():
+    """Watchers attach mid-churn: each must see a gapless rv-ascending
+    suffix (ring replay + pending handoff + live delivery, no seams)."""
+    store = ObjectStore()
+    cache = WatchCache(store, ring_size=1 << 12)
+    for i in range(4):
+        store.create("Pod", _pod(i))
+    stop = threading.Event()
+
+    def churner():
+        j = 0
+        while not stop.is_set():
+            _fresh_update(store, f"p{j % 4:03d}", f"c{j}")
+            j += 1
+
+    t = threading.Thread(target=churner, daemon=True)
+    t.start()
+    try:
+        for _ in range(20):
+            got = []
+            since = cache.current_rv()
+            un = cache.watch(got.append, since_rv=since)
+            while len(got) < 5:
+                pass  # the churner keeps writing
+            un()
+            rvs = [ev.resource_version for ev in got[:5]]
+            assert rvs[0] > since
+            assert rvs == sorted(set(rvs)), f"gap/reorder: {rvs}"
+    finally:
+        stop.set()
+        t.join(5)
+
+
+# --- bookmarks + reflector integration ----------------------------------------
+
+
+def test_bookmark_advances_reflector_and_resume_skips_relist():
+    store = ObjectStore()
+    cache = WatchCache(store)
+    for i in range(3):
+        store.create("Pod", _pod(i))
+    refl = Reflector(cache, "Pod", rewatch_on_error=True)
+    refl.run()
+    # pre-decode the post-resume write so the read_ops bracket below sees
+    # only the CACHE's work, not this driver's store.get
+    staged = SCHEME.decode(to_manifest(store.get("Pod", "default", "p001"),
+                                       SCHEME))
+    staged.metadata.labels["v"] = "after-resume"
+    reads0 = store.read_ops
+    bm0 = m.informer_relists.value(("bookmark",))
+    # another kind's write advances the world PAST this reflector's last
+    # event — exactly what bookmarks exist to communicate to idle watchers
+    from kubernetes_tpu.testutil import make_node
+
+    store.create("Node", make_node().name("bm-node").obj())
+    before = refl.last_rv
+    rv = cache.bookmark_now()
+    assert rv > before
+    assert refl.last_rv == rv == cache.fanned_rv()
+    # cut the stream (simulate a drop): resume must come from last_rv via
+    # the ring — no relist, and the bookmark-saved resync is counted
+    refl._on_watch_error(ConnectionError("injected stream cut"))
+    assert refl.relists == 0
+    assert m.informer_relists.value(("bookmark",)) == bm0 + 1
+    store.update("Pod", staged)
+    assert refl.items[("default", "p001")].metadata.labels["v"] == \
+        "after-resume"
+    assert store.read_ops == reads0
+    refl.stop()
+
+
+def test_chaos_drop_through_cache_resumes_without_event_loss():
+    """A chaos-dropped cache watcher resumes from its rv: the ring replays
+    the very event whose fan-out cut the stream — convergence WITHOUT the
+    O(objects) relist the plain store path needs."""
+    fault = FaultSchedule(0, watch_drop_rate=1.0, max_faults_per_key=2)
+    store = ObjectStore(fault_injector=fault)
+    cache = WatchCache(store)
+    for i in range(3):
+        store.create("Pod", _pod(i))
+    refl = Reflector(cache, "Pod", rewatch_on_error=True)
+    refl.run()
+    for i in range(3, 9):
+        store.create("Pod", _pod(i))  # drops fire on these fan-outs
+    assert fault.injected_counts().get("watch_drop", 0) >= 1
+    assert len(refl.items) == 9, "dropped event lost despite ring resume"
+    assert refl.relists == 0  # every recovery was a resume, not a relist
+    refl.stop()
+
+
+def test_reflector_paged_relist_and_410_fallback():
+    store = ObjectStore()
+    cache = WatchCache(store, ring_size=4)
+    for i in range(9):
+        store.create("Pod", _pod(i))
+    paged0 = m.informer_relists.value(("paged",))
+    refl = Reflector(cache, "Pod", relist_page_size=3, rewatch_on_error=True)
+    refl.run()
+    assert len(refl.items) == 9
+    # the initial sync is paged but is NOT a relist: not counted
+    assert m.informer_relists.value(("paged",)) == paged0
+    # churn the ring past the reflector's rv while its stream is "down",
+    # then break the stream: resume gets 410 → full (paged) relist
+    refl._unwatch()
+    refl._unwatch = None
+    for _ in range(12):
+        _fresh_update(store, "p000", "churn")
+    assert refl.last_rv < cache.oldest_rv
+    refl._on_watch_error(ConnectionError("stream cut while lagging"))
+    assert refl.relists == 1  # the 410 forced exactly one relist
+    assert m.informer_relists.value(("paged",)) == paged0 + 1
+    assert refl.items[("default", "p000")].metadata.labels["v"] == "churn"
+    _fresh_update(store, "p001", "live-again")
+    assert refl.items[("default", "p001")].metadata.labels["v"] == \
+        "live-again"
+    refl.stop()
+
+
+# --- HTTP: pagination, 410, paged relists over the wire -----------------------
+
+
+def test_http_list_pagination_and_410(free_port_apiserver=None):
+    from kubernetes_tpu.apiserver.client import HTTPApiClient
+    from kubernetes_tpu.apiserver.server import APIServer
+
+    store = ObjectStore()
+    api = APIServer(store).start()
+    try:
+        for i in range(7):
+            store.create("Pod", _pod(i))
+        client = HTTPApiClient(api.url)
+        walked, tok = [], None
+        while True:
+            page, rv, tok = client.list_page("Pod", limit=3, continue_=tok)
+            walked += _names(page)
+            if not tok:
+                break
+        assert walked == [f"p{i:03d}" for i in range(7)]
+        # paged reflector over HTTP: the initial sync pages but does not
+        # count as a relist; an error-driven relist pages AND counts
+        paged0 = m.informer_relists.value(("paged",))
+        refl = Reflector(client.for_kind("Pod"), "Pod", relist_page_size=3)
+        refl.run()
+        assert len(refl.items) == 7
+        assert m.informer_relists.value(("paged",)) == paged0
+        refl._on_watch_error(ConnectionError("forced relist"))
+        assert m.informer_relists.value(("paged",)) == paged0 + 1
+        refl.stop()
+        # 410 on a watch from a compacted rv
+        import urllib.error
+        import urllib.request
+
+        api.watch_cache.ring_size = 4
+        for _ in range(12):
+            _fresh_update(store, "p000", "churn")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{api.url}/api/v1/pods?watch=true&resourceVersion=1"
+                f"&timeoutSeconds=1").read()
+        assert ei.value.code == 410
+        assert m.apiserver_rejected.value(("watch_expired",)) >= 1
+        # LIST resourceVersion=0 is "serve current from cache" (the
+        # client-go reflector form) — never a rollback to pre-history,
+        # never 410, even after the ring compacted
+        import json as _json
+
+        with urllib.request.urlopen(
+                f"{api.url}/api/v1/pods?resourceVersion=0") as r:
+            body = _json.loads(r.read())
+        assert len(body["items"]) == 7
+    finally:
+        api.stop()
+
+
+# --- the churn soak (fast shape; acceptance shape is slow-marked) -------------
+
+
+def test_watcher_churn_fast_shape():
+    result = watch_churn_soak(n_watchers=200, n_objects=100, growth=10,
+                              churn_rounds=2, resyncs=30)
+    assert result["store_read_ops_delta"] == 0
+    assert result["watchers_complete"] == 200
+    assert result["events_per_watcher"] == result["events_expected"]
+    assert result["resync_ratio"] < 3.0, result
+
+
+@pytest.mark.slow
+def test_thousand_watcher_soak_acceptance_shape():
+    """ISSUE 11 acceptance: 1000 watchers, 10× object growth, flat resync
+    cost, zero store-lock reads (tools/watch_soak.py runs this same shape
+    as the CI gate)."""
+    result = watch_churn_soak(n_watchers=1000, n_objects=200, growth=10,
+                              churn_rounds=2, resyncs=50)
+    assert result["store_read_ops_delta"] == 0
+    assert result["watchers_complete"] == 1000
+    assert result["resync_ratio"] < 3.0, result

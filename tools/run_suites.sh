@@ -33,6 +33,25 @@ JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_descheduler.py tests/t
 # predictions or the scale decisions are broken
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_whatif.py tests/test_autoscaler.py -q \
   || { echo "FAILED: autoscaler test gate" >> suites_run.log; exit 1; }
+# WAL crash-survival gate: a REAL kill -9 of a subprocess mid-bind (clean
+# and torn-tail variants) followed by replay_on_boot — exactly-once binds,
+# replayed store bit-identical to a never-crashed replica's.  Runs in ~2s
+# with no jax; a control plane that loses acknowledged binds on process
+# death makes every perf number below meaningless, so fail first.
+timeout 300 python tools/wal_crash_gate.py \
+  || { echo "FAILED: WAL crash-survival gate" >> suites_run.log; exit 1; }
+# control-plane durability/flow gate: the WAL + watch-cache + flow-control
+# batteries (torn tails, rv-consistent pagination, 410 relists, reader
+# floods) — cheap and conclusive before the suites
+JAX_PLATFORMS=cpu timeout 900 python -m pytest \
+  tests/test_wal.py tests/test_watchcache.py tests/test_flowcontrol.py \
+  -q -m 'not slow' \
+  || { echo "FAILED: control-plane test gate" >> suites_run.log; exit 1; }
+# thousand-watcher churn soak: relist cost must stay FLAT across a 10x
+# object-count growth and the list/watch-replay path must take zero
+# store-lock reads (the "millions of users" control-plane property)
+timeout 600 python tools/watch_soak.py \
+  || { echo "FAILED: watch soak gate" >> suites_run.log; exit 1; }
 # crash-restart gate: the kill-point battery + cold-start reconstruction +
 # the fast failover soak (leader killed at every registered crash point,
 # exactly-once binding, zero unrepaired drift) — perf numbers from a tree

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Thousand-watcher churn soak (ISSUE 11 acceptance; run_suites.sh gate).
+
+Drives chaos/flood.watch_churn_soak at the acceptance shape — 1000
+concurrent watchers on one WatchCache, object count grown 10× mid-soak —
+and asserts the two scale properties:
+
+  - zero store-lock acquisitions on the list/watch-replay path
+    (ObjectStore.read_ops delta over the whole soak);
+  - resync cost flat across the 10× growth (a dropped watcher resumes by
+    ring replay of its bounded gap, never an O(objects) relist):
+    ratio < 3, with the absolute numbers printed for the record.
+
+No jax: pure control-plane layers, runs in seconds.  The smaller tier-1
+shape lives in tests/test_watchcache.py; the slow-marked test runs this
+exact configuration.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.chaos.flood import watch_churn_soak  # noqa: E402
+
+
+def main() -> int:
+    result = watch_churn_soak(
+        n_watchers=int(os.environ.get("SOAK_WATCHERS", "1000")),
+        n_objects=int(os.environ.get("SOAK_OBJECTS", "200")),
+        growth=10, churn_rounds=2, resyncs=50)
+    ok = (result["store_read_ops_delta"] == 0
+          and result["watchers_complete"] == result["n_watchers"]
+          and result["resync_ratio"] < 3.0)
+    result["watch_soak"] = "PASS" if ok else "FAIL"
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
