@@ -363,7 +363,7 @@ class HTTPExtender:
                 sock, server_hostname=u.hostname)
         return (sock, sock.makefile("rb"))
 
-    def _send(self, verb: str, payload: dict,
+    def _send(self, verb: str, payload,
               idempotent: bool = False) -> dict:
         """POST over a POOLED persistent connection — hand-rolled HTTP/1.1
         (see the fast-path note above; the stdlib stack's per-message
@@ -381,7 +381,12 @@ class HTTPExtender:
         preempt) never resend once any byte arrived (double-bind hazard)."""
         u = urlparse(self.cfg.url_prefix)
         path = f"{u.path.rstrip('/')}/{verb}"
-        body = json.dumps(payload).encode()
+        # pre-encoded bodies (bytes) skip json.dumps: the round walk builds
+        # callout bodies from cached pod/name-list fragments, and at ~40KB
+        # of JSON per pod-round the encode was a measured slice of the
+        # single-core extender suite's wall
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode()
         # resolved port, matching _fresh_conn: u.port is None for a URL
         # without an explicit port, and "Host: example.com:None" breaks
         # strict servers / vhost routing (ADVICE round 5)
@@ -463,11 +468,34 @@ class HTTPExtender:
                 sock.close()
                 raise
 
+    def _args_body(self, pod: v1.Pod, node_names: List[str],
+                   names_json: Optional[bytes],
+                   node_manifests=None) -> bytes:
+        """ExtenderArgs wire bytes, assembled from cached fragments.
+
+        nodeCacheCapable extenders get the NAME-LIST form (``nodenames``,
+        extender.go:277 convertToNodeNames) — the fast path the suites
+        measure; non-capable extenders get full node manifests under
+        ``nodes.items`` exactly as the reference client does
+        (extender.go:416 ``send`` with ExtenderArgs.Nodes), built through
+        the caller-provided ``node_manifests(names) -> bytes`` hook (the
+        scheduler caches the encoded manifest list per feasible-set)."""
+        pod_json = _pod_to_json(pod)
+        if self.cfg.node_cache_capable or node_manifests is None:
+            names = names_json if names_json is not None \
+                else json.dumps(node_names).encode()
+            return b'{"pod":' + pod_json + b',"nodenames":' + names + b"}"
+        return (b'{"pod":' + pod_json + b',"nodes":{"items":'
+                + node_manifests(node_names) + b"}}")
+
     def filter(
-        self, pod: v1.Pod, node_names: List[str]
+        self, pod: v1.Pod, node_names: List[str],
+        names_json: Optional[bytes] = None, node_manifests=None,
     ) -> Tuple[List[str], Dict[str, str]]:
         """→ (feasible node names, failed node → reason). ExtenderArgs uses
-        nodenames when nodeCacheCapable (extender.go:277-345)."""
+        nodenames when nodeCacheCapable, full manifests otherwise
+        (extender.go:277-345); ``names_json``/``node_manifests`` are
+        optional pre-encoded fragments (see _args_body)."""
         if not self.cfg.filter_verb:
             return node_names, {}
         if not self._circuit_allow():
@@ -480,9 +508,9 @@ class HTTPExtender:
                 return node_names, {}
             raise ExtenderError(
                 f"extender {self.cfg.url_prefix}: circuit open")
-        args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
+        body = self._args_body(pod, node_names, names_json, node_manifests)
         try:
-            result = self._send(self.cfg.filter_verb, args, idempotent=True)
+            result = self._send(self.cfg.filter_verb, body, idempotent=True)
         except Exception as e:
             self._circuit_result(False)
             if self.cfg.ignorable:
@@ -493,13 +521,25 @@ class HTTPExtender:
             # protocol-level error from a HEALTHY extender (it answered):
             # not a transport failure — the circuit stays closed
             raise ExtenderError(result["error"])
-        return list(result.get("nodenames") or []), dict(result.get("failedNodes") or {})
+        if result.get("nodenames") is not None:
+            names = list(result.get("nodenames") or [])
+        else:
+            # non-capable reply form: full node objects (FilterResult.Nodes)
+            names = [
+                ((item.get("metadata") or {}).get("name"))
+                for item in ((result.get("nodes") or {}).get("items") or [])
+            ]
+            names = [n for n in names if n]
+        return names, dict(result.get("failedNodes") or {})
 
     def prioritize(
-        self, pod: v1.Pod, node_names: List[str]
+        self, pod: v1.Pod, node_names: List[str],
+        names_json: Optional[bytes] = None, node_manifests=None,
     ) -> Dict[str, float]:
         """→ node → weighted score contribution (HostPriorityList × weight,
-        scheduler.go:1146-1185)."""
+        scheduler.go:1146-1185).  The reference's ``send`` builds ONE
+        ExtenderArgs form per extender for BOTH verbs, so a
+        non-nodeCacheCapable extender receives full manifests here too."""
         if not self.cfg.prioritize_verb:
             return {}
         if not self._circuit_allow():
@@ -507,9 +547,9 @@ class HTTPExtender:
                 return {}
             raise ExtenderError(
                 f"extender {self.cfg.url_prefix}: circuit open")
-        args = {"pod": _pod_to_dict(pod), "nodenames": node_names}
+        body = self._args_body(pod, node_names, names_json, node_manifests)
         try:
-            result = self._send(self.cfg.prioritize_verb, args,
+            result = self._send(self.cfg.prioritize_verb, body,
                                 idempotent=True)
         except Exception as e:
             self._circuit_result(False)
@@ -561,6 +601,40 @@ def _pod_to_dict(pod: v1.Pod) -> dict:
     return d
 
 
+def _node_to_dict(node) -> dict:
+    """Minimal node manifest for the non-nodeCacheCapable ExtenderArgs
+    form (extender.go:416 ships the full node list when the extender
+    can't resolve names against its own cache)."""
+    return {
+        "metadata": {
+            "name": node.metadata.name,
+            "labels": dict(node.metadata.labels),
+        },
+        "status": {
+            "allocatable": dict(node.status.allocatable or {}),
+            "capacity": dict(node.status.capacity or {}),
+        },
+    }
+
+
+def _pod_to_json(pod: v1.Pod) -> bytes:
+    """json.dumps(_pod_to_dict(pod)) cached per (resourceVersion, nodeName)
+    — one round calls filter AND prioritize for the same pod, and a pod
+    deferred across rounds repeats both; at ~1KB of JSON per encode the
+    re-serialization was a measured slice of the single-core extender
+    suite's wall."""
+    key = (pod.metadata.resource_version, pod.spec.node_name)
+    cached = getattr(pod, "_extender_json", None)
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    data = json.dumps(_pod_to_dict(pod)).encode()
+    try:
+        pod._extender_json = (key, data)
+    except (AttributeError, TypeError):
+        pass
+    return data
+
+
 def _pod_to_dict_uncached(pod: v1.Pod) -> dict:
     return {
         "metadata": {
@@ -601,6 +675,11 @@ class TPUScoreExtenderServer:
         import socketserver
 
         self.score_fn = score_fn
+        # name → its JSON encoding (quoted/escaped), cached across requests:
+        # the same few hundred node names ride every callout, and re-encoding
+        # them per response was a measured slice of the single-core extender
+        # suite (the server shares the machine with the scheduler there)
+        self._name_json: Dict[str, str] = {}
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -655,20 +734,43 @@ class TPUScoreExtenderServer:
         args = json.loads(data or b"{}")
         pod = args.get("pod") or {}
         names = list(args.get("nodenames") or [])
+        if not names:
+            # non-nodeCacheCapable callers ship full manifests
+            # (ExtenderArgs.Nodes) — serve them off the metadata names
+            names = [
+                ((item.get("metadata") or {}).get("name"))
+                for item in ((args.get("nodes") or {}).get("items") or [])
+            ]
+            names = [n for n in names if n]
         try:
             feasible, scores = self.score_fn(pod, names)
         # ktpu-analysis: ignore[exception-hygiene] -- surfaced via the extender protocol's error field (extenderv1 FilterResult.Error); the scheduler side decides whether that is ignorable
         except Exception as e:  # extender protocol error field
             return json.dumps({"error": str(e)}).encode()
+        jname = self._name_json
+        if len(jname) > 65536:
+            # bound the per-name memo: a server outliving heavy node churn
+            # (autoscaling creates uniquely-named nodes forever) must not
+            # leak an entry per retired name
+            jname.clear()
+
+        def enc(n: str) -> str:
+            v = jname.get(n)
+            if v is None:
+                v = jname[n] = json.dumps(n)
+            return v
+
         if path.rstrip("/").endswith("filter"):
             feas = set(feasible)  # a list membership scan was O(N²)/request
             failed = {n: "TPUScore: infeasible" for n in names
                       if n not in feas}
-            return json.dumps(
-                {"nodenames": list(feasible), "failedNodes": failed}).encode()
-        return json.dumps(
-            [{"host": n, "score": int(scores.get(n, 0))} for n in names]
-        ).encode()
+            return ('{"nodenames":[' + ",".join(enc(n) for n in feasible)
+                    + '],"failedNodes":' + json.dumps(failed)
+                    + "}").encode()
+        return ("[" + ",".join(
+            '{"host":%s,"score":%d}' % (enc(n), int(scores.get(n, 0)))
+            for n in names
+        ) + "]").encode()
 
     @property
     def url(self) -> str:

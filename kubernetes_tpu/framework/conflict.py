@@ -48,12 +48,21 @@ class ConflictInfo:
             pods need any serialization in the engine.
     sizes — multi-component sizes (for the coupled_component_size histogram).
     exact — False when the class-cap fallback merged all coupled pods.
+    single_class_reps — component root → representative pod, for multi
+            components made of exactly ONE identity class with no gang
+            membership.  TPUScheduler's parallel-safe relaxation inspects
+            these reps against the live topology (engine_choice): a class
+            whose only intra-class effects are used-node-mask-equivalent
+            (required anti over singleton domains) or plane-uniform
+            (affinity over a single live domain) commits in parallel
+            auction rounds like plain pods.
     """
 
     comp: np.ndarray
     multi: np.ndarray
     sizes: List[int]
     exact: bool = True
+    single_class_reps: Optional[dict] = None
 
     @property
     def max_multi(self) -> int:
@@ -197,6 +206,7 @@ def conflict_components(pods, size: int, namespace_labels=None,
         root = uf.find(c)
         groups.setdefault(root, []).append(c)
     sizes: List[int] = []
+    single_class_reps: dict = {}
     for root, classes in groups.items():
         idxs = [i for c in classes for i in members[c]]
         linked = len(classes) > 1 or any(self_edge[c] for c in classes)
@@ -206,4 +216,7 @@ def conflict_components(pods, size: int, namespace_labels=None,
                 comp[i] = rep
                 multi[i] = True
             sizes.append(len(idxs))
-    return ConflictInfo(comp=comp, multi=multi, sizes=sizes)
+            if len(classes) == 1 and gang_of(reps[classes[0]]) is None:
+                single_class_reps[rep] = reps[classes[0]]
+    return ConflictInfo(comp=comp, multi=multi, sizes=sizes,
+                        single_class_reps=single_class_reps)

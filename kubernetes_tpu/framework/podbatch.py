@@ -603,7 +603,14 @@ def identity_classes(batch: PodBatch):
     (measured C=2 at B=256 on the basic suites: one pod template plus the
     padding rows), which turns the ``[B, N]`` dense planes — 18s/batch at
     131k nodes on the 1-core CI host — into a ``[C, N]`` compute (0.26s).
+
+    The result is memoized on the batch object: the router precheck
+    (TPUScheduler.engine_choice), the dedup gate, and the extender callout
+    dedup all consult it for the same compiled batch.
     """
+    cached = getattr(batch, "_identity_classes_cache", None)
+    if cached is not None:
+        return cached
     b = batch.size
 
     def flat(a):
@@ -640,7 +647,12 @@ def identity_classes(batch: PodBatch):
             c = seen[key] = len(rep_rows)
             rep_rows.append(i)
         class_of[i] = c
-    return class_of, np.asarray(rep_rows, dtype=np.int32)
+    out = (class_of, np.asarray(rep_rows, dtype=np.int32))
+    try:
+        batch._identity_classes_cache = out
+    except (AttributeError, TypeError):
+        pass  # frozen stand-ins just recompute
+    return out
 
 
 def _pod_host_ports(pod: v1.Pod):

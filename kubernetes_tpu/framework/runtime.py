@@ -744,22 +744,31 @@ class BatchedFramework:
         list instead of a full ``[B, N]`` argmax.
 
         Bit-for-bit exactness vs the full path:
-          * plane rows: pure functions of (pod row content, snap, dyn) —
-            equal inputs, equal rows (the caller's gate excludes pod-indexed
-            auxes and cross-pod reads, so no other state feeds them);
+          * plane rows: pure functions of (pod row content, snap, dyn, the
+            carried aux state) — equal inputs, equal rows (the caller's
+            gate excludes pod-indexed auxes, so no other state feeds them);
           * top-B candidate truncation: within one round at most B-1 OTHER
             pods commit (one node each), so a pod's best unused feasible
             node is always inside its class's top-B list — every node
             ranked above the list's best unused entry is used, and
             ``lax.top_k`` orders ties by ascending node row exactly like
-            the full path's first-max argmax;
-          * dynamic-plugin aux state: the gate admits only update-free
-            dynamic auxes (checked at trace time below), so round planes
-            depend on ``dyn`` alone and the carried aux state of the full
-            path is vacuous.
+            the full path's first-max argmax.  Affinity-carrying classes
+            don't widen the bound: a rival's count/block/score effects land
+            in the NEXT round's recomputed planes (apply-then-recompute,
+            same as the full path), so within a round the only staleness is
+            still the used-node set — one node per rival commit;
+          * dynamic-plugin aux state: the full path's per-pod aux rows stay
+            CLASS-UNIFORM under commits (every cross tensor is a pure
+            function of the pending pod's class), so carrying the rep rows
+            and updating them per round via the plugins'
+            ``update_batch_classes`` hooks reproduces the full path's rows
+            exactly.  A dynamic plugin with update hooks but no class hook
+            fails loudly at trace time — the caller's gate should have
+            routed that batch to the full path.
 
         Pinned by tests/test_batch_assign.py::test_dedup_* (deduped ==
-        full-path bindings under contention, failure rows, nominated rows).
+        full-path bindings under contention, failure rows, nominated rows,
+        and the randomized affinity-churn battery).
         """
         class_of, rep_batch, rep_auxes = classes
         b = batch.valid.shape[0]
@@ -770,12 +779,13 @@ class BatchedFramework:
         for pw, aux in zip(self.plugins, rep_auxes):
             if pw.plugin.dynamic and aux is not None and (
                     getattr(pw.plugin, "update", None) is not None
-                    or getattr(pw.plugin, "update_batch", None) is not None):
+                    or getattr(pw.plugin, "update_batch", None) is not None
+            ) and getattr(pw.plugin, "update_batch_classes", None) is None:
                 raise ValueError(
                     "identity-class dedup requires update-free dynamic "
-                    f"auxes; {pw.plugin.name} carries one — the caller's "
-                    "dedup gate should have routed this batch to the full "
-                    "path")
+                    f"auxes or an update_batch_classes hook; "
+                    f"{pw.plugin.name} has neither — the caller's dedup "
+                    "gate should have routed this batch to the full path")
         reads = jnp.asarray(coupling.reads)
         solo = jnp.asarray(coupling.solo)
         if coupling.comp is None:
@@ -802,17 +812,41 @@ class BatchedFramework:
             (pw, idx) for idx, pw in enumerate(self.plugins) if pw.plugin.dynamic
         ]
         dyn_rep_auxes = tuple(rep_auxes[idx] for _, idx in dyn_plugins)
+        # affinity/spread-carrying classes: the rep aux rows must track the
+        # round's commits exactly like the full path's pod rows (which stay
+        # class-uniform — see the docstring).  update_batch_classes consumes
+        # the CLASS-level placement one-hot u_c [Cp, N] (commits aggregated
+        # by committer class), so a round's whole update is O(C·N), not
+        # O(B·N) — the dedup win extends to the update half.
+        needs_updates = any(
+            aux is not None
+            and getattr(pw.plugin, "update_batch_classes", None) is not None
+            for (pw, _), aux in zip(dyn_plugins, dyn_rep_auxes))
+        n_classes = rep_batch.valid.shape[0]
 
-        def dense_rep(dyn):
+        def apply_aux_updates(dauxes, commit, choice):
+            u_c = jnp.zeros((n_classes, n_cap), jnp.float32).at[
+                class_of, jnp.clip(choice, 0, n_cap - 1)
+            ].add(commit.astype(jnp.float32))
+            out = []
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
+                fn = getattr(pw.plugin, "update_batch_classes", None)
+                if fn is None or aux is None:
+                    out.append(aux)
+                else:
+                    out.append(fn(aux, u_c, batch, rep_batch, snap, class_of))
+            return tuple(out)
+
+        def dense_rep(dyn, dauxes):
             mask = static_mask
-            for (pw, _), aux in zip(dyn_plugins, dyn_rep_auxes):
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
                 if hasattr(pw.plugin, "filter"):
                     mask = mask & pw.plugin.filter(rep_batch, snap, dyn, aux)
             total = jnp.zeros(mask.shape, jnp.float32)
             for pw, plane in static_raw:
                 total = total + pw.weight * jnp.floor(
                     pw.plugin.normalize(plane, mask))
-            for (pw, _), aux in zip(dyn_plugins, dyn_rep_auxes):
+            for (pw, _), aux in zip(dyn_plugins, dauxes):
                 if not hasattr(pw.plugin, "score"):
                     continue
                 raw = pw.plugin.score(rep_batch, snap, dyn, aux, mask=mask)
@@ -893,17 +927,19 @@ class BatchedFramework:
             return DynamicState(requested=req, non_zero=nz)
 
         def cond(state):
-            _, _, active, _, _, rounds = state
+            _, _, _, active, _, _, rounds = state
             return jnp.any(active) & (rounds <= b)
 
         def body(state):
-            dyn, assigned, active, unsched, feas_n, rounds = state
-            mask_r, scores_r = dense_rep(dyn)
+            dyn, dauxes, assigned, active, unsched, feas_n, rounds = state
+            mask_r, scores_r = dense_rep(dyn, dauxes)
             feasible = jnp.any(mask_r, axis=1)[class_of]
             commit, choice, new_unsched = auction_commits(
                 active, feasible, mask_r, scores_r
             )
             dyn = apply_dyn(dyn, commit, choice)
+            if needs_updates:  # trace-time flag: plain batches skip entirely
+                dauxes = apply_aux_updates(dauxes, commit, choice)
             resolved = commit | new_unsched
             feas_n = jnp.where(
                 resolved & active,
@@ -912,17 +948,18 @@ class BatchedFramework:
             assigned = jnp.where(commit, choice, assigned)
             active = active & ~resolved
             unsched = unsched | new_unsched
-            return dyn, assigned, active, unsched, feas_n, rounds + 1
+            return dyn, dauxes, assigned, active, unsched, feas_n, rounds + 1
 
         init = (
             dyn,
+            dyn_rep_auxes,
             jnp.full(b, -1, jnp.int32),
             batch.valid,
             jnp.zeros(b, bool),
             jnp.zeros(b, jnp.int32),
             jnp.asarray(0, jnp.int32),
         )
-        dyn, assigned, _, _, feas_n, rounds = jax.lax.while_loop(
+        dyn, _, assigned, _, _, feas_n, rounds = jax.lax.while_loop(
             cond, body, init)
         return AssignResult(node_row=assigned, feasible_count=feas_n, dyn=dyn,
                             rounds=rounds)

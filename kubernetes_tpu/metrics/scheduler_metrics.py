@@ -104,6 +104,27 @@ coupled_component_size = default_registry.register(
               [1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
               "Sizes of multi-pod pod-interaction components per batch")
 )
+identity_class_count = default_registry.register(
+    # observed per dedup-ADMITTED dispatch (TPUScheduler._dedup_classes):
+    # how many exact-content pod classes the batch collapsed to — the [C, N]
+    # plane width the fused program actually computed.  Templated suites
+    # sit at 1-2; a drift upward says the dedup win is eroding.
+    Histogram("scheduler_identity_class_count",
+              [1, 2, 4, 8, 16, 32, 64, 128, 256],
+              "Identity classes per dedup-admitted batch")
+)
+dedup_fallback = default_registry.register(
+    # labels: (reason,) — why a batch took the FULL [B, N] path instead of
+    # identity-class dedup: "rng_key" (tie-noise instance), "class_hook"
+    # (a dynamic plugin carries updates but no update_batch_classes),
+    # "pod_indexed_aux" (host aux without a rep-view hook), "gang_anchor"
+    # (a batch pod anchors a gang), "preemption" (affinity batch with a
+    # preemption-capable pod — the dedup variant materializes no pod-level
+    # auxes for the candidate program), "heterogeneous" (C > B/2: rep
+    # planes would be as wide as the full path's)
+    Counter("scheduler_dedup_fallback_total",
+            "Batches routed to the full dense path, by dedup-gate reason")
+)
 
 scheduler_retries = default_registry.register(
     # labels: (reason,) — "cycle_error" (whole-batch dispatch failure

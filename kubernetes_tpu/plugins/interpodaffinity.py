@@ -164,19 +164,34 @@ class InterPodAffinityPlugin(Plugin):
         return m & ns_ok & jnp.asarray(group.valid)[:, :, None]
 
     def _counts(self, match, dom, pod_node, pod_valid, d):
-        """Per-term matches of scheduled pods → domain tables, as two
-        contractions: matches×(pod→node one-hot) gives per-node counts, then
-        a domain scatter-add folds nodes into domains (both MXU-friendly —
-        the per-(pod,term) gather this replaces serializes on TPU)."""
+        """Per-term matches of scheduled pods → domain tables.
+
+        TPU: two contractions — matches×(pod→node one-hot) gives per-node
+        counts, then a domain scatter-add folds nodes into domains (both
+        MXU-friendly — the per-(pod,term) gather this replaces serializes
+        on TPU).  CPU: the [P, N] one-hot materializes 33MB PER PREPARE at
+        a 4k-pod/2k-node tier (measured as the affinity suites' dominant
+        per-cycle device cost on the 1-core container) — a native
+        last-axis ``.at[].add`` scatter is O(B·T·P) instead."""
+        import jax
+
         b, t, _p = match.shape
         n = dom.shape[-1]
         prow = jnp.clip(pod_node, 0, n - 1)
         ok = match & pod_valid[None, None, :] & (pod_node >= 0)[None, None, :]
-        onehot = (
-            (prow[:, None] == jnp.arange(n)[None, :]) & (pod_node >= 0)[:, None]
-        ).astype(jnp.float32)  # [P, N]
-        count_node = jnp.einsum("btp,pn->btn", ok.astype(jnp.float32), onehot)
-        tbl = domain_scatter_add(count_node, dom, d + 1)  # trash slot at D absorbs
+        if jax.default_backend() == "cpu":
+            count_node = jnp.zeros((b, t, n), jnp.float32).at[..., prow].add(
+                ok.astype(jnp.float32))
+        else:
+            onehot = (
+                (prow[:, None] == jnp.arange(n)[None, :])
+                & (pod_node >= 0)[:, None]
+            ).astype(jnp.float32)  # [P, N]
+            count_node = jnp.einsum(
+                "btp,pn->btn", ok.astype(jnp.float32), onehot)
+        from ..ops.segment import domain_scatter_add_backend
+
+        tbl = domain_scatter_add_backend(count_node, dom, d + 1)  # trash at D
         return tbl.astype(jnp.int32)
 
     def prepare(self, batch, snap, dyn, host_aux=None):
@@ -646,6 +661,106 @@ class InterPodAffinityPlugin(Plugin):
             aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
             paff_cnt=paff_cnt, panti_cnt=panti_cnt,
             block_dyn=block_dyn, score_dyn=score_dyn,
+        )
+
+    def host_aux_take(self, aux, rows):
+        """Identity-class rep view of the host aux: the [G, B] batch-match
+        matrix's columns are pure functions of (namespace, labels) — class
+        content — so gathering the rep columns is exact.  ``rows`` may be a
+        traced i32 vector (the dedup path gathers inside the fused
+        program)."""
+        if aux is None:
+            return None
+        return {"match": jnp.asarray(aux["match"])[:, rows]}
+
+    def update_batch_classes(self, aux: IPAAux, u_c, batch, rep_batch, snap,
+                             class_of):
+        """update_batch at identity-class granularity (the dedup engine's
+        round update, runtime.py _batch_assign_dedup): the pending axis is
+        the C class reps, and the round's commits arrive pre-aggregated as
+        the CLASS placement counts ``u_c`` f32[Cp, N] (committer class →
+        node).  Every cross tensor is a pure function of the two pods'
+        classes, so folding committers per class is exact — and the whole
+        round update is O(C·T·N) instead of the full path's O(B·T·N)."""
+        if aux is None:
+            return None
+        d = self._d(rep_batch)
+        use_planes = self._use_planes(rep_batch, snap)
+        # backend-aware domain ops: these run once per AUCTION ROUND, and at
+        # hostname topology (D ≈ N) the one-hot einsum forms are O(N²)
+        # memory traffic per round on the CPU backend — measured as the
+        # whole preferred-affinity window (19s of 20s) before the switch
+        from ..ops.segment import domain_gather_backend as _dgather
+        from ..ops.segment import domain_scatter_add_backend as _dscatter
+
+        def count_inc(cross_kk, dom):
+            # cross_kk [C, T, C]: term (c, t) vs a committer CLASS k; the
+            # class form of update_batch's "bti,in->btn" contraction
+            contrib = jnp.einsum(
+                "ctk,kn->ctn", cross_kk.astype(jnp.float32), u_c)
+            tbl = _dscatter(contrib, dom, d + 1)
+            tbl = tbl * (jnp.arange(d + 1) < d)
+            inc = _dgather(tbl, dom) if use_planes else tbl
+            return inc, jnp.sum(tbl, axis=(1, 2))
+
+        aff_cnt, aff_total = aux.aff_cnt, aux.aff_total
+        if self._present(rep_batch, "req_affinity"):
+            gv = jnp.asarray(rep_batch.req_affinity.valid)
+            aff_cross = aux.aff_cross_all[:, None, :] & gv[:, :, None]
+            inc, mass = count_inc(aff_cross, aux.dom_aff)
+            aff_cnt = aux.aff_cnt + inc.astype(jnp.int32)
+            aff_total = aux.aff_total + mass.astype(jnp.int32)
+        anti_cnt = aux.anti_cnt
+        if self._present(rep_batch, "req_anti_affinity"):
+            anti_cnt = aux.anti_cnt + count_inc(
+                aux.anti_cross, aux.dom_anti)[0].astype(jnp.int32)
+        paff_cnt = aux.paff_cnt
+        if self._present(rep_batch, "pref_affinity"):
+            paff_cnt = aux.paff_cnt + count_inc(
+                aux.paff_cross, aux.dom_paff)[0].astype(jnp.int32)
+        panti_cnt = aux.panti_cnt
+        if self._present(rep_batch, "pref_anti_affinity"):
+            panti_cnt = aux.panti_cnt + count_inc(
+                aux.panti_cross, aux.dom_panti)[0].astype(jnp.int32)
+
+        def same_mass(dom):
+            # committed classes' same-domain commit mass per node: scatter
+            # u_c into each term's domain space, zero the trash column
+            # (absent-key nodes and absent-key commits contribute nothing —
+            # update_batch's (dom < d) gates), gather back per node.  The
+            # class form of same_domains, f32 multiplicity instead of bool.
+            w = _dscatter(
+                jnp.broadcast_to(u_c[:, None, :], dom.shape), dom, d + 1)
+            w = w * (jnp.arange(d + 1) < d)
+            return _dgather(w, dom)  # f32[C, T, N]
+
+        block_dyn = aux.block_dyn
+        if self._present(rep_batch, "req_anti_affinity"):
+            block_add = jnp.einsum(
+                "ktj,ktn->jn", aux.anti_cross.astype(jnp.float32),
+                same_mass(aux.dom_anti)) > 0.5
+            block_dyn = aux.block_dyn | block_add
+
+        def plane(cross, dom, w):
+            return jnp.einsum(
+                "ktj,ktn->jn", cross.astype(jnp.float32) * w, same_mass(dom))
+
+        score_dyn = aux.score_dyn
+        if self._present(rep_batch, "req_affinity"):
+            w1 = jnp.full(aux.dom_aff.shape[:2], self.hard_weight,
+                          jnp.float32)[:, :, None]
+            score_dyn = score_dyn + plane(aux.aff_term_cross, aux.dom_aff, w1)
+        if self._present(rep_batch, "pref_affinity"):
+            w3 = jnp.asarray(rep_batch.pref_affinity.weight)[:, :, None]
+            score_dyn = score_dyn + plane(aux.paff_cross, aux.dom_paff, w3)
+        if self._present(rep_batch, "pref_anti_affinity"):
+            w4 = jnp.asarray(rep_batch.pref_anti_affinity.weight)[:, :, None]
+            score_dyn = score_dyn - plane(aux.panti_cross, aux.dom_panti, w4)
+
+        return aux._replace(
+            aff_cnt=aff_cnt, aff_total=aff_total, anti_cnt=anti_cnt,
+            block_dyn=block_dyn, paff_cnt=paff_cnt, panti_cnt=panti_cnt,
+            score_dyn=score_dyn,
         )
 
     def update_batch(self, aux: IPAAux, commit, choice, u, batch, snap):

@@ -338,6 +338,31 @@ class PodTopologySpreadPlugin(Plugin):
             soft_counts=aux.soft_counts + inc_s.astype(jnp.int32),
         )
 
+    def update_batch_classes(self, aux: TSAux, u_c, batch, rep_batch, snap,
+                             class_of):
+        """update_batch at identity-class granularity (the dedup engine's
+        round update): ``aux`` is the rep view ([C, ...] pending axis) and
+        ``u_c`` f32[Cp, N] holds the round's commits aggregated per
+        COMMITTER class.  match_pending is a pure function of the two pods'
+        classes, so the class fold is exact — O(C·Cc·N) per round."""
+        if aux is None:
+            return None
+        from ..ops.segment import domain_scatter_add_backend as _dscatter
+
+        d = aux.hard_counts.shape[-1] - 1
+        contrib = jnp.einsum(
+            "bck,kn->bcn", aux.match_pending.astype(jnp.float32), u_c)
+        # backend-aware scatter: runs once per auction ROUND, where the
+        # one-hot einsum form is O(N·D) memory traffic per call on CPU
+        hard_inc = _dscatter(
+            contrib * aux.counted_hard[:, None, :], aux.dom_val, d + 1)
+        soft_inc = _dscatter(
+            contrib * aux.counted_soft[:, None, :], aux.dom_val, d + 1)
+        return aux._replace(
+            hard_counts=aux.hard_counts + hard_inc.astype(jnp.int32),
+            soft_counts=aux.soft_counts + soft_inc.astype(jnp.int32),
+        )
+
     def update_batch(self, aux: TSAux, commit, choice, u, batch, snap):
         """All of a round's placements at once (batch_assign):
         contributions are commutative scatter-adds, so the per-pod update

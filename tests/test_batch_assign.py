@@ -424,6 +424,153 @@ def test_dedup_matches_full_path_under_contention():
                           np.asarray(dedup.dyn.requested))
 
 
+# --- affinity-aware dedup (round 12): [C, N] planes + class-level round
+# updates for (anti)affinity-carrying batches, bit-exact vs the full path --
+
+
+def _affinity_pod(p, kind):
+    if kind == "anti":
+        return p.pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                              anti=True)
+    if kind == "required":
+        return p.pod_affinity("zone", {"color": "green"})
+    return p.pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                          weight=2)
+
+
+def _run_dedup_affinity(fw, batch, snap_host, enc, dsnap, dyn, auxes):
+    """Dedup path with the IPA host aux gathered through host_aux_take —
+    the scheduler's fused wiring for affinity-carrying batches."""
+    from kubernetes_tpu.framework.podbatch import identity_classes
+    from kubernetes_tpu.scheduler import _host_aux_take
+
+    host_auxes = fw.host_prepare(batch, snap_host, enc)
+    class_of, reps = identity_classes(batch)
+
+    def run(batch, dsnap, dyn, auxes, order, coupling, class_of, reps):
+        rb = batch.take(reps)
+        rh = _host_aux_take(fw, host_auxes, reps)
+        ra = fw.prepare(rb, dsnap, dyn, rh)
+        return fw.batch_assign(batch, dsnap, dyn, auxes, order, coupling,
+                               classes=(class_of, rb, ra))
+
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    return jax.jit(run)(batch, dsnap, dyn, auxes, order, coupling,
+                        class_of, reps), len(reps)
+
+
+@pytest.mark.parametrize("kind", ["anti", "required", "preferred"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_dedup_matches_full_affinity_churn(kind, seed):
+    """Randomized-churn parity battery (round-12 tentpole): affinity-
+    carrying batches under contention — with EXISTING scheduled affinity
+    pods feeding the incremental index (a live IPA host aux) and a
+    nominated row — must bind bit-for-bit equal through the dedup path
+    (class-rep planes + update_batch_classes round updates) and the full
+    [B, N] path, same coupling."""
+    from kubernetes_tpu.framework.podbatch import PodBatchCompiler
+    from kubernetes_tpu.state.encoding import ClusterEncoder
+
+    rng = np.random.default_rng(40 + seed)
+    cache = Cache()
+    n_nodes = 12
+    zones = 1 if kind == "required" else 3
+    for i in range(n_nodes):
+        cache.add_node(
+            make_node().name(f"n{i:02d}")
+            .capacity({"cpu": "4", "memory": "16Gi", "pods": "110"})
+            .label("kubernetes.io/hostname", f"n{i:02d}")
+            .label("zone", f"z{i % zones}")
+            .obj()
+        )
+    # churn: pre-scheduled affinity pods populate the incremental affinity
+    # index, so host_prepare returns a LIVE match aux for the batch
+    for i in range(int(rng.integers(1, 5))):
+        p = _affinity_pod(
+            make_pod().name(f"ex{i}").uid(f"ex{i}").namespace("default")
+            .req({"cpu": "100m"}).label("color", "green"), kind).obj()
+        p.spec.node_name = f"n{int(rng.integers(0, n_nodes)):02d}"
+        cache.add_pod(p)
+    k = int(rng.integers(6, 14))  # contention against 12 nodes
+    pods = [
+        _affinity_pod(
+            make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+            .req({"cpu": "500m", "memory": "1Gi"}).label("color", "green"),
+            kind).obj()
+        for i in range(k)
+    ]
+    pods[0].status.nominated_node_name = "n03"
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    enc = ClusterEncoder()
+    enc.full_sync(snap)
+    from tests.test_parity import default_framework
+
+    batch = PodBatchCompiler(enc).compile(pods)
+    fw = default_framework(enc)
+    host_auxes = fw.host_prepare(batch, snap, enc)
+    dsnap = enc.to_device()
+    dyn = initial_dynamic_state(dsnap)
+    auxes = fw.prepare(batch, dsnap, dyn, host_auxes)
+    order = jnp.arange(batch.size)
+    coupling = coupling_flags(batch)
+    full = jax.jit(fw.batch_assign)(batch, dsnap, dyn, auxes, order, coupling)
+    dedup, n_classes = _run_dedup_affinity(
+        fw, batch, snap, enc, dsnap, dyn, auxes)
+    assert n_classes <= 3  # one template + padding (+ the nominated twin)
+    assert np.array_equal(np.asarray(full.node_row),
+                          np.asarray(dedup.node_row))
+    assert np.array_equal(np.asarray(full.feasible_count),
+                          np.asarray(dedup.feasible_count))
+    assert np.array_equal(np.asarray(full.dyn.requested),
+                          np.asarray(dedup.dyn.requested))
+
+
+@pytest.mark.parametrize("kind", ["anti", "required", "preferred"])
+def test_scheduler_affinity_dedup_matches_scan(kind):
+    """Scheduler-level parity: assign_mode="auto" (parallel-safe relaxation
+    + affinity dedup) must bind the same pods as the exact serial scan, the
+    dedup path must actually engage (identity_class_count observed), and
+    anti placements stay one-per-hostname."""
+    from kubernetes_tpu.metrics import scheduler_metrics as m
+    from kubernetes_tpu.scheduler import TPUScheduler
+    from kubernetes_tpu.sim.store import ObjectStore
+
+    def build(assign_mode):
+        store = ObjectStore()
+        s = TPUScheduler(store, batch_size=8, assign_mode=assign_mode)
+        s.presize(32, 64)
+        for i in range(24):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .label("kubernetes.io/hostname", f"n{i:03d}")
+                .label("zone", "z0")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"})
+                .obj())
+        for i in range(20):
+            store.create("Pod", _affinity_pod(
+                make_pod().name(f"a{i:03d}").uid(f"a{i:03d}")
+                .namespace("default").req({"cpu": "200m"})
+                .label("color", "green"),
+                "required" if kind == "required" else kind).obj())
+        s.run_until_idle()
+        s.close()
+        pods, _ = store.list("Pod")
+        return {p.metadata.name: p.spec.node_name for p in pods}
+
+    n0 = m.identity_class_count.count()
+    auto = build("auto")
+    assert m.identity_class_count.count() > n0, "dedup path never engaged"
+    scan = build("scan")
+    assert auto == scan
+    assert all(v for v in auto.values())
+    if kind == "anti":
+        rows = list(auto.values())
+        assert len(set(rows)) == len(rows)  # one green pod per hostname
+
+
 def test_dedup_matches_full_path_failures_and_nominated():
     """Unschedulable rows (-1) and the nominated-node fast path must agree
     with the full path too — not just the happy placements."""

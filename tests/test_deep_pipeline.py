@@ -203,6 +203,133 @@ def test_deep_pipeline_affinity_batches_match_sync(kind):
         assert all(v for v in deep.values())
 
 
+def test_affinity_batches_deep_chain_on_cpu_when_deduping():
+    """chain_affinity left at "auto" (OFF on the CPU backend tests run
+    under): the round-12 steady-state heuristic (_chain_affinity_now)
+    still deep-chains affinity batches once the workload is deduping —
+    the chain work then rides the [C]-wide rep tables — and bindings must
+    equal the synchronous path exactly."""
+
+    def build(pipeline):
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=8, pipeline=pipeline,
+                             pipeline_depth=3)
+        sched.presize(32, 96)
+        for i in range(24):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .label("kubernetes.io/hostname", f"n{i:03d}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj(),
+            )
+        for i in range(20):
+            store.create(
+                "Pod",
+                make_pod().name(f"a{i:03d}").uid(f"a{i:03d}")
+                .namespace("default").req({"cpu": "200m"})
+                .label("color", "green")
+                .pod_affinity("kubernetes.io/hostname", {"color": "green"},
+                              anti=True).obj())
+        deep = 0
+        orig = TPUScheduler._dispatch_batch
+
+        def counting(self, infos, prevs=None, **kw):
+            nonlocal deep
+            if prevs:
+                deep += 1
+            return orig(self, infos, prevs=prevs, **kw)
+
+        TPUScheduler._dispatch_batch = counting
+        try:
+            sched.run_until_idle()
+        finally:
+            TPUScheduler._dispatch_batch = orig
+        sched.close()
+        return _bindings(store), deep
+
+    deep, deep_count = build(True)
+    sync, _ = build(False)
+    assert deep_count > 0, \
+        "deduping affinity batches never deep-chained on the CPU backend"
+    assert deep == sync
+
+
+def test_async_extender_rounds_match_sync():
+    """Round-12 tentpole (c): with the whole extender round walk running on
+    a background thread (async_extenders, pipeline mode), bindings must
+    equal the fully synchronous scheduler's — including MULTI-round batches
+    (more pods than nodes per round forces deferrals through the
+    one-commit-per-node rule) and the extender's filter verdicts."""
+    from kubernetes_tpu.extender import (
+        ExtenderConfig,
+        HTTPExtender,
+        TPUScoreExtenderServer,
+        uniform_score_fn,
+    )
+
+    srv = TPUScoreExtenderServer(uniform_score_fn)
+    srv.start()
+    try:
+        def build(pipeline):
+            store = ObjectStore()
+            ext = HTTPExtender(ExtenderConfig(
+                url_prefix=srv.url, filter_verb="filter",
+                prioritize_verb="prioritize", weight=1,
+                node_cache_capable=True,
+            ))
+            sched = TPUScheduler(store, batch_size=16, pipeline=pipeline,
+                                 extenders=[ext])
+            sched.presize(16, 64)
+            _nodes(store, 8)
+            # 40 pods onto 8 nodes: ≥5 walk rounds per full batch (one
+            # commit per node per round)
+            _pods(store, 40)
+            sched.run_until_idle()
+            assert (pipeline and sched.async_extenders) or not pipeline
+            sched.close()
+            ext.close()
+            return _bindings(store)
+
+        async_bindings = build(pipeline=True)
+        sync_bindings = build(pipeline=False)
+        assert async_bindings == sync_bindings
+        assert all(v for v in sync_bindings.values())
+    finally:
+        srv.stop()
+
+
+def test_async_extender_walk_error_requeues_batch():
+    """An async walk that dies (extender transport collapse past the
+    breaker, with ignorable=False) must surface at _complete and route the
+    batch through the cycle failure handler — pods requeue, nothing is
+    assumed, the loop keeps running."""
+    from kubernetes_tpu.extender import ExtenderConfig, HTTPExtender
+
+    store = ObjectStore()
+    # nothing listens on this port: every callout fails, circuit opens,
+    # non-ignorable → ExtenderError out of the walk
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix="http://127.0.0.1:9", filter_verb="filter",
+        ignorable=False, http_timeout=0.2, failure_threshold=1,
+    ))
+    sched = TPUScheduler(store, batch_size=8, pipeline=True,
+                         extenders=[ext])
+    sched.presize(8, 16)
+    _nodes(store, 4)
+    _pods(store, 4)
+    s1 = sched.schedule_cycle()  # dispatch (walk spawned)
+    s2 = sched.schedule_cycle()  # complete: walk ran; pods resolve
+    # either the walk survived (per-pod ExtenderError → unschedulable) or
+    # died (batch requeued via the failure handler) — never a crashed loop
+    assert s1.attempted + s2.attempted >= 0  # loop survived both cycles
+    pods, _ = store.list("Pod")
+    assert all(not p.spec.node_name for p in pods)  # nothing half-bound
+    a, b, u = sched.queue.pending_count()
+    assert a + b + u + s2.unschedulable >= 1  # pods retriable, not lost
+    sched.close()
+    ext.close()
+
+
 def test_deep_pipeline_spread_batches_match_sync():
     """Topology-spread batches deep-chain via chain_prev; bindings must equal
     the synchronous path exactly (the chained count tables reproduce the

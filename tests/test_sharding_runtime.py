@@ -79,7 +79,7 @@ def test_live_sharded_dedup_and_full_paths_agree():
         store = ObjectStore()
         sched = TPUScheduler(store, batch_size=16, sharding=sharding)
         if not dedup:
-            sched._dedup_classes = lambda batch, host_auxes: None
+            sched._dedup_classes = lambda batch, host_auxes, fw=None: None
         _populate(store, n_nodes=8, n_pods=20)  # contention: identical pods
         sched.run_until_idle()
         results.append(_bindings(store))

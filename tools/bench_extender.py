@@ -1,0 +1,114 @@
+"""Microbench: the extender callout path — sync vs async round walk ×
+name-list (nodeCacheCapable) vs full-manifest payloads.  The round-12
+tentpole's extender claim in one table (the `extender_callout_bench`
+section of BENCH_r12_AB.json): moving the whole round walk off the device
+cycle (TPUScheduler async_extenders) and keeping payloads on the
+nodeCacheCapable name-list fast path (`pkg/scheduler/extender.go:277,416`)
+are each worth a measured factor on the wire-bound suite shape.
+
+The extender runs in a SUBPROCESS, as a real extender would — the cost
+measured is the scheduler-side client + wire + a realistic peer, not a
+handler sharing the scheduler's GIL.
+
+    JAX_PLATFORMS=cpu python tools/bench_extender.py [pods]
+
+Prints one JSON object:
+    {"<sync|async>_<names|manifests>": {"pods_per_s": ..,
+     "extender_wait_s": .., "walk_ms_per_pod": ..}, ...}
+"""
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubernetes_tpu.extender import (
+    ExtenderConfig,
+    HTTPExtender,
+    run_subprocess_score_server,
+    uniform_score_fn,
+)
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+N_NODES = 200
+BATCH = 128
+
+
+def start_server():
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(
+        target=partial(run_subprocess_score_server, uniform_score_fn),
+        args=(child,), daemon=True)
+    proc.start()
+    if not parent.poll(60):
+        proc.terminate()
+        raise RuntimeError("extender subprocess failed to start")
+    return proc, parent.recv()
+
+
+def run_one(port: int, n_pods: int, async_walk: bool, capable: bool):
+    store = ObjectStore()
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix=f"http://127.0.0.1:{port}", filter_verb="filter",
+        prioritize_verb="prioritize", weight=1,
+        node_cache_capable=capable,
+    ))
+    sched = TPUScheduler(store, batch_size=BATCH, pipeline=True,
+                         extenders=[ext], async_extenders=async_walk)
+    sched.presize(N_NODES, n_pods + 8)
+    for i in range(N_NODES):
+        store.create("Node", make_node().name(f"node-{i:05d}")
+                     .capacity({"cpu": "32", "memory": "64Gi", "pods": "110"})
+                     .obj())
+    # warm: compile the fused extender programs outside the window
+    for i in range(4):
+        store.create("Pod", make_pod().name(f"warm-{i}").uid(f"warm-{i}")
+                     .namespace("default").req({"cpu": "1m"}).obj())
+    sched.run_until_idle()
+    for i in range(n_pods):
+        store.create("Pod", make_pod().name(f"p-{i:05d}").uid(f"p-{i:05d}")
+                     .namespace("default")
+                     .req({"cpu": "100m", "memory": "100Mi"}).obj())
+    wait0 = sched.phase_wall["extender_wait"]
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    wait = sched.phase_wall["extender_wait"] - wait0
+    pods, _ = store.list("Pod")
+    bound = sum(1 for p in pods if p.spec.node_name
+                and p.metadata.name.startswith("p-"))
+    sched.close()
+    ext.close()
+    assert bound == n_pods, f"only {bound}/{n_pods} bound"
+    return {
+        "pods_per_s": round(n_pods / wall, 1),
+        "extender_wait_s": round(wait, 3),
+        "walk_ms_per_pod": round(1000.0 * wait / n_pods, 3),
+    }
+
+
+def main(n_pods: int = 256) -> dict:
+    proc, port = start_server()
+    out = {}
+    try:
+        for async_walk in (False, True):
+            for capable in (True, False):
+                key = (("async" if async_walk else "sync") + "_"
+                       + ("names" if capable else "manifests"))
+                out[key] = run_one(port, n_pods, async_walk, capable)
+    finally:
+        proc.terminate()
+        proc.join(timeout=5)
+    return out
+
+
+if __name__ == "__main__":
+    pods = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    print(json.dumps(main(pods)))

@@ -1,0 +1,89 @@
+"""Assemble BENCH_r12_AB.json from paired baseline/round-12 bench JSONL runs.
+
+Usage:
+    AB_SCALES='{"Suite/Size": 0.4, ...}' \
+    AB_EXT_BENCH=ext_bench.json \
+    python tools/build_r12_ab.py BASE_FILE:NEW_FILE [BASE2:NEW2 ...]
+
+Each file holds one bench.py JSON line per suite pass; rows are paired by
+workload name with the MEDIAN pass per arm and the full pass band kept
+(VERDICT r5 weak #5: commit the band, not the best window).  AB_EXT_BENCH
+optionally embeds a tools/bench_extender.py result as the
+``extender_callout_bench`` section.  The output drives the COMPONENTS.md
+round-12 A/B table via tools/render_perf_docs.py (generate, don't
+transcribe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from build_r6_ab import load_rows, median_pass, subset  # same pairing rules
+
+
+def main(argv):
+    import multiprocessing
+
+    scales = json.loads(os.environ.get("AB_SCALES", "{}"))
+    rows = []
+    for pair in argv[1:]:
+        base_p, new_p = pair.split(":")
+        base, new = load_rows(base_p), load_rows(new_p)
+        for suite in new:
+            if suite not in base:
+                continue
+            b = median_pass(base[suite])
+            n = median_pass(new[suite])
+            rows.append({
+                "suite": suite,
+                "scale": scales.get(suite, 1.0),
+                "baseline": subset(b),
+                "round12": subset(n),
+                "baseline_passes_pods_per_s": sorted(
+                    p["throughput_pods_per_s"] for p in base[suite]),
+                "round12_passes_pods_per_s": sorted(
+                    p["throughput_pods_per_s"] for p in new[suite]),
+                "speedup": round(
+                    n["throughput_pods_per_s"]
+                    / max(b["throughput_pods_per_s"], 1e-9), 3),
+            })
+    rows.sort(key=lambda r: r["suite"])
+    artifact = {
+        "environment": {
+            "backend": "cpu",
+            "cpus": multiprocessing.cpu_count(),
+            "note": (
+                "no TPU in this round's container; both arms (pre-round-12 "
+                "git worktree vs this build) ran at the scales below on the "
+                "SAME machine — the acceptance ratio is the same-hardware "
+                "1.5× CPU stand-in, per the round-6 precedent; the "
+                "≥1.0 vs_go_envelope_throughput clause applies on "
+                "TPU-class hardware only"),
+        },
+        "scale_note": (
+            "Affinity suites at scale 0.4 / batch 64 (multi-batch windows; "
+            "5k shapes OOM the CPU backend), SchedulingExtender at its "
+            "full 500-node size.  Both arms measured with identical env "
+            "(BENCH_SCALE/BENCH_BATCH/BENCH_ORACLE_*)."),
+        "rows": rows,
+    }
+    ext = os.environ.get("AB_EXT_BENCH")
+    if ext:
+        with open(ext) as f:
+            artifact["extender_callout_bench"] = json.load(f)
+        artifact["extender_callout_note"] = (
+            "tools/bench_extender.py: 256 pods through a subprocess "
+            "extender — async round walk × nodeCacheCapable name-list vs "
+            "full-manifest ExtenderArgs payloads (extender.go:277,416)")
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_r12_AB.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {out} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -224,6 +224,66 @@ def render_r6_ab(ab):
     return "\n".join(lines)
 
 
+R12_BEGIN = ("<!-- GENERATED:PERF:R12AB:BEGIN (tools/render_perf_docs.py — "
+             "edit BENCH_r12_AB.json, not this block) -->")
+R12_END = "<!-- GENERATED:PERF:R12AB:END -->"
+
+
+def render_r12_ab(ab):
+    """Round-12 same-hardware A/B table (BENCH_r12_AB.json): pre-round-12
+    worktree vs the coupled-pipeline build, both arms in THIS container."""
+    env = ab["environment"]
+    lines = [
+        R12_BEGIN,
+        "",
+        f"Environment: `{env['backend']}` backend, {env['cpus']} CPU core(s)"
+        f" — {env['note']}",
+        "",
+        ab["scale_note"],
+        "",
+        "| Suite (scale) | baseline pods/s (passes) | round 12 pods/s "
+        "(passes) | speedup | r12 p99 ms | r12 compiles | "
+        "extender_wait wall (s) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+
+    def band(vals):
+        return "/".join(f"{v:.0f}" for v in vals)
+
+    for r in ab["rows"]:
+        b, n = r["baseline"], r["round12"]
+        ew = n.get("phase_wall_s", {}).get("extender_wait", 0.0)
+        lines.append(
+            f"| {r['suite']} (×{r['scale']}) | "
+            f"{b['throughput_pods_per_s']:.1f} "
+            f"({band(r['baseline_passes_pods_per_s'])}) | "
+            f"{n['throughput_pods_per_s']:.1f} "
+            f"({band(r['round12_passes_pods_per_s'])}) | "
+            f"**{r['speedup']:.2f}×** | "
+            f"{n['attempt_ms']['p99']:.0f} | "
+            f"{int(n['xla_compiles_in_window']['count'])} | "
+            f"{ew:.3f} |"
+        )
+    ext = ab.get("extender_callout_bench")
+    if ext:
+        ks = list(ext)
+        lines += [
+            "",
+            "Extender callout microbench ("
+            + ab.get("extender_callout_note", "tools/bench_extender.py")
+            + "):",
+            "",
+            "| config | pods/s | extender_wait s | walk ms/pod |",
+            "|---|---|---|---|",
+        ] + [
+            f"| {k} | {ext[k]['pods_per_s']} | "
+            f"{ext[k]['extender_wait_s']} | {ext[k]['walk_ms_per_pod']} |"
+            for k in ks
+        ]
+    lines += ["", R12_END]
+    return "\n".join(lines)
+
+
 R9_BEGIN = ("<!-- GENERATED:PERF:R9100K:BEGIN (tools/render_perf_docs.py — "
             "edit BENCH_r09_100K.json, not this block) -->")
 R9_END = "<!-- GENERATED:PERF:R9100K:END -->"
@@ -314,6 +374,12 @@ def main() -> int:
         r9 = None  # pre-round-9 trees have no live-100k artifact
     if r9 is not None:
         ok &= splice("COMPONENTS.md", render_r9_100k(r9), R9_BEGIN, R9_END)
+    try:
+        r12 = load_bench("BENCH_r12_AB.json")
+    except (OSError, json.JSONDecodeError):
+        r12 = None  # pre-round-12 trees have no coupled-pipeline artifact
+    if r12 is not None:
+        ok &= splice("COMPONENTS.md", render_r12_ab(r12), R12_BEGIN, R12_END)
     return 0 if ok else 1
 
 

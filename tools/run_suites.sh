@@ -64,9 +64,15 @@ JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_recovery.py -q -m 'not
 # unsharded/full paths — perf rows from a diverging program would be
 # measuring a different scheduler, so fail fast before any suite runs
 JAX_PLATFORMS=cpu timeout 900 python -m pytest \
-  tests/test_sharding.py tests/test_sharding_runtime.py \
-  tests/test_batch_assign.py -q -m 'not slow' \
+  tests/test_sharding.py tests/test_sharding_runtime.py -q -m 'not slow' \
   || { echo "FAILED: sharding parity gate" >> suites_run.log; exit 1; }
+# affinity-dedup parity gate (round 12): the coupled suites below now run
+# the [C, N] dedup engine with class-level round updates and the
+# parallel-safe auction relaxation — their rows are meaningless unless
+# dedup == full path and chained/async == sync bindings hold bit-for-bit
+JAX_PLATFORMS=cpu timeout 1200 python -m pytest \
+  tests/test_batch_assign.py tests/test_deep_pipeline.py -q -m 'not slow' \
+  || { echo "FAILED: affinity-dedup parity gate" >> suites_run.log; exit 1; }
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -118,6 +124,9 @@ run GangBasic 5000Nodes
 run Defrag 5000Nodes
 run AutoscaleGang 5000Nodes
 run SchedulingExtender 500Nodes
+# the async-extender round walk (round 12) is only a win at zero in-window
+# compiles — same discipline as the affinity suites above
+gate_zero_compiles SchedulingExtender
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
 # the production-scale row (ROADMAP item 1): 100,352 nodes scheduled LIVE
