@@ -303,6 +303,10 @@ class Taint:
     key: str = ""
     value: str = ""
     effect: str = TAINT_NO_SCHEDULE
+    # v1.Taint.TimeAdded: set for NoExecute taints by the node lifecycle
+    # controller; tolerationSeconds countdowns anchor on it so a controller
+    # restart resumes the SAME deadline instead of granting a fresh window
+    time_added: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "Taint":
@@ -310,6 +314,7 @@ class Taint:
             key=d.get("key", ""),
             value=str(d.get("value", "")),
             effect=d.get("effect", TAINT_NO_SCHEDULE),
+            time_added=_parse_time(d.get("timeAdded")),
         )
 
 
@@ -1223,6 +1228,20 @@ class ServiceAccount:
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             secrets=[str(s) for s in d.get("secrets") or []],
         )
+
+
+def node_is_ready(node: Node) -> bool:
+    """Ready unless the Ready condition says "False"/"Unknown".
+
+    A node with NO Ready condition counts ready: hand-built test nodes and
+    freshly-registered kubelets haven't reported yet, and treating them as
+    dead would mask the whole cluster before the first heartbeat (the
+    lifecycle controller only ever writes Unknown for nodes whose LEASE
+    went stale)."""
+    for c in node.status.conditions:
+        if c.get("type") == "Ready":
+            return c.get("status") not in ("False", "Unknown")
+    return True
 
 
 def is_pod_terminating(pod: Pod) -> bool:

@@ -9,12 +9,18 @@ Layout:
   - retry.py  — RetryingStore (Retry-After-honoring write retries)
   - soak.py   — the convergence-under-failure workload driver
     (tests/test_chaos.py battery + tools/chaos_soak.py share it)
+  - partition.py — PartitionDriver (deterministic lease-renewal kills for
+    node sets / whole zones / flapping subsets) + run_node_storm, the
+    node-lifecycle storm soak (tests/test_node_lifecycle.py battery +
+    tools/node_storm_soak.py share it)
 
-soak is imported lazily — it pulls in the scheduler (and jax); the fault
-primitives stay importable from stdlib-only contexts (subprocess servers).
+soak and partition are imported lazily — they pull in the scheduler (and
+jax); the fault primitives stay importable from stdlib-only contexts
+(subprocess servers).
 """
 
 from .faults import (  # noqa: F401
+    CRASH_MID_ZONE_EVICT,
     CRASH_POINTS,
     CRASH_PRE_WAL_FSYNC,
     CRASH_TORN_WAL_WRITE,
@@ -32,6 +38,7 @@ from .faults import (  # noqa: F401
 from .retry import RetryingStore  # noqa: F401
 
 __all__ = [
+    "CRASH_MID_ZONE_EVICT",
     "CRASH_POINTS",
     "CRASH_PRE_WAL_FSYNC",
     "CRASH_TORN_WAL_WRITE",

@@ -57,6 +57,7 @@ CRASH_MID_PLAN_APPLY = "crash.mid_plan_apply"  # descheduler/controller._apply: 
 CRASH_MID_SCALEUP = "crash.mid_scaleup"        # autoscaler/controller._scale_up: some nodes created
 CRASH_POST_LEASE_RENEW = "crash.post_lease_renew"  # leaderelection._tick: lease renewed, holder dies
 CRASH_PRE_WAL_FSYNC = "crash.pre_wal_fsync"    # sim/wal.append: record written, fsync never ran
+CRASH_MID_ZONE_EVICT = "crash.mid_zone_evict"  # controllers/nodelifecycle: unreachable taint written, eviction sweep unrun
 # Not in CRASH_POINTS (armed via arm_torn_write, not crash_points): the
 # torn-write fault writes a PREFIX of the record before dying, so the point
 # name only identifies the ProcessCrash it raises.
@@ -70,6 +71,7 @@ CRASH_POINTS = (
     CRASH_MID_SCALEUP,
     CRASH_POST_LEASE_RENEW,
     CRASH_PRE_WAL_FSYNC,
+    CRASH_MID_ZONE_EVICT,
 )
 
 

@@ -1,0 +1,411 @@
+"""Network-partition chaos: kill lease renewals for node sets, whole zones,
+and flapping subsets — deterministically, riding the seeded FaultSchedule —
+plus the node-storm soak workload shared by tests/test_node_lifecycle.py and
+tools/node_storm_soak.py.
+
+The driver operates at the only layer a real partition touches: the node's
+Lease renewals stop (HollowNode.fail), nothing else changes.  Detection,
+zone aggregation, taints, tolerationSeconds countdowns, rate-limited
+sweeps, and gang repair are all the NodeLifecycleController's job — the
+soak asserts the ISSUE-13 contract end to end:
+
+  - a whole zone going dark (FullDisruption) produces ZERO evictions while
+    the outage holds, and healing cancels every pending countdown;
+  - scattered failures drain at the zone's current token rate (secondary
+    rate in PartialDisruption) — never a storm;
+  - a gang losing one host is failed atomically and rebound EXACTLY once
+    (store-history probe over (name, incarnation) bind transitions);
+  - PDBs hold throughout (the shared gate refuses, never overrides);
+  - the same seed replays the same kill sequence to the same final
+    bindings.
+
+Determinism contract: node subsets are chosen by blake2s rolls keyed on
+(seed, tag, node name) — the smallest-roll k names — so thread timing,
+dict order, and wall clock never enter a kill decision; all deadline math
+runs on the injected clock.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..sim.hollow_node import HollowCluster, HollowNode
+from .faults import FaultSchedule
+
+
+class PartitionDriver:
+    """Deterministic lease-renewal killer over a HollowCluster."""
+
+    def __init__(self, cluster: HollowCluster,
+                 schedule: Optional[FaultSchedule] = None, seed: int = 0,
+                 clock=time.monotonic):
+        self.cluster = cluster
+        self.schedule = schedule or FaultSchedule(seed)
+        self.clock = clock
+        self._by_name: Dict[str, HollowNode] = {
+            n.name: n for n in cluster.nodes}
+        # (clock seconds, action, node name) in execution order — the
+        # replay probe: same seed → identical sequence
+        self.kill_log: List[Tuple[float, str, str]] = []
+        # name → (down_seconds, up_seconds, epoch) flap cycle; phase
+        # derives from the injected clock (per-name epoch: registering a
+        # second flap set must not rephase earlier ones), so flapping is
+        # pure state, not a thread
+        self._flapping: Dict[str, Tuple[float, float, float]] = {}
+
+    # --- deterministic selection ----------------------------------------------
+
+    def _roll(self, tag: str, name: str) -> float:
+        # the schedule's own blake2s primitive — ONE deterministic-roll
+        # implementation per package, so same-seed replay symmetry can't
+        # drift between fault classes
+        return self.schedule._roll("partition", tag, name)
+
+    def pick(self, names: List[str], k: int, tag: str = "pick") -> List[str]:
+        """The k names with the smallest seeded rolls — a pure function of
+        (seed, tag, name), independent of list order."""
+        return sorted(sorted(names), key=lambda n: self._roll(tag, n))[:k]
+
+    def zone_nodes(self, zone: str,
+                   zone_label: str = "topology.kubernetes.io/zone") -> List[str]:
+        return sorted(n.name for n in self.cluster.nodes
+                      if n.labels.get(zone_label) == zone)
+
+    # --- kill / heal ----------------------------------------------------------
+
+    def _record(self, action: str, name: str) -> None:
+        self.kill_log.append((self.clock(), action, name))
+        with self.schedule._lock:
+            self.schedule.injected[f"partition_{action}"] = (
+                self.schedule.injected.get(f"partition_{action}", 0) + 1)
+
+    def partition_nodes(self, names: List[str]) -> List[str]:
+        for name in sorted(names):
+            node = self._by_name[name]
+            if node.alive:
+                node.fail()
+                self._record("kill", name)
+        return sorted(names)
+
+    def heal_nodes(self, names: List[str]) -> None:
+        for name in sorted(names):
+            node = self._by_name[name]
+            self._flapping.pop(name, None)
+            if not node.alive:
+                node.recover()
+                self._record("heal", name)
+
+    def partition_zone(self, zone: str) -> List[str]:
+        """Whole zone dark: every lease renewal in the zone stops."""
+        return self.partition_nodes(self.zone_nodes(zone))
+
+    def heal_zone(self, zone: str) -> None:
+        self.heal_nodes(self.zone_nodes(zone))
+
+    def scatter(self, fraction: float, zone: Optional[str] = None,
+                tag: str = "scatter") -> List[str]:
+        """Kill a deterministic ``fraction`` of the (zone's) nodes."""
+        pool = (self.zone_nodes(zone) if zone is not None
+                else sorted(self._by_name))
+        k = max(1, int(round(len(pool) * fraction)))
+        victims = self.pick(pool, k, tag=tag)
+        return self.partition_nodes(victims)
+
+    # --- flapping -------------------------------------------------------------
+
+    def flap(self, names: List[str], down_seconds: float,
+             up_seconds: float) -> None:
+        """Register a down/up cycle for ``names``; ``step()`` applies the
+        phase the injected clock implies.  Phase 0 starts DOWN (the node
+        dies the moment flapping starts); each name's cycle anchors on its
+        own registration time, so later flap sets never rephase earlier
+        ones."""
+        epoch = self.clock()
+        for name in sorted(names):
+            self._flapping[name] = (float(down_seconds), float(up_seconds),
+                                    epoch)
+        self.step()
+
+    def step(self) -> None:
+        """Apply flap phases for the current injected-clock time."""
+        now = self.clock()
+        for name, (down, up, epoch) in sorted(self._flapping.items()):
+            node = self._by_name[name]
+            t = (now - epoch) % (down + up)
+            should_be_down = t < down
+            if should_be_down and node.alive:
+                node.fail()
+                self._record("kill", name)
+            elif not should_be_down and not node.alive:
+                node.recover()
+                self._record("heal", name)
+
+
+# --- the node-storm soak ------------------------------------------------------
+
+
+@dataclass
+class StormResult:
+    nodes: int
+    pods: int
+    # phase A: zone-wide outage
+    outage_zone_mode: str = ""            # must hold FullDisruption
+    outage_evictions: int = 0             # must be 0
+    cancelled_on_heal: float = 0.0        # countdowns cancelled at heal > 0
+    # phase B: scattered failures
+    scattered_zone_mode: str = ""         # PartialDisruption
+    scattered_swept: int = 0              # nodes drained during the window
+    scattered_budget: int = 0             # token-math upper bound
+    # phase C: gang repair (delta over phase C alone — scattered failures
+    # in phase B may legitimately down a gang host too; every repair is
+    # still exactly-once per outage via the bind probe)
+    gang_repairs: float = 0.0             # must be 1
+    gang_member_binds: Dict[Tuple[str, int], int] = field(default_factory=dict)
+    # invariants across all phases
+    pdb_floor_held: bool = True           # live protected pods ≥ minAvailable
+    overridden_evictions: float = 0.0     # gate never overrode a PDB
+    unbound: List[str] = field(default_factory=list)
+    final_bindings: Dict[str, str] = field(default_factory=dict)
+    kill_log: List[Tuple[float, str, str]] = field(default_factory=list)
+    injected: Dict[str, int] = field(default_factory=dict)
+    wall_seconds: float = 0.0
+
+    @property
+    def converged(self) -> bool:
+        return (self.outage_evictions == 0
+                and self.outage_zone_mode == "FullDisruption"
+                and self.scattered_swept <= self.scattered_budget
+                and self.gang_repairs == 1
+                and all(c == 1 for c in self.gang_member_binds.values())
+                and self.pdb_floor_held
+                and self.overridden_evictions == 0
+                and not self.unbound)
+
+    def determinism_signature(self) -> Dict[str, object]:
+        """The replay-stable view: kill sequence, fault counts, and the
+        final binding map (pod → node)."""
+        return {"kill_log": list(self.kill_log),
+                "injected": dict(self.injected),
+                "final_bindings": dict(self.final_bindings)}
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def run_node_storm(
+    nodes_per_zone: int = 6,
+    n_zones: int = 3,
+    seed: int = 7,
+    *,
+    web_replicas: Optional[int] = None,
+    gang_size: int = 3,
+    grace: float = 40.0,
+    secondary_qps: float = 0.01,
+    large_zone_threshold: Optional[int] = None,
+    toleration_seconds: int = 120,
+) -> StormResult:
+    """The ISSUE-13 acceptance scenario on a fake clock (fully
+    deterministic): zone outage → heal → scattered partial disruption →
+    gang-hosting node death → convergence.  Tier-1 runs the small shape;
+    tools/node_storm_soak.py runs 3×100."""
+    from ..api import objects as v1
+    from ..controllers.disruption import DisruptionController
+    from ..controllers.nodelifecycle import (
+        ZONE_FULL, ZONE_PARTIAL, NodeLifecycleController)
+    from ..gang import POD_GROUP_LABEL
+    from ..metrics import scheduler_metrics as m
+    from ..scheduler import TPUScheduler
+    from ..sim.store import DELETED, MODIFIED, ObjectStore
+    from ..testutil import make_pod
+
+    t0 = time.monotonic()
+    clock = _FakeClock()
+    store = ObjectStore()
+    n_nodes = nodes_per_zone * n_zones
+    web_replicas = (2 * n_nodes if web_replicas is None else web_replicas)
+    if large_zone_threshold is None:
+        # make every zone "large" so PartialDisruption gets the secondary
+        # rate instead of the small-cluster full stop
+        large_zone_threshold = max(1, nodes_per_zone - 1)
+
+    cancelled_before = sum(
+        v for (labels, v) in m.node_lifecycle_evictions.items().items()
+        if labels and labels[1] == "cancelled")
+
+    sched = TPUScheduler(store, batch_size=32, clock=clock, batch_wait=0)
+    sched.presize(n_nodes, web_replicas + gang_size + 64)
+    cluster = HollowCluster(store, n_nodes, clock=clock, zones=n_zones)
+    fault = FaultSchedule(seed)
+    driver = PartitionDriver(cluster, fault, clock=clock)
+    lifecycle = NodeLifecycleController(
+        store, grace_period=grace, clock=clock,
+        gang_directory=sched.gangs,
+        secondary_eviction_qps=secondary_qps,
+        large_zone_threshold=large_zone_threshold)
+    disruption = DisruptionController(store)
+
+    # --- workload: deterministic-name pods the harness itself re-creates
+    # (a stand-in for the ReplicaSet controller whose generated names ride
+    # a process-global counter — replay needs name-stable replacements).
+    # Each name's Nth re-creation carries uid "<name>/rN".
+    desired: Dict[str, dict] = {}
+    generation: Dict[str, int] = {}
+
+    def _spec(name: str, labels: Dict[str, str], cpu: str = "1",
+              tol_seconds: Optional[int] = None):
+        desired[name] = {"labels": labels, "cpu": cpu, "tol": tol_seconds}
+
+    def _reconcile() -> int:
+        created = 0
+        for name, spec in desired.items():
+            if store.get("Pod", "default", name) is not None:
+                continue
+            gen = generation.get(name, 0) + 1
+            generation[name] = gen
+            b = (make_pod().name(name).uid(f"{name}/r{gen}")
+                 .namespace("default").req({"cpu": spec["cpu"]}))
+            for k, val in spec["labels"].items():
+                b = b.label(k, val)
+            if spec["tol"] is not None:
+                b = b.toleration(
+                    key="node.kubernetes.io/unreachable",
+                    operator=v1.TOLERATION_OP_EXISTS, effect="NoExecute",
+                    toleration_seconds=spec["tol"])
+            store.create("Pod", b.obj())
+            created += 1
+        return created
+
+    for i in range(web_replicas):
+        # half the web fleet carries a tolerationSeconds countdown — the
+        # heal phase must cancel those instead of letting them fire
+        _spec(f"web-{i:04d}", {"app": "web"},
+              tol_seconds=(toleration_seconds if i % 2 == 0 else None))
+    store.create("PodGroup", v1.PodGroup(
+        metadata=v1.ObjectMeta(name="gang0", namespace="default"),
+        min_member=gang_size, schedule_timeout_seconds=60))
+    for i in range(gang_size):
+        _spec(f"gang0-{i}", {POD_GROUP_LABEL: "gang0", "app": "gang"})
+    pdb_floor = max(1, int(0.6 * web_replicas))
+    store.create("PodDisruptionBudget", v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="web-pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=pdb_floor))
+
+    result = StormResult(nodes=n_nodes, pods=web_replicas + gang_size)
+
+    def _web_bound() -> int:
+        # BOUND pods only: recreated-but-unbound replacements must not
+        # satisfy the floor, or the probe could never fail
+        return sum(1 for p in store.list("Pod")[0]
+                   if p.metadata.labels.get("app") == "web"
+                   and p.spec.node_name)
+
+    probe_armed = False  # armed once the initial placement completes —
+    # before anything was ever scheduled there is nothing to protect
+
+    def settle(steps: int, dt: float) -> None:
+        for _ in range(steps):
+            clock.advance(dt)
+            driver.step()
+            cluster.heartbeat_all()
+            disruption.sync_once()
+            lifecycle.sync_once()
+            # probe BEFORE replacements are recreated: the gate alone must
+            # have kept ≥ minAvailable members standing (pods on dead but
+            # unevicted nodes count — that is exactly the freeze contract)
+            if probe_armed and _web_bound() < pdb_floor:
+                result.pdb_floor_held = False
+            _reconcile()
+            sched.run_until_idle(max_cycles=20)
+            cluster.sync_all()
+
+    def deleted_pods() -> int:
+        return sum(1 for ev in store._log
+                   if ev.kind == "Pod" and ev.type == DELETED)
+
+    # --- phase 0: schedule everything onto the healthy cluster
+    _reconcile()
+    settle(3, 1.0)
+    probe_armed = True
+
+    # --- phase A: whole zone dark → FullDisruption freeze, zero evictions
+    driver.partition_zone("zone-0")
+    before = deleted_pods()
+    settle(6, grace / 2)  # well past grace, outage holds
+    result.outage_zone_mode = lifecycle.zone_mode("zone-0")
+    result.outage_evictions = deleted_pods() - before
+    driver.heal_zone("zone-0")
+    settle(2, 1.0)
+    cancelled_now = sum(
+        v for (labels, v) in m.node_lifecycle_evictions.items().items()
+        if labels and labels[1] == "cancelled")
+    result.cancelled_on_heal = cancelled_now - cancelled_before
+
+    # --- phase B: scattered failures in zone-1 → PartialDisruption,
+    # sweeps bounded by the secondary token rate.  The sweep count is the
+    # controller's own draining set (a node enters it exactly when its
+    # rate-limited pop ran); the budget is the token math over the whole
+    # window (conservative: tokens only accrue once the zone is Partial)
+    # plus the one banked burst token.
+    victims = driver.scatter(0.6, zone="zone-1", tag="scatter-b")
+    scatter_window = 4 * grace
+    settle(20, scatter_window / 20)
+    result.scattered_zone_mode = lifecycle.zone_mode("zone-1")
+    result.scattered_swept = len(set(victims) & lifecycle.draining)
+    result.scattered_budget = 1 + int(secondary_qps * scatter_window) + 1
+    driver.heal_nodes(victims)
+    settle(4, 5.0)
+
+    # --- phase C: a gang-hosting node dies → atomic repair, rebound once
+    gang_repairs_before = m.gang_repairs.value()
+    gang_nodes = sorted({p.spec.node_name for p in store.list("Pod")[0]
+                         if p.metadata.labels.get(POD_GROUP_LABEL)
+                         and p.spec.node_name})
+    if gang_nodes:
+        driver.partition_nodes(gang_nodes[:1])
+        settle(4, grace)  # detect + sweep + repair + requeue + rebind
+        driver.heal_nodes(gang_nodes[:1])
+        settle(4, 5.0)
+    result.gang_repairs = m.gang_repairs.value() - gang_repairs_before
+
+    # --- exactly-once probe: (name, incarnation) → bind transitions
+    node_of: Dict[str, Optional[str]] = {}
+    incarnation: Dict[str, int] = {}
+    for ev in store._log:
+        if ev.kind != "Pod":
+            continue
+        name = ev.obj.metadata.name
+        if ev.type == DELETED:
+            node_of.pop(name, None)
+            incarnation[name] = incarnation.get(name, 0) + 1
+            continue
+        nn = ev.obj.spec.node_name or None
+        if nn is not None and node_of.get(name) is None:
+            if name.startswith("gang0-"):
+                key = (name, incarnation.get(name, 0))
+                result.gang_member_binds[key] = (
+                    result.gang_member_binds.get(key, 0) + 1)
+        node_of[name] = nn
+
+    result.overridden_evictions = sum(
+        v for (labels, v) in m.descheduler_evictions.items().items()
+        if labels and labels[0] == "nodelifecycle"
+        and labels[1] == "overridden")
+    pods, _ = store.list("Pod")
+    result.unbound = [p.metadata.name for p in pods if not p.spec.node_name]
+    result.final_bindings = {p.metadata.name: p.spec.node_name for p in pods}
+    result.kill_log = list(driver.kill_log)
+    result.injected = fault.injected_counts()
+    result.wall_seconds = time.monotonic() - t0
+    sched.close(flush_events=False)
+    return result

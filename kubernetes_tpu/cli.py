@@ -75,7 +75,7 @@ class Kubectl:
     def _header(self, kind: str) -> List[str]:
         return {
             "Pod": ["NAME", "STATUS", "NODE", "PRIORITY"],
-            "Node": ["NAME", "READY", "TAINTS", "CPU", "MEMORY"],
+            "Node": ["NAME", "READY", "ZONE", "TAINTS", "CPU", "MEMORY"],
             "ReplicaSet": ["NAME", "DESIRED", "CURRENT", "READY"],
             "Deployment": ["NAME", "REPLICAS"],
             "Job": ["NAME", "COMPLETIONS", "SUCCEEDED", "DONE"],
@@ -92,7 +92,10 @@ class Kubectl:
                 (c.get("status", "?") for c in o.status.conditions
                  if c.get("type") == "Ready"), "?",
             )
-            return [o.metadata.name, ready,
+            from .controllers.nodelifecycle import ZONE_LABEL
+
+            zone = o.metadata.labels.get(ZONE_LABEL, "<none>")
+            return [o.metadata.name, ready, zone,
                     ",".join(f"{t.key}:{t.effect}" for t in o.spec.taints) or "<none>",
                     str(o.status.allocatable.get("cpu", "?")),
                     str(o.status.allocatable.get("memory", "?"))]
@@ -442,6 +445,80 @@ class Kubectl:
         head = "ok" if ok else "NotReady"
         return f"{head}\n{out}" if out else head
 
+    # --- node lifecycle / partition-tolerance view ------------------------------
+
+    def nodehealth(self, controller=None, metrics=None) -> str:
+        """``ktpu nodehealth``: per-zone disruption state, Ready/NotReady
+        counts, and eviction-queue depth, plus the pending
+        tolerationSeconds countdowns and the lifecycle eviction totals.
+
+        Reads the live ``NodeLifecycleController`` when given (in-process
+        wiring); otherwise the ``node_lifecycle_*`` metric series —
+        ``metrics`` accepts a pre-parsed {(name, labels): value} dict (the
+        --server path feeds /metrics through ``metrics.registry.parse_text``),
+        else the in-process default registry serves.  Node counts always
+        come from the store's Node objects (READY is the condition the
+        lifecycle controller maintains)."""
+        from .api.objects import node_is_ready
+        from .controllers.nodelifecycle import ZONE_LABEL, ZONE_STATE_CODE
+
+        code_name = {v: k for k, v in ZONE_STATE_CODE.items()}
+        nodes, _ = self.store.list("Node")
+        counts: Dict[str, List[int]] = {}
+        for n in nodes:
+            zone = n.metadata.labels.get(ZONE_LABEL, "")
+            c = counts.setdefault(zone, [0, 0])
+            c[0 if node_is_ready(n) else 1] += 1
+        if metrics is None and controller is None:
+            from .metrics.registry import default_registry, parse_text, render_text
+
+            metrics = parse_text(render_text(default_registry))
+        zones = set(counts)
+        if controller is not None:
+            zones |= set(controller.zones)
+            pending = len(controller.taint_manager)
+        else:
+            zones |= {lab[0] for (name, lab) in metrics
+                      if name == "node_lifecycle_zone_state" and lab}
+            pending = None
+        rows = [["ZONE", "STATE", "READY", "NOTREADY", "EVICTION-QUEUE"]]
+        for zone in sorted(zones):
+            ready, not_ready = counts.get(zone, [0, 0])
+            if controller is not None:
+                state = controller.zone_mode(zone)
+                z = controller.zones.get(zone)
+                depth = len(z.queue) if z is not None else 0
+            else:
+                # the unlabeled zone ("") loses its label value in the
+                # render_text→parse_text round trip (label="" parses to
+                # the empty tuple) — look both keys up so --server output
+                # agrees with the live-controller view
+                keys = [(zone,)] + ([()] if zone == "" else [])
+
+                def series(name, keys=keys):
+                    return next((metrics[(name, k)] for k in keys
+                                 if (name, k) in metrics), 0)
+
+                state = code_name.get(
+                    int(series("node_lifecycle_zone_state")), "Normal")
+                depth = int(series("node_lifecycle_eviction_queue_depth"))
+            rows.append([zone or "<none>", state, str(ready),
+                         str(not_ready), str(depth)])
+        out = _render_table(rows)
+        if pending is not None:
+            out += f"\npending tolerationSeconds countdowns: {pending}"
+        if controller is None:
+            totals = {lab: v for (name, lab), v in metrics.items()
+                      if name == "node_lifecycle_evictions_total" and lab}
+        else:
+            from .metrics import scheduler_metrics as m
+
+            totals = m.node_lifecycle_evictions.items()
+        for lab in sorted(totals):
+            out += (f"\nevictions {lab[0]}/{lab[1]}: "
+                    f"{totals[lab]:g}")
+        return out
+
     # --- control-plane durability / flow-control view --------------------------
 
     def controlplane_status(self, wal=None, watch_cache=None, flow=None,
@@ -616,6 +693,7 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     p.add_argument("action", choices=["status"])
     p = sub.add_parser("controlplane")
     p.add_argument("action", choices=["status"])
+    sub.add_parser("nodehealth")
     sub.add_parser("topology")
     sub.add_parser("readyz")
     for verb in ("cordon", "uncordon"):
@@ -671,6 +749,18 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                     metrics=parse_text(r.read().decode())))
         else:
             print(k.controlplane_status())
+    elif args.verb == "nodehealth":
+        if args.server:
+            # zone state / queue depth live in the serving process; its
+            # /metrics exposition carries the node_lifecycle_* series
+            import urllib.request
+
+            from .metrics.registry import parse_text
+
+            with urllib.request.urlopen(f"{args.server}/metrics") as r:
+                print(k.nodehealth(metrics=parse_text(r.read().decode())))
+        else:
+            print(k.nodehealth())
     elif args.verb == "topology":
         print(k.topology())
     elif args.verb == "readyz":

@@ -1,25 +1,101 @@
-"""Node lifecycle controller: failure detection + elastic rescheduling.
+"""Partition-tolerant node lifecycle: zone-aware health aggregation with
+rate-limited eviction queues, a NoExecute taint manager with
+tolerationSeconds countdowns, and gang-aware slice repair.
 
-Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go:351 —
-monitors node Lease heartbeats (kubelet renews every ¼ lease duration,
-pkg/kubelet/kubelet.go:809-810); a node whose lease is stale past the grace
-period is marked NotReady and gets the NoExecute taint
-node.kubernetes.io/unreachable; its pods are evicted (deleted) so workload
-controllers recreate them and the scheduler places them elsewhere — the
-elastic-recovery loop of SURVEY §5.
+Reference: pkg/controller/nodelifecycle/node_lifecycle_controller.go (1550
+LoC) — monitorNodeHealth marks nodes whose kubelet Lease went stale past
+--node-monitor-grace-period as Ready=Unknown and taints them
+node.kubernetes.io/unreachable:NoExecute; per-zone ``zoneStates`` aggregate
+Ready counts into three modes that retune each zone's RateLimitedTimedQueue
+(``setLimiterInZone``); the NoExecuteTaintManager
+(pkg/controller/nodelifecycle/scheduler/taint_manager.go) evicts pods from
+tainted nodes honoring tolerationSeconds countdowns anchored on
+Taint.TimeAdded.
+
+Mapping and deliberate deviations:
+
+  - **Zone modes** (``ComputeZoneState``): Normal (all ready),
+    PartialDisruption (≥ unhealthy-zone-threshold of the zone NotReady, and
+    more than 2 nodes down — the upstream guard), FullDisruption (zero
+    ready nodes).  Mode drives the zone queue's token bucket: Normal → the
+    primary eviction rate (--node-eviction-rate, 0.1/s), Partial → the
+    secondary rate (--secondary-node-eviction-rate, 0.01/s) for zones
+    larger than ``large_zone_threshold`` and a FULL STOP for small zones
+    (upstream's small-cluster handling).
+  - **FullDisruption freezes evictions** for that zone (timed countdowns
+    included).  DOCUMENTED DEVIATION: upstream only freezes when ALL zones
+    are fully disrupted (the master-partition heuristic) and evicts a
+    single dark zone at the normal rate; here a whole zone going dark is
+    treated as indistinguishable from a network partition — for the TPU
+    north star, deleting an entire zone's training gangs on a partition
+    signal is the worst possible failure amplification.  The taints still
+    land (new work is masked away from the dark zone); only deletion is
+    withheld until the zone either partially recovers or heals.
+    Zones smaller than ``full_disruption_min_nodes`` never freeze: a 1-2
+    node "zone" dying is indistinguishable from plain node death and the
+    basic elastic-recovery loop (evict → controllers recreate → reschedule
+    elsewhere) must keep working.
+  - **Eviction rate = node-sweep rate**, exactly the upstream shape: the
+    rate-limited unit in ``zonePodEvictor``/``zoneNoExecuteTainter`` is a
+    NODE, not a pod.  A popped node's sweep evicts its non-tolerating pods
+    through the shared PDB gate (descheduler/evictions.py); refused pods
+    retry on later syncs WITHOUT consuming fresh tokens (the PR-5
+    replenish-and-drain contract — a still-down node must eventually drain
+    without ever violating a PDB).
+  - **tolerationSeconds** (the ISSUE-13 bugfix): a toleration matching the
+    unreachable taint with ``tolerationSeconds=None`` tolerates FOREVER
+    (never evicted); ``tolerationSeconds=N`` enters the timed eviction
+    queue and survives exactly N seconds from Taint.TimeAdded — upstream
+    semantics, where the seed code evicted such pods immediately.  Lease
+    recovery removes the taint and CANCELS pending countdowns, so a
+    flapping node stops churning workloads.
+  - **Gang-aware slice repair**: a swept node carrying bound members of a
+    PodGroup fails the WHOLE gang atomically — every bound member
+    (wherever it is) is gate-checked first and evicted only if ALL pass,
+    the PodGroup phase resets to Pending, and ``gang_repairs_total``
+    counts the repair once.  The scheduler's GangDirectory sees the
+    deletes through its watch stream and requeues the remainder as one
+    gang; an attached directory is additionally told directly
+    (``repair``) so waiting members reject without waiting for events.
+
+All deadline math runs on the INJECTED clock, so chaos replays with a fake
+clock are deterministic; same seed → same kill sequence → same sweeps.
 """
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import time
-from typing import Dict
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import objects as v1
+from ..chaos.faults import CRASH_MID_ZONE_EVICT, maybe_crash
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
 from ..sim.store import ObjectStore
 
 UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
 NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+ZONE_LABEL = "topology.kubernetes.io/zone"
 DEFAULT_GRACE_PERIOD = 40.0  # node-monitor-grace-period default
+
+# zone disruption modes (node_lifecycle_controller.go ZoneState)
+ZONE_NORMAL = "Normal"
+ZONE_PARTIAL = "PartialDisruption"
+ZONE_FULL = "FullDisruption"
+ZONE_STATE_CODE = {ZONE_NORMAL: 0, ZONE_PARTIAL: 1, ZONE_FULL: 2}
+
+DEFAULT_UNHEALTHY_ZONE_THRESHOLD = 0.55  # --unhealthy-zone-threshold
+DEFAULT_LARGE_ZONE_THRESHOLD = 50        # largeClusterSizeThreshold
+DEFAULT_EVICTION_QPS = 0.1               # --node-eviction-rate
+DEFAULT_SECONDARY_EVICTION_QPS = 0.01    # --secondary-node-eviction-rate
+DEFAULT_EVICTION_BURST = 1               # scheduler.EvictionRateLimiterBurst
+# zones below this NotReady count never enter the FullDisruption freeze
+# (mirrors upstream's ``notReadyNodes > 2`` partial-disruption guard)
+DEFAULT_FULL_DISRUPTION_MIN_NODES = 3
 
 
 def _set_condition(node: v1.Node, cond_type: str, status: str):
@@ -30,75 +106,600 @@ def _set_condition(node: v1.Node, cond_type: str, status: str):
     node.status.conditions.append({"type": cond_type, "status": status})
 
 
+class TokenBucket:
+    """flowcontrol.NewTokenBucketRateLimiter on the injected clock.
+
+    ``set_rate`` settles the accrual at the OLD rate first, so a mode flip
+    mid-interval never retroactively re-prices elapsed time."""
+
+    def __init__(self, qps: float, burst: int, clock, now: float = None):
+        self.qps = float(qps)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock() if now is None else now
+
+    def _refill(self, now: float) -> None:
+        if now > self._last:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def set_rate(self, qps: float, now: float) -> None:
+        if qps == self.qps:
+            return
+        self._refill(now)
+        self.qps = float(qps)
+        if qps <= 0:
+            # a freeze means FROZEN: banked burst must not leak one last
+            # eviction into a zone that just went fully dark
+            self._tokens = 0.0
+
+    def try_take(self, now: float) -> bool:
+        self._refill(now)
+        if self.qps <= 0 or self._tokens < 1.0:
+            return False
+        self._tokens -= 1.0
+        return True
+
+
+class RateLimitedTimedQueue:
+    """Per-zone FIFO of nodes awaiting their eviction sweep, popped at the
+    zone's current token rate (the upstream RateLimitedTimedQueue, minus
+    the retry-backoff machinery — refused sweeps retry via the controller's
+    draining set, not by re-queuing).  ``remove`` is the cancellation hook
+    lease recovery uses."""
+
+    def __init__(self, limiter: TokenBucket):
+        self.limiter = limiter
+        self._items: "OrderedDict[str, None]" = OrderedDict()
+
+    def add(self, node: str) -> None:
+        if node not in self._items:
+            self._items[node] = None
+
+    def remove(self, node: str) -> bool:
+        if node in self._items:
+            del self._items[node]
+            return True
+        return False
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def try_pop(self, now: float) -> Optional[str]:
+        if not self._items or not self.limiter.try_take(now):
+            return None
+        node, _ = self._items.popitem(last=False)
+        return node
+
+
+@dataclass
+class _ZoneHealth:
+    queue: RateLimitedTimedQueue
+    mode: str = ZONE_NORMAL
+    ready: int = 0
+    not_ready: int = 0
+
+
+class NoExecuteTaintManager:
+    """The tolerationSeconds timed eviction queue.
+
+    Entries key on (namespace/name, node); a heap orders deadlines, a live
+    dict arbitrates (lazy cancellation: a popped entry whose dict record
+    disagrees is a ghost).  Deadlines anchor on Taint.TimeAdded, so a
+    successor controller resumes the SAME countdowns instead of granting
+    dead nodes' pods a fresh window."""
+
+    def __init__(self):
+        self._heap: List[Tuple[float, int, str, str]] = []
+        self._pending: Dict[str, Tuple[float, str]] = {}  # pod → (at, node)
+        self._seq = itertools.count()
+
+    def schedule(self, pod_key: str, node: str, fire_at: float) -> None:
+        cur = self._pending.get(pod_key)
+        if cur is not None and cur == (fire_at, node):
+            return  # already scheduled (idempotent re-registration)
+        self._pending[pod_key] = (fire_at, node)
+        heapq.heappush(self._heap, (fire_at, next(self._seq), pod_key, node))
+
+    def cancel_node(self, node: str) -> int:
+        victims = [k for k, (_, n) in self._pending.items() if n == node]
+        for k in victims:
+            del self._pending[k]
+        return len(victims)
+
+    def pending_on(self, pod_key: str) -> bool:
+        return pod_key in self._pending
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def due(self, now: float) -> List[Tuple[str, str]]:
+        """Pop every (pod_key, node) whose deadline passed.  A deferred
+        entry (frozen zone) must be re-``schedule``d by the caller."""
+        out: List[Tuple[str, str]] = []
+        while self._heap and self._heap[0][0] <= now:
+            fire_at, _, pod_key, node = heapq.heappop(self._heap)
+            live = self._pending.get(pod_key)
+            if live is None or live != (fire_at, node):
+                continue  # cancelled or rescheduled — ghost entry
+            del self._pending[pod_key]
+            out.append((pod_key, node))
+        return out
+
+
 class NodeLifecycleController:
-    def __init__(self, store: ObjectStore, grace_period: float = DEFAULT_GRACE_PERIOD,
-                 clock=time.monotonic, eviction_api=None):
+    def __init__(self, store: ObjectStore,
+                 grace_period: float = DEFAULT_GRACE_PERIOD,
+                 clock=time.monotonic, eviction_api=None,
+                 gang_directory=None,
+                 zone_label: str = ZONE_LABEL,
+                 unhealthy_zone_threshold: float = DEFAULT_UNHEALTHY_ZONE_THRESHOLD,
+                 large_zone_threshold: int = DEFAULT_LARGE_ZONE_THRESHOLD,
+                 eviction_qps: float = DEFAULT_EVICTION_QPS,
+                 secondary_eviction_qps: float = DEFAULT_SECONDARY_EVICTION_QPS,
+                 eviction_burst: int = DEFAULT_EVICTION_BURST,
+                 full_disruption_min_nodes: int = DEFAULT_FULL_DISRUPTION_MIN_NODES):
         from ..descheduler.evictions import EvictionAPI
 
         self.store = store
         self.grace = grace_period
         self.clock = clock
+        self.zone_label = zone_label
+        self.unhealthy_zone_threshold = unhealthy_zone_threshold
+        self.large_zone_threshold = large_zone_threshold
+        self.eviction_qps = eviction_qps
+        self.secondary_eviction_qps = secondary_eviction_qps
+        self.eviction_burst = eviction_burst
+        self.full_disruption_min_nodes = full_disruption_min_nodes
         # every pod-killing path goes through the shared eviction gate
-        # (descheduler/evictions.py): a not-ready node's sync can no longer
+        # (descheduler/evictions.py): a not-ready node's sweep can never
         # zero out a PDB-protected workload in one pass.  DOCUMENTED
         # DEVIATION from the reference taint manager, which deletes
-        # NoExecute-evicted pods unconditionally; refused pods survive this
-        # sync and retry on later syncs as budget replenishes.
+        # NoExecute-evicted pods unconditionally; refused pods survive the
+        # sweep and retry on later syncs as budget replenishes.
         self.evictions = eviction_api or EvictionAPI(store, clock=clock)
+        # optional in-process GangDirectory (the scheduler's): repairs also
+        # reject still-waiting members directly instead of waiting for the
+        # watch stream to deliver the deletes
+        self.gangs = gang_directory
+        self.zones: Dict[str, _ZoneHealth] = {}
+        self.taint_manager = NoExecuteTaintManager()
+        # nodes whose sweep ran at least once and which are still down:
+        # PDB-refused pods retry here every sync without new tokens
+        self._draining: Set[str] = set()
+        # node → when this controller first saw it WITHOUT a lease: grace
+        # for never-heartbeat nodes anchors here (no persisted timestamp
+        # shares the injected clock's time base), bounding the
+        # registered-but-kubelet-died blind spot instead of exempting it
+        # forever
+        self._no_lease_since: Dict[str, float] = {}
+
+    # --- zone bookkeeping -----------------------------------------------------
+
+    def _zone_of(self, node: v1.Node) -> str:
+        return node.metadata.labels.get(self.zone_label, "")
+
+    def _zone(self, zone: str) -> _ZoneHealth:
+        z = self.zones.get(zone)
+        if z is None:
+            z = _ZoneHealth(queue=RateLimitedTimedQueue(TokenBucket(
+                self.eviction_qps, self.eviction_burst, self.clock)))
+            self.zones[zone] = z
+        return z
+
+    def zone_mode(self, zone: str) -> str:
+        z = self.zones.get(zone)
+        return z.mode if z is not None else ZONE_NORMAL
+
+    @property
+    def draining(self) -> frozenset:
+        """Nodes whose rate-limited sweep has run and which are still down
+        (PDB-refused pods retry here each sync).  The storm soak reads this
+        as the token-bounded sweep count."""
+        return frozenset(self._draining)
+
+    def _compute_zone_states(self, nodes: List[v1.Node], now: float) -> None:
+        """ComputeZoneState + setLimiterInZone over the just-written
+        conditions; gauges updated per zone every sync."""
+        counts: Dict[str, Tuple[int, int]] = {}
+        for node in nodes:
+            zone = self._zone_of(node)
+            ready, not_ready = counts.get(zone, (0, 0))
+            if v1.node_is_ready(node):
+                ready += 1
+            else:
+                not_ready += 1
+            counts[zone] = (ready, not_ready)
+        for zone, (ready, not_ready) in counts.items():
+            z = self._zone(zone)
+            z.ready, z.not_ready = ready, not_ready
+            total = ready + not_ready
+            if not_ready == 0:
+                mode = ZONE_NORMAL
+            elif ready == 0 and not_ready >= self.full_disruption_min_nodes:
+                mode = ZONE_FULL
+            elif (not_ready / total >= self.unhealthy_zone_threshold
+                  and not_ready > 2):
+                mode = ZONE_PARTIAL
+            else:
+                mode = ZONE_NORMAL
+            if mode != z.mode:
+                klog.V(2).info_s("Zone disruption state changed", zone=zone,
+                                 old=z.mode, new=mode, ready=ready,
+                                 not_ready=not_ready)
+                z.mode = mode
+            if mode == ZONE_FULL:
+                qps = 0.0  # frozen (see module docstring deviation note)
+            elif mode == ZONE_PARTIAL:
+                qps = (self.secondary_eviction_qps
+                       if total > self.large_zone_threshold else 0.0)
+            else:
+                qps = self.eviction_qps
+            z.queue.limiter.set_rate(qps, now)
+            m.node_lifecycle_zone_state.set(ZONE_STATE_CODE[mode], (zone,))
+        # zones whose last node disappeared: report Normal and drop state
+        for zone in [zn for zn in self.zones if zn not in counts]:
+            m.node_lifecycle_zone_state.set(0, (zone,))
+            m.node_lifecycle_queue_depth.set(0, (zone,))
+            del self.zones[zone]
+
+    # --- toleration semantics -------------------------------------------------
+
+    @staticmethod
+    def _unreachable_taint(time_added: float) -> v1.Taint:
+        return v1.Taint(key=UNREACHABLE_TAINT, effect=v1.TAINT_NO_EXECUTE,
+                        time_added=time_added)
+
+    @staticmethod
+    def _matching_tolerations(pod: v1.Pod) -> List[v1.Toleration]:
+        probe = v1.Taint(key=UNREACHABLE_TAINT, effect=v1.TAINT_NO_EXECUTE)
+        return [t for t in pod.spec.tolerations if t.tolerates(probe)]
+
+    @classmethod
+    def _tolerates_forever(cls, pod: v1.Pod) -> bool:
+        """Upstream GetMatchingTolerations: any matching toleration with
+        tolerationSeconds UNSET tolerates the taint indefinitely."""
+        return any(t.toleration_seconds is None
+                   for t in cls._matching_tolerations(pod))
+
+    @classmethod
+    def _toleration_deadline(cls, pod: v1.Pod,
+                             taint_added: float) -> Optional[float]:
+        """Earliest tolerationSeconds expiry (minTolerationTime), None when
+        no bounded toleration matches."""
+        secs = [t.toleration_seconds for t in cls._matching_tolerations(pod)
+                if t.toleration_seconds is not None]
+        if not secs:
+            return None
+        return taint_added + float(min(secs))
+
+    def _register_countdowns(self, node_name: str, taint_added: float,
+                             pods: List[v1.Pod]) -> None:
+        """Enter every bounded-toleration pod on ``node_name`` into the
+        timed eviction queue.  Idempotent (re-run by a successor after a
+        crash) and anchored on Taint.TimeAdded, never "now"."""
+        for p in pods:
+            if p.spec.node_name != node_name:
+                continue
+            if self._tolerates_forever(p):
+                continue
+            deadline = self._toleration_deadline(p, taint_added)
+            if deadline is not None:
+                self.taint_manager.schedule(p.key(), node_name, deadline)
+
+    # --- the sync loop --------------------------------------------------------
 
     def sync_once(self) -> bool:
         changed = False
         now = self.clock()
         nodes, _ = self.store.list("Node")
+        pods: Optional[List[v1.Pod]] = None  # listed lazily, once per sync
+        by_node: Dict[str, List[v1.Pod]] = {}
+
+        def all_pods() -> List[v1.Pod]:
+            nonlocal pods
+            if pods is None:
+                pods = self.store.list("Pod")[0]
+                for p in pods:
+                    if p.spec.node_name:
+                        by_node.setdefault(p.spec.node_name, []).append(p)
+            return pods
+
+        def node_pods(name: str) -> List[v1.Pod]:
+            # one node-name index per sync: a 60-node outage must not
+            # rescan the whole pod list once per down node per sync
+            all_pods()
+            return by_node.get(name, [])
+
+        # 1. monitorNodeHealth: lease staleness → taint/untaint + queue/cancel
         for node in nodes:
-            lease = self.store.get("Lease", "kube-node-lease", node.metadata.name)
-            stale = lease is None or (now - lease.renew_time) > self.grace
-            tainted = any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
-            if stale and lease is not None and not tainted:
-                node.spec.taints.append(
-                    v1.Taint(key=UNREACHABLE_TAINT, effect=v1.TAINT_NO_EXECUTE)
-                )
+            name = node.metadata.name
+            lease = self.store.get("Lease", "kube-node-lease", name)
+            if lease is not None:
+                self._no_lease_since.pop(name, None)
+                stale = (now - lease.renew_time) > self.grace
+            else:
+                # a node whose lease never existed hasn't heartbeat yet —
+                # but the exemption is TIME-BOUNDED: grace anchors on this
+                # controller's first no-lease observation, so a node whose
+                # kubelet died before its first renewal is still detected
+                # (short-lived test fixtures stay untouched within grace)
+                first = self._no_lease_since.setdefault(name, now)
+                stale = (now - first) > self.grace
+            taint = next((t for t in node.spec.taints
+                          if t.key == UNREACHABLE_TAINT), None)
+            zone = self._zone_of(node)
+            if stale and taint is None:
+                node.spec.taints.append(self._unreachable_taint(now))
                 _set_condition(node, "Ready", "Unknown")
                 self.store.update("Node", node)
-                self._evict_pods(node.metadata.name)
                 changed = True
-            elif stale and tainted:
-                # retry PDB-refused evictions from earlier syncs: budget
-                # replenishes as replacements schedule, and a still-down
-                # node must eventually drain without ever violating a PDB
-                changed = self._evict_pods(node.metadata.name) or changed
-            elif not stale and tainted:
-                node.spec.taints = [
-                    t for t in node.spec.taints if t.key != UNREACHABLE_TAINT
-                ]
+                self._register_countdowns(name, now, node_pods(name))
+                # kill-point: the taint/condition write is durable in the
+                # store, the eviction sweep has NOT run — a successor must
+                # resume the sweep exactly-once from the taint alone
+                maybe_crash(CRASH_MID_ZONE_EVICT)
+                self._zone(zone).queue.add(name)
+            elif stale and taint is not None:
+                # ongoing outage (or a successor resuming after a crash):
+                # make sure the node is queued or draining and the
+                # countdowns exist — both re-registrations are idempotent,
+                # and deadlines anchor on the PERSISTED TimeAdded
+                if taint.time_added is None:
+                    # a taint persisted by pre-round-13 code (or written
+                    # externally) carries no anchor: backfill ONCE so the
+                    # countdown deadline stops sliding forward every sync
+                    # (and re-registration stays heap-idempotent)
+                    taint.time_added = now
+                    self.store.update("Node", node)
+                    changed = True
+                if name not in self._draining:
+                    self._zone(zone).queue.add(name)
+                self._register_countdowns(name, taint.time_added,
+                                           node_pods(name))
+            elif not stale and taint is not None and lease is not None:
+                # lease recovery: untaint, restore Ready, and CANCEL every
+                # pending eviction for the node — a flapping node must not
+                # churn workloads (the ISSUE-13 flap contract)
+                node.spec.taints = [t for t in node.spec.taints
+                                    if t.key != UNREACHABLE_TAINT]
                 _set_condition(node, "Ready", "True")
                 self.store.update("Node", node)
+                cancelled = self.taint_manager.cancel_node(name)
+                if self._zone(zone).queue.remove(name):
+                    cancelled += 1
+                self._draining.discard(name)
+                if cancelled:
+                    m.node_lifecycle_evictions.inc(
+                        (self.zone_mode(zone), "cancelled"), by=cancelled)
+                klog.V(2).info_s("Node lease recovered; untainted",
+                                 node=name, cancelled_evictions=cancelled)
                 changed = True
+
+        # 2. zoneStates: aggregate the just-written conditions, retune the
+        # per-zone limiters (Normal/Partial/Full)
+        self._compute_zone_states(nodes, now)
+
+        # 3. rate-limited node sweeps (zonePodEvictor pops)
+        node_zone = {n.metadata.name: self._zone_of(n) for n in nodes}
+        live = {n.metadata.name for n in nodes}
+        for gone in set(self._no_lease_since) - live:
+            del self._no_lease_since[gone]
+        swept_now: Set[str] = set()
+        for zone, z in self.zones.items():
+            if z.mode == ZONE_FULL:
+                continue  # frozen: a dark zone's queue holds
+            # purge queued nodes whose Node object was deleted BEFORE
+            # popping: a dead entry must not burn the zone's only token
+            # (100 s of secondary-rate delay for a no-op sweep)
+            for name in [n for n in z.queue._items if n not in live]:
+                z.queue.remove(name)
+            while True:
+                name = z.queue.try_pop(now)
+                if name is None:
+                    break
+                changed = self._sweep(name, zone, node_pods(name),
+                                      all_pods()) or changed
+                self._draining.add(name)
+                swept_now.add(name)
+
+        # 4. drain retries: swept nodes still down retry their refused
+        # evictions each sync (budget replenishes as replacements land) —
+        # no fresh tokens; the rate limit bounds NEW node sweeps only.
+        # Nodes whose FIRST sweep just ran in step 3 skip this sync's
+        # retry: a second pass at the same instant would hit the gate (and
+        # the eviction metrics) twice for the same refusals.
+        for name in sorted(self._draining):
+            if name not in live:
+                self._draining.discard(name)
+                continue
+            if name in swept_now:
+                continue
+            zone = node_zone.get(name, "")
+            if self.zone_mode(zone) == ZONE_FULL:
+                continue
+            changed = self._sweep(name, zone, node_pods(name),
+                                  all_pods()) or changed
+
+        # 5. taint-manager countdown expiries
+        for pod_key, node_name in self.taint_manager.due(now):
+            zone = node_zone.get(node_name, "")
+            mode = self.zone_mode(zone)
+            if mode == ZONE_FULL:
+                # frozen zone: defer, re-check next sync (deadline kept)
+                self.taint_manager.schedule(pod_key, node_name, now)
+                m.node_lifecycle_evictions.inc((mode, "deferred"))
+                continue
+            ns, _, pname = pod_key.partition("/")
+            pod = self.store.get("Pod", ns, pname)
+            if pod is None or pod.spec.node_name != node_name:
+                continue  # gone or rescheduled — nothing to evict
+            gk = self._gang_key(pod)
+            if gk is not None:
+                # a gang member may ONLY leave through the atomic repair:
+                # a deferred repair (a sibling's PDB refused) re-arms the
+                # countdown instead of falling through to a lone eviction
+                # — never a half-evicted gang
+                changed = self._repair_gang(gk, mode, all_pods()) or changed
+                if self.store.get("Pod", ns, pname) is not None:
+                    self.taint_manager.schedule(pod_key, node_name, now)
+                continue
+            result = self.evictions.evict(
+                pod, reason=f"toleration expired on unreachable node "
+                            f"{node_name}",
+                policy="nodelifecycle")
+            m.node_lifecycle_evictions.inc((mode, self._verdict(result)))
+            if not result.allowed:
+                # PDB-refused: keep the countdown live, retry next sync
+                self.taint_manager.schedule(pod_key, node_name, now)
+            changed = changed or result.evicted
+
+        # queue-depth gauges LAST so `ktpu nodehealth` sees post-sync truth
+        for zone, z in self.zones.items():
+            m.node_lifecycle_queue_depth.set(len(z.queue), (zone,))
         return changed
 
-    def _evict_pods(self, node_name: str) -> bool:
-        """NoExecute taint-manager eviction THROUGH the shared gate: pods
-        without a matching toleration are evicted (controllers recreate
-        them → rescheduled elsewhere), but a pod whose PodDisruptionBudget
-        is exhausted is refused and retried on a later sync — one not-ready
-        node can never zero out a protected workload in one pass."""
-        pods, _ = self.store.list("Pod")
+    @staticmethod
+    def _verdict(result) -> str:
+        if result.evicted:
+            return "evicted"
+        if not result.allowed:
+            return "refused"
+        if result.reason == "pod already gone":
+            return "missing"
+        return "error"
+
+    # --- the per-node eviction sweep ------------------------------------------
+
+    def _sweep(self, node_name: str, zone: str, pods: List[v1.Pod],
+               full_pods: List[v1.Pod]) -> bool:
+        """NoExecute eviction for one popped node: non-tolerating pods
+        evict through the shared gate NOW; forever-tolerations are skipped;
+        bounded tolerations ride the timed queue; bound gang members route
+        to the atomic whole-gang repair.  ``pods`` is the node's own pod
+        list (the per-sync index); ``full_pods`` the whole cluster's (gang
+        members live on other hosts too)."""
+        mode = self.zone_mode(zone)
         evicted = False
         pdbs = None
+        gang_keys: List[str] = []
+        seen_gangs: Set[str] = set()
         for p in pods:
             if p.spec.node_name != node_name:
                 continue
-            tolerated = any(
-                t.key in (UNREACHABLE_TAINT, "") and (
-                    t.operator == v1.TOLERATION_OP_EXISTS or not t.key
-                ) and t.toleration_seconds is None
-                for t in p.spec.tolerations
-            )
-            if not tolerated:
-                if pdbs is None:
-                    pdbs = self.store.list("PodDisruptionBudget")[0]
-                result = self.evictions.evict(
-                    p, reason=f"node {node_name} not ready",
-                    policy="nodelifecycle", pdbs=pdbs)
-                evicted = evicted or result.evicted
+            if self.store.get("Pod", p.namespace, p.metadata.name) is None:
+                continue  # evicted earlier this sync (gang repair overlap)
+            if self._tolerates_forever(p):
+                continue
+            if self.taint_manager.pending_on(p.key()):
+                continue  # bounded toleration: countdown owns the decision
+            gk = self._gang_key(p)
+            if gk is not None:
+                if gk not in seen_gangs:
+                    seen_gangs.add(gk)
+                    gang_keys.append(gk)
+                continue
+            if pdbs is None:
+                pdbs = self.store.list("PodDisruptionBudget")[0]
+            result = self.evictions.evict(
+                p, reason=f"node {node_name} not ready",
+                policy="nodelifecycle", pdbs=pdbs)
+            m.node_lifecycle_evictions.inc((mode, self._verdict(result)))
+            evicted = evicted or result.evicted
+        for gk in gang_keys:
+            evicted = self._repair_gang(gk, mode, full_pods) or evicted
         return evicted
+
+    # --- gang-aware slice repair ----------------------------------------------
+
+    def _gang_key(self, pod: v1.Pod) -> Optional[str]:
+        from ..gang import POD_GROUP_LABEL
+
+        name = pod.metadata.labels.get(POD_GROUP_LABEL)
+        if not name:
+            return None
+        if self.store.get("PodGroup", pod.namespace, name) is None:
+            return None  # labelled but groupless: plain pod semantics
+        return f"{pod.namespace}/{name}"
+
+    def _repair_gang(self, key: str, mode: str, pods: List[v1.Pod]) -> bool:
+        """Fail the WHOLE gang atomically: every store-bound member (on any
+        node, healthy hosts included — a gang missing one member makes no
+        progress) goes through the PDB gate all-or-nothing.  The pre-check
+        is AGGREGATE — each matching PDB must have budget for every member
+        it covers at once (per-member dry-runs can't see the shared
+        drain), so one exhausted budget defers the entire repair to a
+        later sync; nothing is half-evicted.  Exactly-once: the deletes
+        are the store's atomic pops, a repaired gang has no bound members
+        left to trigger a second repair, and ``gang_repairs_total`` counts
+        only a COMPLETED repair (a raced mid-loop refusal leaves the
+        remainder for the next sync's pass, which counts the one repair
+        when it finishes the job)."""
+        from ..gang import POD_GROUP_LABEL
+
+        ns, _, name = key.partition("/")
+        members = [
+            p for p in pods
+            if p.metadata.labels.get(POD_GROUP_LABEL) == name
+            and p.namespace == ns and p.spec.node_name
+            and self.store.get("Pod", p.namespace, p.metadata.name)
+            is not None
+        ]
+        if not members:
+            return False
+        pdbs = self.store.list("PodDisruptionBudget")[0]
+        demand: Dict[str, int] = {}
+        budget: Dict[str, int] = {}
+        for p in members:
+            for pdb in self.evictions.matching_pdbs(p, pdbs):
+                k = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+                demand[k] = demand.get(k, 0) + 1
+                budget[k] = pdb.disruptions_allowed
+        for k, need in sorted(demand.items()):
+            if need > budget[k]:
+                m.node_lifecycle_evictions.inc((mode, "refused"))
+                klog.V(2).info_s(
+                    "Gang repair deferred: PDB lacks budget for the "
+                    "whole gang", group=key, pdb=k, need=need,
+                    allowed=budget[k])
+                return False
+        evicted_any = False
+        complete = True
+        for p in members:
+            result = self.evictions.evict(
+                p, reason=f"gang {key} member lost its node",
+                policy="nodelifecycle", pdbs=pdbs)
+            m.node_lifecycle_evictions.inc((mode, self._verdict(result)))
+            evicted_any = evicted_any or result.evicted
+            if not result.evicted and self._verdict(result) != "missing":
+                complete = False  # raced refusal/fault: finish next sync
+        if not complete:
+            klog.V(2).info_s("Gang repair incomplete; remaining members "
+                             "retry next sync", group=key)
+            return evicted_any
+        if self.gangs is not None:
+            # directory hook FIRST: its _fail_group may write its own
+            # phase (Unschedulable for still-waiting members) — the
+            # controller's Pending write below is the final word, not a
+            # value the hook silently stomps
+            self.gangs.repair(key, "node lost; gang requeued by lifecycle")
+        pg = self.store.get("PodGroup", ns, name)
+        if pg is not None and pg.phase != v1.POD_GROUP_PENDING:
+            pg.phase = v1.POD_GROUP_PENDING
+            try:
+                self.store.update("PodGroup", pg)
+            except Exception as e:
+                # best-effort phase write, same contract as the directory's
+                klog.V(1).info_s("Gang repair phase write failed",
+                                 group=key,
+                                 error=f"{type(e).__name__}: {e}")
+        m.gang_repairs.inc()
+        klog.V(2).info_s("Gang repaired: all bound members evicted, "
+                         "group requeues whole", group=key,
+                         members=len(members))
+        return evicted_any

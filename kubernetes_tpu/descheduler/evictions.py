@@ -9,10 +9,11 @@ one disruption-controller resync interval cannot overshoot) or refuses with
 (controllers/disruption.py) replenishes budgets as replacements schedule.
 
 Callers in-tree:
-  - controllers/nodelifecycle.py — NoExecute taint eviction (refused pods
-    survive the sync and retry when budget replenishes; upstream's taint
-    manager deletes unconditionally — documented deviation, see ISSUE 5's
-    one-sync-zeroes-a-PDB bug),
+  - controllers/nodelifecycle.py — NoExecute eviction from the zone-queue
+    node sweeps, the tolerationSeconds timed queue, and atomic gang
+    repairs (refused pods survive the sweep and retry when budget
+    replenishes; upstream's taint manager deletes unconditionally —
+    documented deviation, see ISSUE 5's one-sync-zeroes-a-PDB bug),
   - scheduler preemption (_run_post_filter) — ``override_pdb=True``: the
     dry-run already *minimized* PDB violations in ranking, and upstream
     preemption may violate budgets as a last resort, so the gate records

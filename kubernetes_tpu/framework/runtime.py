@@ -122,6 +122,18 @@ def coupling_flags(batch, namespace_labels=None, info=None) -> CouplingFlags:
                          multi=info.multi)
 
 
+def live_nodes(snap):
+    """bool[N] schedulable universe: encoded (node_valid) AND Ready
+    (node_ready — the node-lifecycle condition mask).  Every feasibility
+    composition starts from this, so an in-flight cycle dispatched after
+    the lifecycle controller marked a host NotReady can't bind onto it —
+    the taint plane catches tolerating pods, this catches everything.
+    ``getattr`` fallback keeps hand-built snapshot stand-ins (tests,
+    stacked whatif forks) working without the plane."""
+    ready = getattr(snap, "node_ready", None)
+    return snap.node_valid if ready is None else snap.node_valid & ready
+
+
 class BatchedFramework:
     """Drives a fixed plugin list as fused tensor programs.
 
@@ -185,7 +197,7 @@ class BatchedFramework:
     # --- filter + score ------------------------------------------------------
 
     def run_filters(self, batch, snap, dyn, auxes):
-        mask = snap.node_valid[None, :] & batch.valid[:, None]
+        mask = live_nodes(snap)[None, :] & batch.valid[:, None]
         for pw, aux in zip(self.plugins, auxes):
             if hasattr(pw.plugin, "filter"):
                 mask = mask & pw.plugin.filter(batch, snap, dyn, aux)
@@ -236,7 +248,7 @@ class BatchedFramework:
             if hasattr(pw.plugin, "filter"):
                 mask = pw.plugin.filter(batch, snap, dyn, aux)
                 # plugins may return a broadcastable [1, N] plane
-                full = mask & snap.node_valid[None, :] & batch.valid[:, None]
+                full = mask & live_nodes(snap)[None, :] & batch.valid[:, None]
                 bits.append(jnp.any(full, axis=1))
         if not bits:
             return jnp.ones((b, 0), bool)
@@ -249,7 +261,7 @@ class BatchedFramework:
         computed ONCE per batch: the extender path then evaluates each pod as
         an O(N) row (compute_row) instead of recomputing the full [B, N]
         planes per pod — O(B·N) total where it was O(B²·N)."""
-        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_mask = live_nodes(snap)[None, :] & batch.valid[:, None]
         static_raw = []
         for pw, aux in zip(self.plugins, auxes):
             p = pw.plugin
@@ -329,7 +341,7 @@ class BatchedFramework:
         batch, auxes, dyn = jax.tree_util.tree_map(jnp.asarray, (batch, auxes, dyn))
 
         # --- static precompute (outside the scan) ----------------------------
-        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_mask = live_nodes(snap)[None, :] & batch.valid[:, None]
         static_raw: List = []  # (pw, raw_plane or None)
         for pw, aux in zip(self.plugins, auxes):
             p = pw.plugin
@@ -512,7 +524,7 @@ class BatchedFramework:
         order = order.astype(jnp.int32)
 
         # static planes once, as in greedy_assign's fast path
-        static_mask = snap.node_valid[None, :] & batch.valid[:, None]
+        static_mask = live_nodes(snap)[None, :] & batch.valid[:, None]
         static_raw: List = []
         for pw, aux in zip(self.plugins, auxes):
             p = pw.plugin
@@ -800,7 +812,7 @@ class BatchedFramework:
         kcand = min(b, n_cap)
 
         # static planes once, at CLASS granularity
-        static_mask = snap.node_valid[None, :] & rep_batch.valid[:, None]
+        static_mask = live_nodes(snap)[None, :] & rep_batch.valid[:, None]
         static_raw: List = []
         for pw, aux in zip(self.plugins, rep_auxes):
             p = pw.plugin

@@ -334,6 +334,28 @@ class GangDirectory:
                          group=key, reason=reason)
         self._set_phase(g, v1.POD_GROUP_UNSCHEDULABLE)
 
+    # --- node-lifecycle gang repair -------------------------------------------
+
+    def repair(self, key: str, reason: str) -> None:
+        """Lifecycle-controller hook (controllers/nodelifecycle.py): every
+        bound member of ``key`` was just evicted atomically because a host
+        died.  Reject still-waiting members NOW — their flush rollback
+        requeues them alongside the deleted members' replacements — instead
+        of waiting for the watch stream to deliver the deletes, and re-arm
+        the release edge-trigger so the re-formed gang counts one fresh
+        release.  Membership itself is corrected by the DELETED watch
+        events (the store is the source of truth, exactly once)."""
+        g = self._groups.get(key)
+        if g is None:
+            return
+        g.released = False
+        if g.waiting and not g.failing:
+            g.failing = True
+            try:
+                self._fail_group(key, g, reason or "rejected (gang repair)")
+            finally:
+                g.failing = False
+
     # --- PostBind -------------------------------------------------------------
 
     def on_bound(self, pod: v1.Pod, node_name: str) -> None:

@@ -238,6 +238,43 @@ cold_starts = default_registry.register(
             "Cold-start state reconstructions, by drift outcome")
 )
 
+# --- node lifecycle & partition tolerance (controllers/nodelifecycle.py) ------
+# Emitted at the real decision points: every zone-state recompute, every
+# eviction verdict the lifecycle controller receives from the shared gate
+# (plus the cancellations lease recovery performs), and each atomic
+# gang-slice repair — the series `ktpu nodehealth` renders.
+
+node_lifecycle_zone_state = default_registry.register(
+    # labels: (zone,) — 0 Normal | 1 PartialDisruption | 2 FullDisruption
+    # (controllers/nodelifecycle.ZONE_STATE_CODE); set on every sync for
+    # every zone with at least one node
+    Gauge("node_lifecycle_zone_state",
+          "Per-zone disruption state (0 Normal, 1 Partial, 2 Full)")
+)
+node_lifecycle_evictions = default_registry.register(
+    # labels: (mode, result) — mode is the node's ZONE state when the
+    # decision fired ("Normal" | "PartialDisruption" | "FullDisruption");
+    # result is the gate verdict ("evicted" | "refused" | "missing" |
+    # "error") plus two lifecycle-only outcomes: "cancelled" (lease
+    # recovery cancelled a pending timed eviction — the flap guard) and
+    # "deferred" (a due timed eviction held back by a frozen zone)
+    Counter("node_lifecycle_evictions_total",
+            "Node-lifecycle eviction decisions, by zone mode and result")
+)
+node_lifecycle_queue_depth = default_registry.register(
+    # labels: (zone,) — nodes waiting in the zone's rate-limited eviction
+    # queue at the end of the last sync (what `ktpu nodehealth` shows)
+    Gauge("node_lifecycle_eviction_queue_depth",
+          "Nodes pending in each zone's rate-limited eviction queue")
+)
+gang_repairs = default_registry.register(
+    # one increment per gang failed ATOMICALLY by the lifecycle controller
+    # (every bound member evicted through the gate in one pass) — the
+    # requeued-exactly-once probe counts these against rebinds
+    Counter("gang_repairs_total",
+            "Gangs atomically failed and requeued after a member's node died")
+)
+
 # --- descheduler subsystem (kubernetes_tpu/descheduler/) ---------------------
 # Emitted at the real decision points: every pod-killing path's verdict at
 # the shared eviction gate, each policy plan's end state in the controller

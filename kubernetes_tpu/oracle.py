@@ -541,6 +541,11 @@ class Oracle:
                 continue
             if not node_name_fits(pod, node):
                 continue
+            if not v1.node_is_ready(node):
+                # node-lifecycle mask: a NotReady host is out of the
+                # schedulable universe entirely (no toleration escape —
+                # matches the device path's node_valid & node_ready gate)
+                continue
             if not node_schedulable(pod, node):
                 continue
             if not node_affinity_fits(pod, node):

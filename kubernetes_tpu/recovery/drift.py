@@ -92,6 +92,7 @@ def canonical_state(scheduler) -> Dict[str, dict]:
             _canon_vec(enc.requested[row], enc.extended_index),
             tuple(int(v) for v in enc.non_zero_requested[row]),
             bool(enc.unschedulable[row]),
+            bool(enc.node_ready[row]),
             _canon_labels(enc, enc.node_label_keys[row],
                           enc.node_label_vals[row]),
             taints,

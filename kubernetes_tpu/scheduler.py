@@ -862,7 +862,9 @@ class TPUScheduler:
                 bits, res.node_row, res.rounds)
 
         def cand_mask(batch, dsnap, dyn, auxes, levels):
-            static_ok = dsnap.node_valid[None, :] & batch.valid[:, None]
+            from .framework.runtime import live_nodes
+
+            static_ok = live_nodes(dsnap)[None, :] & batch.valid[:, None]
             for pw, aux in zip(fw.plugins, auxes):
                 if pw.plugin.name in TPUScheduler._STATIC_PLUGINS and hasattr(
                     pw.plugin, "filter"

@@ -72,6 +72,12 @@ class HollowNode:
         """Stop heartbeating (simulated node death — chaos hook)."""
         self.alive = False
 
+    def recover(self) -> None:
+        """Resume heartbeating and renew the lease NOW (partition heal —
+        chaos hook; the lifecycle controller untaints on the next sync)."""
+        self.alive = True
+        self.heartbeat()
+
     # --- fake pod lifecycle ---------------------------------------------------
 
     def my_pods(self) -> List[v1.Pod]:

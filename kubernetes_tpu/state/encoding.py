@@ -103,6 +103,11 @@ class DeviceSnapshot:
     image_ids: jnp.ndarray  # i32[N, I]
     image_sizes: jnp.ndarray  # f32[N, I] bytes
     unschedulable: jnp.ndarray  # bool[N]
+    # Ready-condition mask (node lifecycle): False once the lifecycle
+    # controller marks Ready Unknown/False — feasibility composes
+    # node_valid & node_ready so an in-flight cycle can't bind onto a dead
+    # host even before the NoExecute taint plane is consulted
+    node_ready: jnp.ndarray  # bool[N]
     # scheduled pods
     pod_valid: jnp.ndarray  # bool[P]
     pod_node: jnp.ndarray  # i32[P] (-1 when unknown)
@@ -297,6 +302,9 @@ class ClusterEncoder:
         self.image_ids = np.full((n, cfg.image_cap), MISSING, dtype=np.int32)
         self.image_sizes = np.zeros((n, cfg.image_cap), dtype=np.float32)
         self.unschedulable = np.zeros(n, dtype=bool)
+        # ready defaults True: a free/unencoded row is gated by node_valid,
+        # and encode_node always rewrites the bit from live conditions
+        self.node_ready = np.ones(n, dtype=bool)
         self.pod_valid = np.zeros(p, dtype=bool)
         self.pod_node = np.full(p, MISSING, dtype=np.int32)
         self.pod_ns = np.full(p, MISSING, dtype=np.int32)
@@ -428,6 +436,7 @@ class ClusterEncoder:
         self.node_valid[row] = True
         self.node_name_ids[row] = self.dic.intern(name)
         self.unschedulable[row] = node.spec.unschedulable
+        self.node_ready[row] = v1.node_is_ready(node)
         self.allocatable[row] = self._resource_units(info.allocatable, ceil=False)
         self.requested[row] = self._resource_units(info.requested, ceil=True)
         # pods dimension of "requested" = live pod count
@@ -822,6 +831,7 @@ _NODE_ARRAYS = [
     "node_label_keys", "node_label_vals", "node_label_num", "node_topo",
     "taint_keys", "taint_vals",
     "taint_effects", "ports", "ports_ip", "image_ids", "image_sizes", "unschedulable",
+    "node_ready",
 ]
 _POD_ARRAYS = [
     "pod_valid", "pod_node", "pod_ns", "pod_label_keys", "pod_label_vals",

@@ -140,9 +140,12 @@ class PodWrapper:
     def toleration(
         self, key: str, value: str = "", effect: str = "",
         operator: str = v1.TOLERATION_OP_EQUAL,
+        toleration_seconds: Optional[int] = None,
     ) -> "PodWrapper":
         self._pod.spec.tolerations.append(
-            v1.Toleration(key=key, operator=operator, value=value, effect=effect)
+            v1.Toleration(key=key, operator=operator, value=value,
+                          effect=effect,
+                          toleration_seconds=toleration_seconds)
         )
         return self
 

@@ -1,0 +1,735 @@
+"""Partition-tolerant node lifecycle (ISSUE 13): zone-aware eviction
+storms, the tolerationSeconds taint manager, gang-aware slice repair, the
+NotReady encoder mask, the crash.mid_zone_evict kill-point, and the CLI
+nodehealth view.
+
+Reference behaviors exercised: nodelifecycle zoneStates + setLimiterInZone
+(node_lifecycle_controller.go), RateLimitedTimedQueue node pops,
+NoExecuteTaintManager tolerationSeconds countdowns anchored on
+Taint.TimeAdded (taint_manager.go), and the taint-based eviction loop of
+SURVEY §5 — plus this tree's documented deviation: per-zone FullDisruption
+FREEZES evictions (a dark zone is indistinguishable from a partition).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.chaos import (
+    CRASH_MID_ZONE_EVICT,
+    CRASH_POINTS,
+    FaultSchedule,
+    ProcessCrash,
+    crash_schedule,
+)
+from kubernetes_tpu.chaos.partition import PartitionDriver, run_node_storm
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.controllers.disruption import sync_pdbs
+from kubernetes_tpu.controllers.nodelifecycle import (
+    UNREACHABLE_TAINT,
+    ZONE_FULL,
+    ZONE_LABEL,
+    ZONE_NORMAL,
+    ZONE_PARTIAL,
+    NodeLifecycleController,
+    TokenBucket,
+)
+from kubernetes_tpu.gang import POD_GROUP_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.hollow_node import HollowCluster
+from kubernetes_tpu.sim.store import DELETED, ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _lease(store, node, renew_time, clock=None):
+    from kubernetes_tpu.client.leaderelection import Lease
+
+    lease = store.get("Lease", "kube-node-lease", node)
+    if lease is None:
+        lease = Lease(metadata=v1.ObjectMeta(name=node,
+                                             namespace="kube-node-lease"),
+                      renew_time=renew_time)
+        store.create("Lease", lease)
+    else:
+        lease.renew_time = renew_time
+        store.update("Lease", lease)
+
+
+def _mk_node(store, name, zone=None):
+    b = make_node().name(name).capacity({"cpu": "8", "pods": "32"})
+    if zone is not None:
+        b = b.label(ZONE_LABEL, zone)
+    store.create("Node", b.obj())
+
+
+def _mk_zone(store, zone, n, start=0):
+    names = [f"{zone}-n{start + i}" for i in range(n)]
+    for name in names:
+        _mk_node(store, name, zone=zone)
+        _lease(store, name, 0.0)
+    return names
+
+
+def _pod(name, node, labels=None, tol_seconds="absent"):
+    b = (make_pod().name(name).uid(name).namespace("default")
+         .req({"cpu": "1"}))
+    for k, val in (labels or {}).items():
+        b = b.label(k, val)
+    if tol_seconds != "absent":
+        b = b.toleration(key=UNREACHABLE_TAINT,
+                         operator=v1.TOLERATION_OP_EXISTS,
+                         effect="NoExecute",
+                         toleration_seconds=tol_seconds)
+    p = b.obj()
+    p.spec.node_name = node
+    return p
+
+
+def _deleted(store):
+    return [ev.obj.metadata.name for ev in store._log
+            if ev.kind == "Pod" and ev.type == DELETED]
+
+
+# --- token bucket / zone states -------------------------------------------------
+
+
+def test_token_bucket_rates_and_freeze():
+    clock = FakeClock()
+    tb = TokenBucket(qps=0.1, burst=1, clock=clock)
+    assert tb.try_take(clock())          # burst token
+    assert not tb.try_take(clock())      # drained
+    clock.advance(10.0)
+    assert tb.try_take(clock())          # refilled at 0.1/s
+    clock.advance(100.0)
+    tb.set_rate(0.0, clock())            # freeze zeroes the bank
+    assert not tb.try_take(clock())
+    tb.set_rate(0.1, clock())
+    clock.advance(10.0)
+    assert tb.try_take(clock())
+
+
+def test_zone_states_normal_partial_full():
+    clock = FakeClock()
+    store = ObjectStore()
+    # zone-a: 6 nodes, 4 down → PartialDisruption (0.67 ≥ 0.55, >2 down)
+    a = _mk_zone(store, "zone-a", 6)
+    # zone-b: 4 nodes, all down → FullDisruption
+    b = _mk_zone(store, "zone-b", 4)
+    # zone-c: 4 nodes, 1 down → Normal (not >2 down)
+    c = _mk_zone(store, "zone-c", 4)
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)
+    for name in a[:2] + c[1:]:
+        _lease(store, name, clock() - 1.0)
+    ctrl.sync_once()
+    assert ctrl.zone_mode("zone-a") == ZONE_PARTIAL
+    assert ctrl.zone_mode("zone-b") == ZONE_FULL
+    assert ctrl.zone_mode("zone-c") == ZONE_NORMAL
+    assert m.node_lifecycle_zone_state.value(("zone-a",)) == 1
+    assert m.node_lifecycle_zone_state.value(("zone-b",)) == 2
+    assert m.node_lifecycle_zone_state.value(("zone-c",)) == 0
+
+
+def test_never_heartbeat_node_detected_after_bounded_grace():
+    """A node that registers but whose kubelet dies before the FIRST
+    lease renewal must still be detected: grace anchors on the
+    controller's first no-lease observation, not exempted forever."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_node(store, "n0", zone="z")     # Node object, NO lease ever
+    store.create("Pod", _pod("p0", "n0"))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    ctrl.sync_once()                     # first observation at t=0
+    assert not store.get("Node", "", "n0").spec.taints  # within grace
+    clock.advance(50.0)
+    ctrl.sync_once()
+    node = store.get("Node", "", "n0")
+    assert any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+    assert store.get("Pod", "default", "p0") is None
+
+
+def test_tiny_zone_death_never_freezes():
+    """A 1-node 'zone' dying is plain node death: the basic elastic loop
+    (taint → evict → reschedule) must keep working, not freeze."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "solo", 1)
+    store.create("Pod", _pod("p0", "solo-n0"))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)
+    assert ctrl.sync_once()
+    assert ctrl.zone_mode("solo") == ZONE_NORMAL
+    assert store.get("Pod", "default", "p0") is None
+
+
+# --- tolerationSeconds taint manager (the ISSUE-13 bugfix) ----------------------
+
+
+def test_toleration_seconds_countdown_and_forever_regression():
+    """Regression pin for the seed bug: toleration_seconds != None used to
+    mean NOT tolerated (instant eviction).  Upstream semantics: unset
+    seconds → tolerate forever; seconds=N → survive N seconds from
+    Taint.TimeAdded, THEN evict."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 1)
+    store.create("Pod", _pod("instant", "z-n0"))                     # no toleration
+    store.create("Pod", _pod("forever", "z-n0", tol_seconds=None))   # unset = forever
+    store.create("Pod", _pod("timed", "z-n0", tol_seconds=30))       # countdown
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(50.0)  # lease stale at t=50; taint lands now
+    ctrl.sync_once()
+    node = store.get("Node", "", "z-n0")
+    taint = next(t for t in node.spec.taints if t.key == UNREACHABLE_TAINT)
+    assert taint.time_added == 50.0  # anchored for successor controllers
+    assert store.get("Pod", "default", "instant") is None   # swept now
+    assert store.get("Pod", "default", "forever") is not None
+    assert store.get("Pod", "default", "timed") is not None  # countdown live
+    clock.advance(20.0)  # t=70 < 50+30
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "timed") is not None
+    clock.advance(15.0)  # t=85 ≥ 80: countdown fired
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "timed") is None
+    assert store.get("Pod", "default", "forever") is not None  # forever holds
+    assert m.node_lifecycle_evictions.value((ZONE_NORMAL, "evicted")) >= 2
+
+
+def test_lease_recovery_untaints_and_cancels_pending_evictions():
+    """The flap contract: a node that comes back before its countdowns
+    fire is untainted and every queued eviction is CANCELLED — flapping
+    nodes stop churning workloads."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 1)
+    store.create("Pod", _pod("timed", "z-n0", tol_seconds=60))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    cancelled0 = m.node_lifecycle_evictions.value((ZONE_NORMAL, "cancelled"))
+    for flap in range(3):
+        clock.advance(50.0)           # stale → taint + countdown
+        ctrl.sync_once()
+        node = store.get("Node", "", "z-n0")
+        assert any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+        assert len(ctrl.taint_manager) == 1
+        _lease(store, "z-n0", clock())  # lease renews before the countdown
+        ctrl.sync_once()
+        node = store.get("Node", "", "z-n0")
+        assert not any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+        assert next(c["status"] for c in node.status.conditions
+                    if c["type"] == "Ready") == "True"
+        assert len(ctrl.taint_manager) == 0  # countdown cancelled
+    clock.advance(1000.0)
+    ctrl.sync_once()  # long after every abandoned deadline
+    assert store.get("Pod", "default", "timed") is not None  # never evicted
+    assert _deleted(store) == []
+    assert (m.node_lifecycle_evictions.value((ZONE_NORMAL, "cancelled"))
+            - cancelled0) >= 3
+
+
+def test_countdown_survives_controller_restart_without_reset():
+    """Deadlines anchor on the persisted Taint.TimeAdded: a successor
+    controller resumes the SAME countdown instead of granting a fresh
+    window."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 1)
+    store.create("Pod", _pod("timed", "z-n0", tol_seconds=100))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(50.0)
+    ctrl.sync_once()  # taint at t=50; deadline t=150
+    clock.advance(60.0)  # t=110: controller dies here
+    ctrl2 = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    ctrl2.sync_once()
+    assert store.get("Pod", "default", "timed") is not None
+    clock.advance(45.0)  # t=155 ≥ 150: the ORIGINAL deadline, not 110+100
+    ctrl2.sync_once()
+    assert store.get("Pod", "default", "timed") is None
+
+
+# --- disruption modes gate evictions --------------------------------------------
+
+
+def test_full_disruption_freezes_and_heals():
+    clock = FakeClock()
+    store = ObjectStore()
+    names = _mk_zone(store, "dark", 4)
+    for i, name in enumerate(names):
+        store.create("Pod", _pod(f"p{i}", name))
+        store.create("Pod", _pod(f"t{i}", name, tol_seconds=60))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(50.0)
+    ctrl.sync_once()
+    assert ctrl.zone_mode("dark") == ZONE_FULL
+    # hold the outage well past every countdown: still zero evictions
+    for _ in range(10):
+        clock.advance(60.0)
+        ctrl.sync_once()
+    assert _deleted(store) == []
+    assert m.node_lifecycle_evictions.value((ZONE_FULL, "deferred")) > 0
+    # heal: leases renew, taints drop, countdowns cancel, nothing evicted
+    for name in names:
+        _lease(store, name, clock())
+    ctrl.sync_once()
+    assert ctrl.zone_mode("dark") == ZONE_NORMAL
+    for name in names:
+        node = store.get("Node", "", name)
+        assert not any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+    assert len(ctrl.taint_manager) == 0
+    clock.advance(500.0)
+    ctrl.sync_once()
+    assert _deleted(store) == []
+
+
+def test_partial_disruption_sweeps_at_secondary_rate():
+    clock = FakeClock()
+    store = ObjectStore()
+    names = _mk_zone(store, "z", 8)
+    for i, name in enumerate(names):
+        store.create("Pod", _pod(f"p{i}", name))
+    ctrl = NodeLifecycleController(
+        store, grace_period=40.0, clock=clock,
+        secondary_eviction_qps=0.01, large_zone_threshold=4)
+    clock.advance(100.0)
+    survivors = names[5:]  # 5/8 down = 0.625 ≥ 0.55, >2 down → Partial
+    for name in survivors:
+        _lease(store, name, clock())
+    ctrl.sync_once()
+    assert ctrl.zone_mode("z") == ZONE_PARTIAL
+    # one banked burst token sweeps the first node immediately; then the
+    # secondary rate (0.01/s) meters the rest: +100s → exactly one more
+    assert len(ctrl.draining) == 1
+    for expected in (2, 3):
+        clock.advance(100.0)
+        for name in survivors:  # survivors keep heartbeating
+            _lease(store, name, clock())
+        ctrl.sync_once()
+        assert len(ctrl.draining) == expected
+    assert m.node_lifecycle_queue_depth.value(("z",)) == 2.0
+
+
+def test_pdb_refused_sweep_retries_without_tokens():
+    """The PR-5 contract carried into the zone machinery: refused pods
+    retry every sync as budget replenishes — no fresh tokens needed, and
+    the budget is never violated."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 1)
+    for i in range(3):
+        store.create("Pod", _pod(f"web-{i}", "z-n0", labels={"app": "web"}))
+    store.create("PodDisruptionBudget", v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="pdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"app": "web"}),
+        min_available=2))
+    sync_pdbs(store)
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(50.0)
+    ctrl.sync_once()
+    assert len(_deleted(store)) == 1  # one unit of budget, one eviction
+    # replacement lands elsewhere; budget replenishes; NO clock advance —
+    # the draining retry must not be gated on sweep tokens
+    store.create("Pod", _pod("web-new", "n-else", labels={"app": "web"}))
+    sync_pdbs(store)
+    ctrl.sync_once()
+    assert len(_deleted(store)) == 2
+
+
+# --- gang-aware slice repair ----------------------------------------------------
+
+
+def _mk_gang(store, name, nodes):
+    store.create("PodGroup", v1.PodGroup(
+        metadata=v1.ObjectMeta(name=name, namespace="default"),
+        min_member=len(nodes)))
+    for i, node in enumerate(nodes):
+        store.create("Pod", _pod(f"{name}-{i}", node,
+                                 labels={POD_GROUP_LABEL: name}))
+
+
+def test_gang_repair_fails_whole_gang_atomically():
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 3)
+    # gang spans all three nodes; a solo pod rides the healthy node
+    _mk_gang(store, "g0", ["z-n0", "z-n1", "z-n2"])
+    store.create("Pod", _pod("solo", "z-n1"))
+    repairs0 = m.gang_repairs.value()
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)
+    _lease(store, "z-n1", clock() - 1.0)
+    _lease(store, "z-n2", clock() - 1.0)
+    ctrl.sync_once()  # only z-n0 died
+    # the WHOLE gang is gone — members on healthy hosts included — the
+    # bystander solo pod is untouched, and the repair counted ONCE
+    for i in range(3):
+        assert store.get("Pod", "default", f"g0-{i}") is None
+    assert store.get("Pod", "default", "solo") is not None
+    assert m.gang_repairs.value() - repairs0 == 1
+    assert store.get("PodGroup", "default", "g0").phase == v1.POD_GROUP_PENDING
+    # later syncs find no bound members: exactly-once
+    clock.advance(100.0)
+    ctrl.sync_once()
+    assert m.gang_repairs.value() - repairs0 == 1
+
+
+def test_gang_repair_all_or_nothing_under_pdb():
+    """One PDB-refused member defers the ENTIRE repair — never a
+    half-evicted gang — and the repair completes when budget returns."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 2)
+    _mk_gang(store, "g0", ["z-n0", "z-n1"])
+    store.create("PodDisruptionBudget", v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="gpdb", namespace="default"),
+        selector=v1.LabelSelector(
+            match_expressions=[v1.LabelSelectorRequirement(
+                key=POD_GROUP_LABEL, operator=v1.OP_IN, values=["g0"])]),
+        min_available=2))
+    sync_pdbs(store)  # 2 healthy, floor 2 → zero budget
+    repairs0 = m.gang_repairs.value()
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)
+    _lease(store, "z-n1", clock() - 1.0)
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "g0-0") is not None  # deferred whole
+    assert store.get("Pod", "default", "g0-1") is not None
+    assert m.gang_repairs.value() == repairs0
+    # budget arrives (replacement capacity elsewhere): repair completes
+    pdb = store.get("PodDisruptionBudget", "default", "gpdb")
+    pdb.min_available = 0
+    store.update("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "g0-0") is None
+    assert store.get("Pod", "default", "g0-1") is None
+    assert m.gang_repairs.value() - repairs0 == 1
+
+
+def test_gang_repair_pdb_check_is_aggregate_not_per_member():
+    """A PDB shared by the whole gang must have budget for EVERY member at
+    once: per-member dry-runs each see the undrained budget and would
+    half-evict (budget 1, members 2) — the aggregate check defers whole."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 2)
+    _mk_gang(store, "g0", ["z-n0", "z-n1"])
+    store.create("PodDisruptionBudget", v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="gpdb", namespace="default"),
+        selector=v1.LabelSelector(
+            match_expressions=[v1.LabelSelectorRequirement(
+                key=POD_GROUP_LABEL, operator=v1.OP_IN, values=["g0"])]),
+        min_available=1))
+    sync_pdbs(store)  # 2 healthy, floor 1 → budget 1 < gang size 2
+    repairs0 = m.gang_repairs.value()
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)
+    _lease(store, "z-n1", clock())
+    ctrl.sync_once()
+    # budget covers one member but not both: NOTHING evicted
+    assert store.get("Pod", "default", "g0-0") is not None
+    assert store.get("Pod", "default", "g0-1") is not None
+    assert m.gang_repairs.value() == repairs0
+
+
+def test_expired_gang_member_countdown_never_lone_evicts():
+    """A gang member whose tolerationSeconds expires may only leave via
+    the atomic repair: while a sibling's PDB defers the repair, the
+    expired member survives too (countdown re-armed), and the whole gang
+    goes together once budget returns."""
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "z", 2)
+    store.create("PodGroup", v1.PodGroup(
+        metadata=v1.ObjectMeta(name="g0", namespace="default"),
+        min_member=2))
+    store.create("Pod", _pod("g0-0", "z-n0",
+                             labels={POD_GROUP_LABEL: "g0"},
+                             tol_seconds=30))
+    store.create("Pod", _pod("g0-1", "z-n1",
+                             labels={POD_GROUP_LABEL: "g0",
+                                     "protected": "yes"}))
+    store.create("PodDisruptionBudget", v1.PodDisruptionBudget(
+        metadata=v1.ObjectMeta(name="gpdb", namespace="default"),
+        selector=v1.LabelSelector(match_labels={"protected": "yes"}),
+        min_available=1))
+    sync_pdbs(store)  # g0-1's budget is zero → repair must defer
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(50.0)   # z-n0 stale → taint at t=50, countdown t=80
+    _lease(store, "z-n1", clock())
+    ctrl.sync_once()
+    clock.advance(50.0)   # t=100: countdown fired, repair deferred by PDB
+    _lease(store, "z-n1", clock())
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "g0-0") is not None  # NOT lone-evicted
+    assert store.get("Pod", "default", "g0-1") is not None
+    # budget returns: the re-armed countdown completes the atomic repair
+    pdb = store.get("PodDisruptionBudget", "default", "gpdb")
+    pdb.min_available = 0
+    store.update("PodDisruptionBudget", pdb)
+    sync_pdbs(store)
+    clock.advance(1.0)
+    _lease(store, "z-n1", clock())
+    ctrl.sync_once()
+    assert store.get("Pod", "default", "g0-0") is None
+    assert store.get("Pod", "default", "g0-1") is None
+
+
+# --- the scheduler-side mask -----------------------------------------------------
+
+
+def test_scheduler_never_binds_onto_notready_node():
+    """The encoder's node_ready plane: a host marked Ready=Unknown is out
+    of the feasibility universe even for pods that would TOLERATE its
+    taints (the in-flight-cycle guard)."""
+    store = ObjectStore()
+    _mk_node(store, "dead")
+    _mk_node(store, "alive")
+    dead = store.get("Node", "", "dead")
+    dead.status.conditions.append({"type": "Ready", "status": "Unknown"})
+    store.update("Node", dead)
+    sched = TPUScheduler(store, batch_size=4, batch_wait=0)
+    try:
+        for i in range(3):
+            p = (make_pod().name(f"p{i}").uid(f"p{i}").namespace("default")
+                 .req({"cpu": "1"})
+                 .toleration(key=UNREACHABLE_TAINT,
+                             operator=v1.TOLERATION_OP_EXISTS).obj())
+            store.create("Pod", p)
+        sched.run_until_idle(max_cycles=5)
+        pods, _ = store.list("Pod")
+        assert all(p.spec.node_name == "alive" for p in pods)
+        # recovery: condition back to True → host schedulable again
+        dead.status.conditions = [{"type": "Ready", "status": "True"}]
+        store.update("Node", dead)
+        # only the recovered host has 6 free CPUs left (alive holds 3×1cpu
+        # of its 8): rebinding there proves the mask lifted
+        store.create("Pod", make_pod().name("px").uid("px")
+                     .namespace("default").req({"cpu": "6"}).obj())
+        sched.run_until_idle(max_cycles=5)
+        assert store.get("Pod", "default", "px").spec.node_name == "dead"
+    finally:
+        sched.close(flush_events=False)
+
+
+# --- crash.mid_zone_evict kill-point ---------------------------------------------
+
+
+def test_mid_zone_evict_crash_successor_resumes_sweep_exactly_once():
+    """PR-8 catalog extension: the controller dies between the taint write
+    and the eviction sweep; a cold-started successor resumes the sweep
+    from store truth alone — every pod evicted exactly once, the workload
+    rescheduled exactly once."""
+    from kubernetes_tpu.recovery import cold_start
+
+    assert CRASH_MID_ZONE_EVICT in CRASH_POINTS
+    clock = FakeClock()
+    store = ObjectStore()
+    cluster = HollowCluster(store, 2, clock=clock, zones=2)
+    sched = TPUScheduler(store, batch_size=8, clock=clock)
+    desired = [f"p{i}" for i in range(4)]
+    for name in desired:
+        store.create("Pod", make_pod().name(name).uid(f"{name}/r0")
+                     .namespace("default").req({"cpu": "1"}).obj())
+    sched.run_until_idle(max_cycles=5)
+    victim = store.get("Pod", "default", "p0").spec.node_name
+    next(n for n in cluster.nodes if n.name == victim).fail()
+    survivor = next(n for n in cluster.nodes if n.name != victim)
+    clock.advance(50.0)
+    survivor.heartbeat()
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    fault = FaultSchedule(0, crash_points={CRASH_MID_ZONE_EVICT: 1})
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash) as ei:
+            ctrl.sync_once()
+    assert ei.value.point == CRASH_MID_ZONE_EVICT
+    # the taint write landed, the sweep did NOT run
+    node = store.get("Node", "", victim)
+    assert any(t.key == UNREACHABLE_TAINT for t in node.spec.taints)
+    assert _deleted(store) == []
+    sched.close(flush_events=False)
+    # successor: scheduler cold-starts from the store, a FRESH controller
+    # (fail-stop: no in-memory queue survives) resumes from the taint
+    res = cold_start(store, batch_size=8, clock=clock)
+    ctrl2 = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    for _ in range(4):
+        survivor.heartbeat()
+        ctrl2.sync_once()
+        # stand-in workload controller: recreate evicted pods by name
+        for name in desired:
+            if store.get("Pod", "default", name) is None:
+                store.create("Pod", make_pod().name(name).uid(f"{name}/r1")
+                             .namespace("default").req({"cpu": "1"}).obj())
+        res.scheduler.run_until_idle(max_cycles=5)
+    deleted = _deleted(store)
+    assert len(deleted) == len(set(deleted))  # each pod evicted ONCE
+    pods, _ = store.list("Pod")
+    assert len(pods) == 4
+    assert all(p.spec.node_name == survivor.name for p in pods)
+    res.scheduler.close(flush_events=False)
+
+
+# --- the storm soak (fast shape; 3×100 acceptance shape is slow/tools) -----------
+
+
+def test_node_storm_soak_fast_shape():
+    r = run_node_storm(nodes_per_zone=6, n_zones=3, seed=7, gang_size=3)
+    assert r.outage_zone_mode == "FullDisruption"
+    assert r.outage_evictions == 0          # dark zone: evictions frozen
+    assert r.cancelled_on_heal > 0          # heal cancelled the countdowns
+    assert r.scattered_zone_mode == "PartialDisruption"
+    assert r.scattered_swept <= r.scattered_budget  # secondary-rate bound
+    assert r.gang_repairs == 1              # repaired exactly once
+    assert all(c == 1 for c in r.gang_member_binds.values())
+    assert r.pdb_floor_held and r.overridden_evictions == 0
+    assert not r.unbound
+    assert r.converged
+
+
+def test_node_storm_soak_replays_deterministically():
+    a = run_node_storm(nodes_per_zone=4, n_zones=3, seed=11, gang_size=3)
+    b = run_node_storm(nodes_per_zone=4, n_zones=3, seed=11, gang_size=3)
+    assert a.determinism_signature() == b.determinism_signature()
+
+
+@pytest.mark.slow
+def test_node_storm_soak_acceptance_shape():
+    """The ISSUE-13 acceptance shape: 3 zones × 100 hollow nodes (also run
+    standalone via tools/node_storm_soak.py)."""
+    r = run_node_storm(nodes_per_zone=100, n_zones=3, seed=7,
+                       web_replicas=400, gang_size=8,
+                       large_zone_threshold=50)
+    assert r.converged, r
+
+
+# --- partition driver determinism -------------------------------------------------
+
+
+def test_partition_driver_pick_is_seed_deterministic():
+    clock = FakeClock()
+    store = ObjectStore()
+    cluster = HollowCluster(store, 12, clock=clock, zones=3)
+    d1 = PartitionDriver(cluster, FaultSchedule(3), clock=clock)
+    d2 = PartitionDriver(cluster, FaultSchedule(3), clock=clock)
+    names = d1.zone_nodes("zone-1")
+    assert d1.pick(names, 2) == d2.pick(list(reversed(names)), 2)
+    assert d1.pick(names, 2) != PartitionDriver(
+        cluster, FaultSchedule(4), clock=clock).pick(names, 2)
+
+
+def test_partition_driver_second_flap_set_keeps_earlier_phase():
+    """Registering a second flap set must not rephase the first: each
+    name's cycle anchors on its own registration time."""
+    clock = FakeClock()
+    store = ObjectStore()
+    cluster = HollowCluster(store, 2, clock=clock, zones=1)
+    driver = PartitionDriver(cluster, FaultSchedule(0), clock=clock)
+    a, b = cluster.nodes[0].name, cluster.nodes[1].name
+    driver.flap([a], down_seconds=30.0, up_seconds=30.0)
+    clock.advance(45.0)
+    driver.step()
+    assert cluster.nodes[0].alive  # a is mid-UP-phase
+    driver.flap([b], down_seconds=10.0, up_seconds=10.0)
+    assert cluster.nodes[0].alive  # a's phase unchanged by b's registration
+    assert not cluster.nodes[1].alive
+
+
+def test_partition_driver_flap_follows_injected_clock():
+    clock = FakeClock()
+    store = ObjectStore()
+    cluster = HollowCluster(store, 2, clock=clock, zones=1)
+    driver = PartitionDriver(cluster, FaultSchedule(0), clock=clock)
+    name = cluster.nodes[0].name
+    driver.flap([name], down_seconds=10.0, up_seconds=5.0)
+    assert not cluster.nodes[0].alive        # phase 0: down
+    clock.advance(12.0)
+    driver.step()
+    assert cluster.nodes[0].alive            # up window
+    clock.advance(5.0)
+    driver.step()
+    assert not cluster.nodes[0].alive        # next cycle's down window
+    assert cluster.nodes[1].alive            # bystander untouched
+
+
+# --- CLI: get nodes ZONE column + nodehealth --------------------------------------
+
+
+def test_cli_get_nodes_ready_zone_taints_columns():
+    store = ObjectStore()
+    _mk_node(store, "n0", zone="zone-a")
+    node = store.get("Node", "", "n0")
+    node.status.conditions.append({"type": "Ready", "status": "Unknown"})
+    node.spec.taints.append(v1.Taint(key=UNREACHABLE_TAINT,
+                                     effect=v1.TAINT_NO_EXECUTE))
+    store.update("Node", node)
+    out = Kubectl(store).get("nodes")
+    head, row = out.splitlines()[0], out.splitlines()[1]
+    for col in ("READY", "ZONE", "TAINTS"):
+        assert col in head
+    assert "Unknown" in row and "zone-a" in row
+    assert f"{UNREACHABLE_TAINT}:NoExecute" in row
+
+
+def test_cli_nodehealth_live_and_metrics_paths():
+    clock = FakeClock()
+    store = ObjectStore()
+    _mk_zone(store, "zone-a", 4)
+    store.create("Pod", _pod("p0", "zone-a-n0"))
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)  # whole (4-node) zone dark → FullDisruption
+    ctrl.sync_once()
+    k = Kubectl(store)
+    live = k.nodehealth(controller=ctrl)
+    assert "zone-a" in live and "FullDisruption" in live
+    assert "EVICTION-QUEUE" in live
+    # metrics path renders the same zone state from the emitted series
+    # (what `ktpu nodehealth --server` parses out of /metrics)
+    via_metrics = k.nodehealth()
+    assert "zone-a" in via_metrics and "FullDisruption" in via_metrics
+
+
+def test_cli_nodehealth_unlabeled_zone_survives_metrics_roundtrip():
+    """Nodes without a zone label aggregate under zone "" — whose label
+    value the text exposition drops; the --server parse path must still
+    show the zone's real state, not a default Normal."""
+    from kubernetes_tpu.metrics.registry import (
+        default_registry, parse_text, render_text)
+
+    clock = FakeClock()
+    store = ObjectStore()
+    for i in range(4):
+        _mk_node(store, f"n{i}")          # NO zone label
+        _lease(store, f"n{i}", 0.0)
+    ctrl = NodeLifecycleController(store, grace_period=40.0, clock=clock)
+    clock.advance(100.0)                   # all 4 dark → FullDisruption
+    ctrl.sync_once()
+    parsed = parse_text(render_text(default_registry))
+    out = Kubectl(store).nodehealth(metrics=parsed)
+    row = next(l for l in out.splitlines() if l.startswith("<none>"))
+    assert "FullDisruption" in row
+
+
+# --- serialization ----------------------------------------------------------------
+
+
+def test_taint_time_added_roundtrips():
+    from kubernetes_tpu.api.scheme import default_scheme
+    from kubernetes_tpu.api.serialize import roundtrips, to_manifest
+
+    scheme = default_scheme()
+    node = make_node().name("n0").obj()
+    node.spec.taints.append(v1.Taint(key=UNREACHABLE_TAINT,
+                                     effect=v1.TAINT_NO_EXECUTE,
+                                     time_added=123.5))
+    manifest = to_manifest(node, scheme)
+    assert manifest["spec"]["taints"][0]["timeAdded"] == 123.5
+    assert roundtrips(node, scheme)

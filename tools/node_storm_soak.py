@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Node-storm soak gate (ISSUE 13 acceptance shape): 3 zones × 100 hollow
+nodes on a fake clock — zone outage frozen (zero evictions under
+FullDisruption), scattered failures metered by the secondary rate, a
+downed gang repaired atomically and rebound exactly once, PDBs honored,
+and a same-seed replay reaching identical final bindings.
+
+Runs the same ``chaos.partition.run_node_storm`` definition as the tier-1
+fast shape (tests/test_node_lifecycle.py), so the gate and the battery can
+never drift apart.  Exit 0 = pass.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from kubernetes_tpu.chaos.partition import run_node_storm  # noqa: E402
+
+SHAPE = dict(nodes_per_zone=100, n_zones=3, seed=7,
+             web_replicas=400, gang_size=8, large_zone_threshold=50)
+
+
+def main() -> int:
+    a = run_node_storm(**SHAPE)
+    checks = {
+        "full_disruption_held": a.outage_zone_mode == "FullDisruption",
+        "zone_outage_zero_evictions": a.outage_evictions == 0,
+        "heal_cancelled_countdowns": a.cancelled_on_heal > 0,
+        "scattered_partial_mode": a.scattered_zone_mode == "PartialDisruption",
+        "scattered_rate_bounded":
+            a.scattered_swept <= a.scattered_budget,
+        "gang_repaired_once": a.gang_repairs == 1,
+        "gang_rebound_exactly_once":
+            all(c == 1 for c in a.gang_member_binds.values()),
+        "pdb_floor_held": a.pdb_floor_held,
+        "no_pdb_overrides": a.overridden_evictions == 0,
+        "all_bound": not a.unbound,
+    }
+    # determinism: the same seed must replay the same kill sequence to the
+    # same final bindings
+    b = run_node_storm(**SHAPE)
+    checks["deterministic_replay"] = (
+        a.determinism_signature() == b.determinism_signature())
+    report = {
+        "shape": SHAPE,
+        "nodes": a.nodes,
+        "pods": a.pods,
+        "kill_events": len(a.kill_log),
+        "scattered_swept": a.scattered_swept,
+        "scattered_budget": a.scattered_budget,
+        "cancelled_on_heal": a.cancelled_on_heal,
+        "wall_seconds": round(a.wall_seconds + b.wall_seconds, 2),
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,15 @@ JAX_PLATFORMS=cpu timeout 900 python -m pytest \
 # store-lock reads (the "millions of users" control-plane property)
 timeout 600 python tools/watch_soak.py \
   || { echo "FAILED: watch soak gate" >> suites_run.log; exit 1; }
+# node-storm gate (round 13): the partition-tolerant lifecycle battery
+# (zone states, tolerationSeconds taint manager, gang repair, the fast
+# storm shape) followed by the 3-zone × 100-node acceptance soak with a
+# same-seed determinism replay — an eviction storm that deletes a dark
+# zone's workloads (or rebinds a gang twice) invalidates every suite below
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_node_lifecycle.py -q -m 'not slow' \
+  || { echo "FAILED: node lifecycle test gate" >> suites_run.log; exit 1; }
+JAX_PLATFORMS=cpu timeout 900 python tools/node_storm_soak.py \
+  || { echo "FAILED: node storm soak gate" >> suites_run.log; exit 1; }
 # crash-restart gate: the kill-point battery + cold-start reconstruction +
 # the fast failover soak (leader killed at every registered crash point,
 # exactly-once binding, zero unrepaired drift) — perf numbers from a tree
