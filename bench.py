@@ -51,7 +51,12 @@ def run_named(suite: str, size: str, scale: float):
     t0 = time.perf_counter()
     items = run_workload(w)
     wall = time.perf_counter() - t0
-    return w, {i.labels["Metric"]: i.data for i in items}, wall
+    data = {i.labels["Metric"]: i.data for i in items}
+    # the Chrome-trace artifact path rides the item's labels, not its data
+    data["_trace_artifact"] = next(
+        (i.labels.get("TraceArtifact", "") for i in items
+         if i.labels.get("Metric") == "AttemptPhaseLatency"), "")
+    return w, data, wall
 
 
 def oracle_node_cap(n_nodes: int) -> int:
@@ -84,6 +89,28 @@ def oracle_per_pod_ms(n_nodes: int, sample: int) -> float:
     t0 = time.perf_counter()
     o.schedule_batch(pods, infos)
     return (time.perf_counter() - t0) / max(sample, 1) * 1e3
+
+
+def attempt_phase_block(data) -> dict:
+    """detail["attempt_phase_latency"] from the harness's
+    AttemptPhaseLatency item (per-pod span records): per-phase p50/p90/p99
+    in ms + the coverage ratio the run_suites.sh gate asserts."""
+    apl = data.get("AttemptPhaseLatency")
+    if not apl:
+        return {}
+    out = {"phases_ms": {}}
+    for ph in ("dispatch", "device", "bind", "queue_wait"):
+        out["phases_ms"][ph] = {
+            "p50": round(apl.get(f"{ph}_Perc50", 0.0) * 1e3, 3),
+            "p90": round(apl.get(f"{ph}_Perc90", 0.0) * 1e3, 3),
+            "p99": round(apl.get(f"{ph}_Perc99", 0.0) * 1e3, 3),
+        }
+    out["sum_p50_ms"] = round(apl.get("SumPerc50", 0.0) * 1e3, 3)
+    out["attempt_p50_ms"] = round(apl.get("AttemptPerc50", 0.0) * 1e3, 3)
+    out["coverage"] = round(apl.get("Coverage", 0.0), 4)
+    out["records"] = int(apl.get("Records", 0))
+    out["trace_artifact"] = data.get("_trace_artifact", "")
+    return out
 
 
 def main():
@@ -169,6 +196,13 @@ def main():
             # partition / dispatch / fetch / bind / snapshot / compile) —
             # makes a suite win or regression attributable to ITS phase
             "phase_wall_s": data.get("PhaseWallBreakdown", {}),
+            # per-phase ATTEMPT latency reconstructed from the span tracer's
+            # per-pod records (harness AttemptPhaseLatency): p50/p90/p99 per
+            # phase in ms, the sum-of-tiling-p50s vs the measured attempt
+            # p50 (coverage ~1.0 = no unattributed wall-clock), and the
+            # Perfetto-loadable Chrome-trace artifact path when
+            # KTPU_TRACE_DIR was set for the run
+            "attempt_phase_latency": attempt_phase_block(data),
             "wall_s": round(wall, 1),
             "baseline_note": (
                 "vs_baseline = mean per-pod algorithm time of the in-repo "

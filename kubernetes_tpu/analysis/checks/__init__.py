@@ -5,4 +5,5 @@ from . import exception_hygiene  # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import metrics_registration  # noqa: F401
 from . import recompile_hazard  # noqa: F401
+from . import span_catalog  # noqa: F401
 from . import trace_safety  # noqa: F401

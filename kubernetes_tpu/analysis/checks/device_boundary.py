@@ -49,9 +49,11 @@ from .trace_safety import _close_over_calls, _jit_roots, _seeded
 # list is the design's fetch surface, so growth here is a review event the
 # same way a suppression is.
 FETCH_BOUNDARIES: Tuple[Tuple[str, str, str], ...] = (
-    ("scheduler.py", "TPUScheduler._dispatch_batch._bg_fetch",
+    ("scheduler.py", "TPUScheduler._dispatch_batch_traced._bg_fetch",
      "THE packed decision-fetch: the background thread that owns the "
-     "device→host round so the cycle never blocks on it"),
+     "device→host round so the cycle never blocks on it (the body lives "
+     "in _dispatch_batch_traced since the round-14 span failure guard "
+     "split _dispatch_batch)"),
     ("scheduler.py", "TPUScheduler._complete",
      "decision-fetch join: normally consumes the background fetch's host "
      "copy; the blocking fallback is the documented degraded path"),

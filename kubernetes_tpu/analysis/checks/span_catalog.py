@@ -1,0 +1,106 @@
+"""span-catalog: every emitted span name is cataloged, every catalog entry
+is emitted.
+
+Guards the span-observability contract the same way metrics-registration
+guards series names: ``tracer.span("naem")`` with a typo'd or ad-hoc name
+would silently fork the span namespace — dashboards, the `ktpu trace`
+renderer, and the harness's attempt-record aggregation all key on the
+documented names.  The catalog is the ``SPAN_CATALOG`` frozenset literal in
+component_base/trace.py (mirrored into COMPONENTS.md §Observability; the
+doc sync is pinned by tests/test_trace.py).
+
+Rules:
+  unknown-span   ``X.span("name")`` whose literal name is not in
+                 SPAN_CATALOG
+  unused-span    a SPAN_CATALOG entry no scanned code ever emits (dead
+                 catalog entry, or the emit site was lost in a refactor)
+  dynamic-span   ``X.span(expr)`` with a non-literal first argument — span
+                 names must be static so the catalog stays checkable
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import Finding, ModuleInfo, Project
+from ..registry import Check, register_check
+
+TRACE_MODULE_SUFFIX = "component_base/trace.py"
+
+
+def _catalog_names(mod: ModuleInfo) -> Optional[Set[str]]:
+    """String literals of the module-level SPAN_CATALOG assignment."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "SPAN_CATALOG":
+            names = {
+                c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+            return names
+    return None
+
+
+def _span_calls(mod: ModuleInfo):
+    """(node, literal-or-None) for every ``<expr>.span(...)`` call.  The
+    receiver is unconstrained on purpose — the tracer travels under many
+    names (self.tracer, api.tracer, a closure capture) and no other API in
+    the scanned tree spells ``.span(``."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "span":
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                yield node, node.args[0].value
+            else:
+                yield node, None
+
+
+@register_check
+class SpanCatalogCheck(Check):
+    name = "span-catalog"
+    description = ("emitted tracer.span() names are static literals in "
+                   "SPAN_CATALOG; catalog entries are all emitted")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        trace_mod = project.find(TRACE_MODULE_SUFFIX)
+        if trace_mod is None:
+            return []
+        catalog = _catalog_names(trace_mod)
+        if catalog is None:
+            return []
+        findings: List[Finding] = []
+        used: Set[str] = set()
+        for mod in project.modules:
+            if mod is trace_mod:
+                continue  # the tracer's own plumbing defines, not emits
+            for node, name in _span_calls(mod):
+                if name is None:
+                    findings.append(mod.finding(
+                        self.name, "dynamic-span", node,
+                        "span name is not a string literal — the catalog "
+                        "(and every consumer keyed on span names) cannot "
+                        "check a dynamic name"))
+                    continue
+                used.add(name)
+                if name not in catalog:
+                    findings.append(mod.finding(
+                        self.name, "unknown-span", node,
+                        f"span `{name}` is not in SPAN_CATALOG "
+                        f"(component_base/trace.py) — add it there AND to "
+                        f"the COMPONENTS.md span catalog, or fix the typo"))
+        for name in sorted(catalog - used):
+            for node in trace_mod.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        node.targets[0].id == "SPAN_CATALOG":
+                    findings.append(trace_mod.finding(
+                        self.name, "unused-span", node,
+                        f"span `{name}` is cataloged but no scanned code "
+                        f"emits it — dead catalog entry or a lost emit "
+                        f"site"))
+                    break
+        return findings

@@ -56,13 +56,18 @@ class _Waiter:
 
 class _Seat:
     """A held inflight seat; ``release`` is idempotent (the handler's
-    finally always runs it, and the watch path releases early)."""
+    finally always runs it, and the watch path releases early).
+    ``waited`` is the fair-queue wait this seat paid before being granted
+    (0.0 on the uncontended fast path) — the apiserver's ``apf_wait`` span
+    and any queue-latency observability read it off the seat instead of
+    re-timing the admit call."""
 
-    __slots__ = ("_gate", "_released")
+    __slots__ = ("_gate", "_released", "waited")
 
-    def __init__(self, gate: "_InflightGate"):
+    def __init__(self, gate: "_InflightGate", waited: float = 0.0):
         self._gate = gate
         self._released = False
+        self.waited = waited
 
     def release(self) -> None:
         if not self._released:
@@ -113,12 +118,16 @@ class _InflightGate:
                 q = self._queues[user] = deque()
             q.append(w)
             self._queued_total += 1
+        import time as _time
+
+        t_q = _time.monotonic()
         if w.event.wait(self.queue_timeout):
-            return _Seat(self)  # seat handed over by a releaser
+            # seat handed over by a releaser; carry the queue wait out
+            return _Seat(self, waited=_time.monotonic() - t_q)
         with self._lock:
             if w.granted:
                 # granted exactly at the deadline: the seat is ours
-                return _Seat(self)
+                return _Seat(self, waited=_time.monotonic() - t_q)
             q = self._queues.get(user)
             if q is not None:
                 try:

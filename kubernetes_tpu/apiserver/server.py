@@ -174,6 +174,7 @@ class APIServer:
         readyz=None,
         watch_cache="auto",
         flow_control="auto",
+        tracer=None,
     ):
         self.store = store
         # readiness source (component_base.healthz.Readyz or None): when
@@ -230,6 +231,14 @@ class APIServer:
             self.flow: Optional[FlowController] = FlowController()
         else:
             self.flow = flow_control or None
+        # span tracer (component_base/trace.py): one apiserver_request span
+        # per resource request with an apf_wait child when the flow-control
+        # queue actually held it.  Health/discovery/metrics probes are not
+        # spanned (they are exempt from flow control for the same reason).
+        # NOOP by default: a disabled tracer costs one attribute read.
+        from ..component_base.trace import NOOP_TRACER
+
+        self.tracer = tracer or NOOP_TRACER
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -344,12 +353,14 @@ def _make_handler(api: APIServer):
 
         # --- flow control (apiserver/flowcontrol.py) ------------------------
 
-        def _flow_admit(self, mutating: bool) -> bool:
+        def _flow_admit(self, mutating: bool, span=None) -> bool:
             """Acquire an inflight seat (APF position: before authn, after
             routing — shedding must stay cheap under flood).  False when
             the request was already answered 429 + Retry-After.  Fairness
             is keyed by the cheap header identity; the full authn chain
-            still runs afterwards as before."""
+            still runs afterwards as before.  ``span`` is the enclosing
+            apiserver_request span: a seat that actually queued gets a
+            retroactive apf_wait child covering its fair-queue wait."""
             self._flow_seat = None
             if api.flow is None:
                 return True
@@ -357,11 +368,26 @@ def _make_handler(api: APIServer):
             try:
                 self._flow_seat = api.flow.admit(user, mutating=mutating)
             except RequestRejected as e:
+                if span is not None:
+                    span.set(rejected=e.reason)
                 self._status_err(
                     429, "TooManyRequests", str(e),
                     headers=(("Retry-After", f"{e.retry_after:.3f}"),))
                 return False
+            waited = self._flow_seat.waited
+            if span is not None and waited > 0:
+                now = api.tracer.clock()
+                api.tracer.span("apf_wait", parent=span, start=now - waited,
+                                user=user).finish(end=now)
             return True
+
+        def _req_span(self, verb: str):
+            """apiserver_request span for one resource request; None when
+            the tracer is disabled (the constant-false guard)."""
+            if not api.tracer.enabled:
+                return None
+            return api.tracer.span("apiserver_request", verb=verb,
+                                   path=self.path)
 
         def _flow_release(self):
             seat = getattr(self, "_flow_seat", None)
@@ -434,12 +460,17 @@ def _make_handler(api: APIServer):
                             "/metrics"):
                 self._nonresource(url)
                 return
-            if not self._flow_admit(mutating=False):
-                return
+            span = self._req_span("get")
             try:
-                self._get_resource(url)
+                if not self._flow_admit(mutating=False, span=span):
+                    return
+                try:
+                    self._get_resource(url)
+                finally:
+                    self._flow_release()
             finally:
-                self._flow_release()
+                if span is not None:
+                    span.finish()
 
         def _nonresource(self, url):
             if url.path in ("/healthz", "/readyz", "/livez"):
@@ -676,37 +707,32 @@ def _make_handler(api: APIServer):
             finally:
                 unwatch()
 
-        def do_POST(self):
-            if not self._flow_admit(mutating=True):
-                return
+        def _mutating(self, verb: str, body_fn) -> None:
+            """Shared wrapper for the write verbs: request span →
+            flow-control admit → handler → release/finish."""
+            span = self._req_span(verb)
             try:
-                self._post()
+                if not self._flow_admit(mutating=True, span=span):
+                    return
+                try:
+                    body_fn()
+                finally:
+                    self._flow_release()
             finally:
-                self._flow_release()
+                if span is not None:
+                    span.finish()
+
+        def do_POST(self):
+            self._mutating("post", self._post)
 
         def do_PUT(self):
-            if not self._flow_admit(mutating=True):
-                return
-            try:
-                self._put()
-            finally:
-                self._flow_release()
+            self._mutating("put", self._put)
 
         def do_PATCH(self):
-            if not self._flow_admit(mutating=True):
-                return
-            try:
-                self._patch()
-            finally:
-                self._flow_release()
+            self._mutating("patch", self._patch)
 
         def do_DELETE(self):
-            if not self._flow_admit(mutating=True):
-                return
-            try:
-                self._delete()
-            finally:
-                self._flow_release()
+            self._mutating("delete", self._delete)
 
         def _post(self):
             url = urlparse(self.path)

@@ -94,7 +94,23 @@ class RetryingStore:
     def delete(self, kind: str, namespace: str, name: str):
         return self._retry(lambda: self._store.delete(kind, namespace, name))
 
-    def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
+    def bind_pod(self, namespace: str, name: str, node_name: str,
+                 trace_parent=None) -> bool:
+        # span-context handoff forwarded (sim/store.py bind_pod) — but the
+        # scheduler probes THIS wrapper's signature, so forward only when
+        # the wrapped store itself takes the kwarg (an HTTP facade does
+        # not; blindly forwarding would TypeError every bind into the
+        # transient-retry path forever)
+        takes = getattr(self, "_bind_takes_trace", None)
+        if takes is None:
+            from ..utils import takes_kwarg
+
+            takes = self._bind_takes_trace = takes_kwarg(
+                self._store.bind_pod, "trace_parent")
+        if takes:
+            return self._retry(
+                lambda: self._store.bind_pod(namespace, name, node_name,
+                                             trace_parent=trace_parent))
         return self._retry(
             lambda: self._store.bind_pod(namespace, name, node_name))
 

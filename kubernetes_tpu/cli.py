@@ -519,6 +519,97 @@ class Kubectl:
                     f"{totals[lab]:g}")
         return out
 
+    # --- span-trace / SLO observatory ------------------------------------------
+
+    def trace_dump(self, exporter=None, last: int = 8,
+                   max_pods_per_tree: int = 12) -> str:
+        """``ktpu trace``: the last N attempt span trees from an in-process
+        ``InMemoryExporter`` (the scheduler tracer's ring), each rendered
+        with per-span offsets/durations plus the per-pod phase records the
+        attempt root carries.  Spans are in-memory only — there is no
+        --server form; wire the exporter in-process (the perf harness and
+        tests do)."""
+        if exporter is None:
+            return ("no in-process span exporter wired: construct the "
+                    "scheduler with tracer=Tracer(exporters="
+                    "[InMemoryExporter()]) and pass that exporter here")
+        from .component_base.trace import render_tree
+
+        trees = exporter.trees(last=last, root_name="attempt")
+        if not trees:
+            return "no attempt spans recorded"
+        out: List[str] = []
+        for root, children in trees:
+            # trees() already built the children index once for the whole
+            # ring — reuse it instead of re-deriving per root
+            out.append(render_tree(root, children=children))
+            recs = root.attrs.get("pod_phases") or []
+            for r in recs[:max_pods_per_tree]:
+                out.append(
+                    f"    pod {r['pod']}: dispatch {r['dispatch'] * 1e3:.1f}ms"
+                    f" device {r['device'] * 1e3:.1f}ms"
+                    f" bind {r['bind'] * 1e3:.1f}ms"
+                    f" total {r['total'] * 1e3:.1f}ms ({r['outcome']})")
+            if len(recs) > max_pods_per_tree:
+                out.append(f"    … {len(recs) - max_pods_per_tree} more pods")
+        return "\n".join(out)
+
+    _ATTEMPT_HIST = "scheduler_scheduling_attempt_duration_seconds"
+    _PHASE_HIST = "scheduler_attempt_phase_duration_seconds"
+
+    def slo(self, metrics=None) -> str:
+        """``ktpu slo``: current p50/p90/p99 per attempt phase from the
+        live ``scheduler_attempt_phase_duration_seconds`` histograms, or —
+        with ``metrics`` (the --server path: /metrics fed through
+        ``registry.parse_text``) — recomputed from the bucket exposition.
+        The footer compares the sum of the attempt-tiling phase p50s
+        (dispatch+device+bind) against the end-to-end attempt p50: a gap
+        means unattributed wall-clock."""
+        rows = [["PHASE", "P50-MS", "P90-MS", "P99-MS", "COUNT"]]
+        p50 = {}
+        attempt_p50 = attempt_n = 0.0
+        if metrics is None:
+            from .metrics import scheduler_metrics as m
+
+            h = m.attempt_phase_duration
+            for labels in sorted(h._counts):
+                phase = labels[0] if labels else "?"
+                p50[phase] = h.quantile(0.50, labels)
+                rows.append([phase, f"{p50[phase] * 1e3:.3f}",
+                             f"{h.quantile(0.90, labels) * 1e3:.3f}",
+                             f"{h.quantile(0.99, labels) * 1e3:.3f}",
+                             str(h.count(labels))])
+            ah = m.scheduling_attempt_duration
+            attempt_p50, attempt_n = ah.quantile(0.50), ah.count()
+        else:
+            from .metrics.registry import (bucket_counts_from_series,
+                                           quantile_from_counts)
+
+            per = bucket_counts_from_series(metrics, self._PHASE_HIST)
+            for labels in sorted(per):
+                uppers, counts = per[labels]
+                phase = labels[0] if labels else "?"
+                p50[phase] = quantile_from_counts(uppers, counts, 0.50)
+                rows.append([phase, f"{p50[phase] * 1e3:.3f}",
+                             f"{quantile_from_counts(uppers, counts, 0.90) * 1e3:.3f}",
+                             f"{quantile_from_counts(uppers, counts, 0.99) * 1e3:.3f}",
+                             str(sum(counts))])
+            att = bucket_counts_from_series(metrics, self._ATTEMPT_HIST)
+            if () in att:
+                uppers, counts = att[()]
+                attempt_p50 = quantile_from_counts(uppers, counts, 0.50)
+                attempt_n = sum(counts)
+        if len(rows) == 1:
+            return "no attempt-phase observations recorded"
+        out = _render_table(rows)
+        tiling = sum(p50.get(k, 0.0) for k in ("dispatch", "device", "bind"))
+        out += (f"\nattempt p50: {attempt_p50 * 1e3:.3f}ms over "
+                f"{attempt_n:g} attempts; "
+                f"sum of tiling-phase p50s: {tiling * 1e3:.3f}ms")
+        if attempt_p50 > 0:
+            out += f" (coverage {tiling / attempt_p50:.2f}x)"
+        return out
+
     # --- control-plane durability / flow-control view --------------------------
 
     def controlplane_status(self, wal=None, watch_cache=None, flow=None,
@@ -696,6 +787,15 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     sub.add_parser("nodehealth")
     sub.add_parser("topology")
     sub.add_parser("readyz")
+    p = sub.add_parser(
+        "trace",
+        help="dump attempt span trees (IN-PROCESS only: spans live in the "
+             "scheduler process's InMemoryExporter — call "
+             "Kubectl.trace_dump(exporter) there; the shell form prints "
+             "the wiring hint; for remote quantiles use `slo --server`)")
+    p.add_argument("-l", "--last", type=int, default=8,
+                   help="how many attempt span trees to dump")
+    sub.add_parser("slo")
     for verb in ("cordon", "uncordon"):
         p = sub.add_parser(verb)
         p.add_argument("node")
@@ -761,6 +861,20 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                 print(k.nodehealth(metrics=parse_text(r.read().decode())))
         else:
             print(k.nodehealth())
+    elif args.verb == "trace":
+        print(k.trace_dump(last=args.last))
+    elif args.verb == "slo":
+        if args.server:
+            # the scheduler process serving /metrics carries the
+            # attempt-phase bucket exposition; quantiles recompute here
+            import urllib.request
+
+            from .metrics.registry import parse_text
+
+            with urllib.request.urlopen(f"{args.server}/metrics") as r:
+                print(k.slo(metrics=parse_text(r.read().decode())))
+        else:
+            print(k.slo())
     elif args.verb == "topology":
         print(k.topology())
     elif args.verb == "readyz":

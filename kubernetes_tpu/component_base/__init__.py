@@ -3,4 +3,13 @@
 from .featuregate import FeatureGate, default_feature_gate  # noqa: F401
 from .healthz import Healthz, Readyz  # noqa: F401
 from .configz import Configz  # noqa: F401
-from .trace import Trace  # noqa: F401
+from .trace import (  # noqa: F401
+    NOOP_TRACER,
+    ChromeTraceExporter,
+    InMemoryExporter,
+    Span,
+    SpanContext,
+    ThresholdLogExporter,
+    Trace,
+    Tracer,
+)

@@ -1,16 +1,434 @@
-"""Step tracing (reference: k8s.io/utils/trace as used in the scheduling hot
-path — schedulePod creates a trace and logs if >100ms, scheduler.go:775-816;
-plus a hook into the JAX profiler as the OTel analog)."""
+"""Span tracing + legacy step tracing.
+
+Reference: k8s.io/utils/trace as used in the scheduling hot path (schedulePod
+creates a utiltrace and logs if >100ms, scheduler.go:775-816) layered under
+component-base/tracing (the OTel TracerProvider wiring, apiserver and
+scheduler --tracing-config) — this module is both layers' analog:
+
+  - ``Trace`` keeps the utiltrace step-trace semantics (named steps,
+    log_if_long) the scheduler hot path has always used;
+  - ``Tracer``/``Span`` is the OTel-shaped span layer: parent links,
+    attributes, timestamped events, an injected clock (deterministic in
+    tests), and pluggable exporters — an in-memory ring
+    (``InMemoryExporter``: tests + ``ktpu trace``), Chrome trace-event
+    JSONL (``ChromeTraceExporter``: one artifact per perf-suite run,
+    loadable in Perfetto/chrome://tracing), and the log_if_long behavior
+    generalized (``ThresholdLogExporter``).
+
+Overhead policy (the hard constraint the scheduler instrumentation relies
+on): the module-level ``NOOP_TRACER`` has ``enabled = False`` and its
+``span()`` returns one shared ``_NoopSpan`` whose methods do nothing — hot
+paths guard every span build behind ``if tracer.enabled:`` so a disabled
+tracer costs one attribute read per guard (measured in
+tools/bench_trace_overhead.py; gated < 1% of per-pod cost).  Spans are
+emitted ONLY off the jitted paths: they bracket dispatch/fetch boundaries,
+never traced code — emitting from inside a jit would either fail tracing or
+record trace-time, not run-time.
+
+Cross-thread context: a ``SpanContext`` is an explicit value handed through
+the pipeline seams (``_InFlight.span_ctx`` → bg-fetch thread → async
+extender walk → ``_complete`` → bind phase) — never a thread-local, so the
+deep-pipelined scheduler's spans keep their parent links across threads.
+
+SPAN_CATALOG is the closed set of span names this codebase may emit; the
+``span-catalog`` static check (analysis/checks/span_catalog.py) fails
+tools/analyze.py on any ``tracer.span("name")`` literal outside it and on
+any catalog entry no code emits.  The same list is documented in
+COMPONENTS.md §Observability (kept in sync by tests/test_trace.py).
+"""
 
 from __future__ import annotations
 
 import contextlib
+import itertools
+import json
 import logging
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 log = logging.getLogger("kubernetes_tpu.trace")
+
+# The closed span-name catalog (see module docstring).  Grouped by layer:
+# scheduler attempt tree, control-plane (store/WAL), apiserver request.
+SPAN_CATALOG = frozenset({
+    # per dispatched batch: the attempt tree root + its phases
+    "attempt",          # root: one scheduling attempt (one dispatched batch)
+    "queue_wait",       # earliest queue entry -> dispatch pop (per batch)
+    "dispatch",         # host dispatch work (t0 -> device program enqueued)
+    "snapshot",         # cache.update_snapshot + encoder.sync
+    "compile",          # PodBatchCompiler.compile (batch staging, not XLA)
+    "host_prepare",     # framework host_prepare (PreFilter/PreScore analog)
+    "device_enqueue",   # fused-program dispatch (enqueue only, no fetch)
+    "device_wait",      # program enqueue -> decisions host-side (bg fetch)
+    "extender_rounds",  # the extender round walk (callouts + ledger)
+    "complete",         # fetch join + cache assumes (_complete)
+    "bind_phase",       # the batch's binding cycle (reserve/permit/bind)
+    "bind",             # one pod's reserve->bind segment
+    "permit_wait",      # a gang member's Permit hold (held binding cycle)
+    # control plane
+    "wal_append",       # one WAL record append (durable-before-visible)
+    "wal_fsync",        # WAL fsync (cadence or explicit)
+    "apiserver_request",  # one HTTP resource request, routing -> response
+    "apf_wait",         # flow-control queue wait before a seat was granted
+})
+
+
+class SpanContext:
+    """The explicit cross-thread handoff value: identifies a span without
+    holding it (the child end of a parent link)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __repr__(self):
+        return f"SpanContext({self.trace_id}, {self.span_id})"
+
+
+class Span:
+    """One timed operation.  Created by ``Tracer.span``; ``finish()`` (or
+    context-manager exit) stamps the end and hands it to the exporters."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "attrs", "events", "thread", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 span_id: int, parent_id: Optional[int], start: float,
+                 attrs: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end: Optional[float] = None
+        self.attrs: Dict[str, object] = attrs or {}
+        self.events: List = []  # (name, at, attrs)
+        self.thread = threading.get_ident()
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def event(self, name: str, **attrs) -> None:
+        self.events.append((name, self._tracer.clock(), attrs))
+
+    def duration(self) -> float:
+        return (self.end if self.end is not None
+                else self._tracer.clock()) - self.start
+
+    def finish(self, end: Optional[float] = None) -> None:
+        if self.end is not None:
+            return  # idempotent — a finally and an explicit finish may race
+        self.end = self._tracer.clock() if end is None else end
+        self._tracer._export(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+class _NoopSpan:
+    """The shared disabled span: every method is a no-op, so instrumented
+    code may call through unconditionally on paths that are cheap anyway;
+    hot paths should guard on ``tracer.enabled`` instead and skip even the
+    call."""
+
+    __slots__ = ()
+    enabled = False
+    name = ""
+    attrs: Dict[str, object] = {}
+
+    def context(self) -> Optional[SpanContext]:
+        return None
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def duration(self) -> float:
+        return 0.0
+
+    def finish(self, end: Optional[float] = None) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Span factory + exporter fan-out.  ``clock`` is injected (tests pass
+    a fake; the scheduler passes its own clock so spans and metrics share a
+    timeline).  ``enabled`` is the constant hot-path guard — a Tracer built
+    with ``enabled=False`` (or ``NOOP_TRACER``) never allocates a Span."""
+
+    def __init__(self, clock=time.perf_counter, exporters=(),
+                 enabled: bool = True):
+        self.clock = clock
+        self.exporters: List = list(exporters)
+        self.enabled = enabled
+        self._ids = itertools.count(1)
+
+    def span(self, name: str, parent=None, start: Optional[float] = None,
+             **attrs):
+        """Open a span.  ``parent`` is a Span, a SpanContext (the explicit
+        cross-thread handoff), or None (a new root/trace); ``start`` backdates
+        the span to an already-taken clock stamp (retroactive spans around
+        existing stamps cost nothing on the timed path itself)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        span_id = next(self._ids)
+        if parent is None:
+            trace_id, parent_id = span_id, None
+        else:
+            ctx = parent.context() if isinstance(parent, Span) else parent
+            if ctx is None:  # noop parent: still record, as a root
+                trace_id, parent_id = span_id, None
+            else:
+                trace_id, parent_id = ctx.trace_id, ctx.span_id
+        return Span(self, name, trace_id, span_id, parent_id,
+                    self.clock() if start is None else start, attrs or None)
+
+    def _export(self, span: Span) -> None:
+        for ex in self.exporters:
+            try:
+                ex.export(span)
+            except Exception as e:  # an exporter fault must never kill the
+                # scheduling path it observes — drop the span, say so once
+                log.warning("span exporter %s failed: %s: %s",
+                            type(ex).__name__, type(e).__name__, e)
+
+
+class _NoopTracer(Tracer):
+    """``NOOP_TRACER``: the production default.  ``enabled`` is False and
+    ``span()`` short-circuits to the shared noop span even if a caller
+    skipped the guard."""
+
+    def __init__(self):
+        super().__init__(enabled=False)
+
+    def span(self, name: str, parent=None, start=None, **attrs):
+        return NOOP_SPAN
+
+
+NOOP_TRACER = _NoopTracer()
+
+
+# --- exporters ----------------------------------------------------------------
+
+
+class InMemoryExporter:
+    """Bounded ring of finished spans (newest kept), with span-tree
+    reconstruction — the backing for tests and ``ktpu trace``."""
+
+    def __init__(self, max_spans: int = 65536):
+        self._spans: deque = deque(maxlen=max_spans)
+        self._lock = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def trees(self, last: Optional[int] = None,
+              root_name: Optional[str] = None):
+        """The last N root spans (finish order) as (root, children_of) where
+        ``children_of`` maps span_id -> [child spans sorted by start].  A
+        root whose children were evicted from the ring still renders (with
+        the surviving subset)."""
+        spans = self.spans()
+        children: Dict[int, List[Span]] = {}
+        by_trace: Dict[int, List[Span]] = {}
+        for s in spans:
+            by_trace.setdefault(s.trace_id, []).append(s)
+            if s.parent_id is not None:
+                children.setdefault(s.parent_id, []).append(s)
+        for kids in children.values():
+            kids.sort(key=lambda s: s.start)
+        roots = [s for s in spans if s.parent_id is None
+                 and (root_name is None or s.name == root_name)]
+        if last is not None:
+            roots = roots[-last:]
+        return [(r, children) for r in roots]
+
+    def attempt_records(self) -> List[dict]:
+        """Per-pod phase records off the scheduler's ``attempt`` roots (the
+        ``pod_phases`` attribute) — what the perf harness aggregates."""
+        out: List[dict] = []
+        for s in self.spans():
+            if s.name == "attempt" and s.parent_id is None:
+                out.extend(s.attrs.get("pod_phases") or ())
+        return out
+
+
+class ChromeTraceExporter:
+    """Chrome trace-event JSONL: one complete ("ph": "X") event per span,
+    one line each, inside a JSON array that is valid even if the process
+    dies mid-write (the trace-event spec explicitly allows an unterminated
+    array; Perfetto and chrome://tracing both load it).  Timestamps are the
+    tracer clock in µs; ``tid`` is the emitting thread, so cross-thread
+    pipeline spans land on their real timelines."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        self._tids: Dict[int, int] = {}  # thread ident -> small stable tid
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            tid = self._tids.setdefault(span.thread, len(self._tids))
+            ev = {
+                "name": span.name,
+                "cat": "ktpu",
+                "ph": "X",
+                "ts": round(span.start * 1e6, 3),
+                "dur": round(max((span.end or span.start) - span.start, 0.0)
+                             * 1e6, 3),
+                "pid": 1,
+                "tid": tid,
+                "args": _jsonable(span.attrs),
+            }
+            self._f.write(json.dumps(ev) + ",\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                # terminator metadata event closes the array cleanly
+                self._f.write(json.dumps(
+                    {"name": "trace_end", "ph": "i", "ts": 0, "pid": 1,
+                     "tid": 0, "s": "g"}) + "\n]\n")
+                self._f.close()
+
+
+def _jsonable(attrs: dict) -> dict:
+    out = {}
+    for k, v in (attrs or {}).items():
+        if k == "pod_phases":
+            # the per-pod record list is a harness aggregation channel, not
+            # a display attribute (the tree renderer skips it too): ~10KB
+            # of stringified dicts per attempt event would bloat every
+            # committed suite artifact
+            continue
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        elif isinstance(v, (list, tuple)) and len(v) <= 64:
+            out[k] = [x if isinstance(x, (str, int, float, bool)) else str(x)
+                      for x in v]
+        else:
+            out[k] = f"<{type(v).__name__}>"
+    return out
+
+
+class ThresholdLogExporter:
+    """``log_if_long`` generalized: buffers a trace's spans until its ROOT
+    finishes, then logs the whole tree when the root exceeded
+    ``threshold`` seconds — the utiltrace contract at span granularity."""
+
+    def __init__(self, threshold: float = 0.1, max_traces: int = 256):
+        self.threshold = threshold
+        self._lock = threading.Lock()
+        self._by_trace: Dict[int, List[Span]] = {}
+        self._order: deque = deque()
+        self.max_traces = max_traces
+        # traces whose root already flushed: a LATE child (e.g. a gang
+        # permit_wait span resolved cycles after its attempt root) must
+        # not open a fresh buffer entry no root will ever pop — those dead
+        # entries would churn live traces out of the bounded buffer
+        self._flushed: deque = deque(maxlen=4 * max_traces)
+        self._flushed_set: set = set()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            if span.parent_id is not None and \
+                    span.trace_id in self._flushed_set:
+                return  # late child of an already-logged trace: drop
+            if span.trace_id not in self._by_trace:
+                self._by_trace[span.trace_id] = []
+                self._order.append(span.trace_id)
+                while len(self._order) > self.max_traces:
+                    self._by_trace.pop(self._order.popleft(), None)
+            self._by_trace[span.trace_id].append(span)
+            if span.parent_id is not None:
+                return
+            spans = self._by_trace.pop(span.trace_id, [])
+            if len(self._flushed) == self._flushed.maxlen:
+                self._flushed_set.discard(self._flushed[0])
+            self._flushed.append(span.trace_id)
+            self._flushed_set.add(span.trace_id)
+        if span.duration() < self.threshold:
+            return
+        log.info("%s", render_tree(span, spans))
+
+
+def render_tree(root: Span, spans: Optional[List[Span]] = None,
+                children: Optional[Dict[int, List[Span]]] = None) -> str:
+    """Indented tree rendering shared by ThresholdLogExporter and
+    ``ktpu trace``: per-span +offset-from-root and duration in ms.  Pass
+    either the flat span list (the index is derived) or a pre-built
+    ``children`` map (InMemoryExporter.trees already computed one — don't
+    rebuild it per root over a 65k-span ring)."""
+    if children is None:
+        children = {}
+        for s in spans or ():
+            if s.parent_id is not None:
+                children.setdefault(s.parent_id, []).append(s)
+        for kids in children.values():
+            kids.sort(key=lambda s: s.start)
+    lines = [f'span "{root.name}" total={root.duration() * 1e3:.1f}ms '
+             f'{_render_attrs(root.attrs)}'.rstrip()]
+
+    def walk(sid: int, depth: int):
+        for c in children.get(sid, ()):
+            lines.append(
+                f"{'  ' * depth}- {c.name} "
+                f"+{(c.start - root.start) * 1e3:.1f}ms "
+                f"{c.duration() * 1e3:.1f}ms {_render_attrs(c.attrs)}"
+                .rstrip())
+            walk(c.span_id, depth + 1)
+
+    walk(root.span_id, 1)
+    return "\n".join(lines)
+
+
+def _render_attrs(attrs: dict) -> str:
+    shown = {k: v for k, v in (attrs or {}).items() if k != "pod_phases"}
+    return " ".join(f"{k}={v}" for k, v in shown.items())
+
+
+# --- legacy step trace (k8s.io/utils/trace) -----------------------------------
 
 
 @dataclass
@@ -50,7 +468,7 @@ class Trace:
 
 @contextlib.contextmanager
 def device_profile(path: str):
-    """JAX profiler session (the OTel-exporter analog for device work)."""
+    """JAX profiler session (the device-side complement to the host spans)."""
     import jax
 
     jax.profiler.start_trace(path)

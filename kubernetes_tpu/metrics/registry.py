@@ -9,6 +9,7 @@ extraction mirrors test/integration/scheduler_perf/util.go:238-276
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -126,23 +127,34 @@ class Histogram(Metric):
 
     def quantile(self, q: float, labels: Tuple = ()) -> float:
         """Linear-interpolated bucket quantile (scheduler_perf util.go:238-276)."""
-        counts = self._counts.get(labels)
-        if not counts:
-            return 0.0
-        total = sum(counts)
-        target = q * total
-        acc = 0.0
-        lo = 0.0
-        for i, c in enumerate(counts):
-            hi = self.buckets[i] if i < len(self.buckets) else float("inf")
-            if acc + c >= target and c > 0:
-                frac = (target - acc) / c
-                if hi == float("inf"):
-                    return lo
-                return lo + (hi - lo) * frac
-            acc += c
-            lo = hi
-        return lo
+        return quantile_from_counts(self.buckets,
+                                    self._counts.get(labels), q)
+
+
+def quantile_from_counts(buckets: List[float],
+                         counts: Optional[List[int]], q: float) -> float:
+    """Linear-interpolated quantile over per-bucket counts (len(buckets)+1,
+    last = +Inf overflow) — shared by Histogram.quantile and the CLI's
+    ``ktpu slo --server`` path, which rebuilds counts from the /metrics
+    bucket exposition (parse_text) instead of a live Histogram."""
+    if not counts:
+        return 0.0
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    acc = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = buckets[i] if i < len(buckets) else float("inf")
+        if acc + c >= target and c > 0:
+            frac = (target - acc) / c
+            if hi == float("inf"):
+                return lo
+            return lo + (hi - lo) * frac
+        acc += c
+        lo = hi
+    return lo
 
 
 class Registry:
@@ -167,56 +179,147 @@ class Registry:
 default_registry = Registry()
 
 
+def _escape_label_value(v: str) -> str:
+    """Escape one label value for the synthetic comma-joined ``label`` key:
+    backslash, double-quote, newline (the Prometheus escapes) plus the
+    comma, which is this format's tuple separator."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n").replace(",", "\\,"))
+
+
+def _unescape_split(joined: str) -> Tuple[str, ...]:
+    """Split a rendered ``label`` value on unescaped commas and unescape
+    each element — the exact inverse of the join in render_text."""
+    parts: List[str] = []
+    cur: List[str] = []
+    i = 0
+    while i < len(joined):
+        c = joined[i]
+        if c == "\\" and i + 1 < len(joined):
+            nxt = joined[i + 1]
+            cur.append({"n": "\n"}.get(nxt, nxt))
+            i += 2
+            continue
+        if c == ",":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    parts.append("".join(cur))
+    return tuple(parts)
+
+
 def render_text(registry: Optional[Registry] = None) -> str:
     """Prometheus-style text exposition of a registry (the apiserver's
-    /metrics body; ``ktpu controlplane status --server`` parses it back).
+    /metrics body; ``ktpu controlplane status --server`` and ``ktpu slo
+    --server`` parse it back).
 
     Sim-grade format: the registry stores label VALUE tuples without label
     names, so every labeled series renders one synthetic ``label`` key
-    holding the comma-joined values — ``name{label="a,b"} 3``.  Histograms
-    emit ``_count``/``_sum`` only (bucket vectors are an in-process
-    concern; the quantile helpers read them directly)."""
+    holding the comma-joined (escaped) values — ``name{label="a,b"} 3``.
+    Histograms emit the full exposition: cumulative ``_bucket`` series with
+    ``le`` (including ``+Inf``) plus ``_count``/``_sum``, so a remote
+    reader can compute interpolated quantiles (quantile_from_counts) —
+    the ``ktpu slo --server`` dependency.  Known lossy corner, kept for
+    back-compat with existing consumers: a SINGLE empty label value
+    renders ``label=""`` which parses back to the EMPTY tuple (callers
+    like ``ktpu nodehealth`` look both keys up)."""
     reg = registry or default_registry
     lines: List[str] = []
     for name in sorted(reg.metrics):
         metric = reg.metrics[name]
+        series: List[Tuple[str, Tuple, Optional[str], float]] = []
         if isinstance(metric, Histogram):
             with metric._lock:
-                series = [(f"{name}_count", labels, float(n))
-                          for labels, n in metric._n.items()]
-                series += [(f"{name}_sum", labels, s)
+                for labels, counts in metric._counts.items():
+                    acc = 0
+                    for i, c in enumerate(counts):
+                        acc += c
+                        le = (f"{metric.buckets[i]:g}"
+                              if i < len(metric.buckets) else "+Inf")
+                        series.append((f"{name}_bucket", labels, le,
+                                       float(acc)))
+                series += [(f"{name}_count", labels, None, float(n))
+                           for labels, n in metric._n.items()]
+                series += [(f"{name}_sum", labels, None, s)
                            for labels, s in metric._sum.items()]
         elif isinstance(metric, (Counter, Gauge)):
-            series = [(name, labels, v) for labels, v in metric.items().items()]
+            series = [(name, labels, None, v)
+                      for labels, v in metric.items().items()]
         else:
             continue
-        for sname, labels, v in sorted(series, key=lambda t: (t[0], t[1])):
+        for sname, labels, le, v in sorted(
+                series, key=lambda t: (t[0], t[1], t[2] or "")):
+            parts = []
             if labels:
-                joined = ",".join(str(x) for x in labels)
-                lines.append(f'{sname}{{label="{joined}"}} {v:g}')
+                joined = ",".join(_escape_label_value(str(x))
+                                  for x in labels)
+                parts.append(f'label="{joined}"')
+            if le is not None:
+                parts.append(f'le="{le}"')
+            # repr() is the shortest exact round-trip for floats — ":g"
+            # truncated to 6 significant digits, which silently corrupted
+            # large counters through the --server parse path
+            val = (f"{int(v)}" if float(v).is_integer() else repr(float(v)))
+            if parts:
+                lines.append(f"{sname}{{{','.join(parts)}}} {val}")
             else:
-                lines.append(f"{sname} {v:g}")
+                lines.append(f"{sname} {val}")
     return "\n".join(lines) + "\n"
 
 
+_LINE_RE = re.compile(
+    r'^(?P<name>[^{\s]+)'
+    r'(?:\{(?:label="(?P<label>(?:[^"\\]|\\.)*)")?,?'
+    r'(?:le="(?P<le>[^"]*)")?\})?'
+    r'\s+(?P<val>\S+)$')
+
+
 def parse_text(body: str) -> Dict[Tuple[str, Tuple], float]:
-    """Inverse of render_text: {(series name, label tuple) → value}."""
+    """Inverse of render_text: {(series name, label tuple) → value}.
+    Histogram ``_bucket`` series key as (``name_bucket``, labels + (le,)) —
+    ``bucket_counts_from_series`` rebuilds per-bucket count vectors from
+    them for remote quantile computation."""
     out: Dict[Tuple[str, Tuple], float] = {}
     for line in body.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        head, _, val = line.rpartition(" ")
-        if not head:
+        m = _LINE_RE.match(line)
+        if m is None:
             continue
-        if "{" in head:
-            name, _, rest = head.partition("{")
-            joined = rest.rstrip("}").partition('label="')[2].rstrip('"')
-            labels: Tuple = tuple(joined.split(",")) if joined else ()
-        else:
-            name, labels = head, ()
+        labels: Tuple = ()
+        if m.group("label"):
+            labels = _unescape_split(m.group("label"))
+        if m.group("le") is not None:
+            labels = labels + (m.group("le"),)
         try:
-            out[(name, labels)] = float(val)
+            out[(m.group("name"), labels)] = float(m.group("val"))
         except ValueError:
             continue
+    return out
+
+
+def bucket_counts_from_series(metrics: Dict[Tuple[str, Tuple], float],
+                              name: str) -> Dict[Tuple, Tuple[List[float],
+                                                              List[int]]]:
+    """Rebuild {labels → (bucket uppers, per-bucket counts incl. +Inf
+    overflow)} from a parse_text dict's cumulative ``name_bucket`` series —
+    the remote half of Histogram.quantile (feed quantile_from_counts)."""
+    rows: Dict[Tuple, List[Tuple[float, float]]] = {}
+    for (sname, labels), v in metrics.items():
+        if sname != f"{name}_bucket" or not labels:
+            continue
+        le = labels[-1]
+        upper = float("inf") if le == "+Inf" else float(le)
+        rows.setdefault(labels[:-1], []).append((upper, v))
+    out: Dict[Tuple, Tuple[List[float], List[int]]] = {}
+    for labels, pairs in rows.items():
+        pairs.sort()
+        uppers = [u for u, _ in pairs if u != float("inf")]
+        cum = [c for _, c in pairs]
+        counts = [int(round(c - (cum[i - 1] if i else 0.0)))
+                  for i, c in enumerate(cum)]
+        out[labels] = (uppers, counts)
     return out

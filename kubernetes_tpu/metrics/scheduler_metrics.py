@@ -59,6 +59,25 @@ scheduler_cache_size = default_registry.register(
     Gauge("scheduler_scheduler_cache_size")  # labels: (type,)
 )
 
+# --- span-tracing observatory (component_base/trace.py + scheduler) -----------
+# Per-pod attempt latency BY PHASE, observed in the bind phase from the same
+# clock stamps the attempt span tree carries.  The three attempt-tiling
+# phases sum EXACTLY to scheduler_scheduling_attempt_duration_seconds per
+# pod: "dispatch" (host dispatch work, t0 → device program enqueued),
+# "device" (enqueue → decisions host-side; the extender round walk for
+# extender batches), "bind" (the pod's own reserve→bind segment).  Two
+# non-tiling phases ride the same label dimension: "queue_wait" (this
+# attempt's queue entry → dispatch pop — overlaps the previous attempt's
+# pipeline, so it must not be summed into the attempt) and "permit_wait"
+# (a gang member's Permit hold, resolved at the waiting-bind flush).
+# Always-on (independent of the tracer): `ktpu slo` reads these live or via
+# /metrics buckets; the cost is a handful of histogram observes per pod.
+attempt_phase_duration = default_registry.register(
+    Histogram("scheduler_attempt_phase_duration_seconds",
+              exponential_buckets(0.0001, 2, 20),
+              "Per-pod scheduling attempt latency by phase")
+)
+
 # --- robustness / degradation observability ----------------------------------
 # The chaos harness (kubernetes_tpu/chaos/) asserts these series so every
 # retry, relist, and circuit transition is visible, not silent.

@@ -124,8 +124,33 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
     extenders, ext_cleanup = [], None
     if w.make_extenders is not None:
         extenders, ext_cleanup = w.make_extenders()
+    # Span tracing (component_base/trace.py): the in-memory ring feeds the
+    # AttemptPhaseLatency item (per-pod attempt records → p50/p90/p99 per
+    # phase, reconstructed from spans); with KTPU_TRACE_DIR set, a Chrome
+    # trace-event JSONL artifact (one per suite run, Perfetto-loadable) is
+    # written alongside — tools/run_suites.sh sets it and gates on both.
+    import os as _os
+
+    from ..component_base.trace import (ChromeTraceExporter,
+                                        InMemoryExporter, Tracer)
+
+    span_ring = InMemoryExporter(max_spans=262144)
+    exporters: List = [span_ring]
+    chrome = None
+    trace_dir = _os.environ.get("KTPU_TRACE_DIR")
+    trace_path = ""
+    if trace_dir:
+        _os.makedirs(trace_dir, exist_ok=True)
+        trace_path = _os.path.join(
+            trace_dir, w.name.replace("/", "_") + ".trace.jsonl")
+        chrome = ChromeTraceExporter(trace_path)
+        exporters.append(chrome)
+    # tracer clock == scheduler clock (time.monotonic): scheduler spans
+    # stamp explicitly from the scheduler clock, and matching the tracer's
+    # default keeps any tracer-clock spans in the same artifact timeline
+    tracer = Tracer(clock=time.monotonic, exporters=exporters)
     sched = TPUScheduler(store, batch_size=w.batch_size, pipeline=True,
-                         extenders=extenders)
+                         extenders=extenders, tracer=tracer)
     # Pre-size tiers to the run's full extent so no measured cycle pays a
     # DeviceSnapshot shape change (= full program-suite recompile).
     sched.presize(
@@ -341,6 +366,9 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # delta attributes suite time to host_prepare / partition /
                 # dispatch / fetch / bind so a regression names its phase
                 phase0 = dict(sched.phase_wall)
+                # span-window start: only the measured window's attempt
+                # records feed the per-phase latency item below
+                span_ring.clear()
                 t0 = clock()
                 t_last_progress = t0
                 cycle = 0
@@ -579,6 +607,33 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                     },
                     unit="s",
                 ))
+                # per-phase attempt latency reconstructed FROM SPANS: the
+                # attempt roots carry one record per pod with the three
+                # tiling phases (dispatch/device/bind — they sum exactly to
+                # that pod's attempt) plus queue_wait; Coverage compares
+                # the sum of tiling p50s against the measured end-to-end
+                # attempt p50 (the no-unattributed-wall-clock contract the
+                # run_suites.sh gate enforces at 10%)
+                recs = span_ring.attempt_records()
+                ph_data: Dict[str, float] = {"Records": float(len(recs))}
+                for ph in ("dispatch", "device", "bind", "queue_wait"):
+                    vals = sorted(r[ph] for r in recs)
+                    for qname, q in (("Perc50", 0.50), ("Perc90", 0.90),
+                                     ("Perc99", 0.99)):
+                        ph_data[f"{ph}_{qname}"] = _exact(vals, q)
+                ph_data["SumPerc50"] = sum(
+                    ph_data[f"{p}_Perc50"] for p in ("dispatch", "device",
+                                                     "bind"))
+                ph_data["AttemptPerc50"] = hist.exact_quantile(0.50)
+                ph_data["Coverage"] = (
+                    ph_data["SumPerc50"] / ph_data["AttemptPerc50"]
+                    if ph_data["AttemptPerc50"] > 0 else 0.0)
+                items.append(DataItem(
+                    labels={"Name": w.name, "Metric": "AttemptPhaseLatency",
+                            "TraceArtifact": trace_path},
+                    data=ph_data,
+                    unit="s",
+                ))
             elif not op.skip_wait:
                 sched.run_until_idle()
         elif op.opcode == "barrier":
@@ -592,6 +647,8 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
         else:
             raise ValueError(f"unknown opcode {op.opcode}")
     sched.close()  # release the store watch + extender callout pool
+    if chrome is not None:
+        chrome.close()  # terminate the JSON array so the artifact loads
     if ext_cleanup is not None:
         ext_cleanup()
     return items

@@ -43,6 +43,24 @@ def profile_workload(suite: str, size: str, scale: float, top: int = 40) -> str:
         for k, v in sorted(phase.items(), key=lambda kv: -kv[1]):
             out.write(f"  {k:<14}{v:>9.3f}  ({100 * v / total:5.1f}%)\n")
         out.write(json.dumps({"phase_wall_s": phase}) + "\n\n")
+    # per-phase ATTEMPT latency from the span tracer's per-pod records
+    # (harness AttemptPhaseLatency): where a single pod's attempt p50/p99
+    # goes, phase by phase — the wall table above is aggregate, this is
+    # per-attempt (the ROADMAP item-3c latency-attack view)
+    apl = next(
+        (i.data for i in items
+         if i.labels.get("Metric") == "AttemptPhaseLatency"), None)
+    if apl is not None:
+        out.write("Per-phase attempt latency (ms, from spans):\n")
+        for ph in ("dispatch", "device", "bind", "queue_wait"):
+            out.write(
+                f"  {ph:<12}p50 {apl.get(f'{ph}_Perc50', 0) * 1e3:>9.3f}"
+                f"  p90 {apl.get(f'{ph}_Perc90', 0) * 1e3:>9.3f}"
+                f"  p99 {apl.get(f'{ph}_Perc99', 0) * 1e3:>9.3f}\n")
+        out.write(
+            f"  sum(tiling p50) {apl.get('SumPerc50', 0) * 1e3:.3f}ms vs "
+            f"attempt p50 {apl.get('AttemptPerc50', 0) * 1e3:.3f}ms "
+            f"(coverage {apl.get('Coverage', 0):.2f}x)\n\n")
     stats = pstats.Stats(prof, stream=out)
     stats.sort_stats("cumulative").print_stats(top)
     return out.getvalue()

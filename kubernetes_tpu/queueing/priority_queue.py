@@ -38,6 +38,11 @@ class QueuedPodInfo:
     initial_attempt_timestamp: float = 0.0
     attempts: int = 0
     unschedulable_plugins: Set[str] = field(default_factory=set)
+    # when the pod last entered the ACTIVE queue (vs. timestamp, which is
+    # this attempt's overall queue entry incl. backoff/unschedulable time):
+    # the attempt span tree's queue_wait splits backoff wait from
+    # poppable-but-not-yet-popped wait with these two stamps
+    last_activation: float = 0.0
 
 
 def default_less(a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
@@ -102,6 +107,7 @@ class PriorityQueue:
         uid = info.pod.uid
         if uid in self._in_active:
             return
+        info.last_activation = self._clock()
         heapq.heappush(
             self._active, (self._Key(info, self._less), next(self._seq), info)
         )

@@ -106,6 +106,9 @@ class _WaitingBind:
     fw: object
     reserved: List
     since: float
+    # attempt-span context the held binding cycle came from: the
+    # permit_wait span emitted at flush time links into that tree
+    ctx: object = None
 
 
 @dataclass
@@ -294,6 +297,19 @@ class _InFlight:
     # background round walk died with — re-raised at _complete so the
     # batch routes through the cycle failure handler (requeue, not lost)
     walk_error: object = None
+    # span tracing (component_base/trace.py): the attempt root span, its
+    # context (the EXPLICIT cross-thread handoff — bg-fetch and the async
+    # extender walk parent their spans to it), and the clock stamp where
+    # host dispatch work ended (the dispatch/device phase boundary for the
+    # per-pod attempt records).  span is None when the tracer is disabled.
+    span: object = None
+    span_ctx: object = None
+    dispatch_end: float = 0.0
+    # the legacy utiltrace step trace, carried so log_if_long can cover the
+    # WHOLE attempt (dispatch→complete→bind) instead of only the
+    # synchronous dispatch slice — the ISSUE-14 bugfix: under
+    # pipeline/async_extenders the old dispatch-scoped total was misleading
+    trace: object = None
 
 
 class TPUScheduler:
@@ -320,6 +336,7 @@ class TPUScheduler:
         chain_affinity: object = "auto",
         fence=None,
         sharding: object = "auto",
+        tracer=None,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -369,6 +386,20 @@ class TPUScheduler:
             k: 0.0 for k in ("snapshot", "compile", "host_prepare",
                              "partition", "dispatch", "fetch",
                              "extender_wait", "bind")}
+        # Span tracer (component_base/trace.py): one span tree per
+        # dispatched batch — attempt root, queue_wait, dispatch (snapshot/
+        # compile/host_prepare/device_enqueue), device_wait or
+        # extender_rounds, complete, bind_phase + per-pod bind spans —
+        # with the SpanContext handed EXPLICITLY across the pipeline seams
+        # (_InFlight.span_ctx → bg-fetch thread → async extender walk →
+        # _complete → bind; never a thread-local).  Defaults to the shared
+        # NOOP tracer: every emission site is guarded on tracer.enabled, a
+        # constant-false attribute read on the hot path (gated < 1%
+        # overhead in tools/bench_trace_overhead.py).  Spans bracket the
+        # dispatch/fetch boundaries only — never inside jitted code.
+        from .component_base.trace import NOOP_TRACER
+
+        self.tracer = tracer or NOOP_TRACER
         # batch-formation hysteresis: when the active queue holds less than
         # half a batch but a backoff wave (e.g. 256 preemptors nominated
         # together) expires within this window, wait for it — the wave then
@@ -506,6 +537,13 @@ class TPUScheduler:
         # moved on can no longer race the new leader's binding cycles.
         # None (the default, single-replica deployments) costs nothing.
         self.fence = fence
+        # does the store's bind_pod accept the span-context handoff kwarg?
+        # (the informer's signature-probing idiom: ObjectStore and
+        # RetryingStore do, remote facades may not — probe once, not per
+        # bind).  Only consulted when the tracer is enabled.
+        from .utils import takes_kwarg
+
+        self._bind_takes_trace = takes_kwarg(store.bind_pod, "trace_parent")
         from .framework.waiting_pods import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap(clock=clock)
@@ -984,6 +1022,11 @@ class TPUScheduler:
                 # fetch collapse) costs the batch a requeue, not the loop:
                 # nothing was assumed — route through the failure handler
                 # exactly like a dispatch-time fault
+                if fl.span is not None:
+                    fl.span.set(error=f"{type(e).__name__}: {e}").finish()
+                if fl.trace is not None:
+                    fl.trace.step("Completion failed")
+                    fl.trace.log_if_long(0.1)
                 self._handle_cycle_failure(fl.infos, e)
                 stats.attempted += len(fl.infos)
 
@@ -1012,6 +1055,12 @@ class TPUScheduler:
                 try:
                     rows = self._complete(nxt)
                 except Exception as e:
+                    if nxt.span is not None:
+                        nxt.span.set(
+                            error=f"{type(e).__name__}: {e}").finish()
+                    if nxt.trace is not None:
+                        nxt.trace.step("Completion failed")
+                        nxt.trace.log_if_long(0.1)
                     self._handle_cycle_failure(nxt.infos, e)
                     stats.attempted += len(nxt.infos)
                 else:
@@ -1113,15 +1162,58 @@ class TPUScheduler:
         from .component_base.trace import Trace
 
         t0 = self.clock()
-        # hot-path step trace, dumped when a dispatch exceeds 100ms
-        # (utiltrace in schedulePod, scheduler.go:775-791)
+        # hot-path step trace; log_if_long now fires at the END of the
+        # batch's bind phase (via _InFlight.trace) so the logged total
+        # covers dispatch→complete→bind, not just the synchronous dispatch
+        # slice a deep pipeline returns from at enqueue (utiltrace in
+        # schedulePod, scheduler.go:775-791)
         trace = Trace("Scheduling", pods=len(infos))
         cycle = self.queue.scheduling_cycle()
+        # attempt span tree root (see tracer in __init__): children bracket
+        # every host phase; the context travels on the _InFlight record
+        root = ctx = disp_span = None
+        if self.tracer.enabled:
+            root = self.tracer.span("attempt", start=t0, cycle=cycle,
+                                    pods=len(infos))
+            ctx = root.context()
+            disp_span = self.tracer.span("dispatch", parent=ctx, start=t0)
+            earliest = min(qi.timestamp for qi in infos)
+            # active wait = poppable-but-unpopped time (queue pressure);
+            # the rest of the window is backoff/unschedulable parking
+            act = max((t0 - max(qi.last_activation, qi.timestamp)
+                       for qi in infos), default=0.0)
+            self.tracer.span(
+                "queue_wait", parent=ctx, start=earliest,
+                max_wait_ms=round((t0 - earliest) * 1e3, 3),
+                max_active_wait_ms=round(act * 1e3, 3)).finish(end=t0)
+        try:
+            return self._dispatch_batch_traced(
+                infos, prevs, interacts, t0, trace, cycle, root, ctx,
+                disp_span)
+        except Exception as e:
+            # a dispatch-time fault must still close the attempt tree (an
+            # unfinished root would orphan its already-exported children
+            # and strand threshold-exporter buffers) AND dump the legacy
+            # step trace — the slow-dispatch diagnostic matters most on
+            # exactly the cycles that die
+            if root is not None:
+                root.set(error=f"{type(e).__name__}: {e}").finish()
+            trace.log_if_long(0.1)
+            raise
+
+    def _dispatch_batch_traced(self, infos, prevs, interacts, t0, trace,
+                               cycle, root, ctx, disp_span) -> _InFlight:
+        """_dispatch_batch's body, wrapped by the span/trace failure guard
+        above; see _dispatch_batch for the contract."""
         self._dispatch_seq += 1
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
-        self.phase_wall["snapshot"] += self.clock() - t0
+        t_snap_end = self.clock()
+        self.phase_wall["snapshot"] += t_snap_end - t0
+        if disp_span is not None:
+            self.tracer.span("snapshot", parent=disp_span,
+                             start=t0).finish(end=t_snap_end)
         # fast-bound nominations whose assume this refresh now carries: the
         # reservation would double-count from here on — release it.  Marks
         # from the bind phase that ran after the PREVIOUS dispatch carry
@@ -1138,7 +1230,11 @@ class TPUScheduler:
         # reuse the warm executable (first compile is tens of seconds)
         t_c = self.clock()
         batch = self.compiler.compile(pods, pad_to=self.batch_size)
-        self.phase_wall["compile"] += self.clock() - t_c
+        t_c_end = self.clock()
+        self.phase_wall["compile"] += t_c_end - t_c
+        if disp_span is not None:
+            self.tracer.span("compile", parent=disp_span,
+                             start=t_c).finish(end=t_c_end)
         trace.step("Batch compile")
         profile = self._profile_of(infos[0].pod)  # queue groups by profile
         fw = self._framework(profile)
@@ -1155,6 +1251,9 @@ class TPUScheduler:
         )
         dt_hp = self.clock() - t_hp
         self.phase_wall["host_prepare"] += dt_hp
+        if disp_span is not None:
+            self.tracer.span("host_prepare", parent=disp_span,
+                             start=t_hp).finish(end=t_hp + dt_hp)
         # the reference's per-extension-point histogram (:130): host_prepare
         # is this build's PreFilter/PreScore analog, the fused dispatch its
         # Filter+Score (observed below) — was registered-but-unemitted
@@ -1205,6 +1304,14 @@ class TPUScheduler:
                            t0, cycle, profile=profile, fw=fw,
                            engine="extender")
             fl.name_of = dict(self.encoder.row_to_name())
+            # dispatch/device phase boundary: the fused first round is
+            # enqueued; everything after is the extender round walk
+            fl.dispatch_end = self.clock()
+            fl.trace = trace
+            if root is not None:
+                fl.span, fl.span_ctx = root, ctx
+                root.set(engine="extender")
+                disp_span.finish(end=fl.dispatch_end)
             if self.async_extenders:
                 # the WHOLE round walk (device-round fetches, callouts,
                 # host ledger) moves off the device cycle: _complete joins
@@ -1219,7 +1326,16 @@ class TPUScheduler:
 
                 captured = self._capture_walk_state()
 
-                def _walk(rec=fl, clk=self.clock):
+                def _walk(rec=fl, clk=self.clock, tracer=self.tracer):
+                    # cross-thread span handoff: the walk's span parents to
+                    # the attempt context carried on the record — no
+                    # thread-local crosses this seam.  start/end both come
+                    # from the SCHEDULER clock (clk), matching every other
+                    # scheduler-emitted span's clock domain
+                    wspan = (tracer.span("extender_rounds",
+                                         parent=rec.span_ctx, start=clk())
+                             if tracer.enabled and rec.span_ctx is not None
+                             else None)
                     try:
                         out, lat, rounds, _wait = self._assign_with_extenders(
                             fw, jt, batch, dsnap, dyn, auxes, pods, t0,
@@ -1228,13 +1344,20 @@ class TPUScheduler:
                         )
                         rec.fetched, rec.algo_lat = out, lat
                         rec.rounds_np = rounds
+                        if wspan is not None:
+                            wspan.set(rounds=int(rounds),
+                                      callout_wait_ms=round(_wait * 1e3, 3))
                     except Exception as e:  # surfaced at _complete → the
                         rec.walk_error = e  # cycle failure handler requeues
+                        if wspan is not None:
+                            wspan.set(error=f"{type(e).__name__}: {e}")
                         klog.V(1).info_s(
                             "Async extender walk failed; batch requeues at "
                             "completion", pods=len(infos),
                             error=f"{type(e).__name__}: {e}")
                     rec.fetched_at = clk()
+                    if wspan is not None:
+                        wspan.finish(end=rec.fetched_at)
 
                 fl.fetch_thread = threading.Thread(target=_walk, daemon=True)
                 fl.fetch_thread.start()
@@ -1252,6 +1375,12 @@ class TPUScheduler:
             fl.node_row_dev = None
             fl.fetched, fl.algo_lat, fl.rounds_np = node_row, algo_lat, ext_rounds
             fl.fetched_at = self.clock()
+            if root is not None:
+                self.tracer.span(
+                    "extender_rounds", parent=ctx, start=t_d,
+                    rounds=int(ext_rounds),
+                    callout_wait_ms=round(wait * 1e3, 3),
+                ).finish(end=fl.fetched_at)
             return fl
         dsnap, upd = self.encoder.to_device_deferred()
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
@@ -1294,10 +1423,21 @@ class TPUScheduler:
         m.framework_extension_point_duration.observe(dt_disp, ("dispatch",))
         self.encoder.commit_device(dsnap_out)  # futures — safe to adopt now
         trace.step("Device dispatch")
-        trace.log_if_long(0.1)
+        # NOTE: log_if_long moved to the end of this batch's bind phase
+        # (the trace rides the _InFlight record) — under pipeline/
+        # async_extenders the dispatch returns at enqueue, so logging here
+        # reported only the synchronous slice of a multi-cycle attempt
         fl = _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
                        None, t0, cycle, profile=profile, fw=fw, diag_dev=diag,
                        engine=engine, has_aff=bool(batch.has_affinity))
+        fl.dispatch_end = self.clock()
+        fl.trace = trace
+        if root is not None:
+            fl.span, fl.span_ctx = root, ctx
+            root.set(engine=engine)
+            self.tracer.span("device_enqueue", parent=disp_span,
+                             start=t_d).finish(end=fl.dispatch_end)
+            disp_span.finish(end=fl.dispatch_end)
         # Row→name capture at DISPATCH (not complete): a deep-pipelined
         # batch is completed only after the NEXT dispatch's encoder.sync,
         # which may reuse rows of nodes deleted in between — resolving
@@ -1402,7 +1542,21 @@ class TPUScheduler:
                 m.scheduler_retries.inc(("bg_diag_fetch_error",))
                 rec.diag_np = None
 
-        fl.fetch_thread = threading.Thread(target=_bg_fetch, daemon=True)
+        def _bg_run(rec=fl, tracer=self.tracer):
+            _bg_fetch()
+            # cross-thread span handoff (seam #1): the device-wait span is
+            # emitted from the fetch thread, parented to the attempt
+            # context the record carries — enqueue → decisions host-side.
+            # Only on SUCCESS (rec.fetched landed): a failed bg fetch falls
+            # back to _complete's sync fetch, which emits the span itself —
+            # emitting here too would double-count the device wait.
+            if tracer.enabled and rec.span_ctx is not None \
+                    and rec.fetched is not None:
+                tracer.span("device_wait", parent=rec.span_ctx,
+                            start=rec.dispatch_end).finish(
+                    end=rec.fetched_at or rec.dispatch_end)
+
+        fl.fetch_thread = threading.Thread(target=_bg_run, daemon=True)
         fl.fetch_thread.start()
         return fl
 
@@ -1432,6 +1586,13 @@ class TPUScheduler:
             jax.block_until_ready(dev)
             node_row = np.asarray(dev)
             fl.fetched_at = self.clock()
+            if self.tracer.enabled and fl.span_ctx is not None:
+                # no background thread emitted the device-wait span (bg
+                # fetch failed or never ran): record it from the sync fetch
+                self.tracer.span("device_wait", parent=fl.span_ctx,
+                                 start=fl.dispatch_end,
+                                 sync_fallback=True).finish(
+                    end=fl.fetched_at)
         # an extender batch's join waits on callouts, not a device fetch —
         # keep the attribution honest (the extender_wait phase bucket)
         self.phase_wall[
@@ -1464,6 +1625,16 @@ class TPUScheduler:
                 fl.node_names[i] = name
                 self._nominated.pop(qi.pod.uid, None)
                 self.cache.assume_pod(qi.pod, name)
+        if fl.trace is not None:
+            fl.trace.step("Decision fetch")
+        if self.tracer.enabled and fl.span_ctx is not None:
+            # fetch join + cache assumes, under the attempt tree (seam #3:
+            # the context came through the record, not a thread-local).
+            # end stamped explicitly from the SCHEDULER clock — every
+            # scheduler-emitted span uses one clock domain even when the
+            # tracer was built with a different default clock
+            self.tracer.span("complete", parent=fl.span_ctx,
+                             start=t_f).finish(end=self.clock())
         # kill-point: the whole batch is assumed in the cache, nothing is
         # bound in the store — process death here loses every assume (soft
         # state); recovery must reschedule the batch from the store's truth
@@ -1482,8 +1653,44 @@ class TPUScheduler:
         diag_np = cand_np = min_sched_prio = None
         pf_ctx = None  # per-batch preemption context, built on first failure
         fast_bound_uids: List[str] = []  # nominations to release at phase end
+        tracer = self.tracer
+        bp_span = (tracer.span("bind_phase", parent=fl.span_ctx,
+                               start=t_bind)
+                   if tracer.enabled and fl.span_ctx is not None else None)
+        bp_ctx = bp_span.context() if bp_span is not None else None
+        # Per-pod attempt-phase accounting: the three tiling phases sum
+        # EXACTLY to the pod's scheduling_attempt_duration observation —
+        # dispatch (host work to program enqueue), device (enqueue → its
+        # decision host-side; the extender round walk for extender
+        # batches), bind (its own reserve→bind segment).  Records ride the
+        # attempt root span's pod_phases attribute (harness aggregation +
+        # `ktpu trace`); the histograms are always-on (`ktpu slo`).
+        dispatch_host = max(fl.dispatch_end - fl.t0, 0.0)
+        pod_phases: Optional[List[dict]] = (
+            [] if fl.span is not None else None)
+
+        def _note_phases(i, qi, t_pod, now, queued_at, outcome) -> float:
+            algo = float(fl.algo_lat[i])
+            d = min(dispatch_host, algo)
+            dev = algo - d
+            b = max(now - t_pod, 0.0)
+            m.attempt_phase_duration.observe(d, ("dispatch",))
+            m.attempt_phase_duration.observe(dev, ("device",))
+            m.attempt_phase_duration.observe(b, ("bind",))
+            m.attempt_phase_duration.observe(
+                max(fl.t0 - queued_at, 0.0), ("queue_wait",))
+            if pod_phases is not None:
+                pod_phases.append({
+                    "pod": qi.pod.key(), "cycle": fl.cycle,
+                    "engine": fl.engine, "outcome": outcome,
+                    "dispatch": d, "device": dev, "bind": b,
+                    "queue_wait": max(fl.t0 - queued_at, 0.0),
+                    "total": algo + b,
+                })
+            return algo + b
         for i, qi in enumerate(fl.infos):
             t_pod = self.clock()
+            outcome = "unschedulable"  # per-pod attempt record label
             # captured BEFORE any requeue: add_unschedulable/_push_backoff
             # reset qi.timestamp, which would zero the e2e wait term below
             queued_at = qi.timestamp
@@ -1492,25 +1699,40 @@ class TPUScheduler:
                 # name resolved at completion time (see _complete) — the
                 # row→name map may have changed under the next dispatch's sync
                 node_name = fl.node_names[i]
+                bind_span = (tracer.span("bind", parent=bp_ctx, start=t_pod,
+                                         pod=qi.pod.key(), node=node_name)
+                             if bp_ctx is not None else None)
                 try:
                     ok = self._run_reserve_and_bind(fw, qi.pod, node_name,
-                                                    qi=qi)
+                                                    qi=qi,
+                                                    span_ctx=fl.span_ctx)
                 except _TransientBindError:
                     # already rolled back; timer retry via backoff — the
                     # rest of the batch's bind phase proceeds untouched
                     self.cache.forget_pod(qi.pod)
                     self._requeue_after_failure(qi)
-                    m.scheduling_attempt_duration.observe(
-                        float(fl.algo_lat[i]) + (self.clock() - t_pod))
+                    if bind_span is not None:
+                        bind_span.set(outcome="transient_error").finish(
+                            end=self.clock())
+                    m.scheduling_attempt_duration.observe(_note_phases(
+                        i, qi, t_pod, self.clock(), queued_at, "retry"))
                     continue
+                if bind_span is not None:
+                    # explicit end: scheduler-clock domain (see _complete)
+                    bind_span.set(outcome=(
+                        "permit_wait" if ok is _PERMIT_WAIT
+                        else "bound" if ok else "rejected")).finish(
+                        end=self.clock())
                 if ok is _PERMIT_WAIT:
                     # gang Permit hold: assume + reserve kept, bind deferred
                     # to _flush_waiting_binds — neither scheduled nor
                     # unschedulable yet; the attempt latency is still real
-                    m.scheduling_attempt_duration.observe(
-                        float(fl.algo_lat[i]) + (self.clock() - t_pod))
+                    m.scheduling_attempt_duration.observe(_note_phases(
+                        i, qi, t_pod, self.clock(), queued_at,
+                        "permit_wait"))
                     continue
                 if ok:
+                    outcome = "scheduled"
                     self.cache.finish_binding(qi.pod)
                     stats.scheduled += 1
                     m.schedule_attempts.inc(("scheduled",))
@@ -1529,6 +1751,7 @@ class TPUScheduler:
                         f"{qi.pod.metadata.name} to {node_name}",
                     )
                 else:  # reserve/bind failed — roll back (scheduler.go:676-689)
+                    outcome = "bind_rejected"
                     self.cache.forget_pod(qi.pod)
                     # a pod deleted while in flight consumed its DELETE event
                     # already — requeueing it would create a permanent ghost
@@ -1637,6 +1860,7 @@ class TPUScheduler:
                             error=f"{type(e).__name__}: {e}")
                         fast_bound = None
                 if fast_bound is not None:
+                    outcome = "scheduled_fast"
                     # preemption fast-bound the pod to its nominated node
                     # within this attempt (_try_nominated_fast_bind); its
                     # nomination entry stays live until the end of this bind
@@ -1673,7 +1897,7 @@ class TPUScheduler:
             # path), so its attempt spans that algorithm time plus its own
             # host reserve/permit/bind segment — not a batch average.
             now = self.clock()
-            attempt = float(fl.algo_lat[i]) + (now - t_pod)
+            attempt = _note_phases(i, qi, t_pod, now, queued_at, outcome)
             m.scheduling_attempt_duration.observe(attempt)
             # e2e additionally covers the wait since this attempt entered
             # the queue (metrics.go:78-84); the algorithm window overlaps
@@ -1693,8 +1917,24 @@ class TPUScheduler:
         for uid in fast_bound_uids:
             if uid in self._nominated:
                 self._fastbound_noms[uid] = self._dispatch_seq
-        stats.batch_seconds = self.clock() - fl.t0
-        self.phase_wall["bind"] += self.clock() - t_bind
+        t_end = self.clock()
+        stats.batch_seconds = t_end - fl.t0
+        self.phase_wall["bind"] += t_end - t_bind
+        if bp_span is not None:
+            bp_span.finish(end=t_end)
+        if fl.span is not None:
+            # root finishes LAST (the threshold exporter keys on it); the
+            # per-pod phase records ride the root for harness aggregation
+            fl.span.set(scheduled=stats.scheduled,
+                        unschedulable=stats.unschedulable,
+                        pod_phases=pod_phases)
+            fl.span.finish(end=t_end)
+        if fl.trace is not None:
+            # the ISSUE-14 bugfix made concrete: the legacy utiltrace wraps
+            # the WHOLE attempt — its logged total now covers
+            # dispatch→complete→bind even when those ran cycles apart
+            fl.trace.step("Binding cycle")
+            fl.trace.log_if_long(0.1)
         # engine observability: the round count rode the packed decision
         # fetch (row 2); the extender path counted its rounds host-side
         if fl.rounds_np is not None:
@@ -1772,13 +2012,21 @@ class TPUScheduler:
             # allowed: run the deferred PreBind→Bind→PostBind half
             del self._waiting_binds[uid]
             try:
-                ok = self._finish_bind(wb.fw, pod, wb.node_name, wb.reserved)
+                ok = self._finish_bind(wb.fw, pod, wb.node_name, wb.reserved,
+                                       span_ctx=wb.ctx)
             except _TransientBindError:
                 self.cache.forget_pod(pod)
                 self._requeue_after_failure(wb.qi)
                 return True
             now = self.clock()
             m.scheduling_attempt_duration.observe(now - wb.since)
+            m.attempt_phase_duration.observe(now - wb.since, ("permit_wait",))
+            if self.tracer.enabled:
+                self.tracer.span(
+                    "permit_wait", parent=wb.ctx, start=wb.since,
+                    pod=pod.key(),
+                    outcome="released" if ok else "bind_failed",
+                ).finish(end=now)
             if ok:
                 self.cache.finish_binding(pod)
                 stats.scheduled += 1
@@ -1804,6 +2052,13 @@ class TPUScheduler:
             # rejected or deadline expired: roll the cycle back; the
             # unreserve chain fires the gang group-failure hook
             del self._waiting_binds[uid]
+            now = self.clock()
+            m.attempt_phase_duration.observe(now - wb.since, ("permit_wait",))
+            if self.tracer.enabled:
+                self.tracer.span(
+                    "permit_wait", parent=wb.ctx, start=wb.since,
+                    pod=pod.key(), outcome="rejected", reason=str(reason),
+                ).finish(end=now)
             self.gangs.note_wait_rejected(pod, reason)
             for done in reversed(wb.reserved):
                 un = getattr(done.plugin, "unreserve", None)
@@ -2619,6 +2874,8 @@ class TPUScheduler:
         for fl in inflight:
             if fl.fetch_thread is not None:
                 fl.fetch_thread.join()  # let the bg fetch land before discard
+            if fl.span is not None:
+                fl.span.set(error="abandoned: leadership lost").finish()
             for qi in fl.infos:
                 self._requeue_after_failure(qi)
         if inflight:
@@ -2655,7 +2912,8 @@ class TPUScheduler:
             pool.shutdown(wait=False)
 
     def _run_reserve_and_bind(self, fw, pod: v1.Pod, node_name: str,
-                              qi: Optional[QueuedPodInfo] = None):
+                              qi: Optional[QueuedPodInfo] = None,
+                              span_ctx=None):
         """Reserve → Permit → PreBind → Bind → PostBind (scheduler.go:584-698).
 
         Returns True (bound), False (rejected, rolled back), or the
@@ -2708,18 +2966,22 @@ class TPUScheduler:
                     # sibling releases them or the deadline fires
                     self._waiting_binds[pod.uid] = _WaitingBind(
                         qi=qi, node_name=node_name, fw=fw,
-                        reserved=reserved, since=self.clock())
+                        reserved=reserved, since=self.clock(),
+                        ctx=span_ctx)
                     self.gangs.note_waiting(pod, node_name)
                     return _PERMIT_WAIT
                 rollback()
                 return False
-        return self._finish_bind(fw, pod, node_name, reserved)
+        return self._finish_bind(fw, pod, node_name, reserved,
+                                 span_ctx=span_ctx)
 
     def _finish_bind(self, fw, pod: v1.Pod, node_name: str,
-                     reserved: List) -> bool:
+                     reserved: List, span_ctx=None) -> bool:
         """The post-Permit half of the binding cycle (PreBind → Bind →
         PostBind), shared by the synchronous path and the waiting-bind
-        flush; rolls back ``reserved`` on failure."""
+        flush; rolls back ``reserved`` on failure.  ``span_ctx`` is the
+        attempt-tree context handed to the store so its WAL append/fsync
+        spans link under this bind (sim/store.py bind_pod)."""
 
         def rollback():
             self.waiting_pods.remove(pod.uid)
@@ -2745,8 +3007,12 @@ class TPUScheduler:
             raise _TransientBindError("fencing check failed: not the "
                                       "current leader")
         try:
-            ok = self.store.bind_pod(pod.namespace, pod.metadata.name,
-                                     node_name)
+            if self.tracer.enabled and self._bind_takes_trace:
+                ok = self.store.bind_pod(pod.namespace, pod.metadata.name,
+                                         node_name, trace_parent=span_ctx)
+            else:
+                ok = self.store.bind_pod(pod.namespace, pod.metadata.name,
+                                         node_name)
         except Exception as e:
             # transport fault that outlived the client's retries: rollback,
             # then surface as _TransientBindError so the caller requeues to

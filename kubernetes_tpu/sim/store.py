@@ -489,7 +489,13 @@ class ObjectStore:
 
     # --- binding subresource --------------------------------------------------
 
-    def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:
+    def bind_pod(self, namespace: str, name: str, node_name: str,
+                 trace_parent=None) -> bool:
+        """``trace_parent`` (a component_base.trace.SpanContext, or None) is
+        the scheduler's explicit span handoff: the WAL's append/fsync spans
+        for this bind link into the caller's attempt tree instead of
+        floating as roots.  Callers probe for the kwarg (the informer's
+        signature-probing idiom) so facades without it keep working."""
         if self.fault is not None:
             self.fault.write_fault("bind", "Pod", name)
             if self.wal is not None:
@@ -504,7 +510,7 @@ class ObjectStore:
                 # the same node — instead of losing an acknowledged binding
                 self.wal.append("bind", "Pod", namespace=namespace,
                                 name=name, node_name=node_name,
-                                rv=self._rv + 1)
+                                rv=self._rv + 1, trace_parent=trace_parent)
             pod.spec.node_name = node_name
             self._rv += 1
             pod.metadata.resource_version = self._rv

@@ -86,10 +86,17 @@ class WriteAheadLog:
     own lock, but the CLI/status path reads sizes concurrently)."""
 
     def __init__(self, path: str, scheme=None, fsync_every: int = 64,
-                 exempt_kinds=frozenset({"Event"})):
+                 exempt_kinds=frozenset({"Event"}), tracer=None):
+        from ..component_base.trace import NOOP_TRACER
+
         self.path = path
         self._scheme = scheme  # lazy: default_scheme pulls in controllers
         self.fsync_every = fsync_every
+        # span tracer (component_base/trace.py): wal_append/wal_fsync spans
+        # per durable write, linked into the caller's attempt tree via the
+        # explicit trace_parent handoff (bind_pod threads it through).
+        # NOOP by default — a disabled tracer costs one attribute read.
+        self.tracer = tracer or NOOP_TRACER
         # kinds NOT logged (their appends are silent no-ops): Events are
         # best-effort by contract (client/events.py retains-and-flushes,
         # losses are counted, the reference keeps them in a dedicated
@@ -114,7 +121,8 @@ class WriteAheadLog:
         return self._scheme
 
     def append(self, op: str, kind: str, *, obj=None, namespace: str = "",
-               name: str = "", node_name: str = "", rv: int = 0) -> None:
+               name: str = "", node_name: str = "", rv: int = 0,
+               trace_parent=None) -> None:
         """Durably log one mutation BEFORE the store applies it in memory.
 
         Raises on any failure (I/O error, injected torn write) — the store
@@ -135,6 +143,13 @@ class WriteAheadLog:
             manifest = None
         rec = WALRecord(op=op, kind=kind, namespace=namespace, name=name,
                         rv=rv, manifest=manifest, node_name=node_name)
+        # wal_append span: parented to the caller's attempt tree when the
+        # explicit trace_parent handoff carried one (store bind path); a
+        # direct store write without a context records a root span.  Guarded
+        # so the disabled tracer costs one attribute read per append.
+        span = (self.tracer.span("wal_append", parent=trace_parent,
+                                 op=op, kind=kind, rv=rv)
+                if self.tracer.enabled else None)
         payload = rec.payload()
         blob = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         keep = maybe_torn_write(len(blob))
@@ -154,22 +169,30 @@ class WriteAheadLog:
             self._since_fsync += 1
             m.wal_records.inc((op,))
             m.wal_size_bytes.set(float(self._size))
+        if span is not None:
+            span.finish()
         # the acknowledged-but-unsynced window: record bytes are in the OS
         # buffer, fsync has not run — the registered kill-point sits exactly
         # here so the crash battery exercises replay from this state
         maybe_crash(CRASH_PRE_WAL_FSYNC)
         if self.fsync_every and self._since_fsync >= self.fsync_every:
-            self.sync(rv)
+            self.sync(rv, trace_parent=trace_parent)
 
-    def sync(self, rv: int = 0) -> None:
+    def sync(self, rv: int = 0, trace_parent=None) -> None:
         """fsync the file; ``rv`` (when known) records the durability
-        watermark served by ``ktpu controlplane status``."""
+        watermark served by ``ktpu controlplane status``.  ``trace_parent``
+        links the fsync span to the append (and attempt tree) that
+        triggered the cadence."""
+        span = (self.tracer.span("wal_fsync", parent=trace_parent, rv=rv)
+                if self.tracer.enabled else None)
         with self._lock:
             os.fsync(self._f.fileno())
             self._since_fsync = 0
             if rv:
                 self._last_fsync_rv = rv
                 m.wal_last_fsync_rv.set(float(rv))
+        if span is not None:
+            span.finish()
 
     def close(self) -> None:
         with self._lock:

@@ -1,3 +1,18 @@
 """Shared utilities (pytree registration, clocks, heaps)."""
 
 from .pytrees import register_pytree_dataclass  # noqa: F401
+
+
+def takes_kwarg(fn, name: str) -> bool:
+    """Signature-probe: does ``fn`` accept keyword ``name``?  The shared
+    idiom behind optional-kwarg handoffs across pluggable boundaries
+    (store facades' ``bind_pod(trace_parent=)``, informer callbacks) —
+    probe once and cache at the call site, never per call.  Unprobeable
+    callables (builtins, C extensions) answer False: the caller falls
+    back to the plain form."""
+    import inspect
+
+    try:
+        return name in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False

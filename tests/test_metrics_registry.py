@@ -1,0 +1,156 @@
+"""metrics/registry render_text ↔ parse_text round-trip battery (ISSUE-14
+satellite): the `ktpu slo --server` and `ktpu controlplane status --server`
+paths re-derive histogram quantiles and counter/gauge values from the text
+exposition, so the codec must round-trip histogram buckets (incl. +Inf),
+escaped/empty/weird label values, and large/small magnitudes exactly."""
+
+import math
+import random
+import string
+
+import pytest
+
+from kubernetes_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    bucket_counts_from_series,
+    exponential_buckets,
+    parse_text,
+    quantile_from_counts,
+    render_text,
+)
+
+WEIRD = ['', 'plain', 'with,comma', 'with"quote', 'back\\slash',
+         'new\nline', 'tab\tchar', 'ünïcode-ζ', 'le="0.5"', 'a,b\\,c"']
+
+
+def test_counter_gauge_roundtrip_weird_labels():
+    reg = Registry()
+    c = reg.register(Counter("requests_total"))
+    g = reg.register(Gauge("depth"))
+    c.inc(("with,comma", 'with"quote'), by=3)
+    c.inc(("back\\slash",), by=2.5)
+    c.inc((), by=1)
+    g.set(-4.25, ("new\nline", ""))
+    g.set(7, ())
+    parsed = parse_text(render_text(reg))
+    assert parsed[("requests_total", ("with,comma", 'with"quote'))] == 3
+    assert parsed[("requests_total", ("back\\slash",))] == 2.5
+    assert parsed[("requests_total", ())] == 1
+    assert parsed[("depth", ("new\nline", ""))] == -4.25
+    assert parsed[("depth", ())] == 7
+
+
+def test_single_empty_label_value_is_the_documented_lossy_corner():
+    """('',) renders label="" which parses back to () — kept for
+    back-compat (ktpu nodehealth looks both keys up)."""
+    reg = Registry()
+    g = reg.register(Gauge("zone_state"))
+    g.set(2.0, ("",))
+    parsed = parse_text(render_text(reg))
+    assert ("zone_state", ()) in parsed
+    # inside a tuple, empty values survive exactly
+    g2 = reg.register(Gauge("pair"))
+    g2.set(1.0, ("", "x"))
+    parsed = parse_text(render_text(reg))
+    assert parsed[("pair", ("", "x"))] == 1.0
+
+
+def test_histogram_buckets_count_sum_and_inf_roundtrip():
+    reg = Registry()
+    h = reg.register(Histogram("lat_seconds", [0.1, 1.0, 10.0]))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):  # one lands in +Inf overflow
+        h.observe(v, ("phase,x",))
+    text = render_text(reg)
+    assert 'le="+Inf"' in text
+    parsed = parse_text(text)
+    assert parsed[("lat_seconds_count", ("phase,x",))] == 5
+    assert parsed[("lat_seconds_sum", ("phase,x",))] == pytest.approx(56.05)
+    # cumulative bucket series, in le order
+    assert parsed[("lat_seconds_bucket", ("phase,x", "0.1"))] == 1
+    assert parsed[("lat_seconds_bucket", ("phase,x", "1"))] == 3
+    assert parsed[("lat_seconds_bucket", ("phase,x", "10"))] == 4
+    assert parsed[("lat_seconds_bucket", ("phase,x", "+Inf"))] == 5
+    # reconstruction: exact per-bucket counts + remote quantile == live
+    per = bucket_counts_from_series(parsed, "lat_seconds")
+    uppers, counts = per[("phase,x",)]
+    assert counts == [1, 2, 1, 1]
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert quantile_from_counts(uppers, counts, q) == pytest.approx(
+            h.quantile(q, ("phase,x",)))
+
+
+def test_roundtrip_property_randomized():
+    """Seeded property sweep: random registries of all three metric types
+    with adversarial label tuples render → parse to the exact same series
+    map, and every histogram's remote quantiles match the live ones."""
+    rng = random.Random(1405)
+    alphabet = string.ascii_letters + string.digits + ',"\\\n =+{}'
+    for trial in range(20):
+        reg = Registry()
+        live_hists = []
+        expected = {}
+        for mi in range(rng.randint(1, 6)):
+            name = f"m{trial}_{mi}"
+            kind = rng.choice(["counter", "gauge", "hist"])
+            labelsets = []
+            for _ in range(rng.randint(1, 4)):
+                n = rng.randint(0, 3)
+                t = tuple(
+                    "".join(rng.choice(alphabet)
+                            for _ in range(rng.randint(0, 8)))
+                    for _ in range(n))
+                if len(t) == 1 and t[0] == "":
+                    t = ("x",)  # the documented lossy corner, tested above
+                labelsets.append(t)
+            if kind == "counter":
+                met = reg.register(Counter(name))
+                for t in labelsets:
+                    v = round(rng.uniform(0, 1e6), 3)
+                    met.inc(t, by=v)
+                    expected[(name, t)] = expected.get((name, t), 0) + v
+            elif kind == "gauge":
+                met = reg.register(Gauge(name))
+                for t in labelsets:
+                    v = round(rng.uniform(-1e3, 1e3), 6)
+                    met.set(v, t)
+                    expected[(name, t)] = v
+            else:
+                met = reg.register(Histogram(
+                    name, exponential_buckets(0.001, 4, rng.randint(2, 8))))
+                for t in labelsets:
+                    for _ in range(rng.randint(1, 30)):
+                        met.observe(rng.uniform(0, 10.0), t)
+                live_hists.append((name, met, labelsets))
+        parsed = parse_text(render_text(reg))
+        for (name, t), v in expected.items():
+            assert parsed[(name, t)] == pytest.approx(v), (trial, name, t)
+        for name, met, labelsets in live_hists:
+            per = bucket_counts_from_series(parsed, name)
+            for t in set(labelsets):
+                assert parsed[(f"{name}_count", t)] == met.count(t)
+                assert parsed[(f"{name}_sum", t)] == pytest.approx(
+                    met.sum(t), rel=1e-6)
+                uppers, counts = per[t]
+                assert sum(counts) == met.count(t)
+                for q in (0.5, 0.9, 0.99):
+                    assert quantile_from_counts(
+                        uppers, counts, q) == pytest.approx(
+                            met.quantile(q, t), rel=1e-6, abs=1e-12)
+
+
+def test_parse_ignores_comments_blanks_and_garbage():
+    parsed = parse_text(
+        "# HELP x y\n\nnot a metric line at all { } ] [\n"
+        "ok_total 3\nbad_value{label=\"a\"} notafloat\n")
+    assert parsed == {("ok_total", ()): 3.0}
+
+
+def test_quantile_from_counts_edge_cases():
+    assert quantile_from_counts([1.0], None, 0.5) == 0.0
+    assert quantile_from_counts([1.0], [0, 0], 0.5) == 0.0
+    # all mass in +Inf overflow: quantile rails at the top finite edge
+    assert quantile_from_counts([1.0, 2.0], [0, 0, 5], 0.5) == 2.0
+    assert not math.isinf(quantile_from_counts([1.0, 2.0], [0, 0, 5], 0.99))

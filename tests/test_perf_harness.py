@@ -33,8 +33,16 @@ def test_workload_basic_with_metrics():
     for k in ("snapshot", "compile", "host_prepare", "partition",
               "dispatch", "fetch", "bind"):
         assert phases[k] >= 0.0, (k, phases)
+    # span-reconstructed per-phase attempt latency (round 14): one record
+    # per measured pod, tiling-phase sum within 10% of the attempt p50
+    apl = by_metric["AttemptPhaseLatency"]
+    assert apl.data["Records"] >= 16
+    for ph in ("dispatch", "device", "bind"):
+        assert apl.data[f"{ph}_Perc99"] >= apl.data[f"{ph}_Perc50"] >= 0
+    assert 0.9 <= apl.data["Coverage"] <= 1.1, apl.data
+    assert apl.labels["TraceArtifact"] == ""  # KTPU_TRACE_DIR unset here
     doc = json.loads(data_items_to_json(items))
-    assert doc["version"] == "v1" and len(doc["dataItems"]) == 6
+    assert doc["version"] == "v1" and len(doc["dataItems"]) == 7
 
 
 def test_workload_churn():

@@ -1364,3 +1364,62 @@ def test_instrumented_object_store_runs_clean():
         mon.assert_clean()
     finally:
         lockcheck.deactivate()
+
+
+# --- span-catalog (ISSUE-14) -------------------------------------------------
+
+
+SPAN_TRACE_SRC = """
+SPAN_CATALOG = frozenset({"attempt", "dispatch", "ghost_entry"})
+
+
+class Tracer:
+    def span(self, name, parent=None, **attrs):
+        return name
+"""
+
+
+def test_span_catalog_unknown_dynamic_and_unused():
+    findings = analyze({
+        "kubernetes_tpu/component_base/trace.py": SPAN_TRACE_SRC,
+        "kubernetes_tpu/sched.py": """
+        def cycle(tracer, phase):
+            tracer.span("attempt")            # fine
+            tracer.span("dispatch")           # fine
+            tracer.span("dispatchh")          # typo: unknown-span
+            tracer.span(phase)                # dynamic-span
+        """,
+    }, ["span-catalog"])
+    got = rules(findings)
+    assert ("span-catalog", "unknown-span") in got
+    assert ("span-catalog", "dynamic-span") in got
+    # "ghost_entry" is cataloged but never emitted
+    unused = [f for f in findings if f.rule == "unused-span"]
+    assert len(unused) == 1 and "ghost_entry" in unused[0].message
+    # catalog hits anchor at the emitting module, unused at the catalog
+    assert all(f.path == "kubernetes_tpu/sched.py" for f in findings
+               if f.rule in ("unknown-span", "dynamic-span"))
+    assert unused[0].path.endswith("component_base/trace.py")
+
+
+def test_span_catalog_clean_fixture_and_no_trace_module():
+    clean = analyze({
+        "kubernetes_tpu/component_base/trace.py": SPAN_TRACE_SRC,
+        "kubernetes_tpu/sched.py": """
+        def cycle(tracer):
+            tracer.span("attempt")
+            tracer.span("dispatch")
+            tracer.span("ghost_entry")
+        """,
+    }, ["span-catalog"])
+    assert clean == []
+    # without the trace module (or its catalog), the check stays silent
+    assert analyze({"kubernetes_tpu/x.py": """
+    def f(t):
+        t.span("whatever")
+    """}, ["span-catalog"]) == []
+
+
+def test_span_catalog_registered_and_repo_clean(repo_findings):
+    assert "span-catalog" in CHECK_REGISTRY
+    assert [f for f in repo_findings if f.check == "span-catalog"] == []

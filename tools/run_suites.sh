@@ -82,6 +82,15 @@ JAX_PLATFORMS=cpu timeout 900 python -m pytest \
 JAX_PLATFORMS=cpu timeout 1200 python -m pytest \
   tests/test_batch_assign.py tests/test_deep_pipeline.py -q -m 'not slow' \
   || { echo "FAILED: affinity-dedup parity gate" >> suites_run.log; exit 1; }
+# tracer-overhead gate (round 14): the span tracer rides every suite below
+# (the per-phase attempt-latency blocks come from it) — a disabled-tracer
+# footprint >= 1% of per-pod cost would mean the observability tax leaked
+# into the production path, so prove it cheap BEFORE measuring anything
+JAX_PLATFORMS=cpu timeout 900 python tools/bench_trace_overhead.py > BENCH_r14_TRACE_OVERHEAD.json \
+  || { echo "FAILED: tracer overhead gate" >> suites_run.log; exit 1; }
+# every suite run below writes a Perfetto-loadable Chrome-trace JSONL
+# artifact (harness ChromeTraceExporter) next to its bench row
+export KTPU_TRACE_DIR=trace_artifacts
 run() {
   local suite="$1" size="$2" line
   echo "=== $suite/$size $(date +%H:%M:%S) ===" >> suites_run.log
@@ -116,9 +125,38 @@ n = d["detail"]["xla_compiles_in_window"]["count"]
 sys.exit(0 if n == 0 else 1)
 PYEOF
 }
+# span-observatory gate: each gated suite's bench row must carry the
+# per-phase attempt-latency block reconstructed from spans — with the sum
+# of tiling-phase p50s within 10% of the measured attempt p50 (no
+# unattributed wall-clock) — and a non-empty Perfetto artifact on disk
+gate_phase_block() {
+  local suite="$1" line
+  line=$(grep "\"workload\": \"$suite/" "$OUT" | tail -1)
+  if [ -z "$line" ]; then
+    echo "FAILED: phase gate found no row for $suite" >> suites_run.log
+    exit 1
+  fi
+  python - "$line" <<'PYEOF' || { echo "FAILED: $suite attempt-phase block/trace artifact" >> suites_run.log; exit 1; }
+import json, os, sys
+d = json.loads(sys.argv[1])
+apl = d["detail"].get("attempt_phase_latency") or {}
+phases = apl.get("phases_ms") or {}
+assert apl.get("records", 0) > 0, "no per-pod span records"
+for ph in ("dispatch", "device", "bind"):
+    q = phases.get(ph) or {}
+    assert all(k in q for k in ("p50", "p90", "p99")), f"missing {ph} quantiles"
+cov = apl.get("coverage", 0.0)
+assert 0.9 <= cov <= 1.1, f"phase-sum coverage {cov} outside 10% of attempt p50"
+art = apl.get("trace_artifact", "")
+assert art and os.path.getsize(art) > 0, f"missing/empty trace artifact {art!r}"
+sys.exit(0)
+PYEOF
+}
 run SchedulingBasic 5000Nodes
+gate_phase_block SchedulingBasic
 run SchedulingPodAntiAffinity 5000Nodes
 gate_zero_compiles SchedulingPodAntiAffinity
+gate_phase_block SchedulingPodAntiAffinity
 run SchedulingPodAffinity 5000Nodes
 gate_zero_compiles SchedulingPodAffinity
 run TopologySpreading 5000Nodes
@@ -136,6 +174,7 @@ run SchedulingExtender 500Nodes
 # the async-extender round walk (round 12) is only a win at zero in-window
 # compiles — same discipline as the affinity suites above
 gate_zero_compiles SchedulingExtender
+gate_phase_block SchedulingExtender
 # no-extender comparison point at the same shape
 run SchedulingBasic 500Nodes
 # the production-scale row (ROADMAP item 1): 100,352 nodes scheduled LIVE
@@ -144,6 +183,7 @@ run SchedulingBasic 500Nodes
 # of stall and taints the whole row
 run NorthStar 100kNodes
 gate_zero_compiles NorthStar
+gate_phase_block NorthStar
 dline=$(BENCH_SUITE=Density BENCH_SIZE=1000Nodes/30000Pods BENCH_ORACLE_SAMPLE=4 \
   timeout 3000 python bench.py 2>> suites_run.log | tail -1)
 if [ -n "$dline" ] && python -c "import json,sys; json.loads(sys.argv[1])" "$dline" 2>/dev/null; then
