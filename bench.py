@@ -48,6 +48,12 @@ def run_named(suite: str, size: str, scale: float):
     batch = os.environ.get("BENCH_BATCH")
     w = build_workload(suite, size, scale=scale,
                        batch_size=max(1, int(batch)) if batch else None)
+    # A/B knob (tools/build_r15_latency.py): override the suite's adaptive
+    # micro-bucket latency target — "0" disables (the full-batch baseline
+    # arm), any other float replaces the suite default in ms
+    lt = os.environ.get("BENCH_LATENCY_TARGET")
+    if lt is not None:
+        w.latency_target_ms = float(lt) or None
     t0 = time.perf_counter()
     items = run_workload(w)
     wall = time.perf_counter() - t0

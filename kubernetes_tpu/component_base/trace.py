@@ -63,6 +63,9 @@ SPAN_CATALOG = frozenset({
     "host_prepare",     # framework host_prepare (PreFilter/PreScore analog)
     "device_enqueue",   # fused-program dispatch (enqueue only, no fetch)
     "device_wait",      # program enqueue -> decisions host-side (bg fetch)
+    "sync_overlap",     # background snapshot/sync + scatter-build (the
+                        # off-critical-path prep for the NEXT dispatch,
+                        # overlapping the just-dispatched batch's window)
     "extender_rounds",  # the extender round walk (callouts + ledger)
     "complete",         # fetch join + cache assumes (_complete)
     "bind_phase",       # the batch's binding cycle (reserve/permit/bind)

@@ -120,6 +120,13 @@ class KubeSchedulerConfiguration:
     # shards over the first n devices.  Mirrors chain_affinity's
     # backend-gating pattern (TPUScheduler sharding=).
     node_axis_sharding: object = "auto"
+    # attempt-latency target for the adaptive micro-bucket dispatch policy
+    # (no upstream analog — the batched device path's lever on per-attempt
+    # latency: dedup-eligible constraint-free batches split into pow-2
+    # sub-buckets riding the deep pipeline until the recent attempt p99
+    # fits under this budget).  None = off: every cycle pads to the full
+    # batch size.  Mirrors TPUScheduler latency_target_ms.
+    latency_target_ms: Optional[float] = None
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "KubeSchedulerConfiguration":
@@ -142,6 +149,13 @@ class KubeSchedulerConfiguration:
             raise ValueError(
                 f"nodeAxisSharding {sharding} is not a power of two (the "
                 "node-axis mesh requires a power-of-two device count)")
+        lt = d.get("latencyTargetMs")
+        if lt is not None:
+            lt = float(lt)
+            if lt < 0:
+                raise ValueError(f"latencyTargetMs must be >= 0, got {lt}")
+            if lt == 0:
+                lt = None  # 0 = explicit off, same as absent
         return cls(
             profiles=profiles,
             parallelism=int(d.get("parallelism", 16)),
@@ -149,6 +163,7 @@ class KubeSchedulerConfiguration:
             pod_initial_backoff_seconds=float(d.get("podInitialBackoffSeconds", 1)),
             pod_max_backoff_seconds=float(d.get("podMaxBackoffSeconds", 10)),
             node_axis_sharding=sharding,
+            latency_target_ms=lt,
         )
 
     def profile(self, scheduler_name: str = DEFAULT_SCHEDULER_NAME) -> KubeSchedulerProfile:
@@ -260,6 +275,7 @@ def scheduler_from_config(store, cfg: "KubeSchedulerConfiguration", **kwargs):
         for p in cfg.profiles
     }
     kwargs.setdefault("sharding", cfg.node_axis_sharding)
+    kwargs.setdefault("latency_target_ms", cfg.latency_target_ms)
     return TPUScheduler(
         store, profiles=profiles,
         pod_initial_backoff=cfg.pod_initial_backoff_seconds,

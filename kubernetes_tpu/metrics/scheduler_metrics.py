@@ -151,6 +151,30 @@ scheduler_retries = default_registry.register(
     Counter("scheduler_retries_total",
             "Pods requeued through the failure handler instead of dropped")
 )
+
+phase_wall_clamped = default_registry.register(
+    # labels: (phase,) — a phase-wall accumulation came out NEGATIVE and
+    # was clamped to zero.  A negative slice means two timers double-
+    # attributed the same wall-clock (e.g. extender callout wait larger
+    # than the whole dispatch interval it was subtracted from) — exactly
+    # the attribution bug class the per-phase A/B artifacts depend on
+    # never having silently; the old bare max(x, 0.0) hid it.
+    Counter("scheduler_phase_wall_clamped_total",
+            "Negative phase-wall attributions clamped to zero, by phase")
+)
+
+sync_overlap = default_registry.register(
+    # labels: (result,) — how each dispatch consumed the overlapped
+    # background snapshot/sync (see TPUScheduler._spawn_sync_ahead):
+    # "reused" (prepared payload adopted verbatim — nothing changed since
+    # capture), "merged" (top-up diff landed after capture; consumed rows
+    # folded back and the scatter payload rebuilt from live mirrors),
+    # "fallback_node_delete" (a node DELETE arrived after capture — row
+    # reuse could alias the prepared payload, so it was discarded and the
+    # dispatch synced synchronously)
+    Counter("scheduler_sync_overlap_total",
+            "Overlapped-sync consumption per dispatch, by result")
+)
 extender_circuit_state = default_registry.register(
     # labels: (url,) — 0 closed, 1 open, 2 half-open (extender.CircuitBreaker)
     Gauge("extender_circuit_state",

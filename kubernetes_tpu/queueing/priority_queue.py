@@ -173,13 +173,30 @@ class PriorityQueue:
                 if key is None:
                     key = k
                 elif k != key:
-                    info.attempts -= 1  # pop() counted an attempt — undo
                     put_back.append(info)
                     continue
             out.append(info)
-        for info in put_back:
-            self._push_active(info)
+        # through put_back(): attempts un-counted AND last_activation
+        # preserved — a pod repeatedly riding profile-mismatch put-backs
+        # must not have its active-wait attribution restamped every cycle
+        self.put_back(put_back)
         return out
+
+    def put_back(self, infos: Sequence[QueuedPodInfo]) -> None:
+        """Return pods popped this cycle to the active queue untouched — the
+        scheduler's micro-bucket split dispatches only the head of a popped
+        batch and hands the tail straight back.  pop() counted an attempt
+        for each; undo it (the pod was never dispatched).  ``timestamp``
+        AND ``last_activation`` are deliberately preserved: the pod's
+        queue-wait accounting (including the active-wait split the
+        queue_wait span reports) must keep covering the time it spent
+        riding put-back tails — _push_active would otherwise restamp
+        activation every cycle."""
+        for info in infos:
+            info.attempts -= 1
+            la = info.last_activation
+            self._push_active(info)
+            info.last_activation = la
 
     def add_unschedulable(self, info: QueuedPodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:
         """AddUnschedulableIfNotPresent (:387): a move since the cycle started

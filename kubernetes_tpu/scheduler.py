@@ -25,6 +25,7 @@ design:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -310,6 +311,36 @@ class _InFlight:
     # synchronous dispatch slice — the ISSUE-14 bugfix: under
     # pipeline/async_extenders the old dispatch-scoped total was misleading
     trace: object = None
+    # XLA backend-compile count at dispatch (utils/compilemon): the micro-
+    # bucket policy must not feed compile-stalled attempts into its p99 —
+    # a cold shape's first-ever dispatch would otherwise drive the bucket
+    # to the floor on one poisoned sample
+    compiles0: int = -1
+
+
+@dataclass
+class _SyncAhead:
+    """The overlapped snapshot/sync handoff (see _spawn_sync_ahead): one
+    background build of the NEXT dispatch's snapshot + deferred-scatter
+    payload, running during the just-dispatched batch's device window.
+    Explicit-handoff discipline like _bg_fetch and the async extender walk:
+    the record carries everything across the thread seam, _complete joins
+    the thread before any cache assume, and the next dispatch consumes (or
+    discards) the payload."""
+
+    thread: object = None
+    dsnap: object = None
+    upd: object = None
+    # dirty-row sets the background to_device_deferred consumed — folded
+    # back via encoder.restore_dirty when the payload is discarded/merged
+    consumed: object = None
+    # node-delete generation AT CAPTURE (read under the sync lock): a later
+    # delete can free an encoder row the dispatch-time top-up reuses, and
+    # the prepared payload would then scatter the DEAD node's rows over the
+    # new owner — mismatch forces the synchronous fallback
+    node_del_gen: int = -1
+    dic_len: int = -1
+    error: object = None
 
 
 class TPUScheduler:
@@ -337,6 +368,8 @@ class TPUScheduler:
         fence=None,
         sharding: object = "auto",
         tracer=None,
+        overlap_sync: object = "auto",
+        latency_target_ms: Optional[float] = None,
     ):
         """``profiles`` maps schedulerName → plugins factory (domain_cap →
         [PluginWithWeight]); each profile gets its own framework + compiled
@@ -385,7 +418,49 @@ class TPUScheduler:
         self.phase_wall: Dict[str, float] = {
             k: 0.0 for k in ("snapshot", "compile", "host_prepare",
                              "partition", "dispatch", "fetch",
-                             "extender_wait", "bind")}
+                             "extender_wait", "bind",
+                             # queue_wait: _await_backoff_wave hold time —
+                             # previously unattributed, silently inflating
+                             # whatever the caller measured around the
+                             # cycle; sync_overlap: the background
+                             # snapshot/sync wall (OFF the critical path —
+                             # do not sum it into cycle wall)
+                             "queue_wait", "sync_overlap")}
+        # Off-critical-path snapshot/sync (round 15): at the end of a
+        # pipelined cycle a background thread runs cache.update_snapshot +
+        # encoder.sync + the deferred scatter-build for the NEXT dispatch,
+        # overlapping the just-dispatched batch's device window (the fetch
+        # joins release the GIL; on a tunnel-attached TPU the whole round
+        # trip).  _complete joins the thread before any cache assume; the
+        # dispatch consumes the payload with a generation-gated top-up
+        # (see _take_sync_ahead / _deferred_snapshot).  "auto" = on exactly
+        # when the pipeline is: a synchronous scheduler would join the
+        # thread immediately after spawning it — pure overhead.
+        if overlap_sync == "auto":
+            overlap_sync = pipeline
+        self.overlap_sync = bool(overlap_sync)
+        self._sync_ahead: Optional[_SyncAhead] = None
+        # Micro-bucket pipelined dispatch (round 15): dedup-eligible
+        # constraint-free batches split into pow-2 sub-buckets riding the
+        # existing deep-pipeline chain, so a pod's attempt latency tracks
+        # the SUB-BUCKET round trip while aggregate throughput rides
+        # pipeline depth.  latency_target_ms arms the adaptive policy
+        # (_pick_bucket): dispatch at the largest PROFILED tier under
+        # target, descending ONE unprofiled tier at a time while every
+        # profiled tier overruns — at most O(log batch_size) one-off
+        # compiles over the process life, the pow-2 tier-growth
+        # discipline.  The perf harness instead profiles every tier
+        # pre-window via _forced_bucket bursts, so measured windows stay
+        # at zero in-window compiles.  None = off: every cycle pads to
+        # batch_size, byte-identical to the round-14 path.
+        self.latency_target_ms = latency_target_ms
+        self._forced_bucket: Optional[int] = None  # warmup override
+        # pad tier → EMA of per-batch max attempt latency (the p99 proxy a
+        # batch's near-uniform attempts make exact enough, and conservative:
+        # max ≥ p99).  Fed by _bind_phase from compile-clean batches only;
+        # _bucket_from_latency picks the largest profiled tier under target.
+        self._tier_p99: Dict[int, float] = {}
+        self._last_wave_wait = 0.0
         # Span tracer (component_base/trace.py): one span tree per
         # dispatched batch — attempt root, queue_wait, dispatch (snapshot/
         # compile/host_prepare/device_enqueue), device_wait or
@@ -989,6 +1064,15 @@ class TPUScheduler:
         if infos and self.gangs.active:
             infos = self._gang_prefilter(infos, stats)
         next_interacts = self._infos_block_deep(infos) if infos else True
+        # Micro-bucket split (round 15): a dedup-eligible constraint-free
+        # batch dispatches only its head sub-bucket; the tail goes straight
+        # back to the active queue and rides the next cycles' back-to-back
+        # chained dispatches — attempt latency then tracks the SUB-BUCKET
+        # device round instead of the whole batch's.
+        pad = self._pick_bucket(infos, next_interacts)
+        if len(infos) > pad:
+            self.queue.put_back(infos[pad:])
+            infos = infos[:pad]
         # an affinity-carrying in-flight batch can only be chained under a
         # batch that will itself build an InterPodAffinity aux (otherwise
         # the prev batch's anti/score terms would have no tables to land in)
@@ -999,15 +1083,24 @@ class TPUScheduler:
         # would make the in-flight delta rows charge the wrong node).  Depth
         # D keeps up to D-1; a depth-3 steady state completes batches TWO
         # dispatches old, whose programs have long landed — the fetch join
-        # costs ~0 instead of a full tunnel round.
+        # costs ~0 instead of a full tunnel round.  Sub-bucketed cycles cap
+        # the tail at 1 (completions then one window old): at depth 3 a
+        # pod's decision is ~3 bucket-windows from its pop, at depth 2 ~2 —
+        # the device stays saturated either way as long as per-cycle host
+        # work fits under one bucket window, so the shallower chain is pure
+        # latency win at micro-bucket sizes.
         tail = 0
         if bool(infos) and self.pipeline and not self.extenders \
                 and not next_interacts:
-            limit = self.pipeline_depth - 1
+            limit = 1 if pad < self.batch_size else self.pipeline_depth - 1
             for fl in reversed(inflight):
                 if (tail >= limit or fl.interacts
                         or (fl.has_aff and not next_has_aff)
-                        or fl.node_del_gen != self._node_del_gen):
+                        or fl.node_del_gen != self._node_del_gen
+                        # a carry whose arrays are another pad tier would
+                        # compile a fresh delta-slot pytree variant — break
+                        # the chain across tier changes instead
+                        or fl.batch.size != pad):
                     break
                 tail += 1
         # complete (fetch + assume) everything except the chained tail
@@ -1035,7 +1128,8 @@ class TPUScheduler:
             prevs = list(inflight[-tail:]) if tail else None
             try:
                 nxt = self._dispatch_batch(infos, prevs=prevs,
-                                           interacts=next_interacts)
+                                           interacts=next_interacts,
+                                           pad=pad)
             except Exception as e:
                 # whole-cycle fault (store outage mid-dispatch, extender
                 # transport collapse, device error): route through the
@@ -1074,6 +1168,14 @@ class TPUScheduler:
         stats.waiting = len(self._waiting_binds)
         stats.in_flight = sum(len(fl.infos) for fl in inflight)
         self._observe_pending()
+        # overlapped sync for the NEXT dispatch: spawned after every cache
+        # write of THIS cycle (assumes, bind confirmations, flushes) so the
+        # background capture carries them all, leaving only between-cycle
+        # external events + the next completes' assumes to the dispatch-time
+        # top-up.  Idle cycles spawn nothing — there is no next dispatch to
+        # prepare for.
+        if self.overlap_sync and (inflight or stats.attempted):
+            self._spawn_sync_ahead()
         return stats
 
     def _gang_prefilter(self, infos: List[QueuedPodInfo],
@@ -1136,29 +1238,251 @@ class TPUScheduler:
         # REAL-time deadline (not self.clock): under an injected fake clock
         # time.sleep would never advance a clock-based deadline and the loop
         # would spin forever — the wait budget is wall time either way
-        real_deadline = time.monotonic() + self.batch_wait
-        while True:
-            # flush FIRST (next_backoff_expiry applies the debounced event
-            # moves + expired backoffs): a just-failed wave sits in pending
-            # moves where pending_count can't see it yet
-            nxt = self.queue.next_backoff_expiry()
-            a, b, _ = self.queue.pending_count()
-            if b == 0 or nxt is None or a >= self.batch_size // 2 or a >= b:
-                return
-            now = self.clock()
-            if time.monotonic() >= real_deadline or nxt - now > self.batch_wait:
-                return
-            time.sleep(min(0.02, max(nxt - now, 0.001)))
+        t_wave = time.monotonic()
+        real_deadline = t_wave + self.batch_wait
+        try:
+            while True:
+                # flush FIRST (next_backoff_expiry applies the debounced
+                # event moves + expired backoffs): a just-failed wave sits
+                # in pending moves where pending_count can't see it yet
+                nxt = self.queue.next_backoff_expiry()
+                a, b, _ = self.queue.pending_count()
+                # threshold on the EFFECTIVE dispatch size: with the
+                # micro-bucket policy engaged, half a full batch_size of
+                # active pods can be many sub-buckets' worth — holding
+                # them batch_wait (0.5 s) for a backoff wave would blow
+                # the very latency target the policy is holding
+                eff = self._bucket_from_latency() \
+                    if self.latency_target_ms is not None else self.batch_size
+                if b == 0 or nxt is None or a >= eff // 2 or a >= b:
+                    return
+                now = self.clock()
+                if time.monotonic() >= real_deadline \
+                        or nxt - now > self.batch_wait:
+                    return
+                time.sleep(min(0.02, max(nxt - now, 0.001)))
+        finally:
+            # attribute the hold into the queue_wait bucket (and, via
+            # _last_wave_wait, onto the next dispatch's queue_wait span):
+            # unattributed it silently inflated whatever the caller timed
+            # around the cycle — corrupting exactly the per-phase A/B
+            # attribution the latency artifacts gate on
+            waited = time.monotonic() - t_wave
+            if waited > 0.0005:
+                self.phase_wall["queue_wait"] += waited
+                self._last_wave_wait += waited
+
+    # --- overlapped snapshot/sync (round 15) ---------------------------------
+
+    def _spawn_sync_ahead(self) -> None:
+        """Start the off-critical-path snapshot/sync for the NEXT dispatch.
+
+        The cache diff (update_snapshot: generation walk + clone of changed
+        NodeInfos) runs HERE, synchronously — it is the cheap half, and
+        capturing it on the spawning thread means the background thread
+        never reads the live cache, so the watch-event handlers (the only
+        concurrent cache writers) need no lock at all.  The expensive half
+        — encoder.sync's per-pod re-encode of every changed node plus the
+        deferred scatter-build — runs on the thread, during the just-
+        dispatched batch's device window: the main thread's fetch joins
+        release the GIL there, so on the CPU backend the python sync work
+        genuinely overlaps device compute, and on a tunnel-attached TPU it
+        overlaps the ~100ms round trips.  Handoff is the _SyncAhead record;
+        _complete joins the thread before any cache assume or encoder read,
+        and the next dispatch consumes the payload via _take_sync_ahead."""
+        if not self.overlap_sync or self._sync_ahead is not None:
+            return
+        rec = _SyncAhead()
+        changed = self.cache.update_snapshot(self.snapshot)
+        rec.node_del_gen = self._node_del_gen
+        # parent the sync_overlap span to the newest in-flight attempt —
+        # the batch whose device window this work overlaps
+        ctx = self._inflight_q[-1].span_ctx if self._inflight_q else None
+        tracer = self.tracer
+
+        def _run():
+            t_s = self.clock()
+            span = (tracer.span("sync_overlap", parent=ctx, start=t_s)
+                    if tracer.enabled else None)
+            try:
+                self.encoder.sync(self.snapshot, changed)
+                rec.consumed = self.encoder.capture_dirty()
+                # consume_force=False: a force_full_next() set while this
+                # thread runs (harness warms) must survive untouched for
+                # the dispatch-time build — the reuse gate re-checks it
+                rec.dsnap, rec.upd = self.encoder.to_device_deferred(
+                    consume_force=False)
+                rec.dic_len = len(self.encoder.dic)
+                if span is not None:
+                    span.set(changed=len(changed),
+                             payload="scatter" if rec.upd is not None
+                             else "full")
+            except Exception as e:  # surfaced at the next dispatch → the
+                rec.error = e       # cycle failure handler requeues
+                klog.V(1).info_s("Overlapped sync failed; next dispatch "
+                                 "will requeue its batch",
+                                 error=f"{type(e).__name__}: {e}")
+                if span is not None:
+                    span.set(error=f"{type(e).__name__}: {e}")
+            # off-critical-path wall, attributed so the overlap win is
+            # measured, not inferred (do NOT sum this into cycle wall)
+            done = self.clock()
+            self.phase_wall["sync_overlap"] += done - t_s
+            if span is not None:
+                span.finish(end=done)
+
+        rec.thread = threading.Thread(target=_run, daemon=True)
+        self._sync_ahead = rec
+        rec.thread.start()
+
+    def join_sync_ahead(self) -> None:
+        """Barrier for EXTERNAL readers of the scheduler's snapshot/encoder
+        (descheduler/autoscaler controllers driven between cycles, tests):
+        joins any in-flight background sync without consuming its payload.
+        Main-thread internal callers use the same join via _join_sync_ahead
+        at every encoder/snapshot touch point."""
+        self._join_sync_ahead()
+
+    def _join_sync_ahead(self) -> None:
+        rec = self._sync_ahead
+        if rec is not None and rec.thread is not None:
+            rec.thread.join()
+            rec.thread = None
+
+    def _take_sync_ahead(self) -> Optional[_SyncAhead]:
+        """Join + consume the pending overlapped sync at dispatch time.
+        Returns the record (payload valid, possibly needing a merge —
+        _deferred_snapshot decides) or None: no sync ran, it failed (the
+        error re-raises into the cycle failure handler), or a node DELETE
+        landed after the capture — the generation guard — in which case the
+        payload is discarded and the dispatch syncs synchronously."""
+        self._join_sync_ahead()
+        rec, self._sync_ahead = self._sync_ahead, None
+        if rec is None:
+            return None
+        if rec.error is not None:
+            # same contract as an inline sync failure: the dispatch dies
+            # and the batch requeues through _handle_cycle_failure
+            raise rec.error
+        if rec.node_del_gen != self._node_del_gen:
+            if rec.upd is not None:
+                self.encoder.restore_dirty(rec.consumed)
+            m.sync_overlap.inc(("fallback_node_delete",))
+            return None
+        # tracked until _deferred_snapshot consumes it: a dispatch dying
+        # between here and there (compile fault, store outage) must fold
+        # the payload's rows back or they never reach the device
+        self._unconsumed_prep = rec
+        return rec
+
+    def _discard_prep(self) -> None:
+        """Failure-path cleanup for a taken-but-unconsumed overlapped-sync
+        payload (see _take_sync_ahead)."""
+        prep = getattr(self, "_unconsumed_prep", None)
+        self._unconsumed_prep = None
+        if prep is not None and prep.upd is not None:
+            self.encoder.restore_dirty(prep.consumed)
+
+    def _deferred_snapshot(self, prep: Optional[_SyncAhead]):
+        """The dispatch-time deferred upload: the overlapped payload is
+        adopted verbatim when nothing changed since its capture; otherwise
+        its consumed rows fold back into the dirty sets and the scatter
+        rebuilds from the live mirrors (values re-gathered, so a top-up
+        that re-encoded one of the payload's rows can never ship the stale
+        version).  No prep → the plain synchronous build."""
+        enc = self.encoder
+        self._unconsumed_prep = None  # consumed (or folded back) below
+        if prep is None:
+            return enc.to_device_deferred()
+        if (not enc.has_dirty() and len(enc.dic) == prep.dic_len
+                and not getattr(enc, "_force_full_once", False)):
+            m.sync_overlap.inc(("reused",))
+            return prep.dsnap, prep.upd
+        if prep.upd is not None:
+            enc.restore_dirty(prep.consumed)
+        m.sync_overlap.inc(("merged",))
+        return enc.to_device_deferred()
+
+    # --- micro-bucket pipelined dispatch (round 15) --------------------------
+
+    def bucket_tiers(self) -> List[int]:
+        """Pow-2 sub-bucket pad tiers below batch_size, largest first, down
+        to the floor (batch_size/16, min 16) — the shapes the adaptive
+        policy may dispatch.  The perf harness warms each tier pre-window
+        (via _forced_bucket) so the policy's warm-tier gate can engage."""
+        out: List[int] = []
+        t = _pow2(self.batch_size, 1) // 2
+        floor = max(16, self.batch_size // 16)
+        while t >= floor:
+            out.append(t)
+            t //= 2
+        return out
+
+    def _pick_bucket(self, infos, interacts: bool) -> int:
+        """The dispatch pad for this cycle.  Full batch_size unless the
+        micro-bucket policy is armed (latency_target_ms) AND the batch is
+        chain-eligible (pipelined, extender-free, non-interacting — the
+        same gate as deep chaining: sub-buckets only pay off when they can
+        ride the chain back-to-back).  _forced_bucket is the harness's
+        warmup override."""
+        B = self.batch_size
+        if self._forced_bucket:
+            return max(1, min(self._forced_bucket, B))
+        if self.latency_target_ms is None or not infos:
+            return B
+        if interacts or self.extenders or not self.pipeline:
+            # interacting batches dispatch shallow at full size: a small
+            # unchained bucket would serialize dispatch against completion
+            # and lose throughput with no latency win
+            return B
+        return self._bucket_from_latency()
+
+    def _bucket_from_latency(self) -> int:
+        """Pick the dispatch tier from the measured per-tier profiles: the
+        LARGEST profiled tier whose EMA'd batch-max attempt latency fits
+        under 90% of the target (largest = highest throughput; the margin
+        absorbs cycle jitter so the window p99 holds), and full batch_size
+        when nothing is profiled yet.  When every profiled tier overruns
+        the target, DESCEND one unprofiled tier below the smallest — its
+        first dispatch compiles the shape once and its post-compile
+        batches profile it, so a cold production scheduler converges in at
+        most O(log batch_size) one-off compiles (the pow-2 tier-growth
+        discipline; compile-stalled attempts never poison the profile —
+        _InFlight.compiles0).  The perf harness pre-profiles every tier
+        with pipelined warm bursts instead, so measured windows descend
+        nowhere and stay at zero in-window compiles."""
+        B = self.batch_size
+        prof = self._tier_p99
+        if not prof:
+            return B
+        tgt = self.latency_target_ms / 1e3
+        cand = dict(prof)
+        if B not in cand:
+            # the full batch is rarely profiled once the policy engages
+            # (only ≥half-full batches feed profiles, and sub-bucketing
+            # keeps the window off B): predict it from its immediate sub-
+            # tier's profile — attempt latency tracks the pad ~linearly —
+            # so a generous target can still climb back to full batches
+            t = max(cand)
+            if 2 * t >= _pow2(B, 1):
+                cand[B] = 2.0 * cand[t]
+        fit = [t for t, p in cand.items() if p <= 0.9 * tgt]
+        if fit:
+            return max(fit)
+        lower = [t for t in self.bucket_tiers() if t < min(prof)]
+        return max(lower) if lower else min(prof)
 
     def _dispatch_batch(self, infos: List[QueuedPodInfo],
                         prevs: Optional[List[_InFlight]] = None,
-                        interacts: Optional[bool] = None) -> _InFlight:
+                        interacts: Optional[bool] = None,
+                        pad: Optional[int] = None) -> _InFlight:
         """Snapshot → compile → ONE device dispatch; decisions fetched
         (blocking) at _complete.  ``prevs`` (deep pipeline) are the still-in-
         flight batches (oldest first, ≤2) whose device-resident decisions
         feed this program as resource deltas; ``interacts`` is the caller's
         already-computed _pods_block_deep result for this batch (recomputed
-        when absent)."""
+        when absent); ``pad`` is the compile pad tier (micro-bucket policy —
+        defaults to batch_size, the round-14 shape)."""
         from .component_base.trace import Trace
 
         t0 = self.clock()
@@ -1185,35 +1509,54 @@ class TPUScheduler:
             self.tracer.span(
                 "queue_wait", parent=ctx, start=earliest,
                 max_wait_ms=round((t0 - earliest) * 1e3, 3),
-                max_active_wait_ms=round(act * 1e3, 3)).finish(end=t0)
+                max_active_wait_ms=round(act * 1e3, 3),
+                # the batch-formation hysteresis hold preceding this pop
+                # (_await_backoff_wave) — attributed here, not smeared
+                # into the next phase
+                backoff_wave_ms=round(self._last_wave_wait * 1e3, 3),
+            ).finish(end=t0)
+        self._last_wave_wait = 0.0
         try:
             return self._dispatch_batch_traced(
                 infos, prevs, interacts, t0, trace, cycle, root, ctx,
-                disp_span)
+                disp_span, pad=pad)
         except Exception as e:
             # a dispatch-time fault must still close the attempt tree (an
             # unfinished root would orphan its already-exported children
             # and strand threshold-exporter buffers) AND dump the legacy
             # step trace — the slow-dispatch diagnostic matters most on
             # exactly the cycles that die
+            self._discard_prep()
             if root is not None:
                 root.set(error=f"{type(e).__name__}: {e}").finish()
             trace.log_if_long(0.1)
             raise
 
     def _dispatch_batch_traced(self, infos, prevs, interacts, t0, trace,
-                               cycle, root, ctx, disp_span) -> _InFlight:
+                               cycle, root, ctx, disp_span,
+                               pad=None) -> _InFlight:
         """_dispatch_batch's body, wrapped by the span/trace failure guard
         above; see _dispatch_batch for the contract."""
+        from .utils.compilemon import monitor as _cmon
+
         self._dispatch_seq += 1
+        pad = pad or self.batch_size
+        compiles0 = _cmon.snapshot()[0]
+        # Overlapped sync (round 15): adopt the background thread's already-
+        # applied snapshot/sync, then TOP-UP the residue — between-cycle
+        # external events plus this cycle's completion assumes, which post-
+        # date the capture by construction.  update_snapshot is generation-
+        # gated, so the top-up only re-encodes what actually changed since.
+        prep = self._take_sync_ahead() if self.overlap_sync else None
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
         t_snap_end = self.clock()
         self.phase_wall["snapshot"] += t_snap_end - t0
         if disp_span is not None:
-            self.tracer.span("snapshot", parent=disp_span,
-                             start=t0).finish(end=t_snap_end)
+            self.tracer.span("snapshot", parent=disp_span, start=t0,
+                             overlapped=prep is not None).finish(
+                end=t_snap_end)
         # fast-bound nominations whose assume this refresh now carries: the
         # reservation would double-count from here on — release it.  Marks
         # from the bind phase that ran after the PREVIOUS dispatch carry
@@ -1225,11 +1568,13 @@ class TPUScheduler:
                 self._nominated.pop(uid, None)
         trace.step("Snapshot update")
         pods = [qi.pod for qi in infos]
-        # fixed padding: every cycle compiles to ONE (batch_size, tier)
-        # program instead of one per pow-2 backlog size — partial batches
-        # reuse the warm executable (first compile is tens of seconds)
+        # fixed padding: every cycle compiles to ONE (pad, tier) program
+        # per bucket tier instead of one per pow-2 backlog size — partial
+        # batches reuse the warm executable (first compile is tens of
+        # seconds).  pad == batch_size unless the micro-bucket policy
+        # shrank this dispatch onto a warmed sub-bucket tier.
         t_c = self.clock()
-        batch = self.compiler.compile(pods, pad_to=self.batch_size)
+        batch = self.compiler.compile(pods, pad_to=pad)
         t_c_end = self.clock()
         self.phase_wall["compile"] += t_c_end - t_c
         if disp_span is not None:
@@ -1277,7 +1622,7 @@ class TPUScheduler:
             # round, so per-attempt latency must not absorb later pods'
             # rounds.  Snapshot scatter + nominations + prepare + the first
             # round's packed plane ride ONE fused program (prepare_packed).
-            dsnap, upd = self.encoder.to_device_deferred()
+            dsnap, upd = self._deferred_snapshot(prep)
             nom_rows, nom_req = self._nominated_arrays(
                 {qi.pod.uid for qi in infos})
             packed0, auxes, dsnap, dyn = jt["prepare_packed"](
@@ -1303,6 +1648,7 @@ class TPUScheduler:
             fl = _InFlight(infos, batch, dsnap, dyn, auxes, None, None,
                            t0, cycle, profile=profile, fw=fw,
                            engine="extender")
+            fl.compiles0 = compiles0
             fl.name_of = dict(self.encoder.row_to_name())
             # dispatch/device phase boundary: the fused first round is
             # enqueued; everything after is the extender round walk
@@ -1371,7 +1717,14 @@ class TPUScheduler:
             # a suite regression now names the extender protocol, not the
             # device program
             self.phase_wall["extender_wait"] += wait
-            self.phase_wall["dispatch"] += max(self.clock() - t_d - wait, 0.0)
+            ew = self.clock() - t_d - wait
+            if ew < 0:
+                # the callout wall exceeded the interval it was timed
+                # inside — a double-attribution bug, not a rounding blip;
+                # count it instead of silently clamping it away
+                m.phase_wall_clamped.inc(("dispatch",))
+                ew = 0.0
+            self.phase_wall["dispatch"] += ew
             fl.node_row_dev = None
             fl.fetched, fl.algo_lat, fl.rounds_np = node_row, algo_lat, ext_rounds
             fl.fetched_at = self.clock()
@@ -1382,7 +1735,7 @@ class TPUScheduler:
                     callout_wait_ms=round(wait * 1e3, 3),
                 ).finish(end=fl.fetched_at)
             return fl
-        dsnap, upd = self.encoder.to_device_deferred()
+        dsnap, upd = self._deferred_snapshot(prep)
         nom_rows, nom_req = self._nominated_arrays({qi.pod.uid for qi in infos})
         deltas = None
         if prevs:
@@ -1430,6 +1783,7 @@ class TPUScheduler:
         fl = _InFlight(infos, batch, dsnap_out, dyn_out, auxes, res.node_row,
                        None, t0, cycle, profile=profile, fw=fw, diag_dev=diag,
                        engine=engine, has_aff=bool(batch.has_affinity))
+        fl.compiles0 = compiles0
         fl.dispatch_end = self.clock()
         fl.trace = trace
         if root is not None:
@@ -1607,6 +1961,12 @@ class TPUScheduler:
             # (the extender path samples per-pod cycles itself)
             m.scheduling_algorithm_duration.observe(algo)
         node_row = np.array(node_row)  # own copy — may be demoted below
+        # overlapped-sync seam: the background update_snapshot reads cache
+        # clones, and the assumes below mutate the cache — join the sync
+        # thread BEFORE the first assume so its capture is a consistent
+        # point-in-time (the fetch join above is exactly the GIL-released
+        # window the sync was spawned to overlap)
+        self._join_sync_ahead()
         # resolve rows through the DISPATCH-time map (see _InFlight.name_of);
         # a node deleted since dispatch fails the cache liveness check below
         # and its pod retries, exactly like the reference's binding-error path
@@ -1668,6 +2028,15 @@ class TPUScheduler:
         dispatch_host = max(fl.dispatch_end - fl.t0, 0.0)
         pod_phases: Optional[List[dict]] = (
             [] if fl.span is not None else None)
+        # micro-bucket policy feed: attempts from compile-stalled batches
+        # are excluded (one cold-shape dispatch would read as a latency
+        # regression and poison the tier's profile)
+        track_lat = self.latency_target_ms is not None
+        if track_lat and fl.compiles0 >= 0:
+            from .utils.compilemon import monitor as _cmon
+
+            track_lat = _cmon.snapshot()[0] == fl.compiles0
+        batch_attempts: List[float] = []
 
         def _note_phases(i, qi, t_pod, now, queued_at, outcome) -> float:
             algo = float(fl.algo_lat[i])
@@ -1899,6 +2268,8 @@ class TPUScheduler:
             now = self.clock()
             attempt = _note_phases(i, qi, t_pod, now, queued_at, outcome)
             m.scheduling_attempt_duration.observe(attempt)
+            if track_lat:
+                batch_attempts.append(attempt)
             # e2e additionally covers the wait since this attempt entered
             # the queue (metrics.go:78-84); the algorithm window overlaps
             # the wait in the pipelined path, so take the max, not the sum
@@ -1939,6 +2310,20 @@ class TPUScheduler:
         # fetch (row 2); the extender path counted its rounds host-side
         if fl.rounds_np is not None:
             m.assignment_rounds.inc((fl.engine,), by=int(fl.rounds_np))
+        if track_lat and batch_attempts \
+                and 2 * len(batch_attempts) >= fl.batch.size:
+            # per-tier latency profile: EMA of the batch's MAX attempt (a
+            # batch's attempts are near-uniform — one program round — so
+            # max is a tight, conservative p99 proxy); α=0.5 adapts within
+            # a few batches when the regime drifts mid-window.  Only
+            # ≥half-full batches feed it: a 1-pod warm padded to 512 runs
+            # one low-contention assignment round and would record a
+            # flattering profile the window's full batches can't hit.
+            pad_t = fl.batch.size
+            hi = max(batch_attempts)
+            prev = self._tier_p99.get(pad_t)
+            self._tier_p99[pad_t] = hi if prev is None \
+                else 0.5 * prev + 0.5 * hi
         if stats.attempted:
             # the EMA drives the speculative candidate-mask dispatch, so it
             # must count attempts that NEEDED preemption — fast-bound pods
@@ -2870,6 +3255,12 @@ class TPUScheduler:
         re-derives its own.  The bind-time fence (``fence``) covers the
         race this hook cannot: work already past Permit when the lease was
         lost."""
+        self._join_sync_ahead()
+        rec, self._sync_ahead = self._sync_ahead, None
+        if rec is not None and rec.error is None and rec.upd is not None:
+            # un-consume the payload: if this replica ever schedules again,
+            # its next upload must still carry these rows
+            self.encoder.restore_dirty(rec.consumed)
         inflight, self._inflight_q = self._inflight_q, []
         for fl in inflight:
             if fl.fetch_thread is not None:
@@ -2904,6 +3295,7 @@ class TPUScheduler:
         unwatch, self._unwatch = getattr(self, "_unwatch", None), None
         if unwatch is not None:
             unwatch()
+        self._join_sync_ahead()  # no background sync may outlive the watch
         recorder = getattr(self, "recorder", None)
         if recorder is not None and flush_events:
             recorder.flush()
@@ -3111,6 +3503,9 @@ class TPUScheduler:
             # not pay the per-pod fit scans below (their dispatch path
             # ignores it) — conservatively block
             return True
+        # the fit scan below reads live encoder mirrors the overlapped
+        # sync thread may be mid-rewrite — barrier first
+        self._join_sync_ahead()
         valid = np.asarray(self.encoder.node_valid)
         free = (self.encoder.allocatable[valid].astype(np.int64)
                 - self.encoder.requested[valid])

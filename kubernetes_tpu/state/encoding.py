@@ -633,7 +633,7 @@ class ClusterEncoder:
         in MixedChurn)."""
         self._force_full_once = True
 
-    def to_device_deferred(self):
+    def to_device_deferred(self, consume_force: bool = True):
         """Like to_device, but returns the row-scatter payload instead of
         executing it: ``(dsnap, upd)`` where ``upd`` is None (full upload
         happened; dsnap is current) or a PendingScatter the caller applies
@@ -643,8 +643,15 @@ class ClusterEncoder:
         made the eager two-scatter + numeric-upload path 3× slower than the
         fused compute itself.  Caller MUST ``commit_device()`` the updated
         DeviceSnapshot returned by its program (the arrays are async —
-        committing the futures immediately is safe)."""
-        if getattr(self, "_force_full_once", False):
+        committing the futures immediately is safe).
+
+        ``consume_force=False`` is the overlapped-sync background build:
+        it must neither honor nor clear ``force_full_next()`` — a caller
+        may set the flag while the thread runs, and only the DISPATCH-time
+        build may consume it (the dispatch reuse gate re-checks the flag,
+        so a flag set after the background build still forces the full
+        path there)."""
+        if consume_force and getattr(self, "_force_full_once", False):
             self._force_full_once = False
             return self.to_device(force_full=True), None
         # Small-cluster fast path: when the node tier is small (≤1024 rows) a
@@ -731,6 +738,31 @@ class ClusterEncoder:
         padded[: rows.shape[0]] = rows
         vals = tuple(getattr(self, k_)[padded] for k_ in names)
         return (padded, vals)
+
+    def has_dirty(self) -> bool:
+        """Any mirror rows dirtied since the last upload consumed them."""
+        return bool(self._dirty_node_rows or self._dirty_pod_rows
+                    or self.aff.dirty)
+
+    def capture_dirty(self):
+        """Copies of the dirty-row sets an imminent to_device_deferred will
+        consume — the overlapped-sync path stashes them so a DISCARDED
+        payload can be undone (restore_dirty)."""
+        return (set(self._dirty_node_rows), set(self._dirty_pod_rows),
+                set(self.aff.dirty))
+
+    def restore_dirty(self, saved) -> None:
+        """Re-mark rows whose to_device_deferred payload the caller
+        discarded without executing (the overlapped-sync fallback/merge
+        paths): the rows never reached the device, so they must ride the
+        next payload.  The numeric-table high-water mark is invalidated too
+        — to_device_deferred stamped it as uploaded when it built the now-
+        discarded payload."""
+        n, p, a = saved
+        self._dirty_node_rows |= n
+        self._dirty_pod_rows |= p
+        self.aff.dirty |= a
+        self._uploaded_numeric_len = -1
 
     def commit_device(self, dsnap: DeviceSnapshot):
         """Adopt a program-updated DeviceSnapshot as the current device state."""

@@ -330,6 +330,237 @@ def test_async_extender_walk_error_requeues_batch():
     ext.close()
 
 
+def test_node_delete_mid_chain_breaks_tail_and_binds_once():
+    """ISSUE-15 satellite: a node DELETE while batches are chained in
+    flight bumps _node_del_gen — the next dispatch must break the deep
+    tail (a freed encoder row the next sync reuses would make the chained
+    delta rows charge the wrong node) and every pod must still bind
+    exactly once, retries included."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, pipeline=True,
+                         pipeline_depth=3)
+    sched.presize(32, 96)
+    _nodes(store, 24)
+    bind_counts = {}
+
+    def on_bind(ev):
+        if ev.kind == "Pod" and ev.obj.spec.node_name:
+            bind_counts[ev.obj.metadata.name] = \
+                bind_counts.get(ev.obj.metadata.name, 0) + 1
+
+    unwatch = store.watch(on_bind)
+    _pods(store, 48)
+    chained_pads = []
+    orig = TPUScheduler._dispatch_batch
+
+    def counting(self, infos, prevs=None, **kw):
+        chained_pads.append(len(prevs) if prevs else 0)
+        return orig(self, infos, prevs=prevs, **kw)
+
+    TPUScheduler._dispatch_batch = counting
+    try:
+        sched.schedule_cycle()  # dispatch B1
+        sched.schedule_cycle()  # dispatch B2 chained on B1
+        assert chained_pads[-1] == 1, "chain never formed"
+        # mid-chain node delete: B1/B2 still in flight
+        store.delete("Node", "", "n000")
+        sched.schedule_cycle()  # next dispatch must NOT chain
+        assert chained_pads[-1] == 0, \
+            "dispatch after a node delete kept the chained tail"
+        sched.run_until_idle()
+    finally:
+        TPUScheduler._dispatch_batch = orig
+    unwatch()
+    sched.close()
+    pods, _ = store.list("Pod")
+    assert all(p.spec.node_name for p in pods), "pod lost after node delete"
+    assert all(p.spec.node_name != "n000" for p in pods)
+    assert all(v == 1 for v in bind_counts.values()), \
+        f"pods bound more than once: {bind_counts}"
+    assert len(bind_counts) == 48
+
+
+def test_overlap_sync_parity_under_randomized_churn():
+    """ISSUE-15 parity pin: background-synced dispatch must equal the
+    synchronous-sync pipeline bit-for-bit under randomized churn including
+    node deletes — and the node-delete-generation fallback path must
+    actually fire (a delete between the background capture and the next
+    dispatch discards the prepared payload)."""
+    from kubernetes_tpu.metrics import scheduler_metrics as m
+
+    def run(overlap):
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=16, pipeline=True,
+                             pipeline_depth=3, overlap_sync=overlap)
+        sched.presize(48, 160)
+        _nodes(store, 24)
+        # churn nodes: NoSchedule-tainted so no pod ever lands on them —
+        # their delete/re-add storms exercise the sync fallback without
+        # making bindings depend on retry timing
+        def churn_node(i):
+            return (make_node().name(f"churn{i}")
+                    .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"})
+                    .taint("churn", "1", "NoSchedule").obj())
+
+        for i in range(4):
+            store.create("Node", churn_node(i))
+        rng = np.random.default_rng(7)
+        pod_i = 0
+        for wave in range(8):
+            for _ in range(12):
+                store.create(
+                    "Pod",
+                    make_pod().name(f"p{pod_i:03d}").uid(f"p{pod_i:03d}")
+                    .namespace("default")
+                    .req({"cpu": str(100 + 50 * (pod_i % 4)) + "m"}).obj())
+                pod_i += 1
+            sched.schedule_cycle()
+            # randomized churn BETWEEN cycles: deletes land after the
+            # background capture, forcing the generation fallback
+            if rng.random() < 0.75:
+                k = int(rng.integers(0, 4))
+                if store.get("Node", "", f"churn{k}") is not None:
+                    store.delete("Node", "", f"churn{k}")
+                else:
+                    store.create("Node", churn_node(k))
+            sched.schedule_cycle()
+        sched.run_until_idle()
+        sched.close()
+        return _bindings(store)
+
+    def fallback_count():
+        return sum(v for (labels, v) in m.sync_overlap.items().items()
+                   if labels and labels[0] == "fallback_node_delete")
+
+    sync_bindings = run(overlap=False)
+    fb0 = fallback_count()
+    overlap_bindings = run(overlap=True)
+    assert overlap_bindings == sync_bindings
+    assert all(v for v in sync_bindings.values())
+    assert fallback_count() > fb0, \
+        "node-delete sync fallback path never exercised"
+
+
+def test_micro_bucket_dispatch_matches_sync_and_shrinks():
+    """ISSUE-15 micro-buckets: with latency_target_ms armed and the tiers
+    warmed, dedup-eligible constraint-free batches must dispatch at sub-
+    bucket pads (riding the deep chain) and produce bindings identical to
+    a synchronous scheduler running the SAME sub-bucket segmentation (the
+    deep-chain parity contract; across different segmentations the auction
+    admits bounded within-round score drift, so that is the exact pin)."""
+
+    def build(lt, batch):
+        store = ObjectStore()
+        sched = TPUScheduler(store, batch_size=batch,
+                             pipeline=lt is not None,
+                             latency_target_ms=lt)
+        sched.presize(32, 256)
+        for i in range(24):
+            store.create(
+                "Node",
+                make_node().name(f"n{i:03d}")
+                .capacity({"cpu": "16", "memory": "32Gi", "pods": "110"})
+                .obj())
+        if lt is not None:
+            # harness-style tier warm bursts: compile each pad + measure
+            # its pipelined latency profile so the policy can engage
+            for tier in sched.bucket_tiers():
+                for j in range(3 * tier):
+                    store.create(
+                        "Pod",
+                        make_pod().name(f"w{tier}x{j}").uid(f"w{tier}x{j}")
+                        .namespace("default").req({"cpu": "10m"}).obj())
+                sched._forced_bucket = tier
+                for _ in range(16):
+                    s = sched.schedule_cycle()
+                    if s.attempted == 0 and s.in_flight == 0:
+                        break
+                for j in range(3 * tier):
+                    store.delete("Pod", "default", f"w{tier}x{j}")
+            sched._forced_bucket = None
+            assert sched._tier_p99, "tier profiles never measured"
+            # pin the target between tier-16's measured profile and the
+            # predicted full-batch latency, so the policy must pick 16
+            sched.latency_target_ms = \
+                1.5e3 * sched._tier_p99[min(sched._tier_p99)]
+        pads = []
+        orig = TPUScheduler._dispatch_batch
+
+        def counting(self, infos, prevs=None, **kw):
+            pads.append(kw.get("pad") or self.batch_size)
+            return orig(self, infos, prevs=prevs, **kw)
+
+        TPUScheduler._dispatch_batch = counting
+        try:
+            for i in range(64):
+                store.create(
+                    "Pod",
+                    make_pod().name(f"p{i:03d}").uid(f"p{i:03d}")
+                    .namespace("default")
+                    .req({"cpu": str(100 + 25 * (i % 3)) + "m"}).obj())
+            sched.run_until_idle()
+        finally:
+            TPUScheduler._dispatch_batch = orig
+        sched.close()
+        return _bindings(store), pads
+
+    # a generous target still engages sub-bucketing: only the warmed sub-
+    # tiers carry profiles at window start, and the policy picks the
+    # largest PROFILED tier under target — 16 for a 32-batch
+    bucketed, pads = build(lt=10_000.0, batch=32)
+    assert any(p < 32 for p in pads), \
+        f"micro-bucket policy never shrank the pad: {pads}"
+    window_pads = {p for p in pads}
+    assert 16 in window_pads, f"expected tier-16 dispatches, got {pads}"
+    # same segmentation, no pipeline: the parity baseline
+    sync_b, _ = build(lt=None, batch=16)
+    want = {k: v for k, v in bucketed.items() if k.startswith("p")}
+    have = {k: v for k, v in sync_b.items() if k.startswith("p")}
+    assert want == have
+    assert all(v for v in want.values())
+
+
+def test_micro_bucket_descends_without_harness_warming():
+    """A COLD production scheduler with latency_target_ms set (no harness
+    tier bursts, no _forced_bucket) must still engage: when every profiled
+    tier overruns the target the policy descends one unprofiled tier at a
+    time — the knob cannot be a harness-only no-op."""
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=32, pipeline=True,
+                         latency_target_ms=0.001)  # unmeetably tight
+    sched.presize(32, 256)
+    for i in range(16):
+        store.create(
+            "Node",
+            make_node().name(f"n{i:03d}")
+            .capacity({"cpu": "16", "memory": "32Gi", "pods": "110"}).obj())
+    pads = []
+    orig = TPUScheduler._dispatch_batch
+
+    def counting(self, infos, prevs=None, **kw):
+        pads.append(kw.get("pad"))
+        return orig(self, infos, prevs=prevs, **kw)
+
+    TPUScheduler._dispatch_batch = counting
+    try:
+        # enough backlog for the profile to form and the descent to land:
+        # the first full batch compiles (profile-excluded) and a batch's
+        # profile only lands at its BIND, one-two cycles after dispatch
+        for i in range(256):
+            store.create(
+                "Pod",
+                make_pod().name(f"p{i:03d}").uid(f"p{i:03d}")
+                .namespace("default").req({"cpu": "50m"}).obj())
+        sched.run_until_idle()
+    finally:
+        TPUScheduler._dispatch_batch = orig
+    sched.close()
+    pods, _ = store.list("Pod")
+    assert all(p.spec.node_name for p in pods)
+    assert min(pads) == 16, \
+        f"cold policy never descended below batch_size: {pads}"
+
+
 def test_deep_pipeline_spread_batches_match_sync():
     """Topology-spread batches deep-chain via chain_prev; bindings must equal
     the synchronous path exactly (the chained count tables reproduce the

@@ -1,0 +1,159 @@
+"""Round-15 same-hardware attempt-latency A/B → BENCH_r15_LATENCY.json.
+
+Two arms of SchedulingBasic/5000Nodes in THIS container, fresh subprocess
+each (same discipline as tools/build_r12_ab.py):
+
+  baseline  BENCH_LATENCY_TARGET=0  — the round-14 shape: full 512-pod
+            batches, synchronous-equivalent latency profile (the committed
+            BENCH_r14_TRACE.json numbers re-measured on today's weather so
+            the ratio is weather-paired, not transcribed)
+  round15   suite default           — micro-bucket pipelined dispatch
+            (latency_target_ms) + overlapped background snapshot/sync
+
+Acceptance (ISSUE 15): attempt p99 ≥5× lower than baseline at ≥90% of
+baseline throughput, zero in-window compiles, phase coverage ∈ [0.9, 1.1].
+The artifact also carries the "gates" block tools/run_suites.sh
+gate_attempt_p99 reads (budget = measured p99 × tolerance; NorthStar's
+budget is a regression bound against the committed BENCH_r09_100K.json
+p99 — the 100k suite has no same-hardware micro-bucket A/B yet).
+
+Usage: python tools/build_r15_latency.py [--passes N] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUITE, SIZE = "SchedulingBasic", "5000Nodes"
+
+
+def _suite_target_ms() -> float:
+    """The measured suite's configured micro-bucket latency target."""
+    sys.path.insert(0, REPO)
+    from kubernetes_tpu.perf.workloads import build_workload
+
+    return build_workload(SUITE, SIZE).latency_target_ms or 0.0
+
+
+def run_arm(latency_target: str | None) -> dict:
+    env = dict(os.environ)
+    env.update(BENCH_SUITE=SUITE, BENCH_SIZE=SIZE, BENCH_ORACLE_SAMPLE="2")
+    if latency_target is not None:
+        env["BENCH_LATENCY_TARGET"] = latency_target
+    else:
+        env.pop("BENCH_LATENCY_TARGET", None)
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=3000, check=True,
+    )
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--passes", type=int, default=2,
+                    help="passes per arm; best-throughput pass is kept "
+                         "(weather moves passes; pass 1 also warms the "
+                         "persistent compile cache)")
+    ap.add_argument("--out", default="BENCH_r15_LATENCY.json")
+    args = ap.parse_args()
+
+    passes = {"baseline": [], "round15": []}
+    for i in range(args.passes):
+        passes["baseline"].append(run_arm("0"))
+        passes["round15"].append(run_arm(None))
+        print(f"pass {i + 1}: baseline p99="
+              f"{passes['baseline'][-1]['detail']['attempt_ms']['p99']:.0f}ms"
+              f" {passes['baseline'][-1]['detail']['throughput_pods_per_s']:.0f}p/s"
+              f" | round15 p99="
+              f"{passes['round15'][-1]['detail']['attempt_ms']['p99']:.0f}ms"
+              f" {passes['round15'][-1]['detail']['throughput_pods_per_s']:.0f}p/s",
+              file=sys.stderr)
+
+    def best(arm):  # steadiest signal: the best-throughput pass of the arm
+        return max(passes[arm], key=lambda d: d["detail"]["throughput_pods_per_s"])
+
+    base, new = best("baseline")["detail"], best("round15")["detail"]
+    p99_ratio = base["attempt_ms"]["p99"] / max(new["attempt_ms"]["p99"], 1e-9)
+    thr_ratio = new["throughput_pods_per_s"] / max(
+        base["throughput_pods_per_s"], 1e-9)
+
+    import multiprocessing
+
+    r09_p99 = None
+    try:
+        r09_p99 = json.load(open(os.path.join(REPO, "BENCH_r09_100K.json")))[
+            "live_suite"]["detail"]["attempt_ms"]["p99"]
+    except (OSError, KeyError, json.JSONDecodeError) as e:
+        # pre-round-9 tree: the NorthStar regression budget is simply
+        # omitted from the gates block
+        print(f"no BENCH_r09_100K baseline ({type(e).__name__}: {e}); "
+              "omitting the NorthStar gate", file=sys.stderr)
+    artifact = {
+        "metric": "attempt_p99_ab",
+        "suite": f"{SUITE}/{SIZE}",
+        "environment": {
+            "backend": new.get("backend", "?"),
+            "cpus": multiprocessing.cpu_count(),
+            "note": "both arms in THIS container, fresh subprocess each, "
+                    "interleaved passes (weather-paired)",
+        },
+        "baseline": base,
+        "round15": new,
+        "baseline_passes_p99_ms": [
+            d["detail"]["attempt_ms"]["p99"] for d in passes["baseline"]],
+        "round15_passes_p99_ms": [
+            d["detail"]["attempt_ms"]["p99"] for d in passes["round15"]],
+        "baseline_passes_pods_per_s": [
+            d["detail"]["throughput_pods_per_s"] for d in passes["baseline"]],
+        "round15_passes_pods_per_s": [
+            d["detail"]["throughput_pods_per_s"] for d in passes["round15"]],
+        "p99_reduction_x": round(p99_ratio, 2),
+        "throughput_vs_baseline": round(thr_ratio, 3),
+        "acceptance": {
+            "p99_reduction_ge_5x": p99_ratio >= 5.0,
+            "throughput_ge_0p9x": thr_ratio >= 0.9,
+            "zero_inwindow_compiles":
+                new["xla_compiles_in_window"]["count"] == 0,
+            "phase_coverage_in_band":
+                0.9 <= new["attempt_phase_latency"]["coverage"] <= 1.1,
+        },
+        # CI budgets (tools/run_suites.sh gate_attempt_p99): the LOOSER of
+        # measured p99 × weather tolerance and the suite's configured
+        # latencyTargetMs × 1.25 — the policy legitimately holds any tier
+        # fitting 0.9×target, so a compliant run on slower hardware may
+        # sit near the target and must not fail a budget derived from one
+        # machine's measurement alone.  NorthStar: no same-hardware
+        # micro-bucket A/B at 100k yet — its budget is a pure regression
+        # bound on the committed BENCH_r09_100K.json measurement.
+        "gates": {
+            "SchedulingBasic": {
+                "budget_ms": round(max(new["attempt_ms"]["p99"] * 1.5,
+                                       _suite_target_ms() * 1.25), 1),
+                "provenance": "max(round15 measured p99 × 1.5 weather "
+                              "tolerance, suite latencyTargetMs × 1.25 — "
+                              "the policy's own compliance band)",
+            },
+            **({"NorthStar": {
+                "budget_ms": round(r09_p99 * 1.25, 1),
+                "provenance": "BENCH_r09_100K.json live p99 × 1.25 — "
+                              "regression bound, micro-buckets not yet "
+                              "armed at the 131k tier",
+            }} if r09_p99 else {}),
+        },
+    }
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(artifact, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: artifact[k] for k in (
+        "p99_reduction_x", "throughput_vs_baseline", "acceptance")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

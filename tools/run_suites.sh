@@ -125,6 +125,30 @@ n = d["detail"]["xla_compiles_in_window"]["count"]
 sys.exit(0 if n == 0 else 1)
 PYEOF
 }
+# attempt-p99 latency gate (round 15): the suite's fresh row must keep
+# attempt p99 under the budget committed in BENCH_r15_LATENCY.json
+# ("gates": suite → budget_ms, each with provenance + tolerance baked in)
+# — the micro-bucket + overlapped-sync win is held by CI, not re-argued
+gate_attempt_p99() {
+  local suite="$1" line
+  line=$(grep "\"workload\": \"$suite/" "$OUT" | tail -1)
+  if [ -z "$line" ]; then
+    echo "FAILED: p99 gate found no row for $suite" >> suites_run.log
+    exit 1
+  fi
+  python - "$suite" "$line" <<'PYEOF' || { echo "FAILED: $suite attempt p99 over budget" >> suites_run.log; exit 1; }
+import json, sys
+suite, line = sys.argv[1], sys.argv[2]
+budgets = json.load(open("BENCH_r15_LATENCY.json")).get("gates", {})
+budget = budgets.get(suite)
+assert budget, f"no p99 budget for {suite} in BENCH_r15_LATENCY.json"
+p99 = json.loads(line)["detail"]["attempt_ms"]["p99"]
+assert p99 <= budget["budget_ms"], (
+    f"{suite} attempt p99 {p99:.1f} ms over budget {budget['budget_ms']} ms "
+    f"({budget.get('provenance', '')})")
+sys.exit(0)
+PYEOF
+}
 # span-observatory gate: each gated suite's bench row must carry the
 # per-phase attempt-latency block reconstructed from spans — with the sum
 # of tiling-phase p50s within 10% of the measured attempt p50 (no
@@ -154,6 +178,8 @@ PYEOF
 }
 run SchedulingBasic 5000Nodes
 gate_phase_block SchedulingBasic
+gate_attempt_p99 SchedulingBasic
+gate_zero_compiles SchedulingBasic
 run SchedulingPodAntiAffinity 5000Nodes
 gate_zero_compiles SchedulingPodAntiAffinity
 gate_phase_block SchedulingPodAntiAffinity
@@ -184,6 +210,7 @@ run SchedulingBasic 500Nodes
 run NorthStar 100kNodes
 gate_zero_compiles NorthStar
 gate_phase_block NorthStar
+gate_attempt_p99 NorthStar
 dline=$(BENCH_SUITE=Density BENCH_SIZE=1000Nodes/30000Pods BENCH_ORACLE_SAMPLE=4 \
   timeout 3000 python bench.py 2>> suites_run.log | tail -1)
 if [ -n "$dline" ] && python -c "import json,sys; json.loads(sys.argv[1])" "$dline" 2>/dev/null; then
