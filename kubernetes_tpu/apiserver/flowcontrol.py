@@ -197,6 +197,20 @@ class FlowController:
             "readonly", max_readonly_inflight, max_queue_per_user,
             queue_timeout, retry_after, max_queued_total=max_queued_total)
 
+    @classmethod
+    def for_role(cls, role: str) -> "FlowController":
+        """Pool shape per replication role (sim/replication.py).
+
+        A FOLLOWER exists to absorb reads: its readonly pool doubles and
+        its mutating pool shrinks to a sliver — every write it admits is
+        answered 503 at the handler, so seats there only cover the cost of
+        saying no (and of the write burst that arrives the instant
+        promotion flips the role, before callers re-resolve endpoints).
+        A LEADER keeps the defaults."""
+        if role == "follower":
+            return cls(max_mutating_inflight=4, max_readonly_inflight=128)
+        return cls()
+
     def admit(self, user: str, mutating: bool) -> _Seat:
         """Acquire a seat (possibly after a fair-queued wait) or raise
         RequestRejected — the caller answers 429 + Retry-After."""

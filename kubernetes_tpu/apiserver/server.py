@@ -162,7 +162,7 @@ class APIServer:
 
     def __init__(
         self,
-        store: ObjectStore,
+        store: Optional[ObjectStore] = None,
         scheme: Optional[Scheme] = None,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -175,7 +175,21 @@ class APIServer:
         watch_cache="auto",
         flow_control="auto",
         tracer=None,
+        replica=None,
+        follower_wait_seconds: float = 1.0,
     ):
+        # replication follower front end (sim/replication.FollowerReplica):
+        # when set, this server serves the replica's store + watch cache and
+        # rv-gates every read against the replication watermark — a list or
+        # watch at rv ≤ applied_rv serves locally, above it waits at most
+        # ``follower_wait_seconds`` then 504s (the client retries or goes to
+        # the leader), and writes answer 503 until promotion flips the role.
+        self.replica = replica
+        self.follower_wait_seconds = follower_wait_seconds
+        if store is None:
+            if replica is None:
+                raise ValueError("APIServer needs a store or a replica")
+            store = replica.store
         self.store = store
         # readiness source (component_base.healthz.Readyz or None): when
         # set, /readyz serves 503 + per-component rebuild progress while a
@@ -213,7 +227,12 @@ class APIServer:
         # "auto" (default) builds one — pass False to read the store
         # directly (the pre-cache behavior), or a WatchCache to share one
         # across servers.
-        if watch_cache == "auto" or watch_cache is True:
+        if replica is not None and watch_cache == "auto":
+            # the replica already feeds its own cache (bookmark_gate
+            # clamped to the replication watermark) — never build a second
+            self.watch_cache = replica.watch_cache
+            self._owns_watch_cache = False
+        elif watch_cache == "auto" or watch_cache is True:
             self.watch_cache: Optional[WatchCache] = WatchCache(
                 store, scheme=self.scheme)
             self._owns_watch_cache = True
@@ -228,9 +247,15 @@ class APIServer:
         # handshake only).  "auto" builds generous defaults; False
         # disables; a FlowController tunes the pools (flood tests do).
         if flow_control == "auto":
-            self.flow: Optional[FlowController] = FlowController()
+            # a follower's mutating pool shrinks to near-zero (every write
+            # is a 503 until promotion) and its readonly pool widens — the
+            # whole point of a read replica is read capacity
+            self.flow: Optional[FlowController] = FlowController.for_role(
+                "follower" if replica is not None else "leader")
         else:
             self.flow = flow_control or None
+        if replica is not None:
+            m.apiserver_role.set(1.0, (replica.name, replica.role))
         # span tracer (component_base/trace.py): one apiserver_request span
         # per resource request with an apf_wait child when the flow-control
         # queue actually held it.  Health/discovery/metrics probes are not
@@ -511,6 +536,28 @@ def _make_handler(api: APIServer):
                                   "groups": [{"name": g.split("/")[0]}
                                              for g in groups]})
 
+        def _follower_wait(self, rv: int) -> bool:
+            """rv-gate a read against the replication watermark: True when
+            the request may serve locally (not a follower, rv already
+            applied, or the watermark caught up within the bounded wait);
+            False after answering 504 — the client retries, relists at
+            rv=0, or goes to another replica.  A 504 (not 410) because the
+            rv is VALID, just not HERE YET — Expired would trigger a
+            spurious relist."""
+            rep = api.replica
+            if rep is None or rv <= rep.applied_rv():
+                return True
+            if rep.wait_for_rv(rv, api.follower_wait_seconds):
+                return True
+            m.apiserver_rejected.inc(("follower_lag",))
+            self._status_err(
+                504, "Timeout",
+                f"follower {rep.name} applied_rv {rep.applied_rv()} has "
+                f"not reached requested resourceVersion {rv} "
+                f"(lag {rep.lag_rv()})",
+                headers=(("Retry-After", "1"),))
+            return False
+
         def _get_resource(self, url):
             q = parse_qs(url.query)
             r = api.route(url.path)
@@ -543,6 +590,8 @@ def _make_handler(api: APIServer):
             # — NOT an exact rollback to the pre-history world
             rv_param = q.get("resourceVersion", [None])[0]
             exact_rv = int(rv_param) if rv_param and rv_param != "0" else None
+            if exact_rv is not None and not self._follower_wait(exact_rv):
+                return
             next_token = ""
             if api.watch_cache is not None:
                 try:
@@ -591,6 +640,8 @@ def _make_handler(api: APIServer):
             stays fresh and a relist after disconnect replays almost
             nothing."""
             since = int(q.get("resourceVersion", ["0"])[0] or 0)
+            if since and not self._follower_wait(since):
+                return
             timeout = float(q.get("timeoutSeconds", ["30"])[0])
             bookmarks = q.get("allowWatchBookmarks", ["false"])[0] == "true"
             events: "queue.Queue" = queue.Queue(maxsize=4096)
@@ -661,8 +712,10 @@ def _make_handler(api: APIServer):
                         # require the queue drained — the bookmark then
                         # provably covers only events already written to
                         # the wire (cacher.go bookmarks cover progress
-                        # sent to that watcher)
-                        rv = (api.watch_cache.fanned_rv()
+                        # sent to that watcher).  bookmark_rv additionally
+                        # clamps to the replication watermark on a
+                        # follower (the cross-process no-overclaim rule).
+                        rv = (api.watch_cache.bookmark_rv()
                               if api.watch_cache is not None
                               else api.store.current_rv())
                         if not lossy[0] and events.empty():
@@ -709,7 +762,22 @@ def _make_handler(api: APIServer):
 
         def _mutating(self, verb: str, body_fn) -> None:
             """Shared wrapper for the write verbs: request span →
-            flow-control admit → handler → release/finish."""
+            flow-control admit → handler → release/finish.
+
+            A replication FOLLOWER answers every write 503 before any of
+            that — its store would raise FollowerReadOnly anyway (a local
+            write forks the shipped history), but rejecting at the door
+            gives the client the Retry-After + reason it needs to go to
+            the leader.  The check reads the replica's LIVE role, so
+            promotion opens writes with no server restart."""
+            if api.replica is not None and api.replica.role != "leader":
+                m.apiserver_rejected.inc(("follower_readonly",))
+                self._status_err(
+                    503, "ServiceUnavailable",
+                    f"replica {api.replica.name} is a read-only follower; "
+                    f"send writes to the leader",
+                    headers=(("Retry-After", "1"),))
+                return
             span = self._req_span(verb)
             try:
                 if not self._flow_admit(mutating=True, span=span):

@@ -13,6 +13,10 @@ Layout:
     node sets / whole zones / flapping subsets) + run_node_storm, the
     node-lifecycle storm soak (tests/test_node_lifecycle.py battery +
     tools/node_storm_soak.py share it)
+  - replication.py — ShipFaults (deterministic ship-stream drops / torn
+    batches / lag spikes) + run_replication_soak, the two-follower
+    WAL-shipping failover soak (tests/test_replication.py battery +
+    tools/replica_soak.py share it); scheduler-free, so it stays jax-free
 
 soak and partition are imported lazily — they pull in the scheduler (and
 jax); the fault primitives stay importable from stdlib-only contexts
@@ -35,6 +39,7 @@ from .faults import (  # noqa: F401
     maybe_torn_write,
     steal_lease,
 )
+from .replication import ShipFaults, run_replication_soak  # noqa: F401
 from .retry import RetryingStore  # noqa: F401
 
 __all__ = [
@@ -48,6 +53,8 @@ __all__ = [
     "TransientApiError",
     "WatchDropped",
     "RetryingStore",
+    "ShipFaults",
+    "run_replication_soak",
     "crash_schedule",
     "install_crash_schedule",
     "maybe_crash",

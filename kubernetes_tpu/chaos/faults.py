@@ -58,6 +58,7 @@ CRASH_MID_SCALEUP = "crash.mid_scaleup"        # autoscaler/controller._scale_up
 CRASH_POST_LEASE_RENEW = "crash.post_lease_renew"  # leaderelection._tick: lease renewed, holder dies
 CRASH_PRE_WAL_FSYNC = "crash.pre_wal_fsync"    # sim/wal.append: record written, fsync never ran
 CRASH_MID_ZONE_EVICT = "crash.mid_zone_evict"  # controllers/nodelifecycle: unreachable taint written, eviction sweep unrun
+CRASH_MID_PROMOTE = "crash.mid_promote"        # sim/replication.promote: shipped tail durable, WAL not yet reattached
 # Not in CRASH_POINTS (armed via arm_torn_write, not crash_points): the
 # torn-write fault writes a PREFIX of the record before dying, so the point
 # name only identifies the ProcessCrash it raises.
@@ -72,6 +73,7 @@ CRASH_POINTS = (
     CRASH_POST_LEASE_RENEW,
     CRASH_PRE_WAL_FSYNC,
     CRASH_MID_ZONE_EVICT,
+    CRASH_MID_PROMOTE,
 )
 
 

@@ -1,0 +1,563 @@
+"""Replication chaos: deterministic ship-stream faults + the two-follower
+failover soak.
+
+The WAL-shipping layer (sim/replication.py) claims exactly-once record
+apply over an at-least-once wire, rv-consistent follower serving that
+never overclaims a bookmark, and promotion that survives a leader death at
+ANY shipped/unshipped boundary.  This module is the adversary for those
+claims:
+
+  - ``ShipFaults`` — seeded, per-(follower, batch-seq) deterministic
+    decisions to DROP a ship batch on the wire, TEAR it mid-record (a
+    strict byte prefix arrives), or LAG it (extra ship-delay ticks), in
+    the FaultSchedule idiom (chaos/faults.py): same seed → same faults at
+    the same sequence points, replay-stable across runs;
+  - ``run_replication_soak`` — leader + two followers under churn with
+    recording watchers on every replica, a mid-soak leader kill at a
+    configurable shipped/unshipped/torn boundary, a PROMOTION RACE between
+    the two followers (the election lease CAS picks exactly one winner —
+    the loser's promote() raises PromotionFenced), the dead leader's
+    unshipped suffix discarded exactly-once + divergence-probed, the old
+    leader rejoined as a follower over its truncated file, and the
+    discarded writes re-issued against the new leader (the client's retry
+    of an un-acknowledged write).  Final accounting proves: zero
+    lost/duplicated watch events on every replica across the incarnation
+    boundary, zero overclaimed bookmarks, exactly-once binds per
+    incarnation, bounded promotion time, and a replay-stable determinism
+    signature.
+
+Single-threaded, pump-driven, fake-clocked: ship lag, lease expiry, and
+promotion timing all advance with the driver loop, never the wall clock —
+the same seed replays the same run bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..component_base import logging as klog
+
+LEASE_NS, LEASE_NAME = "kube-system", "replication-leader"
+
+
+class ShipFaults:
+    """Deterministic ship-wire faults, keyed by (follower, batch seq).
+
+    The LogShipper consults ``ship_fault`` once per delivery attempt and
+    ``lag_spike`` once per batch cut; both decisions hash (seed, follower,
+    sequence) — blake2s, the chaos-layer convention — so a same-seed rerun
+    injects the identical fault sequence regardless of wall clock or
+    thread interleaving.  ``max_faults_per_stream`` bounds each follower's
+    total so a hostile rate cannot starve convergence forever (the same
+    escape hatch FaultSchedule's max_faults_per_key provides)."""
+
+    def __init__(self, seed: int, *, drop_rate: float = 0.0,
+                 torn_rate: float = 0.0, lag_rate: float = 0.0,
+                 lag_ticks: int = 3, max_faults_per_stream: int = 64):
+        self.seed = seed
+        self.drop_rate = drop_rate
+        self.torn_rate = torn_rate
+        self.lag_rate = lag_rate
+        self.lag_ticks = lag_ticks
+        self.max_faults_per_stream = max_faults_per_stream
+        self._counters: Dict[tuple, int] = {}
+        self._stream_faults: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+
+    def _roll(self, *parts) -> float:
+        digest = hashlib.blake2s(
+            "|".join(map(str, (self.seed,) + parts)).encode(),
+            digest_size=8,
+        ).digest()
+        return int.from_bytes(digest, "big") / 2.0**64
+
+    def _seq(self, *key) -> int:
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return n
+
+    def _record(self, fault: str, follower: str) -> None:
+        from ..metrics import scheduler_metrics as m
+
+        self.injected[fault] = self.injected.get(fault, 0) + 1
+        self._stream_faults[follower] = \
+            self._stream_faults.get(follower, 0) + 1
+        m.chaos_faults_injected.inc((fault,))
+
+    def _exhausted(self, follower: str) -> bool:
+        return (self._stream_faults.get(follower, 0)
+                >= self.max_faults_per_stream)
+
+    def ship_fault(self, follower: str, seq: int,
+                   nbytes: int) -> Optional[Tuple[str, int]]:
+        """Decide one delivery's fate: None (clean), ("drop", 0) — the
+        batch vanishes on the wire — or ("torn", keep) — a strict byte
+        prefix arrives (cut mid-record unless the batch is one record
+        long; the follower's crc walk rejects the fragment either way)."""
+        if self._exhausted(follower):
+            return None
+        if self.drop_rate and \
+                self._roll("ship_drop", follower, seq) < self.drop_rate:
+            self._record("ship_drop", follower)
+            return ("drop", 0)
+        if self.torn_rate and \
+                self._roll("ship_torn", follower, seq) < self.torn_rate:
+            keep = max(1, min(nbytes - 1, int(
+                nbytes * self._roll("ship_torn_keep", follower, seq))))
+            self._record("ship_torn", follower)
+            return ("torn", keep)
+        return None
+
+    def lag_spike(self, follower: str) -> int:
+        """Extra ship-delay ticks for the batches cut this pump (a burst
+        of replication lag; the rv-gated serving path rides it out)."""
+        if not self.lag_rate or self._exhausted(follower):
+            return 0
+        n = self._seq("lag", follower)
+        if self._roll("ship_lag", follower, n) < self.lag_rate:
+            self._record("ship_lag", follower)
+            return self.lag_ticks
+        return 0
+
+    def injected_counts(self) -> Dict[str, int]:
+        return dict(self.injected)
+
+
+class _Recorder:
+    """One watch client on a replica's cache: records every delivered
+    event and bookmark so the final accounting can prove zero lost/dup
+    events and zero overclaimed bookmarks.  ``events`` holds
+    (rv, type, kind, name); ``marks`` holds (position-in-stream, rv)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.events: List[Tuple[int, str, str, str]] = []
+        self.marks: List[Tuple[int, int]] = []
+        self._unwatch = None
+
+    def attach(self, cache, since_rv: int = 0) -> None:
+        self._unwatch = cache.watch(self._on_event, since_rv=since_rv,
+                                    on_bookmark=self._on_bookmark)
+
+    def detach(self) -> None:
+        if self._unwatch is not None:
+            self._unwatch()
+            self._unwatch = None
+
+    def _on_event(self, ev) -> None:
+        self.events.append((ev.resource_version, ev.type, ev.kind,
+                            getattr(ev.obj.metadata, "name", "")))
+
+    def _on_bookmark(self, rv: int) -> None:
+        self.marks.append((len(self.events), rv))
+
+    def prune_above(self, rv: int) -> int:
+        """Roll the recorded stream back to ≤ rv (a rebase discarded the
+        replica's tail); returns events dropped."""
+        keep = [e for e in self.events if e[0] <= rv]
+        dropped = len(self.events) - len(keep)
+        self.events = keep
+        self.marks = [(min(p, len(keep)), brv) for p, brv in self.marks
+                      if brv <= rv]
+        return dropped
+
+    def overclaims(self) -> int:
+        """Bookmarks that promised an rv some LATER-delivered event undercut
+        (the overclaim the watermark clamp forbids): a bookmark at rv B is
+        a contract that every event ≤ B has already been delivered."""
+        bad = 0
+        for pos, brv in self.marks:
+            if any(e[0] <= brv for e in self.events[pos:]):
+                bad += 1
+        return bad
+
+
+@dataclass
+class ReplicaSoakResult:
+    pods: int
+    bound: int
+    events_lost: int            # expected-but-unrecorded, across replicas
+    events_duplicated: int      # recorded more than once, across replicas
+    bookmark_overclaims: int
+    ship_errors: Dict[str, int]  # follower name → deliver-side anomalies
+    promotion_ticks: int         # leader kill → winner promoted
+    promoted: str                # winner replica name
+    fenced_losers: int           # promote() attempts PromotionFenced
+    discarded_records: int       # dead leader's unshipped suffix
+    phantoms: List[str]          # divergence probe output (must be [])
+    duplicate_binds: int         # beyond one per (pod, incarnation)
+    rolled_back_events: int      # loser-rebase stream rollback size
+    rejoined_rv: int             # old leader's rv after rejoin as follower
+    final_rv: int                # new leader's rv at convergence
+    injected: Dict[str, int]
+    iterations: int
+    wall_seconds: float
+
+    @property
+    def converged(self) -> bool:
+        return (self.bound == self.pods and self.events_lost == 0
+                and self.events_duplicated == 0
+                and self.bookmark_overclaims == 0
+                and not self.phantoms and self.duplicate_binds == 0
+                and self.promoted != "")
+
+    def determinism_signature(self) -> Dict[str, object]:
+        """The replay-stable part of a run (wall time excluded)."""
+        return {
+            "injected": dict(self.injected),
+            "promoted": self.promoted,
+            "discarded": self.discarded_records,
+            "final_rv": self.final_rv,
+            "iterations": self.iterations,
+        }
+
+
+def run_replication_soak(
+    seed: int = 11,
+    n_pods: int = 40,
+    n_nodes: int = 6,
+    n_watchers: int = 2,
+    *,
+    workdir: str,
+    kill_mode: str = "unshipped",   # "shipped" | "unshipped" | "torn"
+    unshipped_writes: int = 5,
+    drop_rate: float = 0.08,
+    torn_rate: float = 0.05,
+    lag_rate: float = 0.05,
+    lag_ticks: int = 3,
+    ship_delay: int = 1,
+    batch_max_records: int = 8,
+    lease_duration: float = 0.6,
+    tick: float = 0.05,
+    promotion_tick_cap: int = 200,
+    bookmark_every: int = 3,
+) -> ReplicaSoakResult:
+    """The replication acceptance workload (fast shape by default;
+    tests/test_replication.py's slow marker scales n_watchers to the
+    1000-watcher acceptance shape).  Phases:
+
+      1. churn the leader (creates/binds/updates/deletes) while pumping
+         the faulty ship stream to two followers, bookmarking their
+         caches on a fixed cadence;
+      2. kill the leader at the configured boundary — fully shipped,
+         with an unshipped suffix, or with a torn last record on top;
+      3. race both followers' electors for the replica-set lease on a
+         fake clock (seed-derived tick order); the winner promotes, the
+         loser's promote() must fence;
+      4. discard the dead leader's unshipped suffix exactly-once, probe
+         for divergence, rejoin the old leader as a follower over its
+         truncated file, rebase the loser if it ran ahead of the winner;
+      5. re-issue the discarded writes against the new leader (the
+         client retry of an un-acked write), churn more, drain, and
+         account: zero lost/dup events per recorder, zero bookmark
+         overclaims, exactly-once binds per incarnation, bounded
+         promotion ticks, replay-stable signature.
+    """
+    from ..client.leaderelection import LeaderElector, LeaseLock
+    from ..sim.replication import (
+        FollowerReplica,
+        LogShipper,
+        PromotionFenced,
+        discard_unshipped_suffix,
+        divergence_probe,
+        rebase_follower,
+    )
+    from ..sim.store import DELETED, ObjectStore
+    from ..sim.wal import WriteAheadLog
+    from ..testutil import make_node, make_pod
+
+    t0 = time.monotonic()
+
+    def rng(*parts) -> float:
+        digest = hashlib.blake2s(
+            "|".join(map(str, (seed,) + parts)).encode(),
+            digest_size=8).digest()
+        return int.from_bytes(digest, "big") / 2.0**64
+
+    leader_path = os.path.join(workdir, "leader.wal")
+    wal = WriteAheadLog(leader_path, fsync_every=0)
+    leader = ObjectStore(wal=wal)
+    faults = ShipFaults(seed, drop_rate=drop_rate, torn_rate=torn_rate,
+                        lag_rate=lag_rate, lag_ticks=lag_ticks)
+    shipper = LogShipper(leader_path, batch_max_records=batch_max_records,
+                         ship_delay=ship_delay, faults=faults)
+    followers = [
+        FollowerReplica("f1", os.path.join(workdir, "f1.wal")),
+        FollowerReplica("f2", os.path.join(workdir, "f2.wal")),
+    ]
+    for f in followers:
+        shipper.attach(f)
+
+    # recorders: n_watchers per follower, subscribed from rv 0 — their
+    # streams must reproduce the authoritative history exactly
+    recorders: Dict[str, List[_Recorder]] = {}
+    for f in followers:
+        recorders[f.name] = []
+        for w in range(n_watchers):
+            rec = _Recorder(f"{f.name}-w{w}")
+            rec.attach(f.watch_cache)
+            recorders[f.name].append(rec)
+
+    # the election fabric: its own coordination store (the analog of the
+    # identity-lease etcd), fake-clocked for deterministic expiry
+    class _FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+
+    clock = _FakeClock()
+    election = ObjectStore()
+    leader_elector = LeaderElector(
+        LeaseLock(election, LEASE_NS, LEASE_NAME), identity="leader#0",
+        lease_duration=lease_duration, clock=clock)
+    electors = {
+        f.name: LeaderElector(
+            LeaseLock(election, LEASE_NS, LEASE_NAME), identity=f.name,
+            lease_duration=lease_duration, clock=clock)
+        for f in followers
+    }
+    assert leader_elector.try_acquire_or_renew()
+
+    # --- phase 1: churn under a faulty ship stream ---------------------------
+    iterations = 0
+    bound_names: List[str] = []
+
+    def churn_step(store, i: int) -> None:
+        nonlocal iterations
+        iterations += 1
+        op = rng("op", i)
+        if op < 0.55 or not bound_names:
+            store.create("Pod", make_pod().name(f"p{i}").uid(f"p{i}")
+                         .namespace("default").req({"cpu": "1"}).obj())
+            node = f"n{i % n_nodes}"
+            store.bind_pod("default", f"p{i}", node)
+            bound_names.append(f"p{i}")
+        elif op < 0.8:
+            victim = bound_names[int(rng("upd", i) * len(bound_names))]
+            pod = store.get("Pod", "default", victim)
+            if pod is not None:
+                pod.metadata.labels["touched"] = str(i)
+                store.update("Pod", pod)
+        else:
+            victim = bound_names.pop(int(rng("del", i) * len(bound_names)))
+            store.delete("Pod", "default", victim)
+
+    for i in range(n_nodes):
+        leader.create("Node", make_node().name(f"n{i}")
+                      .capacity({"cpu": "64", "pods": "256"}).obj())
+    half = n_pods // 2
+    for i in range(half):
+        churn_step(leader, i)
+        shipper.pump()
+        leader_elector.try_acquire_or_renew()
+        clock.advance(tick / 10)  # renewals outpace expiry while alive
+        if i % bookmark_every == 0:
+            for f in followers:
+                f.watch_cache.bookmark_now()
+
+    # --- phase 2: kill the leader at the configured boundary -----------------
+    if kill_mode == "shipped":
+        shipper.pump_until_synced()
+    else:
+        shipper.pump_until_synced()
+        for j in range(unshipped_writes):
+            # acknowledged writes the ship stream will never carry: pods
+            # created AND BOUND only on the dying leader (the phantom-bind
+            # material the divergence probe hunts)
+            name = f"unshipped{j}"
+            leader.create("Pod", make_pod().name(name).uid(name)
+                          .namespace("default").req({"cpu": "1"}).obj())
+            leader.bind_pod("default", name, f"n{j % n_nodes}")
+    wal.close()
+    if kill_mode == "torn":
+        # death mid-append: a strict prefix of the final record survives
+        size = os.path.getsize(leader_path)
+        with open(leader_path, "r+b") as fh:
+            fh.truncate(size - 7)
+
+    # --- phase 3: promotion race ---------------------------------------------
+    promotion_ticks = 0
+    winner: Optional[FollowerReplica] = None
+    order = sorted(followers,
+                   key=lambda f: rng("race", f.name, seed))
+    while winner is None and promotion_ticks < promotion_tick_cap:
+        promotion_ticks += 1
+        clock.advance(tick)
+        for f in order:
+            if electors[f.name].try_acquire_or_renew():
+                winner = f
+                break
+    if winner is None:
+        raise AssertionError("promotion race: no winner within cap")
+    loser = next(f for f in followers if f is not winner)
+    fenced = 0
+    try:
+        loser.promote(elector=electors[loser.name])
+    except PromotionFenced:
+        fenced += 1
+    winner.promote(elector=electors[winner.name])
+    win_offset = winner.acked_offset()
+    win_rv = winner.applied_rv()
+
+    # --- phase 4: discard, probe, rejoin, rebase -----------------------------
+    discard = discard_unshipped_suffix(leader_path, win_offset)
+    again = discard_unshipped_suffix(leader_path, win_offset)
+    assert not again.discarded and again.truncated_bytes == 0, \
+        "unshipped-suffix discard ran twice"
+    phantoms = divergence_probe(winner.store, discard.discarded, win_rv)
+
+    new_shipper = LogShipper(winner.wal_path,
+                             batch_max_records=batch_max_records,
+                             ship_delay=ship_delay, faults=faults)
+    rolled_back_events = 0
+    if loser.acked_offset() > win_offset:
+        # the loser out-raced the winner on the wire: its extra tail is
+        # not in the new authoritative log — truncate + rebuild, and roll
+        # the recorders back with it
+        for rec in recorders[loser.name]:
+            rec.detach()
+        loser, rolled = rebase_follower(loser, win_offset)
+        for rec in recorders[loser.name]:
+            rolled_back_events += rec.prune_above(loser.applied_rv())
+            rec.attach(loser.watch_cache, since_rv=loser.applied_rv())
+    new_shipper.attach(loser)
+    # the dead leader rejoins as a follower over its truncated file —
+    # byte-offset compatible with the winner's log (common-prefix rule)
+    rejoined = FollowerReplica("old-leader", leader_path)
+    rej_recorder = _Recorder("old-leader-w0")
+    rej_recorder.attach(rejoined.watch_cache,
+                        since_rv=rejoined.applied_rv())
+    rejoin_base_rv = rejoined.applied_rv()
+    new_shipper.attach(rejoined)
+
+    # --- phase 5: retry discarded writes, churn, drain, account --------------
+    for rec_wal in discard.discarded:
+        # the client's retry of an un-acked write: re-issued against the
+        # new leader, assigned FRESH rvs — never replayed from the corpse
+        if rec_wal.op == "create" and rec_wal.kind == "Pod":
+            winner.store.create("Pod", make_pod()
+                                .name(rec_wal.name).uid(rec_wal.name)
+                                .namespace(rec_wal.namespace or "default")
+                                .req({"cpu": "1"}).obj())
+        elif rec_wal.op == "bind":
+            winner.store.bind_pod(rec_wal.namespace or "default",
+                                  rec_wal.name, rec_wal.node_name)
+    if kill_mode != "shipped":
+        # a TORN final record is not even in the discard list (it never
+        # verified) — but its client still timed out and still retries;
+        # the retry sweep covers every un-acked unshipped write the
+        # harness issued, not just the ones the corpse's log can name
+        for j in range(unshipped_writes):
+            name = f"unshipped{j}"
+            if winner.store.get("Pod", "default", name) is None:
+                winner.store.create("Pod", make_pod().name(name).uid(name)
+                                    .namespace("default")
+                                    .req({"cpu": "1"}).obj())
+            pod = winner.store.get("Pod", "default", name)
+            if not getattr(pod.spec, "node_name", ""):
+                winner.store.bind_pod("default", name, f"n{j % n_nodes}")
+    for i in range(half, n_pods):
+        churn_step(winner.store, i)
+        new_shipper.pump()
+        if i % bookmark_every == 0:
+            winner.watch_cache.bookmark_now()
+            loser.watch_cache.bookmark_now()
+            rejoined.watch_cache.bookmark_now()
+    new_shipper.pump_until_synced()
+    for f in (loser, rejoined):
+        f.watch_cache.bookmark_now()
+
+    # --- accounting ----------------------------------------------------------
+    expected = [(ev.resource_version, ev.type, ev.kind,
+                 getattr(ev.obj.metadata, "name", ""))
+                for ev in winner.store._log]
+    expected_rvs = [e[0] for e in expected]
+
+    def stream_errors(rec: _Recorder, since: int) -> Tuple[int, int]:
+        want = [e for e in expected if e[0] > since]
+        got = rec.events
+        want_c, got_c = Counter(want), Counter(got)
+        lost = sum((want_c - got_c).values())
+        dup = sum((got_c - want_c).values())
+        return lost, dup
+
+    lost = dup = over = 0
+    all_recs = ([(r, 0) for rs in recorders.values() for r in rs]
+                + [(rej_recorder, rejoin_base_rv)])
+    for rec, since in all_recs:
+        n_lost, n_dup = stream_errors(rec, since)
+        lost += n_lost
+        dup += n_dup
+        over += rec.overclaims()
+
+    # exactly-once binds per (pod, incarnation) across the incarnation
+    # boundary, from the authoritative history (failover.py's accounting):
+    # a DELETE closes an incarnation; a re-bind or node change within one
+    # is a duplicate.  The discarded-then-retried binds appear exactly
+    # once — in the NEW leader's history only.
+    node_of: Dict[str, Optional[str]] = {}
+    incarnation: Counter = Counter()
+    binds: Counter = Counter()
+    duplicates = 0
+    for ev in winner.store._log:
+        if ev.kind != "Pod":
+            continue
+        name = ev.obj.metadata.name
+        if ev.type == DELETED:
+            node_of.pop(name, None)
+            incarnation[name] += 1
+            continue
+        nn = getattr(ev.obj.spec, "node_name", "") or None
+        prev = node_of.get(name)
+        if nn is not None and prev is None:
+            binds[(name, incarnation[name])] += 1
+        elif nn is not None and prev is not None and nn != prev:
+            duplicates += 1
+        node_of[name] = nn
+    duplicates += sum(c - 1 for c in binds.values() if c > 1)
+
+    pods, _ = winner.store.list("Pod")
+    n_bound = sum(1 for p in pods if getattr(p.spec, "node_name", ""))
+
+    for rs in recorders.values():
+        for rec in rs:
+            rec.detach()
+    rej_recorder.detach()
+    rejoined.close()
+    loser.close()
+    winner.store.wal.close()
+    winner.watch_cache.close()
+
+    result = ReplicaSoakResult(
+        pods=len(pods), bound=n_bound,
+        events_lost=lost, events_duplicated=dup,
+        bookmark_overclaims=over,
+        ship_errors={f.name: f.ship_errors
+                     for f in (winner, loser, rejoined)},
+        promotion_ticks=promotion_ticks, promoted=winner.name,
+        fenced_losers=fenced,
+        discarded_records=len(discard.discarded), phantoms=phantoms,
+        duplicate_binds=duplicates,
+        rolled_back_events=rolled_back_events,
+        rejoined_rv=rejoined.applied_rv(),
+        final_rv=expected_rvs[-1] if expected_rvs else 0,
+        injected=faults.injected_counts(),
+        iterations=iterations,
+        wall_seconds=time.monotonic() - t0,
+    )
+    klog.V(1).info_s(
+        "Replication soak complete", pods=result.pods, bound=result.bound,
+        promoted=result.promoted, promotion_ticks=result.promotion_ticks,
+        discarded=result.discarded_records, lost=lost, dup=dup,
+        overclaims=over, injected=result.injected)
+    return result

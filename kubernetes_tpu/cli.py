@@ -613,12 +613,15 @@ class Kubectl:
     # --- control-plane durability / flow-control view --------------------------
 
     def controlplane_status(self, wal=None, watch_cache=None, flow=None,
-                            metrics=None) -> str:
+                            metrics=None, replication=None) -> str:
         """``ktpu controlplane status``: the durable-control-plane gauges —
         WAL size/records/last-fsync-rv (how much survives kill -9), watch
         cache ring occupancy/oldest-rv (what a watcher can resume from
-        without a relist), and the flow-control inflight/rejected counts
-        (who is being shed, and why).
+        without a relist), the flow-control inflight/rejected counts
+        (who is being shed, and why), and the replication block: each
+        replica's role, applied_rv/leader_rv/lag watermark, and
+        ship-stream health (``replication`` accepts a list of
+        sim/replication.FollowerReplica for the live path).
 
         Reads live objects when given (in-process wiring); otherwise the
         metric series they emit — ``metrics`` accepts a pre-parsed
@@ -673,6 +676,38 @@ class Kubectl:
             rows.append(["flow-rejected", reason, f"{rejected[reason]:g}"])
         if not rejected:
             rows.append(["flow-rejected", "total", "0"])
+        # --- replication block: per-replica role + watermark + ship health
+        if replication is not None:
+            for rep in replication:
+                rows.append([f"replica-{rep.name}", "role", rep.role])
+                rows.append([f"replica-{rep.name}", "applied-rv",
+                             str(rep.applied_rv())])
+                rows.append([f"replica-{rep.name}", "leader-rv",
+                             str(rep.leader_rv())])
+                rows.append([f"replica-{rep.name}", "lag-rv",
+                             str(rep.lag_rv())])
+                rows.append([f"replica-{rep.name}", "ship-errors",
+                             str(rep.ship_errors)])
+        else:
+            # metrics fallback: applied/lag are per-replica gauges, role is
+            # the (replica, role)=1 series, ship errors count per reason
+            applied = {lab[0]: v for (n, lab), v in metrics.items()
+                       if n == "replication_applied_rv" and lab}
+            lag = {lab[0]: v for (n, lab), v in metrics.items()
+                   if n == "replication_lag_rv" and lab}
+            roles = {lab[0]: lab[1] for (n, lab), v in metrics.items()
+                     if n == "apiserver_role" and len(lab) == 2 and v >= 1}
+            for name in sorted(set(applied) | set(roles)):
+                rows.append([f"replica-{name}", "role",
+                             roles.get(name, "unknown")])
+                rows.append([f"replica-{name}", "applied-rv",
+                             f"{applied.get(name, 0.0):g}"])
+                rows.append([f"replica-{name}", "lag-rv",
+                             f"{lag.get(name, 0.0):g}"])
+        ship_err = {lab[0]: v for (n, lab), v in metrics.items()
+                    if n == "replication_ship_errors_total" and lab}
+        for reason in sorted(ship_err):
+            rows.append(["ship-errors", reason, f"{ship_err[reason]:g}"])
         return _render_table(rows)
 
     # --- slice fragmentation view ---------------------------------------------

@@ -37,6 +37,98 @@ from ..metrics import scheduler_metrics as m
 from ..sim.store import ADDED, DELETED, ERROR, MODIFIED, ObjectStore, WatchEvent
 
 
+class FailoverEndpoints:
+    """Store-shaped facade over an ordered set of replica endpoints
+    (leader + replication followers, sim/replication.py): a reflector
+    pointed at this object survives a replica death by rotating to the
+    next endpoint on the next call.
+
+    Rotation triggers ONLY on ConnectionError (which chaos WatchDropped
+    subclasses) and OSError — the failure modes that mean "this replica is
+    gone", not "this request is wrong".  Everything else passes through
+    untouched; above all ``TooOldResourceVersion`` (410): the follower's
+    shorter ring legitimately answers 410 below its horizon, and the
+    reflector's relist-on-410 against the SAME endpoint is the correct
+    recovery — rotating would just hide the compaction.  Each endpoint
+    gets one try per call; when all of them refuse, the last error
+    propagates (the reflector's backoff loop owns the retry cadence).
+
+    rv-interchangeability is what makes this sound: every endpoint serves
+    the same WAL-ordered history, so an rv learned from one replica is
+    meaningful at every other (lists rv-gate, bookmarks never overclaim
+    the watermark), and a mid-walk rotation cannot teleport the reflector
+    into a different timeline."""
+
+    def __init__(self, endpoints: List[object], on_failover=None):
+        if not endpoints:
+            raise ValueError("FailoverEndpoints needs at least one endpoint")
+        self.endpoints = list(endpoints)
+        self.on_failover = on_failover
+        self.failovers = 0
+        self._idx = 0
+        self._lock = lockcheck.maybe_wrap(
+            threading.Lock(), "FailoverEndpoints._lock")
+
+    @property
+    def current(self):
+        with self._lock:
+            return self.endpoints[self._idx]
+
+    def _call(self, method: str, *args, **kwargs):
+        return self._call_fn(method,
+                             lambda ep: getattr(ep, method)(*args, **kwargs))
+
+    def _call_fn(self, method: str, fn):
+        last_exc: Optional[Exception] = None
+        for _ in range(len(self.endpoints)):
+            with self._lock:
+                idx = self._idx
+                ep = self.endpoints[idx]
+            try:
+                return fn(ep)
+            except (ConnectionError, OSError) as e:
+                last_exc = e
+                with self._lock:
+                    if self._idx == idx:  # first failure wins the rotate
+                        self._idx = (self._idx + 1) % len(self.endpoints)
+                        self.failovers += 1
+                klog.V(1).info_s("endpoint failover", method=method,
+                                 error=f"{type(e).__name__}: {e}",
+                                 failovers=self.failovers)
+                if self.on_failover is not None:
+                    self.on_failover(ep, e)
+        raise last_exc  # every endpoint refused
+
+    def list(self, kind: str):
+        return self._call("list", kind)
+
+    def list_page(self, kind: str, limit: int = 0, continue_=None,
+                  resource_version=None):
+        return self._call("list_page", kind, limit=limit,
+                          continue_=continue_,
+                          resource_version=resource_version)
+
+    def get(self, kind: str, namespace: str, name: str):
+        return self._call("get", kind, namespace, name)
+
+    def watch(self, handler, since_rv: int = 0, **kwargs):
+        # the reflector detected stream kwargs on OUR signature (VAR_KEYWORD
+        # accepts them all); each endpoint gets only what its own watch
+        # takes — mixed fleets (plain store + watch-cache replica) work
+        def do(ep):
+            try:
+                params = inspect.signature(ep.watch).parameters
+                var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                             for p in params.values())
+            except (TypeError, ValueError):
+                params, var_kw = {}, False
+            kw = kwargs if var_kw else {
+                k: v for k, v in kwargs.items() if k in params}
+            return ep.watch(handler, since_rv=since_rv, **kw)
+
+        return self._call_fn("watch", do)
+
+
 class Reflector:
     """ListAndWatch one kind into a local store dict."""
 

@@ -362,6 +362,42 @@ whatif_forks = default_registry.register(
     Counter("whatif_forks_evaluated_total",
             "Counterfactual forks evaluated by the whatif engine")
 )
+# --- WAL replication & follower reads (kubernetes_tpu/sim/replication.py) ----
+# Emitted at the real decision points: the follower's ship-apply path
+# (FollowerReplica.deliver), the shipper's per-pump lag refresh
+# (LogShipper.pump), and role transitions (follower construction,
+# promotion, APIServer startup).
+
+replication_applied_rv = default_registry.register(
+    # labels: (replica,) — highest WAL resourceVersion this follower has
+    # applied from the shipped stream: its rv-gated serving watermark
+    # (lists/watches at rv ≤ this serve locally; above it wait-then-504)
+    Gauge("replication_applied_rv",
+          "Highest shipped WAL resourceVersion applied, per follower")
+)
+replication_lag_rv = default_registry.register(
+    # labels: (replica,) — leader_rv - applied_rv at the last ship pump or
+    # batch apply (0 = caught up)
+    Gauge("replication_lag_rv",
+          "Replication lag in resourceVersions, per follower replica")
+)
+replication_ship_errors = default_registry.register(
+    # labels: (reason,) — "torn" (batch cut mid-record: the verified
+    # prefix applied, the remainder is resent), "gap" (batch offset ahead
+    # of the follower's applied watermark: rejected, shipper resends),
+    # "stale" (delivery to an already-promoted replica: ignored),
+    # "regressed" (tailed file shrank below the verified prefix)
+    Counter("replication_ship_errors_total",
+            "Ship-stream anomalies detected by the replication layer")
+)
+apiserver_role = default_registry.register(
+    # labels: (replica, role) — 1 for the replica's CURRENT role
+    # ("leader" | "follower"), 0 once it transitions away (promotion
+    # flips follower→leader); `ktpu controlplane status` renders the set
+    Gauge("apiserver_role",
+          "Current serving role per apiserver replica (1 = active)")
+)
+
 autoscaler_scale_decisions = default_registry.register(
     # labels: (direction, result) — direction "up" | "down"; result
     # "applied" (nodes created / node drained+deleted) | "no_fit" (no

@@ -1,0 +1,620 @@
+"""WAL-shipped follower read replicas: log shipping, rv-gated watermarks,
+and leader failover with follower promotion.
+
+Reference analog: etcd's one-leader-many-reader topology under the
+apiserver (raft log shipping in server/etcdserver/raft.go, the follower's
+applied-index watermark) combined with the watch cache's bookmark
+discipline (storage/cacher/cacher.go) and the apiserver's lease-based
+identity (apiserver/pkg/reconcilers/lease.go).  Reads scale horizontally
+only if a follower can serve rv-consistent lists/watches while ONE leader
+takes writes — and the hard part is surviving lag, torn ship batches, log
+truncation, and leader death without ever overclaiming a resourceVersion.
+
+Topology and protocol:
+
+  - the leader is an ordinary ``ObjectStore`` + ``WriteAheadLog`` (PR-10):
+    every mutation is length-prefixed, crc-checksummed, and durable before
+    it is visible;
+  - a ``LogShipper`` tails the leader's WAL file by byte offset, verifies
+    records with the same header/crc walk replay uses, and ships them to
+    followers in bounded batches after a configurable ``ship_delay`` (the
+    model of real replication lag).  Delivery is at-least-once over an
+    unreliable "wire" (chaos/replication.py drops and tears batches);
+    offset-contiguous apply on the follower makes it exactly-once;
+  - a ``FollowerReplica`` persists every verified batch to its OWN log
+    file FIRST (durable before visible — the same discipline the leader's
+    WAL enforces), then applies it through ``ObjectStore.replay_record``,
+    which re-emits watch events so the follower's ``WatchCache`` populates
+    and fans out exactly the event stream a leader-side cache would;
+  - the replication watermark (``applied_rv``, ``leader_rv``, lag) gates
+    follower serving: rv ≤ applied_rv serves locally, bookmarks clamp to
+    the watermark (WatchCache.bookmark_gate — the PR-10 no-overclaim
+    invariant extended across processes), rv > applied_rv waits
+    bounded-then-504s (apiserver/server.py), and rv below the follower's
+    ring serves 410 so clients relist against either replica
+    interchangeably.
+
+Failover (the raft-shaped part, PR-8 fencing):
+
+  - leader election runs over a coordination store (the analog of etcd
+    serving apiserver identity leases) via client/leaderelection.py;
+    ``lease_transitions`` is the fencing token — promotion refuses to run
+    for an elector that cannot prove it currently holds the lease;
+  - ``FollowerReplica.promote()`` replays the shipped log tail from its
+    local file (anything persisted but not yet applied), truncation-checks
+    the tail exactly like ``replay_on_boot``, then re-opens a
+    ``WriteAheadLog`` for appends at the clean end — the follower's file
+    IS the new authoritative log (its bytes are a verified prefix of the
+    dead leader's, so offsets keep lining up for every other follower:
+    the raft log-matching property);
+  - the dead leader's UNSHIPPED suffix — records past what the promoted
+    follower had persisted — is detected and discarded exactly-once
+    (``discard_unshipped_suffix``), and ``divergence_probe`` asserts none
+    of those discarded writes (phantom binds above all) leaked into the
+    promoted state.  A discarded acknowledged write is the classic
+    asynchronous-replication data-loss window; the probe proves it is a
+    clean loss, never a divergence.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..chaos.faults import CRASH_MID_PROMOTE, maybe_crash
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from .store import ObjectStore
+from .wal import WriteAheadLog, WALRecord, read_records, scan_records
+from .watchcache import WatchCache
+
+
+class PromotionFenced(RuntimeError):
+    """promote() refused: the elector cannot prove current leadership
+    (not leading, or the lease's transition count moved past its fencing
+    token).  In a promotion race, exactly one follower's elector wins the
+    CAS on the election lease — every other candidate lands here."""
+
+
+@dataclass
+class ShipBatch:
+    """One in-flight batch of raw WAL bytes (length-prefixed + crc-checked
+    records, sliced on record boundaries)."""
+    data: bytes
+    from_offset: int   # leader-file byte offset of data[0]
+    leader_rv: int     # leader's verified-tail rv when the batch was cut
+    seq: int           # global batch sequence (chaos decisions key on it)
+    due: int           # shipper tick at which delivery happens (lag model)
+
+
+@dataclass
+class PromotionResult:
+    name: str
+    records_replayed: int = 0     # shipped-but-unapplied tail re-applied
+    last_rv: int = 0
+    truncated_tail: bool = False  # local persist was torn mid-crash
+    truncated_at: int = 0
+    wal: Optional[WriteAheadLog] = None
+
+
+@dataclass
+class DiscardResult:
+    """Outcome of discarding a dead leader's unshipped WAL suffix."""
+    cut_at: int = 0
+    discarded: List[WALRecord] = field(default_factory=list)
+    truncated_bytes: int = 0   # 0 on the second call: discard is exactly-once
+
+
+class FollowerReplica:
+    """One read replica: its own store + watch cache, fed only by shipped
+    WAL records, persisting them locally before applying (so promotion can
+    replay the tail and re-open the log for appends)."""
+
+    def __init__(self, name: str, wal_path: str, *, scheme=None,
+                 ring_size: int = 4096):
+        self.name = name
+        self.wal_path = wal_path
+        self._scheme = scheme  # lazy: default_scheme pulls in controllers
+        self.role = "follower"
+        self.store = ObjectStore()
+        self._applied_offset = 0
+        self._applied_rv = 0
+        self._leader_rv = 0
+        self.ship_errors = 0
+        self.batches_applied = 0
+        # Condition over an RLock: deliver holds it across the store apply
+        # (replay_record re-emits into the watch cache synchronously on
+        # this thread), and rv-gated HTTP readers wait on it bounded —
+        # FollowerReplica.wait_for_rv is the 504 gate's clock.
+        self._cond = threading.Condition(threading.RLock())
+        # rejoin path: a previous incarnation's persisted log reconstructs
+        # the store exactly like a leader boot would — including the
+        # torn-tail truncation (our own persist may have died mid-write).
+        # Under _cond like every other scheme()/watermark writer — the
+        # ctor is single-threaded, but one lock story beats two.
+        if os.path.exists(wal_path) and os.path.getsize(wal_path) > 0:
+            from .wal import replay_on_boot
+
+            with self._cond:
+                replay = replay_on_boot(wal_path, store=self.store,
+                                        scheme=self.scheme())
+                self._applied_offset = os.path.getsize(wal_path)
+                self._applied_rv = replay.last_rv
+                self._leader_rv = replay.last_rv
+        # the cache replays the (possibly rebooted) store's history, then
+        # follows every replay_record emit; bookmarks clamp to the
+        # replication watermark — the cross-process no-overclaim rule
+        self.watch_cache = WatchCache(self.store, scheme=self._scheme,
+                                      ring_size=ring_size)
+        self.watch_cache.bookmark_gate = self.applied_rv
+        # followers are read-only: a local write would fork this store's
+        # history from the leader's log (FollowerReadOnly on every verb;
+        # replay_record is exempt).  promote() clears the flag.
+        self.store.read_only = True
+        self._f = open(wal_path, "ab")
+        m.replication_applied_rv.set(float(self._applied_rv), (name,))
+        m.apiserver_role.set(1.0, (name, "follower"))
+
+    def scheme(self):
+        if self._scheme is None:
+            from ..api.scheme import default_scheme
+
+            self._scheme = default_scheme()
+        return self._scheme
+
+    # --- watermark -----------------------------------------------------------
+
+    def applied_rv(self) -> int:
+        with self._cond:
+            return self._applied_rv
+
+    def leader_rv(self) -> int:
+        """Leader's verified-tail rv as of the last batch this follower
+        RECEIVED (a fully-partitioned follower reports a stale leader_rv —
+        lag is a lower bound, exactly like a raft follower's view)."""
+        with self._cond:
+            return self._leader_rv
+
+    def lag_rv(self) -> int:
+        with self._cond:
+            return max(0, self._leader_rv - self._applied_rv)
+
+    def acked_offset(self) -> int:
+        """Byte offset of the leader's file this follower has durably
+        applied through — the shipper's resend cursor."""
+        with self._cond:
+            return self._applied_offset
+
+    def wait_for_rv(self, rv: int, timeout: float) -> bool:
+        """Block until applied_rv ≥ rv or ``timeout`` elapses (the
+        bounded-then-504 gate for follower reads above the watermark)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._applied_rv < rv:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._cond.wait(remain)
+            return True
+
+    # --- ship-apply (the wire's receive side) --------------------------------
+
+    def deliver(self, data: bytes, from_offset: int, leader_rv: int) -> int:
+        """Verify + persist + apply one shipped batch; returns records
+        applied.  Tolerates the wire's failure modes without ever applying
+        an unverifiable or non-contiguous byte:
+
+          - batch from a FUTURE offset (an earlier batch was dropped):
+            rejected whole — the shipper resends from acked_offset;
+          - batch overlapping the PAST (resend after a torn prefix
+            applied): the already-applied prefix is skipped by offset
+            arithmetic, never re-applied — exactly-once;
+          - torn batch (cut mid-record): the verified prefix persists and
+            applies, the torn remainder is dropped and resent.
+        """
+        with self._cond:
+            if self.role != "follower":
+                # a stale shipper delivering to a promoted leader: its own
+                # WAL is now authoritative; applying shipped bytes on top
+                # would double-apply its history
+                self.ship_errors += 1
+                m.replication_ship_errors.inc(("stale",))
+                return 0
+            self._leader_rv = max(self._leader_rv, leader_rv)
+            if from_offset > self._applied_offset:
+                self.ship_errors += 1
+                m.replication_ship_errors.inc(("gap",))
+                self._refresh_gauges()
+                return 0
+            skip = self._applied_offset - from_offset
+            if skip >= len(data):
+                self._refresh_gauges()
+                return 0  # entirely already-applied (duplicate resend)
+            chunk = data[skip:]
+            records, good_len = scan_records(chunk,
+                                             base_offset=self._applied_offset)
+            if good_len < len(chunk):
+                # torn ship batch: apply the verified prefix, count the
+                # tear; the shipper resends the remainder from our ack
+                self.ship_errors += 1
+                m.replication_ship_errors.inc(("torn",))
+            if good_len == 0:
+                self._refresh_gauges()
+                return 0
+            # durable before visible, follower edition: the verified bytes
+            # reach OUR log file before the store applies them, so a crash
+            # mid-apply leaves a shipped tail promote()/reboot replays —
+            # never an applied-but-unpersisted rv the watermark overclaims
+            self._f.write(chunk[:good_len])
+            self._f.flush()
+            scheme = self.scheme()
+            for _, rec in records:
+                obj = (scheme.decode(rec.manifest)
+                       if rec.manifest is not None else None)
+                self.store.replay_record(
+                    rec.op, rec.kind, obj=obj, namespace=rec.namespace,
+                    name=rec.name, node_name=rec.node_name, rv=rec.rv)
+                self._applied_rv = rec.rv
+            self._applied_offset += good_len
+            self.batches_applied += 1
+            self._refresh_gauges()
+            self._cond.notify_all()
+            return len(records)
+
+    def _refresh_gauges(self):
+        m.replication_applied_rv.set(float(self._applied_rv), (self.name,))
+        m.replication_lag_rv.set(
+            float(max(0, self._leader_rv - self._applied_rv)), (self.name,))
+
+    # --- promotion -----------------------------------------------------------
+
+    def promote(self, elector=None, *, fsync_every: int = 1
+                ) -> PromotionResult:
+        """Become the leader: replay the shipped log tail, fence, re-open
+        the WAL for appends at the truncation-checked tail.
+
+        ``elector`` (client/leaderelection.LeaderElector over the replica
+        set's coordination store, or None for unfenced test use) must
+        PROVE current leadership — ``check_fence`` re-reads the live lease
+        and compares holder + lease_transitions against the token captured
+        at acquire.  Two followers racing here serialize through the lease
+        CAS: exactly one promotes, the loser raises PromotionFenced.
+
+        Idempotent across a crash mid-promotion (``crash.mid_promote``):
+        everything before the WAL reattach is derived from the durable
+        local file, so a fresh FollowerReplica on the same path can simply
+        promote again."""
+        if elector is not None and not (elector.is_leader()
+                                        and elector.check_fence()):
+            raise PromotionFenced(
+                f"{self.name}: cannot promote without holding the "
+                f"replica-set lease (fence token "
+                f"{getattr(elector, 'fence_token', None)})")
+        with self._cond:
+            # the shipped tail is durable before anything changes role
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            maybe_crash(CRASH_MID_PROMOTE)
+            result = PromotionResult(name=self.name)
+            records, good_end = read_records(self.wal_path)
+            size = os.path.getsize(self.wal_path)
+            if good_end < size:
+                # our own persist died mid-write: the torn tail truncates
+                # exactly like a leader boot's (replay_on_boot contract),
+                # durably, so the re-opened log appends at a clean end
+                result.truncated_tail = True
+                result.truncated_at = good_end
+                with open(self.wal_path, "r+b") as f:
+                    f.truncate(good_end)
+                    f.flush()
+                    os.fsync(f.fileno())
+            # replay the shipped-but-unapplied tail (persisted by deliver,
+            # not yet applied when the old incarnation stopped)
+            scheme = self.scheme()
+            for off, rec in records:
+                if off < self._applied_offset:
+                    continue
+                obj = (scheme.decode(rec.manifest)
+                       if rec.manifest is not None else None)
+                self.store.replay_record(
+                    rec.op, rec.kind, obj=obj, namespace=rec.namespace,
+                    name=rec.name, node_name=rec.node_name, rv=rec.rv)
+                self._applied_rv = rec.rv
+                result.records_replayed += 1
+            self._applied_offset = good_end
+            self.store.rebuild_admission_caches()
+            # the follower's file becomes the authoritative log: appends
+            # land at the truncation-checked tail; a successor of OUR
+            # death must lose nothing acknowledged, so fsync every append
+            self.store.read_only = False
+            self.store.wal = WriteAheadLog(self.wal_path,
+                                           scheme=self._scheme,
+                                           fsync_every=fsync_every)
+            result.wal = self.store.wal
+            result.last_rv = self._applied_rv
+            self.role = "leader"
+            # bookmarks now follow the cache's own fanned watermark — the
+            # leader's no-overclaim story is PR-10's single-process one
+            self.watch_cache.bookmark_gate = None
+            self._refresh_gauges()
+            self._cond.notify_all()
+        m.apiserver_role.set(0.0, (self.name, "follower"))
+        m.apiserver_role.set(1.0, (self.name, "leader"))
+        klog.V(1).info_s("follower promoted", name=self.name,
+                         last_rv=result.last_rv,
+                         replayed=result.records_replayed,
+                         truncated=result.truncated_tail)
+        return result
+
+    def close(self):
+        with self._cond:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+        self.watch_cache.close()
+
+
+class LogShipper:
+    """Tail a leader's WAL file and stream verified records to followers.
+
+    Pump-driven and deterministic: ``pump()`` advances one tick — scan the
+    file's new bytes (verifying with the same length/crc walk replay
+    uses), deliver batches whose ship delay has elapsed, and cut new
+    batches for followers that are behind.  A batch is only cut when the
+    follower has NOTHING in flight, always from its acked offset — so a
+    dropped or torn batch is re-cut automatically next tick (at-least-once
+    ship + offset-contiguous apply = exactly-once records).
+
+    ``faults`` (chaos/replication.py ShipFaults, or None) decides drops,
+    tears, and lag spikes per batch, deterministically by batch seq."""
+
+    def __init__(self, wal_path: str, *, name: str = "leader",
+                 batch_max_records: int = 64, ship_delay: int = 0,
+                 faults=None):
+        self.wal_path = wal_path
+        self.name = name
+        self.batch_max_records = batch_max_records
+        self.ship_delay = ship_delay
+        self.faults = faults
+        self._followers: List[FollowerReplica] = []
+        self._pending: Dict[str, Deque[ShipBatch]] = {}
+        self._scan_offset = 0        # verified prefix length so far
+        self._boundaries: List[int] = []  # record END offsets, ascending
+        self._leader_rv = 0
+        self._tick = 0
+        self._seq = 0
+        self.batches_shipped = 0
+        self.records_shipped = 0
+        self.scan_regressions = 0
+
+    def leader_rv(self) -> int:
+        return self._leader_rv
+
+    @property
+    def verified_offset(self) -> int:
+        return self._scan_offset
+
+    def attach(self, follower: FollowerReplica) -> None:
+        """Register a follower; it resumes from its own acked offset
+        (fresh = 0, a rejoining replica = its replayed file size — byte
+        offsets line up because its file is a verified prefix of ours)."""
+        self._followers.append(follower)
+        self._pending[follower.name] = deque()
+
+    def detach(self, follower: FollowerReplica) -> None:
+        self._followers = [f for f in self._followers if f is not follower]
+        self._pending.pop(follower.name, None)
+
+    # --- the tick ------------------------------------------------------------
+
+    def pump(self) -> int:
+        """One ship round; returns records applied by followers this
+        tick."""
+        self._tick += 1
+        self._scan()
+        applied = 0
+        for f in self._followers:
+            q = self._pending[f.name]
+            while q and q[0].due <= self._tick:
+                batch = q.popleft()
+                data = batch.data
+                if self.faults is not None:
+                    verdict = self.faults.ship_fault(f.name, batch.seq,
+                                                     len(data))
+                    if verdict is not None:
+                        kind, keep = verdict
+                        if kind == "drop":
+                            continue  # lost on the wire; re-cut next tick
+                        if kind == "torn":
+                            data = data[:keep]
+                applied += f.deliver(data, batch.from_offset,
+                                     batch.leader_rv)
+            if not q:
+                cursor = f.acked_offset()
+                if cursor < self._scan_offset:
+                    delay = self.ship_delay
+                    if self.faults is not None:
+                        delay += self.faults.lag_spike(f.name)
+                    for data, off in self._slice(cursor):
+                        self._seq += 1
+                        q.append(ShipBatch(data=data, from_offset=off,
+                                           leader_rv=self._leader_rv,
+                                           seq=self._seq,
+                                           due=self._tick + delay))
+                        self.batches_shipped += 1
+            m.replication_lag_rv.set(
+                float(max(0, self._leader_rv - f.applied_rv())), (f.name,))
+        self.records_shipped += applied
+        return applied
+
+    def pump_until_synced(self, max_pumps: int = 10_000) -> int:
+        """Pump until every follower acked the verified tail (bounded);
+        returns pumps used.  The convergence helper tests and the soak's
+        drain phase call."""
+        for i in range(max_pumps):
+            self.pump()
+            if all(f.acked_offset() >= self._scan_offset
+                   and not self._pending[f.name]
+                   for f in self._followers):
+                return i + 1
+        return max_pumps
+
+    # --- file tailing --------------------------------------------------------
+
+    def _scan(self) -> None:
+        """Advance the verified prefix over the file's new bytes.
+
+        Only VERIFIED bytes ever advance the cursor, so a torn tail is
+        re-read every tick until it either verifies (it never will) or the
+        owner truncates it away (replay_on_boot's durable cut) and clean
+        appends land at the same offset — the follower-attaching-
+        mid-truncation contract the regression test pins."""
+        try:
+            size = os.path.getsize(self.wal_path)
+        except OSError:
+            return
+        if size < self._scan_offset:
+            # the file shrank BELOW the verified prefix: an out-of-protocol
+            # rewrite (never the torn-tail truncation, which cuts at our
+            # own good_end or later).  Refuse to guess: count it and stop
+            # shipping rather than stream bytes that no longer line up.
+            self.scan_regressions += 1
+            m.replication_ship_errors.inc(("regressed",))
+            return
+        if size == self._scan_offset:
+            return
+        with open(self.wal_path, "rb") as f:
+            f.seek(self._scan_offset)
+            data = f.read()
+        records, good_len = scan_records(data, base_offset=self._scan_offset)
+        if not records:
+            return
+        # each record's END is the next record's offset; the last ends the
+        # verified prefix — batches slice on these boundaries only
+        ends = [records[i + 1][0] for i in range(len(records) - 1)]
+        ends.append(self._scan_offset + good_len)
+        self._boundaries.extend(ends)
+        self._scan_offset += good_len
+        self._leader_rv = records[-1][1].rv
+
+    def _slice(self, cursor: int) -> List[Tuple[bytes, int]]:
+        """Cut [cursor, verified_end) into batches of at most
+        ``batch_max_records`` records, on record boundaries."""
+        lo = bisect.bisect_right(self._boundaries, cursor)
+        ends = self._boundaries[lo:]
+        out: List[Tuple[bytes, int]] = []
+        with open(self.wal_path, "rb") as f:
+            start = cursor
+            while ends:
+                take = ends[:self.batch_max_records]
+                ends = ends[self.batch_max_records:]
+                end = take[-1]
+                f.seek(start)
+                out.append((f.read(end - start), start))
+                start = end
+        return out
+
+
+# --- unshipped-suffix discard + divergence probe ------------------------------
+
+
+def discard_unshipped_suffix(wal_path: str,
+                             shipped_offset: int) -> DiscardResult:
+    """Detect and discard, exactly once, a dead leader's WAL records past
+    what the promoted follower had persisted (``shipped_offset`` — the new
+    leader's file size at promotion; byte offsets line up because the
+    follower's file is a verified prefix of the leader's).
+
+    The discarded records are acknowledged writes the asynchronous ship
+    stream never carried: the classic replication-lag loss window.  They
+    are returned for the divergence probe (and forensics); the file is
+    truncated durably so a rejoin of the old leader as a follower resumes
+    from the common prefix — calling again discards nothing (the
+    exactly-once contract the chaos battery pins)."""
+    result = DiscardResult()
+    if not os.path.exists(wal_path):
+        return result
+    records, good_end = read_records(wal_path)
+    cut = min(shipped_offset, good_end)
+    result.cut_at = cut
+    result.discarded = [rec for off, rec in records if off >= cut]
+    size = os.path.getsize(wal_path)
+    if size > cut:
+        result.truncated_bytes = size - cut
+        with open(wal_path, "r+b") as f:
+            f.truncate(cut)
+            f.flush()
+            os.fsync(f.fileno())
+        klog.V(1).info_s("unshipped WAL suffix discarded", path=wal_path,
+                         cut_at=cut, records=len(result.discarded),
+                         bytes=result.truncated_bytes)
+    return result
+
+
+def divergence_probe(store: ObjectStore, discarded: List[WALRecord],
+                     shipped_rv: int) -> List[str]:
+    """Assert the promoted store carries NO trace of the discarded
+    suffix: run immediately after promotion, before the new leader takes
+    writes.  Returns human-readable phantom descriptions (empty = clean).
+
+    A phantom is state only the discarded records could explain — a pod
+    bound to the node a discarded bind named at or past that bind's rv, an
+    object standing at a discarded write's rv, or any rv past the shipped
+    watermark."""
+    phantoms: List[str] = []
+    current = store.current_rv()
+    if current > shipped_rv:
+        phantoms.append(
+            f"store rv {current} is past the shipped watermark "
+            f"{shipped_rv}")
+    for rec in discarded:
+        obj = store.get(rec.kind, rec.namespace, rec.name)
+        if rec.op == "bind":
+            if obj is not None and \
+                    getattr(obj.spec, "node_name", "") == rec.node_name and \
+                    obj.metadata.resource_version >= rec.rv:
+                phantoms.append(
+                    f"phantom bind: {rec.namespace}/{rec.name} -> "
+                    f"{rec.node_name} (discarded rv {rec.rv})")
+        elif rec.op in ("create", "update"):
+            if obj is not None and \
+                    obj.metadata.resource_version >= rec.rv:
+                phantoms.append(
+                    f"phantom {rec.op}: {rec.kind} "
+                    f"{rec.namespace}/{rec.name} at rv "
+                    f"{obj.metadata.resource_version} "
+                    f"(discarded rv {rec.rv})")
+    return phantoms
+
+
+def rebase_follower(follower: FollowerReplica,
+                    to_offset: int) -> Tuple[FollowerReplica,
+                                             List[WALRecord]]:
+    """Roll a promotion LOSER back to the new leader's log length.
+
+    A loser that had applied FURTHER than the winner persisted holds
+    records the new authoritative log lacks (it was simply luckier on the
+    wire) — raft resolves this by truncating the follower's log to match
+    the leader's.  The in-memory store cannot un-apply, so the rebase
+    truncates the local file durably and reconstructs a fresh
+    FollowerReplica from it; returns (new_replica, rolled_back_records)
+    so the harness can re-point watchers and account the rollback."""
+    follower.close()
+    records, good_end = read_records(follower.wal_path)
+    cut = min(to_offset, good_end)
+    rolled = [rec for off, rec in records if off >= cut]
+    if os.path.getsize(follower.wal_path) > cut:
+        with open(follower.wal_path, "r+b") as f:
+            f.truncate(cut)
+            f.flush()
+            os.fsync(f.fileno())
+    fresh = FollowerReplica(follower.name, follower.wal_path,
+                            scheme=follower._scheme,
+                            ring_size=follower.watch_cache.ring_size)
+    return fresh, rolled

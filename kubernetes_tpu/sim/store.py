@@ -36,6 +36,15 @@ class StaleResourceVersion(ValueError):
     """CAS precondition failed in ObjectStore.update (409 Conflict analog)."""
 
 
+class FollowerReadOnly(PermissionError):
+    """Direct write against a read-only follower store (503 analog).
+
+    A replication follower's store may only change through
+    ``replay_record`` (shipped WAL records) — a local write would fork its
+    history from the leader's log and every rv it serves afterwards would
+    be unprovable.  Promotion (sim/replication.py) clears the flag."""
+
+
 @dataclass
 class WatchEvent:
     type: str
@@ -59,6 +68,13 @@ class ObjectStore:
         # reconstructs this store from the file.  None (default) costs one
         # attribute check per write.
         self.wal = wal
+        # read-only guard (sim/replication.py FollowerReplica): True while
+        # this store is a replication follower — every direct write verb
+        # raises FollowerReadOnly; replay_record (the ship-apply path) is
+        # exempt.  Defense in depth for the no-divergence invariant: the
+        # apiserver already 503s follower writes, but in-process callers
+        # holding the store object must hit the same wall.
+        self.read_only = False
         # store-lock READ acquisitions (list/get/watch/current_rv): the
         # watch cache's zero-store-lock contract on the list/watch-replay
         # path is asserted against deltas of this counter
@@ -207,7 +223,14 @@ class ObjectStore:
 
     # --- CRUD ----------------------------------------------------------------
 
+    def _check_writable(self, op: str, kind: str, name: str) -> None:
+        if self.read_only:
+            raise FollowerReadOnly(
+                f"store is a read-only replication follower: "
+                f"{op} {kind}/{name} must go to the leader")
+
     def create(self, kind: str, obj) -> int:
+        self._check_writable("create", kind, obj.metadata.name)
         if self.fault is not None:
             # outside the lock: an injected delay/429 must not stall other
             # writers; raising HERE means the mutation never half-applied,
@@ -247,6 +270,7 @@ class ObjectStore:
         StaleResourceVersion — the etcd3 GuaranteedUpdate contract that makes
         the apiserver's 409 actually prevent lost updates (a handler-level
         check-then-act would race concurrent writers)."""
+        self._check_writable("update", kind, obj.metadata.name)
         if self.fault is not None:
             self.fault.write_fault("update", kind, obj.metadata.name)
             if self.wal is not None:
@@ -282,6 +306,7 @@ class ObjectStore:
             return self._rv
 
     def delete(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        self._check_writable("delete", kind, name)
         if kind in self.CLUSTER_SCOPED:
             namespace = ""
         if self.fault is not None:
@@ -496,6 +521,7 @@ class ObjectStore:
         for this bind link into the caller's attempt tree instead of
         floating as roots.  Callers probe for the kwarg (the informer's
         signature-probing idiom) so facades without it keep working."""
+        self._check_writable("bind", "Pod", name)
         if self.fault is not None:
             self.fault.write_fault("bind", "Pod", name)
             if self.wal is not None:

@@ -229,14 +229,17 @@ class ReplayResult:
     errors: List[str] = field(default_factory=list)
 
 
-def read_records(path: str):
-    """Yield (offset, WALRecord) for every verifiable record; returns the
-    byte offset where a torn/corrupt tail begins (== file size when the
-    whole log verifies).  Used by replay and by forensic tooling."""
+def scan_records(data: bytes, base_offset: int = 0):
+    """Walk a length-prefixed + crc-checked byte stream: returns
+    ([(absolute_offset, WALRecord)], verified_length) where
+    ``verified_length`` counts only bytes of fully-verifiable records — a
+    torn/corrupt tail (short header, overrunning length, crc mismatch,
+    undecodable payload) stops the walk.  ``base_offset`` shifts the
+    reported record offsets so callers tailing a file mid-stream (the
+    replication LogShipper, a follower verifying a shipped batch) get
+    file-absolute positions from a relative slice."""
     good_end = 0
     records = []
-    with open(path, "rb") as f:
-        data = f.read()
     off = 0
     while off + _HEADER.size <= len(data):
         length, crc = _HEADER.unpack_from(data, off)
@@ -248,12 +251,22 @@ def read_records(path: str):
         if zlib.crc32(payload) != crc:
             break  # torn/corrupt: checksum fails
         try:
-            records.append((off, WALRecord.from_payload(payload)))
+            records.append((base_offset + off,
+                            WALRecord.from_payload(payload)))
         except (ValueError, KeyError):
             break  # undecodable payload that passed crc: treat as tail
         off = end
         good_end = end
     return records, good_end
+
+
+def read_records(path: str):
+    """Yield (offset, WALRecord) for every verifiable record; returns the
+    byte offset where a torn/corrupt tail begins (== file size when the
+    whole log verifies).  Used by replay and by forensic tooling."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return scan_records(data)
 
 
 def replay_on_boot(path: str, *, store=None, scheme=None,
@@ -281,8 +294,16 @@ def replay_on_boot(path: str, *, store=None, scheme=None,
         result.truncated_tail = True
         result.truncated_at = good_end
         if truncate:
+            # fsync the cut: a LogShipper (sim/replication.py) tails this
+            # same file by byte offset, and a re-resurrected torn suffix
+            # after a crash-mid-truncation would sit exactly where the
+            # next clean append lands — the shipper would then stream
+            # garbage bytes it can never verify past.  Durable truncation
+            # keeps the file re-openable for appends AND for shipping.
             with open(path, "r+b") as f:
                 f.truncate(good_end)
+                f.flush()
+                os.fsync(f.fileno())
         klog.V(1).info_s("WAL torn tail truncated", path=path,
                          at=good_end, lost_bytes=size - good_end)
     for _, rec in records:

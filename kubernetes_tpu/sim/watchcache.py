@@ -116,6 +116,12 @@ class WatchCache:
         # this cannot be served (events after it are gone) → 410
         self._compacted_rv = 0
         self._watchers: List[_CacheWatcher] = []
+        # optional external watermark (sim/replication.py): a follower
+        # replica clamps bookmarks to min(fanned_rv, gate()) — the PR-10
+        # no-overclaim invariant extended ACROSS processes.  The gate is a
+        # zero-arg callable returning the replication applied_rv; None (the
+        # default, single-process caches) costs one attribute read.
+        self.bookmark_gate: Optional[Callable[[], int]] = None
         self._stopped = False
         self._bookmark_thread: Optional[threading.Thread] = None
         # single-entry page memo: (rv, kind) → (snapshot, sorted keys).
@@ -222,6 +228,15 @@ class WatchCache:
         BOOKMARK may safely carry — see _apply)."""
         with self._lock:
             return self._fanned_rv
+
+    def bookmark_rv(self) -> int:
+        """The rv a BOOKMARK may carry RIGHT NOW: fanned_rv, clamped to the
+        replication watermark when a ``bookmark_gate`` is wired (a follower
+        must never bookmark past what it has provably applied — the
+        cross-process half of the no-overclaim invariant)."""
+        gate = self.bookmark_gate
+        rv = self.fanned_rv()
+        return min(rv, gate()) if gate is not None else rv
 
     @property
     def ring_occupancy(self) -> int:
@@ -359,8 +374,8 @@ class WatchCache:
         """Deliver the current rv to every bookmark-consuming watcher (the
         cacher's bookmarkFrequency tick, callable on demand so tests are
         deterministic).  Returns the rv delivered."""
+        rv = self.bookmark_rv()
         with self._lock:
-            rv = self._fanned_rv
             targets = [w for w in self._watchers
                        if w.on_bookmark is not None and not w.syncing]
         for w in targets:

@@ -1,0 +1,520 @@
+"""WAL-shipped follower replicas: ship protocol, rv-gated serving,
+promotion + fencing, unshipped-suffix discard, and the chaos soak.
+
+Reference behaviors exercised: etcd's raft log shipping (a follower's
+log is always a verified prefix of the leader's; apply is offset-
+contiguous and exactly-once), the cacher's bookmark discipline extended
+across processes (a follower never bookmarks past its replication
+watermark), and lease-fenced promotion (exactly one winner per
+incarnation, the loser's promote() refuses).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.chaos.faults import (
+    CRASH_MID_PROMOTE,
+    CRASH_POINTS,
+    FaultSchedule,
+    ProcessCrash,
+    crash_schedule,
+)
+from kubernetes_tpu.chaos.replication import ShipFaults, run_replication_soak
+from kubernetes_tpu.client.leaderelection import LeaderElector, LeaseLock
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.sim.replication import (
+    FollowerReplica,
+    LogShipper,
+    PromotionFenced,
+    discard_unshipped_suffix,
+    divergence_probe,
+    rebase_follower,
+)
+from kubernetes_tpu.sim.store import FollowerReadOnly, ObjectStore
+from kubernetes_tpu.sim.wal import (
+    WriteAheadLog,
+    replay_on_boot,
+    scan_records,
+)
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    """deliver() holds the replica condition across store apply and cache
+    fan-out; the bookmark gate reads it from the cache's bookmark path —
+    every battery here runs with inversion detection."""
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
+
+
+def _pod(i, ns="default"):
+    return (make_pod().name(f"p{i:03d}").uid(f"p{i:03d}").namespace(ns)
+            .req({"cpu": "1"}).creation_timestamp(100.0 + i).obj())
+
+
+def _leader(tmp_path, fsync_every=0):
+    wal = WriteAheadLog(str(tmp_path / "leader.wal"), fsync_every=fsync_every)
+    return ObjectStore(wal=wal), wal
+
+
+def _follower(tmp_path, name="f1", **kw):
+    return FollowerReplica(name, str(tmp_path / f"{name}.wal"), **kw)
+
+
+# --- ship protocol ------------------------------------------------------------
+
+
+def test_shipper_streams_records_and_follower_converges(tmp_path):
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path, batch_max_records=3)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(10):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    assert f.applied_rv() == store.current_rv()
+    assert f.lag_rv() == 0
+    assert f.acked_offset() == os.path.getsize(wal.path)
+    # the follower's file is a byte-identical prefix (here: copy) of the
+    # leader's — the log-matching property offsets rely on
+    assert open(f.wal_path, "rb").read() == open(wal.path, "rb").read()
+    objs, rv = f.store.list("Pod")
+    assert len(objs) == 10 and rv == store.current_rv()
+
+
+def test_ship_delay_models_replication_lag(tmp_path):
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path, ship_delay=3)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    store.create("Pod", _pod(0))
+    ship.pump()  # batch cut at tick 1, due at tick 4
+    assert f.applied_rv() == 0 and f.leader_rv() == 0
+    ship.pump()
+    ship.pump()
+    assert f.applied_rv() == 0, "batch delivered before its ship delay"
+    ship.pump()
+    assert f.applied_rv() == store.current_rv()
+
+
+def test_dropped_batches_resend_from_acked_offset(tmp_path):
+    store, wal = _leader(tmp_path)
+    faults = ShipFaults(seed=3, drop_rate=1.0, max_faults_per_stream=2)
+    ship = LogShipper(wal.path, batch_max_records=2, faults=faults)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(6):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    assert f.applied_rv() == store.current_rv()
+    assert faults.injected.get("ship_drop") == 2
+
+
+def test_torn_batch_applies_verified_prefix_then_resends(tmp_path):
+    store, wal = _leader(tmp_path)
+    faults = ShipFaults(seed=5, torn_rate=1.0, max_faults_per_stream=1)
+    ship = LogShipper(wal.path, batch_max_records=4, faults=faults)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(8):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    assert f.applied_rv() == store.current_rv()
+    assert faults.injected.get("ship_torn") == 1
+    # exactly-once despite the redelivery overlap: every rv applied once
+    rvs = [ev.resource_version for ev in f.store._log]
+    assert rvs == sorted(set(rvs))
+
+
+def test_gap_batch_rejected_until_resend_fills_it(tmp_path):
+    store, wal = _leader(tmp_path)
+    store.create("Pod", _pod(0))
+    data = open(wal.path, "rb").read()
+    f = _follower(tmp_path)
+    errs0 = m.replication_ship_errors.value(("gap",))
+    # a batch from a FUTURE offset (its predecessor was dropped): rejected
+    # whole, counted, watermark unmoved
+    assert f.deliver(data, from_offset=100, leader_rv=1) == 0
+    assert f.ship_errors == 1
+    assert m.replication_ship_errors.value(("gap",)) == errs0 + 1
+    assert f.applied_rv() == 0
+    # the contiguous resend applies; a duplicate redelivery is a no-op
+    assert f.deliver(data, from_offset=0, leader_rv=1) == 1
+    assert f.deliver(data, from_offset=0, leader_rv=1) == 0
+    assert f.applied_rv() == 1
+
+
+def test_follower_store_rejects_direct_writes(tmp_path):
+    f = _follower(tmp_path)
+    with pytest.raises(FollowerReadOnly):
+        f.store.create("Pod", _pod(0))
+    with pytest.raises(FollowerReadOnly):
+        f.store.bind_pod("default", "p000", "n0")
+    # replay_record is exempt: it IS the replication apply path
+    f.store.replay_record("create", "Pod", obj=_pod(0), rv=1)
+    assert f.store.get("Pod", "default", "p000") is not None
+
+
+def test_wait_for_rv_bounded(tmp_path):
+    f = _follower(tmp_path)
+    assert f.wait_for_rv(0, timeout=0.01)
+    assert not f.wait_for_rv(5, timeout=0.05), \
+        "wait_for_rv returned for an rv never applied"
+
+
+# --- satellite 2: torn-tail truncation stays shippable ------------------------
+
+
+def test_follower_attaching_mid_truncation_never_applies_torn_record(
+        tmp_path):
+    """replay_on_boot's torn-tail cut must leave the file re-openable for
+    SHIPPING too: a follower attached across the truncation boundary never
+    applies the torn record and resumes at the next clean append."""
+    store, wal = _leader(tmp_path)
+    for i in range(4):
+        store.create("Pod", _pod(i))
+    wal.close()
+    good_size = os.path.getsize(wal.path)
+    # crash mid-append: half a record lands past the verified tail
+    with open(wal.path, "ab") as fh:
+        fh.write(b"\x00\x00\x01\x00GARBAGE-TORN-TAIL")
+    ship = LogShipper(wal.path, batch_max_records=2)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    ship.pump_until_synced()
+    # only the verified prefix shipped; the torn bytes never advanced the
+    # scan cursor (re-read every tick, never verified)
+    assert f.applied_rv() == 4
+    assert ship.verified_offset == good_size
+    assert ship.scan_regressions == 0
+    # boot-path recovery truncates the tail DURABLY and reopens for appends
+    replay = replay_on_boot(wal.path, truncate=True)
+    assert replay.truncated_tail and replay.truncated_at == good_size
+    wal2 = WriteAheadLog(wal.path, fsync_every=0)
+    store2 = replay.store
+    store2.wal = wal2
+    store2.create("Pod", _pod(9))
+    # the clean append lands exactly where the torn record sat; the
+    # follower ships and applies it with no gap, no garbage, no regress
+    ship.pump_until_synced()
+    assert f.applied_rv() == store2.current_rv() == 5
+    assert f.store.get("Pod", "default", "p009") is not None
+    assert ship.scan_regressions == 0
+    assert b"GARBAGE" not in open(f.wal_path, "rb").read()
+
+
+# --- promotion, fencing, divergence -------------------------------------------
+
+
+def _elect(election_store, identity, clock, lease_duration=0.3):
+    return LeaderElector(
+        LeaseLock(election_store, "kube-system", "repl-lease"),
+        identity=identity, lease_duration=lease_duration, clock=clock)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_promotion_race_fences_single_winner(tmp_path):
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path)
+    f1, f2 = _follower(tmp_path, "f1"), _follower(tmp_path, "f2")
+    ship.attach(f1)
+    ship.attach(f2)
+    for i in range(5):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    wal.close()
+    clock = _Clock()
+    election = ObjectStore()
+    e1, e2 = _elect(election, "f1", clock), _elect(election, "f2", clock)
+    # both race: the lease CAS picks exactly one
+    won1 = e1.try_acquire_or_renew()
+    won2 = e2.try_acquire_or_renew()
+    assert won1 and not won2
+    with pytest.raises(PromotionFenced):
+        f2.promote(elector=e2)
+    assert f2.role == "follower" and f2.store.read_only
+    res = f1.promote(elector=e1)
+    assert f1.role == "leader" and not f1.store.read_only
+    assert res.last_rv == 5
+    # the promoted log takes appends at the truncation-checked tail
+    f1.store.create("Pod", _pod(9))
+    assert f1.store.current_rv() == 6
+
+
+def test_unshipped_suffix_discard_exactly_once_and_divergence_probe(
+        tmp_path):
+    store, wal = _leader(tmp_path)
+    store.create("Node", make_node().name("n0")
+                 .capacity({"cpu": "8", "pods": "32"}).obj())
+    ship = LogShipper(wal.path)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(4):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    shipped_rv = f.applied_rv()
+    # acknowledged writes the stream never carries — including a bind,
+    # the classic phantom the probe hunts
+    store.create("Pod", _pod(7))
+    store.bind_pod("default", "p007", "n0")
+    wal.close()
+    res = f.promote()
+    d1 = discard_unshipped_suffix(wal.path, f.acked_offset())
+    assert [r.op for r in d1.discarded] == ["create", "bind"]
+    assert d1.truncated_bytes > 0
+    # exactly-once: the second call finds nothing to cut
+    d2 = discard_unshipped_suffix(wal.path, f.acked_offset())
+    assert not d2.discarded and d2.truncated_bytes == 0
+    assert divergence_probe(f.store, d1.discarded, res.last_rv) == []
+    assert f.store.get("Pod", "default", "p007") is None
+    assert shipped_rv == res.last_rv
+    # a PHANTOM is detected: apply the discarded suffix as if it leaked
+    for rec in d1.discarded:
+        obj = (f.store.wal.scheme().decode(rec.manifest)
+               if rec.manifest is not None else None)
+        f.store.replay_record(rec.op, rec.kind, obj=obj,
+                              namespace=rec.namespace, name=rec.name,
+                              node_name=rec.node_name, rv=rec.rv)
+    phantoms = divergence_probe(f.store, d1.discarded, res.last_rv)
+    assert phantoms and any("phantom bind" in p for p in phantoms)
+
+
+def test_crash_mid_promote_is_idempotent(tmp_path):
+    assert CRASH_MID_PROMOTE in CRASH_POINTS
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(6):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    wal.close()
+    fault = FaultSchedule(0, crash_points={CRASH_MID_PROMOTE: 1})
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash):
+            f.promote()
+        # death between the durable tail fsync and the WAL reattach: the
+        # replica object is gone, but everything promotion needs is in
+        # the file — a fresh incarnation on the same path just promotes
+        f2 = FollowerReplica("f1", f.wal_path)
+        assert f2.applied_rv() == 6
+        res = f2.promote()
+    assert res.last_rv == 6 and f2.role == "leader"
+    f2.store.create("Pod", _pod(9))
+    assert f2.store.current_rv() == 7
+
+
+def test_rebase_rolls_loser_back_to_winner_log_length(tmp_path):
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path)
+    slow, fast = _follower(tmp_path, "slow"), _follower(tmp_path, "fast")
+    ship.attach(fast)
+    for i in range(6):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    # "slow" wins the race holding only a 3-record prefix; "fast" ran
+    # ahead on the wire — deliver the prefix bytes directly
+    data = open(wal.path, "rb").read()
+    records, _ = scan_records(data)
+    prefix_end = records[3][0]  # offset where record 4 begins
+    assert slow.deliver(data[:prefix_end], 0, 3) == 3
+    wal.close()
+    win = slow.promote()
+    cut = slow.acked_offset()
+    assert fast.acked_offset() > cut
+    rebased, rolled = rebase_follower(fast, cut)
+    assert [r.rv for r in rolled] == list(range(win.last_rv + 1, 7))
+    assert rebased.applied_rv() == win.last_rv
+    assert os.path.getsize(rebased.wal_path) == cut
+    # rebased follower resumes cleanly over the new leader's log
+    ship3 = LogShipper(slow.wal_path)
+    ship3.attach(rebased)
+    slow.store.create("Pod", _pod(9))
+    ship3.pump_until_synced()
+    assert rebased.applied_rv() == slow.store.current_rv()
+
+
+# --- follower HTTP serving ----------------------------------------------------
+
+
+def _http_fixture(tmp_path, **server_kw):
+    from kubernetes_tpu.apiserver.server import APIServer
+
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path)
+    f = _follower(tmp_path, **{k: v for k, v in server_kw.items()
+                               if k == "ring_size"})
+    ship.attach(f)
+    api = APIServer(replica=f,
+                    follower_wait_seconds=server_kw.get(
+                        "follower_wait_seconds", 0.15)).start()
+    return store, wal, ship, f, api
+
+
+def test_follower_serves_rv_consistent_list_and_waits_then_504(tmp_path):
+    store, wal, ship, f, api = _http_fixture(tmp_path)
+    try:
+        for i in range(5):
+            store.create("Pod", _pod(i))
+        ship.pump_until_synced()
+        r = urllib.request.urlopen(
+            f"{api.url}/api/v1/pods?resourceVersion={f.applied_rv()}")
+        assert len(json.loads(r.read())["items"]) == 5
+        # an rv the watermark has not reached: bounded wait, then 504
+        # Timeout (NOT 410 — the rv is valid, just not here yet)
+        store.create("Pod", _pod(9))
+        rej0 = m.apiserver_rejected.value(("follower_lag",))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{api.url}/api/v1/pods"
+                f"?resourceVersion={store.current_rv()}")
+        assert ei.value.code == 504
+        assert ei.value.headers.get("Retry-After") is not None
+        assert json.loads(ei.value.read())["reason"] == "Timeout"
+        assert m.apiserver_rejected.value(("follower_lag",)) == rej0 + 1
+        # once shipped, the same rv serves
+        ship.pump_until_synced()
+        r = urllib.request.urlopen(
+            f"{api.url}/api/v1/pods?resourceVersion={store.current_rv()}")
+        assert len(json.loads(r.read())["items"]) == 6
+        # watch above the watermark gates the same way
+        store.create("Pod", _pod(10))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{api.url}/api/v1/pods?watch=true"
+                f"&resourceVersion={store.current_rv()}&timeoutSeconds=1")
+        assert ei.value.code == 504
+    finally:
+        api.stop()
+
+
+def test_follower_rejects_writes_503_until_promoted(tmp_path):
+    from kubernetes_tpu.api.serialize import to_manifest
+
+    store, wal, ship, f, api = _http_fixture(tmp_path)
+    try:
+        manifest = to_manifest(_pod(0), f.scheme())
+        req = urllib.request.Request(
+            f"{api.url}/api/v1/namespaces/default/pods",
+            data=json.dumps(manifest).encode(), method="POST")
+        rej0 = m.apiserver_rejected.value(("follower_readonly",))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert m.apiserver_rejected.value(("follower_readonly",)) == rej0 + 1
+        # promotion opens writes on the SAME server — the role check is
+        # live, no restart, no re-wiring
+        wal.close()
+        f.promote()
+        assert urllib.request.urlopen(req).status == 201
+    finally:
+        api.stop()
+
+
+def test_follower_shorter_ring_answers_410_for_relist(tmp_path):
+    store, wal, ship, f, api = _http_fixture(tmp_path, ring_size=4)
+    try:
+        for i in range(14):
+            store.create("Pod", _pod(i))
+        ship.pump_until_synced()
+        assert f.watch_cache.oldest_rv > 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{api.url}/api/v1/pods?watch=true&resourceVersion=1"
+                f"&timeoutSeconds=1")
+        assert ei.value.code == 410
+        assert json.loads(ei.value.read())["reason"] == "Expired"
+        # rv=0 ("serve current") still lists — the relist entry point
+        r = urllib.request.urlopen(f"{api.url}/api/v1/pods?resourceVersion=0")
+        assert len(json.loads(r.read())["items"]) == 14
+    finally:
+        api.stop()
+
+
+def test_follower_bookmarks_clamp_to_replication_watermark(tmp_path):
+    store, wal = _leader(tmp_path)
+    ship = LogShipper(wal.path)
+    f = _follower(tmp_path)
+    ship.attach(f)
+    for i in range(5):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    marks = []
+    unwatch = f.watch_cache.watch(lambda ev: None,
+                                  since_rv=f.applied_rv(),
+                                  on_bookmark=marks.append)
+    assert f.watch_cache.bookmark_now() == 5
+    # an artificially LOW gate (mid-apply watermark) clamps the bookmark
+    # below fanned_rv — the cross-process no-overclaim rule, isolated
+    f.watch_cache.bookmark_gate = lambda: 3
+    assert f.watch_cache.bookmark_rv() == 3
+    assert f.watch_cache.bookmark_now() == 3
+    assert marks == [5, 3]
+    unwatch()
+    # promotion lifts the gate: leader bookmarks follow fanned_rv again
+    wal.close()
+    f.promote()
+    assert f.watch_cache.bookmark_gate is None
+    assert f.watch_cache.bookmark_rv() == f.watch_cache.fanned_rv()
+
+
+# --- the soak (fast shapes; acceptance shape is slow-marked) ------------------
+
+
+@pytest.mark.parametrize("kill_mode", ["shipped", "unshipped", "torn"])
+def test_replication_soak_fast_shape(tmp_path, kill_mode):
+    r = run_replication_soak(seed=11, workdir=str(tmp_path),
+                             kill_mode=kill_mode)
+    assert r.converged, r
+    assert r.fenced_losers == 1
+    assert r.promotion_ticks <= 60
+    if kill_mode != "shipped":
+        assert r.discarded_records > 0
+    assert r.phantoms == []
+
+
+def test_replication_soak_deterministic_replay(tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    a = run_replication_soak(seed=23, workdir=str(tmp_path / "a"),
+                             kill_mode="unshipped")
+    b = run_replication_soak(seed=23, workdir=str(tmp_path / "b"),
+                             kill_mode="unshipped")
+    assert a.determinism_signature() == b.determinism_signature()
+
+
+@pytest.mark.slow
+def test_replication_soak_thousand_watcher_acceptance_shape(tmp_path):
+    """ISSUE 16 acceptance: 500 recording watchers per follower (1000
+    total), heavy fault rates, leader killed with an unshipped suffix —
+    zero lost/dup events, zero overclaimed bookmarks, exactly-once binds
+    across the incarnation boundary (tools/replica_soak.py runs this
+    same shape as the CI gate)."""
+    r = run_replication_soak(seed=16, n_pods=120, n_watchers=500,
+                             workdir=str(tmp_path), kill_mode="unshipped",
+                             drop_rate=0.15, torn_rate=0.1, lag_rate=0.1)
+    assert r.converged, r
+    assert r.events_lost == 0 and r.events_duplicated == 0
+    assert r.bookmark_overclaims == 0
+    assert r.duplicate_binds == 0

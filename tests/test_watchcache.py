@@ -344,3 +344,113 @@ def test_thousand_watcher_soak_acceptance_shape():
     assert result["store_read_ops_delta"] == 0
     assert result["watchers_complete"] == 1000
     assert result["resync_ratio"] < 3.0, result
+
+
+# --- informer vs a lagging replication follower (ISSUE 16 satellite) ----------
+
+
+def _shipped_follower(tmp_path, n_pods, ring_size=4096):
+    from kubernetes_tpu.sim.replication import FollowerReplica, LogShipper
+    from kubernetes_tpu.sim.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path / "leader.wal"), fsync_every=0)
+    store = ObjectStore(wal=wal)
+    ship = LogShipper(wal.path)
+    f = FollowerReplica("f1", str(tmp_path / "f1.wal"), ring_size=ring_size)
+    ship.attach(f)
+    for i in range(n_pods):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    return store, ship, f
+
+
+def test_paged_walk_straddling_watermark_advance_stays_rv_pinned(tmp_path):
+    """A paged LIST walk against a FOLLOWER cache whose replication
+    watermark advances between pages: every page serves the walk's pinned
+    rv — pods shipped mid-walk never leak in (the etcd3 pagination
+    contract, unchanged by which replica answers)."""
+    store, ship, f = _shipped_follower(tmp_path, 9)
+    page1, rv, tok = f.watch_cache.list_page("Pod", limit=4)
+    assert rv == f.applied_rv() and tok
+    # the watermark advances mid-walk: new pods ship and apply
+    for i in range(20, 24):
+        store.create("Pod", _pod(i))
+    ship.pump_until_synced()
+    assert f.applied_rv() > rv
+    walked = _names(page1)
+    while tok:
+        page, prv, tok = f.watch_cache.list_page("Pod", limit=4,
+                                                 continue_=tok)
+        assert prv == rv, "page escaped the walk's pinned rv"
+        walked += _names(page)
+    assert walked == [f"p{i:03d}" for i in range(9)], \
+        "mid-walk shipped pods leaked into an rv-pinned walk"
+    # a FRESH walk serves the advanced watermark
+    objs, rv2, _ = f.watch_cache.list_page("Pod", limit=0)
+    assert rv2 == f.applied_rv() and len(objs) == 13
+
+
+def test_follower_shorter_ring_410_relists_without_double_delivery(
+        tmp_path):
+    """A reflector on a FOLLOWER whose ring is shorter than the leader's:
+    falling behind the follower's horizon answers 410 → ONE fresh paged
+    walk against the SAME endpoint (FailoverEndpoints must not rotate on
+    410 — compaction is not a dead replica), and the relist diff delivers
+    no duplicate events for objects the reflector already holds."""
+    from kubernetes_tpu.client.informer import FailoverEndpoints
+
+    store, ship, f = _shipped_follower(tmp_path, 6, ring_size=4)
+    fo = FailoverEndpoints([f.watch_cache])
+    seen = []
+    refl = Reflector(fo, "Pod", relist_page_size=3, rewatch_on_error=True)
+    refl.add_handler(
+        lambda et, obj, old: seen.append(
+            (et, obj.metadata.name, obj.metadata.resource_version)))
+    refl.run()
+    assert len(refl.items) == 6
+    # churn the follower past its short ring while the stream is "down"
+    refl._unwatch()
+    refl._unwatch = None
+    for _ in range(12):
+        _fresh_update(store, "p000", "churn")
+    ship.pump_until_synced()
+    assert refl.last_rv < f.watch_cache.oldest_rv
+    paged0 = m.informer_relists.value(("paged",))
+    refl._on_watch_error(ConnectionError("stream cut while lagging"))
+    assert refl.relists == 1
+    assert m.informer_relists.value(("paged",)) == paged0 + 1
+    assert fo.failovers == 0, "rotated on a 410 (compaction, not death)"
+    assert refl.items[("default", "p000")].metadata.labels["v"] == "churn"
+    # exactly-once delivery per (object, rv): the relist diffed against
+    # held state instead of replaying the walked world
+    assert len(seen) == len(set(seen)), seen
+    # live again after the relist: shipped updates keep flowing
+    _fresh_update(store, "p001", "live-again")
+    ship.pump_until_synced()
+    assert refl.items[("default", "p001")].metadata.labels["v"] == \
+        "live-again"
+    refl.stop()
+
+
+def test_failover_endpoints_rotate_off_dead_replica(tmp_path):
+    """The rotation half: a dead endpoint (ConnectionError on every verb)
+    rotates the facade to the live follower, once, on the first failing
+    call — the reflector never notices."""
+    from kubernetes_tpu.client.informer import FailoverEndpoints
+
+    class DeadEndpoint:
+        def list_page(self, *a, **kw):
+            raise ConnectionError("replica gone")
+
+        list = watch = get = list_page
+
+    store, ship, f = _shipped_follower(tmp_path, 5)
+    rotated = []
+    fo = FailoverEndpoints([DeadEndpoint(), f.watch_cache],
+                           on_failover=lambda ep, e: rotated.append(ep))
+    refl = Reflector(fo, "Pod", relist_page_size=3, rewatch_on_error=True)
+    refl.run()
+    assert len(refl.items) == 5
+    assert fo.failovers == 1 and len(rotated) == 1
+    assert fo.current is f.watch_cache
+    refl.stop()

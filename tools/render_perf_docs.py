@@ -395,6 +395,54 @@ def render_r15_latency(ab):
     return "\n".join(lines)
 
 
+R16_BEGIN = ("<!-- GENERATED:PERF:R16REPLICA:BEGIN (tools/render_perf_docs.py"
+             " — edit BENCH_r16_REPLICA.json, not this block) -->")
+R16_END = "<!-- GENERATED:PERF:R16REPLICA:END -->"
+
+
+def render_r16_replica(r16):
+    """Round-16 replication bench (BENCH_r16_REPLICA.json): promotion
+    time over a shipped N-record log (the failover write-unavailability
+    window) and follower paged-read throughput at the watermark, median +
+    per-pass band, plus the riding soak's convergence line."""
+    env = r16["environment"]
+    promo = r16["promotion_ms"]
+    reads = r16["follower_read_pages_per_s"]
+    soak = r16["soak"]
+
+    def band(vals):
+        return "/".join(f"{v:.0f}" for v in vals)
+
+    lines = [
+        R16_BEGIN,
+        "",
+        f"Environment: `{env['backend']}` backend, {env['cpus']} CPU "
+        f"core(s) — {env['note']}",
+        "",
+        "| metric | median | passes |",
+        "|---|---|---|",
+        f"| follower promotion over a {r16['records']}-record shipped log "
+        f"(fsync + tail verify + WAL reattach) | "
+        f"{promo['median']:.1f} ms | {band(promo['passes'])} |",
+        f"| follower read throughput (rv-pinned "
+        f"{r16['page_limit']}-object LIST pages at the watermark) | "
+        f"{reads['median']:.0f} pages/s | {band(reads['passes'])} |",
+        "",
+        f"Soak (unshipped-boundary kill, seed 11): "
+        f"{'converged' if soak['converged'] else 'FAILED'} — promoted "
+        f"{soak['promoted']} in {soak['promotion_ticks']} ticks "
+        f"({soak['fenced_losers']} fenced loser), "
+        f"{soak['discarded_records']} unshipped records discarded "
+        f"exactly-once, {soak['events_lost']} lost / "
+        f"{soak['events_duplicated']} duplicated events, "
+        f"{soak['bookmark_overclaims']} overclaimed bookmarks, injected "
+        f"{soak['injected']}.",
+        "",
+        R16_END,
+    ]
+    return "\n".join(lines)
+
+
 R9_BEGIN = ("<!-- GENERATED:PERF:R9100K:BEGIN (tools/render_perf_docs.py — "
             "edit BENCH_r09_100K.json, not this block) -->")
 R9_END = "<!-- GENERATED:PERF:R9100K:END -->"
@@ -498,6 +546,13 @@ def main() -> int:
     if r15 is not None:
         ok &= splice("COMPONENTS.md", render_r15_latency(r15),
                      R15_BEGIN, R15_END)
+    try:
+        r16 = load_bench("BENCH_r16_REPLICA.json")
+    except (OSError, json.JSONDecodeError):
+        r16 = None  # pre-round-16 trees have no replication artifact
+    if r16 is not None:
+        ok &= splice("COMPONENTS.md", render_r16_replica(r16),
+                     R16_BEGIN, R16_END)
     return 0 if ok else 1
 
 

@@ -1,0 +1,200 @@
+"""Two-follower WAL-shipping soak gate + the round-16 replica bench.
+
+Gate mode (default) — the replication layer's CI gate, run fail-fast by
+tools/run_suites.sh before any perf suite:
+
+  - the two-follower failover soak at EVERY leader-kill boundary
+    (shipped / unshipped / torn), 500 recording watchers per follower
+    (1000 total — the acceptance shape tests/test_replication.py slow-marks),
+    heavy ship-wire fault rates: zero lost/duplicated watch events across
+    the incarnation boundary, zero overclaimed bookmarks, exactly-once
+    binds, a fenced promotion race with one winner, the dead leader's
+    unshipped suffix discarded exactly-once and divergence-probed clean;
+  - a same-seed determinism replay of the unshipped run: identical
+    injected-fault counts, winner, discard count, and final rv.
+
+Bench mode (``--bench``) — multi-pass promotion-time and follower-read-
+throughput measurement, median + per-pass band, written to
+BENCH_r16_REPLICA.json and rendered into COMPONENTS.md by
+tools/render_perf_docs.py:
+
+  - promotion: a fresh follower incarnation over a shipped N-record log
+    (the rejoin replay is setup, NOT timed) runs promote() — fence-free
+    fsync + tail verification + WAL reattach, the write-unavailability
+    window a failover pays;
+  - follower reads: rv-pinned paged LIST walks against the follower's
+    watch cache at the replication watermark, ops/s.
+
+    python tools/replica_soak.py [SEED]
+    python tools/replica_soak.py --bench [PASSES]
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+from kubernetes_tpu.chaos.replication import run_replication_soak  # noqa: E402
+
+SOAK_CFG = dict(n_pods=120, n_nodes=6, n_watchers=500,
+                drop_rate=0.15, torn_rate=0.1, lag_rate=0.1)
+
+
+def report(tag, r):
+    status = "CONVERGED" if r.converged else "FAILED"
+    print(f"[{tag}] {status}: {r.bound}/{r.pods} bound, "
+          f"lost={r.events_lost} dup={r.events_duplicated} "
+          f"overclaims={r.bookmark_overclaims} "
+          f"dup_binds={r.duplicate_binds} phantoms={len(r.phantoms)}, "
+          f"promoted={r.promoted} (fenced={r.fenced_losers}, "
+          f"{r.promotion_ticks} ticks), discarded={r.discarded_records}, "
+          f"rolled_back={r.rolled_back_events}, final_rv={r.final_rv}, "
+          f"{r.wall_seconds:.1f}s")
+    print(f"[{tag}] injected: {dict(sorted(r.injected.items()))} "
+          f"ship_errors: {dict(sorted(r.ship_errors.items()))}")
+    return r.converged
+
+
+def gate(seed: int) -> int:
+    ok = True
+    results = {}
+    for kill_mode in ("shipped", "unshipped", "torn"):
+        with tempfile.TemporaryDirectory() as wd:
+            r = run_replication_soak(seed=seed, workdir=wd,
+                                     kill_mode=kill_mode, **SOAK_CFG)
+        results[kill_mode] = r
+        ok &= report(kill_mode, r)
+    with tempfile.TemporaryDirectory() as wd:
+        replay = run_replication_soak(seed=seed, workdir=wd,
+                                      kill_mode="unshipped", **SOAK_CFG)
+    deterministic = (replay.determinism_signature()
+                     == results["unshipped"].determinism_signature())
+    print(f"deterministic replay: {deterministic}")
+    if not deterministic:
+        print(f"  run1: {results['unshipped'].determinism_signature()}")
+        print(f"  run2: {replay.determinism_signature()}")
+    return 0 if (ok and deterministic) else 1
+
+
+# --- bench mode ---------------------------------------------------------------
+
+BENCH_RECORDS = 2000
+READ_OPS = 2000
+PAGE_LIMIT = 100
+
+
+def _build_shipped_pair(workdir: str, n_records: int):
+    """Leader with ``n_records`` WAL records (create+bind mix), fully
+    shipped to one follower; returns (leader_store, shipper, follower)."""
+    from kubernetes_tpu.sim.replication import FollowerReplica, LogShipper
+    from kubernetes_tpu.sim.store import ObjectStore
+    from kubernetes_tpu.sim.wal import WriteAheadLog
+    from kubernetes_tpu.testutil import make_node, make_pod
+
+    wal = WriteAheadLog(os.path.join(workdir, "leader.wal"), fsync_every=0)
+    store = ObjectStore(wal=wal)
+    for i in range(4):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "64", "pods": "256"}).obj())
+    n_pods = (n_records - 4) // 2
+    for i in range(n_pods):
+        name = f"b{i:05d}"
+        store.create("Pod", make_pod().name(name).uid(name)
+                     .namespace("default").req({"cpu": "1"}).obj())
+        store.bind_pod("default", name, f"n{i % 4}")
+    ship = LogShipper(wal.path, batch_max_records=256)
+    f = FollowerReplica("bench-f1", os.path.join(workdir, "f1.wal"))
+    ship.attach(f)
+    ship.pump_until_synced()
+    assert f.applied_rv() == store.current_rv()
+    return store, ship, f
+
+
+def bench(passes: int) -> int:
+    from kubernetes_tpu.sim.replication import FollowerReplica
+
+    out = {
+        "suite": "ReplicationR16",
+        "generated_by": "tools/replica_soak.py --bench",
+        "environment": {
+            "backend": "cpu",
+            "cpus": os.cpu_count(),
+            "note": "single-host sim; promotion excludes the rejoin "
+                    "replay (setup), reads are rv-pinned paged walks "
+                    "at the replication watermark",
+        },
+        "records": BENCH_RECORDS,
+        "read_ops": READ_OPS,
+        "page_limit": PAGE_LIMIT,
+    }
+    with tempfile.TemporaryDirectory() as wd:
+        store, ship, f = _build_shipped_pair(wd, BENCH_RECORDS)
+
+        promo_ms = []
+        for p in range(passes):
+            cand_path = os.path.join(wd, f"cand{p}.wal")
+            shutil.copyfile(f.wal_path, cand_path)
+            cand = FollowerReplica(f"cand{p}", cand_path)  # rejoin: untimed
+            t0 = time.perf_counter()
+            cand.promote()
+            promo_ms.append((time.perf_counter() - t0) * 1e3)
+            cand.store.wal.close()
+            cand.watch_cache.close()
+
+        read_ops_s = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            done = 0
+            tok = None
+            while done < READ_OPS:
+                page, rv, tok = f.watch_cache.list_page(
+                    "Pod", limit=PAGE_LIMIT, continue_=tok or None)
+                done += 1
+                if not tok:
+                    tok = None
+            read_ops_s.append(READ_OPS / (time.perf_counter() - t0))
+        f.close()
+
+    out["promotion_ms"] = {
+        "median": statistics.median(promo_ms),
+        "passes": [round(v, 2) for v in promo_ms],
+    }
+    out["follower_read_pages_per_s"] = {
+        "median": statistics.median(read_ops_s),
+        "passes": [round(v, 1) for v in read_ops_s],
+    }
+
+    # one fast converged soak rides along for the rendered context line
+    with tempfile.TemporaryDirectory() as wd:
+        r = run_replication_soak(seed=11, workdir=wd, kill_mode="unshipped")
+    out["soak"] = {
+        "converged": r.converged,
+        "pods": r.pods,
+        "promoted": r.promoted,
+        "promotion_ticks": r.promotion_ticks,
+        "fenced_losers": r.fenced_losers,
+        "discarded_records": r.discarded_records,
+        "events_lost": r.events_lost,
+        "events_duplicated": r.events_duplicated,
+        "bookmark_overclaims": r.bookmark_overclaims,
+        "injected": dict(sorted(r.injected.items())),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_r16_REPLICA.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(out, indent=2))
+    return 0 if r.converged else 1
+
+
+if __name__ == "__main__":
+    if "--bench" in sys.argv[1:]:
+        rest = [a for a in sys.argv[1:] if a != "--bench"]
+        sys.exit(bench(int(rest[0]) if rest else 5))
+    sys.exit(gate(int(sys.argv[1]) if len(sys.argv) > 1 else 16))

@@ -68,6 +68,14 @@ JAX_PLATFORMS=cpu timeout 900 python tools/node_storm_soak.py \
 # fail fast here; the full 500-pod soak runs behind the slow marker
 JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_recovery.py -q -m 'not slow' \
   || { echo "FAILED: recovery test gate" >> suites_run.log; exit 1; }
+# replication gate (round 16): the two-follower WAL-shipping soak at every
+# leader-kill boundary (shipped/unshipped/torn, 1000 recording watchers)
+# plus a same-seed determinism replay — a follower that loses or
+# double-delivers an event, overclaims a bookmark, or promotes without the
+# fence would poison every read-scaling claim, so fail fast here; the fast
+# unit battery rides tier-1 (tests/test_replication.py)
+JAX_PLATFORMS=cpu timeout 900 python tools/replica_soak.py \
+  || { echo "FAILED: replication soak gate" >> suites_run.log; exit 1; }
 # sharding-parity gate: the node-sharded live runtime and the
 # identity-class dedup path (round 9) must bind bit-for-bit with the
 # unsharded/full paths — perf rows from a diverging program would be
