@@ -6,4 +6,5 @@ from . import lock_discipline  # noqa: F401
 from . import metrics_registration  # noqa: F401
 from . import recompile_hazard  # noqa: F401
 from . import span_catalog  # noqa: F401
+from . import thread_ownership  # noqa: F401
 from . import trace_safety  # noqa: F401

@@ -1,0 +1,479 @@
+"""Thread checks: ownership, handoff discipline, thread-locals, lifecycle.
+
+Four checks over the shared ThreadAnalysis model (analysis/threads.py):
+
+  thread-ownership     — a self-field or module global written under one
+                         thread role and touched under another, with no
+                         held lock, no handoff, no suppression.
+  handoff-discipline   — a handoff record's data field read before the
+                         thread join that makes the write visible, or the
+                         record republished without consuming/guarding the
+                         previous one.
+  thread-local-context — implicit thread-local context passing: module-
+                         level ``threading.local()`` blobs, and class
+                         thread-locals whose attrs leak outside the class
+                         (the PR 14 span-context rule, now enforced).
+  daemon-lifecycle     — every spawned thread must be joined somewhere or
+                         poll a stop signal wired to a recognized stop /
+                         close path; executors need a shutdown path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, Project, dotted_name
+from ..registry import Check, register_check
+from ..threads import (
+    MAIN,
+    STOP_METHODS,
+    Handoff,
+    SpawnSite,
+    ThreadAnalysis,
+    thread_analysis_for,
+)
+
+
+def _fmt_roles(roles: Set[str]) -> str:
+    return "{" + ", ".join(sorted(roles)) + "}"
+
+
+@register_check
+class ThreadOwnershipCheck(Check):
+    name = "thread-ownership"
+    description = ("self-fields / globals accessed under multiple thread "
+                   "roles without a held lock or recognized handoff")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        ta = thread_analysis_for(project)
+        by_path = project.by_path()
+        for (path, cls, attr), fo in sorted(ta.fields.items()):
+            if fo.classification != "racy":
+                continue
+            mod = by_path[path]
+            for s in fo.writes():
+                if s.locked:
+                    continue
+                yield mod.finding(
+                    self.name, "unsynchronized-cross-role-write", s.node,
+                    f"`self.{attr}` ({cls}) is written under roles "
+                    f"{_fmt_roles(fo.write_roles)} and read under "
+                    f"{_fmt_roles(fo.read_roles)} — this write in "
+                    f"`{s.method}` holds no lock and the field is not a "
+                    f"recognized handoff")
+            for s in fo.reads():
+                if s.locked or not (s.roles - fo.write_roles):
+                    continue
+                yield mod.finding(
+                    self.name, "cross-role-read", s.node,
+                    f"`self.{attr}` ({cls}) is written under roles "
+                    f"{_fmt_roles(fo.write_roles)} but read here in "
+                    f"`{s.method}` under {_fmt_roles(s.roles)} with no "
+                    f"held lock — the read races the writer")
+        for (path, name), fo in sorted(ta.globals.items()):
+            if fo.classification != "racy":
+                continue
+            mod = by_path[path]
+            for s in fo.writes():
+                if s.locked:
+                    continue
+                yield mod.finding(
+                    self.name, "global-cross-role", s.node,
+                    f"module global `{name}` is written under roles "
+                    f"{_fmt_roles(fo.write_roles)} with no lock — "
+                    f"cross-thread global mutation")
+
+
+@register_check
+class HandoffDisciplineCheck(Check):
+    name = "handoff-discipline"
+    description = ("handoff record fields read before the dominating "
+                   "join; records republished while a consumer is live")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        ta = thread_analysis_for(project)
+        by_path = project.by_path()
+        for (path, cls), h in sorted(ta.handoffs.items()):
+            mod = by_path[path]
+            yield from self._check_reads(ta, mod, h)
+            yield from self._check_republish(ta, mod, h)
+
+    def _check_reads(self, ta: ThreadAnalysis, mod: ModuleInfo,
+                     h: Handoff) -> Iterable[Finding]:
+        for qual, fn in sorted(mod.functions.items()):
+            # the spawned closures themselves are the PRODUCER side —
+            # their record writes/reads happen on the handoff thread
+            if any(qual.startswith(sq + ".") for sq in h.spawner_quals):
+                continue
+            aliases = ta.record_aliases(mod, fn, qual, h)
+            if not aliases:
+                continue
+            barriers = ta.join_barrier_lines(mod, fn, qual, h)
+            spawn_line = h.spawn_lines.get(qual)
+            rec_local = h.record_locals.get(qual)
+            callers_joined: Dict[str, bool] = {}
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in aliases
+                        and node.attr in h.data_fields):
+                    continue
+                if mod.scope_of(node) != qual:
+                    continue
+                _bind_line, pre_joined, kind = aliases[node.value.id]
+                if pre_joined:
+                    continue
+                if spawn_line is not None and node.value.id == rec_local:
+                    if node.lineno <= spawn_line:
+                        continue  # before start(): the thread isn't running
+                    if any(spawn_line < b < node.lineno for b in barriers):
+                        continue
+                    if self._spawn_arm_returns(mod, fn, h, qual, node):
+                        continue  # the spawning branch returned — this
+                        # read only executes on the no-spawn path
+                else:
+                    if any(b < node.lineno for b in barriers):
+                        continue  # a join of this handoff's thread attr
+                        # precedes the read (binding through the publish
+                        # field after the join sees a joined record)
+                    if kind == "param":
+                        key = node.value.id
+                        if key not in callers_joined:
+                            callers_joined[key] = self._callsites_joined(
+                                ta, mod, qual, h)
+                        if callers_joined[key]:
+                            continue  # every caller joins before passing
+                yield mod.finding(
+                    self.name, "read-before-join", node,
+                    f"`{node.value.id}.{node.attr}` is a {h.cls} handoff "
+                    f"field written by its spawned thread, but no "
+                    f"`.{'/'.join(sorted(h.thread_attrs))}.join()` "
+                    f"dominates this read in `{qual}` — the value may "
+                    f"still be mid-write")
+
+    @staticmethod
+    def _spawn_arm_returns(mod: ModuleInfo, fn: ast.AST, h: Handoff,
+                           qual: str, read: ast.AST) -> bool:
+        """True when an ``if`` arm containing the spawn — but not the read
+        — ends in return/raise: control never flows from the spawn to the
+        read (the scheduler's async-walk arm returns the record; the sync
+        arm below it fills the same fields on the main thread)."""
+        spawn = h.spawn_nodes.get(qual)
+        if spawn is None:
+            return False
+        cur = spawn
+        for anc in mod.ancestors(spawn):
+            if anc is fn:
+                break
+            if isinstance(anc, ast.If) and \
+                    not any(n is read for n in ast.walk(anc)):
+                for arm in (anc.body, anc.orelse):
+                    if any(any(n is cur for n in ast.walk(s)) for s in arm):
+                        if arm and isinstance(arm[-1],
+                                              (ast.Return, ast.Raise)):
+                            return True
+            cur = anc
+        return False
+
+    @staticmethod
+    def _callsites_joined(ta: ThreadAnalysis, mod: ModuleInfo,
+                          qual: str, h: Handoff) -> bool:
+        """Caller-side domination for annotated record parameters: every
+        resolvable call site of ``qual`` in this module either follows a
+        join barrier in its own function or passes an already-joined
+        alias (`_bind_phase(fl, …)` is only called after `_complete(fl)`
+        joined the fetch thread)."""
+        target_key = (mod.path, qual)
+        sites = []
+        for cqual, cfn in mod.functions.items():
+            if cqual == qual:
+                continue
+            for node in ast.walk(cfn):
+                if not isinstance(node, ast.Call) or \
+                        mod.scope_of(node) != cqual:
+                    continue
+                if target_key in ta.dfa.resolve_call(mod, cqual, node):
+                    sites.append((cqual, cfn, node))
+        if not sites:
+            return False
+        for cqual, cfn, node in sites:
+            if any(b < node.lineno
+                   for b in ta.join_barrier_lines(mod, cfn, cqual, h)):
+                continue
+            aliases = ta.record_aliases(mod, cfn, cqual, h)
+            if any(isinstance(a, ast.Name) and a.id in aliases
+                   and aliases[a.id][1]
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords]):
+                continue
+            return False
+        return True
+
+    def _check_republish(self, ta: ThreadAnalysis, mod: ModuleInfo,
+                         h: Handoff) -> Iterable[Finding]:
+        for qual in sorted(h.spawner_quals):
+            fn = mod.functions.get(qual)
+            if fn is None or not h.publish_fields:
+                continue
+            rec_local = h.record_locals.get(qual)
+            barriers = ta.join_barrier_lines(mod, fn, qual, h)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == rec_local
+                        and mod.scope_of(node) == qual):
+                    continue
+                pubs = [t for t in node.targets
+                        if isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and t.attr in h.publish_fields]
+                if not pubs:
+                    continue
+                attr = pubs[0].attr
+                guarded = any(b < node.lineno for b in barriers) or any(
+                    isinstance(n, ast.Attribute)
+                    and isinstance(n.ctx, ast.Load)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self" and n.attr == attr
+                    and mod.scope_of(n) == qual
+                    and n.lineno < node.lineno
+                    for n in ast.walk(fn))
+                if guarded:
+                    continue
+                yield mod.finding(
+                    self.name, "republish-while-live", node,
+                    f"`self.{attr}` is republished with a fresh {h.cls} "
+                    f"without first checking or joining the previous one "
+                    f"in `{qual}` — an in-flight consumer would be "
+                    f"orphaned")
+
+
+@register_check
+class ThreadLocalContextCheck(Check):
+    name = "thread-local-context"
+    description = ("implicit thread-local context passing: module-level "
+                   "threading.local() and class thread-locals leaking "
+                   "outside their class")
+
+    @staticmethod
+    def _makes_local(value: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call)
+            and dotted_name(n.func).rsplit(".", 1)[-1] == "local"
+            for n in ast.walk(value))
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        # (path, owning class qualname, attr) for self.<attr> = local()
+        owners: List[Tuple[str, str, str]] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Assign) or \
+                        not self._makes_local(node.value):
+                    continue
+                scope = mod.scope_of(node)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and scope == "":
+                        yield mod.finding(
+                            self.name, "implicit-thread-local", node,
+                            f"module-level `threading.local()` blob "
+                            f"`{tgt.id}` — context must be passed "
+                            f"explicitly (argument or record field), not "
+                            f"smuggled through thread-local state")
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        cls_qual = scope.rsplit(".", 1)[0] if "." in scope \
+                            else ""
+                        owners.append((mod.path, cls_qual, tgt.attr))
+        for path, cls_qual, attr in owners:
+            for mod in project.modules:
+                for node in ast.walk(mod.tree):
+                    if not (isinstance(node, ast.Attribute)
+                            and node.attr == attr):
+                        continue
+                    scope = mod.scope_of(node)
+                    inside = (mod.path == path
+                              and (scope == cls_qual
+                                   or scope.startswith(cls_qual + ".")))
+                    if inside:
+                        continue
+                    yield mod.finding(
+                        self.name, "thread-local-escape", node,
+                        f"thread-local attr `.{attr}` (owned by "
+                        f"`{cls_qual}` in {path}) is touched outside its "
+                        f"owning class — per-thread state must not leak "
+                        f"across component boundaries")
+
+
+@register_check
+class DaemonLifecycleCheck(Check):
+    name = "daemon-lifecycle"
+    description = ("spawned threads must be joined or wired to a stop/"
+                   "close path; executors need a shutdown path")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        ta = thread_analysis_for(project)
+        by_path = project.by_path()
+        for sp in ta.spawns:
+            mod = by_path[sp.path]
+            if sp.kind == "executor":
+                if not self._has_shutdown(mod, sp):
+                    yield mod.finding(
+                        self.name, "unmanaged-executor", sp.call,
+                        "ThreadPoolExecutor constructed with no "
+                        "`.shutdown(` call in the owning class/module — "
+                        "worker threads leak past close")
+                continue
+            if sp.kind in ("submit", "map"):
+                continue  # lifecycle owned by the executor's shutdown
+            if self._managed(ta, mod, sp):
+                continue
+            yield mod.finding(
+                self.name, "unjoined-thread", sp.call,
+                f"thread spawned here ({sp.role}) is never joined and "
+                f"polls no stop signal wired to a "
+                f"{'/'.join(sorted(STOP_METHODS))} path — it outlives "
+                f"its owner")
+
+    @staticmethod
+    def _has_shutdown(mod: ModuleInfo, sp: SpawnSite) -> bool:
+        scope: ast.AST = mod.tree
+        for anc in mod.ancestors(sp.call):
+            if isinstance(anc, ast.ClassDef):
+                scope = anc
+                break
+        return any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "shutdown"
+            for n in ast.walk(scope))
+
+    def _managed(self, ta: ThreadAnalysis, mod: ModuleInfo,
+                 sp: SpawnSite) -> bool:
+        if sp.store_attr:
+            # `self._thread = Thread(…)` — the join must live in the SAME
+            # class (another class joining its own `_thread` proves
+            # nothing); record-stored handles (`fl.fetch_thread`) may be
+            # joined anywhere in the module (the scheduler joins them in
+            # _complete / abandon_inflight)
+            scope: ast.AST = mod.tree
+            if sp.store_obj == "self":
+                for anc in mod.ancestors(sp.call):
+                    if isinstance(anc, ast.ClassDef):
+                        scope = anc
+                        break
+            if any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr in ("join", "cancel")
+                   and isinstance(n.func.value, ast.Attribute)
+                   and n.func.value.attr == sp.store_attr
+                   for n in ast.walk(scope)):
+                return True
+            # swap-join idiom: `t, self.<attr> = self.<attr>, None` then
+            # `t.join(…)` — the handle moves to a local before the join
+            swapped: Set[str] = set()
+            for n in ast.walk(scope):
+                if not isinstance(n, ast.Assign):
+                    continue
+                tgts, vals = n.targets, [n.value]
+                if len(tgts) == 1 and isinstance(tgts[0], ast.Tuple) and \
+                        isinstance(n.value, ast.Tuple):
+                    tgts, vals = tgts[0].elts, n.value.elts
+                for t, v in zip(tgts, vals):
+                    if isinstance(t, ast.Name) and \
+                            isinstance(v, ast.Attribute) and \
+                            v.attr == sp.store_attr:
+                        swapped.add(t.id)
+            if swapped and any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("join", "cancel")
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in swapped
+                    for n in ast.walk(scope)):
+                return True
+        if sp.store_local:
+            # a local thread handle with any `.join(` in the same function
+            # (the flood battery joins its reader pool in a loop)
+            fn = mod.functions.get(sp.spawner_qual)
+            if fn is not None and any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("join", "cancel")
+                    for n in ast.walk(fn)):
+                return True
+        return self._polls_managed_stop(ta, mod, sp)
+
+    def _polls_managed_stop(self, ta: ThreadAnalysis, mod: ModuleInfo,
+                            sp: SpawnSite) -> bool:
+        """The target polls a stop signal (`X.is_set()` / `X.wait(` /
+        `self.<f>` loop flag) that a stop/close path or sibling closure
+        sets."""
+        if sp.target_key is None or sp.target_key[0] != mod.path:
+            return False
+        target = mod.functions.get(sp.target_key[1])
+        if target is None:
+            return False
+        names: Set[str] = set()
+        self_flags: Set[str] = set()
+        for node in ast.walk(target):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("is_set", "wait"):
+                recv = node.func.value
+                if isinstance(recv, ast.Name):
+                    names.add(recv.id)
+                elif isinstance(recv, ast.Attribute) and \
+                        isinstance(recv.value, ast.Name) and \
+                        recv.value.id == "self":
+                    self_flags.add(recv.attr)
+            elif isinstance(node, ast.While):
+                for n in ast.walk(node.test):
+                    if isinstance(n, ast.Attribute) and \
+                            isinstance(n.value, ast.Name) and \
+                            n.value.id == "self":
+                        self_flags.add(n.attr)
+        if names and sp.spawner_qual in mod.functions:
+            # sibling closures of the spawner may own the setter (the
+            # client's `unwatch` closure calls `stop.set()`)
+            spawner = mod.functions[sp.spawner_qual]
+            for node in ast.walk(spawner):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "set" and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id in names:
+                    return True
+        if self_flags:
+            cls = None
+            for anc in mod.ancestors(sp.call):
+                if isinstance(anc, ast.ClassDef):
+                    cls = anc
+                    break
+            if cls is not None:
+                for meth in cls.body:
+                    if not isinstance(meth, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    if meth.name not in STOP_METHODS:
+                        continue
+                    for node in ast.walk(meth):
+                        if isinstance(node, ast.Call) and \
+                                isinstance(node.func, ast.Attribute) and \
+                                node.func.attr == "set" and \
+                                isinstance(node.func.value, ast.Attribute) \
+                                and isinstance(node.func.value.value,
+                                               ast.Name) \
+                                and node.func.value.value.id == "self" \
+                                and node.func.value.attr in self_flags:
+                            return True
+                        if isinstance(node, ast.Assign):
+                            for t in node.targets:
+                                if isinstance(t, ast.Attribute) and \
+                                        isinstance(t.value, ast.Name) and \
+                                        t.value.id == "self" and \
+                                        t.attr in self_flags:
+                                    return True
+        return False

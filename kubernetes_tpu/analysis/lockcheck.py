@@ -18,6 +18,7 @@ a monitor for every test and asserts no inversions at teardown.
 from __future__ import annotations
 
 import itertools
+import sys
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -102,6 +103,18 @@ class LockMonitor:
                 del stack[i]
                 return
 
+    def note_release_all(self, key: str) -> int:
+        """Drop every held occurrence of ``key`` on this thread, returning
+        the reentrant depth dropped — Condition.wait's _release_save fully
+        releases an RLock regardless of depth, and the held stack must
+        agree or every lock acquired during the wait would appear ordered
+        after a lock this thread no longer holds."""
+        stack = self._stack()
+        depth = stack.count(key)
+        if depth:
+            stack[:] = [k for k in stack if k != key]
+        return depth
+
     def report(self) -> str:
         if not self.violations:
             return "lockcheck: no lock-order inversions observed"
@@ -157,6 +170,43 @@ class CheckedLock:
         except AttributeError:  # RLock pre-3.12 has no locked()
             return False
 
+    # --- Condition protocol ---------------------------------------------------
+    # threading.Condition(lock) hasattr-probes these at construction; if
+    # absent it falls back to one release()/acquire() pair, which both
+    # under-releases a reentrant RLock in wait() and mis-probes ownership
+    # via acquire(0).  Delegating versions make
+    # ``Condition(maybe_wrap(RLock(), name))`` safe to instrument
+    # (sim/replication.py's FollowerReplica._cond).
+
+    def _is_owned(self) -> bool:
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        depth = self._monitor.note_release_all(self._key)
+        inner = getattr(self._inner, "_release_save", None)
+        if inner is not None:
+            return (inner(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, saved) -> None:
+        state, depth = saved
+        # like acquire(): record intent BEFORE blocking
+        self._monitor.note_acquire(self._key, _caller())
+        inner = getattr(self._inner, "_acquire_restore", None)
+        if inner is not None:
+            inner(state)
+        else:
+            self._inner.acquire()
+        for _ in range(depth - 1):  # restore reentrant depth on the stack
+            self._monitor.note_acquire(self._key, _caller())
+
 
 def _caller() -> str:
     import sys
@@ -200,3 +250,166 @@ def deactivate() -> None:
 
 def active_monitor() -> Optional[LockMonitor]:
     return _active
+
+
+# ---------------------------------------------------------------------------
+# access sanitizer: the runtime cross-check of the STATIC ownership report
+# (analysis/threads.py).  The thread-ownership check claims every shared
+# field is single-role, lock-protected, a handoff, or loaned; this records
+# which threads actually WRITE each instrumented field and whether they
+# held a same-class instrumented lock at the time.  A field written
+# unsynchronized by two threads on ONE instance, whose static
+# classification says "single-role" or "locked", is a contradiction —
+# static said safe, runtime disproved it.
+#
+# Sampling policy (documented limits, by design):
+#   - write-side only: __setattr__ interception sees rebinds, not interior
+#     container mutation (`self.d[k] = v` mutates the dict, not the field)
+#     and not reads — cheap enough for every autouse fixture run;
+#   - lock attribution is the lockcheck held stack, so it only sees locks
+#     the monitor instruments (maybe_wrap'd): pair the sanitizer with an
+#     active LockMonitor;
+#   - per-instance keying uses id(self); id reuse after gc can merge two
+#     short-lived instances (more candidates, then the static report
+#     adjudicates — never fewer).
+# ---------------------------------------------------------------------------
+
+_san_active: Optional["AccessSanitizer"] = None
+
+
+class OwnershipViolation(RuntimeError):
+    pass
+
+
+class AccessSanitizer:
+    """Per-thread field-write recording over instrumented classes."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (class name, attr) → {instance id → set of unsynchronized
+        # writer thread idents}
+        self._unsync: Dict[Tuple[str, str], Dict[int, Set[int]]] = {}
+        self._patched: List[Tuple[type, Optional[object]]] = []
+
+    # --- recording ------------------------------------------------------------
+
+    def note_write(self, cls_name: str, attr: str, instance_id: int) -> None:
+        mon = _active
+        if mon is not None:
+            prefix = cls_name + "."
+            for key in mon._stack():
+                if key.split("#", 1)[0].startswith(prefix):
+                    return  # a same-class instrumented lock is held
+        ident = threading.get_ident()
+        with self._mu:
+            by_inst = self._unsync.setdefault((cls_name, attr), {})
+            by_inst.setdefault(instance_id, set()).add(ident)
+
+    def instrument(self, classes) -> None:
+        """Patch each class's __setattr__ to report writes here."""
+        for cls in classes:
+            if any(c is cls for c, _ in self._patched):
+                continue
+            own = cls.__dict__.get("__setattr__")
+            fallback = cls.__setattr__  # resolved through the MRO
+            cname = cls.__name__
+            san = self
+
+            def _recording_setattr(obj, name, value,
+                                   _f=fallback, _c=cname, _s=san):
+                # mirror the static engine's EXEMPT_METHODS: constructor
+                # writes are single-threaded by convention and handed off
+                # with a happens-before edge (Thread.start), so a
+                # construct-on-main / drive-on-worker instance is ONE
+                # writer, exactly as the ownership report models it
+                try:
+                    caller = sys._getframe(1).f_code.co_name
+                except (AttributeError, ValueError):
+                    caller = ""
+                if caller not in ("__init__", "__new__"):
+                    _s.note_write(_c, name, id(obj))
+                _f(obj, name, value)
+
+            self._patched.append((cls, own))
+            cls.__setattr__ = _recording_setattr
+
+    def restore(self) -> None:
+        for cls, own in reversed(self._patched):
+            if own is None:
+                # the class never defined its own __setattr__ — removing
+                # the wrapper falls back to the inherited slot
+                del cls.__setattr__
+            else:
+                cls.__setattr__ = own
+        self._patched.clear()
+
+    # --- verification ---------------------------------------------------------
+
+    def candidates(self) -> List[Tuple[str, str, int]]:
+        """(class, attr, thread count) for every field some single
+        instance saw unsynchronized writes from ≥2 threads."""
+        out = []
+        with self._mu:
+            for (cname, attr), by_inst in sorted(self._unsync.items()):
+                worst = max((len(t) for t in by_inst.values()), default=0)
+                if worst >= 2:
+                    out.append((cname, attr, worst))
+        return out
+
+    def needs_verify(self) -> bool:
+        """True iff verify() could possibly fail — fixtures call this
+        first so clean runs never pay for the static ownership report."""
+        return bool(self.candidates())
+
+    def verify(self, ownership_report: Dict[str, Dict[str, dict]]
+               ) -> List[str]:
+        """Contradictions between observed writes and the static report.
+
+        A candidate field contradicts when the static engine classified it
+        "single-role" (no cross-role access exists) or "locked" (every
+        conflicting site holds a lock): two unsynchronized runtime writer
+        threads disprove either claim.  "handoff"/"loaned" fields are
+        join-protocol-protected — multi-thread writes are their normal
+        operation, ordered by the join that handoff-discipline verifies.
+        Fields absent from the report (dynamic attrs the AST never saw)
+        are skipped: no static claim exists to contradict."""
+        violations = []
+        for cname, attr, nthreads in self.candidates():
+            claim = ownership_report.get(cname, {}).get(attr)
+            if claim is None:
+                continue
+            if claim["classification"] in ("single-role", "locked"):
+                violations.append(
+                    f"{cname}.{attr}: static ownership says "
+                    f"{claim['classification']!r} but {nthreads} threads "
+                    f"wrote it unsynchronized on one instance "
+                    f"(roles: {', '.join(claim['roles']) or 'none'})")
+        return violations
+
+    def assert_consistent(self, ownership_report) -> None:
+        v = self.verify(ownership_report)
+        if v:
+            raise OwnershipViolation(
+                "access sanitizer: runtime writes contradict the static "
+                "ownership report:\n  " + "\n  ".join(v))
+
+
+def sanitize(classes) -> AccessSanitizer:
+    """Activate an AccessSanitizer over ``classes`` (idempotent per call
+    pair with unsanitize; reuses the active sanitizer if one exists)."""
+    global _san_active
+    if _san_active is None:
+        _san_active = AccessSanitizer()
+    _san_active.instrument(classes)
+    return _san_active
+
+
+def unsanitize() -> Optional[AccessSanitizer]:
+    """Restore every patched __setattr__; returns the retired sanitizer
+    (fixtures verify against the static report AFTER restoring, off the
+    instrumented path)."""
+    global _san_active
+    san, _san_active = _san_active, None
+    if san is not None:
+        san.restore()
+    return san

@@ -1,0 +1,801 @@
+"""Thread-ownership analysis: role graph + ownership lattice + handoffs.
+
+The THIRD analysis engine (core.py is per-module AST invariants, PR 7's
+dataflow.py is the device-boundary taint engine).  This one answers the
+question the concurrent runtime (PRs 10-16) has so far answered only by
+convention: "which thread owns this field, and is every cross-thread
+access synchronized or explicitly handed off?"  The pipeline:
+
+  1. spawn sites    — every ``threading.Thread(target=…)``, ``Timer``,
+                      executor ``submit``/``map`` and ``ThreadPoolExecutor``
+                      construction in the project; each resolvable target
+                      seeds one thread ROLE.
+  2. role graph     — roles propagate through PR 7's interprocedural call
+                      graph (DataflowAnalysis.resolve_call edges): a
+                      function's role set is every thread kind it may run
+                      under.  MAIN seeds every function not exclusively
+                      reachable from spawn targets, so a helper called both
+                      from the dispatch path and from a background closure
+                      ends up {main, <spawn role>} — the racy shape.
+  3. ownership      — per-class ``self``-field lattice (plus ``global``
+                      writes): each access site carries (role set,
+                      lock-held).  A field written under ≥2 roles, or
+                      written under one role and read under another, must
+                      be lock-protected at every conflicting site (reusing
+                      lock_discipline's always-locked-helper propagation),
+                      be a recognized HANDOFF field, or carry a justified
+                      suppression.
+  4. handoffs       — the `_InFlight`/`_SyncAhead` pattern: a record local
+                      published once, its fields written by the spawned
+                      closure (directly or through default-arg aliases) and
+                      consumed only after an explicit ``<rec>.<thread>
+                      .join()`` the engine verifies DOMINATES the read
+                      (lexical statement order, join-helper calls resolved
+                      transitively, pre-joined aliases tracked through
+                      calls to joining functions).
+
+Deliberate approximations (documented, covered elsewhere):
+  - callbacks registered into fan-out seams (``store.watch(self._apply)``)
+    run under the REGISTRAR's roles — the runtime access sanitizer
+    (lockcheck.AccessSanitizer) is the cross-check for those paths;
+  - join dominance is lexical (statement order within one function, plus
+    caller-side domination for annotated record parameters), not a CFG
+    dominator tree;
+  - consumer discovery is same-module (every handoff record in this tree
+    lives and dies inside the module that spawns its thread).
+
+Checks built on top live in checks/thread_ownership.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import ModuleInfo, Project, dotted_name
+from .dataflow import DataflowAnalysis, analysis_for
+
+MAIN = "main"
+
+# synchronization-primitive constructors (beyond Lock/RLock, which
+# lock_discipline._lock_attrs already recognizes): a Condition wraps a
+# lock, so ``with self._cond`` is lock-held; Semaphores gate, not own
+_SYNC_CTORS = {"Condition", "Semaphore", "BoundedSemaphore"}
+
+# method bare-names recognized as a stop/close path for daemon-lifecycle
+STOP_METHODS = {"close", "stop", "shutdown", "abandon_inflight"}
+
+Key = Tuple[str, str]  # (path, qualname) — dataflow FunctionNode key
+
+
+# ---------------------------------------------------------------------------
+# spawn sites
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SpawnSite:
+    """One thread/executor creation point."""
+
+    path: str
+    lineno: int
+    call: ast.Call
+    kind: str  # "thread" | "timer" | "submit" | "map" | "executor"
+    spawner_qual: str  # enclosing function qualname ("" = module level)
+    target_expr: Optional[ast.AST]  # the callable handed to the thread
+    target_key: Optional[Key]  # resolved project function, if any
+    role: str  # role label seeded by this site
+    store_obj: str = ""  # receiver name when stored `<obj>.<attr> = Thread`
+    store_attr: str = ""  # the attr ("" when not attribute-stored)
+    store_local: str = ""  # local var name when `t = Thread(...)`
+
+
+def _sync_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Lock-like self attributes: Lock/RLock (lock_discipline) plus bare
+    Condition()/Semaphore() constructions."""
+    out = _lock_attrs(cls)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        makes = any(
+            isinstance(n, ast.Call)
+            and dotted_name(n.func).rsplit(".", 1)[-1] in _SYNC_CTORS
+            for n in ast.walk(node.value))
+        if not makes:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+def _spawn_kind(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    last = name.rsplit(".", 1)[-1] if name else ""
+    if last == "Thread":
+        return "thread"
+    if last == "Timer":
+        return "timer"
+    if last == "ThreadPoolExecutor":
+        return "executor"
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr == "submit":
+            return "submit"
+        if call.func.attr == "map":
+            # Executor.map only: plain ``.map`` is too common — require a
+            # pool-ish receiver (the scheduler's ``self._ext_pool().map``)
+            recv = call.func.value
+            recv_name = (dotted_name(recv.func) if isinstance(recv, ast.Call)
+                         else dotted_name(recv)).lower()
+            if "pool" in recv_name or "executor" in recv_name:
+                return "map"
+    return None
+
+
+def _spawn_target_expr(call: ast.Call, kind: str) -> Optional[ast.AST]:
+    if kind == "thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if kind == "timer":
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return kw.value
+        return call.args[1] if len(call.args) > 1 else None
+    if kind in ("submit", "map"):
+        return call.args[0] if call.args else None
+    return None  # executor construction has no target
+
+
+# ---------------------------------------------------------------------------
+# ownership lattice
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AccessSite:
+    node: ast.AST
+    lineno: int
+    scope: str  # innermost function qualname containing the access
+    method: str  # bare class-method name the site lives in
+    roles: Set[str]
+    locked: bool
+    is_write: bool
+
+
+@dataclass
+class FieldOwnership:
+    """One (class, field) row of the ownership report."""
+
+    path: str
+    cls: str
+    name: str
+    sites: List[AccessSite] = field(default_factory=list)
+    # filled by _classify():
+    write_roles: Set[str] = field(default_factory=set)
+    read_roles: Set[str] = field(default_factory=set)
+    conflict: bool = False
+    classification: str = "single-role"  # | locked | handoff | racy
+
+    def writes(self) -> List[AccessSite]:
+        return [s for s in self.sites if s.is_write]
+
+    def reads(self) -> List[AccessSite]:
+        return [s for s in self.sites if not s.is_write]
+
+
+@dataclass
+class Handoff:
+    """One record class published to a spawned thread (`_SyncAhead`)."""
+
+    path: str  # module the spawner lives in
+    cls: str  # record class name
+    thread_attrs: Set[str] = field(default_factory=set)  # `thread`
+    data_fields: Set[str] = field(default_factory=set)  # thread-written
+    spawner_quals: Set[str] = field(default_factory=set)
+    spawn_lines: Dict[str, int] = field(default_factory=dict)  # qual → line
+    spawn_nodes: Dict[str, ast.Call] = field(default_factory=dict)
+    record_locals: Dict[str, str] = field(default_factory=dict)  # qual → name
+    publish_fields: Set[str] = field(default_factory=set)  # self.<f> = rec
+
+
+class ThreadAnalysis:
+    """Shared project-wide thread model every thread check reads."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.dfa: DataflowAnalysis = analysis_for(project)
+        self.spawns: List[SpawnSite] = []
+        self.roles: Dict[Key, Set[str]] = {}
+        self.fields: Dict[Tuple[str, str, str], FieldOwnership] = {}
+        self.globals: Dict[Tuple[str, str], FieldOwnership] = {}
+        self.handoffs: Dict[Tuple[str, str], Handoff] = {}
+        # functions that (transitively) join a handoff thread attr
+        self._joinish: Set[Key] = set()
+        # roles whose EVERY spawn stores its thread into a handoff record
+        # attr (join-dominance of those attrs is handoff-discipline's job)
+        self.join_bounded_roles: Set[str] = set()
+        # role → classes containing its spawn sites (the spawning class
+        # itself gets no loan exemption: it runs concurrently with the
+        # thread it spawned, by construction)
+        self.role_spawn_class: Dict[str, Set[Tuple[str, str]]] = {}
+        self._find_spawns()
+        self._assign_roles()
+        self._find_handoffs()
+        self._build_ownership()
+
+    # --- spawn discovery --------------------------------------------------
+
+    def _find_spawns(self) -> None:
+        for mod in self.project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _spawn_kind(node)
+                if kind is None:
+                    continue
+                target = _spawn_target_expr(node, kind)
+                key = self._resolve_target(mod, node, target)
+                self.spawns.append(SpawnSite(
+                    path=mod.path, lineno=node.lineno, call=node, kind=kind,
+                    spawner_qual=mod.scope_of(node),
+                    target_expr=target, target_key=key,
+                    role=self._role_name(mod, node, key, target),
+                    **self._storage_of(mod, node)))
+
+    def _resolve_target(self, mod: ModuleInfo, call: ast.Call,
+                        target: Optional[ast.AST]) -> Optional[Key]:
+        if target is None:
+            return None
+        # resolve_call only inspects .func — wrap the target expression so
+        # the dataflow engine's whole resolution ladder (nesting chain,
+        # self-methods, imports, unique-bare-name duck typing) applies
+        probe = ast.Call(func=target, args=[], keywords=[])
+        hits = self.dfa.resolve_call(mod, mod.scope_of(call), probe)
+        return hits[0] if len(hits) == 1 else None
+
+    def _role_name(self, mod: ModuleInfo, call: ast.Call,
+                   key: Optional[Key], target: Optional[ast.AST]) -> str:
+        base = os.path.basename(mod.path)
+        if key is not None:
+            return f"{base}:{key[1]}"
+        label = dotted_name(target) if target is not None else "<opaque>"
+        return f"{base}:{label or '<lambda>'}@L{call.lineno}"
+
+    def _storage_of(self, mod: ModuleInfo, call: ast.Call) -> Dict[str, str]:
+        """Where the Thread/executor object lands: `<obj>.<attr> = …`,
+        `local = …`, or nothing (fire-and-forget / comprehension)."""
+        out = {"store_obj": "", "store_attr": "", "store_local": ""}
+        parent = mod.parents.get(call)
+        # `t = Thread(…)` nested in a list comprehension: credit the
+        # comprehension's assignment target (chaos/flood.py reader pool)
+        hops = 0
+        while parent is not None and not isinstance(parent, ast.Assign) \
+                and hops < 4:
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef, ast.Module)):
+                return out
+            parent = mod.parents.get(parent)
+            hops += 1
+        if not isinstance(parent, ast.Assign):
+            return out
+        for tgt in parent.targets:
+            if isinstance(tgt, ast.Attribute):
+                out["store_attr"] = tgt.attr
+                out["store_obj"] = dotted_name(tgt.value)
+                return out
+            if isinstance(tgt, ast.Name):
+                out["store_local"] = tgt.id
+                # keep scanning: `pool = self._ext_pool_obj = …` stores both
+        return out
+
+    # --- role graph -------------------------------------------------------
+
+    def _bfs(self, roots: Iterable[Key]) -> Set[Key]:
+        """Transitive callees over RESOLVED call edges only — nested defs
+        are NOT implicit callees here (defining a closure is not running
+        it; the spawn site decides which role runs it)."""
+        seen: Set[Key] = set()
+        work = [k for k in roots if k in self.dfa.functions]
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for k2 in self.dfa.functions[key].callees:
+                if k2 not in seen and k2 in self.dfa.functions:
+                    work.append(k2)
+        return seen
+
+    def _assign_roles(self) -> None:
+        spawn_only: Set[Key] = set()
+        for sp in self.spawns:
+            if sp.target_key is None:
+                continue
+            closure = self._bfs([sp.target_key])
+            spawn_only |= closure
+            for k in closure:
+                self.roles.setdefault(k, set()).add(sp.role)
+        # MAIN seeds: every function NOT exclusively thread-reachable.
+        # Propagating main through the same call edges then re-adds it to
+        # shared helpers (e.g. _assign_with_extenders: called from the
+        # dispatch path AND from the async walk closure → {main, walk}).
+        main_seeds = [k for k in self.dfa.functions if k not in spawn_only]
+        for k in self._bfs(main_seeds):
+            self.roles.setdefault(k, set()).add(MAIN)
+        for k in main_seeds:
+            self.roles.setdefault(k, set()).add(MAIN)
+
+    def roles_of(self, path: str, qual: str) -> Set[str]:
+        """Role set for code whose innermost function scope is ``qual``
+        (class-body / module-level statements run on the importing or
+        constructing thread → MAIN)."""
+        got = self.roles.get((path, qual))
+        if got:
+            return got
+        return {MAIN}
+
+    # --- handoff recognition ----------------------------------------------
+
+    def _find_handoffs(self) -> None:
+        by_path = self.project.by_path()
+        for sp in self.spawns:
+            if sp.target_key is None or not sp.store_attr:
+                continue
+            if not sp.store_obj or sp.store_obj == "self" or \
+                    "." in sp.store_obj:
+                continue  # self-attr storage is the ownership lattice's job
+            mod = by_path.get(sp.path)
+            if mod is None or sp.spawner_qual not in mod.functions:
+                continue
+            spawner = mod.functions[sp.spawner_qual]
+            cls_name = self._record_class(mod, spawner, sp.store_obj)
+            if cls_name is None:
+                continue
+            h = self.handoffs.setdefault(
+                (sp.path, cls_name), Handoff(path=sp.path, cls=cls_name))
+            h.thread_attrs.add(sp.store_attr)
+            h.spawner_quals.add(sp.spawner_qual)
+            h.spawn_lines[sp.spawner_qual] = sp.lineno
+            h.spawn_nodes[sp.spawner_qual] = sp.call
+            h.record_locals[sp.spawner_qual] = sp.store_obj
+            h.data_fields |= self._thread_written_fields(
+                mod, sp.spawner_qual, sp.store_obj)
+            h.publish_fields |= self._publish_fields(
+                mod, spawner, sp.store_obj)
+            self.join_bounded_roles.add(sp.role)
+        if self.handoffs:
+            self._solve_joinish()
+        # a role is join-bounded only when ALL of its spawns are record-
+        # stored; any bare spawn of the same role voids the bound
+        for sp in self.spawns:
+            key = (sp.path, self._record_class_of_spawn(sp))
+            if key not in self.handoffs and sp.role in self.join_bounded_roles:
+                self.join_bounded_roles.discard(sp.role)
+        for sp in self.spawns:
+            self.role_spawn_class.setdefault(sp.role, set()).add(
+                (sp.path, self._spawn_class_name(sp)))
+
+    def _record_class_of_spawn(self, sp: SpawnSite) -> str:
+        if not sp.store_attr or not sp.store_obj or sp.store_obj == "self" \
+                or "." in sp.store_obj:
+            return ""
+        mod = self.project.by_path().get(sp.path)
+        if mod is None or sp.spawner_qual not in mod.functions:
+            return ""
+        return self._record_class(mod, mod.functions[sp.spawner_qual],
+                                  sp.store_obj) or ""
+
+    def _spawn_class_name(self, sp: SpawnSite) -> str:
+        mod = self.project.by_path().get(sp.path)
+        if mod is None:
+            return ""
+        for anc in mod.ancestors(sp.call):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+        return ""
+
+    def _record_class(self, mod: ModuleInfo, spawner: ast.AST,
+                      name: str) -> Optional[str]:
+        """Class of the record local ``name = ClassName(...)`` in spawner."""
+        for node in ast.walk(spawner):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+                ctor = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                if ctor and (ctor[:1].isupper() or ctor.startswith("_")):
+                    return ctor
+        return None
+
+    def _thread_written_fields(self, mod: ModuleInfo, spawner_qual: str,
+                               record: str) -> Set[str]:
+        """Attrs the spawned closure (any nested def of the spawner, which
+        is where every thread body in this tree lives) writes on the record
+        — directly by its captured name or through a default-arg alias
+        (``def _bg_fetch(rec=fl)``)."""
+        out: Set[str] = set()
+        for qual, fn in mod.functions.items():
+            if not qual.startswith(spawner_qual + "."):
+                continue
+            aliases = {record}
+            args = fn.args
+            defaults = args.defaults
+            pos = (args.posonlyargs + args.args)[-len(defaults):] \
+                if defaults else []
+            for a, d in zip(pos, defaults):
+                if isinstance(d, ast.Name) and d.id == record:
+                    aliases.add(a.arg)
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if isinstance(d, ast.Name) and d.id == record:
+                    aliases.add(a.arg)
+            for node in ast.walk(fn):
+                if mod.scope_of(node) != qual:
+                    continue
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    tgts = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in tgts:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id in aliases:
+                            out.add(t.attr)
+        return out
+
+    def _publish_fields(self, mod: ModuleInfo, spawner: ast.AST,
+                        record: str) -> Set[str]:
+        """self-fields the spawner publishes the record into."""
+        out: Set[str] = set()
+        for node in ast.walk(spawner):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == record:
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        out.add(attr)
+        return out
+
+    def _solve_joinish(self) -> None:
+        """Functions that join a handoff thread attr, transitively: a call
+        to a joinish function is as good as the ``.join()`` itself (the
+        scheduler's `_join_sync_ahead` helper)."""
+        thread_attrs = set()
+        for h in self.handoffs.values():
+            thread_attrs |= h.thread_attrs
+        direct: Set[Key] = set()
+        for key, fn in self.dfa.functions.items():
+            if self._has_direct_join(fn.mod, fn.node, fn.qual, thread_attrs):
+                direct.add(key)
+        self._joinish = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.dfa.functions.items():
+                if key in self._joinish:
+                    continue
+                if fn.callees & self._joinish:
+                    self._joinish.add(key)
+                    changed = True
+
+    @staticmethod
+    def _has_direct_join(mod: ModuleInfo, fn: ast.AST, qual: str,
+                         thread_attrs: Set[str]) -> bool:
+        for node in ast.walk(fn):
+            if mod.scope_of(node) != qual:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and \
+                    isinstance(node.func.value, ast.Attribute) and \
+                    node.func.value.attr in thread_attrs:
+                return True
+        return False
+
+    def join_barrier_lines(self, mod: ModuleInfo, fn: ast.AST,
+                           qual: str, h: Handoff) -> List[int]:
+        """Line numbers in ``fn`` after which the handoff's thread has
+        provably been joined: direct ``.<thread>.join()`` calls and calls
+        resolving to joinish functions."""
+        out: List[int] = []
+        for node in ast.walk(fn):
+            if mod.scope_of(node) != qual or not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and \
+                    isinstance(node.func.value, ast.Attribute) and \
+                    node.func.value.attr in h.thread_attrs:
+                out.append(node.lineno)
+                continue
+            for key in self.dfa.resolve_call(mod, qual, node):
+                if key in self._joinish:
+                    out.append(node.lineno)
+                    break
+        return sorted(out)
+
+    def record_aliases(self, mod: ModuleInfo, fn: ast.AST, qual: str,
+                       h: Handoff) -> Dict[str, Tuple[int, bool, str]]:
+        """Locals in ``fn`` bound to a handoff record:
+        name → (binding line, pre_joined, kind).
+
+        pre_joined=True when the alias came from a call to a joinish
+        function (``prep = self._take_sync_ahead()`` hands back an
+        already-joined record — no further barrier needed).  kind is one
+        of "param" (annotated parameter), "publish" (loaded from the
+        publication field), "joinish", "ctor"."""
+        out: Dict[str, Tuple[int, bool, str]] = {}
+        # annotated parameters: ``def _complete(self, fl: _InFlight)``
+        for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if a.annotation is not None and \
+                    self._annotation_names(a.annotation) & {h.cls}:
+                out[a.arg] = (fn.lineno, False, "param")
+        for node in ast.walk(fn):
+            if mod.scope_of(node) != qual or not isinstance(node, ast.Assign):
+                continue
+            pairs: List[Tuple[ast.AST, ast.AST]] = []
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Tuple) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(node.targets[0].elts) == len(node.value.elts):
+                pairs = list(zip(node.targets[0].elts, node.value.elts))
+            else:
+                pairs = [(t, node.value) for t in node.targets]
+            for tgt, val in pairs:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                if _self_attr(val) in h.publish_fields:
+                    out[tgt.id] = (node.lineno, False, "publish")
+                elif isinstance(val, ast.Call):
+                    keys = self.dfa.resolve_call(mod, qual, val)
+                    if keys and all(k in self._joinish for k in keys):
+                        # prep = self._take_sync_ahead(): the record comes
+                        # back already joined — no further barrier needed
+                        out[tgt.id] = (node.lineno, True, "joinish")
+                    elif dotted_name(val.func).rsplit(".", 1)[-1] == h.cls:
+                        out[tgt.id] = (node.lineno, False, "ctor")
+        return out
+
+    @staticmethod
+    def _annotation_names(ann: ast.AST) -> Set[str]:
+        """Bare class names mentioned by an annotation (unwraps Optional[X],
+        quotes, unions)."""
+        out: Set[str] = set()
+        for node in ast.walk(ann):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                out.add(node.attr)
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+                out.add(node.value.split("[")[-1].rstrip("]").strip())
+        return out
+
+    # --- ownership lattice ------------------------------------------------
+
+    def _build_ownership(self) -> None:
+        for mod in self.project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._scan_class(mod, node)
+            self._scan_globals(mod)
+        for fo in list(self.fields.values()) + list(self.globals.values()):
+            self._classify(fo)
+
+    def _scan_class(self, mod: ModuleInfo, cls: ast.ClassDef) -> None:
+        cls_qual = mod.scope_of(cls) or cls.name
+        locks = _sync_attrs(cls)
+        wrappers = _lock_wrappers(cls, locks)
+        propagated = _always_locked_methods(
+            _intra_class_calls(mod, cls, cls_qual, locks, wrappers))
+        method_names = {n.name for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+
+        def add_site(attr: str, node: ast.AST, is_write: bool) -> None:
+            if attr in locks or attr in method_names:
+                return
+            scope = mod.scope_of(node)
+            if not scope.startswith(cls_qual + "."):
+                return  # class-body statement: construction, not sharing
+            method = scope[len(cls_qual) + 1:].split(".", 1)[0]
+            if method in EXEMPT_METHODS:
+                return  # the object is not shared during construction
+            locked = (_under_lock(mod, node, locks, cls, wrappers)
+                      or method in propagated)
+            fo = self.fields.setdefault(
+                (mod.path, cls.name, attr),
+                FieldOwnership(path=mod.path, cls=cls.name, name=attr))
+            fo.sites.append(AccessSite(
+                node=node, lineno=getattr(node, "lineno", 0), scope=scope,
+                method=method, roles=self.roles_of(mod.path, scope),
+                locked=locked, is_write=is_write))
+
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    attr = _self_attr(t)
+                    if attr:
+                        add_site(attr, node, True)
+                if isinstance(node, ast.AugAssign):
+                    attr = _self_attr(node.target)
+                    if attr:
+                        add_site(attr, node, False)  # += also reads
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        add_site(attr, node, True)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATING_METHODS:
+                attr = _self_attr(node.func.value)
+                if attr:
+                    add_site(attr, node, True)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                parent = mod.parents.get(node)
+                if isinstance(parent, ast.Subscript) and \
+                        isinstance(parent.ctx, (ast.Store, ast.Del)):
+                    pass  # self.X[k] = v — already a write via the Assign
+                elif isinstance(parent, ast.Call) and parent.func is node:
+                    pass  # self.meth(...) handled via MUTATING_METHODS
+                elif isinstance(parent, ast.Attribute) and \
+                        isinstance(mod.parents.get(parent), ast.Call) and \
+                        mod.parents[parent].func is parent and \
+                        parent.attr in MUTATING_METHODS:
+                    pass  # self.X.append(...) — already a write site
+                else:
+                    add_site(node.attr, node, False)
+
+    def _scan_globals(self, mod: ModuleInfo) -> None:
+        """Module globals written via ``global X`` inside functions."""
+        for qual, fn in mod.functions.items():
+            declared: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global) and \
+                        mod.scope_of(node) == qual:
+                    declared |= set(node.names)
+            if not declared:
+                continue
+            roles = self.roles_of(mod.path, qual)
+            for node in ast.walk(fn):
+                if mod.scope_of(node) != qual:
+                    continue
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id in declared:
+                            fo = self.globals.setdefault(
+                                (mod.path, t.id),
+                                FieldOwnership(path=mod.path, cls="",
+                                               name=t.id))
+                            fo.sites.append(AccessSite(
+                                node=node, lineno=node.lineno, scope=qual,
+                                method=qual, roles=roles, locked=False,
+                                is_write=True))
+
+    def _classify(self, fo: FieldOwnership) -> None:
+        for s in fo.sites:
+            (fo.write_roles if s.is_write else fo.read_roles).update(s.roles)
+        writes = fo.writes()
+        multi_write = len(fo.write_roles) >= 2
+        cross_read = (len(fo.write_roles) == 1
+                      and bool(fo.read_roles - fo.write_roles))
+        fo.conflict = bool(writes) and (multi_write or cross_read)
+        if not fo.conflict:
+            fo.classification = "single-role"
+            return
+        if fo.cls and self._is_handoff_field(fo):
+            fo.classification = "handoff"
+            return
+        conflicting = writes + [r for r in fo.reads()
+                                if r.roles - fo.write_roles]
+        if all(s.locked for s in conflicting):
+            fo.classification = "locked"
+        elif fo.cls and self._is_loaned(fo):
+            fo.classification = "loaned"
+        else:
+            fo.classification = "racy"
+
+    def _is_loaned(self, fo: FieldOwnership) -> bool:
+        """The sync-overlap protocol LOANS whole objects (the encoder, the
+        snapshot) to a spawned thread for its bounded lifetime; the join
+        that handoff-discipline verifies transfers ownership back.  A
+        conflict whose every non-main role is join-bounded is therefore
+        protected by that protocol — except on the spawning class itself,
+        which by construction runs concurrently with its own spawn (its
+        shared fields need a lock or a record handoff, not a loan).  The
+        runtime access sanitizer is the cross-check for loaned classes."""
+        nonmain = (fo.write_roles | fo.read_roles) - {MAIN}
+        if not nonmain:
+            return False
+        for r in nonmain:
+            if r not in self.join_bounded_roles:
+                return False
+            if (fo.path, fo.cls) in self.role_spawn_class.get(r, set()):
+                return False
+        return True
+
+    def _is_handoff_field(self, fo: FieldOwnership) -> bool:
+        h = self.handoffs.get((fo.path, fo.cls))
+        if h is None:
+            return False
+        return fo.name in h.data_fields or fo.name in h.thread_attrs
+
+    # --- the report (CLI --report-ownership + the runtime sanitizer) -------
+
+    def ownership_report(self) -> Dict[str, Dict[str, dict]]:
+        """class name → field → {roles, write_roles, classification}.
+
+        The runtime access sanitizer (lockcheck.AccessSanitizer.verify)
+        compares observed per-thread write patterns against this: a field
+        the static engine calls single-role or locked must never show
+        unsynchronized multi-thread writes at runtime."""
+        out: Dict[str, Dict[str, dict]] = {}
+        for (path, cls, name), fo in sorted(self.fields.items()):
+            out.setdefault(cls, {})[name] = {
+                "path": path,
+                "roles": sorted(fo.write_roles | fo.read_roles),
+                "write_roles": sorted(fo.write_roles),
+                "classification": fo.classification,
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# cache (mirrors dataflow.analysis_for)
+# ---------------------------------------------------------------------------
+
+_CACHE: Dict[int, ThreadAnalysis] = {}
+
+
+def thread_analysis_for(project: Project) -> ThreadAnalysis:
+    key = id(project)
+    hit = _CACHE.get(key)
+    if hit is not None and hit.project is project:
+        return hit
+    _CACHE.clear()
+    _CACHE[key] = ThreadAnalysis(project)
+    return _CACHE[key]
+
+
+_REPO_REPORT: Optional[Dict[str, Dict[str, dict]]] = None
+
+
+def repo_ownership_report() -> Dict[str, Dict[str, dict]]:
+    """The repo's own ownership report, computed once per process — the
+    runtime access sanitizer's static reference (test fixtures call this
+    lazily, only when a candidate contradiction was actually observed)."""
+    global _REPO_REPORT
+    if _REPO_REPORT is None:
+        from .core import DEFAULT_SCAN_PATHS, load_project
+
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        project = load_project(root, DEFAULT_SCAN_PATHS)
+        _REPO_REPORT = ThreadAnalysis(project).ownership_report()
+    return _REPO_REPORT
+
+
+# Imported LAST, not at the top: importing checks/ runs checks/__init__,
+# which imports checks/thread_ownership.py, which imports back into THIS
+# module.  With every name above already bound, the cycle resolves in
+# either entry order (threads first, or the check registry first).  The
+# helpers are only called from function bodies, never at module scope.
+from .checks.lock_discipline import (  # noqa: E402
+    EXEMPT_METHODS,
+    MUTATING_METHODS,
+    _always_locked_methods,
+    _intra_class_calls,
+    _lock_attrs,
+    _lock_wrappers,
+    _self_attr,
+    _under_lock,
+)

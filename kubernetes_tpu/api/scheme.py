@@ -17,6 +17,8 @@ manifests into typed objects).  This build's types carry their own
 
 from __future__ import annotations
 
+import threading
+
 from typing import Dict, List, Tuple, Type
 
 from . import objects as v1
@@ -28,7 +30,12 @@ class SchemeError(Exception):
 
 class Scheme:
     def __init__(self, converter=None):
-        # kind → (group, canonical version, type)
+        # kind → (group, canonical version, type).  The registry is read
+        # from watch-decode threads (client watch_kind → decode) while
+        # late registrations may still land on the main thread, so every
+        # _kinds access holds _lock — registration is startup-cheap and
+        # decode's lookup is one dict get under an uncontended lock.
+        self._lock = threading.Lock()
         self._kinds: Dict[str, Tuple[str, str, Type]] = {}
         # spoke-version conversion registry (api/conversion.py); None = the
         # scheme serves canonical versions only
@@ -38,35 +45,39 @@ class Scheme:
         """AddKnownTypes analog; the type's ``kind`` attribute names it.
         Duplicate kinds are rejected so a later registration cannot silently
         shadow an earlier one."""
-        prev = self._kinds.get(typ.kind)
-        if prev is not None and prev[2] is not typ:
-            raise SchemeError(
-                f"kind {typ.kind!r} already registered for group "
-                f"{prev[0]!r} as {prev[2].__name__}"
-            )
-        if prev is not None and prev[:2] != (group, version):
-            # one GVK per type: re-registering the same type under a different
-            # group/version would silently change which apiVersion decode()
-            # validates against
-            raise SchemeError(
-                f"type {typ.__name__} already registered as "
-                f"({prev[0]!r}, {prev[1]!r}); cannot re-register as "
-                f"({group!r}, {version!r})"
-            )
-        self._kinds[typ.kind] = (group, version, typ)
+        with self._lock:
+            prev = self._kinds.get(typ.kind)
+            if prev is not None and prev[2] is not typ:
+                raise SchemeError(
+                    f"kind {typ.kind!r} already registered for group "
+                    f"{prev[0]!r} as {prev[2].__name__}"
+                )
+            if prev is not None and prev[:2] != (group, version):
+                # one GVK per type: re-registering the same type under a
+                # different group/version would silently change which
+                # apiVersion decode() validates against
+                raise SchemeError(
+                    f"type {typ.__name__} already registered as "
+                    f"({prev[0]!r}, {prev[1]!r}); cannot re-register as "
+                    f"({group!r}, {version!r})"
+                )
+            self._kinds[typ.kind] = (group, version, typ)
         return self
 
     def gv_of(self, typ: Type):
         """(group, version) a type is served under, or None (ObjectKinds)."""
-        entry = self._kinds.get(getattr(typ, "kind", None))
+        with self._lock:
+            entry = self._kinds.get(getattr(typ, "kind", None))
         if entry is None or entry[2] is not typ:
             return None
         return entry[0], entry[1]
 
     def recognized(self) -> List[str]:
+        with self._lock:
+            entries = list(self._kinds.items())
         return sorted(
             f"{g + '/' if g else ''}{ver}:{kind}"
-            for kind, (g, ver, _t) in self._kinds.items()
+            for kind, (g, ver, _t) in entries
         )
 
     def decode(self, manifest: dict):
@@ -77,11 +88,13 @@ class Scheme:
         kind = manifest.get("kind")
         if not kind:
             raise SchemeError("manifest has no kind")
-        entry = self._kinds.get(kind)
+        with self._lock:
+            entry = self._kinds.get(kind)
+            known = sorted(self._kinds) if entry is None else ()
         if entry is None:
             raise SchemeError(
                 f"no kind {kind!r} is registered "
-                f"(known: {', '.join(sorted(self._kinds))})"
+                f"(known: {', '.join(known)})"
             )
         group, _version, typ = entry
         api = manifest.get("apiVersion", "")

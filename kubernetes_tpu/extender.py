@@ -675,6 +675,7 @@ class TPUScoreExtenderServer:
         import socketserver
 
         self.score_fn = score_fn
+        self._thread: Optional[threading.Thread] = None
         # name → its JSON encoding (quoted/escaped), cached across requests:
         # the same few hundred node names ride every callout, and re-encoding
         # them per response was a measured slice of the single-core extender
@@ -783,6 +784,12 @@ class TPUScoreExtenderServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            # shutdown() returns once serve_forever exits its loop; the
+            # bounded join keeps a request already in a handler from
+            # leaking the serving thread past stop()
+            thread.join(timeout=2.0)
 
 
 def run_subprocess_score_server(score_fn, port_pipe):

@@ -341,6 +341,11 @@ class _SyncAhead:
     node_del_gen: int = -1
     dic_len: int = -1
     error: object = None
+    # off-critical-path wall of the background sync, carried on the record
+    # so the spawned thread never touches self.phase_wall (which the main
+    # thread mutates concurrently during the overlap window) — folded into
+    # phase_wall["sync_overlap"] by _join_sync_ahead, after the join
+    wall: float = 0.0
 
 
 class TPUScheduler:
@@ -440,6 +445,12 @@ class TPUScheduler:
             overlap_sync = pipeline
         self.overlap_sync = bool(overlap_sync)
         self._sync_ahead: Optional[_SyncAhead] = None
+        # guards the lazily-built extender-callout pool: _ext_pool is
+        # reached from the main dispatch path AND from the async walk
+        # thread (micro-bucket pipelining can run two walks back to back),
+        # and an unguarded double-build would leak a 16-worker pool
+        self._ext_pool_lock = threading.Lock()
+        self._ext_pool_obj = None
         # Micro-bucket pipelined dispatch (round 15): dedup-eligible
         # constraint-free batches split into pow-2 sub-buckets riding the
         # existing deep-pipeline chain, so a pod's attempt latency tracks
@@ -1325,9 +1336,10 @@ class TPUScheduler:
                 if span is not None:
                     span.set(error=f"{type(e).__name__}: {e}")
             # off-critical-path wall, attributed so the overlap win is
-            # measured, not inferred (do NOT sum this into cycle wall)
+            # measured, not inferred (do NOT sum this into cycle wall);
+            # rides the record — phase_wall belongs to the main thread
             done = self.clock()
-            self.phase_wall["sync_overlap"] += done - t_s
+            rec.wall = done - t_s
             if span is not None:
                 span.finish(end=done)
 
@@ -1348,6 +1360,10 @@ class TPUScheduler:
         if rec is not None and rec.thread is not None:
             rec.thread.join()
             rec.thread = None
+            # fold the background wall in here, after the join: the record
+            # hands the measurement off like every other _SyncAhead field
+            self.phase_wall["sync_overlap"] += rec.wall
+            rec.wall = 0.0
 
     def _take_sync_ahead(self) -> Optional[_SyncAhead]:
         """Join + consume the pending overlapped sync at dispatch time.
@@ -3225,13 +3241,14 @@ class TPUScheduler:
         workers' lock waits are idle time with the GIL released (the
         extender subprocess runs during them), so deep pipelining is what
         keeps the wire full.  Released by close()."""
-        pool = getattr(self, "_ext_pool_obj", None)
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        with self._ext_pool_lock:
+            pool = self._ext_pool_obj
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            pool = self._ext_pool_obj = ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="extender-callout")
-        return pool
+                pool = self._ext_pool_obj = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="extender-callout")
+            return pool
 
     def _fence_ok(self) -> bool:
         """Evaluate the bind fence; an unprovable fence (predicate raised)
@@ -3299,7 +3316,8 @@ class TPUScheduler:
         recorder = getattr(self, "recorder", None)
         if recorder is not None and flush_events:
             recorder.flush()
-        pool, self._ext_pool_obj = getattr(self, "_ext_pool_obj", None), None
+        with self._ext_pool_lock:
+            pool, self._ext_pool_obj = self._ext_pool_obj, None
         if pool is not None:
             pool.shutdown(wait=False)
 

@@ -66,6 +66,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..analysis import lockcheck
 from ..chaos.faults import CRASH_MID_PROMOTE, maybe_crash
 from ..component_base import logging as klog
 from ..metrics import scheduler_metrics as m
@@ -131,7 +132,12 @@ class FollowerReplica:
         # (replay_record re-emits into the watch cache synchronously on
         # this thread), and rv-gated HTTP readers wait on it bounded —
         # FollowerReplica.wait_for_rv is the 504 gate's clock.
-        self._cond = threading.Condition(threading.RLock())
+        # maybe_wrap keeps the RLock visible to an active LockMonitor and
+        # the access sanitizer (CheckedLock implements the Condition
+        # owner/release/restore protocol, so wait() keeps held-stack
+        # bookkeeping exact across the full reentrant release)
+        self._cond = threading.Condition(
+            lockcheck.maybe_wrap(threading.RLock(), "FollowerReplica._cond"))
         # rejoin path: a previous incarnation's persisted log reconstructs
         # the store exactly like a leader boot would — including the
         # torn-tail truncation (our own persist may have died mid-write).

@@ -122,7 +122,10 @@ class WatchCache:
         # zero-arg callable returning the replication applied_rv; None (the
         # default, single-process caches) costs one attribute read.
         self.bookmark_gate: Optional[Callable[[], int]] = None
-        self._stopped = False
+        # Event, not a bare bool: the bookmark thread polls it cross-thread
+        # (its wait() doubles as the cadence sleep, so close() interrupts a
+        # mid-interval sleep instead of waiting it out)
+        self._stop = threading.Event()
         self._bookmark_thread: Optional[threading.Thread] = None
         # single-entry page memo: (rv, kind) → (snapshot, sorted keys).
         # A paginated walk hits list_page once per page at ONE rv — without
@@ -388,10 +391,7 @@ class WatchCache:
             return
 
         def run():
-            while not self._stopped:
-                time.sleep(interval)
-                if self._stopped:
-                    return
+            while not self._stop.wait(interval):
                 self.bookmark_now()
 
         self._bookmark_thread = threading.Thread(
@@ -399,7 +399,13 @@ class WatchCache:
         self._bookmark_thread.start()
 
     def close(self) -> None:
-        self._stopped = True
+        self._stop.set()
+        thread, self._bookmark_thread = self._bookmark_thread, None
+        if thread is not None:
+            # bounded join: the thread wakes from its interval wait as soon
+            # as the event is set; the timeout only guards a bookmark
+            # delivery already in flight
+            thread.join(timeout=5.0)
         if self._unwatch is not None:
             self._unwatch()
             self._unwatch = None

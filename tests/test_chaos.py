@@ -51,13 +51,23 @@ def lock_order_monitor():
     instrumented (analysis/lockcheck.maybe_wrap), and any lock-order
     inversion observed across the test's threads fails it at teardown —
     the project's stand-in for running this battery under the Go race
-    detector."""
+    detector.  The access sanitizer rides the same fixture: every store /
+    watch-cache field write is attributed to its thread + held locks, and
+    any multi-thread unsynchronized pattern is checked against the static
+    thread-ownership report (static says safe, runtime proves it)."""
+    from kubernetes_tpu.sim.watchcache import WatchCache
+
     mon = lockcheck.activate()
+    san = lockcheck.sanitize([ObjectStore, WatchCache])
     try:
         yield mon
     finally:
+        lockcheck.unsanitize()
         lockcheck.deactivate()
     assert not mon.violations, mon.report()
+    if san.needs_verify():  # lazy: clean runs never build the report
+        from kubernetes_tpu.analysis.threads import repo_ownership_report
+        san.assert_consistent(repo_ownership_report())
 
 
 class FakeClock:

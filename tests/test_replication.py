@@ -48,13 +48,23 @@ from kubernetes_tpu.testutil import make_node, make_pod
 def lock_order_monitor():
     """deliver() holds the replica condition across store apply and cache
     fan-out; the bookmark gate reads it from the cache's bookmark path —
-    every battery here runs with inversion detection."""
+    every battery here runs with inversion detection.  The replica's
+    _cond is constructed through maybe_wrap (the CheckedLock Condition
+    protocol keeps wait()'s full reentrant release exact), so the access
+    sanitizer can attribute watermark writes to the held condition and
+    cross-check any unsynchronized pattern against the static
+    thread-ownership report."""
     mon = lockcheck.activate()
+    san = lockcheck.sanitize([FollowerReplica, LogShipper])
     try:
         yield mon
     finally:
+        lockcheck.unsanitize()
         lockcheck.deactivate()
     assert not mon.violations, mon.report()
+    if san.needs_verify():  # lazy: clean runs never build the report
+        from kubernetes_tpu.analysis.threads import repo_ownership_report
+        san.assert_consistent(repo_ownership_report())
 
 
 def _pod(i, ns="default"):

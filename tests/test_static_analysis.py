@@ -46,7 +46,10 @@ def test_all_ten_checks_registered():
             "exception-hygiene", "metrics-registration",
             # the dataflow engine's five (PR 7)
             "host-sync", "vmap-purity", "donation-aliasing",
-            "shape-drift", "blocking-in-cycle"} <= set(CHECK_REGISTRY)
+            "shape-drift", "blocking-in-cycle",
+            # the thread-ownership engine's four (PR 17)
+            "thread-ownership", "handoff-discipline",
+            "thread-local-context", "daemon-lifecycle"} <= set(CHECK_REGISTRY)
 
 
 def test_unknown_check_rejected():

@@ -1,0 +1,692 @@
+"""Thread-ownership engine battery: role graph, ownership lattice,
+handoff discipline, lifecycle, seeded repo regressions, and the runtime
+access sanitizer that cross-checks the static report.
+
+The seeded regressions re-inject the EXACT bug shapes this PR fixed
+(the scheduler's background phase_wall write, the replication watermark,
+the watch-cache stop flag, an unjoined server thread) and pin the
+finding to the injected file:line — the ratchet that keeps them fixed.
+"""
+
+import ast
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.analysis.core import (
+    DEFAULT_SCAN_PATHS,
+    ModuleInfo,
+    load_project,
+    project_from_sources,
+    run_checks,
+)
+from kubernetes_tpu.analysis.registry import default_checks
+from kubernetes_tpu.analysis.threads import (
+    MAIN,
+    ThreadAnalysis,
+    thread_analysis_for,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THREAD_CHECKS = ["thread-ownership", "handoff-discipline",
+                 "thread-local-context", "daemon-lifecycle"]
+
+
+def analyze(sources, checks):
+    project = project_from_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+    return run_checks(project, default_checks(checks))
+
+
+def sites(findings):
+    return [(f.path, f.line, f.rule) for f in findings]
+
+
+def _ta(sources):
+    project = project_from_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()})
+    return thread_analysis_for(project)
+
+
+# --- role graph ---------------------------------------------------------------
+
+
+ROLE_SRC = {
+    "pkg/pump.py": """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._thread = threading.Thread(target=self._drain, daemon=True)
+            self._thread.start()
+
+        def _drain(self):
+            self._shared_helper()
+
+        def _shared_helper(self):
+            pass
+
+        def run_main(self):
+            self._shared_helper()
+
+        def close(self):
+            self._thread.join()
+    """
+}
+
+
+def test_roles_propagate_through_call_graph():
+    ta = _ta(ROLE_SRC)
+    path = "pkg/pump.py"
+    drain = ta.roles_of(path, "Pump._drain")
+    assert drain and MAIN not in drain, drain
+    helper = ta.roles_of(path, "Pump._shared_helper")
+    assert MAIN in helper and len(helper) == 2, helper
+    assert ta.roles_of(path, "Pump.run_main") == {MAIN}
+
+
+# --- thread-ownership ---------------------------------------------------------
+
+
+OWNERSHIP_POS = {
+    "pkg/counter.py": """
+    import threading
+
+    class Counter:
+        def start(self):
+            self._thread = threading.Thread(target=self._drain, daemon=True)
+            self._thread.start()
+
+        def _drain(self):
+            self.total = 1
+
+        def close(self):
+            self._thread.join()
+            return self.total
+    """
+}
+
+
+def test_unlocked_cross_role_field_is_flagged_on_both_sides():
+    got = sites(analyze(OWNERSHIP_POS, ["thread-ownership"]))
+    assert ("pkg/counter.py", 10, "unsynchronized-cross-role-write") in got
+    assert ("pkg/counter.py", 14, "cross-role-read") in got
+    assert len(got) == 2, got
+
+
+def test_planted_unlocked_cross_role_write_is_exactly_one_finding():
+    """The planted write is the ONLY unlocked conflicting site (the main-
+    thread reader holds the class lock), so the check pins exactly one
+    finding at the planted file:line."""
+    src = {
+        "pkg/gauge.py": """
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._tick, daemon=True)
+                self._thread.start()
+
+            def _tick(self):
+                self.beat = 1
+
+            def close(self):
+                self._thread.join()
+                with self._lock:
+                    return self.beat
+        """
+    }
+    got = sites(analyze(src, ["thread-ownership"]))
+    assert got == [("pkg/gauge.py", 14,
+                    "unsynchronized-cross-role-write")], got
+
+
+def test_lock_protected_cross_role_field_is_clean():
+    src = {
+        "pkg/counter.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._drain, daemon=True)
+                self._thread.start()
+
+            def _drain(self):
+                with self._lock:
+                    self.total += 1
+
+            def close(self):
+                self._thread.join()
+                with self._lock:
+                    return self.total
+        """
+    }
+    assert analyze(src, ["thread-ownership"]) == []
+
+
+def test_cross_role_global_write_is_flagged():
+    src = {
+        "pkg/g.py": """
+        import threading
+
+        TOTAL = 0
+
+        def bump():
+            global TOTAL
+            TOTAL = TOTAL + 1
+
+        def fire():
+            global TOTAL
+            t = threading.Thread(target=bump, daemon=True)
+            t.start()
+            t.join()
+            TOTAL = 0
+        """
+    }
+    got = sites(analyze(src, ["thread-ownership"]))
+    assert ("pkg/g.py", 8, "global-cross-role") in got
+    assert ("pkg/g.py", 15, "global-cross-role") in got
+
+
+def test_suppressed_finding_with_justification_is_silent():
+    src = dict(OWNERSHIP_POS)
+    src["pkg/counter.py"] = src["pkg/counter.py"].replace(
+        "self.total = 1",
+        "self.total = 1  # ktpu-analysis: ignore[thread-ownership] -- "
+        "single-shot probe, reader joins first").replace(
+        "return self.total",
+        "return self.total  # ktpu-analysis: ignore[thread-ownership] -- "
+        "single-shot probe, reader joins first")
+    assert analyze(src, ["thread-ownership"]) == []
+
+
+def test_suppression_of_unknown_thread_check_name_is_linted():
+    src = {
+        "pkg/x.py": """
+        X = 1  # ktpu-analysis: ignore[thread-onwership] -- typo'd name
+        """
+    }
+    got = sites(analyze(src, ["thread-ownership"]))
+    assert ("pkg/x.py", 2, "unknown-check") in got
+
+
+def test_stale_thread_suppression_is_linted():
+    src = {
+        "pkg/x.py": """
+        X = 1  # ktpu-analysis: ignore[daemon-lifecycle] -- nothing here
+        """
+    }
+    got = sites(analyze(src, ["daemon-lifecycle"]))
+    assert ("pkg/x.py", 2, "unused") in got
+
+
+# --- handoff discipline -------------------------------------------------------
+
+
+HANDOFF_CLEAN = {
+    "pkg/runner.py": """
+    import threading
+
+    class Result:
+        pass
+
+    class Runner:
+        def kick(self):
+            if self._inflight is not None:
+                self._inflight.thread.join()
+            rec = Result()
+            def _bg():
+                rec.value = 42
+            rec.thread = threading.Thread(target=_bg, daemon=True)
+            rec.thread.start()
+            self._inflight = rec
+            return rec
+
+        def collect(self):
+            rec = self._inflight
+            rec.thread.join()
+            rec.thread = None
+            return rec.value
+    """
+}
+
+
+def test_joined_handoff_is_clean():
+    assert analyze(HANDOFF_CLEAN, THREAD_CHECKS) == []
+
+
+def test_read_before_join_is_flagged_at_the_read():
+    src = {
+        "pkg/runner.py": HANDOFF_CLEAN["pkg/runner.py"].replace(
+            """\
+        def collect(self):
+            rec = self._inflight
+            rec.thread.join()
+            rec.thread = None
+            return rec.value
+""",
+            """\
+        def collect(self):
+            rec = self._inflight
+            early = rec.value
+            rec.thread.join()
+            return early
+""")
+    }
+    got = sites(analyze(src, ["handoff-discipline"]))
+    assert got == [("pkg/runner.py", 21, "read-before-join")], got
+
+
+def test_republish_without_guard_is_flagged():
+    src = {
+        "pkg/runner.py": HANDOFF_CLEAN["pkg/runner.py"].replace(
+            """\
+            if self._inflight is not None:
+                self._inflight.thread.join()
+""", "")
+    }
+    got = sites(analyze(src, ["handoff-discipline"]))
+    assert got == [("pkg/runner.py", 14, "republish-while-live")], got
+
+
+# --- thread-local-context -----------------------------------------------------
+
+
+def test_module_level_threading_local_is_flagged():
+    src = {
+        "pkg/ctx.py": """
+        import threading
+
+        _ctx = threading.local()
+
+        def put(v):
+            _ctx.v = v
+        """
+    }
+    got = sites(analyze(src, ["thread-local-context"]))
+    assert got == [("pkg/ctx.py", 4, "implicit-thread-local")], got
+
+
+def test_class_thread_local_escaping_the_class_is_flagged():
+    src = {
+        "pkg/holder.py": """
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self._tls_blob = threading.local()
+
+            def put(self, v):
+                self._tls_blob.v = v
+        """,
+        "pkg/peek.py": """
+        def peek(h):
+            return h._tls_blob.v
+        """,
+    }
+    got = sites(analyze(src, ["thread-local-context"]))
+    assert got == [("pkg/peek.py", 3, "thread-local-escape")], got
+
+
+# --- daemon-lifecycle ---------------------------------------------------------
+
+
+def test_fire_and_forget_thread_is_flagged():
+    src = {
+        "pkg/d.py": """
+        import threading
+
+        def work():
+            return 1
+
+        def fire():
+            threading.Thread(target=work, daemon=True).start()
+        """
+    }
+    got = sites(analyze(src, ["daemon-lifecycle"]))
+    assert got == [("pkg/d.py", 8, "unjoined-thread")], got
+
+
+def test_stop_event_wired_to_sibling_setter_is_managed():
+    src = {
+        "pkg/d.py": """
+        import threading
+
+        def serve(tick):
+            stop = threading.Event()
+
+            def loop():
+                while not stop.wait(0.1):
+                    tick()
+
+            threading.Thread(target=loop, daemon=True).start()
+
+            def unwatch():
+                stop.set()
+            return unwatch
+        """
+    }
+    assert analyze(src, ["daemon-lifecycle"]) == []
+
+
+def test_executor_without_shutdown_is_flagged():
+    src = {
+        "pkg/e.py": """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def build():
+            return ThreadPoolExecutor(max_workers=2)
+        """
+    }
+    got = sites(analyze(src, ["daemon-lifecycle"]))
+    assert got == [("pkg/e.py", 5, "unmanaged-executor")], got
+
+
+def test_executor_with_class_shutdown_is_managed():
+    src = {
+        "pkg/e.py": """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Owner:
+            def open(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+
+            def close(self):
+                self._pool.shutdown(wait=False)
+        """
+    }
+    assert analyze(src, ["daemon-lifecycle"]) == []
+
+
+# --- the repo is clean under all four checks ---------------------------------
+
+
+def _repo_project():
+    return load_project(REPO_ROOT, DEFAULT_SCAN_PATHS)
+
+
+def test_repo_is_clean_under_thread_checks():
+    findings = run_checks(_repo_project(), default_checks(THREAD_CHECKS))
+    assert findings == [], "\n".join(
+        f"{f.location()} [{f.check}/{f.rule}] {f.message}" for f in findings)
+
+
+def test_repo_ownership_report_reflects_the_fixes():
+    """The fields this PR's burn-down fixed carry the classification the
+    fix earned: the scheduler's extender pool is lock-protected, the
+    Scheme registry is lock-protected, and phase_wall is main-only again
+    (the background sync wall now rides the _SyncAhead record)."""
+    report = thread_analysis_for(_repo_project()).ownership_report()
+    sched = report["TPUScheduler"]
+    assert sched["_ext_pool_obj"]["classification"] == "locked"
+    assert report["Scheme"]["_kinds"]["classification"] == "locked"
+    pw = sched["phase_wall"]
+    assert pw["classification"] == "single-role"
+    assert pw["roles"] == [MAIN]
+
+
+# --- seeded repo regressions: re-inject the fixed bugs ------------------------
+
+
+def _patched_repo_project(path_suffix, anchor, injected):
+    project = _repo_project()
+    mod = project.find(path_suffix)
+    lines = mod.source.splitlines(keepends=True)
+    at = next(i for i, ln in enumerate(lines) if ln.startswith(anchor))
+    lines.insert(at, injected if injected.endswith("\n") else injected + "\n")
+    patched = ModuleInfo(mod.path, "".join(lines))
+    project.modules[project.modules.index(mod)] = patched
+    return project, at + 1
+
+
+def test_seeded_background_phase_wall_write_fires_thread_ownership():
+    """The exact pre-fix scheduler bug: the overlapped-sync closure
+    writing phase_wall (a main-thread dict) from the background thread.
+    Re-injecting it makes phase_wall racy again — the injected line is
+    flagged, and every finding stays inside scheduler.py."""
+    project, lineno = _patched_repo_project(
+        "kubernetes_tpu/scheduler.py",
+        "            rec.wall = done - t_s",
+        '            self.phase_wall["sync_overlap"] += done - t_s\n')
+    findings = run_checks(project, default_checks(["thread-ownership"]))
+    assert findings, "injected background phase_wall write went unflagged"
+    assert {f.path for f in findings} == {"kubernetes_tpu/scheduler.py"}
+    assert lineno in {f.line for f in findings}
+    assert {f.rule for f in findings} <= {
+        "unsynchronized-cross-role-write", "cross-role-read"}
+
+
+def test_seeded_unlocked_watermark_write_fires_exactly_once():
+    """An injected background closure bumping FollowerReplica._applied_rv
+    outside _cond — every legitimate site holds the condition, so the
+    ONLY finding is the injected write, at its exact line."""
+    injected = (
+        "    def _lag_probe(self):\n"
+        "        def _bump():\n"
+        "            self._applied_rv = self._applied_rv + 1\n"
+        "        threading.Thread(target=_bump, daemon=True).start()\n")
+    project, lineno = _patched_repo_project(
+        "kubernetes_tpu/sim/replication.py",
+        "    def _refresh_gauges(self):", injected)
+    findings = run_checks(project, default_checks(["thread-ownership"]))
+    assert [(f.path, f.line) for f in findings] == \
+        [("kubernetes_tpu/sim/replication.py", lineno + 2)], sites(findings)
+
+
+def test_seeded_stop_flag_read_fires_thread_ownership():
+    """The exact pre-fix watch-cache bug shape: the bookmark loop polling
+    a plain attribute the main thread writes (now a threading.Event).
+    The injected cross-role read is flagged at its line."""
+    project, lineno = _patched_repo_project(
+        "kubernetes_tpu/sim/watchcache.py",
+        "                self.bookmark_now()",
+        "                if self._bookmark_thread is None:\n"
+        "                    return\n")
+    findings = run_checks(project, default_checks(["thread-ownership"]))
+    assert findings, "injected cross-role stop-flag read went unflagged"
+    assert {f.path for f in findings} == {"kubernetes_tpu/sim/watchcache.py"}
+    assert (lineno, "cross-role-read") in {(f.line, f.rule) for f in findings}
+
+
+def test_seeded_unjoined_server_thread_fires_daemon_lifecycle():
+    """An injected fire-and-forget thread in APIServer — no join, no stop
+    signal — is exactly one daemon-lifecycle finding at the spawn."""
+    injected = (
+        "    def _fire_probe(self):\n"
+        "        threading.Thread(target=self._probe_loop, "
+        "daemon=True).start()\n"
+        "\n"
+        "    def _probe_loop(self):\n"
+        "        while True:\n"
+        "            pass\n"
+        "\n")
+    project, lineno = _patched_repo_project(
+        "kubernetes_tpu/apiserver/server.py",
+        "    def stop(self):", injected)
+    findings = run_checks(project, default_checks(["daemon-lifecycle"]))
+    assert [(f.path, f.line, f.rule) for f in findings] == \
+        [("kubernetes_tpu/apiserver/server.py", lineno + 1,
+          "unjoined-thread")], sites(findings)
+
+
+# --- CheckedLock Condition protocol -------------------------------------------
+
+
+def test_condition_over_checked_rlock_keeps_monitor_stacks_exact():
+    """threading.Condition probes _is_owned/_release_save/_acquire_restore
+    on its lock; CheckedLock must implement them or wait() on a wrapped
+    RLock under-releases and the held-stack bookkeeping drifts.  A
+    reentrant wait must fully release (the notifier can acquire), then
+    restore BOTH the inner lock depth and the monitor stack."""
+    mon = lockcheck.activate()
+    try:
+        lock = lockcheck.maybe_wrap(threading.RLock(), "CondOwner.cond")
+        assert isinstance(lock, lockcheck.CheckedLock)
+        cond = threading.Condition(lock)
+        ready = []
+        observed = {}
+
+        def waiter():
+            with cond:
+                with cond:  # depth 2 across the wait
+                    while not ready:
+                        cond.wait(timeout=5)
+                    observed["inside"] = list(mon._stack())
+                observed["after_inner"] = list(mon._stack())
+            observed["after_outer"] = list(mon._stack())
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with cond:
+                if cond._waiters:
+                    ready.append(1)
+                    cond.notify_all()
+                    break
+            time.sleep(0.01)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert len(observed["inside"]) == 2, observed
+        assert len(observed["after_inner"]) == 1, observed
+        assert observed["after_outer"] == [], observed
+        assert mon._stack() == []  # main thread fully released
+        assert mon.violations == [], mon.report()
+    finally:
+        lockcheck.deactivate()
+
+
+def test_replica_condition_is_instrumented_under_a_monitor():
+    """FollowerReplica constructs its condition through maybe_wrap: under
+    an active monitor the replica's _cond runs on a CheckedLock, so the
+    replication battery's deliver/wait_for_rv paths feed the inversion
+    detector and the access sanitizer's lock attribution."""
+    import tempfile
+
+    from kubernetes_tpu.sim.replication import FollowerReplica
+
+    lockcheck.activate()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            rep = FollowerReplica("san-f", os.path.join(td, "f.wal"))
+            assert isinstance(rep._cond._lock, lockcheck.CheckedLock)
+            with rep._cond:
+                assert rep._cond._lock._key in lockcheck.active_monitor(
+                    )._stack()
+    finally:
+        lockcheck.deactivate()
+
+
+# --- access sanitizer ---------------------------------------------------------
+
+
+class _Plant:
+    def __init__(self):
+        self.lock = None
+        self.level = 0
+
+
+def test_sanitizer_records_unsynchronized_multi_thread_writes():
+    lockcheck.activate()
+    san = lockcheck.sanitize([_Plant])
+    try:
+        p = _Plant()
+        p.lock = lockcheck.maybe_wrap(threading.Lock(), "_Plant.lock")
+
+        def unlocked():
+            p.level = 1
+
+        def locked():
+            with p.lock:
+                p.level = 2
+
+        t1 = threading.Thread(target=unlocked)
+        t2 = threading.Thread(target=locked)
+        t1.start(); t1.join()
+        t2.start(); t2.join()
+        p.level = 3  # main, unlocked: 2 unsynchronized writers (main + t1)
+        assert san.needs_verify()
+        assert ("_Plant", "level", 2) in san.candidates()
+        # the locked write was attributed to the held _Plant.* lock and
+        # never counted — only one entry reaches 2 writers
+        report = {"_Plant": {
+            "level": {"classification": "locked", "roles": ["main", "bg"]},
+        }}
+        violations = san.verify(report)
+        assert len(violations) == 1 and "_Plant.level" in violations[0]
+        with pytest.raises(lockcheck.OwnershipViolation):
+            san.assert_consistent(report)
+    finally:
+        lockcheck.unsanitize()
+        lockcheck.deactivate()
+    # restore() really detached the recorder
+    q = _Plant()
+    q.level = 9
+    assert san.candidates() == [("_Plant", "level", 2)]
+
+
+def test_sanitizer_skips_handoff_loaned_and_unreported_fields():
+    san = lockcheck.sanitize([_Plant])
+    try:
+        p = _Plant()
+
+        def w():
+            p.level = 1
+
+        t = threading.Thread(target=w)
+        t.start(); t.join()
+        p.level = 2
+        assert san.needs_verify()
+        report = {"_Plant": {
+            "level": {"classification": "handoff", "roles": ["main", "bg"]},
+        }}
+        assert san.verify(report) == []
+        report["_Plant"]["level"]["classification"] = "loaned"
+        assert san.verify(report) == []
+        assert san.verify({}) == []  # field unknown to the static engine
+    finally:
+        lockcheck.unsanitize()
+
+
+def test_sanitizer_single_thread_use_never_needs_verify():
+    san = lockcheck.sanitize([_Plant])
+    try:
+        p = _Plant()
+        for i in range(5):
+            p.level = i
+        assert not san.needs_verify()
+        assert san.verify({"_Plant": {"level": {
+            "classification": "single-role", "roles": ["main"]}}}) == []
+    finally:
+        lockcheck.unsanitize()
+
+
+def test_sanitizer_distinguishes_instances():
+    """One writer thread per instance is NOT a race — candidates key on a
+    single instance seeing two unsynchronized writers."""
+    san = lockcheck.sanitize([_Plant])
+    try:
+        def spin():  # each thread builds and mutates its OWN instance
+            p = _Plant()
+            p.level = 1
+
+        for _ in range(2):
+            t = threading.Thread(target=spin)
+            t.start(); t.join()
+        assert not san.needs_verify()
+    finally:
+        lockcheck.unsanitize()

@@ -25,13 +25,21 @@ from kubernetes_tpu.testutil import make_pod
 @pytest.fixture(autouse=True)
 def lock_order_monitor():
     """Cache fan-out runs under the store lock and its readers under the
-    cache lock — every battery here runs with inversion detection."""
+    cache lock — every battery here runs with inversion detection, plus
+    the access sanitizer: cache/store field writes are recorded per
+    thread with held-lock attribution, and unsynchronized multi-thread
+    patterns are verified against the static thread-ownership report."""
     mon = lockcheck.activate()
+    san = lockcheck.sanitize([ObjectStore, WatchCache])
     try:
         yield mon
     finally:
+        lockcheck.unsanitize()
         lockcheck.deactivate()
     assert not mon.violations, mon.report()
+    if san.needs_verify():  # lazy: clean runs never build the report
+        from kubernetes_tpu.analysis.threads import repo_ownership_report
+        san.assert_consistent(repo_ownership_report())
 
 
 SCHEME = default_scheme()

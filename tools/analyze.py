@@ -22,6 +22,10 @@
                                           current findings (do this after
                                           FIXING sites, never to absorb
                                           new violations — keep it EMPTY)
+  python tools/analyze.py --report-ownership  dump the thread-ownership
+                                          engine's per-field role map
+                                          (class → field → roles/
+                                          classification) and exit
   --checks a,b  run a subset; --paths P ...  scan other roots (fixtures)
 """
 
@@ -121,7 +125,19 @@ def main(argv=None) -> int:
     ap.add_argument("--paths", nargs="*", default=None,
                     help="roots to scan (default: %s)"
                          % (DEFAULT_SCAN_PATHS,))
+    ap.add_argument("--report-ownership", action="store_true",
+                    help="dump the thread-ownership role map (class → "
+                         "field → write/read roles + classification) as "
+                         "JSON and exit; the same map the runtime access "
+                         "sanitizer verifies against")
     args = ap.parse_args(argv)
+
+    if args.report_ownership:
+        from kubernetes_tpu.analysis.threads import thread_analysis_for
+        project = load_project(REPO_ROOT, args.paths or DEFAULT_SCAN_PATHS)
+        print(json.dumps(thread_analysis_for(project).ownership_report(),
+                         indent=1, sort_keys=True))
+        return 0
 
     subset = [c for c in args.checks.split(",") if c]
     if args.check not in (None, "all"):

@@ -17,6 +17,15 @@ FAILED=0
 # the same one tier-1 enforces via tests/test_static_analysis.py.
 python tools/analyze.py --diff origin/main --check all > /dev/null || { echo "FAILED: static analysis diff gate" >> suites_run.log; exit 1; }
 python tools/analyze.py --check all > /dev/null || { echo "FAILED: static analysis gate" >> suites_run.log; exit 1; }
+# thread-ownership gate: the four concurrency checks (thread-ownership,
+# handoff-discipline, thread-local-context, daemon-lifecycle) are part of
+# `--check all` above; the NAMED invocation keeps them conclusive even if
+# someone narrows the gate list, and archives the ownership role map the
+# runtime access sanitizer verifies against
+python tools/analyze.py --check thread-ownership,handoff-discipline,thread-local-context,daemon-lifecycle > /dev/null \
+  || { echo "FAILED: thread analysis gate" >> suites_run.log; exit 1; }
+python tools/analyze.py --report-ownership > thread_ownership_report.json \
+  || { echo "FAILED: thread ownership report" >> suites_run.log; exit 1; }
 # gang-subsystem gate: the coscheduling battery (all-or-nothing, Permit
 # holds, timeout requeue, CLI) is cheap and conclusive — fail fast before
 # the expensive suites, same rationale as the analyzer gate above
