@@ -160,6 +160,28 @@ def main():
                                  init_pods=init_p)
     env_dense = envelope_stats(n_nodes, env_pods, sample=False, suite=suite,
                                init_pods=init_p)
+    # gang / DRA suites: their extra collectors ride the detail block so
+    # artifacts (BENCH_r18_DRA.json, suites_5k.out rows) can cite gangs/s,
+    # time-to-full-slice and claims/s without re-running anything
+    extra = {}
+    if "GangThroughput" in data:
+        gt, tfs = data["GangThroughput"], data.get("TimeToFullSlice", {})
+        extra["gang"] = {
+            "gangs": int(gt.get("Gangs", 0)),
+            "gangs_per_s": gt.get("Average", 0.0),
+            "time_to_full_slice_s": {
+                "p50": round(tfs.get("Perc50", 0.0), 3),
+                "p90": round(tfs.get("Perc90", 0.0), 3),
+                "max": round(tfs.get("Max", 0.0), 3),
+            },
+        }
+    if "ClaimsAllocated" in data:
+        ca = data["ClaimsAllocated"]
+        extra["dra_claims"] = {
+            "allocated": int(ca.get("Count", 0)),
+            "claims_per_s": ca.get("PerSecond", 0.0),
+        }
+
     p99_s = att["ExactPerc99"]
     vs_env_p99 = (env_sampled["attempt_ms"]["p99"] / 1e3) / p99_s if p99_s else 0.0
     env_thr = env_sampled["throughput_pods_per_s"]
@@ -209,6 +231,7 @@ def main():
             # Perfetto-loadable Chrome-trace artifact path when
             # KTPU_TRACE_DIR was set for the run
             "attempt_phase_latency": attempt_phase_block(data),
+            **extra,
             "wall_s": round(wall, 1),
             "baseline_note": (
                 "vs_baseline = mean per-pod algorithm time of the in-repo "

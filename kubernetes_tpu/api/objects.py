@@ -449,6 +449,25 @@ class Volume:
 
 
 @dataclass
+class PodResourceClaim:
+    """spec.resourceClaims entry: a pod-local name bound to either an
+    existing ResourceClaim or a ResourceClaimTemplate the claim controller
+    stamps a per-pod claim from (resource.k8s.io DRA)."""
+
+    name: str = ""
+    resource_claim_name: Optional[str] = None
+    resource_claim_template_name: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PodResourceClaim":
+        return cls(
+            name=d.get("name", ""),
+            resource_claim_name=d.get("resourceClaimName"),
+            resource_claim_template_name=d.get("resourceClaimTemplateName"),
+        )
+
+
+@dataclass
 class PodSpec:
     containers: List[Container] = field(default_factory=list)
     init_containers: List[Container] = field(default_factory=list)
@@ -466,6 +485,7 @@ class PodSpec:
     volumes: List[Volume] = field(default_factory=list)
     host_network: bool = False
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    resource_claims: List[PodResourceClaim] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "PodSpec":
@@ -491,6 +511,10 @@ class PodSpec:
             volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],
             host_network=bool(d.get("hostNetwork", False)),
             preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"),
+            resource_claims=[
+                PodResourceClaim.from_dict(c)
+                for c in d.get("resourceClaims") or []
+            ],
         )
 
 

@@ -153,6 +153,14 @@ def default_scheme() -> Scheme:
     s.add_known_type("scheduling.k8s.io", "v1", v1.PriorityClass)
     # coscheduling CRD (sigs.k8s.io/scheduler-plugins) — the gang unit
     s.add_known_type("scheduling.x-k8s.io", "v1alpha1", v1.PodGroup)
+    # dynamic resource allocation (resource.k8s.io — DeviceClass selectors,
+    # per-node ResourceSlice inventories, ResourceClaim allocation results)
+    from ..dra.api import (DeviceClass, ResourceClaim, ResourceClaimTemplate,
+                           ResourceSlice)
+
+    for typ in (DeviceClass, ResourceClaim, ResourceClaimTemplate,
+                ResourceSlice):
+        s.add_known_type("resource.k8s.io", "v1alpha2", typ)
     # cluster-autoscaler capacity unit (kubernetes_tpu/autoscaler)
     from ..autoscaler.api import NodeGroup
 

@@ -256,6 +256,32 @@ def _spec_status(obj) -> Dict[str, Any]:
                 ]}
     if isinstance(obj, v1.ServiceAccount):
         return {"secrets": list(obj.secrets)}
+    if obj.__class__.__name__ == "DeviceClass":
+        # resource.k8s.io family: name-based dispatch like NodeGroup below
+        # (the types live in kubernetes_tpu/dra and importing them here
+        # would cycle through the scheme)
+        return {"spec": {"selectors": dict(obj.selectors)}}
+    if obj.__class__.__name__ == "ResourceSlice":
+        return {"spec": {
+            "nodeName": obj.node_name,
+            "pool": {"name": obj.pool},
+            "driver": obj.driver,
+            "devices": [{"name": dev.name,
+                         "attributes": dict(dev.attributes)}
+                        for dev in obj.devices],
+        }}
+    if obj.__class__.__name__ == "ResourceClaim":
+        status: Dict[str, Any] = {"state": obj.state}
+        if obj.allocated_node or obj.allocated_devices:
+            status["allocation"] = {"nodeName": obj.allocated_node,
+                                    "devices": list(obj.allocated_devices)}
+        if obj.reserved_for:
+            status["reservedFor"] = obj.reserved_for
+        return {"spec": {"devices": {"requests": [_device_request(obj.request)]}},
+                "status": status}
+    if obj.__class__.__name__ == "ResourceClaimTemplate":
+        return {"spec": {"spec": {
+            "devices": {"requests": [_device_request(obj.request)]}}}}
     if obj.__class__.__name__ == "NodeGroup":
         # name-based dispatch like the HPA below: the type lives in the
         # autoscaler package and importing it here would cycle
@@ -280,6 +306,11 @@ def _spec_status(obj) -> Dict[str, Any]:
     body = _ser(obj)
     body.pop("metadata", None)
     return body
+
+
+def _device_request(r) -> Dict[str, Any]:
+    return {"name": r.name, "deviceClassName": r.device_class_name,
+            "count": r.count}
 
 
 def _ep_addr(a: v1.EndpointAddress) -> Dict[str, Any]:

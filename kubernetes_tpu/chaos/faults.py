@@ -59,6 +59,8 @@ CRASH_POST_LEASE_RENEW = "crash.post_lease_renew"  # leaderelection._tick: lease
 CRASH_PRE_WAL_FSYNC = "crash.pre_wal_fsync"    # sim/wal.append: record written, fsync never ran
 CRASH_MID_ZONE_EVICT = "crash.mid_zone_evict"  # controllers/nodelifecycle: unreachable taint written, eviction sweep unrun
 CRASH_MID_PROMOTE = "crash.mid_promote"        # sim/replication.promote: shipped tail durable, WAL not yet reattached
+CRASH_MID_PROVISION = "crash.mid_provision"    # controllers/volumebinder.sync_once: PV claimRef written, PVC bind lost
+CRASH_MID_CLAIM_COMMIT = "crash.mid_claim_commit"  # dra/plugin.pre_bind: some claims committed, pod not bound
 # Not in CRASH_POINTS (armed via arm_torn_write, not crash_points): the
 # torn-write fault writes a PREFIX of the record before dying, so the point
 # name only identifies the ProcessCrash it raises.
@@ -74,6 +76,8 @@ CRASH_POINTS = (
     CRASH_PRE_WAL_FSYNC,
     CRASH_MID_ZONE_EVICT,
     CRASH_MID_PROMOTE,
+    CRASH_MID_PROVISION,
+    CRASH_MID_CLAIM_COMMIT,
 )
 
 

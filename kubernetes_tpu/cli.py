@@ -27,6 +27,9 @@ KIND_ALIASES = {
     "pg": "PodGroup", "podgroup": "PodGroup", "podgroups": "PodGroup",
     "ng": "NodeGroup", "nodegroup": "NodeGroup", "nodegroups": "NodeGroup",
     "ev": "Event", "events": "Event",
+    "resourceclaim": "ResourceClaim", "resourceclaims": "ResourceClaim",
+    "deviceclass": "DeviceClass", "deviceclasses": "DeviceClass",
+    "resourceslice": "ResourceSlice", "resourceslices": "ResourceSlice",
 }
 
 from .api.scheme import SchemeError, default_scheme
@@ -81,6 +84,9 @@ class Kubectl:
             "Job": ["NAME", "COMPLETIONS", "SUCCEEDED", "DONE"],
             "PodGroup": ["NAME", "MIN-MEMBER", "PHASE", "TIMEOUT"],
             "NodeGroup": ["NAME", "SIZE", "MIN", "MAX", "TEMPLATE"],
+            "ResourceClaim": ["NAME", "STATE", "NODE", "ALLOCATED-DEVICE"],
+            "DeviceClass": ["NAME", "SELECTORS"],
+            "ResourceSlice": ["NAME", "NODE", "POOL", "DEVICES"],
         }.get(kind, ["NAME"])
 
     def _row(self, kind: str, o, nodes: Optional[List[v1.Node]] = None) -> List[str]:
@@ -120,6 +126,15 @@ class Kubectl:
                 tmpl += f",slice={o.slice_size}"
             return [o.metadata.name, str(size), str(o.min_size),
                     str(o.max_size), tmpl or "<none>"]
+        if kind == "ResourceClaim":
+            return [o.metadata.name, o.state, o.allocated_node or "<none>",
+                    ",".join(o.allocated_devices) or "<none>"]
+        if kind == "DeviceClass":
+            sel = ",".join(f"{k}={v}" for k, v in sorted(o.selectors.items()))
+            return [o.metadata.name, sel or "<none>"]
+        if kind == "ResourceSlice":
+            return [o.metadata.name, o.node_name or "<none>", o.pool or "<none>",
+                    str(len(o.devices))]
         return [o.metadata.name]
 
     def describe(self, kind: str, namespace: str, name: str) -> str:

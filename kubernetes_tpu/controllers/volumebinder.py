@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Set
 
 from ..api import objects as v1
 from ..api.resource import parse_quantity_exact
+from ..chaos.faults import CRASH_MID_PROVISION, maybe_crash
 from ..sim.store import ObjectStore
 
 
@@ -64,6 +65,17 @@ class PersistentVolumeBinderController:
                 pv.claim_ref = None
                 self.store.update("PersistentVolume", pv)
                 changed = True
+            elif not claim.volume_name:
+                # half-applied binding (a crash at CRASH_MID_PROVISION: the
+                # PV's claimRef landed, the PVC write never did) — COMPLETE
+                # it rather than release, the reference syncVolume's
+                # volume-bound/claim-unbound arm.  Exactly once: the PV
+                # holds its reserve through the crash, and this repair is
+                # the single claim-side write that consumes it.
+                claim.volume_name = pv.metadata.name
+                claim.phase = "Bound"
+                self.store.update("PersistentVolumeClaim", claim)
+                changed = True
         available = [pv for pv in pvs if not pv.claim_ref]
         for pvc in pvcs:
             key = f"{pvc.metadata.namespace}/{pvc.metadata.name}"
@@ -107,6 +119,9 @@ class PersistentVolumeBinderController:
             pvc.volume_name = best.metadata.name
             pvc.phase = "Bound"
             self.store.update("PersistentVolume", best)
+            # kill-point: the PV side of the bind is durable, the PVC side
+            # is not — the repair arm above must converge this state
+            maybe_crash(CRASH_MID_PROVISION)
             self.store.update("PersistentVolumeClaim", pvc)
             available.remove(best)
             changed = True

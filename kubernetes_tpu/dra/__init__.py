@@ -1,0 +1,24 @@
+"""Dynamic Resource Allocation: named TPU-device claims.
+
+Reference: pkg/scheduler/framework/plugins/dynamicresources and the
+resource.k8s.io API group (ResourceClaim / ResourceSlice structured
+parameters).  Pods stop requesting devices as a fungible counted resource
+and instead reference ResourceClaims that the scheduler resolves to
+SPECIFIC named devices (a concrete chip on a concrete host in a concrete
+slice) out of per-node ResourceSlice inventories.
+"""
+
+from .api import (  # noqa: F401
+    CLAIM_ALLOCATED,
+    CLAIM_PENDING,
+    CLAIM_RESERVED,
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceClaimTemplate,
+    ResourceSlice,
+)
+from .controller import ResourceClaimController  # noqa: F401
+from .index import DraIndex  # noqa: F401
+from .plugin import DynamicResourcesPlugin  # noqa: F401

@@ -38,6 +38,9 @@ class EventResource(str, enum.Enum):
     CSI_NODE = "CSINode"
     SERVICE = "Service"
     POD_GROUP = "PodGroup"
+    RESOURCE_CLAIM = "ResourceClaim"
+    RESOURCE_SLICE = "ResourceSlice"
+    DEVICE_CLASS = "DeviceClass"
     WILDCARD = "*"
 
 

@@ -76,10 +76,20 @@ class GangDirectory:
         self._slice_cache: Optional[np.ndarray] = None
         self._slice_cache_gen = -1
         self._noop_seg_cache: Dict[int, np.ndarray] = {}
+        # pod → pending chip demand (the scheduler wires its
+        # DraIndex.pod_claim_demand); None = claim-blind anchor pick
+        self._claim_demand = None
 
     def bind_runtime(self, waiting_pods) -> None:
         """Wire the scheduler-owned WaitingPodsMap (release/reject target)."""
         self._waiting_pods = waiting_pods
+
+    def attach_claim_resolver(self, fn) -> None:
+        """Make the anchor-slice pick consume DRA claim demand: a fresh
+        gang anchors to a slice whose free CHIPS cover the gang's pending
+        claims, so its members' claims co-allocate into one slice instead
+        of scattering across slices that can each host only part of it."""
+        self._claim_demand = fn
 
     # --- membership ----------------------------------------------------------
 
@@ -436,8 +446,17 @@ class GangDirectory:
         -2 for non-members (zero plane, shared compiled program)."""
         slice_dom = self._slice_dom(encoder)
         anchor = np.full(batch_size, -2, dtype=np.int32)
+        # per-gang pending chip demand over this batch's staged members —
+        # the slice the gang anchors to must have room for ALL of them
+        demands: Dict[str, int] = {}
+        if self._claim_demand is not None:
+            for pod in self._staged[:batch_size]:
+                key = self.group_key_of(pod)
+                if key is not None:
+                    demands[key] = demands.get(key, 0) + int(
+                        self._claim_demand(pod))
         memo: Dict[str, int] = {}
-        best = None  # lazily computed once per call
+        best = None  # lazily computed once per call (claim-free gangs)
         for i, pod in enumerate(self._staged[:batch_size]):
             key = self.group_key_of(pod)
             if key is None:
@@ -454,9 +473,13 @@ class GangDirectory:
                             a = int(slice_dom[row])
                             break
                 if a == -2:
-                    if best is None:
-                        best = self._best_free_slice(slice_dom, encoder)
-                    a = best
+                    need = demands.get(key, 0)
+                    if need > 0:
+                        a = self._best_free_slice(slice_dom, encoder, need)
+                    else:
+                        if best is None:
+                            best = self._best_free_slice(slice_dom, encoder)
+                        a = best
                 memo[key] = a
             anchor[i] = a
         return slice_dom, anchor
@@ -480,7 +503,8 @@ class GangDirectory:
         self._slice_cache, self._slice_cache_gen = dom, self._node_gen
         return dom
 
-    def _best_free_slice(self, slice_dom: np.ndarray, encoder) -> int:
+    def _best_free_slice(self, slice_dom: np.ndarray, encoder,
+                         claim_demand: int = 0) -> int:
         valid = np.asarray(encoder.node_valid)
         member = (slice_dom >= 0) & valid
         if not member.any():
@@ -489,4 +513,18 @@ class GangDirectory:
                 - np.asarray(encoder.requested)[:, 0])
         totals = np.zeros(int(slice_dom.max()) + 1, dtype=np.int64)
         np.add.at(totals, slice_dom[member], free[member])
+        if claim_demand > 0:
+            # claim-aware pick: among slices whose free CHIPS (the encoder
+            # claim planes the DraIndex projects) cover the gang's pending
+            # demand, take the most free CPU; if none can, take the most
+            # free chips — members still filter per-node, and the Permit
+            # timeout fails a truly starved gang atomically
+            chips = (np.asarray(encoder.claim_capacity).astype(np.int64)
+                     - np.asarray(encoder.claim_allocated))
+            chip_tot = np.zeros_like(totals)
+            np.add.at(chip_tot, slice_dom[member], chips[member])
+            fits = chip_tot >= claim_demand
+            if fits.any():
+                return int(np.argmax(np.where(fits, totals, -1)))
+            return int(np.argmax(chip_tot))
         return int(np.argmax(totals))

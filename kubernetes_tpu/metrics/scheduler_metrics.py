@@ -398,6 +398,28 @@ apiserver_role = default_registry.register(
           "Current serving role per apiserver replica (1 = active)")
 )
 
+# --- dynamic resource allocation (kubernetes_tpu/dra/) ------------------------
+# Emitted at the real decision points: PreBind's claim-commit loop (one
+# increment per claim, one duration observation per pod allocation), and
+# the Reserve-time conflict path.
+
+dra_claims_allocated = default_registry.register(
+    # labels: (result,) — "allocated" (claim allocation persisted to the
+    # store) | "conflict" (Reserve lost the named-device race or the claim
+    # was held by another pod) | "rollback" (a later claim's commit failed,
+    # this pod's written claims were deallocated — the exactly-once path)
+    # | "error" (terminal store fault with nothing left to roll back)
+    Counter("dra_claims_allocated_total",
+            "ResourceClaim allocation outcomes, by result")
+)
+dra_allocation_duration = default_registry.register(
+    # PreBind entry → all of the pod's claims committed (or rolled back);
+    # one observation per pod that carried at least one claim
+    Histogram("dra_allocation_duration_seconds",
+              exponential_buckets(0.0001, 2, 15),
+              "Per-pod ResourceClaim allocation commit latency")
+)
+
 autoscaler_scale_decisions = default_registry.register(
     # labels: (direction, result) — direction "up" | "down"; result
     # "applied" (nodes created / node drained+deleted) | "no_fit" (no

@@ -70,6 +70,9 @@ class Workload:
     # the driven controller is a cluster-autoscaler (AutoscaleGang):
     # collect scale-decision + whatif-fork items instead of evictions/s
     autoscaler: bool = False
+    # DRA suites (DeviceClaimGang): collect the claims/s item from the
+    # window's dra_claims_allocated_total{result=allocated} delta
+    dra: bool = False
     # arms the scheduler's adaptive micro-bucket policy (TPUScheduler
     # latency_target_ms): dedup-eligible constraint-free batches split into
     # pow-2 sub-buckets until the recent attempt p99 fits under the target.
@@ -432,6 +435,15 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                 # delta attributes suite time to host_prepare / partition /
                 # dispatch / fetch / bind so a regression names its phase
                 phase0 = dict(sched.phase_wall)
+
+                def _claims_allocated() -> float:
+                    return sum(
+                        v for (labels, v)
+                        in m.dra_claims_allocated.items().items()
+                        if labels and labels[0] == "allocated")
+
+                # window delta: the warm pods' claim commits must not count
+                claims0 = _claims_allocated() if w.dra else 0.0
                 # Stop-the-world gen-2 GC pauses (CPython re-scans the
                 # whole warmed object graph — 5k Node/NodeInfo trees,
                 # compiled batches, programs: measured 120-180 ms each,
@@ -578,6 +590,16 @@ def run_workload(w: Workload, clock=time.perf_counter) -> List[DataItem]:
                                   "PerSecond": (round(evicted / total_s, 2)
                                                 if total_s > 0 else 0.0)},
                             unit="evictions/s",
+                        ))
+                    if w.dra:
+                        allocated = _claims_allocated() - claims0
+                        items.append(DataItem(
+                            labels={"Name": w.name,
+                                    "Metric": "ClaimsAllocated"},
+                            data={"Count": float(allocated),
+                                  "PerSecond": (round(allocated / total_s, 2)
+                                                if total_s > 0 else 0.0)},
+                            unit="claims/s",
                         ))
                     if w.gang_size:
                         gd = sorted(gang_done_t)

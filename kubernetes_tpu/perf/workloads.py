@@ -568,6 +568,333 @@ def _autoscale_gang(n, p, mp) -> Workload:
     )
 
 
+# --- Dynamic resource allocation (DRA) --------------------------------------
+
+CHIPS_PER_HOST = 4  # chips each host's ResourceSlice publishes
+
+# warm-pod offsets the harness's template warms actually dispatch
+# (9_990_000 + 2*wi + j — see harness.py); the warm pool provisions one
+# claim + one singleton PodGroup per offset so claim-carrying warm batches
+# compile the SAME program variant (gang aux + claim planes) as the window
+DRA_WARM_POOL = 8
+
+
+def dra_class_template(i: int) -> tuple:
+    from ..dra.api import DeviceClass
+
+    return ("DeviceClass",
+            DeviceClass(metadata=v1.ObjectMeta(name="tpu")))
+
+
+def dra_slice_template(gang_size: int = GANG_SIZE) -> Callable[[int], tuple]:
+    """ResourceSlice j publishes host node-j's chips into pool slice-{j//gs}
+    — the TPU driver's per-node inventory, one slice label per pool."""
+    from ..dra.api import (ATTR_CHIP_INDEX, ATTR_HOST, ATTR_MEMORY,
+                           ATTR_SLICE, Device, ResourceSlice)
+
+    def tmpl(j: int) -> tuple:
+        host = f"node-{j:06d}"
+        sl = f"slice-{j // gang_size:05d}"
+        devs = [
+            # device names carry the host: unique within the pool (several
+            # hosts publish into one slice's pool), so "<pool>/<device>"
+            # pins (slice, host, chip) exactly
+            Device(name=f"{host}-chip{c}", attributes={
+                ATTR_SLICE: sl, ATTR_HOST: host,
+                ATTR_CHIP_INDEX: str(c), ATTR_MEMORY: "16",
+            })
+            for c in range(CHIPS_PER_HOST)
+        ]
+        return ("ResourceSlice", ResourceSlice(
+            metadata=v1.ObjectMeta(name=f"rs-{host}"),
+            node_name=host, pool=sl, devices=devs))
+
+    return tmpl
+
+
+def dra_claim_template(j: int) -> tuple:
+    from ..dra.api import DeviceRequest, ResourceClaim
+
+    return ("ResourceClaim", ResourceClaim(
+        metadata=v1.ObjectMeta(name=f"gangclaim-{j:06d}",
+                               namespace="default"),
+        request=DeviceRequest(device_class_name="tpu",
+                              count=CHIPS_PER_HOST)))
+
+
+def dra_warm_node(n: int) -> Callable[[int], v1.Node]:
+    """One dedicated warm host (index n, its own slice label): warm pods
+    pin here via node selector, so the chips their claims consume — left
+    Reserved when the harness deletes the warm pods — never shrink a
+    production slice below a gang's demand."""
+    from ..gang import SLICE_LABEL
+
+    def tmpl(i: int) -> v1.Node:
+        return (
+            make_node().name(f"node-{i:06d}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+            .label("dra-warm", "1")
+            .label(SLICE_LABEL, "slice-warm")
+            .obj()
+        )
+
+    return tmpl
+
+
+def dra_warm_slice(n: int) -> Callable[[int], tuple]:
+    from ..dra.api import (ATTR_CHIP_INDEX, ATTR_HOST, ATTR_SLICE, Device,
+                           ResourceSlice)
+
+    def tmpl(j: int) -> tuple:
+        host = f"node-{n:06d}"
+        devs = [
+            Device(name=f"chip{c}", attributes={
+                ATTR_SLICE: "slice-warm", ATTR_HOST: host,
+                ATTR_CHIP_INDEX: str(c),
+            })
+            for c in range(2 * DRA_WARM_POOL)
+        ]
+        return ("ResourceSlice", ResourceSlice(
+            metadata=v1.ObjectMeta(name=f"rs-{host}"),
+            node_name=host, pool="slice-warm", devices=devs))
+
+    return tmpl
+
+
+def dra_warm_claim_template(j: int) -> tuple:
+    from ..dra.api import DeviceRequest, ResourceClaim
+
+    return ("ResourceClaim", ResourceClaim(
+        metadata=v1.ObjectMeta(name=f"warmclaim-{j}", namespace="default"),
+        request=DeviceRequest(device_class_name="tpu", count=1)))
+
+
+def dra_warm_group_template(j: int) -> tuple:
+    # min_member=1: the warm singleton gang reaches quorum instantly, so
+    # the warm batch runs the FULL gang+claim program (anchor plane, claim
+    # filter/score, Reserve, PreBind CAS commit) end to end
+    pg = v1.PodGroup(
+        metadata=v1.ObjectMeta(name=f"wg-{j}", namespace="default"),
+        min_member=1, schedule_timeout_seconds=60,
+    )
+    return ("PodGroup", pg)
+
+
+def pod_claim_gang(gang_size: int = GANG_SIZE) -> Callable[[int], v1.Pod]:
+    """Gang member i claims its host's whole chip inventory (one named
+    ResourceClaim per member, pre-created); warm indices (≥9M) yield
+    singleton-gang pods claiming ONE warm-pool chip, pinned to the warm
+    host — the claim-carrying program variants must all be warm before
+    the window (run_suites.sh holds this suite at zero in-window
+    compiles)."""
+    from ..gang import POD_GROUP_LABEL
+
+    def tmpl(i: int) -> v1.Pod:
+        if i >= 9_000_000:
+            k = i - 9_990_000
+            return (
+                _base_pod(i, "dwarm", "default")
+                .label(POD_GROUP_LABEL, f"wg-{k}")
+                .req({"cpu": "100m", "memory": "100Mi"})
+                .node_selector({"dra-warm": "1"})
+                .claim(f"warmclaim-{k}")
+                .obj()
+            )
+        return (
+            _base_pod(i, "dgang", "default")
+            .label(POD_GROUP_LABEL, f"pg-{i // gang_size:05d}")
+            .req({"cpu": "3000m", "memory": "500Mi"})
+            .claim(f"gangclaim-{i:06d}")
+            .obj()
+        )
+
+    return tmpl
+
+
+def _device_claim_gang(n, p, mp) -> Workload:
+    """DeviceClaimGang: GangBasic's all-or-nothing slice jobs, each member
+    carrying a named ResourceClaim for its host's chips — the anchor-slice
+    score consumes claim demand, Filter/Score run the batched claim
+    planes, Reserve/PreBind allocate named devices with CAS exactly-once.
+    Measures claims/s alongside gangs/s + time-to-full-slice."""
+    gs = GANG_SIZE if mp >= GANG_SIZE else max(2, mp)
+    ngangs = max(1, mp // gs)
+    return Workload(
+        name="DeviceClaimGang",
+        ops=[
+            Op("createNodes", n, node_template=node_sliced(gs)),
+            Op("createNodes", 1, node_template=dra_warm_node(n)),
+            Op("createObjects", 1, object_template=dra_class_template),
+            Op("createObjects", n, object_template=dra_slice_template(gs)),
+            Op("createObjects", 1, object_template=dra_warm_slice(n)),
+            Op("createObjects", DRA_WARM_POOL,
+               object_template=dra_warm_claim_template),
+            Op("createObjects", DRA_WARM_POOL,
+               object_template=dra_warm_group_template),
+            Op("createObjects", ngangs, object_template=podgroup_template(gs)),
+            Op("createObjects", ngangs * gs, object_template=dra_claim_template),
+            Op("createPods", ngangs * gs, pod_template=pod_claim_gang(gs),
+               collect_metrics=True),
+        ],
+        batch_size=64,
+        gang_size=gs,
+        dra=True,
+    )
+
+
+# --- stateful / volume-topology suites --------------------------------------
+
+STS_CLASS = "sts-local"
+STS_CHURN_SLOTS = 8
+
+
+def sts_class_template(j: int) -> tuple:
+    sc = v1.StorageClass(volume_binding_mode=v1.VOLUME_BINDING_WAIT)
+    sc.metadata.name = STS_CLASS
+    return ("StorageClass", sc)
+
+
+def pv_local_template(n: int, offset: int = 0,
+                      prefix: str = "sts") -> Callable[[int], tuple]:
+    """Local PV j pinned to host (offset+j) % n — WaitForFirstConsumer
+    inventory the VolumeBinding plugin matches at Filter time."""
+
+    def tmpl(j: int) -> tuple:
+        pv = v1.PersistentVolume(capacity={"storage": "10Gi"},
+                                 storage_class_name=STS_CLASS)
+        pv.metadata.name = f"{prefix}-pv-{j:06d}"
+        pv.node_affinity = v1.NodeSelector(node_selector_terms=[
+            v1.NodeSelectorTerm(match_expressions=[
+                v1.NodeSelectorRequirement(
+                    key="kubernetes.io/hostname", operator=v1.OP_IN,
+                    values=[f"node-{(offset + j) % n:06d}"],
+                )
+            ])
+        ])
+        return ("PersistentVolume", pv)
+
+    return tmpl
+
+
+def pvc_wffc_template(prefix: str) -> Callable[[int], tuple]:
+    def tmpl(j: int) -> tuple:
+        pvc = v1.PersistentVolumeClaim(storage_class_name=STS_CLASS,
+                                       requested_storage="5Gi")
+        pvc.metadata.name = f"{prefix}-{j:06d}"
+        pvc.metadata.namespace = "default"
+        return ("PersistentVolumeClaim", pvc)
+
+    return tmpl
+
+
+def pod_stateful(i: int) -> v1.Pod:
+    if i >= 9_000_000:
+        return pod_default(i)  # warm pods must bind without a PVC
+    return (
+        _base_pod(i, "sts", "default")
+        .req({"cpu": "100m", "memory": "500Mi"})
+        .pvc(f"sts-data-{i:06d}")
+        .obj()
+    )
+
+
+def _stateful_churn(n, p, mp) -> Workload:
+    """StatefulChurn: every measured pod binds its own WaitForFirstConsumer
+    PVC to a node-local PV (the VolumeBinding Reserve/PreBind path at
+    scale), while a churn hook recreates StatefulSet-shaped pods whose
+    PVCs are ALREADY bound — each recreated pod must follow its volume."""
+
+    def churn_pvc_template(j: int) -> tuple:
+        pvc = v1.PersistentVolumeClaim(storage_class_name=STS_CLASS,
+                                       requested_storage="5Gi")
+        pvc.metadata.name = f"churn-data-{j:03d}"
+        pvc.metadata.namespace = "default"
+        return ("PersistentVolumeClaim", pvc)
+
+    def churn(store, cycle: int):
+        # recreate-mode stateful churn: the pod dies, its PVC (and the PV
+        # the first bind chose) survives — the reference StatefulSet shape
+        k = cycle % STS_CHURN_SLOTS
+        name = f"sts-churn-{k:03d}"
+        if store.get("Pod", "default", name) is not None:
+            store.delete("Pod", "default", name)
+        store.create(
+            "Pod",
+            make_pod().name(name).uid(f"{name}-{cycle}").namespace("default")
+            .req({"cpu": "100m", "memory": "500Mi"})
+            .pvc(f"churn-data-{k:03d}").obj(),
+        )
+
+    return Workload(
+        name="StatefulChurn",
+        ops=[
+            Op("createNodes", n, node_template=node_default),
+            Op("createObjects", 1, object_template=sts_class_template),
+            Op("createObjects", mp, object_template=pv_local_template(n)),
+            Op("createObjects", STS_CHURN_SLOTS,
+               object_template=pv_local_template(n, offset=mp,
+                                                 prefix="churn")),
+            Op("createObjects", mp, object_template=pvc_wffc_template("sts-data")),
+            Op("createObjects", STS_CHURN_SLOTS,
+               object_template=churn_pvc_template),
+            Op("createPods", mp, pod_template=pod_stateful,
+               collect_metrics=True),
+        ],
+        batch_size=256,
+        churn_between_cycles=churn,
+    )
+
+
+def pod_volume_zone_spread(i: int) -> v1.Pod:
+    if i >= 9_000_000:
+        return pod_default(i)
+    return (
+        _base_pod(i, "vzs", "default")
+        .req({"cpu": "100m", "memory": "500Mi"})
+        .label("color", "blue")
+        .topology_spread(
+            5, "topology.kubernetes.io/zone", labels={"color": "blue"}
+        )
+        .pvc(f"vzs-data-{i:06d}")
+        .obj()
+    )
+
+
+def _volume_zone_spread(n, p, mp) -> Workload:
+    """VolumeZoneSpread: each measured pod carries a PVC already bound to
+    a ZONAL PV (VolumeZone filters its nodes to the PV's zone) plus a
+    DoNotSchedule zone-spread constraint — the two planes must agree, the
+    reference's zonal-StatefulSet shape."""
+
+    def pv_zonal_template(j: int) -> tuple:
+        pv = v1.PersistentVolume(capacity={"storage": "10Gi"})
+        pv.metadata.name = f"vzs-pv-{j:06d}"
+        pv.metadata.labels = {
+            "topology.kubernetes.io/zone": ZONES3[j % len(ZONES3)]}
+        pv.claim_ref = f"default/vzs-data-{j:06d}"
+        return ("PersistentVolume", pv)
+
+    def pvc_bound_template(j: int) -> tuple:
+        pvc = v1.PersistentVolumeClaim(volume_name=f"vzs-pv-{j:06d}",
+                                       requested_storage="5Gi")
+        pvc.metadata.name = f"vzs-data-{j:06d}"
+        pvc.metadata.namespace = "default"
+        pvc.phase = "Bound"
+        return ("PersistentVolumeClaim", pvc)
+
+    return Workload(
+        name="VolumeZoneSpread",
+        ops=[
+            Op("createNodes", n, node_template=node_zoned(ZONES3)),
+            Op("createObjects", mp, object_template=pv_zonal_template),
+            Op("createObjects", mp, object_template=pvc_bound_template),
+            Op("createPods", mp, pod_template=pod_volume_zone_spread,
+               collect_metrics=True),
+        ],
+        batch_size=256,
+    )
+
+
 def _mixed_churn(n, p, mp) -> Workload:
     def churn(store, cycle: int):
         # recreate-mode churn (SchedulingWithMixedChurn): one node, one
@@ -671,6 +998,26 @@ SUITES: Dict[str, Suite] = {
         Suite("AutoscaleGang", _autoscale_gang,
               {"64Nodes": (16, 0, 56), "500Nodes": (120, 0, 480),
                "5000Nodes": (1200, 0, 4800)},
+              batch_size={"5000Nodes": 512}),
+        # Gang scheduling with named-device claims: every member carries a
+        # ResourceClaim for its host's chips; the anchor-slice plane
+        # consumes claim demand and PreBind CAS-commits allocations — see
+        # _device_claim_gang.  Zero-in-window-compile gated in
+        # run_suites.sh (the claim planes ride the warm program variants).
+        Suite("DeviceClaimGang", _device_claim_gang,
+              {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
+               "5000Nodes": (5000, 0, 4800)},
+              batch_size={"5000Nodes": 512}),
+        # Stateful workloads: WFFC PVC-per-pod binding at scale plus
+        # recreate-churn of already-bound StatefulSet pods — see
+        # _stateful_churn
+        Suite("StatefulChurn", _stateful_churn,
+              {"500Nodes": (500, 0, 1000), "5000Nodes": (5000, 0, 2000)},
+              batch_size={"5000Nodes": 512}),
+        # Zonal volumes × zone spread: VolumeZone filter + DoNotSchedule
+        # spread on the same axis — see _volume_zone_spread
+        Suite("VolumeZoneSpread", _volume_zone_spread,
+              {"500Nodes": (500, 0, 1000), "5000Nodes": (5000, 0, 2000)},
               batch_size={"5000Nodes": 512}),
         # Descheduler: every HOST fragmented by a pre-bound straggler,
         # gangs blocked until the defrag policy frees whole slices — see

@@ -54,7 +54,8 @@ from .state.units import pow2_round_up as _pow2
 DEFAULT_SCHEDULER_NAME = "default-scheduler"  # apis/config v1.Pod default
 
 
-def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
+def default_plugins(domain_cap: int, listers=None,
+                    dra_index=None) -> List[PluginWithWeight]:
     """Default plugin set + weights (apis/config/v1beta3/default_plugins.go:32-51)."""
     from .plugins.volumes import (
         NodeVolumeLimitsPlugin,
@@ -63,6 +64,7 @@ def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
         VolumeZonePlugin,
     )
 
+    from .dra import DynamicResourcesPlugin
     from .gang import CoschedulingPlugin
 
     PW = PluginWithWeight
@@ -78,6 +80,7 @@ def default_plugins(domain_cap: int, listers=None) -> List[PluginWithWeight]:
         PW(NodeVolumeLimitsPlugin(listers), 0),
         PW(VolumeBindingPlugin(listers), 0),
         PW(VolumeZonePlugin(listers), 0),
+        PW(DynamicResourcesPlugin(dra_index), 1),
         PW(P.PodTopologySpreadPlugin(domain_cap=domain_cap), 2),
         PW(P.InterPodAffinityPlugin(domain_cap=domain_cap), 2),
         PW(P.BalancedAllocationPlugin(), 1),
@@ -547,8 +550,16 @@ class TPUScheduler:
         from .plugins.volumes import StoreVolumeListers
 
         listers = StoreVolumeListers(store)
+        # DRA ledger: device inventory + claim allocations, projected into
+        # the encoder's claim planes right after every sync (see
+        # _dispatch_batch_traced) and consumed by the DynamicResources
+        # plugin's Reserve/PreBind plus the gang anchor-slice resolver
+        from .dra import DraIndex
+
+        self.dra = DraIndex(store)
         if plugins_factory is default_plugins:
-            self._plugins_factory = lambda d: default_plugins(d, listers)
+            self._plugins_factory = lambda d: default_plugins(
+                d, listers, dra_index=self.dra)
         else:
             self._plugins_factory = plugins_factory
         # profile map: schedulerName → plugins factory; every profile gets its
@@ -575,6 +586,7 @@ class TPUScheduler:
         from .gang import GangDirectory
 
         self.gangs = GangDirectory(store, clock=clock)
+        self.gangs.attach_claim_resolver(self.dra.pod_claim_demand)
         self.queue = PriorityQueue(
             less=self.gangs.less,
             clock=clock, cluster_event_map=event_map,
@@ -674,11 +686,21 @@ class TPUScheduler:
         "StorageClass": EventResource.STORAGE_CLASS,
         "CSINode": EventResource.CSI_NODE,
         "Service": EventResource.SERVICE,
+        "ResourceClaim": EventResource.RESOURCE_CLAIM,
+        "ResourceSlice": EventResource.RESOURCE_SLICE,
+        "DeviceClass": EventResource.DEVICE_CLASS,
     }
 
-    # kinds that never unblock scheduling (avoid wildcard requeue storms)
+    # DRA kinds feed the index before the requeue fires (claim-plane dirt
+    # must precede the pods the event unblocks)
+    _DRA_KINDS = frozenset(("ResourceClaim", "ResourceSlice", "DeviceClass"))
+
+    # kinds that never unblock scheduling (avoid wildcard requeue storms);
+    # a ResourceClaimTemplate only matters once the claim controller stamps
+    # a claim from it — THAT create requeues
     _IGNORED_KINDS = {"Lease", "Event", "ReplicaSet", "Deployment", "Job",
-                      "StatefulSet", "DaemonSet", "HorizontalPodAutoscaler"}
+                      "StatefulSet", "DaemonSet", "HorizontalPodAutoscaler",
+                      "ResourceClaimTemplate"}
 
     def _on_event(self, ev: WatchEvent):
         if ev.kind == "Node":
@@ -693,6 +715,12 @@ class TPUScheduler:
                       DELETED: ActionType.DELETE}.get(ev.type, ActionType.ALL)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(EventResource.POD_GROUP, action))
+        elif ev.kind in self._DRA_KINDS:
+            self.dra.on_event(ev.type, ev.obj)
+            action = {ADDED: ActionType.ADD, MODIFIED: ActionType.UPDATE,
+                      DELETED: ActionType.DELETE}.get(ev.type, ActionType.ALL)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(self._KIND_RESOURCE[ev.kind], action))
         elif ev.kind in self._IGNORED_KINDS:
             return
         else:
@@ -720,6 +748,9 @@ class TPUScheduler:
         self.gangs.invalidate_nodes()  # slice-domain plane is stale
         if ev.type == ADDED:
             self.cache.add_node(node)
+            # a (re)added node may land on a freed encoder row whose claim
+            # planes were zeroed — re-project its inventory next flush
+            self.dra.note_node(node.metadata.name)
             self.queue.move_all_to_active_or_backoff(fwk_events.NODE_ADD)
         elif ev.type == MODIFIED:
             old_info = self.cache._nodes.get(node.metadata.name)
@@ -1567,6 +1598,11 @@ class TPUScheduler:
         # O(changed-nodes) refresh, generation-gated (cache.go:197-276 analog)
         changed = self.cache.update_snapshot(self.snapshot)
         self.encoder.sync(self.snapshot, changed)
+        # DRA claim planes: project dirty nodes' (capacity, allocated) into
+        # the encoder mirrors now, BEFORE the deferred device upload — the
+        # upload closure re-checks encoder dirt at call time, so this flush
+        # always rides the same scatter/snapshot as the node sync above
+        self.dra.flush_to_encoder(self.encoder)
         t_snap_end = self.clock()
         self.phase_wall["snapshot"] += t_snap_end - t0
         if disp_span is not None:
@@ -2722,6 +2758,10 @@ class TPUScheduler:
             if POD_GROUP_LABEL in p.metadata.labels:
                 return False
             if getattr(p.spec, "volumes", None):
+                return False
+            # DRA host aux is pod-indexed (per-pod claim pins/blocks): the
+            # full gate would refuse it, so the upgrade is wasted work
+            if getattr(p.spec, "resource_claims", None):
                 return False
         if self._batch_can_preempt(batch):
             return False

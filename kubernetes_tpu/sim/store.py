@@ -105,7 +105,8 @@ class ObjectStore:
     # --- helpers -------------------------------------------------------------
 
     CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
-                      "PriorityClass", "Namespace"}
+                      "PriorityClass", "Namespace",
+                      "DeviceClass", "ResourceSlice"}
 
     @classmethod
     def _key(cls, kind: str, obj) -> Tuple[str, str, str]:

@@ -108,6 +108,12 @@ class DeviceSnapshot:
     # node_valid & node_ready so an in-flight cycle can't bind onto a dead
     # host even before the NoExecute taint plane is consulted
     node_ready: jnp.ndarray  # bool[N]
+    # DRA claim planes (dra/index.py writes the mirrors): published TPU
+    # device inventory and currently-allocated device count per node row.
+    # free chips = claim_capacity - claim_allocated is the filter plane the
+    # DynamicResources plugin and the gang anchor-slice score consume
+    claim_capacity: jnp.ndarray  # i32[N]
+    claim_allocated: jnp.ndarray  # i32[N]
     # scheduled pods
     pod_valid: jnp.ndarray  # bool[P]
     pod_node: jnp.ndarray  # i32[P] (-1 when unknown)
@@ -305,6 +311,10 @@ class ClusterEncoder:
         # ready defaults True: a free/unencoded row is gated by node_valid,
         # and encode_node always rewrites the bit from live conditions
         self.node_ready = np.ones(n, dtype=bool)
+        # claim planes are owned by the DRA index, not encode_node: a node
+        # re-encode must not clobber inventory written from ResourceSlices
+        self.claim_capacity = np.zeros(n, dtype=np.int32)
+        self.claim_allocated = np.zeros(n, dtype=np.int32)
         self.pod_valid = np.zeros(p, dtype=bool)
         self.pod_node = np.full(p, MISSING, dtype=np.int32)
         self.pod_ns = np.full(p, MISSING, dtype=np.int32)
@@ -529,11 +539,34 @@ class ClusterEncoder:
             return
         self._row_to_name.pop(row, None)
         self.node_valid[row] = False
+        # claim planes persist across encode_node (the DRA index owns them),
+        # so a freed row must drop its inventory here or the next node to
+        # reuse the row would inherit the dead host's chips
+        self.claim_capacity[row] = 0
+        self.claim_allocated[row] = 0
         self._free_node_rows.append(row)
         self._dirty_node_rows.add(row)
         for uid in self._pods_by_node.pop(name, []):
             if self._pod_owner.get(uid) == name:
                 self._remove_pod_row(uid)
+
+    # --- DRA claim planes (dra/index.py is the writer) -----------------------
+
+    def set_claim_row(self, name: str, capacity: int, allocated: int) -> bool:
+        """Write a node's claim planes by NAME; False when the node has no
+        row yet (the index retries on its next flush once the node encodes).
+        No-change writes skip the dirty mark so a steady-state flush costs
+        nothing on the scatter path."""
+        row = self.node_rows.get(name)
+        if row is None:
+            return False
+        if (self.claim_capacity[row] == capacity
+                and self.claim_allocated[row] == allocated):
+            return True
+        self.claim_capacity[row] = capacity
+        self.claim_allocated[row] = allocated
+        self._dirty_node_rows.add(row)
+        return True
 
     # --- scheduled-pod encoding ---------------------------------------------
 
@@ -863,7 +896,7 @@ _NODE_ARRAYS = [
     "node_label_keys", "node_label_vals", "node_label_num", "node_topo",
     "taint_keys", "taint_vals",
     "taint_effects", "ports", "ports_ip", "image_ids", "image_sizes", "unschedulable",
-    "node_ready",
+    "node_ready", "claim_capacity", "claim_allocated",
 ]
 _POD_ARRAYS = [
     "pod_valid", "pod_node", "pod_ns", "pod_label_keys", "pod_label_vals",

@@ -190,6 +190,22 @@ class PodWrapper:
         )
         return self
 
+    def claim(self, claim_name: str, name: str = "") -> "PodWrapper":
+        """Reference an existing ResourceClaim by object name."""
+        self._pod.spec.resource_claims.append(
+            v1.PodResourceClaim(name=name or claim_name,
+                                resource_claim_name=claim_name)
+        )
+        return self
+
+    def claim_template(self, template_name: str, name: str = "") -> "PodWrapper":
+        """Reference a ResourceClaimTemplate (claim stamped per pod)."""
+        self._pod.spec.resource_claims.append(
+            v1.PodResourceClaim(name=name or template_name,
+                                resource_claim_template_name=template_name)
+        )
+        return self
+
     def nominated_node_name(self, n: str) -> "PodWrapper":
         self._pod.status.nominated_node_name = n
         return self

@@ -263,10 +263,12 @@ class WhatIfEngine:
             for row, name in reversed(encode_order):
                 enc.remove_node(name)
 
+        dra = getattr(self.sched, "dra", None)
         per_fork: List[dict] = []
         for fi, f in enumerate(forks):
             vic: List[Tuple[int, int]] = []
             aff: List[Tuple[int, int]] = []
+            chips: List[int] = []
             for v in f.victims:
                 pr = enc.pod_rows.get(v.uid)
                 nr = enc.node_rows.get(v.spec.node_name)
@@ -274,11 +276,13 @@ class WhatIfEngine:
                     continue  # not encoded (already gone / never bound): no-op
                 vic.append((pr, nr))
                 aff.extend(enc.aff.contributions(v.uid))
+                # DRA: evicting a claim-holding victim releases its chips
+                chips.append(dra.pod_chips(v) if dra is not None else 0)
             dels = [enc.node_rows[n] for n in f.remove_nodes
                     if n in enc.node_rows]
             adds = scratch.get(fi, [])
             per_fork.append({"vic": vic, "aff": aff, "del": dels,
-                             "add": adds})
+                             "add": adds, "chips": chips})
 
         vcap = _pow2(max((len(p["vic"]) for p in per_fork), default=1), 8)
         acap = _pow2(max((len(p["aff"]) for p in per_fork), default=1), 8)
@@ -286,14 +290,22 @@ class WhatIfEngine:
         mcap = (_pow2(max((len(p["add"]) for p in per_fork), default=1), 4)
                 if any_adds else 0)
 
+        # claim-chip release plane only when some victim actually holds
+        # chips: a None field keeps the pre-DRA payload pytree, so existing
+        # compiled variants (and claim-free runs) are untouched
+        any_chips = any(any(p["chips"]) for p in per_fork)
+
         payloads: List[ForkPayload] = []
         views: List[ForkedEncoderView] = []
         added_names: List[Dict[int, str]] = []
         for p in per_fork:
             vic_p = np.full(vcap, -1, dtype=np.int32)
             vic_n = np.zeros(vcap, dtype=np.int32)
+            vic_c = np.zeros(vcap, dtype=np.int32) if any_chips else None
             for i, (pr, nr) in enumerate(p["vic"]):
                 vic_p[i], vic_n[i] = pr, nr
+                if vic_c is not None:
+                    vic_c[i] = p["chips"][i]
             aff_r = np.full(acap, -1, dtype=np.int32)
             aff_v = np.zeros(acap, dtype=np.int32)
             for i, (gr, dv) in enumerate(p["aff"]):
@@ -321,10 +333,12 @@ class WhatIfEngine:
             payloads.append(ForkPayload(
                 vic_pod_rows=vic_p, vic_node_rows=vic_n,
                 aff_rows=aff_r, aff_vals=aff_v, del_rows=del_r,
-                add_rows=add_rows, add_ok=add_ok, add_vals=add_vals))
+                add_rows=add_rows, add_ok=add_ok, add_vals=add_vals,
+                vic_claim_chips=vic_c))
             views.append(ForkedEncoderView(
                 enc, p["vic"], p["del"],
-                [row for row, _ in p["add"]], captured_view))
+                [row for row, _ in p["add"]], captured_view,
+                vic_claim_chips=p["chips"] if any_chips else None))
             added_names.append({row: name for row, name in p["add"]})
         return payloads, views, added_names
 

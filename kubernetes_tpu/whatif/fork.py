@@ -64,6 +64,10 @@ class ForkPayload(NamedTuple):
     add_rows: object = None  # i32[M] | None — scratch rows to activate
     add_ok: object = None  # bool[M] | None
     add_vals: object = None  # tuple[np.ndarray[M, ...]] | None
+    # DRA: chips each victim holds on its host (claim_allocated released on
+    # eviction).  None (the default) keeps the pre-DRA pytree structure, so
+    # claim-free consumers reuse their compiled variants unchanged.
+    vic_claim_chips: object = None  # i32[V] | None
 
 
 def apply_fork(dsnap, p: ForkPayload):
@@ -114,10 +118,15 @@ def apply_fork(dsnap, p: ForkPayload):
     aval = jnp.clip(p.aff_vals, 0, d - 1)
     aff_counts = dsnap.aff_counts.at[arow, aval].add(
         -ok_a.astype(dsnap.aff_counts.dtype))
-    return dataclasses.replace(
-        dsnap, node_valid=node_valid, pod_valid=pod_valid,
-        requested=requested, non_zero_requested=non_zero,
-        aff_counts=aff_counts)
+    out = dict(node_valid=node_valid, pod_valid=pod_valid,
+               requested=requested, non_zero_requested=non_zero,
+               aff_counts=aff_counts)
+    # --- DRA claim release: a victim's allocated chips return to its host
+    # (pads carry chips=0, an exact no-op like the resource deltas) ----------
+    if p.vic_claim_chips is not None:
+        out["claim_allocated"] = dsnap.claim_allocated.at[nrow].add(
+            jnp.where(ok_v, -p.vic_claim_chips, 0))
+    return dataclasses.replace(dsnap, **out)
 
 
 class ForkedEncoderView:
@@ -139,7 +148,8 @@ class ForkedEncoderView:
     def __init__(self, encoder, vic_rows: Sequence[Tuple[int, int]],
                  del_rows: Sequence[int],
                  add_rows: Sequence[int],
-                 add_captured: Optional[Dict[int, dict]] = None):
+                 add_captured: Optional[Dict[int, dict]] = None,
+                 vic_claim_chips: Optional[Sequence[int]] = None):
         self._enc = encoder
         requested = encoder.requested.copy()
         non_zero = encoder.non_zero_requested.copy()
@@ -161,11 +171,19 @@ class ForkedEncoderView:
             pod_valid[pr] = False
         for row in del_rows:
             node_valid[row] = False
+        # DRA: victims release their allocated chips in the mirror too, so
+        # host readers (the gang free-chip slice scan) match the device fork
+        claim_allocated = encoder.claim_allocated
+        if vic_claim_chips is not None and any(vic_claim_chips):
+            claim_allocated = claim_allocated.copy()
+            for (_pr, nr), chips in zip(vic_rows, vic_claim_chips):
+                claim_allocated[nr] -= chips
         self.requested = requested
         self.non_zero_requested = non_zero
         self.pod_valid = pod_valid
         self.node_valid = node_valid
         self.allocatable = allocatable
+        self.claim_allocated = claim_allocated
 
     def __getattr__(self, name):
         return getattr(self._enc, name)
@@ -190,4 +208,7 @@ def stack_payloads(payloads: Sequence[ForkPayload]) -> ForkPayload:
         aff_vals=np.stack([p.aff_vals for p in payloads]),
         del_rows=np.stack([p.del_rows for p in payloads]),
         add_rows=add_rows, add_ok=add_ok, add_vals=add_vals,
+        vic_claim_chips=(
+            None if first.vic_claim_chips is None
+            else np.stack([p.vic_claim_chips for p in payloads])),
     )

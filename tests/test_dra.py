@@ -1,0 +1,686 @@
+"""Dynamic resource allocation: resource.k8s.io API kinds, the DraIndex
+ledger, named-device scheduling end to end (incl. the gang all-or-nothing
+acceptance), whatif claim-plane parity, the crash/chaos battery, the
+claim controller, CLI verbs, and metrics."""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import roundtrips, to_manifest
+from kubernetes_tpu.chaos import (
+    FaultSchedule,
+    ProcessCrash,
+    RetryingStore,
+    crash_schedule,
+)
+from kubernetes_tpu.chaos.faults import (
+    CRASH_MID_CLAIM_COMMIT,
+    CRASH_MID_PROVISION,
+)
+from kubernetes_tpu.cli import Kubectl
+from kubernetes_tpu.dra import DraIndex, ResourceClaimController
+from kubernetes_tpu.dra.api import (
+    CLAIM_PENDING,
+    CLAIM_RESERVED,
+    ATTR_CHIP_INDEX,
+    ATTR_HOST,
+    ATTR_SLICE,
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceClaimTemplate,
+    ResourceSlice,
+    pod_claim_names,
+    stamped_claim_name,
+)
+from kubernetes_tpu.gang import POD_GROUP_LABEL, SLICE_LABEL
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.scheduler import TPUScheduler
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_node, make_pod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _no_sleep(_seconds):
+    pass
+
+
+def mk_class(name="tpu", selectors=None):
+    dc = DeviceClass(selectors=dict(selectors or {}))
+    dc.metadata.name = name
+    return dc
+
+
+def mk_slice(name, node, pool, chips=4):
+    # device names carry the host (several hosts publish into one pool),
+    # so "<pool>/<device>" is unambiguous — the workload generator's idiom
+    sl = ResourceSlice(node_name=node, pool=pool, devices=[
+        Device(name=f"{node}-chip{i}", attributes={
+            ATTR_SLICE: pool, ATTR_HOST: node, ATTR_CHIP_INDEX: str(i),
+        }) for i in range(chips)
+    ])
+    sl.metadata.name = name
+    return sl
+
+
+def mk_claim(name, cls="tpu", count=4, ns="default"):
+    c = ResourceClaim(request=DeviceRequest(device_class_name=cls,
+                                            count=count))
+    c.metadata.name = name
+    c.metadata.namespace = ns
+    return c
+
+
+def _tpu_cluster(n_nodes=4, chips=4, slice_hosts=2, cpu="8"):
+    """n_nodes hosts, SLICE_LABEL s{i//slice_hosts}, one ResourceSlice per
+    host publishing ``chips`` chips into the pool named after the slice."""
+    clock = FakeClock()
+    store = ObjectStore()
+    sched = TPUScheduler(store, batch_size=8, clock=clock, batch_wait=0)
+    store.create("DeviceClass", mk_class())
+    for i in range(n_nodes):
+        pool = f"s{i // slice_hosts}"
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": cpu, "pods": "20"})
+                     .label(SLICE_LABEL, pool).obj())
+        store.create("ResourceSlice",
+                     mk_slice(f"rs-n{i}", f"n{i}", pool, chips))
+    return clock, store, sched
+
+
+# --- L0: API objects, scheme, serialization ----------------------------------
+
+
+def test_dra_kinds_scheme_decode_and_roundtrip():
+    scheme = default_scheme()
+    claim = scheme.decode({
+        "apiVersion": "resource.k8s.io/v1alpha2",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "c", "namespace": "ml"},
+        "spec": {"devices": {"requests": [
+            {"name": "devices", "deviceClassName": "tpu", "count": 4}]}},
+        "status": {"state": "Reserved",
+                   "allocation": {"nodeName": "n0",
+                                  "devices": ["s0/chip0", "s0/chip1"]},
+                   "reservedFor": "pod-uid"},
+    })
+    assert claim.request.count == 4
+    assert claim.request.device_class_name == "tpu"
+    assert claim.state == CLAIM_RESERVED
+    assert claim.allocated_node == "n0"
+    assert claim.allocated_devices == ["s0/chip0", "s0/chip1"]
+    assert claim.reserved_for == "pod-uid"
+    assert roundtrips(claim, scheme)
+    wire = to_manifest(claim, scheme)
+    assert wire["apiVersion"] == "resource.k8s.io/v1alpha2"
+
+    for obj in (mk_class(selectors={ATTR_SLICE: "s0"}),
+                mk_slice("rs-n0", "n0", "s0"),
+                mk_claim("c2")):
+        assert roundtrips(obj, scheme), obj.kind
+    tpl = ResourceClaimTemplate(request=DeviceRequest(
+        device_class_name="tpu", count=2))
+    tpl.metadata.name = "t"
+    tpl.metadata.namespace = "default"
+    assert roundtrips(tpl, scheme)
+
+
+def test_pod_claim_name_resolution():
+    p = (make_pod().name("job-0").uid("job-0")
+         .claim("explicit")
+         .claim_template("tmpl", name="tpu").obj())
+    assert pod_claim_names(p) == [
+        "explicit", stamped_claim_name("job-0", "tpu")]
+    assert stamped_claim_name("job-0", "tpu") == "job-0-tpu"
+    # a malformed entry (neither claim nor template) resolves to None
+    p.spec.resource_claims.append(v1.PodResourceClaim(name="bad"))
+    assert pod_claim_names(p)[-1] is None
+
+
+def test_deviceclass_attribute_matching():
+    dev = Device(name="chip0", attributes={ATTR_SLICE: "s0", ATTR_HOST: "n0"})
+    assert mk_class(selectors={}).matches(dev)
+    assert mk_class(selectors={ATTR_SLICE: "s0"}).matches(dev)
+    assert not mk_class(selectors={ATTR_SLICE: "s1"}).matches(dev)
+
+
+# --- L1: the DraIndex ledger --------------------------------------------------
+
+
+def test_index_inventory_and_allocation_ledger():
+    store = ObjectStore()
+    idx = DraIndex(store)
+    idx.apply_class(mk_class())
+    idx.apply_slice(mk_slice("rs-n0", "n0", "s0", chips=4))
+    assert idx.node_capacity("n0") == 4
+    assert idx.node_allocated("n0") == 0
+    c = mk_claim("c1", count=2)
+    c.allocated_node = "n0"
+    c.allocated_devices = ["s0/chip0", "s0/chip1"]
+    idx.apply_claim(c)
+    assert idx.node_allocated("n0") == 2
+    # idempotent replay (watch redelivery) does not double-count
+    idx.apply_claim(c)
+    assert idx.node_allocated("n0") == 2
+    idx.remove_claim(c.key())
+    assert idx.node_allocated("n0") == 0
+    idx.remove_slice("rs-n0")
+    assert idx.node_capacity("n0") == 0
+
+
+def test_index_reserve_all_or_nothing_rolls_back_partial_assumes():
+    store = ObjectStore()
+    idx = DraIndex(store)
+    idx.apply_class(mk_class())
+    idx.apply_slice(mk_slice("rs-n0", "n0", "s0", chips=4))
+    idx.apply_claim(mk_claim("c1", count=3))
+    idx.apply_claim(mk_claim("c2", count=3))  # 3+3 > 4: second must fail
+    pod = (make_pod().name("p").uid("p").claim("c1").claim("c2").obj())
+    decisions, reason = idx.reserve(pod, "n0")
+    assert decisions is None and "free devices" in reason
+    # the first claim's assume rolled back — nothing leaked
+    assert idx.node_allocated("n0") == 0
+    # a fitting pod then takes named devices deterministically
+    pod2 = make_pod().name("q").uid("q").claim("c1").obj()
+    decisions, reason = idx.reserve(pod2, "n0")
+    assert reason is None
+    [(claim, devices)] = decisions
+    assert claim.metadata.name == "c1"
+    assert devices == ["s0/n0-chip0", "s0/n0-chip1", "s0/n0-chip2"]
+    assert idx.node_allocated("n0") == 3
+    idx.unreserve(pod2)
+    assert idx.node_allocated("n0") == 0
+
+
+def test_index_resolve_unresolvable_shapes():
+    store = ObjectStore()
+    idx = DraIndex(store)
+    missing = make_pod().name("p").uid("p").claim("ghost").obj()
+    assert idx.resolve(missing) == (0, None, False)
+    foreign = mk_claim("c1")
+    foreign.reserved_for = "somebody-else"
+    idx.apply_claim(foreign)
+    assert idx.resolve(
+        make_pod().name("p2").uid("p2").claim("c1").obj())[2] is False
+    # two claims pinned to two different nodes can never co-place
+    a, b = mk_claim("a", count=1), mk_claim("b", count=1)
+    a.allocated_node, b.allocated_node = "n0", "n1"
+    idx.apply_claim(a)
+    idx.apply_claim(b)
+    assert idx.resolve(
+        make_pod().name("p3").uid("p3").claim("a").claim("b").obj()
+    )[2] is False
+
+
+# --- L2: end-to-end named-device scheduling ----------------------------------
+
+
+def test_e2e_pod_binds_with_named_devices_and_metrics():
+    _clock, store, sched = _tpu_cluster(n_nodes=2)
+    store.create("ResourceClaim", mk_claim("c1", count=4))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).claim("c1").obj())
+    before = m.dra_claims_allocated.value(("allocated",))
+    dur0 = m.dra_allocation_duration.count(())
+    stats = sched.run_until_idle()
+    assert stats.scheduled == 1
+    pod = store.get("Pod", "default", "p")
+    assert pod.spec.node_name
+    claim = store.get("ResourceClaim", "default", "c1")
+    assert claim.state == CLAIM_RESERVED
+    assert claim.allocated_node == pod.spec.node_name
+    assert claim.reserved_for == "p"
+    assert len(set(claim.allocated_devices)) == 4
+    pool = f"s{int(pod.spec.node_name[1:]) // 2}"
+    assert all(d.startswith(f"{pool}/") for d in claim.allocated_devices)
+    assert m.dra_claims_allocated.value(("allocated",)) == before + 1
+    assert m.dra_allocation_duration.count(()) == dur0 + 1
+
+
+def test_e2e_allocated_claim_pins_pod_to_its_node():
+    _clock, store, sched = _tpu_cluster(n_nodes=4)
+    c = mk_claim("c1", count=2)
+    c.allocated_node = "n3"
+    c.allocated_devices = ["s1/chip0", "s1/chip1"]
+    c.reserved_for = "p"  # already reserved for this pod (retry shape)
+    store.create("ResourceClaim", c)
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).claim("c1").obj())
+    assert sched.run_until_idle().scheduled == 1
+    assert store.get("Pod", "default", "p").spec.node_name == "n3"
+
+
+def test_e2e_insufficient_chips_unschedulable():
+    _clock, store, sched = _tpu_cluster(n_nodes=1, chips=2)
+    store.create("ResourceClaim", mk_claim("c1", count=4))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).claim("c1").obj())
+    stats = sched.run_until_idle(max_cycles=5)
+    assert stats.scheduled == 0
+    assert store.get("ResourceClaim", "default", "c1").state == CLAIM_PENDING
+
+
+def test_e2e_gang_claims_all_or_nothing_into_one_slice():
+    """THE acceptance scenario: a 2-member gang, each member claiming a
+    full host's 4 chips, lands all-or-nothing in ONE slice — and it is the
+    slice with enough FREE chips (slice s0 is blighted by a pre-existing
+    allocation), every claim Reserved with named non-overlapping chips."""
+    clock, store, sched = _tpu_cluster(n_nodes=4, chips=4, slice_hosts=2)
+    ghost = mk_claim("ghost", count=1)
+    ghost.state = CLAIM_RESERVED
+    ghost.allocated_node = "n0"
+    ghost.allocated_devices = ["s0/n0-chip0"]
+    ghost.reserved_for = "ghost-pod"
+    store.create("ResourceClaim", ghost)
+    store.create("Pod", make_pod().name("ghost-pod").uid("ghost-pod")
+                 .namespace("default").node("n0").claim("ghost").obj())
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name="g", namespace="default"),
+                     min_member=2, schedule_timeout_seconds=30)
+    store.create("PodGroup", pg)
+    for i in range(2):
+        store.create("ResourceClaim", mk_claim(f"g-{i}-tpu", count=4))
+        store.create("Pod", (make_pod().name(f"g-{i}").uid(f"g-{i}")
+                             .namespace("default")
+                             .label(POD_GROUP_LABEL, "g")
+                             .req({"cpu": "1"}).claim(f"g-{i}-tpu").obj()))
+    for _ in range(8):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    nodes = [store.get("Pod", "default", f"g-{i}").spec.node_name
+             for i in range(2)]
+    assert all(nodes), nodes
+    slices = {store.get("Node", "", n).metadata.labels[SLICE_LABEL]
+              for n in nodes}
+    assert slices == {"s1"}  # s0 has only 7 free chips for 8 demanded
+    devices = []
+    for i in range(2):
+        claim = store.get("ResourceClaim", "default", f"g-{i}-tpu")
+        assert claim.state == CLAIM_RESERVED
+        assert claim.reserved_for == f"g-{i}"
+        assert claim.allocated_node == nodes[i]
+        assert len(claim.allocated_devices) == 4
+        devices += claim.allocated_devices
+    assert len(set(devices)) == 8  # no chip handed out twice
+    assert all(d.startswith("s1/") for d in devices)
+
+
+def test_e2e_starved_gang_timeout_releases_all_claims():
+    """A gang that can never fully place times out with ZERO claims left
+    allocated — the members that reserved chips at Permit release them
+    through the unreserve chain atomically."""
+    clock, store, sched = _tpu_cluster(n_nodes=2, chips=4, slice_hosts=2)
+    pg = v1.PodGroup(metadata=v1.ObjectMeta(name="g", namespace="default"),
+                     min_member=3, schedule_timeout_seconds=2)
+    store.create("PodGroup", pg)
+    for i in range(3):  # 3 members × 4 chips > the 8 chips that exist
+        store.create("ResourceClaim", mk_claim(f"g-{i}-tpu", count=4))
+        store.create("Pod", (make_pod().name(f"g-{i}").uid(f"g-{i}")
+                             .namespace("default")
+                             .label(POD_GROUP_LABEL, "g")
+                             .req({"cpu": "1"}).claim(f"g-{i}-tpu").obj()))
+    for _ in range(4):
+        sched.schedule_cycle()
+        clock.advance(0.5)
+    clock.advance(10.0)
+    sched.schedule_cycle()
+    assert len(sched._waiting_binds) == 0
+    for i in range(3):
+        assert not store.get("Pod", "default", f"g-{i}").spec.node_name
+        claim = store.get("ResourceClaim", "default", f"g-{i}-tpu")
+        assert claim.state == CLAIM_PENDING
+        assert not claim.allocated_devices
+    assert sched.dra.node_allocated("n0") == 0
+    assert sched.dra.node_allocated("n1") == 0
+
+
+# --- L3: whatif claim-plane parity -------------------------------------------
+
+
+def test_kfork_claim_planes_vmapped_equals_sequential():
+    """K-fork contract extended to DRA: pending pods carrying claims and a
+    victim holding allocated chips produce identical placements vmapped
+    vs sequential — the claim planes ride every fork shape."""
+    from kubernetes_tpu.whatif import ForkSpec, WhatIfEngine
+
+    _clock, store, sched = _tpu_cluster(n_nodes=4, chips=4, slice_hosts=2)
+    # a bound victim holding a full host of chips
+    vic_claim = mk_claim("vic-tpu", count=4)
+    vic_claim.state = CLAIM_RESERVED
+    vic_claim.allocated_node = "n1"
+    vic_claim.allocated_devices = [f"s0/chip{i}" for i in range(4)]
+    vic_claim.reserved_for = "vic"
+    store.create("ResourceClaim", vic_claim)
+    vic = (make_pod().name("vic").uid("vic").namespace("default")
+           .req({"cpu": "1"}).claim("vic-tpu").node("n1").obj())
+    store.create("Pod", vic)
+    sched.schedule_cycle()  # prime encoder/index state
+    pend = []
+    for i in range(3):
+        store.create("ResourceClaim", mk_claim(f"pend-{i}-tpu", count=4))
+        pend.append(make_pod().name(f"pend-{i}").uid(f"pend-{i}")
+                    .namespace("default").req({"cpu": "1"})
+                    .claim(f"pend-{i}-tpu").obj())
+    engine = WhatIfEngine(sched)
+    forks = [
+        ForkSpec(victims=[vic], note="evict claim holder"),
+        ForkSpec(remove_nodes=["n3"], note="remove"),
+        ForkSpec(victims=[vic], remove_nodes=["n2"], note="mixed"),
+    ]
+    vm = engine.evaluate(pend, forks, vmapped=True)
+    seq = engine.evaluate(pend, forks, vmapped=False)
+    assert len(vm) == len(seq) == len(forks)
+    for a, b in zip(vm, seq):
+        assert a.placements == b.placements, (a.fork.note, a.placements,
+                                              b.placements)
+    # the victim fork actually freed its chips: some fork seats a pod on
+    # the victim's host, which without the release plane could not fit
+    evict_fork = vm[0]
+    assert "n1" in set(evict_fork.placements.values())
+
+
+# --- L4: crash + chaos battery -----------------------------------------------
+
+
+def _two_claim_pod(store):
+    store.create("ResourceClaim", mk_claim("c1", count=2))
+    store.create("ResourceClaim", mk_claim("c2", count=2))
+    store.create("Pod", make_pod().name("p").uid("p").namespace("default")
+                 .req({"cpu": "1"}).claim("c1").claim("c2").obj())
+
+
+def test_crash_mid_claim_commit_retry_completes_exactly_once():
+    """Kill between the two claim commits of one pod: the first claim is
+    durably Reserved, the pod unbound.  A fresh scheduler incarnation plus
+    the claim controller converge — the pod binds to the node the crashed
+    commit pinned, the second claim allocates, nothing double-allocates."""
+    _clock, store, sched = _tpu_cluster(n_nodes=2)
+    _two_claim_pod(store)
+    fault = FaultSchedule(7)
+    fault.arm_crash(CRASH_MID_CLAIM_COMMIT, at_hit=1)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash):
+            sched.run_until_idle(max_cycles=5)
+    assert fault.crashes_fired()
+    c1 = store.get("ResourceClaim", "default", "c1")
+    c2 = store.get("ResourceClaim", "default", "c2")
+    committed = [c for c in (c1, c2) if c.allocated_node]
+    assert len(committed) == 1  # exactly the pre-crash prefix
+    assert not store.get("Pod", "default", "p").spec.node_name
+    # new incarnation (the dead scheduler's memory is gone)
+    sched2 = TPUScheduler(store, batch_size=8, batch_wait=0)
+    ctrl = ResourceClaimController(store, index=sched2.dra)
+    ctrl.sync_once()  # live unbound pod: repair must NOT steal its claim
+    assert store.get("ResourceClaim", "default",
+                     committed[0].metadata.name).allocated_node
+    assert sched2.run_until_idle().scheduled == 1
+    pod = store.get("Pod", "default", "p")
+    devices = []
+    for name in ("c1", "c2"):
+        claim = store.get("ResourceClaim", "default", name)
+        assert claim.state == CLAIM_RESERVED
+        assert claim.allocated_node == pod.spec.node_name
+        assert claim.reserved_for == "p"
+        devices += claim.allocated_devices
+    assert len(set(devices)) == 4  # disjoint named chips, no double-alloc
+    assert ctrl.sync_once() is False  # converged: repair finds nothing
+
+
+def test_crash_mid_claim_commit_dead_pod_repaired_exactly_once():
+    """Same kill, but the consuming pod is deleted before recovery: the
+    repair arm returns the committed claim to Pending exactly once."""
+    _clock, store, sched = _tpu_cluster(n_nodes=2)
+    _two_claim_pod(store)
+    fault = FaultSchedule(7)
+    fault.arm_crash(CRASH_MID_CLAIM_COMMIT, at_hit=1)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash):
+            sched.run_until_idle(max_cycles=5)
+    store.delete("Pod", "default", "p")
+    ctrl = ResourceClaimController(store)
+    assert ctrl.sync_once() is True
+    for name in ("c1", "c2"):
+        claim = store.get("ResourceClaim", "default", name)
+        assert claim.state == CLAIM_PENDING
+        assert not claim.allocated_devices and not claim.reserved_for
+    assert ctrl.sync_once() is False  # second sweep: nothing left to do
+
+
+def test_prebind_terminal_fault_rolls_back_written_claims():
+    """A store fault that outlasts the CAS loop on the SECOND claim rolls
+    back the first claim's allocation — the pod's claims land in the
+    store all-or-nothing, and the retried cycle converges."""
+    from kubernetes_tpu.chaos.faults import TransientApiError
+
+    _clock, store, sched = _tpu_cluster(n_nodes=2)
+
+    class FailSecondClaim:
+        def __init__(self, inner):
+            self._inner = inner
+            self.armed = True
+
+        def update(self, kind, obj, expected_rv=None, **kw):
+            if (self.armed and kind == "ResourceClaim"
+                    and obj.metadata.name == "c2" and obj.allocated_node):
+                self.armed = False
+                raise TransientApiError(429, message="injected storm")
+            return self._inner.update(kind, obj, expected_rv=expected_rv,
+                                      **kw)
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    _two_claim_pod(store)
+    rb0 = m.dra_claims_allocated.value(("rollback",))
+    sched.dra.store = FailSecondClaim(store)
+    for _ in range(10):  # advance past the failed pod's backoff window
+        sched.schedule_cycle()
+        _clock.advance(5.0)
+        if store.get("Pod", "default", "p").spec.node_name:
+            break
+    assert m.dra_claims_allocated.value(("rollback",)) >= rb0 + 1
+    # the retry (fault disarms itself) converges with both claims landed
+    assert store.get("Pod", "default", "p").spec.node_name
+    pod = store.get("Pod", "default", "p")
+    for name in ("c1", "c2"):
+        claim = store.get("ResourceClaim", "default", name)
+        assert claim.allocated_node == pod.spec.node_name
+        assert claim.reserved_for == "p"
+
+
+def test_chaos_storm_every_claim_allocated_exactly_once():
+    """Watch drops + 429/500 storms + CAS conflicts: all claim-carrying
+    pods eventually bind, every claim is owned by exactly its consumer,
+    and no chip is handed to two claims."""
+    fault = FaultSchedule(
+        13, watch_drop_rate=0.15, write_429_rate=0.3, write_500_rate=0.1,
+        conflict_rate=0.15, retry_after=0.0, max_faults_per_key=3,
+    )
+    raw = ObjectStore(fault_injector=fault)
+    store = RetryingStore(raw, sleep=_no_sleep)
+    store.create("DeviceClass", mk_class())
+    for i in range(3):
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "8", "pods": "20"})
+                     .label(SLICE_LABEL, "s0").obj())
+        store.create("ResourceSlice", mk_slice(f"rs-n{i}", f"n{i}", "s0", 4))
+    for i in range(6):  # 6 × 2 chips on 12 chips: tight but feasible
+        store.create("ResourceClaim", mk_claim(f"c{i}", count=2))
+        store.create("Pod", make_pod().name(f"p{i}").uid(f"p{i}")
+                     .namespace("default").req({"cpu": "1"})
+                     .claim(f"c{i}").obj())
+    sched = TPUScheduler(store, batch_size=4, pod_initial_backoff=0.01,
+                         pod_max_backoff=0.05, batch_wait=0)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        sched.run_until_idle(max_cycles=50, backoff_wait=0.5)
+        bound = sum(1 for i in range(6)
+                    if raw.get("Pod", "default", f"p{i}").spec.node_name)
+        if bound == 6:
+            break
+        time.sleep(0.01)
+    assert bound == 6
+    devices_by_node = {}
+    for i in range(6):
+        pod = raw.get("Pod", "default", f"p{i}")
+        claim = raw.get("ResourceClaim", "default", f"c{i}")
+        assert claim.state == CLAIM_RESERVED
+        assert claim.reserved_for == f"p{i}"
+        assert claim.allocated_node == pod.spec.node_name
+        assert len(claim.allocated_devices) == 2
+        devices_by_node.setdefault(claim.allocated_node, []).extend(
+            claim.allocated_devices)
+    for node, devs in devices_by_node.items():
+        assert len(devs) == len(set(devs)), (node, devs)
+        assert len(devs) <= 4
+    assert sum(fault.injected_counts().values()) > 0  # the storm fired
+
+
+def test_crash_mid_provision_cold_start_repair_exactly_once():
+    """Volume twin of the claim-commit kill: the binder dies after the PV
+    claimRef write, before the PVC write.  A cold-start binder's repair
+    arm completes the half-applied binding exactly once."""
+    from kubernetes_tpu.controllers.volumebinder import (
+        PersistentVolumeBinderController,
+    )
+
+    store = ObjectStore()
+    pv = v1.PersistentVolume(capacity={"storage": "10Gi"})
+    pv.metadata.name = "pv0"
+    store.create("PersistentVolume", pv)
+    pvc = v1.PersistentVolumeClaim(requested_storage="5Gi")
+    pvc.metadata.name = "data"
+    pvc.metadata.namespace = "default"
+    store.create("PersistentVolumeClaim", pvc)
+    fault = FaultSchedule(3)
+    fault.arm_crash(CRASH_MID_PROVISION, at_hit=1)
+    with crash_schedule(fault):
+        with pytest.raises(ProcessCrash):
+            PersistentVolumeBinderController(store).sync_once()
+    assert fault.crashes_fired()  # died between the PV and PVC writes
+    # durable state at the kill: the PV side landed, the PVC side did not
+    # (the dead binder's in-memory PVC mutation never reached a commit —
+    # reset the claim side to what the store durably held)
+    assert store.get("PersistentVolume", "", "pv0").claim_ref == \
+        "default/data"
+    dead = store.get("PersistentVolumeClaim", "default", "data")
+    dead.volume_name = ""
+    dead.phase = ""
+    store.update("PersistentVolumeClaim", dead)
+    cold = PersistentVolumeBinderController(store)  # fresh incarnation
+    assert cold.sync_once() is True
+    got = store.get("PersistentVolumeClaim", "default", "data")
+    assert got.volume_name == "pv0" and got.phase == "Bound"
+    assert cold.sync_once() is False  # idempotent: repaired exactly once
+
+
+# --- claim controller: stamping ----------------------------------------------
+
+
+def test_controller_stamps_template_claims_idempotently():
+    store = ObjectStore()
+    tpl = ResourceClaimTemplate(request=DeviceRequest(
+        device_class_name="tpu", count=4))
+    tpl.metadata.name = "tpu-tmpl"
+    tpl.metadata.namespace = "default"
+    store.create("ResourceClaimTemplate", tpl)
+    store.create("Pod", make_pod().name("job-0").uid("job-0")
+                 .namespace("default")
+                 .claim_template("tpu-tmpl", name="tpu").obj())
+    ctrl = ResourceClaimController(store)
+    assert ctrl.sync_once() is True
+    claim = store.get("ResourceClaim", "default", "job-0-tpu")
+    assert claim is not None
+    assert claim.request.count == 4
+    assert claim.request.device_class_name == "tpu"
+    assert ctrl.sync_once() is False  # deterministic name: no duplicate
+    assert len(store.list("ResourceClaim")[0]) == 1
+
+
+def test_e2e_template_stamped_gang_member_schedules():
+    """Template → controller stamp → scheduler resolves the stamped name
+    and allocates: the full TrainingJob-shaped flow."""
+    _clock, store, sched = _tpu_cluster(n_nodes=2)
+    tpl = ResourceClaimTemplate(request=DeviceRequest(
+        device_class_name="tpu", count=4))
+    tpl.metadata.name = "tpu-tmpl"
+    tpl.metadata.namespace = "default"
+    store.create("ResourceClaimTemplate", tpl)
+    store.create("Pod", make_pod().name("job-0").uid("job-0")
+                 .namespace("default").req({"cpu": "1"})
+                 .claim_template("tpu-tmpl", name="tpu").obj())
+    # before the stamp the pod is unresolvable — never partially placed
+    assert sched.run_until_idle(max_cycles=3).scheduled == 0
+    ResourceClaimController(store, index=sched.dra).sync_once()
+    for _ in range(10):  # the claim ADD event requeues; ride out backoff
+        sched.schedule_cycle()
+        _clock.advance(5.0)
+        if store.get("Pod", "default", "job-0").spec.node_name:
+            break
+    assert store.get("Pod", "default", "job-0").spec.node_name
+    claim = store.get("ResourceClaim", "default", "job-0-tpu")
+    assert claim.state == CLAIM_RESERVED
+    assert claim.reserved_for == "job-0"
+    assert len(claim.allocated_devices) == 4
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_dra_verbs():
+    store = ObjectStore()
+    store.create("DeviceClass", mk_class(selectors={ATTR_SLICE: "s0"}))
+    store.create("ResourceSlice", mk_slice("rs-n0", "n0", "s0", 4))
+    claim = mk_claim("job-0-tpu", count=2)
+    claim.state = CLAIM_RESERVED
+    claim.allocated_node = "n0"
+    claim.allocated_devices = ["s0/chip0", "s0/chip1"]
+    store.create("ResourceClaim", claim)
+    store.create("ResourceClaim", mk_claim("idle", count=1))
+    k = Kubectl(store)
+    out = k.get("resourceclaims")
+    assert "NAME" in out and "STATE" in out and "ALLOCATED-DEVICE" in out
+    assert "job-0-tpu" in out and "Reserved" in out
+    assert "s0/chip0,s0/chip1" in out
+    assert "idle" in out and "Pending" in out and "<none>" in out
+    out = k.get("deviceclasses")
+    assert "tpu" in out and "slice=s0" in out
+    out = k.get("resourceslices")
+    assert "rs-n0" in out and "s0" in out and "4" in out
+    wire = k.get_json("resourceclaim", "default", "job-0-tpu")
+    assert '"resource.k8s.io/v1alpha2"' in wire
+
+
+# --- perf plumbing smoke ------------------------------------------------------
+
+
+def test_device_claim_gang_workload_shape():
+    """The DeviceClaimGang suite's generators agree with each other: pod i
+    references claim gangclaim-i, warm pods are singleton gangs pinned to
+    the warm node, and the suite is flagged dra for the harness."""
+    from kubernetes_tpu.perf.workloads import SUITES, build_workload
+
+    w = build_workload("DeviceClaimGang", "64Nodes")
+    assert w.dra is True and w.gang_size
+    assert "DeviceClaimGang" in SUITES
+    op = next(o for o in w.ops if o.opcode == "createPods")
+    pod = op.pod_template(0)
+    assert pod_claim_names(pod) == ["gangclaim-000000"]
+    warm = op.pod_template(9_990_000)
+    assert pod_claim_names(warm) == ["warmclaim-0"]
+    assert warm.spec.node_selector == {"dra-warm": "1"}
+    assert warm.metadata.labels[POD_GROUP_LABEL] == "wg-0"

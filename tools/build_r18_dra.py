@@ -1,0 +1,94 @@
+"""DeviceClaimGang bench artifact → BENCH_r18_DRA.json.
+
+Runs the DeviceClaimGang suite (named TPU-device claims riding the gang
+anchor-slice path: batched claim Filter/Score planes, Reserve picks named
+devices, PreBind CAS-commits allocations) in fresh subprocesses — same
+discipline as tools/build_r12_ab.py — and writes the artifact
+tools/render_perf_docs.py renders into COMPONENTS.md.  The best-throughput
+pass is kept; every pass's pods/s rides along so weather is visible.
+
+Acceptance (ISSUE 18): every gang all-or-nothing with every member's claim
+allocated to named chips in ONE slice, claims/s reported, zero in-window
+compiles (the run_suites.sh gate holds the 5k row to the same bar).
+
+Usage: python tools/build_r18_dra.py [--size SIZE] [--scale F]
+       [--passes N] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUITE = "DeviceClaimGang"
+
+
+def run_pass(size: str, scale: float) -> dict:
+    env = dict(os.environ)
+    env.update(BENCH_SUITE=SUITE, BENCH_SIZE=size, BENCH_ORACLE_SAMPLE="2",
+               BENCH_SCALE=str(scale))
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=3000, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", default="500Nodes")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--passes", type=int, default=2,
+                    help="passes; best-throughput pass is kept (pass 1 "
+                         "also warms the persistent compile cache)")
+    ap.add_argument("--out", default="BENCH_r18_DRA.json")
+    args = ap.parse_args()
+
+    passes = []
+    for i in range(args.passes):
+        passes.append(run_pass(args.size, args.scale))
+        d = passes[-1]["detail"]
+        print(f"pass {i + 1}: {d['throughput_pods_per_s']:.0f} pods/s, "
+              f"{d.get('dra_claims', {}).get('claims_per_s', 0):.0f} "
+              f"claims/s, {d['xla_compiles_in_window']['count']} compiles",
+              file=sys.stderr)
+
+    best = max(passes, key=lambda d: d["detail"]["throughput_pods_per_s"])
+    dd = best["detail"]
+    gang = dd.get("gang") or {}
+    claims = dd.get("dra_claims") or {}
+    assert claims.get("allocated", 0) > 0, "no claims allocated — bad run"
+    assert gang.get("gangs", 0) > 0, "no gangs seated — bad run"
+
+    import jax
+
+    artifact = {
+        "environment": {
+            "backend": jax.default_backend(),
+            "cpus": os.cpu_count(),
+            "note": "all passes in THIS container, fresh subprocess each; "
+                    "best-throughput pass kept (weather moves passes ±2×)",
+        },
+        "suite": SUITE,
+        "size": args.size,
+        "scale": args.scale,
+        "passes_pods_per_s": [
+            p["detail"]["throughput_pods_per_s"] for p in passes],
+        "run": best,
+    }
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"wrote {args.out}: {dd['throughput_pods_per_s']:.0f} pods/s, "
+          f"{gang.get('gangs', 0)} gangs, "
+          f"{claims.get('allocated', 0)} claims allocated "
+          f"({claims.get('claims_per_s', 0):.0f}/s), "
+          f"{dd['xla_compiles_in_window']['count']} in-window compiles",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -494,6 +494,52 @@ def render_r9_100k(ab):
     return "\n".join(lines)
 
 
+R18_BEGIN = ("<!-- GENERATED:PERF:R18DRA:BEGIN (tools/render_perf_docs.py — "
+             "edit BENCH_r18_DRA.json, not this block) -->")
+R18_END = "<!-- GENERATED:PERF:R18DRA:END -->"
+
+
+def render_r18_dra(r18):
+    """DeviceClaimGang artifact block (BENCH_r18_DRA.json, built by
+    tools/build_r18_dra.py): gangs/s, claims/s, time-to-full-slice and the
+    zero-in-window-compile line for the named-device-claim gang suite."""
+    env = r18["environment"]
+    dd = r18["run"]["detail"]
+    att = dd["attempt_ms"]
+    gang = dd.get("gang") or {}
+    claims = dd.get("dra_claims") or {}
+    tfs = gang.get("time_to_full_slice_s") or {}
+
+    def band(vals):
+        return "/".join(f"{v:.0f}" for v in vals)
+
+    lines = [
+        R18_BEGIN,
+        "",
+        f"Environment: `{env['backend']}` backend, {env['cpus']} CPU "
+        f"core(s) — {env['note']}",
+        "",
+        f"| metric ({r18['suite']}/{r18['size']}"
+        + (f" ×{r18['scale']}" if r18.get("scale", 1.0) != 1.0 else "")
+        + ") | value |",
+        "|---|---|",
+        f"| member pods/s (passes) | {dd['throughput_pods_per_s']:.1f} "
+        f"({band(r18['passes_pods_per_s'])}) |",
+        f"| gangs seated / gangs/s | {gang.get('gangs', 0)} / "
+        f"{gang.get('gangs_per_s', 0.0):.2f} |",
+        f"| claims allocated / claims/s | {claims.get('allocated', 0)} / "
+        f"{claims.get('claims_per_s', 0.0):.1f} |",
+        f"| time-to-full-slice p50 / p90 / max | {tfs.get('p50', 0):.3f} / "
+        f"{tfs.get('p90', 0):.3f} / {tfs.get('max', 0):.3f} s |",
+        f"| attempt p50 / p99 | {att['p50']:.0f} / {att['p99']:.0f} ms |",
+        f"| in-window XLA compiles | "
+        f"{int(dd['xla_compiles_in_window']['count'])} |",
+        "",
+        R18_END,
+    ]
+    return "\n".join(lines)
+
+
 def splice(path, block, begin=BEGIN, end=END):
     p = os.path.join(REPO, path)
     text = open(p).read()
@@ -553,6 +599,13 @@ def main() -> int:
     if r16 is not None:
         ok &= splice("COMPONENTS.md", render_r16_replica(r16),
                      R16_BEGIN, R16_END)
+    try:
+        r18 = load_bench("BENCH_r18_DRA.json")
+    except (OSError, json.JSONDecodeError):
+        r18 = None  # pre-round-18 trees have no DRA artifact
+    if r18 is not None:
+        ok &= splice("COMPONENTS.md", render_r18_dra(r18),
+                     R18_BEGIN, R18_END)
     return 0 if ok else 1
 
 

@@ -31,6 +31,13 @@ python tools/analyze.py --report-ownership > thread_ownership_report.json \
 # the expensive suites, same rationale as the analyzer gate above
 JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_gang.py tests/test_permit.py -q \
   || { echo "FAILED: gang test gate" >> suites_run.log; exit 1; }
+# DRA gate: the named-claim battery (exactly-once CAS allocation, gang
+# all-or-nothing co-allocation, whatif claim-plane parity, chaos storms,
+# mid-commit crash recovery) — the DeviceClaimGang suite below is
+# meaningless if claim allocation double-books, leaks, or diverges from
+# the sequential path, so fail fast before any expensive suite runs
+JAX_PLATFORMS=cpu timeout 900 python -m pytest tests/test_dra.py -q -m 'not slow' \
+  || { echo "FAILED: DRA test gate" >> suites_run.log; exit 1; }
 # descheduler gate: the eviction-API + planner-parity + disruption battery
 # is cheap and conclusive — the Defrag suite below is meaningless if the
 # planner's predictions or the PDB gate are broken
@@ -211,6 +218,14 @@ run Unschedulable 5000Nodes/200InitPods
 run SchedulingWithMixedChurn 5000Nodes
 run PreemptionBasic 5000Nodes
 run GangBasic 5000Nodes
+# named-device claims riding the gang path: the claim planes must stay
+# inside the warm program variants (the warm-pool singleton gangs warm the
+# gang+claim shape pre-window), so hold the suite to zero in-window
+# compiles like the other coupled suites
+run DeviceClaimGang 5000Nodes
+gate_zero_compiles DeviceClaimGang
+run StatefulChurn 5000Nodes
+run VolumeZoneSpread 5000Nodes
 run Defrag 5000Nodes
 run AutoscaleGang 5000Nodes
 run SchedulingExtender 500Nodes
