@@ -1,5 +1,11 @@
-"""API object model (reference L0: staging/src/k8s.io/api + apimachinery)."""
+"""API object model (reference L0: staging/src/k8s.io/api + apimachinery).
 
+``api.wire`` is the binary wire codec + per-client content negotiation
+(the protobuf-serializer analogue, round 19) — imported as a module, not
+re-exported names, so the codec surface stays one namespace.
+"""
+
+from . import wire  # noqa: F401
 from .objects import (  # noqa: F401
     Affinity,
     Container,

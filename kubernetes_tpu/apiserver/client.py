@@ -15,6 +15,7 @@ import time
 import urllib.request
 from typing import Callable, List, Optional, Tuple
 
+from ..api import wire
 from ..api.scheme import Scheme, default_scheme
 from ..chaos.retry import backoff_delay
 from ..metrics import scheduler_metrics as m
@@ -30,10 +31,17 @@ class HTTPApiClient:
     def __init__(self, base_url: str, scheme: Optional[Scheme] = None,
                  user: str = "", max_retries: int = 4,
                  retry_backoff: float = 0.05, retry_backoff_max: float = 2.0,
-                 jitter_seed: int = 0):
+                 jitter_seed: int = 0, codec: str = "wire"):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme()
         self.user = user
+        # preferred wire codec, sent as the Accept header; the response's
+        # Content-Type decides the actual decode (negotiation is the
+        # server's call — an old server answering JSON still works, and
+        # errors are always JSON Status bodies), so callers never see the
+        # format: lists return objects, watches return WatchEvents either
+        # way.  "json" opts out (legacy servers, debugging with curl).
+        self.codec = codec if codec in ("wire", "json") else "wire"
         self._watch_threads: List[threading.Thread] = []
         self._stopped = False
         # retrying transport: 429/500/503 are resent after honoring the
@@ -69,16 +77,31 @@ class HTTPApiClient:
         return self.base_url + path + (f"?{query}" if query else "")
 
     def _request(self, method: str, url: str, body: Optional[dict] = None):
-        data = json.dumps(body).encode() if body is not None else None
+        if body is None:
+            data = None
+        elif self.codec == "wire":
+            data = wire.wire_encode(body)
+        else:
+            data = json.dumps(body).encode()
         attempt = 0
         while True:
             req = urllib.request.Request(url, data=data, method=method)
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type",
+                           wire.content_type_for(self.codec)
+                           if data is not None else "application/json")
+            req.add_header("Accept", wire.content_type_for(self.codec))
             if self.user:
                 req.add_header("X-Remote-User", self.user)
             try:
                 with urllib.request.urlopen(req, timeout=10) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    raw = resp.read() or b"{}"
+                    # decode what the server actually sent: a wire doc
+                    # decodes to the same manifest dict json would carry
+                    # (the round-trip parity contract), so callers are
+                    # codec-blind from here on
+                    if wire.is_wire(raw):
+                        return wire.wire_decode(raw)
+                    return json.loads(raw)
             except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
                 if e.code not in RETRYABLE_CODES or attempt >= self.max_retries:
                     raise
@@ -98,10 +121,18 @@ class HTTPApiClient:
 
     # --- the ListerWatcher contract ----------------------------------------
 
+    def _decode_item(self, item):
+        """One LIST item: a binary list embeds each object as its
+        self-contained wire doc (bytes — decoded by the native fast path),
+        a JSON list carries manifest dicts."""
+        if isinstance(item, (bytes, bytearray)):
+            return wire.decode_object(bytes(item), self.scheme)
+        return self.scheme.decode(item)
+
     def list(self, kind: str) -> Tuple[List[object], int]:
         payload = self._request("GET", self._url(kind))
         rv = int(payload.get("metadata", {}).get("resourceVersion", "0"))
-        objs = [self.scheme.decode(m) for m in payload.get("items", [])]
+        objs = [self._decode_item(m) for m in payload.get("items", [])]
         return objs, rv
 
     def list_page(self, kind: str, limit: int = 0,
@@ -119,7 +150,7 @@ class HTTPApiClient:
         payload = self._request("GET", self._url(kind, query=query))
         meta = payload.get("metadata", {})
         rv = int(meta.get("resourceVersion", "0"))
-        objs = [self.scheme.decode(m) for m in payload.get("items", [])]
+        objs = [self._decode_item(m) for m in payload.get("items", [])]
         return objs, rv, meta.get("continue", "")
 
     def for_kind(self, kind: str) -> "_KindClient":
@@ -157,36 +188,62 @@ class HTTPApiClient:
                       f"&allowWatchBookmarks=true",
             )
             req = urllib.request.Request(url)
+            req.add_header("Accept", wire.content_type_for(self.codec))
             if self.user:
                 req.add_header("X-Remote-User", self.user)
+
+            def stream_error(message: str):
+                # in-band stream failure (watch protocol ERROR, e.g. 410
+                # Gone / chaos drop): rv continuity is broken — the
+                # consumer must relist
+                if on_error is not None and not stop.is_set():
+                    from ..chaos.faults import WatchDropped
+
+                    on_error(WatchDropped(message))
+
             try:
                 with urllib.request.urlopen(req, timeout=timeout_seconds + 5) as resp:
-                    for raw in resp:
-                        if stop.is_set():
-                            break
-                        line = raw.strip()
-                        if not line:
-                            continue
-                        ev = json.loads(line)
-                        if ev["type"] == ERROR:
-                            # in-band stream failure (watch protocol ERROR,
-                            # e.g. 410 Gone / chaos drop): rv continuity is
-                            # broken — the consumer must relist
-                            if on_error is not None and not stop.is_set():
-                                from ..chaos.faults import WatchDropped
-
-                                on_error(WatchDropped(
-                                    str((ev.get("object") or {})
-                                        .get("message", "watch ERROR"))))
-                            return
-                        rv = int((ev["object"].get("metadata") or {})
-                                 .get("resourceVersion", "0"))
-                        if ev["type"] == "BOOKMARK":
-                            if on_bookmark is not None:
-                                on_bookmark(rv)
-                            continue
-                        obj = self.scheme.decode(ev["object"])
-                        handler(WatchEvent(ev["type"], kind, obj, rv))
+                    ct = resp.headers.get("Content-Type") or ""
+                    if wire.codec_of_content_type(ct) == "wire":
+                        # binary framing: the rv rides the frame header and
+                        # the object doc takes the native decoder
+                        while not stop.is_set():
+                            frame = wire.read_watch_frame(resp)
+                            if frame is None:
+                                break
+                            ev_type, rv, doc = frame
+                            if ev_type == ERROR:
+                                stream_error(str(
+                                    (wire.wire_decode(doc) or {})
+                                    .get("message", "watch ERROR")))
+                                return
+                            if ev_type == "BOOKMARK":
+                                if on_bookmark is not None:
+                                    on_bookmark(rv)
+                                continue
+                            obj = wire.decode_object(doc, self.scheme)
+                            handler(WatchEvent(ev_type, kind, obj, rv))
+                    else:
+                        for raw in resp:
+                            if stop.is_set():
+                                break
+                            line = raw.strip()
+                            if not line:
+                                continue
+                            ev = json.loads(line)
+                            if ev["type"] == ERROR:
+                                stream_error(str(
+                                    (ev.get("object") or {})
+                                    .get("message", "watch ERROR")))
+                                return
+                            rv = int((ev["object"].get("metadata") or {})
+                                     .get("resourceVersion", "0"))
+                            if ev["type"] == "BOOKMARK":
+                                if on_bookmark is not None:
+                                    on_bookmark(rv)
+                                continue
+                            obj = self.scheme.decode(ev["object"])
+                            handler(WatchEvent(ev["type"], kind, obj, rv))
             except Exception as e:
                 if not stop.is_set():
                     if on_error is not None:

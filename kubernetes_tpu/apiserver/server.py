@@ -51,6 +51,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..api import wire
 from ..api.scheme import Scheme, SchemeError, default_scheme
 from ..api.serialize import to_manifest
 from ..metrics import registry as metrics_registry
@@ -336,15 +337,36 @@ def _make_handler(api: APIServer):
 
         # --- plumbing -------------------------------------------------------
 
-        def _send_json(self, code: int, payload: dict, headers=()):
-            body = json.dumps(payload).encode()
+        def _send_bytes(self, code: int, body: bytes, content_type: str,
+                        headers=()):
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             for k, v in headers:
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_json(self, code: int, payload: dict, headers=()):
+            self._send_bytes(code, json.dumps(payload).encode(),
+                             "application/json", headers=headers)
+
+        def _codec(self) -> str:
+            """Negotiate the response codec from the Accept header (the
+            protobuf-negotiation analog: runtime/negotiate.go) and count
+            the request under it.  Call once per resource request."""
+            codec = wire.negotiate_codec(self.headers.get("Accept"))
+            m.apiserver_wire_requests.inc((codec,))
+            return codec
+
+        def _send_object(self, code: int, obj, codec: str, headers=()):
+            """One object in the negotiated codec, served from its
+            encode-once payload (api.wire.payload_for): the bytes a write
+            response sends are the SAME bytes every watcher was fanned —
+            encoded once per codec per write."""
+            p = wire.payload_for(obj, api.scheme)
+            self._send_bytes(code, p.bytes_for(codec),
+                             wire.content_type_for(codec), headers=headers)
 
         def _status_err(self, code: int, reason: str, message: str,
                         headers=()):
@@ -423,7 +445,14 @@ def _make_handler(api: APIServer):
         def _body(self) -> dict:
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b"{}"
-            return json.loads(raw or b"{}")
+            raw = raw or b"{}"
+            # wire-encoded request body: negotiated via Content-Type, with
+            # a magic-byte sniff as backstop (the magic is not valid UTF-8,
+            # so a JSON body can never be misread as wire)
+            ct = self.headers.get("Content-Type") or ""
+            if wire.WIRE_CONTENT_TYPE in ct or wire.is_wire(raw):
+                return wire.wire_decode(raw)
+            return json.loads(raw)
 
         def _user(self) -> Optional[UserInfo]:
             """Run the authn chain.  None means 401 was already sent.  With
@@ -568,15 +597,16 @@ def _make_handler(api: APIServer):
             if not self._check("watch" if "watch" in q else
                                ("get" if name else "list"), kind, ns):
                 return
+            codec = self._codec()
             if name:
                 obj = api.store.get(kind, ns, name)
                 if obj is None:
                     self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
                     return
-                self._send_json(200, to_manifest(obj, api.scheme))
+                self._send_object(200, obj, codec)
                 return
             if q.get("watch", ["false"])[0] == "true":
-                self._watch(kind, ns, q)
+                self._watch(kind, ns, q, codec)
                 return
             # LIST: served from the watch cache (zero store-lock reads),
             # with rv-consistent limit/continue pagination; a continue
@@ -617,21 +647,36 @@ def _make_handler(api: APIServer):
                     continue
                 if fsel and not _match_field_selector(fsel, o):
                     continue
-                items.append(to_manifest(o, api.scheme))
+                # encode-once: objects at the cache's current rv hit the
+                # payload memo captured at apply time; only rolled-back
+                # pagination snapshots pay a fresh encode
+                items.append(wire.payload_for(o, api.scheme))
             meta = {"resourceVersion": str(rv)}
             if next_token:
                 # like the reference: selectors filter WITHIN the page, so
                 # a page may carry fewer than `limit` items while continue
                 # is still set — clients walk until continue is empty
                 meta["continue"] = next_token
-            self._send_json(200, {
-                "kind": f"{kind}List", "apiVersion": "v1",
-                "metadata": meta,
-                "items": items,
-            })
+            head = {"kind": f"{kind}List", "apiVersion": "v1",
+                    "metadata": meta}
+            if codec == "wire":
+                # each item is embedded as a BYTES value holding the SAME
+                # self-contained wire doc the GET/watch planes serve — the
+                # envelope encode copies bytes, it never re-serializes
+                doc = dict(head)
+                doc["items"] = [p.wire_bytes() for p in items]
+                self._send_bytes(200, wire.wire_encode(doc),
+                                 wire.WIRE_CONTENT_TYPE)
+                return
+            # JSON: splice the cached item bytes verbatim into the
+            # envelope — json.dumps never sees the items
+            body = (json.dumps(head).encode()[:-1] + b', "items": ['
+                    + b", ".join(p.json_bytes() for p in items) + b"]}")
+            self._send_bytes(200, body, "application/json")
 
-        def _watch(self, kind: str, ns: str, q: dict):
-            """Chunked JSON-lines watch stream from a resourceVersion.
+        def _watch(self, kind: str, ns: str, q: dict, codec: str = "json"):
+            """Chunked watch stream from a resourceVersion — JSON lines or
+            length-prefixed binary frames, per the negotiated codec.
 
             ``allowWatchBookmarks=true`` adds periodic BOOKMARK events — an
             otherwise-empty object carrying just the store's current
@@ -678,9 +723,8 @@ def _make_handler(api: APIServer):
             # long-running-request exemption)
             self._flow_release()
 
-            def write_line(payload: dict) -> bool:
-                line = json.dumps(payload).encode() + b"\n"
-                chunk = f"{len(line):X}\r\n".encode() + line + b"\r\n"
+            def write_raw(blob: bytes) -> bool:
+                chunk = f"{len(blob):X}\r\n".encode() + blob + b"\r\n"
                 try:
                     self.wfile.write(chunk)
                     self.wfile.flush()
@@ -689,9 +733,26 @@ def _make_handler(api: APIServer):
                         socket.timeout):
                     return False
 
+            def event_bytes(ev_type: str, payload=None, obj_doc=None,
+                            rv: int = 0) -> bytes:
+                """One watch event in the negotiated codec.  ``payload``
+                (api.wire.EncodedPayload) serves the cached bytes — THE
+                encode-once fan-out: a thousand watchers write the same
+                bytes object.  ``obj_doc`` is for synthetic objects
+                (bookmarks, errors) that have no payload."""
+                if codec == "wire":
+                    body = (payload.wire_bytes() if payload is not None
+                            else wire.wire_encode(obj_doc))
+                    return wire.encode_watch_frame(ev_type, body, rv=rv)
+                body = (payload.json_bytes() if payload is not None
+                        else json.dumps(obj_doc).encode())
+                return (b'{"type": "' + ev_type.encode()
+                        + b'", "object": ' + body + b'}\n')
+
             try:
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type",
+                                 wire.content_type_for(codec))
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 deadline = time.monotonic() + timeout
@@ -719,11 +780,10 @@ def _make_handler(api: APIServer):
                               if api.watch_cache is not None
                               else api.store.current_rv())
                         if not lossy[0] and events.empty():
-                            if not write_line({
-                                "type": "BOOKMARK",
-                                "object": {"kind": kind, "metadata":
-                                           {"resourceVersion": str(rv)}},
-                            }):
+                            if not write_raw(event_bytes(
+                                    "BOOKMARK", rv=rv,
+                                    obj_doc={"kind": kind, "metadata":
+                                             {"resourceVersion": str(rv)}})):
                                 return
                     try:
                         ev = events.get(timeout=min(remain, 0.25))
@@ -737,21 +797,22 @@ def _make_handler(api: APIServer):
                         # protocol stream-failure marker) REPLACES this
                         # event — the client must relist to recover it,
                         # exactly as after a real 410 Gone
-                        if write_line({
-                            "type": ERROR,
-                            "object": {"kind": "Status", "status": "Failure",
-                                       "reason": "Expired",
-                                       "message": "chaos: watch dropped"},
-                        }):
+                        if write_raw(event_bytes(
+                                ERROR,
+                                obj_doc={"kind": "Status",
+                                         "status": "Failure",
+                                         "reason": "Expired",
+                                         "message": "chaos: watch dropped"})):
                             try:  # close the stream cleanly after ERROR
                                 self.wfile.write(b"0\r\n\r\n")
                             except (BrokenPipeError, ConnectionResetError):
                                 pass
                         return
-                    if not write_line({
-                        "type": ev.type,
-                        "object": to_manifest(ev.obj, api.scheme),
-                    }):
+                    # the cache stamped the payload at apply time; events
+                    # from a cache-less store encode on demand (memoized)
+                    p = ev.payload or wire.payload_for(ev.obj, api.scheme)
+                    if not write_raw(event_bytes(ev.type, payload=p,
+                                                 rv=ev.resource_version)):
                         return
                 try:
                     self.wfile.write(b"0\r\n\r\n")
@@ -897,7 +958,9 @@ def _make_handler(api: APIServer):
             except ValueError as e:
                 self._status_err(409, "AlreadyExists", str(e))
                 return
-            self._send_json(201, to_manifest(obj, api.scheme))
+            # the store write already fanned the object through the watch
+            # cache, which captured its payload — this response reuses it
+            self._send_object(201, obj, self._codec())
 
         def _put(self):
             url = urlparse(self.path)
@@ -928,7 +991,7 @@ def _make_handler(api: APIServer):
             if not self._store_update_rv(kind, obj,
                                          None if rv in (None, "") else rv):
                 return
-            self._send_json(200, to_manifest(obj, api.scheme))
+            self._send_object(200, obj, self._codec())
 
         def _store_update_rv(self, kind, obj, rv) -> bool:
             """Write through the store with ``rv`` (when not None) as an
@@ -999,7 +1062,7 @@ def _make_handler(api: APIServer):
                 except KeyError:
                     self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
                     return
-                self._send_json(200, to_manifest(obj, api.scheme))
+                self._send_object(200, obj, self._codec())
                 return
             self._status_err(
                 409, "Conflict",
@@ -1030,7 +1093,7 @@ def _make_handler(api: APIServer):
                 return
             # the deleted object's final state, as the reference apiserver
             # returns it (clients needing only confirmation ignore the body)
-            self._send_json(200, to_manifest(obj, api.scheme))
+            self._send_object(200, obj, self._codec())
 
     return Handler
 

@@ -121,8 +121,13 @@ def watch_churn_soak(n_watchers: int = 1000, n_objects: int = 100,
       - ``resync_ratio`` stays ~flat (< 3): resuming a watcher from a
         bookmark-fresh rv costs ring replay of its GAP — the same wall
         time at 10× the objects — never an O(objects) relist;
-      - every watcher saw every churn event (no fan-out loss).
+      - every watcher saw every churn event (no fan-out loss);
+      - ``encodes_per_event`` ~1 (round 19): every watcher pulls the
+        event's serialized bytes, but the encode-once payload means the
+        whole fan-out costs ONE json encode per event, not n_watchers.
     """
+    from ..api import wire  # noqa: F401 — payload plumbing under test
+    from ..metrics import scheduler_metrics as m
     from ..sim.store import ObjectStore
     from ..sim.watchcache import WatchCache
     from ..testutil import make_pod
@@ -142,10 +147,13 @@ def watch_churn_soak(n_watchers: int = 1000, n_objects: int = 100,
     def handler_for(i):
         def h(ev):
             counts[i] += 1
+            if ev.payload is not None:
+                ev.payload.json_bytes()  # serve bytes, as HTTP fan-out does
         return h
 
     unwatchers = [cache.watch(handler_for(i), since_rv=start_rv)
                   for i in range(n_watchers)]
+    encodes0 = m.apiserver_wire_encode.value(("json", "false"))
 
     def measure_resync() -> float:
         """Median-free total: ``resyncs`` watcher resumes from an rv
@@ -191,4 +199,8 @@ def watch_churn_soak(n_watchers: int = 1000, n_objects: int = 100,
         "resync_ratio": (big_resync / small_resync
                          if small_resync > 0 else 0.0),
         "store_read_ops_delta": small_reads + big_reads,
+        "json_encodes_delta": m.apiserver_wire_encode.value(
+            ("json", "false")) - encodes0,
+        "encodes_per_event": (m.apiserver_wire_encode.value(
+            ("json", "false")) - encodes0) / max(expected, 1),
     }

@@ -723,6 +723,23 @@ class Kubectl:
                     if n == "replication_ship_errors_total" and lab}
         for reason in sorted(ship_err):
             rows.append(["ship-errors", reason, f"{ship_err[reason]:g}"])
+        # --- wire block: per-codec negotiation counts + the encode-once
+        # cache's hit rate (apiserver_wire_encode_total{codec,cached} —
+        # hits are bytes served without a serialization; a healthy
+        # thousand-watcher plane runs near 1.0)
+        requests = {lab[0]: v for (n, lab), v in metrics.items()
+                    if n == "apiserver_wire_requests_total" and lab}
+        for codec in sorted(requests):
+            rows.append(["wire", f"requests-{codec}",
+                         f"{requests[codec]:g}"])
+        if not requests:
+            rows.append(["wire", "requests", "0"])
+        encodes = {lab: v for (n, lab), v in metrics.items()
+                   if n == "apiserver_wire_encode_total" and len(lab) == 2}
+        hits = sum(v for lab, v in encodes.items() if lab[1] == "true")
+        total = sum(encodes.values())
+        rows.append(["wire", "encode-cache-hit-rate",
+                     f"{hits / total:.3f}" if total else "n/a"])
         return _render_table(rows)
 
     # --- slice fragmentation view ---------------------------------------------

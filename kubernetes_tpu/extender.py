@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from .api import objects as v1
+from .api import wire
 from .metrics import scheduler_metrics as m
 
 
@@ -583,22 +584,17 @@ class HTTPExtender:
 
 
 def _pod_to_dict(pod: v1.Pod) -> dict:
-    """Serialized form cached per pod object: one scheduling round calls
-    filter AND prioritize for the same pod (2 serializations), and a pod
-    deferred across rounds repeats both.  The cache key is
-    (resourceVersion, nodeName): the sim store bumps resourceVersion on
-    every update, so in-place mutations that went through the store
-    invalidate; nodeName covers the bind subresource path."""
+    """Serialized form memoized per pod object via the shared encode memo
+    (api.wire.memo_encode — the one mechanism the watch cache, WAL, and
+    HTTP planes use): one scheduling round calls filter AND prioritize for
+    the same pod (2 serializations), and a pod deferred across rounds
+    repeats both.  The key is (resourceVersion, nodeName): the sim store
+    bumps resourceVersion on every update, so in-place mutations that went
+    through the store invalidate; nodeName covers the bind subresource
+    path."""
     key = (pod.metadata.resource_version, pod.spec.node_name)
-    cached = getattr(pod, "_extender_dict", None)
-    if cached is not None and cached[0] == key:
-        return cached[1]
-    d = _pod_to_dict_uncached(pod)
-    try:
-        pod._extender_dict = (key, d)
-    except (AttributeError, TypeError):
-        pass  # __slots__/frozen pod stand-ins can't carry the cache
-    return d
+    return wire.memo_encode(pod, "_extender_dict", key,
+                            lambda: _pod_to_dict_uncached(pod))
 
 
 def _node_to_dict(node) -> dict:
@@ -618,21 +614,14 @@ def _node_to_dict(node) -> dict:
 
 
 def _pod_to_json(pod: v1.Pod) -> bytes:
-    """json.dumps(_pod_to_dict(pod)) cached per (resourceVersion, nodeName)
-    — one round calls filter AND prioritize for the same pod, and a pod
-    deferred across rounds repeats both; at ~1KB of JSON per encode the
-    re-serialization was a measured slice of the single-core extender
-    suite's wall."""
+    """json.dumps(_pod_to_dict(pod)) memoized per (resourceVersion,
+    nodeName) through the shared encode memo — one round calls filter AND
+    prioritize for the same pod, and a pod deferred across rounds repeats
+    both; at ~1KB of JSON per encode the re-serialization was a measured
+    slice of the single-core extender suite's wall."""
     key = (pod.metadata.resource_version, pod.spec.node_name)
-    cached = getattr(pod, "_extender_json", None)
-    if cached is not None and cached[0] == key:
-        return cached[1]
-    data = json.dumps(_pod_to_dict(pod)).encode()
-    try:
-        pod._extender_json = (key, data)
-    except (AttributeError, TypeError):
-        pass
-    return data
+    return wire.memo_encode(pod, "_extender_json", key,
+                            lambda: json.dumps(_pod_to_dict(pod)).encode())
 
 
 def _pod_to_dict_uncached(pod: v1.Pod) -> dict:

@@ -242,6 +242,23 @@ wal_last_fsync_rv = default_registry.register(
     Gauge("wal_last_fsync_rv",
           "Highest resourceVersion known fsynced to the WAL")
 )
+apiserver_wire_encode = default_registry.register(
+    # labels: (codec, cached) — codec "json" | "wire", cached "true" |
+    # "false".  Incremented by api/wire.py EncodedPayload every time a
+    # serving plane asks for an object's encoded bytes: cached="false" is
+    # a real serialization, cached="true" a byte-cache hit.  The
+    # encode-once contract is the ratio: at N watchers per event, total
+    # increments ≈ N per codec but cached="false" stays ≈ 1.
+    Counter("apiserver_wire_encode_total",
+            "Encoded-payload requests by codec and cache outcome")
+)
+apiserver_wire_requests = default_registry.register(
+    # labels: (codec,) — "json" | "wire": list/get/watch requests served
+    # in each negotiated content type (Accept-header negotiation,
+    # apiserver/server.py)
+    Counter("apiserver_wire_requests_total",
+            "API requests served, by negotiated wire codec")
+)
 watch_cache_ring_occupancy = default_registry.register(
     Gauge("watch_cache_ring_occupancy",
           "Events currently held in the watch cache ring")

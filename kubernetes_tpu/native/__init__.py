@@ -149,6 +149,55 @@ class NativeInterner:
 
 
 
+_wire_lock = threading.Lock()
+# one-shot cell guarded by _wire_lock; a dict (mutated, never rebound) so
+# the first caller may arrive on any thread
+_wire_state = {"tried": False, "mod": None}
+
+
+def load_wire_codec():
+    """CPython-extension wire codec (api/wire.py's fast path, a full
+    extension module rather than a ctypes kernel — it builds Python objects
+    directly).  Compiled with the interpreter's own headers on first use,
+    cached next to the source; returns the raw module (api/wire.py calls
+    its setup()).  None without a toolchain or under KTPU_NO_NATIVE —
+    api/wire.py's pure-Python codec is the parity oracle and serves every
+    call byte-identically."""
+    with _wire_lock:
+        if _wire_state["tried"]:
+            return _wire_state["mod"]
+        _wire_state["tried"] = True
+        if os.environ.get("KTPU_NO_NATIVE"):
+            return None
+        try:
+            import sysconfig
+
+            src = os.path.join(_HERE, "wire_codec.cpp")
+            so = os.path.join(_HERE, "_wire_codec.so")
+            if not os.path.exists(so) or (
+                os.path.getmtime(so) < os.path.getmtime(src)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC",
+                     f"-I{sysconfig.get_paths()['include']}",
+                     "-o", so, src],
+                    check=True, capture_output=True, timeout=180,
+                )
+            from importlib.machinery import ExtensionFileLoader
+            from importlib.util import module_from_spec, spec_from_file_location
+
+            loader = ExtensionFileLoader("ktpu_wire_codec", so)
+            spec = spec_from_file_location("ktpu_wire_codec", so,
+                                           loader=loader)
+            mod = module_from_spec(spec)
+            loader.exec_module(mod)
+            _wire_state["mod"] = mod
+        # ktpu-analysis: ignore[exception-hygiene] -- best-effort capability probe: no compiler/headers is a SUPPORTED configuration; api/wire.py falls back to the pure-python codec, which stays the parity oracle
+        except Exception:
+            _wire_state["mod"] = None
+        return _wire_state["mod"]
+
+
 def _configure_preempt_sweep(lib: ctypes.CDLL) -> None:
     i64p = ctypes.POINTER(ctypes.c_int64)
     u8p = ctypes.POINTER(ctypes.c_uint8)

@@ -1,0 +1,1888 @@
+// CPython extension: the wire v1 codec's fast path (api/wire.py owns the
+// format spec and the pure-Python reference implementation — byte parity
+// between the two backends is pinned by tests/test_wire.py).
+//
+// Three layers, all emitting the identical byte stream:
+//   encode_value / decode_value — generic manifest-dict <-> wire document
+//   encode_pod / encode_node    — object -> wire document DIRECTLY (no
+//       intermediate to_manifest dict); returns None ("bail") for any shape
+//       outside the fast subset, and the caller falls back to the reference
+//       path.  A bail is always safe: it defers to the reference encoder.
+//   decode_object               — wire document -> typed Pod/Node via
+//       __new__ + __dict__ fill, honoring every from_dict quirk (uid/now
+//       factories, namespace "default", resourceVersion dropped, Node
+//       allocatable copying capacity); returns None to bail to the
+//       scheme.decode(wire_decode(...)) reference path.
+//
+// Built by native.load_wire_codec() with g++ against the interpreter's own
+// headers; absent a toolchain (or under KTPU_NO_NATIVE) api/wire.py serves
+// every call from the Python codec.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---- wire format constants (mirror api/wire.py; v1 is frozen) --------------
+
+static const char WIRE_HEADER[4] = {'\xd7', 'K', 'W', '\x01'};
+
+enum {
+    T_NULL = 0x00, T_FALSE = 0x01, T_TRUE = 0x02,
+    T_INT = 0x03, T_NINT = 0x04, T_FLOAT = 0x05,
+    T_STR = 0x06, T_STRREF = 0x07, T_STRWK = 0x08,
+    T_LIST = 0x09, T_MAP = 0x0a, T_BYTES = 0x0b,
+};
+
+static const int MAX_DEPTH = 200;
+
+// ---- interned names: one table drives wire keys, getattr, and __dict__ -----
+
+#define WIRE_NAMES(X) \
+    /* wire keys + literals (camelCase / values) */ \
+    X(kind, "kind") X(apiVersion, "apiVersion") X(metadata, "metadata") \
+    X(name, "name") X(k_namespace, "namespace") X(uid, "uid") \
+    X(labels, "labels") X(annotations, "annotations") \
+    X(resourceVersion, "resourceVersion") \
+    X(creationTimestamp, "creationTimestamp") \
+    X(deletionTimestamp, "deletionTimestamp") \
+    X(ownerReferences, "ownerReferences") \
+    X(spec, "spec") X(status, "status") \
+    X(containers, "containers") X(initContainers, "initContainers") \
+    X(image, "image") X(resources, "resources") X(requests, "requests") \
+    X(limits, "limits") X(ports, "ports") \
+    X(containerPort, "containerPort") X(hostPort, "hostPort") \
+    X(hostIP, "hostIP") X(protocol, "protocol") \
+    X(nodeName, "nodeName") X(nodeSelector, "nodeSelector") \
+    X(affinity, "affinity") X(tolerations, "tolerations") \
+    X(priority, "priority") X(priorityClassName, "priorityClassName") \
+    X(schedulerName, "schedulerName") \
+    X(topologySpreadConstraints, "topologySpreadConstraints") \
+    X(overhead, "overhead") X(volumes, "volumes") \
+    X(hostNetwork, "hostNetwork") X(preemptionPolicy, "preemptionPolicy") \
+    X(resourceClaims, "resourceClaims") \
+    X(phase, "phase") X(nominatedNodeName, "nominatedNodeName") \
+    X(conditions, "conditions") X(podIP, "podIP") \
+    X(capacity, "capacity") X(allocatable, "allocatable") \
+    X(images, "images") X(names, "names") X(sizeBytes, "sizeBytes") \
+    X(volumesAttached, "volumesAttached") \
+    X(unschedulable, "unschedulable") X(taints, "taints") \
+    X(podCIDR, "podCIDR") X(key, "key") X(value, "value") \
+    X(effect, "effect") X(timeAdded, "timeAdded") \
+    X(v_Pod, "Pod") X(v_Node, "Node") X(v_v1, "v1") \
+    X(v_default, "default") X(v_default_scheduler, "default-scheduler") \
+    X(v_Pending, "Pending") X(v_PreemptLowerPriority, "PreemptLowerPriority") \
+    X(v_TCP, "TCP") X(v_NoSchedule, "NoSchedule") \
+    /* snake_case attribute names (getattr on encode, __dict__ on decode) */ \
+    X(a_metadata, "metadata") X(a_spec, "spec") X(a_status, "status") \
+    X(a_name, "name") X(a_namespace, "namespace") X(a_uid, "uid") \
+    X(a_labels, "labels") X(a_annotations, "annotations") \
+    X(a_resource_version, "resource_version") \
+    X(a_creation_timestamp, "creation_timestamp") \
+    X(a_deletion_timestamp, "deletion_timestamp") \
+    X(a_owner_references, "owner_references") \
+    X(a_containers, "containers") X(a_init_containers, "init_containers") \
+    X(a_node_name, "node_name") X(a_node_selector, "node_selector") \
+    X(a_affinity, "affinity") X(a_tolerations, "tolerations") \
+    X(a_priority, "priority") \
+    X(a_priority_class_name, "priority_class_name") \
+    X(a_scheduler_name, "scheduler_name") \
+    X(a_topology_spread_constraints, "topology_spread_constraints") \
+    X(a_overhead, "overhead") X(a_volumes, "volumes") \
+    X(a_host_network, "host_network") \
+    X(a_preemption_policy, "preemption_policy") \
+    X(a_resource_claims, "resource_claims") \
+    X(a_phase, "phase") X(a_nominated_node_name, "nominated_node_name") \
+    X(a_conditions, "conditions") X(a_pod_ip, "pod_ip") \
+    X(a_image, "image") X(a_resources, "resources") X(a_ports, "ports") \
+    X(a_requests, "requests") X(a_limits, "limits") \
+    X(a_container_port, "container_port") X(a_host_port, "host_port") \
+    X(a_host_ip, "host_ip") X(a_protocol, "protocol") \
+    X(a_unschedulable, "unschedulable") X(a_taints, "taints") \
+    X(a_pod_cidr, "pod_cidr") \
+    X(a_capacity, "capacity") X(a_allocatable, "allocatable") \
+    X(a_images, "images") X(a_volumes_attached, "volumes_attached") \
+    X(a_names, "names") X(a_size_bytes, "size_bytes") \
+    X(a_key, "key") X(a_value, "value") X(a_effect, "effect") \
+    X(a_time_added, "time_added")
+
+enum {
+#define X(id, s) N_##id,
+    WIRE_NAMES(X)
+#undef X
+    N_COUNT
+};
+
+static const char* const NAME_STRS[N_COUNT] = {
+#define X(id, s) s,
+    WIRE_NAMES(X)
+#undef X
+};
+
+static PyObject* g_name_py[N_COUNT];
+static int32_t g_name_wk[N_COUNT];
+
+// ---- module state handed over by api/wire.py setup() ------------------------
+
+static std::unordered_map<std::string, uint32_t>* g_wk = nullptr;
+static std::vector<PyObject*>* g_wk_strs = nullptr;
+
+static PyObject* g_WireError = nullptr;
+static PyObject* g_object_new = nullptr;
+static PyObject* g_new_uid = nullptr;
+static PyObject* g_now = nullptr;
+static PyObject* g_cls_Pod = nullptr;
+static PyObject* g_cls_ObjectMeta = nullptr;
+static PyObject* g_cls_PodSpec = nullptr;
+static PyObject* g_cls_PodStatus = nullptr;
+static PyObject* g_cls_Container = nullptr;
+static PyObject* g_cls_RR = nullptr;
+static PyObject* g_cls_ContainerPort = nullptr;
+static PyObject* g_cls_Node = nullptr;
+static PyObject* g_cls_NodeSpec = nullptr;
+static PyObject* g_cls_NodeStatus = nullptr;
+static PyObject* g_cls_Taint = nullptr;
+static PyObject* g_cls_ContainerImage = nullptr;
+static int g_ready = 0;
+
+// ---- encode buffer ----------------------------------------------------------
+
+struct Buf {
+    std::string s;
+    void u8(uint8_t b) { s.push_back((char)b); }
+    void raw(const char* p, size_t n) { s.append(p, n); }
+    void uvarint(uint64_t n) {
+        while (true) {
+            uint8_t b = n & 0x7f;
+            n >>= 7;
+            if (n) { s.push_back((char)(b | 0x80)); } else { s.push_back((char)b); return; }
+        }
+    }
+};
+
+typedef std::unordered_map<std::string, uint32_t> StrTable;
+
+static void emit_str_raw(Buf& b, StrTable& t, const char* u, Py_ssize_t len) {
+    std::string key(u, (size_t)len);
+    auto wk = g_wk->find(key);
+    if (wk != g_wk->end()) { b.u8(T_STRWK); b.uvarint(wk->second); return; }
+    auto it = t.find(key);
+    if (it != t.end()) { b.u8(T_STRREF); b.uvarint(it->second); return; }
+    uint32_t slot = (uint32_t)t.size();
+    t.emplace(std::move(key), slot);
+    b.u8(T_STR); b.uvarint((uint64_t)len); b.raw(u, (size_t)len);
+}
+
+// well-known name emit: one byte-ish, no hashing (indices cached at setup)
+static void emit_name(Buf& b, StrTable& t, int idx) {
+    int32_t wk = g_name_wk[idx];
+    if (wk >= 0) { b.u8(T_STRWK); b.uvarint((uint32_t)wk); return; }
+    emit_str_raw(b, t, NAME_STRS[idx], (Py_ssize_t)strlen(NAME_STRS[idx]));
+}
+
+// ---- generic value encoder (parity with api/wire.py _encode_value) ----------
+
+static int enc_value(PyObject* v, Buf& b, StrTable& t, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "wire value nests too deeply");
+        return -1;
+    }
+    if (v == Py_None) { b.u8(T_NULL); return 0; }
+    if (PyBool_Check(v)) { b.u8(v == Py_True ? T_TRUE : T_FALSE); return 0; }
+    if (PyUnicode_Check(v)) {
+        Py_ssize_t len;
+        const char* u = PyUnicode_AsUTF8AndSize(v, &len);
+        if (!u) return -1;
+        emit_str_raw(b, t, u, len);
+        return 0;
+    }
+    if (PyLong_Check(v)) {
+        int overflow;
+        long long llv = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (llv == -1 && !overflow && PyErr_Occurred()) return -1;
+        if (!overflow) {
+            if (llv >= 0) { b.u8(T_INT); b.uvarint((uint64_t)llv); }
+            else { b.u8(T_NINT); b.uvarint(~(uint64_t)llv); }  // -1-x == ~x
+            return 0;
+        }
+        if (overflow > 0) {
+            unsigned long long ull = PyLong_AsUnsignedLongLong(v);
+            if (ull == (unsigned long long)-1 && PyErr_Occurred()) return -1;
+            b.u8(T_INT); b.uvarint(ull);
+            return 0;
+        }
+        PyErr_SetString(PyExc_OverflowError,
+                        "int exceeds wire v1's 64-bit range");
+        return -1;
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        char be[8];
+        for (int i = 0; i < 8; i++) be[i] = (char)(bits >> (56 - 8 * i));
+        b.u8(T_FLOAT); b.raw(be, 8);
+        return 0;
+    }
+    if (PyBytes_Check(v)) {
+        b.u8(T_BYTES);
+        b.uvarint((uint64_t)PyBytes_GET_SIZE(v));
+        b.raw(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+        return 0;
+    }
+    if (PyByteArray_Check(v)) {
+        b.u8(T_BYTES);
+        b.uvarint((uint64_t)PyByteArray_GET_SIZE(v));
+        b.raw(PyByteArray_AS_STRING(v), (size_t)PyByteArray_GET_SIZE(v));
+        return 0;
+    }
+    if (PyList_Check(v) || PyTuple_Check(v)) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+        b.u8(T_LIST); b.uvarint((uint64_t)n);
+        PyObject** items = PySequence_Fast_ITEMS(v);
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (enc_value(items[i], b, t, depth + 1) < 0) return -1;
+        return 0;
+    }
+    if (PyDict_Check(v)) {
+        b.u8(T_MAP); b.uvarint((uint64_t)PyDict_GET_SIZE(v));
+        PyObject *key, *val;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(v, &pos, &key, &val)) {
+            if (!PyUnicode_Check(key)) {
+                PyErr_Format(PyExc_ValueError,
+                             "map keys must be strings, got %s",
+                             Py_TYPE(key)->tp_name);
+                return -1;
+            }
+            if (enc_value(key, b, t, depth + 1) < 0) return -1;
+            if (enc_value(val, b, t, depth + 1) < 0) return -1;
+        }
+        return 0;
+    }
+    PyErr_Format(PyExc_TypeError, "unencodable type %s", Py_TYPE(v)->tp_name);
+    return -1;
+}
+
+// ---- generic strict decoder (parity with api/wire.py _decode_value) ---------
+
+struct Dec {
+    const uint8_t* d;
+    Py_ssize_t n;
+    Py_ssize_t pos;
+    std::vector<PyObject*> table;  // owned refs, released by dec_free
+};
+
+static void dec_free(Dec& c) {
+    for (PyObject* s : c.table) Py_DECREF(s);
+    c.table.clear();
+}
+
+static int rd_uvarint(Dec& c, uint64_t* out) {
+    int shift = 0;
+    uint64_t n = 0;
+    while (true) {
+        if (c.pos >= c.n) {
+            PyErr_SetString(g_WireError, "truncated varint");
+            return -1;
+        }
+        uint8_t b = c.d[c.pos++];
+        n |= (uint64_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) { *out = n; return 0; }
+        shift += 7;
+        if (shift > 63) {
+            PyErr_SetString(g_WireError, "varint exceeds 64 bits");
+            return -1;
+        }
+    }
+}
+
+static PyObject* dec_value(Dec& c, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_SetString(g_WireError, "wire document nests too deeply");
+        return NULL;
+    }
+    if (c.pos >= c.n) {
+        PyErr_SetString(g_WireError, "truncated document");
+        return NULL;
+    }
+    uint8_t tag = c.d[c.pos++];
+    uint64_t u;
+    switch (tag) {
+    case T_NULL: Py_RETURN_NONE;
+    case T_FALSE: Py_RETURN_FALSE;
+    case T_TRUE: Py_RETURN_TRUE;
+    case T_INT:
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        return PyLong_FromUnsignedLongLong(u);
+    case T_NINT: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        if (u < (uint64_t)1 << 63)
+            return PyLong_FromLongLong(-1 - (long long)u);
+        PyObject* mag = PyLong_FromUnsignedLongLong(u);
+        if (!mag) return NULL;
+        PyObject* one = PyLong_FromLong(1);
+        PyObject* tmp = PyNumber_Add(mag, one);
+        Py_DECREF(mag); Py_DECREF(one);
+        if (!tmp) return NULL;
+        PyObject* out = PyNumber_Negative(tmp);
+        Py_DECREF(tmp);
+        return out;
+    }
+    case T_FLOAT: {
+        if (c.pos + 8 > c.n) {
+            PyErr_SetString(g_WireError, "truncated float");
+            return NULL;
+        }
+        uint64_t bits = 0;
+        for (int i = 0; i < 8; i++) bits = (bits << 8) | c.d[c.pos + i];
+        c.pos += 8;
+        double dv;
+        memcpy(&dv, &bits, 8);
+        return PyFloat_FromDouble(dv);
+    }
+    case T_STR: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        if (c.pos + (Py_ssize_t)u > c.n || (Py_ssize_t)u < 0) {
+            PyErr_SetString(g_WireError, "truncated string");
+            return NULL;
+        }
+        PyObject* s = PyUnicode_DecodeUTF8(
+            (const char*)c.d + c.pos, (Py_ssize_t)u, NULL);
+        c.pos += (Py_ssize_t)u;
+        if (!s) {
+            PyObject *et, *ev, *tb;
+            PyErr_Fetch(&et, &ev, &tb);
+            PyErr_Format(g_WireError, "invalid utf-8 in string");
+            Py_XDECREF(et); Py_XDECREF(ev); Py_XDECREF(tb);
+            return NULL;
+        }
+        Py_INCREF(s);
+        c.table.push_back(s);
+        return s;
+    }
+    case T_STRREF: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        if (u >= c.table.size()) {
+            PyErr_Format(g_WireError,
+                         "string back-ref %llu out of range",
+                         (unsigned long long)u);
+            return NULL;
+        }
+        PyObject* s = c.table[(size_t)u];
+        Py_INCREF(s);
+        return s;
+    }
+    case T_STRWK: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        if (u >= g_wk_strs->size()) {
+            PyErr_Format(g_WireError,
+                         "well-known index %llu out of range",
+                         (unsigned long long)u);
+            return NULL;
+        }
+        PyObject* s = (*g_wk_strs)[(size_t)u];
+        Py_INCREF(s);
+        return s;
+    }
+    case T_BYTES: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        if (c.pos + (Py_ssize_t)u > c.n || (Py_ssize_t)u < 0) {
+            PyErr_SetString(g_WireError, "truncated bytes");
+            return NULL;
+        }
+        PyObject* b = PyBytes_FromStringAndSize(
+            (const char*)c.d + c.pos, (Py_ssize_t)u);
+        c.pos += (Py_ssize_t)u;
+        return b;
+    }
+    case T_LIST: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        PyObject* out = PyList_New(0);
+        if (!out) return NULL;
+        for (uint64_t i = 0; i < u; i++) {
+            PyObject* item = dec_value(c, depth + 1);
+            if (!item || PyList_Append(out, item) < 0) {
+                Py_XDECREF(item); Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(item);
+        }
+        return out;
+    }
+    case T_MAP: {
+        if (rd_uvarint(c, &u) < 0) return NULL;
+        PyObject* out = PyDict_New();
+        if (!out) return NULL;
+        for (uint64_t i = 0; i < u; i++) {
+            PyObject* k = dec_value(c, depth + 1);
+            if (!k) { Py_DECREF(out); return NULL; }
+            if (!PyUnicode_Check(k)) {
+                PyErr_SetString(g_WireError, "map key is not a string");
+                Py_DECREF(k); Py_DECREF(out);
+                return NULL;
+            }
+            PyObject* v = dec_value(c, depth + 1);
+            if (!v || PyDict_SetItem(out, k, v) < 0) {
+                Py_DECREF(k); Py_XDECREF(v); Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(k); Py_DECREF(v);
+        }
+        return out;
+    }
+    default:
+        PyErr_Format(g_WireError, "unknown tag 0x%02x", tag);
+        return NULL;
+    }
+}
+
+static int check_ready() {
+    if (!g_ready) {
+        PyErr_SetString(PyExc_RuntimeError, "wire codec not set up");
+        return -1;
+    }
+    return 0;
+}
+
+// ---- module functions: generic codec ---------------------------------------
+
+static PyObject* py_encode_value(PyObject* self, PyObject* arg) {
+    if (check_ready() < 0) return NULL;
+    Buf b;
+    b.raw(WIRE_HEADER, 4);
+    StrTable t;
+    if (enc_value(arg, b, t, 0) < 0) return NULL;
+    return PyBytes_FromStringAndSize(b.s.data(), (Py_ssize_t)b.s.size());
+}
+
+static int dec_init(Dec& c, Py_buffer* view) {
+    c.d = (const uint8_t*)view->buf;
+    c.n = view->len;
+    c.pos = 0;
+    if (c.n < 4 || memcmp(c.d, WIRE_HEADER, 3) != 0) {
+        PyErr_SetString(g_WireError, "not a wire document (bad magic)");
+        return -1;
+    }
+    if (c.d[3] != (uint8_t)WIRE_HEADER[3]) {
+        PyErr_Format(g_WireError, "unsupported wire version %d", c.d[3]);
+        return -1;
+    }
+    c.pos = 4;
+    return 0;
+}
+
+static PyObject* py_decode_value(PyObject* self, PyObject* arg) {
+    if (check_ready() < 0) return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+    Dec c;
+    PyObject* out = NULL;
+    if (dec_init(c, &view) == 0) {
+        out = dec_value(c, 0);
+        if (out && c.pos != c.n) {
+            PyErr_Format(g_WireError, "%zd trailing bytes after document",
+                         c.n - c.pos);
+            Py_CLEAR(out);
+        }
+    }
+    dec_free(c);
+    PyBuffer_Release(&view);
+    return out;
+}
+
+// ---- object fast path: encode ----------------------------------------------
+//
+// Each emit_* mirrors api/serialize.py exactly (field order, skip-if-default
+// rules, camelCase renames).  Any attribute whose type or value falls outside
+// the fast subset sets *bail and the caller returns None to the reference
+// encoder — bailing is always correct, never wrong bytes.
+
+struct AttrVal {  // owned getattr with cleanup bookkeeping
+    PyObject* o;
+    AttrVal() : o(NULL) {}
+    ~AttrVal() { Py_XDECREF(o); }
+    bool get(PyObject* src, int name_idx) {
+        // dataclass fields live in the instance dict — read it directly
+        // and skip the type's MRO walk (the encode path does ~35 of these
+        // per pod); fall back to the full protocol for anything exotic
+        PyObject** dp = _PyObject_GetDictPtr(src);
+        if (dp && *dp) {
+            PyObject* v = PyDict_GetItem(*dp, g_name_py[name_idx]);
+            if (v) {
+                Py_INCREF(v);
+                o = v;
+                return true;
+            }
+        }
+        o = PyObject_GetAttr(src, g_name_py[name_idx]);
+        return o != NULL;
+    }
+};
+
+static bool str_eq(PyObject* v, int name_idx) {
+    return PyUnicode_Check(v) &&
+           PyUnicode_Compare(v, g_name_py[name_idx]) == 0;
+}
+
+static bool str_empty(PyObject* v) {
+    return PyUnicode_GET_LENGTH(v) == 0;
+}
+
+// truthiness matching Python `if value:`; -1 on error
+static int truthy(PyObject* v) { return PyObject_IsTrue(v); }
+
+static int emit_meta(PyObject* meta, Buf& b, StrTable& t, int* bail);
+static int emit_pod_spec(PyObject* spec, Buf& b, StrTable& t, int* bail);
+static int emit_pod_status(PyObject* st, Buf& b, StrTable& t, int* bail);
+static int emit_node_spec(PyObject* spec, Buf& b, StrTable& t, int* bail);
+static int emit_node_status(PyObject* st, Buf& b, StrTable& t, int* bail);
+
+static PyObject* encode_obj_common(PyObject* obj, int kind_name,
+                                   int (*spec_fn)(PyObject*, Buf&, StrTable&, int*),
+                                   int (*status_fn)(PyObject*, Buf&, StrTable&, int*)) {
+    if (check_ready() < 0) return NULL;
+    Buf b;
+    StrTable t;
+    int bail = 0;
+    b.raw(WIRE_HEADER, 4);
+    b.u8(T_MAP); b.uvarint(5);
+    emit_name(b, t, N_kind); emit_name(b, t, kind_name);
+    emit_name(b, t, N_apiVersion); emit_name(b, t, N_v_v1);
+    AttrVal meta, spec, status;
+    if (!meta.get(obj, N_a_metadata) || !spec.get(obj, N_a_spec) ||
+        !status.get(obj, N_a_status))
+        return NULL;
+    emit_name(b, t, N_metadata);
+    if (emit_meta(meta.o, b, t, &bail) < 0) return NULL;
+    if (bail) Py_RETURN_NONE;
+    emit_name(b, t, N_spec);
+    if (spec_fn(spec.o, b, t, &bail) < 0) return NULL;
+    if (bail) Py_RETURN_NONE;
+    emit_name(b, t, N_status);
+    if (status_fn(status.o, b, t, &bail) < 0) return NULL;
+    if (bail) Py_RETURN_NONE;
+    return PyBytes_FromStringAndSize(b.s.data(), (Py_ssize_t)b.s.size());
+}
+
+static PyObject* py_encode_pod(PyObject* self, PyObject* pod) {
+    return encode_obj_common(pod, N_v_Pod, emit_pod_spec, emit_pod_status);
+}
+
+static PyObject* py_encode_node(PyObject* self, PyObject* node) {
+    return encode_obj_common(node, N_v_Node, emit_node_spec, emit_node_status);
+}
+
+// _meta(): name always; namespace/uid/labels/annotations/resourceVersion/
+// creationTimestamp if truthy; deletionTimestamp if not None; ownerReferences
+// present -> bail (outside the fast subset).
+static int emit_meta(PyObject* meta, Buf& b, StrTable& t, int* bail) {
+    AttrVal name, ns, uid, labels, ann, rv, ct, dt, owners;
+    if (!name.get(meta, N_a_name) || !ns.get(meta, N_a_namespace) ||
+        !uid.get(meta, N_a_uid) || !labels.get(meta, N_a_labels) ||
+        !ann.get(meta, N_a_annotations) ||
+        !rv.get(meta, N_a_resource_version) ||
+        !ct.get(meta, N_a_creation_timestamp) ||
+        !dt.get(meta, N_a_deletion_timestamp) ||
+        !owners.get(meta, N_a_owner_references))
+        return -1;
+    int t_ns = truthy(ns.o), t_uid = truthy(uid.o), t_lab = truthy(labels.o);
+    int t_ann = truthy(ann.o), t_rv = truthy(rv.o), t_ct = truthy(ct.o);
+    int t_own = truthy(owners.o);
+    if (t_ns < 0 || t_uid < 0 || t_lab < 0 || t_ann < 0 || t_rv < 0 ||
+        t_ct < 0 || t_own < 0)
+        return -1;
+    if (t_own) { *bail = 1; return 0; }
+    if (t_rv && !PyLong_Check(rv.o)) { *bail = 1; return 0; }
+    int count = 1 + t_ns + t_uid + t_lab + t_ann + t_rv + t_ct +
+                (dt.o != Py_None ? 1 : 0);
+    b.u8(T_MAP); b.uvarint((uint64_t)count);
+    emit_name(b, t, N_name);
+    if (enc_value(name.o, b, t, 1) < 0) return -1;
+    if (t_ns) {
+        emit_name(b, t, N_k_namespace);
+        if (enc_value(ns.o, b, t, 1) < 0) return -1;
+    }
+    if (t_uid) {
+        emit_name(b, t, N_uid);
+        if (enc_value(uid.o, b, t, 1) < 0) return -1;
+    }
+    if (t_lab) {
+        emit_name(b, t, N_labels);
+        if (enc_value(labels.o, b, t, 1) < 0) return -1;
+    }
+    if (t_ann) {
+        emit_name(b, t, N_annotations);
+        if (enc_value(ann.o, b, t, 1) < 0) return -1;
+    }
+    if (t_rv) {
+        emit_name(b, t, N_resourceVersion);
+        PyObject* s = PyObject_Str(rv.o);  // str(resource_version)
+        if (!s) return -1;
+        Py_ssize_t len;
+        const char* u = PyUnicode_AsUTF8AndSize(s, &len);
+        if (!u) { Py_DECREF(s); return -1; }
+        emit_str_raw(b, t, u, len);
+        Py_DECREF(s);
+    }
+    if (t_ct) {
+        emit_name(b, t, N_creationTimestamp);
+        if (enc_value(ct.o, b, t, 1) < 0) return -1;
+    }
+    if (dt.o != Py_None) {
+        emit_name(b, t, N_deletionTimestamp);
+        if (enc_value(dt.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+// helpers for the skip-if-default rules -------------------------------------
+
+// list attr: returns 0 and sets *skip when empty, bails on non-list or
+// (when support_nonempty is false) on any elements
+static int list_gate(PyObject* v, int* bail, int* nonempty,
+                     int support_nonempty) {
+    if (!PyList_Check(v)) { *bail = 1; return 0; }
+    *nonempty = PyList_GET_SIZE(v) > 0;
+    if (*nonempty && !support_nonempty) *bail = 1;
+    return 0;
+}
+
+// str attr skipped when == default literal; bail on non-str
+static int str_field(PyObject* v, int dflt_idx, int* bail, int* emit) {
+    if (!PyUnicode_Check(v)) { *bail = 1; *emit = 0; return 0; }
+    *emit = dflt_idx < 0 ? !str_empty(v) : !str_eq(v, dflt_idx);
+    return 0;
+}
+
+static int emit_container(PyObject* c, Buf& b, StrTable& t, int* bail);
+
+static int emit_pod_spec(PyObject* spec, Buf& b, StrTable& t, int* bail) {
+    AttrVal cont, init, nn, nsel, aff, tol, prio, pcn, sched, tsc, over,
+        vols, hn, pp, claims;
+    if (!cont.get(spec, N_a_containers) ||
+        !init.get(spec, N_a_init_containers) ||
+        !nn.get(spec, N_a_node_name) || !nsel.get(spec, N_a_node_selector) ||
+        !aff.get(spec, N_a_affinity) || !tol.get(spec, N_a_tolerations) ||
+        !prio.get(spec, N_a_priority) ||
+        !pcn.get(spec, N_a_priority_class_name) ||
+        !sched.get(spec, N_a_scheduler_name) ||
+        !tsc.get(spec, N_a_topology_spread_constraints) ||
+        !over.get(spec, N_a_overhead) || !vols.get(spec, N_a_volumes) ||
+        !hn.get(spec, N_a_host_network) ||
+        !pp.get(spec, N_a_preemption_policy) ||
+        !claims.get(spec, N_a_resource_claims))
+        return -1;
+    int e_cont = 0, e_init = 0, e_tol = 0, e_tsc = 0, e_vols = 0,
+        e_claims = 0;
+    list_gate(cont.o, bail, &e_cont, 1);
+    list_gate(init.o, bail, &e_init, 0);
+    list_gate(tol.o, bail, &e_tol, 0);
+    list_gate(tsc.o, bail, &e_tsc, 0);
+    list_gate(vols.o, bail, &e_vols, 0);
+    list_gate(claims.o, bail, &e_claims, 0);
+    if (aff.o != Py_None) *bail = 1;
+    int e_nn, e_pcn, e_sched, e_pp;
+    str_field(nn.o, -1, bail, &e_nn);
+    str_field(pcn.o, -1, bail, &e_pcn);
+    str_field(sched.o, N_v_default_scheduler, bail, &e_sched);
+    str_field(pp.o, N_v_PreemptLowerPriority, bail, &e_pp);
+    int e_nsel = 0;
+    if (!PyDict_Check(nsel.o)) *bail = 1;
+    else e_nsel = PyDict_GET_SIZE(nsel.o) > 0;
+    if (!PyDict_Check(over.o) || PyDict_GET_SIZE(over.o) > 0) *bail = 1;
+    int e_prio = 0;
+    if (prio.o != Py_None) {  // None -> field skipped (val is None)
+        if (PyBool_Check(prio.o) || !PyLong_Check(prio.o)) *bail = 1;
+        else {
+            long long p = PyLong_AsLongLong(prio.o);
+            if (p == -1 && PyErr_Occurred()) return -1;
+            e_prio = p != 0;
+        }
+    }
+    int e_hn = 0;
+    if (!PyBool_Check(hn.o)) *bail = 1;
+    else e_hn = hn.o == Py_True;
+    if (*bail) return 0;
+    int count = e_cont + e_nn + e_nsel + e_prio + e_pcn + e_sched + e_hn +
+                e_pp;
+    b.u8(T_MAP); b.uvarint((uint64_t)count);
+    if (e_cont) {
+        emit_name(b, t, N_containers);
+        Py_ssize_t n = PyList_GET_SIZE(cont.o);
+        b.u8(T_LIST); b.uvarint((uint64_t)n);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (emit_container(PyList_GET_ITEM(cont.o, i), b, t, bail) < 0)
+                return -1;
+            if (*bail) return 0;
+        }
+    }
+    if (e_nn) {
+        emit_name(b, t, N_nodeName);
+        if (enc_value(nn.o, b, t, 1) < 0) return -1;
+    }
+    if (e_nsel) {
+        emit_name(b, t, N_nodeSelector);
+        if (enc_value(nsel.o, b, t, 1) < 0) return -1;
+    }
+    if (e_prio) {
+        emit_name(b, t, N_priority);
+        if (enc_value(prio.o, b, t, 1) < 0) return -1;
+    }
+    if (e_pcn) {
+        emit_name(b, t, N_priorityClassName);
+        if (enc_value(pcn.o, b, t, 1) < 0) return -1;
+    }
+    if (e_sched) {
+        emit_name(b, t, N_schedulerName);
+        if (enc_value(sched.o, b, t, 1) < 0) return -1;
+    }
+    if (e_hn) {
+        emit_name(b, t, N_hostNetwork);
+        b.u8(T_TRUE);
+    }
+    if (e_pp) {
+        emit_name(b, t, N_preemptionPolicy);
+        if (enc_value(pp.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+// int field skipped when 0; bail on bool/non-int
+static int int_field(PyObject* v, int* bail, int* emit) {
+    if (PyBool_Check(v) || !PyLong_Check(v)) { *bail = 1; *emit = 0; return 0; }
+    long long x = PyLong_AsLongLong(v);
+    if (x == -1 && PyErr_Occurred()) return -1;
+    *emit = x != 0;
+    return 0;
+}
+
+static int emit_port(PyObject* p, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(p, (PyTypeObject*)g_cls_ContainerPort)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal cp, hp, hip, proto;
+    if (!cp.get(p, N_a_container_port) || !hp.get(p, N_a_host_port) ||
+        !hip.get(p, N_a_host_ip) || !proto.get(p, N_a_protocol))
+        return -1;
+    int e_cp, e_hp, e_hip, e_proto;
+    if (int_field(cp.o, bail, &e_cp) < 0 || int_field(hp.o, bail, &e_hp) < 0)
+        return -1;
+    str_field(hip.o, -1, bail, &e_hip);
+    str_field(proto.o, N_v_TCP, bail, &e_proto);
+    if (*bail) return 0;
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_cp + e_hp + e_hip + e_proto));
+    if (e_cp) {
+        emit_name(b, t, N_containerPort);
+        if (enc_value(cp.o, b, t, 1) < 0) return -1;
+    }
+    if (e_hp) {
+        emit_name(b, t, N_hostPort);
+        if (enc_value(hp.o, b, t, 1) < 0) return -1;
+    }
+    if (e_hip) {
+        emit_name(b, t, N_hostIP);
+        if (enc_value(hip.o, b, t, 1) < 0) return -1;
+    }
+    if (e_proto) {
+        emit_name(b, t, N_protocol);
+        if (enc_value(proto.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+static int emit_container(PyObject* c, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(c, (PyTypeObject*)g_cls_Container)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal name, image, res, ports;
+    if (!name.get(c, N_a_name) || !image.get(c, N_a_image) ||
+        !res.get(c, N_a_resources) || !ports.get(c, N_a_ports))
+        return -1;
+    int e_name, e_image;
+    str_field(name.o, -1, bail, &e_name);
+    str_field(image.o, -1, bail, &e_image);
+    int e_ports = 0;
+    list_gate(ports.o, bail, &e_ports, 1);
+    if (!PyObject_TypeCheck(res.o, (PyTypeObject*)g_cls_RR)) *bail = 1;
+    if (*bail) return 0;
+    AttrVal req, lim;
+    if (!req.get(res.o, N_a_requests) || !lim.get(res.o, N_a_limits))
+        return -1;
+    if (!PyDict_Check(req.o) || !PyDict_Check(lim.o)) { *bail = 1; return 0; }
+    int e_req = PyDict_GET_SIZE(req.o) > 0, e_lim = PyDict_GET_SIZE(lim.o) > 0;
+    int e_res = e_req || e_lim;  // resources == RR() -> skipped
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_name + e_image + e_res + e_ports));
+    if (e_name) {
+        emit_name(b, t, N_name);
+        if (enc_value(name.o, b, t, 1) < 0) return -1;
+    }
+    if (e_image) {
+        emit_name(b, t, N_image);
+        if (enc_value(image.o, b, t, 1) < 0) return -1;
+    }
+    if (e_res) {
+        emit_name(b, t, N_resources);
+        b.u8(T_MAP); b.uvarint((uint64_t)(e_req + e_lim));
+        if (e_req) {
+            emit_name(b, t, N_requests);
+            if (enc_value(req.o, b, t, 2) < 0) return -1;
+        }
+        if (e_lim) {
+            emit_name(b, t, N_limits);
+            if (enc_value(lim.o, b, t, 2) < 0) return -1;
+        }
+    }
+    if (e_ports) {
+        emit_name(b, t, N_ports);
+        Py_ssize_t n = PyList_GET_SIZE(ports.o);
+        b.u8(T_LIST); b.uvarint((uint64_t)n);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (emit_port(PyList_GET_ITEM(ports.o, i), b, t, bail) < 0)
+                return -1;
+            if (*bail) return 0;
+        }
+    }
+    return 0;
+}
+
+static int emit_pod_status(PyObject* st, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(st, (PyTypeObject*)g_cls_PodStatus)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal phase, nom, cond, ip;
+    if (!phase.get(st, N_a_phase) ||
+        !nom.get(st, N_a_nominated_node_name) ||
+        !cond.get(st, N_a_conditions) || !ip.get(st, N_a_pod_ip))
+        return -1;
+    int e_phase, e_nom, e_ip, e_cond = 0;
+    str_field(phase.o, N_v_Pending, bail, &e_phase);
+    str_field(nom.o, -1, bail, &e_nom);
+    str_field(ip.o, -1, bail, &e_ip);
+    list_gate(cond.o, bail, &e_cond, 1);
+    if (*bail) return 0;
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_phase + e_nom + e_cond + e_ip));
+    if (e_phase) {
+        emit_name(b, t, N_phase);
+        if (enc_value(phase.o, b, t, 1) < 0) return -1;
+    }
+    if (e_nom) {
+        emit_name(b, t, N_nominatedNodeName);
+        if (enc_value(nom.o, b, t, 1) < 0) return -1;
+    }
+    if (e_cond) {
+        emit_name(b, t, N_conditions);
+        if (enc_value(cond.o, b, t, 1) < 0) return -1;
+    }
+    if (e_ip) {
+        emit_name(b, t, N_podIP);
+        if (enc_value(ip.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+static int emit_taint(PyObject* taint, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(taint, (PyTypeObject*)g_cls_Taint)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal key, val, eff, ta;
+    if (!key.get(taint, N_a_key) || !val.get(taint, N_a_value) ||
+        !eff.get(taint, N_a_effect) || !ta.get(taint, N_a_time_added))
+        return -1;
+    int e_key, e_val, e_eff;
+    str_field(key.o, -1, bail, &e_key);
+    str_field(val.o, -1, bail, &e_val);
+    str_field(eff.o, N_v_NoSchedule, bail, &e_eff);
+    if (*bail) return 0;
+    int e_ta = ta.o != Py_None;
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_key + e_val + e_eff + e_ta));
+    if (e_key) {
+        emit_name(b, t, N_key);
+        if (enc_value(key.o, b, t, 1) < 0) return -1;
+    }
+    if (e_val) {
+        emit_name(b, t, N_value);
+        if (enc_value(val.o, b, t, 1) < 0) return -1;
+    }
+    if (e_eff) {
+        emit_name(b, t, N_effect);
+        if (enc_value(eff.o, b, t, 1) < 0) return -1;
+    }
+    if (e_ta) {
+        emit_name(b, t, N_timeAdded);
+        if (enc_value(ta.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+static int emit_node_spec(PyObject* spec, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(spec, (PyTypeObject*)g_cls_NodeSpec)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal unsched, taints, cidr;
+    if (!unsched.get(spec, N_a_unschedulable) ||
+        !taints.get(spec, N_a_taints) || !cidr.get(spec, N_a_pod_cidr))
+        return -1;
+    int e_unsched = 0;
+    if (!PyBool_Check(unsched.o)) *bail = 1;
+    else e_unsched = unsched.o == Py_True;
+    int e_taints = 0;
+    list_gate(taints.o, bail, &e_taints, 1);
+    int e_cidr;
+    str_field(cidr.o, -1, bail, &e_cidr);
+    if (*bail) return 0;
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_unsched + e_taints + e_cidr));
+    if (e_unsched) {
+        emit_name(b, t, N_unschedulable);
+        b.u8(T_TRUE);
+    }
+    if (e_taints) {
+        emit_name(b, t, N_taints);
+        Py_ssize_t n = PyList_GET_SIZE(taints.o);
+        b.u8(T_LIST); b.uvarint((uint64_t)n);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (emit_taint(PyList_GET_ITEM(taints.o, i), b, t, bail) < 0)
+                return -1;
+            if (*bail) return 0;
+        }
+    }
+    if (e_cidr) {
+        emit_name(b, t, N_podCIDR);
+        if (enc_value(cidr.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+static int emit_image(PyObject* img, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(img, (PyTypeObject*)g_cls_ContainerImage)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal names, sz;
+    if (!names.get(img, N_a_names) || !sz.get(img, N_a_size_bytes))
+        return -1;
+    int e_names = 0, e_sz;
+    list_gate(names.o, bail, &e_names, 1);
+    if (int_field(sz.o, bail, &e_sz) < 0) return -1;
+    if (*bail) return 0;
+    b.u8(T_MAP); b.uvarint((uint64_t)(e_names + e_sz));
+    if (e_names) {
+        emit_name(b, t, N_names);
+        if (enc_value(names.o, b, t, 1) < 0) return -1;
+    }
+    if (e_sz) {
+        emit_name(b, t, N_sizeBytes);
+        if (enc_value(sz.o, b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+// node status: the serializer always emits all five keys (allocatable is
+// kept alongside capacity because from_dict defaults it FROM capacity)
+static int emit_node_status(PyObject* st, Buf& b, StrTable& t, int* bail) {
+    if (!PyObject_TypeCheck(st, (PyTypeObject*)g_cls_NodeStatus)) {
+        *bail = 1;
+        return 0;
+    }
+    AttrVal cap, alloc, images, cond, va;
+    if (!cap.get(st, N_a_capacity) || !alloc.get(st, N_a_allocatable) ||
+        !images.get(st, N_a_images) || !cond.get(st, N_a_conditions) ||
+        !va.get(st, N_a_volumes_attached))
+        return -1;
+    if (!PyDict_Check(cap.o) || !PyDict_Check(alloc.o) ||
+        !PyList_Check(images.o) || !PyList_Check(cond.o) ||
+        !PyList_Check(va.o)) {
+        *bail = 1;
+        return 0;
+    }
+    b.u8(T_MAP); b.uvarint(5);
+    emit_name(b, t, N_capacity);
+    if (enc_value(cap.o, b, t, 1) < 0) return -1;
+    emit_name(b, t, N_allocatable);
+    if (enc_value(alloc.o, b, t, 1) < 0) return -1;
+    emit_name(b, t, N_images);
+    Py_ssize_t n = PyList_GET_SIZE(images.o);
+    b.u8(T_LIST); b.uvarint((uint64_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (emit_image(PyList_GET_ITEM(images.o, i), b, t, bail) < 0)
+            return -1;
+        if (*bail) return 0;
+    }
+    emit_name(b, t, N_conditions);
+    if (enc_value(cond.o, b, t, 1) < 0) return -1;
+    emit_name(b, t, N_volumesAttached);
+    n = PyList_GET_SIZE(va.o);
+    b.u8(T_LIST); b.uvarint((uint64_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        b.u8(T_MAP); b.uvarint(1);
+        emit_name(b, t, N_name);
+        if (enc_value(PyList_GET_ITEM(va.o, i), b, t, 1) < 0) return -1;
+    }
+    return 0;
+}
+
+// ---- object fast path: decode ----------------------------------------------
+//
+// Structured walk over the document, building typed objects via
+// object.__new__ + __dict__ fill.  Any structural surprise (unknown key,
+// unexpected value type, non-Pod/Node kind) raises nothing — it sets *bail
+// and decode_object returns None so api/wire.py runs the reference
+// scheme.decode(wire_decode(data)) path, which handles every shape.
+// Byte-level violations (bad magic, truncation) DO raise WireError —
+// exactly what the reference path would raise.
+
+struct FastDec {
+    Dec c;
+    int bail;
+};
+
+// decoded key == g_name_py[idx]?  Well-known-sourced keys are the interned
+// g_wk_strs objects, so pointer equality answers first.
+static bool key_is(PyObject* k, int idx) {
+    if (k == g_name_py[idx]) return true;
+    return PyUnicode_Compare(k, g_name_py[idx]) == 0;
+}
+
+// build an instance of cls with __dict__ = d (steals d on success).
+// tp_alloc is exactly what object.__new__ does for these plain dataclass
+// heap types (none overrides __new__ — setup() verifies), minus a Python
+// call dispatch per object.
+static PyObject* build(PyObject* cls, PyObject* d) {
+    PyTypeObject* tp = (PyTypeObject*)cls;
+    PyObject* inst = tp->tp_alloc(tp, 0);
+    if (!inst) { Py_DECREF(d); return NULL; }
+    PyObject** dictptr = _PyObject_GetDictPtr(inst);
+    if (dictptr) {
+        Py_XDECREF(*dictptr);
+        *dictptr = d;  // stolen
+        return inst;
+    }
+    int rc = PyObject_SetAttrString(inst, "__dict__", d);
+    Py_DECREF(d);
+    if (rc < 0) { Py_DECREF(inst); return NULL; }
+    return inst;
+}
+
+static int dict_set(PyObject* d, int name_idx, PyObject* v_stolen) {
+    if (!v_stolen) return -1;
+    int rc = PyDict_SetItem(d, g_name_py[name_idx], v_stolen);
+    Py_DECREF(v_stolen);
+    return rc;
+}
+
+// expect and open a map; returns -1 error, 0 ok (count in *count)
+static int open_map(FastDec& f, uint64_t* count) {
+    if (f.c.pos >= f.c.n) {
+        PyErr_SetString(g_WireError, "truncated document");
+        return -1;
+    }
+    if (f.c.d[f.c.pos] != T_MAP) { f.bail = 1; return 0; }
+    f.c.pos++;
+    return rd_uvarint(f.c, count);
+}
+
+// read one map key (must be a string value); NULL on error/bail
+static PyObject* read_key(FastDec& f) {
+    PyObject* k = dec_value(f.c, 1);
+    if (!k) return NULL;
+    if (!PyUnicode_Check(k)) {
+        Py_DECREF(k);
+        PyErr_SetString(g_WireError, "map key is not a string");
+        return NULL;
+    }
+    return k;
+}
+
+// skip-and-drop one value (consume for parity with from_dict's ignores)
+static int drop_value(FastDec& f) {
+    PyObject* v = dec_value(f.c, 1);
+    if (!v) return -1;
+    Py_DECREF(v);
+    return 0;
+}
+
+// value coercions mirroring from_dict ---------------------------------------
+
+// float(v) for int|float; bail otherwise (e.g. RFC3339 strings)
+static PyObject* as_float(FastDec& f, PyObject* v) {
+    if (PyFloat_Check(v)) return v;
+    if (PyLong_Check(v) && !PyBool_Check(v)) {
+        PyObject* out = PyNumber_Float(v);
+        Py_DECREF(v);
+        return out;
+    }
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+// int(v) — only exact ints pass (bool/float/str bail to the reference path)
+static PyObject* as_int(FastDec& f, PyObject* v) {
+    if (PyLong_Check(v) && !PyBool_Check(v)) return v;
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+static PyObject* as_str(FastDec& f, PyObject* v) {
+    if (PyUnicode_Check(v)) return v;
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+static PyObject* as_bool(FastDec& f, PyObject* v) {
+    if (PyBool_Check(v)) return v;
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+static PyObject* as_dict(FastDec& f, PyObject* v) {
+    if (PyDict_Check(v)) return v;
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+static PyObject* as_list(FastDec& f, PyObject* v) {
+    if (PyList_Check(v)) return v;
+    Py_DECREF(v);
+    f.bail = 1;
+    return NULL;
+}
+
+// ObjectMeta.from_dict parity: namespace "default", uid falsy -> new_uid(),
+// creationTimestamp absent -> now(), resourceVersion DROPPED (stays 0),
+// ownerReferences/unknown keys -> bail.
+static PyObject* dec_meta(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    int have_uid = 0, have_ct = 0;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        PyObject* v = dec_value(f.c, 1);
+        if (!v) { Py_DECREF(k); Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_name)) rc = dict_set(d, N_a_name, as_str(f, v));
+        else if (key_is(k, N_k_namespace))
+            rc = dict_set(d, N_a_namespace, as_str(f, v));
+        else if (key_is(k, N_uid)) {
+            v = as_str(f, v);
+            if (v && PyUnicode_GET_LENGTH(v) > 0) {
+                have_uid = 1;
+                rc = dict_set(d, N_a_uid, v);
+            } else
+                Py_XDECREF(v);  // falsy uid -> factory below
+        } else if (key_is(k, N_labels))
+            rc = dict_set(d, N_a_labels, as_dict(f, v));
+        else if (key_is(k, N_annotations))
+            rc = dict_set(d, N_a_annotations, as_dict(f, v));
+        else if (key_is(k, N_resourceVersion))
+            Py_DECREF(v);  // from_dict drops resourceVersion on purpose
+        else if (key_is(k, N_creationTimestamp)) {
+            have_ct = 1;
+            rc = dict_set(d, N_a_creation_timestamp, as_float(f, v));
+        } else if (key_is(k, N_deletionTimestamp))
+            rc = dict_set(d, N_a_deletion_timestamp, as_float(f, v));
+        else {
+            Py_DECREF(v);
+            f.bail = 1;  // ownerReferences / unknown key
+        }
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    // defaults for absent keys
+    if (!PyDict_GetItem(d, g_name_py[N_a_name]) &&
+        dict_set(d, N_a_name, PyUnicode_FromString("")) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_namespace])) {
+        Py_INCREF(g_name_py[N_v_default]);
+        if (dict_set(d, N_a_namespace, g_name_py[N_v_default]) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    if (!have_uid &&
+        dict_set(d, N_a_uid,
+                 PyObject_CallFunctionObjArgs(g_new_uid, NULL)) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_labels]) &&
+        dict_set(d, N_a_labels, PyDict_New()) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_annotations]) &&
+        dict_set(d, N_a_annotations, PyDict_New()) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!have_ct &&
+        dict_set(d, N_a_creation_timestamp,
+                 PyObject_CallFunctionObjArgs(g_now, NULL)) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (dict_set(d, N_a_resource_version, PyLong_FromLong(0)) < 0 ||
+        dict_set(d, N_a_owner_references, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_deletion_timestamp])) {
+        Py_INCREF(Py_None);
+        if (dict_set(d, N_a_deletion_timestamp, Py_None) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    return build(g_cls_ObjectMeta, d);
+}
+
+static PyObject* dec_meta_default() {
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    if (dict_set(d, N_a_name, PyUnicode_FromString("")) < 0)
+        { Py_DECREF(d); return NULL; }
+    Py_INCREF(g_name_py[N_v_default]);
+    if (dict_set(d, N_a_namespace, g_name_py[N_v_default]) < 0 ||
+        dict_set(d, N_a_uid,
+                 PyObject_CallFunctionObjArgs(g_new_uid, NULL)) < 0 ||
+        dict_set(d, N_a_labels, PyDict_New()) < 0 ||
+        dict_set(d, N_a_annotations, PyDict_New()) < 0 ||
+        dict_set(d, N_a_creation_timestamp,
+                 PyObject_CallFunctionObjArgs(g_now, NULL)) < 0 ||
+        dict_set(d, N_a_resource_version, PyLong_FromLong(0)) < 0 ||
+        dict_set(d, N_a_owner_references, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    Py_INCREF(Py_None);
+    if (dict_set(d, N_a_deletion_timestamp, Py_None) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_ObjectMeta, d);
+}
+
+// absent-key defaults: set dflt (stolen) unless key already present
+static int dflt(PyObject* d, int name_idx, PyObject* v_stolen) {
+    if (!v_stolen) return -1;
+    if (PyDict_GetItem(d, g_name_py[name_idx])) {
+        Py_DECREF(v_stolen);
+        return 0;
+    }
+    return dict_set(d, name_idx, v_stolen);
+}
+
+static PyObject* dflt_str(int lit_idx) {  // new ref to a literal
+    Py_INCREF(g_name_py[lit_idx]);
+    return g_name_py[lit_idx];
+}
+
+static PyObject* dec_rr(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        PyObject* v = dec_value(f.c, 1);
+        if (!v) { Py_DECREF(k); Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_requests)) {
+            v = as_dict(f, v);
+            if (v && PyDict_GET_SIZE(v) > 0) rc = dict_set(d, N_a_requests, v);
+            else Py_XDECREF(v);  // `dict(d.get("requests") or {})` -> fresh {}
+        } else if (key_is(k, N_limits)) {
+            v = as_dict(f, v);
+            if (v && PyDict_GET_SIZE(v) > 0) rc = dict_set(d, N_a_limits, v);
+            else Py_XDECREF(v);
+        } else
+            Py_DECREF(v);  // RR.from_dict ignores unknown keys
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_requests, PyDict_New()) < 0 ||
+        dflt(d, N_a_limits, PyDict_New()) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_RR, d);
+}
+
+static PyObject* dec_port(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        PyObject* v = dec_value(f.c, 1);
+        if (!v) { Py_DECREF(k); Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_containerPort))
+            rc = dict_set(d, N_a_container_port, as_int(f, v));
+        else if (key_is(k, N_hostPort))
+            rc = dict_set(d, N_a_host_port, as_int(f, v));
+        else if (key_is(k, N_hostIP))
+            rc = dict_set(d, N_a_host_ip, as_str(f, v));
+        else if (key_is(k, N_protocol))
+            rc = dict_set(d, N_a_protocol, as_str(f, v));
+        else { Py_DECREF(v); f.bail = 1; }
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_container_port, PyLong_FromLong(0)) < 0 ||
+        dflt(d, N_a_host_port, PyLong_FromLong(0)) < 0 ||
+        dflt(d, N_a_host_ip, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_protocol, dflt_str(N_v_TCP)) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_ContainerPort, d);
+}
+
+static PyObject* dec_container(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_name)) {
+            rc = dict_set(d, N_a_name, as_str(f, dec_value(f.c, 1)));
+        } else if (key_is(k, N_image)) {
+            rc = dict_set(d, N_a_image, as_str(f, dec_value(f.c, 1)));
+        } else if (key_is(k, N_resources)) {
+            rc = dict_set(d, N_a_resources, dec_rr(f));
+        } else if (key_is(k, N_ports)) {
+            PyObject* out = PyList_New(0);
+            uint64_t n;
+            if (!out) rc = -1;
+            else if (f.c.pos >= f.c.n || f.c.d[f.c.pos] != T_LIST)
+                { f.bail = 1; Py_DECREF(out); }
+            else {
+                f.c.pos++;
+                if (rd_uvarint(f.c, &n) < 0) rc = -1;
+                else
+                    for (uint64_t j = 0; j < n; j++) {
+                        PyObject* p = dec_port(f);
+                        if (!p || PyList_Append(out, p) < 0) {
+                            Py_XDECREF(p); rc = -1; break;
+                        }
+                        Py_DECREF(p);
+                    }
+                if (rc < 0 || f.bail) Py_DECREF(out);
+                else rc = dict_set(d, N_a_ports, out);
+            }
+        } else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_name, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_image, PyUnicode_FromString("")) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_resources])) {
+        PyObject* rd = PyDict_New();
+        PyObject* rr = NULL;
+        if (rd && dict_set(rd, N_a_requests, PyDict_New()) == 0 &&
+            dict_set(rd, N_a_limits, PyDict_New()) == 0)
+            rr = build(g_cls_RR, rd);
+        else
+            Py_XDECREF(rd);
+        if (dflt(d, N_a_resources, rr) < 0) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_ports, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_Container, d);
+}
+
+// read `[ ... ]` of element decoder fn
+typedef PyObject* (*dec_fn)(FastDec&);
+static PyObject* dec_typed_list(FastDec& f, dec_fn fn) {
+    if (f.c.pos >= f.c.n || f.c.d[f.c.pos] != T_LIST) {
+        f.bail = 1;
+        return NULL;
+    }
+    f.c.pos++;
+    uint64_t n;
+    if (rd_uvarint(f.c, &n) < 0) return NULL;
+    PyObject* out = PyList_New(0);
+    if (!out) return NULL;
+    for (uint64_t i = 0; i < n; i++) {
+        PyObject* item = fn(f);
+        if (!item || PyList_Append(out, item) < 0) {
+            Py_XDECREF(item); Py_DECREF(out);
+            return NULL;
+        }
+        Py_DECREF(item);
+    }
+    return out;
+}
+
+static PyObject* dec_pod_spec(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_containers))
+            rc = dict_set(d, N_a_containers, dec_typed_list(f, dec_container));
+        else if (key_is(k, N_nodeName))
+            rc = dict_set(d, N_a_node_name, as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_nodeSelector)) {
+            PyObject* m = as_dict(f, dec_value(f.c, 1));
+            if (m) {
+                // from_dict str()-coerces values; pass through only all-str
+                PyObject *mk, *mv;
+                Py_ssize_t mpos = 0;
+                while (PyDict_Next(m, &mpos, &mk, &mv))
+                    if (!PyUnicode_Check(mv)) { f.bail = 1; break; }
+                if (f.bail) Py_DECREF(m);
+                else rc = dict_set(d, N_a_node_selector, m);
+            }
+        } else if (key_is(k, N_priority))
+            rc = dict_set(d, N_a_priority, as_int(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_priorityClassName))
+            rc = dict_set(d, N_a_priority_class_name,
+                          as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_schedulerName))
+            rc = dict_set(d, N_a_scheduler_name,
+                          as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_hostNetwork))
+            rc = dict_set(d, N_a_host_network, as_bool(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_preemptionPolicy))
+            rc = dict_set(d, N_a_preemption_policy,
+                          as_str(f, dec_value(f.c, 1)));
+        else
+            f.bail = 1;  // affinity/tolerations/volumes/... -> reference path
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_containers, PyList_New(0)) < 0 ||
+        dict_set(d, N_a_init_containers, PyList_New(0)) < 0 ||
+        dflt(d, N_a_node_name, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_node_selector, PyDict_New()) < 0)
+        { Py_DECREF(d); return NULL; }
+    Py_INCREF(Py_None);
+    if (dict_set(d, N_a_affinity, Py_None) < 0 ||
+        dict_set(d, N_a_tolerations, PyList_New(0)) < 0 ||
+        dflt(d, N_a_priority, PyLong_FromLong(0)) < 0 ||
+        dflt(d, N_a_priority_class_name, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_scheduler_name, dflt_str(N_v_default_scheduler)) < 0 ||
+        dict_set(d, N_a_topology_spread_constraints, PyList_New(0)) < 0 ||
+        dict_set(d, N_a_overhead, PyDict_New()) < 0 ||
+        dict_set(d, N_a_volumes, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_host_network])) {
+        Py_INCREF(Py_False);
+        if (dict_set(d, N_a_host_network, Py_False) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_preemption_policy,
+             dflt_str(N_v_PreemptLowerPriority)) < 0 ||
+        dict_set(d, N_a_resource_claims, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_PodSpec, d);
+}
+
+static PyObject* dec_pod_status(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_phase))
+            rc = dict_set(d, N_a_phase, as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_nominatedNodeName))
+            rc = dict_set(d, N_a_nominated_node_name,
+                          as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_conditions))
+            rc = dict_set(d, N_a_conditions, as_list(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_podIP))
+            rc = dict_set(d, N_a_pod_ip, as_str(f, dec_value(f.c, 1)));
+        else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_phase, dflt_str(N_v_Pending)) < 0 ||
+        dflt(d, N_a_nominated_node_name, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_conditions, PyList_New(0)) < 0 ||
+        dflt(d, N_a_pod_ip, PyUnicode_FromString("")) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_PodStatus, d);
+}
+
+static PyObject* dec_taint(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_key))
+            rc = dict_set(d, N_a_key, as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_value))
+            rc = dict_set(d, N_a_value, as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_effect))
+            rc = dict_set(d, N_a_effect, as_str(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_timeAdded))
+            rc = dict_set(d, N_a_time_added, as_float(f, dec_value(f.c, 1)));
+        else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_key, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_value, PyUnicode_FromString("")) < 0 ||
+        dflt(d, N_a_effect, dflt_str(N_v_NoSchedule)) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!PyDict_GetItem(d, g_name_py[N_a_time_added])) {
+        Py_INCREF(Py_None);
+        if (dict_set(d, N_a_time_added, Py_None) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    return build(g_cls_Taint, d);
+}
+
+static PyObject* dec_node_spec(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_unschedulable))
+            rc = dict_set(d, N_a_unschedulable, as_bool(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_taints))
+            rc = dict_set(d, N_a_taints, dec_typed_list(f, dec_taint));
+        else if (key_is(k, N_podCIDR))
+            rc = dict_set(d, N_a_pod_cidr, as_str(f, dec_value(f.c, 1)));
+        else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (!PyDict_GetItem(d, g_name_py[N_a_unschedulable])) {
+        Py_INCREF(Py_False);
+        if (dict_set(d, N_a_unschedulable, Py_False) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_taints, PyList_New(0)) < 0 ||
+        dflt(d, N_a_pod_cidr, PyUnicode_FromString("")) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_NodeSpec, d);
+}
+
+static PyObject* dec_image(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_names)) {
+            PyObject* lst = as_list(f, dec_value(f.c, 1));
+            if (lst) {
+                for (Py_ssize_t j = 0; j < PyList_GET_SIZE(lst); j++)
+                    if (!PyUnicode_Check(PyList_GET_ITEM(lst, j)))
+                        { f.bail = 1; break; }  // str(n) coercion
+                if (f.bail) Py_DECREF(lst);
+                else rc = dict_set(d, N_a_names, lst);
+            }
+        } else if (key_is(k, N_sizeBytes))
+            rc = dict_set(d, N_a_size_bytes, as_int(f, dec_value(f.c, 1)));
+        else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_names, PyList_New(0)) < 0 ||
+        dflt(d, N_a_size_bytes, PyLong_FromLong(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_ContainerImage, d);
+}
+
+static PyObject* dec_node_status(FastDec& f) {
+    uint64_t count;
+    if (open_map(f, &count) < 0 || f.bail) return NULL;
+    PyObject* d = PyDict_New();
+    if (!d) return NULL;
+    int have_alloc_nonempty = 0;
+    for (uint64_t i = 0; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) { Py_DECREF(d); return NULL; }
+        int rc = 0;
+        if (key_is(k, N_capacity))
+            rc = dict_set(d, N_a_capacity, as_dict(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_allocatable)) {
+            PyObject* m = as_dict(f, dec_value(f.c, 1));
+            if (m) {
+                // `dict(d.get("allocatable") or cap)`: an EMPTY allocatable
+                // is falsy and from_dict copies capacity instead
+                if (PyDict_GET_SIZE(m) > 0) {
+                    have_alloc_nonempty = 1;
+                    rc = dict_set(d, N_a_allocatable, m);
+                } else
+                    Py_DECREF(m);
+            }
+        } else if (key_is(k, N_images))
+            rc = dict_set(d, N_a_images, dec_typed_list(f, dec_image));
+        else if (key_is(k, N_conditions))
+            rc = dict_set(d, N_a_conditions, as_list(f, dec_value(f.c, 1)));
+        else if (key_is(k, N_volumesAttached)) {
+            PyObject* lst = as_list(f, dec_value(f.c, 1));
+            if (lst) {
+                PyObject* out = PyList_New(PyList_GET_SIZE(lst));
+                if (!out) { Py_DECREF(lst); rc = -1; }
+                else {
+                    for (Py_ssize_t j = 0; j < PyList_GET_SIZE(lst); j++) {
+                        PyObject* el = PyList_GET_ITEM(lst, j);
+                        PyObject* nm;
+                        if (PyDict_Check(el)) {
+                            nm = PyDict_GetItem(el, g_name_py[N_name]);
+                            if (!nm) nm = Py_None;  // v.get("name") -> None
+                        } else if (PyUnicode_Check(el))
+                            nm = el;  // str(v) of a str is itself
+                        else { f.bail = 1; break; }
+                        Py_INCREF(nm);
+                        PyList_SET_ITEM(out, j, nm);
+                    }
+                    Py_DECREF(lst);
+                    if (f.bail) Py_DECREF(out);
+                    else rc = dict_set(d, N_a_volumes_attached, out);
+                }
+            }
+        } else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_capacity, PyDict_New()) < 0)
+        { Py_DECREF(d); return NULL; }
+    if (!have_alloc_nonempty) {
+        PyObject* cap = PyDict_GetItem(d, g_name_py[N_a_capacity]);
+        if (dict_set(d, N_a_allocatable, PyDict_Copy(cap)) < 0)
+            { Py_DECREF(d); return NULL; }
+    }
+    if (dflt(d, N_a_images, PyList_New(0)) < 0 ||
+        dflt(d, N_a_conditions, PyList_New(0)) < 0 ||
+        dflt(d, N_a_volumes_attached, PyList_New(0)) < 0)
+        { Py_DECREF(d); return NULL; }
+    return build(g_cls_NodeStatus, d);
+}
+
+// empty-manifest sub-objects for absent spec/status keys
+static PyObject* dec_from_empty(dec_fn fn) {
+    static const uint8_t empty_map[] = {T_MAP, 0};
+    FastDec f;
+    f.c.d = empty_map;
+    f.c.n = 2;
+    f.c.pos = 0;
+    f.bail = 0;
+    PyObject* out = fn(f);
+    dec_free(f.c);
+    return out;
+}
+
+static PyObject* py_decode_object(PyObject* self, PyObject* arg) {
+    if (check_ready() < 0) return NULL;
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+    FastDec f;
+    f.bail = 0;
+    PyObject *meta = NULL, *spec = NULL, *status = NULL, *out = NULL;
+    int is_pod = 0;
+    uint64_t count = 0;
+    if (dec_init(f.c, &view) < 0) goto done;
+    if (open_map(f, &count) < 0 || f.bail) goto done;
+    if (count < 1 || count > 5) { f.bail = 1; goto done; }
+    {
+        // first key must be "kind" so the right sub-decoders drive the rest
+        PyObject* k = read_key(f);
+        if (!k) goto done;
+        int ok = key_is(k, N_kind);
+        Py_DECREF(k);
+        if (!ok) { f.bail = 1; goto done; }
+        PyObject* v = dec_value(f.c, 1);
+        if (!v) goto done;
+        if (key_is(v, N_v_Pod)) is_pod = 1;
+        else if (key_is(v, N_v_Node)) is_pod = 0;
+        else { Py_DECREF(v); f.bail = 1; goto done; }
+        Py_DECREF(v);
+    }
+    for (uint64_t i = 1; i < count; i++) {
+        PyObject* k = read_key(f);
+        if (!k) goto done;
+        int rc = 0;
+        if (key_is(k, N_apiVersion)) {
+            PyObject* v = as_str(f, dec_value(f.c, 1));
+            // fast path serves the default registration only ("", "v1");
+            // anything else goes through scheme.decode's validation
+            if (v && !key_is(v, N_v_v1)) f.bail = 1;
+            Py_XDECREF(v);
+        } else if (key_is(k, N_metadata)) {
+            meta = dec_meta(f);
+            if (!meta) rc = -1;
+        } else if (key_is(k, N_spec)) {
+            spec = is_pod ? dec_pod_spec(f) : dec_node_spec(f);
+            if (!spec) rc = -1;
+        } else if (key_is(k, N_status)) {
+            status = is_pod ? dec_pod_status(f) : dec_node_status(f);
+            if (!status) rc = -1;
+        } else
+            f.bail = 1;
+        Py_DECREF(k);
+        if (rc < 0 || f.bail) goto done;
+    }
+    if (f.c.pos != f.c.n) {
+        PyErr_Format(g_WireError, "%zd trailing bytes after document",
+                     f.c.n - f.c.pos);
+        goto done;
+    }
+    if (!meta) meta = dec_meta_default();
+    if (!spec) spec = dec_from_empty(is_pod ? dec_pod_spec : dec_node_spec);
+    if (!status)
+        status = dec_from_empty(is_pod ? dec_pod_status : dec_node_status);
+    if (meta && spec && status) {
+        PyObject* d = PyDict_New();
+        if (d) {
+            Py_INCREF(meta); Py_INCREF(spec); Py_INCREF(status);
+            if (dict_set(d, N_a_metadata, meta) == 0 &&
+                dict_set(d, N_a_spec, spec) == 0 &&
+                dict_set(d, N_a_status, status) == 0)
+                out = build(is_pod ? g_cls_Pod : g_cls_Node, d);
+            else
+                Py_DECREF(d);
+        }
+    }
+done:
+    Py_XDECREF(meta);
+    Py_XDECREF(spec);
+    Py_XDECREF(status);
+    dec_free(f.c);
+    PyBuffer_Release(&view);
+    if (!out) {
+        if (PyErr_Occurred()) return NULL;  // hard error (e.g. WireError)
+        Py_RETURN_NONE;  // structural bail -> reference path
+    }
+    return out;
+}
+
+// ---- setup ------------------------------------------------------------------
+
+static PyObject* ref_get(PyObject* refs, const char* name) {
+    PyObject* v = PyDict_GetItemString(refs, name);
+    if (!v) {
+        PyErr_Format(PyExc_KeyError, "wire codec setup missing ref %s", name);
+        return NULL;
+    }
+    Py_INCREF(v);
+    return v;
+}
+
+static PyObject* py_setup(PyObject* self, PyObject* args) {
+    PyObject *wk_list, *refs;
+    if (!PyArg_ParseTuple(args, "OO", &wk_list, &refs)) return NULL;
+    if (!PyList_Check(wk_list) || !PyDict_Check(refs)) {
+        PyErr_SetString(PyExc_TypeError, "setup(wk_list, refs_dict)");
+        return NULL;
+    }
+    if (g_ready) Py_RETURN_NONE;  // one configuration per process
+    g_wk = new std::unordered_map<std::string, uint32_t>();
+    g_wk_strs = new std::vector<PyObject*>();
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(wk_list); i++) {
+        PyObject* s = PyList_GET_ITEM(wk_list, i);
+        if (!PyUnicode_Check(s)) {
+            PyErr_SetString(PyExc_TypeError, "well-known entries must be str");
+            return NULL;
+        }
+        Py_INCREF(s);
+        PyUnicode_InternInPlace(&s);
+        g_wk_strs->push_back(s);
+        Py_ssize_t len;
+        const char* u = PyUnicode_AsUTF8AndSize(s, &len);
+        if (!u) return NULL;
+        g_wk->emplace(std::string(u, (size_t)len), (uint32_t)i);
+    }
+    for (int i = 0; i < N_COUNT; i++) {
+        g_name_py[i] = PyUnicode_InternFromString(NAME_STRS[i]);
+        if (!g_name_py[i]) return NULL;
+        auto it = g_wk->find(NAME_STRS[i]);
+        g_name_wk[i] = it == g_wk->end() ? -1 : (int32_t)it->second;
+    }
+    if (!(g_WireError = ref_get(refs, "WireError")) ||
+        !(g_new_uid = ref_get(refs, "new_uid")) ||
+        !(g_now = ref_get(refs, "now")) ||
+        !(g_cls_Pod = ref_get(refs, "Pod")) ||
+        !(g_cls_ObjectMeta = ref_get(refs, "ObjectMeta")) ||
+        !(g_cls_PodSpec = ref_get(refs, "PodSpec")) ||
+        !(g_cls_PodStatus = ref_get(refs, "PodStatus")) ||
+        !(g_cls_Container = ref_get(refs, "Container")) ||
+        !(g_cls_RR = ref_get(refs, "ResourceRequirements")) ||
+        !(g_cls_ContainerPort = ref_get(refs, "ContainerPort")) ||
+        !(g_cls_Node = ref_get(refs, "Node")) ||
+        !(g_cls_NodeSpec = ref_get(refs, "NodeSpec")) ||
+        !(g_cls_NodeStatus = ref_get(refs, "NodeStatus")) ||
+        !(g_cls_Taint = ref_get(refs, "Taint")) ||
+        !(g_cls_ContainerImage = ref_get(refs, "ContainerImage")))
+        return NULL;
+    g_object_new = PyObject_GetAttrString((PyObject*)&PyBaseObject_Type,
+                                          "__new__");
+    if (!g_object_new) return NULL;
+    // build() allocates with tp_alloc, which is only object.__new__'s
+    // behavior while no class overrides __new__ — verify that holds
+    PyObject* built[] = {g_cls_Pod, g_cls_ObjectMeta, g_cls_PodSpec,
+                         g_cls_PodStatus, g_cls_Container, g_cls_RR,
+                         g_cls_ContainerPort, g_cls_Node, g_cls_NodeSpec,
+                         g_cls_NodeStatus, g_cls_Taint, g_cls_ContainerImage};
+    for (PyObject* cls : built) {
+        if (!PyType_Check(cls) ||
+            ((PyTypeObject*)cls)->tp_new != PyBaseObject_Type.tp_new) {
+            PyErr_SetString(PyExc_TypeError,
+                            "wire fast path requires plain __new__ classes");
+            return NULL;
+        }
+    }
+    g_ready = 1;
+    Py_RETURN_NONE;
+}
+
+// ---- module -----------------------------------------------------------------
+
+static PyMethodDef wire_methods[] = {
+    {"setup", py_setup, METH_VARARGS,
+     "setup(well_known_list, refs_dict) — configure the codec once"},
+    {"encode_value", py_encode_value, METH_O,
+     "manifest value -> wire v1 document bytes"},
+    {"decode_value", py_decode_value, METH_O,
+     "wire v1 document bytes -> manifest value (strict)"},
+    {"encode_pod", py_encode_pod, METH_O,
+     "Pod -> wire document, or None when outside the fast subset"},
+    {"encode_node", py_encode_node, METH_O,
+     "Node -> wire document, or None when outside the fast subset"},
+    {"decode_object", py_decode_object, METH_O,
+     "wire document -> typed Pod/Node, or None to use the reference path"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef wire_module = {
+    PyModuleDef_HEAD_INIT, "ktpu_wire_codec",
+    "wire v1 codec fast path (see api/wire.py for the format spec)",
+    -1, wire_methods,
+};
+
+PyMODINIT_FUNC PyInit_ktpu_wire_codec(void) {
+    return PyModule_Create(&wire_module);
+}

@@ -260,8 +260,7 @@ class FollowerReplica:
             self._f.flush()
             scheme = self.scheme()
             for _, rec in records:
-                obj = (scheme.decode(rec.manifest)
-                       if rec.manifest is not None else None)
+                obj = rec.decode_obj(scheme)
                 self.store.replay_record(
                     rec.op, rec.kind, obj=obj, namespace=rec.namespace,
                     name=rec.name, node_name=rec.node_name, rv=rec.rv)
@@ -326,8 +325,7 @@ class FollowerReplica:
             for off, rec in records:
                 if off < self._applied_offset:
                     continue
-                obj = (scheme.decode(rec.manifest)
-                       if rec.manifest is not None else None)
+                obj = rec.decode_obj(scheme)
                 self.store.replay_record(
                     rec.op, rec.kind, obj=obj, namespace=rec.namespace,
                     name=rec.name, node_name=rec.node_name, rv=rec.rv)

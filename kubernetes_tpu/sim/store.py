@@ -51,6 +51,12 @@ class WatchEvent:
     kind: str
     obj: object
     resource_version: int
+    # encode-once serving: the watch cache stamps the object's
+    # api.wire.EncodedPayload here at apply time, so every downstream
+    # consumer (HTTP fan-out, WAL, replication) serves cached bytes
+    # instead of re-serializing.  None for events that never crossed a
+    # watch cache (direct store watchers encode on demand).
+    payload: object = None
 
 
 class ObjectStore:

@@ -8,15 +8,21 @@ a fresh process reconstructs the exact store by replay.
 
 Record format (length-prefixed + checksummed, wal/decoder.go shape):
 
-    ``>I`` payload length | ``>I`` crc32(payload) | payload (JSON)
+    ``>I`` payload length | ``>I`` crc32(payload) | payload
 
-The payload carries the op (``create``/``update``/``delete``/``bind``), the
-final resourceVersion the store assigned, and — for create/update — the
-object's wire manifest (api/serialize.to_manifest; WAL fidelity is
-wire-manifest fidelity, the same form etcd stores).  ``replay_on_boot``
-re-applies records through ``ObjectStore.replay_record`` and TRUNCATES a
-torn tail record (a crash mid-append leaves a prefix whose length or crc
-cannot verify — everything before it is intact by construction).
+The payload is a binary wire document (api/wire.py, sniffed by its magic;
+logs written before the wire plane carry JSON payloads and replay
+identically — mixed-format logs are a supported upgrade path).  It carries
+the op (``create``/``update``/``delete``/``bind``), the final
+resourceVersion the store assigned, and — for create/update — the object's
+self-contained wire doc under ``objw`` (the SAME bytes the watch cache
+fanned out: appending is a memo hit, not an encode; legacy records carry
+the manifest dict under ``obj`` instead).  WAL fidelity is wire fidelity —
+``scheme.decode(wire_decode(objw)) == scheme.decode(manifest)`` is pinned
+for every kind.  ``replay_on_boot`` re-applies records through
+``ObjectStore.replay_record`` and TRUNCATES a torn tail record (a crash
+mid-append leaves a prefix whose length or crc cannot verify — everything
+before it is intact by construction).
 
 fsync cadence is configurable (``fsync_every``: 1 = every append, the
 acknowledged-implies-durable contract; N = every N appends — bounded loss
@@ -41,6 +47,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..analysis import lockcheck
+from ..api import wire
 from ..chaos.faults import (
     CRASH_PRE_WAL_FSYNC,
     CRASH_TORN_WAL_WRITE,
@@ -63,22 +70,63 @@ class WALRecord:
     rv: int
     manifest: Optional[dict] = None  # create/update: the object's wire form
     node_name: str = ""              # bind: the target node
+    # create/update in a binary record: the object's self-contained wire
+    # doc — the encode-once bytes (manifest stays populated on decode so
+    # forensic consumers keep reading one field)
+    obj_bytes: Optional[bytes] = None
+    codec: str = "wire"              # payload() emission format
 
     def payload(self) -> bytes:
         body = {"op": self.op, "kind": self.kind, "ns": self.namespace,
                 "name": self.name, "rv": self.rv}
+        if self.codec == "wire":
+            if self.obj_bytes is not None:
+                # BYTES-embedded verbatim: the envelope encode copies the
+                # cached object bytes, it never re-serializes the object
+                body["objw"] = self.obj_bytes
+            elif self.manifest is not None:
+                body["obj"] = self.manifest
+            if self.node_name:
+                body["node"] = self.node_name
+            return wire.wire_encode(body)
         if self.manifest is not None:
             body["obj"] = self.manifest
+        elif self.obj_bytes is not None:
+            body["obj"] = wire.wire_decode(self.obj_bytes)
         if self.node_name:
             body["node"] = self.node_name
         return json.dumps(body, separators=(",", ":")).encode()
 
     @classmethod
     def from_payload(cls, raw: bytes) -> "WALRecord":
+        """Decode one record payload, binary or JSON (magic sniff): logs
+        from before the wire plane — and mixed-format logs mid-upgrade —
+        replay through the same path."""
+        if wire.is_wire(raw):
+            body = wire.wire_decode(raw)
+            objw = body.get("objw")
+            manifest = body.get("obj")
+            if manifest is None and objw is not None:
+                manifest = wire.wire_decode(objw)
+            return cls(op=body["op"], kind=body["kind"],
+                       namespace=body["ns"], name=body["name"],
+                       rv=body["rv"], manifest=manifest, obj_bytes=objw,
+                       node_name=body.get("node", ""), codec="wire")
         body = json.loads(raw)
         return cls(op=body["op"], kind=body["kind"], namespace=body["ns"],
                    name=body["name"], rv=body["rv"],
-                   manifest=body.get("obj"), node_name=body.get("node", ""))
+                   manifest=body.get("obj"), node_name=body.get("node", ""),
+                   codec="json")
+
+    def decode_obj(self, scheme):
+        """The record's object (None for delete/bind), decoded by the
+        fastest available path: the wire doc takes the native decoder,
+        legacy manifests take scheme.decode — pinned to agree."""
+        if self.obj_bytes is not None:
+            return wire.decode_object(self.obj_bytes, scheme)
+        if self.manifest is not None:
+            return scheme.decode(self.manifest)
+        return None
 
 
 class WriteAheadLog:
@@ -133,16 +181,17 @@ class WriteAheadLog:
         if kind in self.exempt_kinds:
             return
         if obj is not None:
-            from ..api.serialize import to_manifest
-
-            manifest = to_manifest(obj, self.scheme())
+            # encode-once: the object's payload memo (api.wire) is shared
+            # with the watch cache and the HTTP planes — whichever plane
+            # touches this object version first pays the encode
+            obj_bytes = wire.payload_for(obj, self.scheme()).wire_bytes()
             meta = obj.metadata
             namespace = namespace or getattr(meta, "namespace", "")
             name = name or meta.name
         else:
-            manifest = None
+            obj_bytes = None
         rec = WALRecord(op=op, kind=kind, namespace=namespace, name=name,
-                        rv=rv, manifest=manifest, node_name=node_name)
+                        rv=rv, obj_bytes=obj_bytes, node_name=node_name)
         # wal_append span: parented to the caller's attempt tree when the
         # explicit trace_parent handoff carried one (store bind path); a
         # direct store write without a context records a root span.  Guarded
@@ -307,7 +356,7 @@ def replay_on_boot(path: str, *, store=None, scheme=None,
         klog.V(1).info_s("WAL torn tail truncated", path=path,
                          at=good_end, lost_bytes=size - good_end)
     for _, rec in records:
-        obj = scheme.decode(rec.manifest) if rec.manifest is not None else None
+        obj = rec.decode_obj(scheme)
         store.replay_record(rec.op, rec.kind, obj=obj,
                             namespace=rec.namespace, name=rec.name,
                             node_name=rec.node_name, rv=rec.rv)

@@ -20,8 +20,16 @@ etcd sees exactly one watch.  Mirrored behaviors:
     idle watchers' restart points fresh so a reconnect replays almost
     nothing instead of relisting the world.
 
-Ring sizing: each entry holds the event plus the PREVIOUS wire manifest of
-the object (captured at apply time — the only moment the pre-state exists;
+Encode once, fan out bytes: ``_apply`` captures the object's encoded
+payload (``api.wire.EncodedPayload`` — wire bytes and JSON bytes, lazily
+materialized per codec) exactly once per write and stamps it on the
+WatchEvent; the HTTP watch/list planes, the WAL, and replication all serve
+those cached bytes verbatim, so a thousand watchers cost ONE encode per
+codec instead of a thousand ``json.dumps`` calls (upstream: the cacher
+serving pre-encoded protobuf objects).
+
+Ring sizing: each entry holds the event plus the PREVIOUS payload of the
+object (its apply-time capture — the only moment the pre-state exists;
 in-process callers that mutate objects in place carry the same
 elided-history caveat client/informer.py documents).  A ring of R events
 serves any watcher or continue token that lags by < R writes; older ones
@@ -52,9 +60,12 @@ class TooOldResourceVersion(ValueError):
 @dataclass
 class _RingEntry:
     ev: WatchEvent
-    # the object's wire form BEFORE this event applied (None for ADDED):
-    # what list-at-rv rollback restores
-    prev_manifest: Optional[dict]
+    # the object's EncodedPayload BEFORE this event applied (None for
+    # ADDED): what list-at-rv rollback restores.  Holding the payload —
+    # not a manifest dict — means the pre-state was already encoded when
+    # ITS event applied; rollback decodes it lazily and the ring stays an
+    # index over payloads, never a second copy of the data.
+    prev_payload: Optional[object]
 
 
 class _CacheWatcher:
@@ -94,6 +105,12 @@ class WatchCache:
     def __init__(self, store: ObjectStore, scheme=None,
                  ring_size: int = 4096):
         self._store = store
+        if scheme is None:
+            # resolve eagerly: scheme() must be a pure read — _apply calls
+            # it on the writer's thread outside the cache lock
+            from ..api.scheme import default_scheme
+
+            scheme = default_scheme()
         self._scheme = scheme
         self.ring_size = ring_size
         self._lock = lockcheck.maybe_wrap(threading.RLock(),
@@ -142,10 +159,6 @@ class WatchCache:
         self._unwatch = store.watch(self._apply)
 
     def scheme(self):
-        if self._scheme is None:
-            from ..api.scheme import default_scheme
-
-            self._scheme = default_scheme()
         return self._scheme
 
     # --- write side: the store's fan-out ------------------------------------
@@ -162,19 +175,26 @@ class WatchCache:
         Runs on the writer's thread under the STORE lock (we are a store
         watcher) — but handler/callback invocation happens OUTSIDE the
         cache lock, so no lock order cache→anything is ever created."""
-        from ..api.serialize import to_manifest
+        from ..api import wire
 
         key = self._key(ev)
+        scheme = self.scheme()
+        # THE encode-once moment: capture the object's payload exactly once
+        # per write and stamp it on the event — every serving plane
+        # downstream (HTTP fan-out, LIST, WAL, replication) reuses it
+        ev.payload = wire.payload_for(ev.obj, scheme)
         with self._lock:
             prev = self._objects.get(key)
-            prev_manifest = (to_manifest(prev, self.scheme())
-                             if prev is not None else None)
+            # the pre-state's payload was captured when ITS event applied,
+            # so this is a memo hit, not an encode
+            prev_payload = (wire.payload_for(prev, scheme)
+                            if prev is not None else None)
             if ev.type == DELETED:
                 self._objects.pop(key, None)
             else:
                 self._objects[key] = ev.obj
             self._rv = ev.resource_version
-            self._ring.append(_RingEntry(ev, prev_manifest))
+            self._ring.append(_RingEntry(ev, prev_payload))
             self._ring_rvs.append(ev.resource_version)
             if len(self._ring) > 2 * self.ring_size:
                 drop = len(self._ring) - self.ring_size
@@ -271,14 +291,15 @@ class WatchCache:
         start = bisect.bisect_right(self._ring_rvs, rv)
         for entry in reversed(self._ring[start:]):
             key = self._key(entry.ev)
-            if entry.prev_manifest is None:  # ADDED: did not exist before
+            if entry.prev_payload is None:  # ADDED: did not exist before
                 out.pop(key, None)
             else:
-                obj = self.scheme().decode(entry.prev_manifest)
+                manifest = entry.prev_payload.manifest()
+                obj = self.scheme().decode(manifest)
                 # decode drops resourceVersion on purpose (server write
                 # paths re-stamp it); a rolled-back object must carry the
                 # rv it HAD, or list-at-rv would not be bit-faithful
-                prev_rv = (entry.prev_manifest.get("metadata") or {}) \
+                prev_rv = (manifest.get("metadata") or {}) \
                     .get("resourceVersion")
                 if prev_rv:
                     obj.metadata.resource_version = int(prev_rv)

@@ -193,6 +193,30 @@ def test_cli_main_controlplane_status(capsys):
     assert "wal" in out and "watch-cache" in out and "flow-" in out
 
 
+def test_cli_controlplane_status_wire_rows():
+    """The wire block (round 19): after real negotiated traffic the table
+    shows the per-codec request split and the encode-cache hit rate."""
+    import urllib.request
+
+    from kubernetes_tpu.apiserver.client import HTTPApiClient
+    from kubernetes_tpu.apiserver.server import APIServer
+    from kubernetes_tpu.metrics.registry import parse_text
+
+    store = ObjectStore()
+    api = APIServer(store).start()
+    try:
+        HTTPApiClient(api.url, codec="wire").create(
+            "Pod", make_pod().name("wp").uid("wp").namespace("default").obj())
+        HTTPApiClient(api.url, codec="json").list("Pod")
+        with urllib.request.urlopen(f"{api.url}/metrics") as r:
+            metrics = parse_text(r.read().decode())
+        out = Kubectl(store).controlplane_status(metrics=metrics)
+        assert "requests-wire" in out and "requests-json" in out
+        assert "encode-cache-hit-rate" in out
+    finally:
+        api.stop()
+
+
 def test_cli_controlplane_status_over_server():
     """--server path: the verb reads the apiserver's /metrics exposition
     and renders the same table the in-process path does."""

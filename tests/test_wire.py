@@ -1,0 +1,534 @@
+"""Binary wire plane: codec round-trip parity, encode-once payloads,
+torn-record rejection, mixed-format WAL replay, HTTP negotiation.
+
+The contract under test (ISSUE 19):
+``scheme.decode(wire_decode(wire_encode(m))) == scheme.decode(m)`` for
+every registered kind, across BOTH backends (pure Python and the native
+extension), plus the serving-plane property that one write costs one
+encode per codec no matter how many watchers fan out.
+"""
+
+import dataclasses
+import io
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import objects as v1
+from kubernetes_tpu.api import wire
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import to_manifest
+from kubernetes_tpu.metrics import scheduler_metrics as m
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.sim.wal import (
+    WALRecord,
+    WriteAheadLog,
+    replay_on_boot,
+    scan_records,
+)
+from kubernetes_tpu.sim.watchcache import WatchCache
+from kubernetes_tpu.testutil import make_node, make_pod
+
+SCHEME = default_scheme()
+
+BACKENDS = [True] + ([False] if wire._native() is not None else [])
+
+
+# --- value-level codec -------------------------------------------------------
+
+VALUES = [
+    None, True, False, 0, 1, -1, 7, -7, 127, 128, -128, 2**31, -(2**31),
+    2**63 - 1, -(2**63), 0.0, -1.5, 3.14159, 1e300, "", "x", "pod",
+    "üñïçødé-☃\U0001F600", "a" * 300, b"", b"\x00\xff raw",
+    [], [1, 2, 3], ["a", "a", "a"], {}, {"k": "v"},
+    {"kind": "Pod", "metadata": {"labels": {"app": "web", "tier": "web"}}},
+    [{"deep": [{"deeper": [None, True, {"n": -42}]}]}],
+    {"repeat": ["default", "default", "default-scheduler", "Pending"]},
+]
+
+
+@pytest.mark.parametrize("force_python", BACKENDS)
+def test_value_roundtrip(force_python):
+    for val in VALUES:
+        blob = wire.wire_encode(val, force_python=force_python)
+        assert blob[:3] == wire.WIRE_MAGIC
+        assert wire.is_wire(blob)
+        out = wire.wire_decode(blob, force_python=force_python)
+        assert out == val, val
+        assert type(out) is type(val) or isinstance(val, bool)
+
+
+def test_cross_backend_byte_parity():
+    """The native encoder must emit BYTE-IDENTICAL documents to the pure
+    Python reference (cached bytes are shared between both backends)."""
+    if wire._native() is None:
+        pytest.skip("no native codec in this environment")
+    for val in VALUES:
+        assert wire.wire_encode(val) == wire.wire_encode(
+            val, force_python=True), val
+        # and each backend decodes the other's output
+        blob = wire.wire_encode(val)
+        assert wire.wire_decode(blob) == wire.wire_decode(
+            blob, force_python=True)
+
+
+def test_encode_rejects_unsupported():
+    with pytest.raises((TypeError, wire.WireError)):
+        wire.wire_encode(object(), force_python=True)
+    with pytest.raises((ValueError, TypeError)):
+        wire.wire_encode({1: "non-string key"}, force_python=True)
+    with pytest.raises((OverflowError, ValueError)):
+        wire.wire_encode(2**64, force_python=True)
+
+
+@pytest.mark.parametrize("force_python", BACKENDS)
+def test_strict_decode_rejects_torn_and_trailing(force_python):
+    blob = wire.wire_encode(
+        {"kind": "Pod", "items": [1, 2.5, "x", None, b"b"]},
+        force_python=True)
+    # every strict prefix is torn
+    for cut in range(len(blob)):
+        with pytest.raises(ValueError):
+            wire.wire_decode(blob[:cut], force_python=force_python)
+    with pytest.raises(ValueError):
+        wire.wire_decode(blob + b"\x00", force_python=force_python)
+    with pytest.raises(ValueError):  # JSON is never wire
+        wire.wire_decode(b'{"kind": "Pod"}', force_python=force_python)
+    with pytest.raises(ValueError):  # future format version
+        wire.wire_decode(wire.WIRE_MAGIC + b"\x02" + blob[4:],
+                         force_python=force_python)
+    assert not wire.is_wire(b'{"json": true}')
+    assert not wire.is_wire(b"")
+
+
+# --- every registered kind, randomized ---------------------------------------
+
+_UNICODE_POOL = ["web", "üñïçødé", "☃-snow", "data-\U0001F600",
+                 "zone/a", "", "x" * 80]
+
+
+def _randomize(obj, rng, depth=0):
+    """Walk a dataclass instance and fill primitive fields with random
+    values (property-style field population: serialize/decode are generic,
+    so any value a field can hold must round-trip)."""
+    if depth > 4 or not dataclasses.is_dataclass(obj):
+        return
+    for f in dataclasses.fields(obj):
+        cur = getattr(obj, f.name, None)
+        if f.name in ("resource_version", "owner_references"):
+            continue
+        if isinstance(cur, bool):
+            setattr(obj, f.name, rng.random() < 0.5)
+        elif isinstance(cur, int) and rng.random() < 0.7:
+            setattr(obj, f.name, rng.randrange(-5, 10**6))
+        elif isinstance(cur, float):
+            setattr(obj, f.name, round(rng.uniform(0, 10**6), 3))
+        elif isinstance(cur, str) and rng.random() < 0.7:
+            setattr(obj, f.name, rng.choice(_UNICODE_POOL))
+        elif (isinstance(cur, dict) and rng.random() < 0.5
+              and f.name in ("labels", "annotations", "node_selector")):
+            cur = dict(cur)
+            cur[rng.choice(_UNICODE_POOL) or "k"] = rng.choice(_UNICODE_POOL)
+            setattr(obj, f.name, cur)
+        elif dataclasses.is_dataclass(cur):
+            _randomize(cur, rng, depth + 1)
+        elif isinstance(cur, list):
+            for item in cur:
+                _randomize(item, rng, depth + 1)
+
+
+def _normalized(obj):
+    d = to_manifest(obj, SCHEME)
+    meta = d.setdefault("metadata", {})
+    meta.pop("uid", None)  # decode regenerates when absent/falsy
+    meta.pop("creationTimestamp", None)
+    return d
+
+
+@pytest.mark.parametrize("force_python", BACKENDS)
+def test_every_registered_kind_roundtrips(force_python):
+    """The tentpole contract, for all 25+ registered kinds with
+    randomized field population and unicode labels, both codecs."""
+    for entry in sorted(SCHEME.recognized()):
+        kind = entry.split(":", 1)[1]
+        rng = random.Random(entry)
+        obj = SCHEME.decode({"kind": kind, "metadata": {
+            "name": "obj-1", "namespace": "prod",
+            "labels": {"app": "web", "ünïcode": "☃"},
+            "annotations": {"note": "a" * 120},
+        }})
+        _randomize(obj, rng)
+        manifest = to_manifest(obj, SCHEME)
+        blob = wire.wire_encode(manifest, force_python=force_python)
+        # value-exact round trip: the wire doc IS the manifest
+        assert wire.wire_decode(blob, force_python=force_python) == manifest
+        # decoded-object equivalence across codecs (the ISSUE contract)
+        via_wire = SCHEME.decode(wire.wire_decode(blob))
+        via_json = SCHEME.decode(json.loads(json.dumps(manifest)))
+        assert _normalized(via_wire) == _normalized(via_json), kind
+        if wire._native() is not None:
+            assert blob == wire.wire_encode(manifest), kind
+
+
+def test_defaults_present_vs_elided():
+    """A manifest with defaults spelled out and one with them elided must
+    decode to the same object through the wire codec."""
+    elided = {"kind": "Pod", "metadata": {"name": "p"},
+              "spec": {"containers": [{"name": "c", "image": "i"}]}}
+    present = {"kind": "Pod", "apiVersion": "v1",
+               "metadata": {"name": "p", "namespace": "default"},
+               "spec": {"containers": [{"name": "c", "image": "i",
+                                        "ports": []}],
+                        "schedulerName": "default-scheduler",
+                        "preemptionPolicy": "PreemptLowerPriority",
+                        "hostNetwork": False, "nodeSelector": {}},
+               "status": {"phase": "Pending"}}
+    objs = []
+    for manifest in (elided, present):
+        for fp in BACKENDS:
+            blob = wire.wire_encode(manifest, force_python=fp)
+            objs.append(SCHEME.decode(wire.wire_decode(blob,
+                                                       force_python=fp)))
+    norm = [_normalized(o) for o in objs]
+    assert all(n == norm[0] for n in norm)
+
+
+# --- native object fast paths ------------------------------------------------
+
+def _rich_pod(i=0):
+    pod = (make_pod().name(f"web-{i}").uid(f"uid-{i}").namespace("prod")
+           .label("app", "web").label("tier", "fe")
+           .req({"cpu": "500m", "memory": "1Gi"}).priority(1000)
+           .obj())
+    pod.spec.containers[0].ports = [v1.ContainerPort(container_port=8080)]
+    pod.spec.node_name = f"node-{i % 3}"
+    pod.spec.node_selector = {"pool": "general"}
+    pod.status.phase = "Running"
+    pod.status.pod_ip = f"10.0.0.{i % 250}"
+    pod.status.conditions = [{"type": "Ready", "status": "True"}]
+    return pod
+
+
+def _rich_node(i=0):
+    node = (make_node().name(f"node-{i}").label("zone", "us-a")
+            .capacity({"cpu": "16", "memory": "64Gi", "google.com/tpu": "4"})
+            .taint("tpu", "v5e", "NoSchedule").obj())
+    node.status.images = [v1.ContainerImage(names=["nginx:1.25"],
+                                            size_bytes=187654321)]
+    node.status.conditions = [{"type": "Ready", "status": "True"}]
+    node.spec.pod_cidr = "10.4.0.0/24"
+    return node
+
+
+def test_fast_path_parity_with_reference():
+    """encode_object must emit the SAME bytes as the pure-Python reference
+    walking to_manifest, and decode_object must agree with scheme.decode —
+    the native fast paths are behaviorally invisible."""
+    for obj in [_rich_pod(0), _rich_pod(1), _rich_node(0),
+                v1.Pod(metadata=v1.ObjectMeta(name="bare")),
+                v1.Node(metadata=v1.ObjectMeta(name="bare-n"))]:
+        manifest = to_manifest(obj, SCHEME)
+        fast = wire.encode_object(obj, SCHEME)
+        ref = wire.wire_encode(manifest, force_python=True)
+        assert fast == ref, obj.kind
+        got = wire.decode_object(fast, SCHEME)
+        want = SCHEME.decode(manifest)
+        assert _normalized(got) == _normalized(want), obj.kind
+
+
+def test_fast_decode_quirk_parity():
+    """from_dict quirks the native decoder must honor: empty allocatable
+    copies capacity; absent namespace defaults; rv is dropped."""
+    node = _rich_node(1)
+    node.status.allocatable = {}
+    blob = wire.encode_object(node, SCHEME)
+    got = wire.decode_object(blob, SCHEME)
+    want = SCHEME.decode(to_manifest(node, SCHEME))
+    assert got.status.allocatable == want.status.allocatable
+    assert got.status.allocatable == got.status.capacity
+    assert got.status.allocatable is not got.status.capacity
+
+    pod = _rich_pod(2)
+    pod.metadata.resource_version = 77
+    got = wire.decode_object(wire.encode_object(pod, SCHEME), SCHEME)
+    assert got.metadata.resource_version == 0  # from_dict drops rv
+    assert got.metadata.namespace == "prod"
+
+
+def test_encode_object_bails_safely_on_stand_ins():
+    """Objects outside the fast subset (odd attribute shapes) must fall
+    back to the reference path, never emit wrong bytes."""
+    pod = _rich_pod(3)
+    pod.spec.affinity = v1.Affinity()  # non-None affinity → bail
+    fast = wire.encode_object(pod, SCHEME)
+    assert fast == wire.wire_encode(to_manifest(pod, SCHEME),
+                                    force_python=True)
+
+
+# --- EncodedPayload / encode-once --------------------------------------------
+
+def test_encoded_payload_lazy_and_stable():
+    pod = _rich_pod(4)
+    p = wire.EncodedPayload.from_object(pod, SCHEME)
+    wb = p.wire_bytes()
+    jb = p.json_bytes()
+    assert wire.is_wire(wb) and not wire.is_wire(jb)
+    assert json.loads(jb) == wire.wire_decode(wb) == p.manifest()
+    # identical objects on repeat (cached, not re-encoded)
+    assert p.wire_bytes() is wb
+    assert p.json_bytes() is jb
+    assert p.bytes_for("wire") is wb and p.bytes_for("json") is jb
+
+
+def test_payload_for_memoizes_per_rv():
+    pod = _rich_pod(5)
+    pod.metadata.resource_version = 3
+    p1 = wire.payload_for(pod, SCHEME)
+    assert wire.payload_for(pod, SCHEME) is p1
+    pod.metadata.resource_version = 4  # store-mediated mutation
+    p2 = wire.payload_for(pod, SCHEME)
+    assert p2 is not p1
+
+
+def test_watch_cache_encodes_once_per_event():
+    """The headline fan-out property: N watchers of one event cost ONE
+    json encode (and one wire encode), not N."""
+    store = ObjectStore()
+    cache = WatchCache(store, SCHEME)
+    seen = [[] for _ in range(8)]
+    for lane in seen:
+        store_events = lane
+        cache.watch(lane.append)
+    base_uncached = m.apiserver_wire_encode.value(("json", "false"))
+    pod = _rich_pod(6)
+    store.create("Pod", pod)
+    payloads = set()
+    for lane in seen:
+        assert len(lane) == 1
+        assert lane[0].payload is not None
+        lane[0].payload.json_bytes()
+        payloads.add(id(lane[0].payload))
+    assert len(payloads) == 1  # every watcher holds THE payload
+    assert m.apiserver_wire_encode.value(("json", "false")) \
+        == base_uncached + 1
+    cache.close()
+
+
+def test_watch_cache_rollback_from_prev_payload():
+    """rv-consistent pagination still rolls back through the ring when
+    entries hold payloads instead of manifests."""
+    store = ObjectStore()
+    cache = WatchCache(store, SCHEME)
+    pod = _rich_pod(7)
+    store.create("Pod", pod)
+    rv1 = cache.current_rv()
+    pod2 = _rich_pod(7)
+    pod2.metadata.uid = pod.metadata.uid
+    pod2.status.phase = "Succeeded"
+    store.update("Pod", pod2)
+    objs, rv, _ = cache.list_page("Pod", resource_version=rv1)
+    assert rv == rv1 and len(objs) == 1
+    assert objs[0].status.phase == "Running"
+    assert objs[0].metadata.resource_version == rv1
+    now_objs, _, _ = cache.list_page("Pod")
+    assert now_objs[0].status.phase == "Succeeded"
+    cache.close()
+
+
+# --- watch frames ------------------------------------------------------------
+
+def test_watch_frame_roundtrip_and_torn_rejection():
+    doc = wire.wire_encode(to_manifest(_rich_pod(8), SCHEME))
+    frames = (wire.encode_watch_frame("ADDED", doc, rv=12)
+              + wire.encode_watch_frame("BOOKMARK", wire.wire_encode(
+                  {"kind": "Pod"}), rv=13))
+    stream = io.BytesIO(frames)
+    t1, rv1, d1 = wire.read_watch_frame(stream)
+    assert (t1, rv1, d1) == ("ADDED", 12, doc)
+    t2, rv2, _ = wire.read_watch_frame(stream)
+    assert (t2, rv2) == ("BOOKMARK", 13)
+    assert wire.read_watch_frame(stream) is None  # clean EOF
+    frame1_len = len(wire.encode_watch_frame("ADDED", doc, rv=12))
+    for cut in range(1, len(frames) - 1):
+        if cut == frame1_len:
+            continue  # a whole frame + nothing is a clean EOF, not torn
+        s = io.BytesIO(frames[:cut])
+        with pytest.raises(wire.WireError):
+            while wire.read_watch_frame(s) is not None:
+                pass
+    with pytest.raises(wire.WireError):
+        wire.encode_watch_frame("NOPE", doc)
+
+
+# --- WAL: binary records, mixed-format replay, torn tails --------------------
+
+def _store_fingerprint(store):
+    out = {}
+    for kind in ("Pod", "Node"):
+        objs, _ = store.list(kind)
+        for o in objs:
+            d = to_manifest(o, SCHEME)
+            out[(kind, d["metadata"].get("namespace", ""),
+                 d["metadata"]["name"])] = d
+    return out
+
+
+def test_wal_binary_records_roundtrip(tmp_path):
+    path = str(tmp_path / "wal.log")
+    wal = WriteAheadLog(path, scheme=SCHEME, fsync_every=0)
+    store = ObjectStore(wal=wal)
+    for i in range(4):
+        store.create("Pod", _rich_pod(i))
+    store.create("Node", _rich_node(0))
+    pod_upd = _rich_pod(1)
+    pod_upd.status.phase = "Succeeded"
+    store.update("Pod", pod_upd)
+    store.delete("Pod", "prod", "web-0")
+    wal.close()
+    records, good_end = scan_records(open(path, "rb").read())
+    assert good_end == wal.size_bytes
+    assert all(r.codec == "wire" for _, r in records)
+    assert all(r.obj_bytes is not None for _, r in records
+               if r.op in ("create", "update"))
+    replayed = replay_on_boot(path, scheme=SCHEME).store
+    assert _store_fingerprint(replayed) == _store_fingerprint(store)
+
+
+def test_wal_mixed_format_replay_bit_identical(tmp_path):
+    """A log with legacy JSON records followed by binary records (an
+    in-place upgrade) reconstructs the exact store."""
+    import struct
+    import zlib
+
+    path = str(tmp_path / "mixed.log")
+    legacy, modern = _rich_pod(10), _rich_pod(11)
+    with open(path, "wb") as f:
+        for rec in [
+            WALRecord(op="create", kind="Pod", namespace="prod",
+                      name=legacy.metadata.name, rv=1,
+                      manifest=to_manifest(legacy, SCHEME), codec="json"),
+            WALRecord(op="create", kind="Pod", namespace="prod",
+                      name=modern.metadata.name, rv=2,
+                      obj_bytes=wire.encode_object(modern, SCHEME),
+                      codec="wire"),
+            WALRecord(op="bind", kind="Pod", namespace="prod",
+                      name=modern.metadata.name, rv=3,
+                      node_name="node-9", codec="wire"),
+        ]:
+            payload = rec.payload()
+            f.write(struct.pack(">II", len(payload), zlib.crc32(payload))
+                    + payload)
+    result = replay_on_boot(path, scheme=SCHEME)
+    assert result.records_applied == 3 and not result.truncated_tail
+    never_crashed = ObjectStore()
+    never_crashed.create("Pod", legacy)
+    m2 = _rich_pod(11)
+    m2.metadata.uid = modern.metadata.uid
+    never_crashed.create("Pod", m2)
+    never_crashed.bind_pod("prod", m2.metadata.name, "node-9")
+    fp_replay = _store_fingerprint(result.store)
+    fp_live = _store_fingerprint(never_crashed)
+    for d in list(fp_replay.values()) + list(fp_live.values()):
+        d["metadata"].pop("creationTimestamp", None)
+    assert fp_replay == fp_live
+
+
+def test_wal_torn_binary_tail_truncated(tmp_path):
+    path = str(tmp_path / "torn.log")
+    wal = WriteAheadLog(path, scheme=SCHEME, fsync_every=0)
+    store = ObjectStore(wal=wal)
+    for i in range(3):
+        store.create("Pod", _rich_pod(i))
+    wal.close()
+    whole = open(path, "rb").read()
+    # tear the last record mid-payload
+    with open(path, "wb") as f:
+        f.write(whole[:-7])
+    result = replay_on_boot(path, scheme=SCHEME)
+    assert result.truncated_tail
+    assert result.records_applied == 2
+    import os
+    assert os.path.getsize(path) == result.truncated_at
+    # corrupted byte inside a binary payload → crc refuses the record
+    data = bytearray(whole)
+    data[len(whole) // 2] ^= 0xFF
+    records, good = scan_records(bytes(data))
+    assert len(records) < 3
+
+
+# --- HTTP negotiation end-to-end ---------------------------------------------
+
+@pytest.fixture()
+def server():
+    from kubernetes_tpu.apiserver import APIServer
+
+    store = ObjectStore()
+    srv = APIServer(store, SCHEME).start()
+    yield srv
+    srv.stop()
+
+
+def test_http_codec_negotiation_end_to_end(server):
+    from kubernetes_tpu.apiserver import HTTPApiClient
+
+    wire_client = HTTPApiClient(server.url, SCHEME, codec="wire")
+    json_client = HTTPApiClient(server.url, SCHEME, codec="json")
+    base_wire = m.apiserver_wire_requests.value(("wire",))
+    base_json = m.apiserver_wire_requests.value(("json",))
+
+    pod = _rich_pod(20)
+    reply = wire_client.create("Pod", pod)  # wire body, wire response
+    assert reply["metadata"]["name"] == pod.metadata.name
+    json_client.create("Node", _rich_node(20))
+
+    for client in (wire_client, json_client):
+        got = client.get("Pod", "prod", pod.metadata.name)
+        assert got.spec.containers[0].image == pod.spec.containers[0].image
+        objs, rv = client.list("Pod")
+        assert len(objs) == 1 and rv > 0
+        assert _normalized(objs[0]) == _normalized(
+            SCHEME.decode(to_manifest(pod, SCHEME)))
+    # raw transport check: the wire client's LIST really is binary
+    import urllib.request
+
+    req = urllib.request.Request(server.url + "/api/v1/pods")
+    req.add_header("Accept", wire.WIRE_CONTENT_TYPE)
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        assert resp.headers.get("Content-Type") == wire.WIRE_CONTENT_TYPE
+        body = resp.read()
+    assert wire.is_wire(body)
+    doc = wire.wire_decode(body)
+    assert isinstance(doc["items"][0], bytes)
+    assert m.apiserver_wire_requests.value(("wire",)) > base_wire
+    assert m.apiserver_wire_requests.value(("json",)) > base_json
+
+
+@pytest.mark.parametrize("codec", ["wire", "json"])
+def test_http_watch_stream_both_codecs(server, codec):
+    from kubernetes_tpu.apiserver import HTTPApiClient
+
+    client = HTTPApiClient(server.url, SCHEME, codec=codec)
+    events = []
+    done = threading.Event()
+
+    def handler(ev):
+        events.append(ev)
+        if len(events) >= 2:
+            done.set()
+
+    client.watch_kind("Pod", handler, since_rv=0, timeout_seconds=10)
+    time.sleep(0.3)
+    server.store.create("Pod", _rich_pod(30))
+    upd = _rich_pod(30)
+    upd.status.phase = "Succeeded"
+    upd.metadata.resource_version = 0
+    server.store.update("Pod", upd)
+    assert done.wait(5), f"saw {len(events)} events over {codec}"
+    assert [e.type for e in events[:2]] == ["ADDED", "MODIFIED"]
+    assert events[0].resource_version > 0
+    assert events[1].resource_version > events[0].resource_version
+    assert events[0].obj.metadata.name == "web-30"
+    assert events[1].obj.status.phase == "Succeeded"

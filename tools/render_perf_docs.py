@@ -540,6 +540,48 @@ def render_r18_dra(r18):
     return "\n".join(lines)
 
 
+R19_BEGIN = ("<!-- GENERATED:PERF:R19WIRE:BEGIN (tools/render_perf_docs.py — "
+             "edit BENCH_r19_WIRE.json, not this block) -->")
+R19_END = "<!-- GENERATED:PERF:R19WIRE:END -->"
+
+
+def render_r19_wire(r19):
+    """Binary wire plane artifact block (BENCH_r19_WIRE.json, built by
+    tools/bench_wire.py): per-event codec ratios with min..max bands, byte
+    sizes, and the thousand-watcher encode-once fanout line."""
+    env = r19["environment"]
+    fan = r19["fanout"]
+    epe = fan["encodes_per_event"]
+
+    def row(name, d):
+        lo, hi = d["band_ratio"]
+        return (f"| {name} encode+decode | {d['median_json_us']:.1f} µs | "
+                f"{d['median_wire_us']:.1f} µs | "
+                f"**{d['median_ratio']:.1f}×** ({lo:.1f}–{hi:.1f}) | "
+                f"{d['json_bytes']} → {d['wire_bytes']} B |")
+
+    lines = [
+        R19_BEGIN,
+        "",
+        f"Environment: {env['cpus']} CPU core(s), native codec "
+        f"{'ON' if env['native_codec'] else 'OFF'} — {env['note']}",
+        "",
+        "| per event | JSON | wire | ratio (band) | payload |",
+        "|---|---|---|---|---|",
+        row("pod", r19["pod"]),
+        row("node", r19["node"]),
+        "",
+        f"Fan-out soak: {fan['n_watchers']} watchers × {fan['n_events']} "
+        f"events = {fan['deliveries']} deliveries; uncached encodes per "
+        f"event: wire {epe['wire']:.2f}, json {epe['json']:.2f} "
+        f"(encode-once holds — the cost is ~1 encode per codec, not "
+        f"~{fan['n_watchers']}).",
+        "",
+        R19_END,
+    ]
+    return "\n".join(lines)
+
+
 def splice(path, block, begin=BEGIN, end=END):
     p = os.path.join(REPO, path)
     text = open(p).read()
@@ -606,6 +648,13 @@ def main() -> int:
     if r18 is not None:
         ok &= splice("COMPONENTS.md", render_r18_dra(r18),
                      R18_BEGIN, R18_END)
+    try:
+        r19 = load_bench("BENCH_r19_WIRE.json")
+    except (OSError, json.JSONDecodeError):
+        r19 = None  # pre-round-19 trees have no wire-codec artifact
+    if r19 is not None:
+        ok &= splice("COMPONENTS.md", render_r19_wire(r19),
+                     R19_BEGIN, R19_END)
     return 0 if ok else 1
 
 

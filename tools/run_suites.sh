@@ -63,6 +63,20 @@ JAX_PLATFORMS=cpu timeout 900 python -m pytest \
   tests/test_wal.py tests/test_watchcache.py tests/test_flowcontrol.py \
   -q -m 'not slow' \
   || { echo "FAILED: control-plane test gate" >> suites_run.log; exit 1; }
+# wire-codec parity gate (round 19): the binary wire plane carries every
+# list/watch/WAL byte the suites below produce — a codec that diverges
+# from JSON by one field would corrupt stores silently, so pin round-trip
+# parity for every registered kind on BOTH backends (native C extension
+# and the KTPU_NO_NATIVE pure-Python fallback) before anything expensive
+JAX_PLATFORMS=cpu timeout 600 python -m pytest tests/test_wire.py -q \
+  || { echo "FAILED: wire codec parity gate" >> suites_run.log; exit 1; }
+JAX_PLATFORMS=cpu KTPU_NO_NATIVE=1 timeout 600 python -m pytest tests/test_wire.py -q \
+  || { echo "FAILED: wire pure-python parity gate" >> suites_run.log; exit 1; }
+# wire bench: the committed 10x per-event codec win and the encode-once
+# fanout property (1000 watchers, ~1 uncached encode per codec per event)
+# re-proven on THIS tree -> BENCH_r19_WIRE.json
+timeout 900 python tools/bench_wire.py \
+  || { echo "FAILED: wire bench gate" >> suites_run.log; exit 1; }
 # thousand-watcher churn soak: relist cost must stay FLAT across a 10x
 # object-count growth and the list/watch-replay path must take zero
 # store-lock reads (the "millions of users" control-plane property)
