@@ -181,6 +181,12 @@ def main():
             "allocated": int(ca.get("Count", 0)),
             "claims_per_s": ca.get("PerSecond", 0.0),
         }
+    if "TrainingJobThroughput" in data:
+        tj = data["TrainingJobThroughput"]
+        extra["trainingjobs"] = {
+            "jobs": int(tj.get("Jobs", 0)),
+            "jobs_per_s": tj.get("PerSecond", 0.0),
+        }
 
     p99_s = att["ExactPerc99"]
     vs_env_p99 = (env_sampled["attempt_ms"]["p99"] / 1e3) / p99_s if p99_s else 0.0

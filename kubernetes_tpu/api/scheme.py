@@ -37,6 +37,10 @@ class Scheme:
         # decode's lookup is one dict get under an uncontended lock.
         self._lock = threading.Lock()
         self._kinds: Dict[str, Tuple[str, str, Type]] = {}
+        # bumped on every add/remove so consumers caching derived maps
+        # (apiserver resource routing) can invalidate without a callback
+        # registry — the CRD registrar makes the kind set dynamic
+        self.generation = 0
         # spoke-version conversion registry (api/conversion.py); None = the
         # scheme serves canonical versions only
         self.converter = converter
@@ -61,8 +65,20 @@ class Scheme:
                     f"({prev[0]!r}, {prev[1]!r}); cannot re-register as "
                     f"({group!r}, {version!r})"
                 )
+            if prev is None:
+                self.generation += 1
             self._kinds[typ.kind] = (group, version, typ)
         return self
+
+    def remove_known_type(self, kind: str):
+        """Unregister a kind (CRD deletion).  Returns the removed type, or
+        None when the kind was not registered — removal is idempotent so a
+        replayed CRD-delete converges instead of erroring."""
+        with self._lock:
+            entry = self._kinds.pop(kind, None)
+            if entry is not None:
+                self.generation += 1
+        return None if entry is None else entry[2]
 
     def gv_of(self, typ: Type):
         """(group, version) a type is served under, or None (ObjectKinds)."""
@@ -71,6 +87,13 @@ class Scheme:
         if entry is None or entry[2] is not typ:
             return None
         return entry[0], entry[1]
+
+    def kind_types(self) -> Dict[str, Tuple[str, str, Type]]:
+        """Snapshot of kind → (group, version, type) — the registrar and
+        the apiserver's routing rebuild read it; pair with ``generation``
+        to cache derived maps."""
+        with self._lock:
+            return dict(self._kinds)
 
     def recognized(self) -> List[str]:
         with self._lock:
@@ -171,4 +194,15 @@ def default_scheme() -> Scheme:
     from ..controllers.podautoscaler import HorizontalPodAutoscaler
 
     s.add_known_type("autoscaling", "v2", HorizontalPodAutoscaler)
+    # tenant-definable kinds (apiextensions-apiserver): the CRD object
+    # itself is a built-in; the kinds it DEFINES are installed dynamically
+    # by apiextensions/registrar.py
+    from ..apiextensions.api import CustomResourceDefinition
+
+    s.add_known_type("apiextensions.k8s.io", "v1", CustomResourceDefinition)
+    from ..auth.api import (ClusterRole, ClusterRoleBinding, Role,
+                            RoleBinding)
+
+    for typ in (Role, ClusterRole, RoleBinding, ClusterRoleBinding):
+        s.add_known_type("rbac.authorization.k8s.io", "v1", typ)
     return s

@@ -142,6 +142,15 @@ def _storageclass_topologies(sc: v1.StorageClass):
 
 def _spec_status(obj) -> Dict[str, Any]:
     """Kind-specific body (everything except metadata/apiVersion/kind)."""
+    if getattr(obj, "_custom_resource", False):
+        # dynamically-registered kind (apiextensions/api.CustomResource):
+        # the body IS the manifest body, kept verbatim at decode time —
+        # serving it back is a copy, not a schema-aware walk.  Marker-attr
+        # dispatch, not an import: the apiextensions package imports the
+        # scheme, which imports this module.
+        import copy as _copy
+
+        return {k: _copy.deepcopy(val) for k, val in obj.body.items()}
     if isinstance(obj, (v1.Pod, v1.Node)):
         body = {"spec": (_pod_spec(obj.spec) if isinstance(obj, v1.Pod)
                          else _ser(obj.spec))}
@@ -282,6 +291,41 @@ def _spec_status(obj) -> Dict[str, Any]:
     if obj.__class__.__name__ == "ResourceClaimTemplate":
         return {"spec": {"spec": {
             "devices": {"requests": [_device_request(obj.request)]}}}}
+    if obj.__class__.__name__ == "CustomResourceDefinition":
+        # apiextensions family: name-based dispatch like NodeGroup below
+        versions = [
+            {"name": v, "served": True, "storage": v == obj.storage_version}
+            for v in obj.versions
+        ]
+        if obj.schema:
+            for entry in versions:
+                if entry["storage"]:
+                    entry["schema"] = {"openAPIV3Schema": obj.schema}
+        return {"spec": {
+            "group": obj.group,
+            "scope": obj.scope,
+            "names": {"plural": obj.names.plural,
+                      "singular": obj.names.singular,
+                      "kind": obj.names.kind,
+                      "listKind": obj.names.list_kind},
+            "versions": versions,
+        }}
+    if obj.__class__.__name__ in ("Role", "ClusterRole"):
+        return {"rules": [
+            {"verbs": list(r.verbs), "apiGroups": list(r.api_groups),
+             "resources": list(r.resources),
+             **({"resourceNames": list(r.resource_names)}
+                if r.resource_names else {})}
+            for r in obj.rules
+        ]}
+    if obj.__class__.__name__ in ("RoleBinding", "ClusterRoleBinding"):
+        return {
+            "subjects": [{"kind": s.kind, "name": s.name} for s in
+                         obj.subjects],
+            "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                        "kind": obj.role_ref.kind,
+                        "name": obj.role_ref.name},
+        }
     if obj.__class__.__name__ == "NodeGroup":
         # name-based dispatch like the HPA below: the type lives in the
         # autoscaler package and importing it here would cycle

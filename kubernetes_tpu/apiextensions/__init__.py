@@ -1,0 +1,22 @@
+"""Dynamic kind registration (apiextensions.k8s.io).
+
+Reference: staging/src/k8s.io/apiextensions-apiserver — the
+CustomResourceDefinition object model (``api.py``) and the dynamic
+registration machinery (``registrar.py``, the customresource_handler.go
+analog) that installs tenant-defined kinds into the scheme, store scoping,
+watch cache, WAL, and apiserver routing at runtime.
+"""
+
+from .api import (  # noqa: F401
+    CLUSTER_SCOPE,
+    NAMESPACE_SCOPE,
+    CRDNames,
+    CustomResource,
+    CustomResourceDefinition,
+    make_kind_type,
+    validate_structural,
+)
+from .registrar import (  # noqa: F401
+    DynamicKindRegistrar,
+    attach_registrar,
+)

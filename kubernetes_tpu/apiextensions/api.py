@@ -1,0 +1,294 @@
+"""apiextensions.k8s.io/v1 object model: CustomResourceDefinition + the
+generic CustomResource type its registrations serve.
+
+Reference: staging/src/k8s.io/apiextensions-apiserver/pkg/apis/apiextensions
+(CustomResourceDefinitionSpec — group/versions/scope/names) and the
+structural-schema validation of pkg/apiserver/validation, collapsed to the
+subset the control plane actually enforces here: type checking, required
+fields, enums, and numeric bounds over a declared openAPIV3Schema tree.
+
+A ``CustomResourceDefinition`` is itself an ordinary built-in kind — it is
+stored, WAL-logged, watched, and wire-encoded like any other object.  The
+kinds it DEFINES are subclasses of ``CustomResource`` minted per CRD by
+``make_kind_type`` and installed dynamically (registrar.py).  A custom
+resource keeps its manifest body verbatim (everything except
+kind/apiVersion/metadata), so serving it back — JSON or binary wire — is a
+generic-document encode with no frozen vocabulary required.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Type
+
+from ..api.objects import ObjectMeta
+
+NAMESPACE_SCOPE = "Namespaced"
+CLUSTER_SCOPE = "Cluster"
+
+# manifest keys that are NOT part of a custom resource's body
+_ENVELOPE_KEYS = ("kind", "apiVersion", "metadata")
+
+
+@dataclass
+class CRDNames:
+    """spec.names: how the defined kind is addressed (REST plural, kind)."""
+
+    plural: str = ""
+    singular: str = ""
+    kind: str = ""
+    list_kind: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CRDNames":
+        kind = d.get("kind", "")
+        return cls(
+            plural=d.get("plural", ""),
+            singular=d.get("singular", "") or kind.lower(),
+            kind=kind,
+            list_kind=d.get("listKind", "") or (kind + "List" if kind else ""),
+        )
+
+
+@dataclass
+class CustomResourceDefinition:
+    """One tenant-defined kind: group + served versions + scope + names +
+    the storage version's structural schema."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    group: str = ""
+    scope: str = NAMESPACE_SCOPE
+    names: CRDNames = field(default_factory=CRDNames)
+    versions: List[str] = field(default_factory=lambda: ["v1"])
+    storage_version: str = "v1"
+    schema: Optional[dict] = None  # the storage version's openAPIV3Schema
+
+    kind = "CustomResourceDefinition"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name  # cluster-scoped
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CustomResourceDefinition":
+        # decode is LENIENT: the wire/WAL planes must round-trip any stored
+        # document bit-for-bit; invariant enforcement lives in validate(),
+        # applied by the registrar before a registration is ever served
+        spec = d.get("spec") or {}
+        names = CRDNames.from_dict(spec.get("names") or {})
+        group = spec.get("group", "")
+        scope = spec.get("scope", NAMESPACE_SCOPE)
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        raw_versions = spec.get("versions") or [{"name": "v1",
+                                                 "storage": True}]
+        served: List[str] = []
+        storage = ""
+        schema = None
+        for v in raw_versions:
+            if isinstance(v, str):
+                v = {"name": v}
+            if not v.get("served", True):
+                continue
+            vname = v.get("name", "")
+            if not vname:
+                continue
+            served.append(vname)
+            if v.get("storage", False) or not storage:
+                storage = vname
+                schema = (v.get("schema") or {}).get("openAPIV3Schema")
+        return cls(metadata=meta, group=group, scope=scope, names=names,
+                   versions=served, storage_version=storage or "v1",
+                   schema=schema)
+
+    def validate(self) -> "CustomResourceDefinition":
+        """The spec invariants a registration must satisfy to be SERVED
+        (raises ValueError).  Kept out of from_dict deliberately: decode
+        round-trips any stored doc, the registrar refuses invalid ones."""
+        if not self.group:
+            raise ValueError("CustomResourceDefinition spec.group is required")
+        if not self.names.kind or not self.names.plural:
+            raise ValueError(
+                "CustomResourceDefinition spec.names needs kind and plural")
+        if self.scope not in (NAMESPACE_SCOPE, CLUSTER_SCOPE):
+            raise ValueError(
+                f"CustomResourceDefinition spec.scope must be "
+                f"{NAMESPACE_SCOPE!r} or {CLUSTER_SCOPE!r}, "
+                f"got {self.scope!r}")
+        expect = f"{self.names.plural}.{self.group}"
+        if self.metadata.name and self.metadata.name != expect:
+            # the reference's name invariant: <plural>.<group> — it is what
+            # makes CRD names collision-free across groups
+            raise ValueError(
+                f"CustomResourceDefinition name must be {expect!r} "
+                f"(plural.group), got {self.metadata.name!r}")
+        if not self.versions:
+            raise ValueError("CustomResourceDefinition serves no versions")
+        return self
+
+
+# --- structural schema validation -------------------------------------------
+
+_TYPE_CHECKS = {
+    "object": lambda v: isinstance(v, dict),
+    "array": lambda v: isinstance(v, list),
+    "string": lambda v: isinstance(v, str),
+    # bool is an int subclass in Python; a schema saying integer must not
+    # silently admit true/false
+    "integer": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "number": lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool),
+    "boolean": lambda v: isinstance(v, bool),
+}
+
+
+def validate_structural(schema: Optional[dict], value,
+                        path: str = "") -> List[str]:
+    """Errors from checking ``value`` against a structural-schema subset:
+    ``type``, ``properties``/``required``/``additionalProperties`` (objects),
+    ``items`` (arrays), ``enum``, ``minimum``/``maximum`` (numbers).
+    Empty list = valid; an empty/absent schema admits everything (the
+    reference's x-kubernetes-preserve-unknown-fields posture)."""
+    if not schema:
+        return []
+    errors: List[str] = []
+    where = path or "<root>"
+    t = schema.get("type")
+    if t:
+        check = _TYPE_CHECKS.get(t)
+        if check is None:
+            errors.append(f"{where}: unsupported schema type {t!r}")
+            return errors
+        if not check(value):
+            errors.append(
+                f"{where}: expected {t}, got {type(value).__name__}")
+            return errors  # children of a mistyped node are meaningless
+    enum = schema.get("enum")
+    if enum is not None and value not in enum:
+        errors.append(f"{where}: {value!r} not in enum {enum!r}")
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        lo, hi = schema.get("minimum"), schema.get("maximum")
+        if lo is not None and value < lo:
+            errors.append(f"{where}: {value} below minimum {lo}")
+        if hi is not None and value > hi:
+            errors.append(f"{where}: {value} above maximum {hi}")
+    if isinstance(value, dict):
+        props = schema.get("properties") or {}
+        for req in schema.get("required") or []:
+            if req not in value:
+                errors.append(f"{where}: missing required field {req!r}")
+        for k, v in value.items():
+            sub = props.get(k)
+            if sub is not None:
+                errors.extend(
+                    validate_structural(sub, v, f"{path}.{k}" if path else k))
+            elif schema.get("additionalProperties") is False:
+                errors.append(f"{where}: unknown field {k!r}")
+    if isinstance(value, list):
+        items = schema.get("items")
+        if items:
+            for i, v in enumerate(value):
+                errors.extend(validate_structural(items, v, f"{where}[{i}]"))
+    return errors
+
+
+# --- the generic custom resource type ---------------------------------------
+
+
+class CustomResource:
+    """Base of every dynamically-minted custom kind.
+
+    Holds metadata plus the manifest body VERBATIM (``body``: every
+    top-level key except kind/apiVersion/metadata) — serving it back is a
+    generic-document encode, which is exactly how the wire codec handles
+    kinds outside its frozen vocabulary.  Subclasses are minted per CRD by
+    ``make_kind_type`` and carry kind/group/version/plural/scope/schema as
+    class attributes; ``from_dict`` enforces the CRD's structural schema,
+    so invalid bodies are rejected at decode time (HTTP 400) on every path
+    — apiserver, WAL replay, in-process writes."""
+
+    kind = ""
+    group = ""
+    version = "v1"
+    plural = ""
+    scope = NAMESPACE_SCOPE
+    schema: Optional[dict] = None
+    crd_name = ""
+    # serializer marker (api/serialize.py dispatches on it without
+    # importing this module — the same no-cycle discipline as the
+    # name-based dispatch for DRA/autoscaler kinds)
+    _custom_resource = True
+
+    def __init__(self, metadata: Optional[ObjectMeta] = None,
+                 body: Optional[dict] = None):
+        self.metadata = metadata or ObjectMeta()
+        self.body = body if body is not None else {}
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def spec(self) -> dict:
+        return self.body.get("spec") or {}
+
+    @property
+    def status(self) -> dict:
+        return self.body.get("status") or {}
+
+    def key(self) -> str:
+        if type(self).scope == CLUSTER_SCOPE:
+            return self.metadata.name
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def __eq__(self, other) -> bool:
+        return (type(other) is type(self)
+                and other.metadata == self.metadata
+                and other.body == self.body)
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(kind={type(self).kind!r}, "
+                f"name={self.metadata.name!r})")
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "CustomResource":
+        errors = validate_structural(cls.schema, dict(d))
+        if errors:
+            raise ValueError(
+                f"{cls.kind} schema validation failed: "
+                + "; ".join(errors[:8]))
+        body = {k: copy.deepcopy(v) for k, v in d.items()
+                if k not in _ENVELOPE_KEYS}
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   body=body)
+
+
+def make_kind_type(crd: CustomResourceDefinition) -> Type[CustomResource]:
+    """Mint the served type for one CRD: a CustomResource subclass whose
+    class attributes pin the CRD's identity.  The scheme registers the
+    subclass like any hand-written kind — decode dispatch, gv_of, and the
+    serializer need nothing CRD-specific."""
+    return type(crd.names.kind, (CustomResource,), {
+        "kind": crd.names.kind,
+        "group": crd.group,
+        "version": crd.storage_version,
+        "plural": crd.names.plural,
+        "scope": crd.scope,
+        "schema": copy.deepcopy(crd.schema) if crd.schema else None,
+        "crd_name": crd.metadata.name,
+        "_fingerprint": registration_fingerprint(crd),
+    })
+
+
+# fingerprint of the parts of a CRD that change the served type; the
+# registrar skips reinstalling when a replayed/re-listed CRD matches
+def registration_fingerprint(crd: CustomResourceDefinition) -> tuple:
+    return (crd.group, crd.storage_version, crd.names.plural,
+            crd.names.kind, crd.scope, repr(crd.schema))

@@ -1,0 +1,214 @@
+"""Dynamic-kind registrar: CRD objects → served kinds, at runtime.
+
+Reference: apiextensions-apiserver/pkg/apiserver/customresource_handler.go —
+the crdHandler that watches CustomResourceDefinitions and (un)installs REST
+storage for the kinds they define.  Here the moving parts are narrower but
+the same shape: on CRD create/update the registrar mints the served type
+(api.make_kind_type) and registers it in the scheme — which is the single
+source the apiserver's routing, the WAL's encoder, and every decode path
+read — and flips the kind's store scoping; on CRD delete it cascades the
+stored custom resources out (watchers see ordered DELETED events) and
+removes the kind, so the plural 404s and open watches terminate.
+
+Convergence discipline (the ghost-kind invariant):
+  - every operation is idempotent — a replayed or re-listed CRD event
+    re-derives the same registration (``_fingerprint`` match → no-op);
+  - a CRD whose kind collides with a built-in is REFUSED (counted under
+    ``crd_registrations_total{op="conflict"}``), never half-installed;
+  - cascade deletes that fail under injected faults (429 storms) park the
+    kind in a pending set that ``resync()``/the next drain retries — a
+    deleted CRD's resources eventually disappear, exactly once each;
+  - during WAL replay the registrar NEVER writes to the store (the log
+    already contains whatever cascade completed before the crash);
+    ``resync()`` after replay completes any interrupted cascade.
+
+Threading: the registrar is driven by ONE store's synchronous watch
+fan-out (events arrive under the store lock, in rv order) plus boot-time
+``attach``/``resync`` calls made before serving starts — a single logical
+writer, so its bookkeeping dicts need no lock of their own.  Cascade
+deletes triggered by a live event re-enter the store through its reentrant
+write path; during ``attach``'s history replay and WAL replay they are
+deferred and drained outside the store lock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ..chaos.faults import (
+    CRASH_MID_CRD_REGISTER,
+    TransientApiError,
+    maybe_crash,
+)
+from ..component_base import logging as klog
+from ..metrics import scheduler_metrics as m
+from ..sim.store import ObjectStore
+from .api import CLUSTER_SCOPE, CustomResourceDefinition, make_kind_type
+
+CRD_KIND = CustomResourceDefinition.kind
+
+
+class DynamicKindRegistrar:
+    def __init__(self, store: ObjectStore, scheme):
+        self.store = store
+        self.scheme = scheme
+        # CRD name → the served type this registrar installed for it
+        self._installed: Dict[str, Type] = {}
+        # kinds whose stored resources still need cascade deletion
+        self._pending_cascade: set = set()
+        # True while a WAL replay drives the store: the log already holds
+        # the pre-crash cascade, so the registrar must not issue writes
+        self.replaying = False
+        self._attaching = False
+        self._unwatch = None
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def attach(self, drain: bool = True) -> "DynamicKindRegistrar":
+        """Subscribe to the store's watch stream.  History replays
+        synchronously, so every CRD already stored installs before this
+        returns; cascades discovered during the replay drain afterwards,
+        outside the store lock."""
+        self._attaching = True
+        try:
+            self._unwatch = self.store.watch(self._on_event)
+        finally:
+            self._attaching = False
+        if drain:
+            self._drain_cascades()
+        return self
+
+    def close(self) -> None:
+        if self._unwatch is not None:
+            self._unwatch()
+            self._unwatch = None
+
+    def installed_kinds(self) -> Dict[str, str]:
+        """CRD name → kind currently served (a stable snapshot)."""
+        return {name: typ.kind for name, typ in self._installed.items()}
+
+    # --- event plane ---------------------------------------------------------
+
+    def _on_event(self, ev) -> None:
+        if ev.kind != CRD_KIND:
+            return
+        if ev.type in ("ADDED", "MODIFIED"):
+            self._install(ev.obj)
+        elif ev.type == "DELETED":
+            self._uninstall(ev.obj.metadata.name)
+
+    # --- install / uninstall -------------------------------------------------
+
+    def _install(self, crd: CustomResourceDefinition) -> None:
+        try:
+            crd.validate()
+        except ValueError as e:
+            # stored but never served (decode is lenient so the wire/WAL
+            # planes round-trip any doc; the invariants gate SERVING)
+            m.crd_registrations.inc(("invalid",))
+            klog.error_s(e, "CRD refused: invalid spec",
+                         crd=crd.metadata.name)
+            return
+        kind = crd.names.kind
+        typ = make_kind_type(crd)
+        entry = self.scheme.kind_types().get(kind)
+        op = "install"
+        if entry is not None:
+            current = entry[2]
+            if not getattr(current, "_custom_resource", False):
+                # a built-in already serves this kind: refuse — installing
+                # over it would shadow core serving (the ghost-kind bug)
+                m.crd_registrations.inc(("conflict",))
+                klog.error_s(
+                    None, "CRD refused: kind collides with a built-in",
+                    crd=crd.metadata.name, kind=kind)
+                return
+            if getattr(current, "_fingerprint", None) == typ._fingerprint:
+                # replayed/re-listed event for the registration we already
+                # serve — the idempotent fast path
+                self._installed[crd.metadata.name] = current
+                return
+            # schema/scope/version changed: re-mint under the same kind
+            self.scheme.remove_known_type(kind)
+            if getattr(current, "scope", "") == CLUSTER_SCOPE \
+                    and crd.scope != CLUSTER_SCOPE:
+                ObjectStore.CLUSTER_SCOPED.discard(kind)
+            op = "update"
+        # the crash window: the CRD write is durable (WAL) and visible
+        # (watch fan-out reached us) but the kind is not yet served —
+        # recovery must converge to exactly one registration
+        maybe_crash(CRASH_MID_CRD_REGISTER)
+        self.scheme.add_known_type(crd.group, crd.storage_version, typ)
+        if crd.scope == CLUSTER_SCOPE:
+            # in-place: client facades alias the SAME set object
+            ObjectStore.CLUSTER_SCOPED.add(kind)
+        self._installed[crd.metadata.name] = typ
+        m.crd_registrations.inc((op,))
+        m.crd_kinds_served.set(float(len(self._installed)))
+        klog.V(1).info_s("custom kind installed", crd=crd.metadata.name,
+                         kind=kind, group=crd.group, scope=crd.scope, op=op)
+
+    def _uninstall(self, crd_name: str) -> None:
+        typ = self._installed.pop(crd_name, None)
+        if typ is None:
+            return  # replayed delete of a registration already gone
+        kind = typ.kind
+        # cascade parks first and (when live) drains BEFORE the kind
+        # leaves the scheme, so the DELETED events fan out while the kind
+        # still encodes with its apiVersion — watchers decode an ordered
+        # drain, then see the stream terminate.  A crash anywhere in the
+        # window leaves either a pending cascade or a registration whose
+        # CRD is gone; resync() converges both.
+        self._pending_cascade.add(kind)
+        if not self.replaying and not self._attaching:
+            self._drain_cascades()
+        self.scheme.remove_known_type(kind)
+        if typ.scope == CLUSTER_SCOPE:
+            ObjectStore.CLUSTER_SCOPED.discard(kind)
+        m.crd_registrations.inc(("uninstall",))
+        m.crd_kinds_served.set(float(len(self._installed)))
+        klog.V(1).info_s("custom kind uninstalled", crd=crd_name, kind=kind)
+
+    def _drain_cascades(self) -> None:
+        """Delete every stored resource of each pending-cascade kind.
+        Injected transient faults leave the kind pending for the next
+        drain/resync — convergent, and exactly-once per object because
+        delete of a missing object is a no-op."""
+        for kind in list(self._pending_cascade):
+            clean = True
+            objs, _ = self.store.list(kind)
+            for obj in objs:
+                ns = getattr(obj.metadata, "namespace", "")
+                try:
+                    self.store.delete(kind, ns, obj.metadata.name)
+                except TransientApiError as e:
+                    clean = False
+                    klog.V(1).info_s(
+                        "cascade delete deferred", kind=kind,
+                        name=obj.metadata.name,
+                        err=f"{type(e).__name__}: {e}")
+            if clean and not self.store.list(kind)[0]:
+                self._pending_cascade.discard(kind)
+
+    # --- convergence ---------------------------------------------------------
+
+    def resync(self) -> "DynamicKindRegistrar":
+        """Reconcile registrations against the stored CRDs: install every
+        CRD present, uninstall every registration whose CRD is gone, and
+        complete interrupted cascades.  The recovery entry point — after a
+        WAL replay, a crash mid-register, or a fault storm, one resync
+        restores the zero-ghost-kind invariant."""
+        crds, _ = self.store.list(CRD_KIND)
+        present = {crd.metadata.name: crd for crd in crds}
+        for crd in present.values():
+            self._install(crd)
+        for name in [n for n in self._installed if n not in present]:
+            self._uninstall(name)
+        self._drain_cascades()
+        return self
+
+
+def attach_registrar(store: ObjectStore, scheme,
+                     drain: bool = True) -> DynamicKindRegistrar:
+    """Convenience: build + attach in one call (boot paths use it)."""
+    return DynamicKindRegistrar(store, scheme).attach(drain=drain)

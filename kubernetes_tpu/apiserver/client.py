@@ -29,12 +29,13 @@ RETRYABLE_CODES = (429, 500, 503)
 
 class HTTPApiClient:
     def __init__(self, base_url: str, scheme: Optional[Scheme] = None,
-                 user: str = "", max_retries: int = 4,
+                 user: str = "", groups: tuple = (), max_retries: int = 4,
                  retry_backoff: float = 0.05, retry_backoff_max: float = 2.0,
                  jitter_seed: int = 0, codec: str = "wire"):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme()
         self.user = user
+        self.groups = tuple(groups)
         # preferred wire codec, sent as the Accept header; the response's
         # Content-Type decides the actual decode (negotiation is the
         # server's call — an old server answering JSON still works, and
@@ -60,18 +61,21 @@ class HTTPApiClient:
         return (f"/apis/{group}/{version}" if group else f"/api/{version}")
 
     def _type_of(self, kind: str):
-        for entry in self.scheme.recognized():
-            if entry.split(":", 1)[1] == kind:
-                return self.scheme.decode({"kind": kind,
-                                           "metadata": {}}).__class__
-        raise KeyError(kind)
+        entry = self.scheme.kind_types().get(kind)
+        if entry is None:
+            raise KeyError(kind)
+        return entry[2]
 
     def _url(self, kind: str, namespace: str = "", name: str = "",
              query: str = "") -> str:
         path = self._prefix(kind)
         if namespace:
             path += f"/namespaces/{namespace}"
-        path += f"/{resource_of(kind)}"
+        # CRD-minted types declare their REST plural (spec.names.plural);
+        # built-ins derive it from the kind name
+        resource = getattr(self._type_of(kind), "plural", "") \
+            or resource_of(kind)
+        path += f"/{resource}"
         if name:
             path += f"/{name}"
         return self.base_url + path + (f"?{query}" if query else "")
@@ -92,6 +96,8 @@ class HTTPApiClient:
             req.add_header("Accept", wire.content_type_for(self.codec))
             if self.user:
                 req.add_header("X-Remote-User", self.user)
+            if self.groups:
+                req.add_header("X-Remote-Group", ",".join(self.groups))
             try:
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     raw = resp.read() or b"{}"
@@ -191,6 +197,8 @@ class HTTPApiClient:
             req.add_header("Accept", wire.content_type_for(self.codec))
             if self.user:
                 req.add_header("X-Remote-User", self.user)
+            if self.groups:
+                req.add_header("X-Remote-Group", ",".join(self.groups))
 
             def stream_error(message: str):
                 # in-band stream failure (watch protocol ERROR, e.g. 410

@@ -87,10 +87,14 @@ class _InflightGate:
         self.queue_timeout = queue_timeout
         self.retry_after = retry_after
         # TOTAL queued bound across all users: the per-user bound alone is
-        # bypassable by rotating the (unauthenticated) fairness identity —
-        # a flooder minting a fresh user per request would otherwise grow
-        # queues and handler threads without ever seeing a 429 (APF bounds
-        # total seats+queues the same way).  Default: 8 queued per seat.
+        # bypassable by rotating the fairness identity — a flooder minting
+        # a fresh user per request would otherwise grow queues and handler
+        # threads without ever seeing a 429 (APF bounds total seats+queues
+        # the same way).  With authenticators configured the identity is
+        # the AUTHENTICATED user (401 precedes admission, so rotation
+        # requires minting real credentials); header-spoofing only works
+        # on open servers, and this bound holds either way.  Default: 8
+        # queued per seat.
         self.max_queued_total = (max_queued_total if max_queued_total
                                  is not None else max_inflight * 8)
         self._lock = lockcheck.maybe_wrap(
@@ -213,6 +217,12 @@ class FlowController:
 
     def admit(self, user: str, mutating: bool) -> _Seat:
         """Acquire a seat (possibly after a fair-queued wait) or raise
-        RequestRejected — the caller answers 429 + Retry-After."""
+        RequestRejected — the caller answers 429 + Retry-After.
+
+        ``user`` is the AUTHENTICATED name when the server has
+        authenticators (APIServer._flow_admit authenticates first, so
+        fairness keys on a verified identity); on open servers it falls
+        back to the self-reported header, and the total-queued bound
+        absorbs identity-rotation floods either way."""
         gate = self.mutating if mutating else self.readonly
         return gate.acquire(user or "system:anonymous")

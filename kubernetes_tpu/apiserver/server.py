@@ -30,8 +30,11 @@ admission → registry):
   (X-Remote-User / X-Remote-Group); ``token_authenticator`` the static
   token file (Authorization: Bearer).
 - Authorization: a pluggable ``authorizer(user, verb, resource,
-  namespace) -> bool`` — the RBAC-shaped decision point without the full
-  policy object model.
+  namespace) -> bool``.  When the callable also accepts the keyword
+  attributes ``name``/``api_group``/``groups`` (detected once by
+  signature probe), the server passes them — ``auth.rbac.RBACAuthorizer``
+  is the full policy evaluator over stored Role/ClusterRole objects; a
+  legacy 4-positional lambda keeps working.
 - Admission: ``mutating_admission`` then ``validating_admission`` hook
   lists run on every write after decode, before storage — each mutating
   hook is ``(operation, kind, obj, user) -> obj | None`` (None keeps the
@@ -42,6 +45,7 @@ admission → registry):
 
 from __future__ import annotations
 
+import inspect
 import json
 import queue
 import socket
@@ -212,11 +216,27 @@ class APIServer:
         # admission hook points (mutating then validating), run on writes
         self.mutating_admission = list(mutating_admission or [])
         self.validating_admission = list(validating_admission or [])
-        # resource name → kind, built from the scheme's served kinds
+        # resource name → kind, rebuilt whenever the scheme's generation
+        # moves (the dynamic-kind registrar adds/removes CRD kinds at
+        # runtime; a generation compare per route() is one int read, so
+        # built-in traffic pays nothing for the dynamism)
         self.kinds_by_resource: Dict[str, str] = {}
-        for entry in self.scheme.recognized():
-            kind = entry.split(":", 1)[1]
-            self.kinds_by_resource[resource_of(kind)] = kind
+        self._resource_by_kind: Dict[str, str] = {}
+        self._group_by_kind: Dict[str, str] = {}
+        self._kinds_generation = -1
+        self._kinds_lock = threading.Lock()
+        self._refresh_kinds()
+        # authorizer capability probe (once, at wiring time): the RBAC
+        # authorizer takes the richer (name, api_group, groups) keywords;
+        # a legacy 4-positional callable still works unchanged
+        self._authz_rich = False
+        if authorizer is not None:
+            try:
+                params = inspect.signature(authorizer).parameters
+                self._authz_rich = all(
+                    k in params for k in ("name", "api_group", "groups"))
+            except (TypeError, ValueError):
+                self._authz_rich = False
         # the shared eviction gate behind POST pods/{name}/eviction
         # (pkg/registry/core/pod eviction REST analog): PDB-consulting,
         # 429 TooManyRequests when budget is exhausted
@@ -293,10 +313,53 @@ class APIServer:
 
     # --- path handling ------------------------------------------------------
 
+    def _refresh_kinds(self) -> None:
+        """Rebuild the resource↔kind routing maps when the scheme's
+        generation moved (a CRD installed or uninstalled a kind).  The
+        common case is one int compare; the rebuild itself is a full
+        replace under a small lock so a racing request never reads a
+        half-built map.  CRD-minted types declare their REST plural
+        (``plural`` class attr, from spec.names.plural); built-ins derive
+        it from the kind name as before."""
+        gen = self.scheme.generation
+        if gen == self._kinds_generation:
+            return
+        with self._kinds_lock:
+            if gen == self._kinds_generation:
+                return
+            by_resource: Dict[str, str] = {}
+            by_kind: Dict[str, str] = {}
+            group_of: Dict[str, str] = {}
+            for kind, (group, _version, typ) in \
+                    self.scheme.kind_types().items():
+                res = getattr(typ, "plural", "") or resource_of(kind)
+                by_resource[res] = kind
+                by_kind[kind] = res
+                group_of[kind] = group
+            self.kinds_by_resource = by_resource
+            self._resource_by_kind = by_kind
+            self._group_by_kind = group_of
+            self._kinds_generation = gen
+
+    def serves_kind(self, kind: str) -> bool:
+        """True while ``kind`` is a served (routable) kind.  Open watch
+        streams poll this each loop so a CRD deletion terminates them
+        in-band instead of leaving readers on a dead resource."""
+        self._refresh_kinds()
+        return kind in self._resource_by_kind
+
+    def resource_for(self, kind: str) -> str:
+        """Kind → its served REST resource name (authz attribute)."""
+        return self._resource_by_kind.get(kind) or resource_of(kind)
+
+    def group_for(self, kind: str) -> str:
+        return self._group_by_kind.get(kind, "")
+
     def route(self, path: str) -> Optional[Tuple[str, str, str, str]]:
         """path → (kind, namespace, name, subresource); '' for absent parts.
 
         None for non-resource paths (health/discovery handled elsewhere)."""
+        self._refresh_kinds()
         parts = [p for p in path.split("/") if p]
         if not parts:
             return None
@@ -401,17 +464,24 @@ def _make_handler(api: APIServer):
         # --- flow control (apiserver/flowcontrol.py) ------------------------
 
         def _flow_admit(self, mutating: bool, span=None) -> bool:
-            """Acquire an inflight seat (APF position: before authn, after
-            routing — shedding must stay cheap under flood).  False when
-            the request was already answered 429 + Retry-After.  Fairness
-            is keyed by the cheap header identity; the full authn chain
-            still runs afterwards as before.  ``span`` is the enclosing
-            apiserver_request span: a seat that actually queued gets a
-            retroactive apf_wait child covering its fair-queue wait."""
+            """Run authn, then acquire an inflight seat (the reference APF
+            position: WithPriorityAndFairness sits after WithAuthentication
+            precisely so fairness keys on the VERIFIED identity — keying on
+            a raw header would let one tenant spoof another's queue and
+            starve it).  False when the request was already answered (401
+            from authn, or 429 + Retry-After from the queue).  The identity
+            is stashed for ``_check``/admission so the chain authenticates
+            once.  ``span`` is the enclosing apiserver_request span: a seat
+            that actually queued gets a retroactive apf_wait child covering
+            its fair-queue wait."""
             self._flow_seat = None
+            ui = self._user()
+            if ui is None:
+                return False  # 401 already sent
+            self._req_user = ui
             if api.flow is None:
                 return True
-            user = self.headers.get("X-Remote-User") or "system:anonymous"
+            user = ui.name or "system:anonymous"
             try:
                 self._flow_seat = api.flow.admit(user, mutating=mutating)
             except RequestRejected as e:
@@ -469,19 +539,35 @@ def _make_handler(api: APIServer):
                              "no authenticator identified the request")
             return None
 
-        def _check(self, verb: str, kind: str, ns: str) -> bool:
-            """authn → authz for one request; sends the 401/403 on failure
-            and stashes the identity for the admission hooks."""
-            user = self._user()
+        def _check(self, verb: str, kind: str, ns: str,
+                   name: str = "") -> bool:
+            """Authorize one request; sends the 401/403 on failure.  The
+            identity was established by ``_flow_admit`` (authn runs once
+            per request, before fairness queuing); the fallback `_user()`
+            covers callers outside the seated path.  A rich authorizer
+            (RBAC) additionally receives the object name, API group, and
+            the identity's groups — resourceNames rules and group-shaped
+            bindings need them."""
+            user = getattr(self, "_req_user", None)
             if user is None:
-                return False
-            self._req_user = user
-            if api.authorizer is not None and not api.authorizer(
-                    user.name, verb, resource_of(kind), ns):
-                self._status_err(403, "Forbidden",
-                                 f"user {user.name} cannot {verb} "
-                                 f"{resource_of(kind)}")
-                return False
+                user = self._user()
+                if user is None:
+                    return False
+                self._req_user = user
+            if api.authorizer is not None:
+                resource = api.resource_for(kind)
+                if api._authz_rich:
+                    allowed = api.authorizer(
+                        user.name, verb, resource, ns, name=name,
+                        api_group=api.group_for(kind),
+                        groups=tuple(getattr(user, "groups", ()) or ()))
+                else:
+                    allowed = api.authorizer(user.name, verb, resource, ns)
+                if not allowed:
+                    self._status_err(403, "Forbidden",
+                                     f"user {user.name} cannot {verb} "
+                                     f"{resource}")
+                    return False
             return True
 
         def _admit(self, operation: str, kind: str, obj):
@@ -595,7 +681,8 @@ def _make_handler(api: APIServer):
                 return
             kind, ns, name, _sub = r
             if not self._check("watch" if "watch" in q else
-                               ("get" if name else "list"), kind, ns):
+                               ("get" if name else "list"), kind, ns,
+                               name=name):
                 return
             codec = self._codec()
             if name:
@@ -764,6 +851,36 @@ def _make_handler(api: APIServer):
                     remain = deadline - time.monotonic()
                     if remain <= 0:
                         break
+                    if not api.serves_kind(kind):
+                        # the CRD defining this kind was deleted out from
+                        # under the stream: flush the events already fanned
+                        # (the cascade's ordered DELETED drain), then
+                        # terminate in-band so the client stops (and
+                        # relists into a 404) instead of idling on a
+                        # resource that no longer exists
+                        while True:
+                            try:
+                                ev = events.get_nowait()
+                            except queue.Empty:
+                                break
+                            p = ev.payload or wire.payload_for(
+                                ev.obj, api.scheme)
+                            if not write_raw(event_bytes(
+                                    ev.type, payload=p,
+                                    rv=ev.resource_version)):
+                                return
+                        if write_raw(event_bytes(
+                                ERROR,
+                                obj_doc={"kind": "Status",
+                                         "status": "Failure",
+                                         "reason": "Expired",
+                                         "message": "the server no longer "
+                                                    f"serves {kind}"})):
+                            try:
+                                self.wfile.write(b"0\r\n\r\n")
+                            except (BrokenPipeError, ConnectionResetError):
+                                pass
+                        return
                     if bookmarks and time.monotonic() >= next_bookmark:
                         next_bookmark = time.monotonic() + 1.0
                         # correctness order: read the fully-fanned-out rv
@@ -873,7 +990,7 @@ def _make_handler(api: APIServer):
             if self._shed("POST", kind, name or ""):
                 return
             if kind == "Pod" and name and sub == "binding":
-                if not self._check("create", "Pod", ns):
+                if not self._check("create", "Pod", ns, name=name):
                     return
                 body = self._body()
                 node = ((body.get("target") or {}).get("name")) or ""
@@ -899,7 +1016,7 @@ def _make_handler(api: APIServer):
                 # the Eviction subresource (policy/v1): the shared gate
                 # decides; an exhausted PodDisruptionBudget answers 429
                 # TooManyRequests exactly like the reference handler
-                if not self._check("delete", "Pod", ns):
+                if not self._check("delete", "Pod", ns, name=name):
                     return
                 body = self._body()
                 if body:
@@ -971,7 +1088,7 @@ def _make_handler(api: APIServer):
             kind, ns, name, _sub = r
             if self._shed("PUT", kind, name):
                 return
-            if not self._check("update", kind, ns):
+            if not self._check("update", kind, ns, name=name):
                 return
             if api.store.get(kind, ns, name) is None:
                 self._status_err(404, "NotFound", f"{kind} {ns}/{name}")
@@ -1024,7 +1141,7 @@ def _make_handler(api: APIServer):
             kind, ns, name, _sub = r
             if self._shed("PATCH", kind, name):
                 return
-            if not self._check("patch", kind, ns):
+            if not self._check("patch", kind, ns, name=name):
                 return
             patch = self._body()
             client_rv = ((patch.get("metadata") or {}).get("resourceVersion"))
@@ -1078,7 +1195,7 @@ def _make_handler(api: APIServer):
             kind, ns, name, _sub = r
             if self._shed("DELETE", kind, name):
                 return
-            if not self._check("delete", kind, ns):
+            if not self._check("delete", kind, ns, name=name):
                 return
             cur = api.store.get(kind, ns, name)
             if cur is None:

@@ -1,0 +1,19 @@
+"""RBAC authorization (rbac.authorization.k8s.io).
+
+Reference: plugin/pkg/auth/authorizer/rbac/rbac.go — the policy object
+model (``api.py``: Role/ClusterRole + bindings), the rule evaluator
+(``rbac.py``), and the bootstrap policy granting the built-in components
+exactly their verbs (``bootstrap.py``, the bootstrappolicy analog).
+"""
+
+from .api import (  # noqa: F401
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    RoleRef,
+    Subject,
+)
+from .bootstrap import bootstrap_objects, install_bootstrap_policy  # noqa: F401
+from .rbac import RBACAuthorizer  # noqa: F401

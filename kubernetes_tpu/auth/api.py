@@ -1,0 +1,187 @@
+"""rbac.authorization.k8s.io/v1 object model.
+
+Reference: staging/src/k8s.io/api/rbac/v1/types.go — PolicyRule (verbs ×
+apiGroups × resources × resourceNames, ``*`` wildcards), Role/ClusterRole
+as rule bags, and the bindings attaching subjects to them.  Roles and
+RoleBindings are namespaced; their Cluster* counterparts are
+cluster-scoped (sim/store.py CLUSTER_SCOPED carries them).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Mapping
+
+from ..api.objects import ObjectMeta
+
+WILDCARD = "*"
+
+
+@dataclass
+class PolicyRule:
+    """One grant: the cross product of verbs × apiGroups × resources,
+    optionally narrowed to specific object names."""
+
+    verbs: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=lambda: [""])
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "PolicyRule":
+        return cls(
+            verbs=[str(v) for v in d.get("verbs") or []],
+            api_groups=[str(g) for g in d.get("apiGroups") or [""]],
+            resources=[str(r) for r in d.get("resources") or []],
+            resource_names=[str(n) for n in d.get("resourceNames") or []],
+        )
+
+    def matches(self, verb: str, api_group: str, resource: str,
+                name: str = "") -> bool:
+        """rbac.go RuleAllows: every dimension must admit the request; an
+        empty resourceNames list means ALL names (narrowing is opt-in)."""
+        if WILDCARD not in self.verbs and verb not in self.verbs:
+            return False
+        if WILDCARD not in self.api_groups \
+                and api_group not in self.api_groups:
+            return False
+        if WILDCARD not in self.resources and resource not in self.resources:
+            return False
+        if self.resource_names and WILDCARD not in self.resource_names \
+                and name not in self.resource_names:
+            return False
+        return True
+
+
+def _rules_from(d: Mapping) -> List[PolicyRule]:
+    return [PolicyRule.from_dict(r) for r in d.get("rules") or []]
+
+
+@dataclass
+class Role:
+    """Namespaced rule bag: grants apply only inside metadata.namespace."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    kind = "Role"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Role":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   rules=_rules_from(d))
+
+
+@dataclass
+class ClusterRole:
+    """Cluster-scoped rule bag: bindable in any namespace or cluster-wide."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    kind = "ClusterRole"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ClusterRole":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   rules=_rules_from(d))
+
+
+@dataclass
+class Subject:
+    """Who a binding grants to: a User or a Group (ServiceAccounts reduce
+    to their ``system:serviceaccount:...`` user names here)."""
+
+    kind: str = "User"
+    name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Subject":
+        return cls(kind=d.get("kind", "User"), name=d.get("name", ""))
+
+
+@dataclass
+class RoleRef:
+    kind: str = "ClusterRole"  # "Role" | "ClusterRole"
+    name: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "RoleRef":
+        return cls(kind=d.get("kind", "ClusterRole"),
+                   name=d.get("name", ""))
+
+
+def _subjects_from(d: Mapping) -> List[Subject]:
+    return [Subject.from_dict(s) for s in d.get("subjects") or []]
+
+
+@dataclass
+class RoleBinding:
+    """Namespaced grant: subjects get the referenced Role's (or
+    ClusterRole's) rules INSIDE metadata.namespace only."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    kind = "RoleBinding"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "RoleBinding":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   subjects=_subjects_from(d),
+                   role_ref=RoleRef.from_dict(d.get("roleRef") or {}))
+
+
+@dataclass
+class ClusterRoleBinding:
+    """Cluster-wide grant: subjects get the ClusterRole's rules in every
+    namespace and for cluster-scoped resources."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    subjects: List[Subject] = field(default_factory=list)
+    role_ref: RoleRef = field(default_factory=RoleRef)
+
+    kind = "ClusterRoleBinding"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return self.metadata.name
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "ClusterRoleBinding":
+        return cls(metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+                   subjects=_subjects_from(d),
+                   role_ref=RoleRef.from_dict(d.get("roleRef") or {}))

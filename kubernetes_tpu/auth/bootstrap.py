@@ -1,0 +1,131 @@
+"""Bootstrap RBAC policy: built-in components get exactly their verbs.
+
+Reference: plugin/pkg/auth/authorizer/rbac/bootstrappolicy/policy.go — the
+cluster ships with ClusterRoles for each control-plane component and the
+bindings attaching the component identities to them, so turning
+authorization on does not lock the cluster out of itself.  The grants are
+least-privilege by construction: each role lists only the (verb, resource)
+pairs the component's reconcile loops actually issue, so the RBAC battery
+can assert both directions — every built-in passes, and anything outside
+its envelope is denied like any other user.
+
+Identities match what the components send on the wire: HTTPApiClient
+stamps its ``user`` as the request identity, so a scheduler built with
+``user="system:kube-scheduler"`` authenticates as exactly the subject
+bound here.  ``cluster-admin`` (wildcard everything) is bound to the
+``system:masters`` group — the break-glass identity tests and operators
+use, mirroring the reference bootstrap.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..api.objects import ObjectMeta
+from .api import ClusterRole, ClusterRoleBinding, PolicyRule, RoleRef, Subject
+
+# component identities (defined here; nothing else in the tree hardcodes
+# them, so tests and main() wiring import these names)
+USER_SCHEDULER = "system:kube-scheduler"
+USER_CONTROLLER_MANAGER = "system:kube-controller-manager"
+USER_DESCHEDULER = "system:descheduler"
+USER_AUTOSCALER = "system:autoscaler"
+GROUP_MASTERS = "system:masters"
+
+_RW = ["get", "list", "watch", "create", "update", "patch", "delete"]
+_RO = ["get", "list", "watch"]
+
+
+def _rule(resources: List[str], verbs: List[str],
+          api_groups: Tuple[str, ...] = ("",)) -> PolicyRule:
+    return PolicyRule(verbs=list(verbs), api_groups=list(api_groups),
+                      resources=list(resources))
+
+
+def _role(name: str, rules: List[PolicyRule]) -> ClusterRole:
+    return ClusterRole(metadata=ObjectMeta(name=name), rules=rules)
+
+
+def _bind(name: str, role: str, subject: Subject) -> ClusterRoleBinding:
+    return ClusterRoleBinding(
+        metadata=ObjectMeta(name=name),
+        subjects=[subject],
+        role_ref=RoleRef(kind="ClusterRole", name=role))
+
+
+def bootstrap_objects() -> List[object]:
+    """The bootstrap ClusterRoles + ClusterRoleBindings, in install order.
+
+    Verb envelopes trace to the components' actual request patterns:
+    the scheduler binds pods (POST pods/{name}/binding authorizes as
+    ``create pods``) and CASes pod/claim/podgroup status; the
+    descheduler evicts (POST pods/{name}/eviction authorizes as
+    ``delete pods``); the autoscaler creates and deletes nodes and
+    updates its nodegroups; the controller-manager owns the workload
+    expansion loops (replicasets/trainingjobs → pods + claims).
+    """
+    objs: List[object] = [
+        _role("system:kube-scheduler", [
+            _rule(["pods"], _RO + ["create", "update", "patch"]),
+            _rule(["nodes", "podgroups", "priorityclasses",
+                   "storageclasses", "csinodes", "persistentvolumes",
+                   "persistentvolumeclaims", "poddisruptionbudgets"], _RO),
+            _rule(["podgroups"], ["update", "patch"]),
+            _rule(["resourceclaims", "resourceslices", "deviceclasses"],
+                  _RO),
+            _rule(["resourceclaims"], ["update", "patch"]),
+            _rule(["leases"], _RW),
+        ]),
+        _role("system:kube-controller-manager", [
+            _rule(["pods", "resourceclaims", "resourceclaimtemplates",
+                   "podgroups"], _RW),
+            _rule(["replicasets", "trainingjobs", "horizontalpodautoscalers"],
+                  _RO + ["update", "patch"], api_groups=("*",)),
+            _rule(["nodes", "namespaces", "deviceclasses",
+                   "resourceslices"], _RO),
+            _rule(["leases"], _RW),
+        ]),
+        _role("system:descheduler", [
+            # eviction authorizes as delete on pods (the subresource gate)
+            _rule(["pods"], _RO + ["delete"]),
+            _rule(["nodes", "podgroups", "poddisruptionbudgets"], _RO),
+            _rule(["leases"], _RW),
+        ]),
+        _role("system:autoscaler", [
+            _rule(["nodes"], _RO + ["create", "delete"]),
+            _rule(["nodegroups"], _RO + ["update", "patch"],
+                  api_groups=("*",)),
+            _rule(["pods", "podgroups"], _RO),
+            _rule(["leases"], _RW),
+        ]),
+        _role("cluster-admin", [
+            _rule(["*"], ["*"], api_groups=("*",)),
+        ]),
+        _bind("system:kube-scheduler", "system:kube-scheduler",
+              Subject(kind="User", name=USER_SCHEDULER)),
+        _bind("system:kube-controller-manager",
+              "system:kube-controller-manager",
+              Subject(kind="User", name=USER_CONTROLLER_MANAGER)),
+        _bind("system:descheduler", "system:descheduler",
+              Subject(kind="User", name=USER_DESCHEDULER)),
+        _bind("system:autoscaler", "system:autoscaler",
+              Subject(kind="User", name=USER_AUTOSCALER)),
+        _bind("cluster-admin", "cluster-admin",
+              Subject(kind="Group", name=GROUP_MASTERS)),
+    ]
+    return objs
+
+
+def install_bootstrap_policy(store) -> int:
+    """Create the bootstrap objects in ``store``; objects already present
+    are left untouched (idempotent — safe on every boot, including a boot
+    whose WAL replay already restored them).  Returns how many were
+    created this call."""
+    created = 0
+    for obj in bootstrap_objects():
+        try:
+            store.create(obj.kind, obj)
+            created += 1
+        except ValueError:
+            pass  # already bootstrapped (or operator-modified: keep theirs)
+    return created

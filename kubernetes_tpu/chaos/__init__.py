@@ -24,6 +24,7 @@ jax); the fault primitives stay importable from stdlib-only contexts
 """
 
 from .faults import (  # noqa: F401
+    CRASH_MID_CRD_REGISTER,
     CRASH_MID_ZONE_EVICT,
     CRASH_POINTS,
     CRASH_PRE_WAL_FSYNC,
@@ -43,6 +44,7 @@ from .replication import ShipFaults, run_replication_soak  # noqa: F401
 from .retry import RetryingStore  # noqa: F401
 
 __all__ = [
+    "CRASH_MID_CRD_REGISTER",
     "CRASH_MID_ZONE_EVICT",
     "CRASH_POINTS",
     "CRASH_PRE_WAL_FSYNC",

@@ -61,6 +61,7 @@ CRASH_MID_ZONE_EVICT = "crash.mid_zone_evict"  # controllers/nodelifecycle: unre
 CRASH_MID_PROMOTE = "crash.mid_promote"        # sim/replication.promote: shipped tail durable, WAL not yet reattached
 CRASH_MID_PROVISION = "crash.mid_provision"    # controllers/volumebinder.sync_once: PV claimRef written, PVC bind lost
 CRASH_MID_CLAIM_COMMIT = "crash.mid_claim_commit"  # dra/plugin.pre_bind: some claims committed, pod not bound
+CRASH_MID_CRD_REGISTER = "crash.mid_crd_register"  # apiextensions/registrar._install: CRD durable, kind not yet served
 # Not in CRASH_POINTS (armed via arm_torn_write, not crash_points): the
 # torn-write fault writes a PREFIX of the record before dying, so the point
 # name only identifies the ProcessCrash it raises.
@@ -78,6 +79,7 @@ CRASH_POINTS = (
     CRASH_MID_PROMOTE,
     CRASH_MID_PROVISION,
     CRASH_MID_CLAIM_COMMIT,
+    CRASH_MID_CRD_REGISTER,
 )
 
 

@@ -59,12 +59,72 @@ class Kubectl:
     def __init__(self, store: ObjectStore):
         self.store = store
 
+    # --- dynamic kinds --------------------------------------------------------
+
+    def resolve_kind(self, kind: str) -> str:
+        """Alias table first; an unknown name then tries DYNAMIC discovery
+        (kubectl's RESTMapper refresh on a no-match): fetch the stored
+        CustomResourceDefinitions — over HTTP when the store is the facade
+        — and match plural/singular/kind, minting the served type into the
+        client-side scheme(s) so list/get decode the custom resources."""
+        k = KIND_ALIASES.get(kind.lower(), kind)
+        if k != kind or kind in _scheme().kind_types():
+            return k
+        want = kind.lower()
+        try:
+            crds, _ = self.store.list("CustomResourceDefinition")
+        except Exception as e:
+            # store/server without the apiextensions surface: the unknown
+            # name falls through to the normal unknown-kind error path
+            from .utils import klog
+
+            klog.V(1).info_s("CRD discovery unavailable",
+                             kind=kind, err=str(e))
+            return k
+        for crd in crds:
+            names = crd.names
+            if want in (names.plural.lower(), names.singular.lower(),
+                        names.kind.lower()):
+                self._register_dynamic(crd)
+                return names.kind
+        return k
+
+    def _register_dynamic(self, crd) -> None:
+        from .apiextensions.api import CLUSTER_SCOPE, make_kind_type
+
+        typ = make_kind_type(crd)
+        schemes = [_scheme()]
+        client = getattr(self.store, "_client", None)
+        if getattr(client, "scheme", None) is not None \
+                and client.scheme is not _scheme():
+            schemes.append(client.scheme)
+        for s in schemes:
+            if crd.names.kind not in s.kind_types():
+                s.add_known_type(crd.group, crd.storage_version, typ)
+        if crd.scope == CLUSTER_SCOPE:
+            # in-place: the store facade aliases the same scoping set
+            self.store.CLUSTER_SCOPED.add(crd.names.kind)
+
+    # --- auth -----------------------------------------------------------------
+
+    def can_i(self, verb: str, resource: str, user: str,
+              namespace: str = "", name: str = "",
+              groups: tuple = ()) -> str:
+        """``kubectl auth can-i``: evaluate the stored RBAC policy for an
+        arbitrary subject.  Runs the SAME RBACAuthorizer the apiserver
+        enforces with, over this client's store view (HTTP or local)."""
+        from .auth.rbac import RBACAuthorizer
+
+        allowed = RBACAuthorizer(self.store).authorize(
+            user, verb, resource, namespace, name=name, groups=groups)
+        return "yes" if allowed else "no"
+
     # --- get / describe -------------------------------------------------------
 
     def get(self, kind: str, namespace: Optional[str] = None) -> str:
         if kind.lower() in ("slice", "slices"):
             return self.get_slices()
-        kind = KIND_ALIASES.get(kind.lower(), kind)
+        kind = self.resolve_kind(kind)
         objs, _ = self.store.list(kind)
         if namespace:
             objs = [o for o in objs if getattr(o.metadata, "namespace", "") == namespace]
@@ -76,6 +136,9 @@ class Kubectl:
         return _render_table([self._header(kind)] + rows)
 
     def _header(self, kind: str) -> List[str]:
+        entry = _scheme().kind_types().get(kind)
+        if entry is not None and getattr(entry[2], "_custom_resource", False):
+            return ["NAME", "AGE"]
         return {
             "Pod": ["NAME", "STATUS", "NODE", "PRIORITY"],
             "Node": ["NAME", "READY", "ZONE", "TAINTS", "CPU", "MEMORY"],
@@ -135,15 +198,27 @@ class Kubectl:
         if kind == "ResourceSlice":
             return [o.metadata.name, o.node_name or "<none>", o.pool or "<none>",
                     str(len(o.devices))]
+        if getattr(o, "_custom_resource", False):
+            import time as _time
+
+            age = max(0, int(_time.time() - o.metadata.creation_timestamp))
+            return [o.metadata.name,
+                    f"{age // 3600}h{(age % 3600) // 60:02d}m" if age >= 3600
+                    else f"{age // 60}m{age % 60:02d}s" if age >= 60
+                    else f"{age}s"]
         return [o.metadata.name]
 
     def describe(self, kind: str, namespace: str, name: str) -> str:
-        kind = KIND_ALIASES.get(kind.lower(), kind)
+        kind = self.resolve_kind(kind)
         o = self.store.get(kind, namespace, name)
         if o is None:
             return f"{kind} {namespace}/{name} not found"
         import dataclasses, json
 
+        if not dataclasses.is_dataclass(o):  # custom resources: wire manifest
+            from .api.serialize import to_manifest
+
+            return json.dumps(to_manifest(o, _scheme()), default=str, indent=2)
         return json.dumps(dataclasses.asdict(o), default=str, indent=2)
 
     # --- apply / delete / scale ----------------------------------------------
@@ -177,7 +252,7 @@ class Kubectl:
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> str:
-        kind = KIND_ALIASES.get(kind.lower(), kind)
+        kind = self.resolve_kind(kind)
         obj = self.store.delete(kind, namespace, name)
         return (
             f"{kind.lower()}/{name} deleted" if obj is not None
@@ -198,7 +273,7 @@ class Kubectl:
         from .api.serialize import to_manifest
         import json
 
-        kind = KIND_ALIASES.get(kind.lower(), kind)
+        kind = self.resolve_kind(kind)
         o = self.store.get(kind, namespace, name)
         if o is None:
             return f"{kind} {namespace}/{name} not found"
@@ -820,6 +895,10 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         help="apiserver URL (kubectl --server): verbs run over HTTP "
              "instead of an in-process store",
     )
+    ap.add_argument("--user", default="",
+                    help="identity sent as X-Remote-User (server mode)")
+    ap.add_argument("--group", action="append", default=[],
+                    help="group sent as X-Remote-Group (repeatable)")
     sub = ap.add_subparsers(dest="verb", required=True)
     g = sub.add_parser("get")
     g.add_argument("kind")
@@ -863,6 +942,17 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
     p.add_argument("-l", "--last", type=int, default=8,
                    help="how many attempt span trees to dump")
     sub.add_parser("slo")
+    p = sub.add_parser("auth", help="kubectl auth can-i against stored RBAC")
+    p.add_argument("action", choices=["can-i"])
+    p.add_argument("can_verb", metavar="verb")
+    p.add_argument("resource")
+    p.add_argument("--as", dest="as_user", required=True,
+                   help="subject to evaluate (kubectl --as)")
+    p.add_argument("--as-group", dest="as_groups", action="append",
+                   default=[], help="group membership (repeatable)")
+    p.add_argument("-n", "--namespace", default="")
+    p.add_argument("--name", default="",
+                   help="resourceName-scoped check (e.g. a single object)")
     for verb in ("cordon", "uncordon"):
         p = sub.add_parser(verb)
         p.add_argument("node")
@@ -871,7 +961,8 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
         from .apiserver import HTTPApiClient
         from .apiserver.client import HTTPStoreFacade
 
-        store = HTTPStoreFacade(HTTPApiClient(args.server))
+        store = HTTPStoreFacade(HTTPApiClient(
+            args.server, user=args.user, groups=tuple(args.group)))
     else:
         store = ObjectStore()
     k = Kubectl(store)
@@ -957,6 +1048,10 @@ def main(argv=None):  # pragma: no cover - thin shell wrapper
                 print(e.read().decode())
         else:
             print(k.readyz_status())
+    elif args.verb == "auth":
+        print(k.can_i(args.can_verb, args.resource, args.as_user,
+                      namespace=args.namespace, name=args.name,
+                      groups=tuple(args.as_groups)))
     elif args.verb in ("cordon", "uncordon"):
         print(k.cordon(args.node, on=args.verb == "cordon"))
     return 0

@@ -448,3 +448,30 @@ autoscaler_scale_decisions = default_registry.register(
     Counter("autoscaler_scale_decisions_total",
             "Cluster-autoscaler scale decisions, by direction and outcome")
 )
+
+# --- multi-tenant API surface (apiextensions + auth) --------------------------
+
+crd_registrations = default_registry.register(
+    # labels: (op,) — "install" (kind newly served) | "update" (schema or
+    # scope change re-minted the served type) | "uninstall" (CRD deleted,
+    # kind removed + stored CRs cascaded) | "conflict" (CRD names a kind a
+    # built-in already serves: registration refused, never a ghost kind)
+    Counter("apiextensions_crd_registrations_total",
+            "Dynamic-kind registrar operations, by outcome")
+)
+crd_kinds_served = default_registry.register(
+    Gauge("apiextensions_crd_kinds_served",
+          "Custom kinds currently installed in the serving scheme")
+)
+rbac_decisions = default_registry.register(
+    # labels: (decision,) — "allow" | "deny"; one increment per authorizer
+    # evaluation at the apiserver door
+    Counter("rbac_authorization_decisions_total",
+            "RBAC authorizer decisions, by outcome")
+)
+trainingjob_expansions = default_registry.register(
+    # labels: (result,) — "expanded" (objects newly created this sync) |
+    # "steady" (job already fully expanded — the idempotent no-op path)
+    Counter("trainingjob_expansions_total",
+            "TrainingJob controller reconciles, by outcome")
+)

@@ -742,6 +742,81 @@ def _device_claim_gang(n, p, mp) -> Workload:
     )
 
 
+# --- TrainingJob custom-workload suite --------------------------------------
+
+
+def trainingjob_crd_object(j: int) -> tuple:
+    from ..apiextensions.api import CustomResourceDefinition
+    from ..controllers.trainingjob import TRAININGJOB_CRD
+
+    return ("CustomResourceDefinition",
+            CustomResourceDefinition.from_dict(TRAININGJOB_CRD))
+
+
+def trainingjob_template(replicas: int,
+                         chips: int = CHIPS_PER_HOST) -> Callable[[int], tuple]:
+    """TrainingJob CR j: ``replicas`` members, each claiming its host's
+    whole chip inventory — the controller expands these into the same
+    gang+claim object graph DeviceClaimGang pre-creates by hand."""
+    from ..apiextensions.api import CustomResourceDefinition, make_kind_type
+    from ..controllers.trainingjob import TRAININGJOB_CRD, TRAININGJOB_GROUP
+
+    typ = make_kind_type(CustomResourceDefinition.from_dict(TRAININGJOB_CRD))
+
+    def tmpl(j: int) -> tuple:
+        return ("TrainingJob", typ.from_dict({
+            "apiVersion": f"{TRAININGJOB_GROUP}/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": f"job-{j:05d}", "namespace": "default"},
+            "spec": {"replicas": replicas, "chipsPerReplica": chips},
+        }))
+
+    return tmpl
+
+
+def _trainingjob_flow(n, p, mp) -> Workload:
+    """TrainingJobFlow: the multi-tenant workload API measured end to end.
+    TrainingJob CRs (a CRD-defined custom kind, not a built-in) sit in the
+    store at window start; the DRIVEN TrainingJobController expands each
+    into PodGroup + member pods + named ResourceClaims INSIDE the measured
+    window, and the gang + device-claim pipeline schedules them — jobs/s
+    (time-to-full-slice per job) is the headline, pods/s + claims/s ride
+    along.  Every measured pod is controller-born (``driven_pods``); the
+    warm pool is DeviceClaimGang's, so the claim-carrying program variants
+    are warm and the window holds zero compiles."""
+    gs = GANG_SIZE if mp >= GANG_SIZE else max(2, mp)
+    njobs = max(1, mp // gs)
+
+    def make_controller(store, sched):
+        from ..controllers.trainingjob import TrainingJobController
+
+        return TrainingJobController(store, sched)
+
+    return Workload(
+        name="TrainingJobFlow",
+        ops=[
+            Op("createNodes", n, node_template=node_sliced(gs)),
+            Op("createNodes", 1, node_template=dra_warm_node(n)),
+            Op("createObjects", 1, object_template=dra_class_template),
+            Op("createObjects", n, object_template=dra_slice_template(gs)),
+            Op("createObjects", 1, object_template=dra_warm_slice(n)),
+            Op("createObjects", DRA_WARM_POOL,
+               object_template=dra_warm_claim_template),
+            Op("createObjects", DRA_WARM_POOL,
+               object_template=dra_warm_group_template),
+            Op("createObjects", 1, object_template=trainingjob_crd_object),
+            Op("createObjects", njobs, object_template=trainingjob_template(gs)),
+            Op("createPods", 0, pod_template=pod_claim_gang(gs),
+               collect_metrics=True, driven_pods=njobs * gs),
+        ],
+        batch_size=64,
+        gang_size=gs,
+        dra=True,
+        trainingjob=True,
+        make_descheduler=make_controller,
+    )
+
+
 # --- stateful / volume-topology suites --------------------------------------
 
 STS_CLASS = "sts-local"
@@ -1005,6 +1080,14 @@ SUITES: Dict[str, Suite] = {
         # _device_claim_gang.  Zero-in-window-compile gated in
         # run_suites.sh (the claim planes ride the warm program variants).
         Suite("DeviceClaimGang", _device_claim_gang,
+              {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
+               "5000Nodes": (5000, 0, 4800)},
+              batch_size={"5000Nodes": 512}),
+        # TrainingJob custom workload: a CRD-defined kind a driven
+        # controller expands into gang + claim objects INSIDE the measured
+        # window — jobs/s + time-to-full-slice for the controller→
+        # scheduler pipeline — see _trainingjob_flow
+        Suite("TrainingJobFlow", _trainingjob_flow,
               {"64Nodes": (64, 0, 56), "500Nodes": (500, 0, 480),
                "5000Nodes": (5000, 0, 4800)},
               batch_size={"5000Nodes": 512}),

@@ -110,9 +110,14 @@ class ObjectStore:
 
     # --- helpers -------------------------------------------------------------
 
+    # NOTE: mutated in place by the dynamic-kind registrar for
+    # cluster-scoped CRDs — client facades alias this same set object, so
+    # scoping changes propagate everywhere at once.
     CLUSTER_SCOPED = {"Node", "PersistentVolume", "StorageClass", "CSINode",
                       "PriorityClass", "Namespace",
-                      "DeviceClass", "ResourceSlice"}
+                      "DeviceClass", "ResourceSlice",
+                      "CustomResourceDefinition",
+                      "ClusterRole", "ClusterRoleBinding"}
 
     @classmethod
     def _key(cls, kind: str, obj) -> Tuple[str, str, str]:

@@ -276,6 +276,10 @@ class ReplayResult:
     truncated_tail: bool = False
     truncated_at: int = 0  # byte offset the torn tail was cut at
     errors: List[str] = field(default_factory=list)
+    # the dynamic-kind registrar attached during replay (CRD records
+    # re-install their kinds before the custom-resource records that
+    # follow them decode); callers keep it attached for live serving
+    registrar: object = None
 
 
 def scan_records(data: bytes, base_offset: int = 0):
@@ -355,6 +359,20 @@ def replay_on_boot(path: str, *, store=None, scheme=None,
                 os.fsync(f.fileno())
         klog.V(1).info_s("WAL torn tail truncated", path=path,
                          at=good_end, lost_bytes=size - good_end)
+    # dynamic kinds: a CRD record precedes every record of the kind it
+    # defines (rv order), and replay_record emits synchronously, so an
+    # attached registrar re-installs each kind into the scheme BEFORE the
+    # first custom-resource record decodes.  ``replaying`` suppresses the
+    # registrar's own writes (the log already holds whatever cascade
+    # completed pre-crash); resync() after replay finishes any cascade the
+    # crash interrupted — replayed exactly once, because deleting a
+    # missing object is a no-op.
+    from ..apiextensions.registrar import DynamicKindRegistrar
+
+    registrar = DynamicKindRegistrar(store, scheme)
+    registrar.replaying = True
+    registrar.attach(drain=False)
+    result.registrar = registrar
     for _, rec in records:
         obj = rec.decode_obj(scheme)
         store.replay_record(rec.op, rec.kind, obj=obj,
@@ -363,6 +381,8 @@ def replay_on_boot(path: str, *, store=None, scheme=None,
         result.records_applied += 1
         result.last_rv = rec.rv
     store.rebuild_admission_caches()
+    registrar.replaying = False
+    registrar.resync()
     klog.V(1).info_s("WAL replay complete", path=path,
                      records=result.records_applied, last_rv=result.last_rv,
                      truncated=result.truncated_tail)

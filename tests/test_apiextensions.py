@@ -1,0 +1,492 @@
+"""Multi-tenant API surface: CRD lifecycle, dynamic kind serving, the
+TrainingJob custom workload, and registration convergence under faults.
+
+Reference behaviors exercised: apiextensions-apiserver's crdHandler
+(customresource_handler.go) — CRD create installs served storage at
+runtime, CRD delete cascades the stored CRs and terminates their watches;
+structural-schema validation (pkg/apiserver/validation); and the
+exactly-once registration discipline a WAL-replayed boot must converge to.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.api.scheme import SchemeError, default_scheme
+from kubernetes_tpu.api.serialize import to_manifest
+from kubernetes_tpu.apiextensions import (
+    CustomResourceDefinition,
+    DynamicKindRegistrar,
+    attach_registrar,
+    make_kind_type,
+    validate_structural,
+)
+from kubernetes_tpu.apiserver import APIServer, HTTPApiClient
+from kubernetes_tpu.apiserver.client import HTTPStoreFacade
+from kubernetes_tpu.chaos import (
+    CRASH_MID_CRD_REGISTER,
+    FaultSchedule,
+    ProcessCrash,
+    WatchDropped,
+    crash_schedule,
+)
+from kubernetes_tpu.controllers.trainingjob import (
+    TRAININGJOB_CRD,
+    TrainingJobController,
+    install_trainingjob_crd,
+)
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.sim.wal import WriteAheadLog, replay_on_boot
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
+
+
+WIDGET_CRD = {
+    "apiVersion": "apiextensions.k8s.io/v1",
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "widgets.example.com"},
+    "spec": {
+        "group": "example.com",
+        "scope": "Namespaced",
+        "names": {"plural": "widgets", "singular": "widget",
+                  "kind": "Widget"},
+        "versions": [{
+            "name": "v1", "served": True, "storage": True,
+            "schema": {"openAPIV3Schema": {
+                "type": "object",
+                "properties": {"spec": {
+                    "type": "object",
+                    "required": ["size"],
+                    "properties": {
+                        "size": {"type": "integer", "minimum": 1},
+                        "color": {"type": "string",
+                                  "enum": ["red", "blue"]},
+                    },
+                }},
+            }},
+        }],
+    },
+}
+
+
+def widget_manifest(name, size=3, ns="default", **extra):
+    spec = {"size": size, **extra}
+    return {"apiVersion": "example.com/v1", "kind": "Widget",
+            "metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+def _live(scheme=None):
+    """store + scheme + attached registrar (the serving wiring)."""
+    store = ObjectStore()
+    scheme = scheme or default_scheme()
+    reg = attach_registrar(store, scheme)
+    return store, scheme, reg
+
+
+# --- registrar: install / idempotency / conflict ------------------------------
+
+
+def test_crd_create_installs_kind_and_delete_cascades():
+    store, scheme, reg = _live()
+    store.create("CustomResourceDefinition", scheme.decode(WIDGET_CRD))
+    assert "Widget" in scheme.kind_types()
+    assert reg.installed_kinds() == {"widgets.example.com": "Widget"}
+    store.create("Widget", scheme.decode(widget_manifest("w1")))
+    store.create("Widget", scheme.decode(widget_manifest("w2")))
+    assert len(store.list("Widget")[0]) == 2
+    store.delete("CustomResourceDefinition", "", "widgets.example.com")
+    # kind gone from the scheme, stored CRs cascaded out
+    assert "Widget" not in scheme.kind_types()
+    assert store.list("Widget")[0] == []
+    assert reg.installed_kinds() == {}
+
+
+def test_replayed_crd_event_is_idempotent():
+    store, scheme, reg = _live()
+    crd = scheme.decode(WIDGET_CRD)
+    store.create("CustomResourceDefinition", crd)
+    typ0 = scheme.kind_types()["Widget"][2]
+    # a second registrar attach replays history — same registration object
+    reg2 = DynamicKindRegistrar(store, scheme).attach()
+    assert scheme.kind_types()["Widget"][2] is typ0
+    reg2.close()
+    # resync (the recovery path) is equally a no-op
+    reg.resync()
+    assert scheme.kind_types()["Widget"][2] is typ0
+
+
+def test_crd_shadowing_builtin_kind_is_refused():
+    store, scheme, reg = _live()
+    bad = {**WIDGET_CRD, "metadata": {"name": "pods.example.com"},
+           "spec": {**WIDGET_CRD["spec"],
+                    "names": {"plural": "pods", "singular": "pod",
+                              "kind": "Pod"}}}
+    store.create("CustomResourceDefinition", scheme.decode(bad))
+    # built-in Pod still served by the hand-written type
+    typ = scheme.kind_types()["Pod"][2]
+    assert not getattr(typ, "_custom_resource", False)
+    assert reg.installed_kinds() == {}
+
+
+def test_crd_update_reinstalls_under_same_kind():
+    store, scheme, reg = _live()
+    store.create("CustomResourceDefinition", scheme.decode(WIDGET_CRD))
+    typ0 = scheme.kind_types()["Widget"][2]
+    upd = json.loads(json.dumps(WIDGET_CRD))
+    upd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]["properties"][
+        "spec"]["properties"]["size"]["minimum"] = 2
+    crd = scheme.decode(upd)
+    old = store.get("CustomResourceDefinition", "", "widgets.example.com")
+    crd.metadata.resource_version = old.metadata.resource_version
+    store.update("CustomResourceDefinition", crd)
+    typ1 = scheme.kind_types()["Widget"][2]
+    assert typ1 is not typ0 and typ1._fingerprint != typ0._fingerprint
+    # the tightened schema is live
+    with pytest.raises(ValueError):
+        typ1.from_dict(widget_manifest("w", size=1))
+
+
+# --- structural schema --------------------------------------------------------
+
+
+def test_structural_schema_validation():
+    schema = WIDGET_CRD["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    assert validate_structural(schema, widget_manifest("ok")) == []
+    assert validate_structural(
+        schema, {"spec": {}})  # missing required size
+    assert validate_structural(
+        schema, {"spec": {"size": 0}})  # below minimum
+    assert validate_structural(
+        schema, {"spec": {"size": "three"}})  # wrong type
+    assert validate_structural(
+        schema, {"spec": {"size": 2, "color": "green"}})  # enum violation
+
+
+# --- HTTP serving: CRUD / watch / pagination, both codecs ---------------------
+
+
+@pytest.mark.parametrize("codec", ["wire", "json"])
+def test_cr_crud_watch_pagination_over_http(codec):
+    store, scheme, reg = _live()
+    srv = APIServer(store, scheme).start()
+    try:
+        client = HTTPApiClient(srv.url, scheme=scheme, codec=codec)
+        fac = HTTPStoreFacade(client)
+        fac.create("CustomResourceDefinition", scheme.decode(WIDGET_CRD))
+        events, errors = [], []
+        done = threading.Event()
+        stop = client.watch_kind(
+            "Widget",
+            lambda ev: (events.append((ev.type, ev.obj.metadata.name)),
+                        done.set() if len(events) >= 4 else None),
+            on_error=lambda e: errors.append(e))
+        for i in range(3):
+            fac.create("Widget",
+                       scheme.decode(widget_manifest(f"w{i}", size=i + 1)))
+        # update via CAS
+        w0 = fac.get("Widget", "default", "w0")
+        w0.body["spec"]["size"] = 9
+        fac.update("Widget", w0)
+        assert done.wait(5.0)
+        assert [e for e in events if e[0] == "ADDED"] == [
+            ("ADDED", "w0"), ("ADDED", "w1"), ("ADDED", "w2")]
+        assert ("MODIFIED", "w0") in events
+        stop()
+        # rv-pinned pagination: 2-page walk over the 3 CRs
+        page1, rv1, cont = client.list_page("Widget", limit=2)
+        assert len(page1) == 2 and cont
+        page2, rv2, cont2 = client.list_page("Widget", limit=2,
+                                             continue_=cont)
+        assert rv2 == rv1 and cont2 == ""
+        names = {o.metadata.name for o in page1 + page2}
+        assert names == {"w0", "w1", "w2"}
+        assert fac.get("Widget", "default", "w0").body["spec"]["size"] == 9
+        fac.delete("Widget", "default", "w2")
+        assert fac.get("Widget", "default", "w2") is None
+    finally:
+        srv.stop()
+
+
+def test_crd_delete_terminates_watch_and_404s():
+    store, scheme, reg = _live()
+    srv = APIServer(store, scheme).start()
+    try:
+        # client with its OWN scheme, minting the kind from the CRD
+        # manifest — the realistic remote-tenant shape (no shared scheme)
+        cscheme = default_scheme()
+        crd = CustomResourceDefinition.from_dict(WIDGET_CRD)
+        cscheme.add_known_type(crd.group, crd.storage_version,
+                               make_kind_type(crd))
+        client = HTTPApiClient(srv.url, scheme=cscheme)
+        fac = HTTPStoreFacade(client)
+        fac.create("CustomResourceDefinition",
+                   cscheme.decode(WIDGET_CRD))
+        fac.create("Widget", cscheme.decode(widget_manifest("w")))
+        events, errors = [], []
+        dropped = threading.Event()
+        client.watch_kind(
+            "Widget", lambda ev: events.append(ev.type),
+            on_error=lambda e: (errors.append(e), dropped.set()))
+        deadline = time.monotonic() + 5.0
+        while "ADDED" not in events and time.monotonic() < deadline:
+            time.sleep(0.02)
+        fac.delete("CustomResourceDefinition", "", "widgets.example.com")
+        assert dropped.wait(5.0)
+        # ordered drain THEN termination: the cascade's DELETED arrived
+        # before the stream dropped
+        assert events == ["ADDED", "DELETED"]
+        assert isinstance(errors[0], WatchDropped)
+        # the plural no longer serves
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"{srv.url}/apis/example.com/v1/namespaces/default/widgets")
+        assert e.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_invalid_cr_rejected_over_http():
+    store, scheme, reg = _live()
+    srv = APIServer(store, scheme).start()
+    try:
+        client = HTTPApiClient(srv.url, scheme=scheme)
+        HTTPStoreFacade(client).create(
+            "CustomResourceDefinition", scheme.decode(WIDGET_CRD))
+        bad = widget_manifest("w", size=0)  # minimum violation
+        req = urllib.request.Request(
+            f"{srv.url}/apis/example.com/v1/namespaces/default/widgets",
+            method="POST", data=json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+
+
+# --- WAL replay / cold start --------------------------------------------------
+
+
+def test_wal_replay_rebuilds_dynamic_kinds_before_crs(tmp_path):
+    path = str(tmp_path / "store.wal")
+    scheme = default_scheme()
+    store = ObjectStore(wal=WriteAheadLog(path))
+    reg = attach_registrar(store, scheme)
+    store.create("CustomResourceDefinition", scheme.decode(WIDGET_CRD))
+    store.create("Widget", scheme.decode(widget_manifest("w1", size=5)))
+    store.wal.close()
+    # successor boot: FRESH scheme — the CRD record must install the kind
+    # before the Widget record decodes
+    scheme2 = default_scheme()
+    replay = replay_on_boot(path, scheme=scheme2)
+    assert replay.records_applied == 2
+    assert "Widget" in scheme2.kind_types()
+    w = replay.store.get("Widget", "default", "w1")
+    assert w.body["spec"]["size"] == 5
+    # the replayed registrar keeps serving: a new CRD installs live
+    more = {**WIDGET_CRD, "metadata": {"name": "gauges.example.com"},
+            "spec": {**WIDGET_CRD["spec"],
+                     "names": {"plural": "gauges", "singular": "gauge",
+                               "kind": "Gauge"}}}
+    replay.store.create("CustomResourceDefinition", scheme2.decode(more))
+    assert "Gauge" in scheme2.kind_types()
+
+
+def test_crash_mid_crd_register_converges_exactly_once(tmp_path):
+    """Kill between the CRD's durable write and the scheme registration;
+    the successor's replay + resync must serve the kind exactly once."""
+    path = str(tmp_path / "store.wal")
+    scheme = default_scheme()
+    store = ObjectStore(wal=WriteAheadLog(path))
+    attach_registrar(store, scheme)
+    sched = FaultSchedule(seed=7)
+    sched.arm_crash(CRASH_MID_CRD_REGISTER, at_hit=1)
+    with crash_schedule(sched):
+        with pytest.raises(ProcessCrash):
+            store.create("CustomResourceDefinition",
+                         scheme.decode(WIDGET_CRD))
+    store.wal.close()
+    # pre-crash state: CRD durable+stored, kind NOT served
+    assert "Widget" not in scheme.kind_types()
+    scheme2 = default_scheme()
+    replay = replay_on_boot(path, scheme=scheme2)
+    assert replay.records_applied == 1
+    assert "Widget" in scheme2.kind_types()
+    m = replay.store.get("CustomResourceDefinition", "",
+                         "widgets.example.com")
+    assert m is not None
+    # exactly once: the registration is the single live one and CRs serve
+    replay.store.create("Widget",
+                        scheme2.decode(widget_manifest("w", size=2)))
+    assert len(replay.store.list("Widget")[0]) == 1
+
+
+# --- chaos: registration convergence under a fault storm ----------------------
+
+
+def test_crd_churn_under_fault_storm_leaves_zero_ghost_kinds():
+    """Install/uninstall churn with injected 429s on the cascade path:
+    after resync, served kinds == stored CRDs exactly (no ghosts)."""
+    fault = FaultSchedule(seed=11, write_429_rate=0.3)
+    store = ObjectStore(fault_injector=fault)
+    scheme = default_scheme()
+    reg = attach_registrar(store, scheme)
+    kinds = [("sprockets.example.com", "Sprocket", "sprockets"),
+             ("cogs.example.com", "Cog", "cogs"),
+             ("flanges.example.com", "Flange", "flanges")]
+    for crd_name, kind, plural in kinds:
+        manifest = {**WIDGET_CRD, "metadata": {"name": crd_name},
+                    "spec": {**WIDGET_CRD["spec"],
+                             "names": {"plural": plural,
+                                       "singular": plural[:-1],
+                                       "kind": kind}}}
+        for attempt in range(50):
+            try:
+                store.create("CustomResourceDefinition",
+                             scheme.decode(manifest))
+                break
+            except Exception:
+                continue
+        for i in range(3):
+            cr = {"apiVersion": "example.com/v1", "kind": kind,
+                  "metadata": {"name": f"{plural}-{i}",
+                               "namespace": "default"},
+                  "spec": {"size": 1}}
+            for attempt in range(50):
+                try:
+                    store.create(kind, scheme.decode(cr))
+                    break
+                except Exception:
+                    continue
+    # delete two CRDs under the storm: cascades may defer on 429
+    for crd_name, _, _ in kinds[:2]:
+        for attempt in range(50):
+            try:
+                store.delete("CustomResourceDefinition", "", crd_name)
+                break
+            except Exception:
+                continue
+    for _ in range(50):  # convergence loop: resync retries parked cascades
+        reg.resync()
+        if (not store.list("Sprocket")[0]
+                and not store.list("Cog")[0]):
+            break
+    assert "Sprocket" not in scheme.kind_types()
+    assert "Cog" not in scheme.kind_types()
+    assert "Flange" in scheme.kind_types()
+    assert store.list("Sprocket")[0] == []
+    assert store.list("Cog")[0] == []
+    assert len(store.list("Flange")[0]) == 3
+    assert reg.installed_kinds() == {"flanges.example.com": "Flange"}
+
+
+# --- TrainingJob: the custom workload rides the gang + claim path -------------
+
+
+def _tpu_cluster(store):
+    from kubernetes_tpu.dra.api import (ATTR_CHIP_INDEX, ATTR_HOST,
+                                        ATTR_SLICE, Device, DeviceClass,
+                                        ResourceSlice)
+    from kubernetes_tpu.gang import SLICE_LABEL
+    from kubernetes_tpu.testutil import make_node
+
+    dc = DeviceClass()
+    dc.metadata.name = "tpu"
+    store.create("DeviceClass", dc)
+    for i in range(4):
+        pool = f"s{i // 2}"
+        store.create("Node", make_node().name(f"n{i}")
+                     .capacity({"cpu": "4", "memory": "32Gi", "pods": "20"})
+                     .label(SLICE_LABEL, pool).obj())
+        sl = ResourceSlice(node_name=f"n{i}", pool=pool, devices=[
+            Device(name=f"n{i}-chip{j}", attributes={
+                ATTR_SLICE: pool, ATTR_HOST: f"n{i}",
+                ATTR_CHIP_INDEX: str(j)}) for j in range(4)])
+        sl.metadata.name = f"rs-n{i}"
+        store.create("ResourceSlice", sl)
+
+
+def test_trainingjob_expands_and_gang_schedules_end_to_end():
+    from kubernetes_tpu.scheduler import TPUScheduler
+
+    store, scheme, reg = _live()
+    install_trainingjob_crd(store, scheme)
+    assert "TrainingJob" in scheme.kind_types()
+    _tpu_cluster(store)
+    job = scheme.decode({
+        "apiVersion": "workloads.tpu.dev/v1", "kind": "TrainingJob",
+        "metadata": {"name": "mnist", "namespace": "default"},
+        "spec": {"replicas": 2, "chipsPerReplica": 4}})
+    store.create("TrainingJob", job)
+    ctrl = TrainingJobController(store)
+    assert ctrl.sync_once()          # expansion creates objects
+    assert not ctrl.sync_once()      # steady state: exactly-once
+    pg = store.get("PodGroup", "default", "tj-mnist")
+    assert pg.min_member == 2
+    pods, _ = store.list("Pod")
+    assert sorted(p.metadata.name for p in pods) == \
+        ["tj-mnist-0", "tj-mnist-1"]
+    owner = pods[0].metadata.owner_references[0]
+    assert owner.kind == "TrainingJob" and owner.name == "mnist"
+    sched = TPUScheduler(store, batch_size=8, batch_wait=0)
+    assert sched.run_until_idle(max_cycles=10).scheduled == 2
+    slices = set()
+    for i in range(2):
+        p = store.get("Pod", "default", f"tj-mnist-{i}")
+        c = store.get("ResourceClaim", "default", f"tj-mnist-{i}")
+        assert p.spec.node_name and c.allocated_node == p.spec.node_name
+        assert len(c.allocated_devices) == 4
+        slices.add(p.spec.node_name)
+    assert len(slices) == 2  # one member per host, whole chips each
+    ctrl.sync_once()
+    j = store.get("TrainingJob", "default", "mnist")
+    assert j.body["status"] == {"phase": "Running", "boundReplicas": 2}
+
+
+def test_trainingjob_schema_rejects_bad_spec():
+    store, scheme, reg = _live()
+    install_trainingjob_crd(store, scheme)
+    typ = scheme.kind_types()["TrainingJob"][2]
+    with pytest.raises(ValueError):
+        typ.from_dict({"apiVersion": "workloads.tpu.dev/v1",
+                       "kind": "TrainingJob",
+                       "metadata": {"name": "bad"},
+                       "spec": {"replicas": 0, "chipsPerReplica": 4}})
+    with pytest.raises(ValueError):
+        typ.from_dict({"apiVersion": "workloads.tpu.dev/v1",
+                       "kind": "TrainingJob",
+                       "metadata": {"name": "bad"},
+                       "spec": {"replicas": 2}})  # chipsPerReplica required
+
+
+def test_cli_dynamic_discovery_and_crd_get():
+    from kubernetes_tpu.cli import Kubectl
+
+    store, scheme, reg = _live()
+    install_trainingjob_crd(store, scheme)
+    store.create("TrainingJob", scheme.decode({
+        "apiVersion": "workloads.tpu.dev/v1", "kind": "TrainingJob",
+        "metadata": {"name": "mnist", "namespace": "default"},
+        "spec": {"replicas": 2, "chipsPerReplica": 4}}))
+    import kubernetes_tpu.cli as cli_mod
+    cli_mod._scheme_cache.clear()
+    try:
+        k = Kubectl(store)
+        out = k.get("trainingjobs")  # plural → dynamic discovery
+        assert "mnist" in out and "NAME" in out and "AGE" in out
+        assert "mnist" in k.describe("trainingjob", "default", "mnist")
+    finally:
+        cli_mod._scheme_cache.clear()

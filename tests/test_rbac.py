@@ -1,0 +1,279 @@
+"""RBAC authorization: rule matching, the store-backed evaluator, door
+enforcement at the HTTP apiserver, and the bootstrap policy envelope.
+
+Reference behaviors exercised: plugin/pkg/auth/authorizer/rbac
+(RuleAllows — verbs × apiGroups × resources × resourceNames with ``*``
+wildcards; ClusterRoleBindings grant everywhere, RoleBindings only in
+their namespace) and the bootstrap cluster roles
+(plugin/pkg/auth/authorizer/rbac/bootstrappolicy) that give each control
+loop exactly its verb envelope.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.analysis import lockcheck
+from kubernetes_tpu.api.scheme import default_scheme
+from kubernetes_tpu.api.serialize import to_manifest
+from kubernetes_tpu.apiserver import APIServer, HTTPApiClient
+from kubernetes_tpu.apiserver.client import HTTPStoreFacade
+from kubernetes_tpu.apiserver.server import header_authenticator
+from kubernetes_tpu.auth.api import (
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    RoleRef,
+    Subject,
+)
+from kubernetes_tpu.auth.bootstrap import (
+    GROUP_MASTERS,
+    USER_AUTOSCALER,
+    USER_CONTROLLER_MANAGER,
+    USER_DESCHEDULER,
+    USER_SCHEDULER,
+    install_bootstrap_policy,
+)
+from kubernetes_tpu.auth.rbac import RBACAuthorizer
+from kubernetes_tpu.sim.store import ObjectStore
+from kubernetes_tpu.testutil import make_pod
+
+
+@pytest.fixture(autouse=True)
+def lock_order_monitor():
+    mon = lockcheck.activate()
+    try:
+        yield mon
+    finally:
+        lockcheck.deactivate()
+    assert not mon.violations, mon.report()
+
+
+SCHEME = default_scheme()
+
+
+# --- rule matching ------------------------------------------------------------
+
+
+def test_policy_rule_wildcards_and_resource_names():
+    r = PolicyRule(verbs=["get", "list"], resources=["pods"])
+    assert r.matches("get", "", "pods")
+    assert not r.matches("delete", "", "pods")
+    assert not r.matches("get", "", "nodes")
+    assert not r.matches("get", "apps", "pods")  # group-scoped mismatch
+    star = PolicyRule(verbs=["*"], api_groups=["*"], resources=["*"])
+    assert star.matches("delete", "rbac.authorization.k8s.io",
+                        "clusterroles", name="anything")
+    named = PolicyRule(verbs=["get"], resources=["configmaps"],
+                       resource_names=["the-one"])
+    assert named.matches("get", "", "configmaps", name="the-one")
+    assert not named.matches("get", "", "configmaps", name="other")
+    # empty resourceNames == every name (types.go semantics)
+    assert r.matches("get", "", "pods", name="any")
+
+
+# --- evaluator ----------------------------------------------------------------
+
+
+def test_evaluator_scoping_and_bindings():
+    from kubernetes_tpu.api.objects import ObjectMeta
+
+    store = ObjectStore()
+    authz = RBACAuthorizer(store)
+    # nothing bound: deny
+    assert not authz("alice", "get", "pods", "default")
+    store.create("Role", Role(
+        metadata=ObjectMeta(name="pod-reader", namespace="team-a"),
+        rules=[PolicyRule(verbs=["get", "list", "watch"],
+                          resources=["pods"])]))
+    store.create("RoleBinding", RoleBinding(
+        metadata=ObjectMeta(name="alice-reads", namespace="team-a"),
+        subjects=[Subject(kind="User", name="alice")],
+        role_ref=RoleRef(kind="Role", name="pod-reader")))
+    # allowed in the bound namespace only, for the granted verbs only
+    assert authz("alice", "get", "pods", "team-a")
+    assert not authz("alice", "get", "pods", "default")
+    assert not authz("alice", "delete", "pods", "team-a")
+    assert not authz("bob", "get", "pods", "team-a")
+    # group subject via ClusterRoleBinding: everywhere
+    store.create("ClusterRole", ClusterRole(
+        metadata=ObjectMeta(name="node-viewer"),
+        rules=[PolicyRule(verbs=["get", "list"], resources=["nodes"])]))
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        metadata=ObjectMeta(name="ops-view-nodes"),
+        subjects=[Subject(kind="Group", name="ops")],
+        role_ref=RoleRef(kind="ClusterRole", name="node-viewer")))
+    assert authz("carol", "list", "nodes", "", groups=("ops",))
+    assert not authz("carol", "list", "nodes", "")  # not in the group
+    # dangling roleRef: deny, never crash
+    store.create("RoleBinding", RoleBinding(
+        metadata=ObjectMeta(name="dangling", namespace="team-a"),
+        subjects=[Subject(kind="User", name="dave")],
+        role_ref=RoleRef(kind="Role", name="no-such-role")))
+    assert not authz("dave", "get", "pods", "team-a")
+
+
+def test_evaluator_resource_name_scoping():
+    from kubernetes_tpu.api.objects import ObjectMeta
+
+    store = ObjectStore()
+    store.create("ClusterRole", ClusterRole(
+        metadata=ObjectMeta(name="one-node"),
+        rules=[PolicyRule(verbs=["get"], resources=["nodes"],
+                          resource_names=["n1"])]))
+    store.create("ClusterRoleBinding", ClusterRoleBinding(
+        metadata=ObjectMeta(name="erin-one-node"),
+        subjects=[Subject(kind="User", name="erin")],
+        role_ref=RoleRef(kind="ClusterRole", name="one-node")))
+    authz = RBACAuthorizer(store)
+    assert authz("erin", "get", "nodes", "", name="n1")
+    assert not authz("erin", "get", "nodes", "", name="n2")
+    # a LIST has no single name — a resourceNames-scoped grant must not
+    # leak the collection
+    assert not authz("erin", "list", "nodes", "")
+
+
+# --- HTTP door enforcement ----------------------------------------------------
+
+
+def _rbac_server(store=None):
+    store = store or ObjectStore()
+    srv = APIServer(store, SCHEME,
+                    authenticators=[header_authenticator],
+                    authorizer=RBACAuthorizer(store)).start()
+    return store, srv
+
+
+def test_unbound_403_role_bound_200_same_request():
+    from kubernetes_tpu.api.objects import ObjectMeta
+
+    store, srv = _rbac_server()
+    try:
+        pod = to_manifest(make_pod().name("p").uid("p").namespace("default")
+                          .req({"cpu": "1"}).obj(), SCHEME)
+
+        def create_as(user):
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods", method="POST",
+                data=json.dumps(pod).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Remote-User": user})
+            return urllib.request.urlopen(req).status
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            create_as("mallory")
+        assert e.value.code == 403
+        status = json.loads(e.value.read())
+        assert status["reason"] == "Forbidden"
+        store.create("Role", Role(
+            metadata=ObjectMeta(name="maker", namespace="default"),
+            rules=[PolicyRule(verbs=["create"], resources=["pods"])]))
+        store.create("RoleBinding", RoleBinding(
+            metadata=ObjectMeta(name="mallory-makes", namespace="default"),
+            subjects=[Subject(kind="User", name="mallory")],
+            role_ref=RoleRef(kind="Role", name="maker")))
+        assert create_as("mallory") == 201  # the SAME request now passes
+    finally:
+        srv.stop()
+
+
+def test_unauthenticated_401_before_authorization():
+    store, srv = _rbac_server()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{srv.url}/api/v1/pods")
+        assert e.value.code == 401
+    finally:
+        srv.stop()
+
+
+def test_group_identity_flows_through_the_door():
+    store, srv = _rbac_server()
+    try:
+        install_bootstrap_policy(store)
+        # masters group: full wildcard via cluster-admin
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/nodes",
+            headers={"X-Remote-User": "root-ish",
+                     "X-Remote-Group": "system:masters"})
+        assert urllib.request.urlopen(req).status == 200
+        # same user without the group header: denied
+        req = urllib.request.Request(
+            f"{srv.url}/api/v1/nodes",
+            headers={"X-Remote-User": "root-ish"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_client_facade_sends_identity():
+    store, srv = _rbac_server()
+    try:
+        install_bootstrap_policy(store)
+        fac = HTTPStoreFacade(HTTPApiClient(
+            srv.url, scheme=SCHEME, user="admin",
+            groups=("system:masters",)))
+        assert fac.list("Node")[0] == []  # authorized empty list
+        nobody = HTTPStoreFacade(HTTPApiClient(srv.url, scheme=SCHEME,
+                                               user="nobody"))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            nobody.list("Node")
+        assert e.value.code == 403
+    finally:
+        srv.stop()
+
+
+# --- bootstrap policy envelope ------------------------------------------------
+
+
+def test_bootstrap_policy_is_idempotent():
+    store = ObjectStore()
+    assert install_bootstrap_policy(store) == 10
+    assert install_bootstrap_policy(store) == 0  # second run creates nothing
+
+
+def test_bootstrap_grants_each_controller_its_envelope():
+    store = ObjectStore()
+    install_bootstrap_policy(store)
+    authz = RBACAuthorizer(store)
+    # scheduler: binds pods, updates claims/groups — but never deletes nodes
+    assert authz(USER_SCHEDULER, "create", "pods", "default")
+    assert authz(USER_SCHEDULER, "update", "pods", "default")
+    assert authz(USER_SCHEDULER, "list", "nodes", "")
+    assert authz(USER_SCHEDULER, "update", "resourceclaims", "default")
+    assert authz(USER_SCHEDULER, "update", "podgroups", "default")
+    assert not authz(USER_SCHEDULER, "delete", "nodes", "")
+    assert not authz(USER_SCHEDULER, "create", "clusterroles", "")
+    # controller-manager: full workload-object lifecycle incl. the
+    # TrainingJob custom kind (group-wildcarded workload rule)
+    assert authz(USER_CONTROLLER_MANAGER, "create", "pods", "default")
+    assert authz(USER_CONTROLLER_MANAGER, "create", "resourceclaims",
+                 "default")
+    assert authz(USER_CONTROLLER_MANAGER, "update", "trainingjobs",
+                 "default", api_group="workloads.tpu.dev")
+    assert authz(USER_CONTROLLER_MANAGER, "create", "podgroups", "default")
+    assert not authz(USER_CONTROLLER_MANAGER, "delete", "nodes", "")
+    # descheduler: evicts pods, never creates them
+    assert authz(USER_DESCHEDULER, "delete", "pods", "default")
+    assert authz(USER_DESCHEDULER, "list", "poddisruptionbudgets",
+                 "default")
+    assert not authz(USER_DESCHEDULER, "create", "pods", "default")
+    # autoscaler: grows/shrinks nodes, patches nodegroups
+    assert authz(USER_AUTOSCALER, "create", "nodes", "")
+    assert authz(USER_AUTOSCALER, "delete", "nodes", "")
+    assert authz(USER_AUTOSCALER, "patch", "nodegroups", "",
+                 api_group="autoscaling.x-k8s.io")
+    assert not authz(USER_AUTOSCALER, "delete", "pods", "default")
+    # every identity can renew leases (leader election)
+    for u in (USER_SCHEDULER, USER_CONTROLLER_MANAGER, USER_DESCHEDULER,
+              USER_AUTOSCALER):
+        assert authz(u, "update", "leases", "kube-system")
+    # masters wildcard reaches RBAC objects themselves
+    assert authz("anyone", "delete", "clusterrolebindings", "",
+                 groups=(GROUP_MASTERS,))

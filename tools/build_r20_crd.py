@@ -1,0 +1,119 @@
+"""TrainingJobFlow bench artifact → BENCH_r20_CRD.json.
+
+Runs the TrainingJobFlow suite (a tenant-defined TrainingJob custom
+resource served through the dynamic-kind plane, expanded by the
+controller into PodGroup + member pods + named-device ResourceClaims and
+gang-scheduled through the identical warm path as DeviceClaimGang) in
+fresh subprocesses and writes the artifact tools/render_perf_docs.py
+renders into COMPONENTS.md.
+
+Unlike the older best-pass artifacts, this one keeps the MEDIAN pass and
+publishes the full per-pass band (the tunnel-attached chip's weather
+moves passes ±2×; a best-pass headline overstates the typical run).
+
+Acceptance (ISSUE 20): TrainingJobs expanded and gang-scheduled end to
+end with jobs/s reported, member claims allocated, and zero in-window
+compiles (the run_suites.sh gate holds the 5k row to the same bar).
+
+Usage: python tools/build_r20_crd.py [--size SIZE] [--scale F]
+       [--passes N] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUITE = "TrainingJobFlow"
+
+
+def run_pass(size: str, scale: float) -> dict:
+    env = dict(os.environ)
+    env.update(BENCH_SUITE=SUITE, BENCH_SIZE=size, BENCH_ORACLE_SAMPLE="2",
+               BENCH_SCALE=str(scale))
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=3000, check=True,
+    )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", default="500Nodes")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--passes", type=int, default=3,
+                    help="passes; the MEDIAN-throughput pass is kept and "
+                         "the full band rides along (pass 1 also warms "
+                         "the persistent compile cache)")
+    ap.add_argument("--out", default="BENCH_r20_CRD.json")
+    args = ap.parse_args()
+
+    passes = []
+    for i in range(args.passes):
+        passes.append(run_pass(args.size, args.scale))
+        d = passes[-1]["detail"]
+        print(f"pass {i + 1}: {d['throughput_pods_per_s']:.0f} pods/s, "
+              f"{d.get('trainingjobs', {}).get('jobs_per_s', 0):.1f} "
+              f"jobs/s, {d['xla_compiles_in_window']['count']} compiles",
+              file=sys.stderr)
+
+    def thr(p):
+        return p["detail"]["throughput_pods_per_s"]
+
+    median = sorted(passes, key=thr)[len(passes) // 2]
+    dd = median["detail"]
+    gang = dd.get("gang") or {}
+    claims = dd.get("dra_claims") or {}
+    jobs = dd.get("trainingjobs") or {}
+    assert jobs.get("jobs", 0) > 0, "no TrainingJobs completed — bad run"
+    assert gang.get("gangs", 0) > 0, "no gangs seated — bad run"
+    assert claims.get("allocated", 0) > 0, "no claims allocated — bad run"
+
+    pods_band = sorted(thr(p) for p in passes)
+    jobs_band = sorted(
+        p["detail"].get("trainingjobs", {}).get("jobs_per_s", 0.0)
+        for p in passes)
+
+    import jax
+
+    artifact = {
+        "environment": {
+            "backend": jax.default_backend(),
+            "cpus": os.cpu_count(),
+            "note": "all passes in THIS container, fresh subprocess each; "
+                    "MEDIAN-throughput pass kept, full band published "
+                    "(weather moves passes ±2×)",
+        },
+        "suite": SUITE,
+        "size": args.size,
+        "scale": args.scale,
+        "pods_per_s": {
+            "median": statistics.median(pods_band),
+            "band": [pods_band[0], pods_band[-1]],
+            "passes": pods_band,
+        },
+        "jobs_per_s": {
+            "median": statistics.median(jobs_band),
+            "band": [jobs_band[0], jobs_band[-1]],
+            "passes": jobs_band,
+        },
+        "run": median,
+    }
+    with open(os.path.join(REPO, args.out), "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(f"wrote {args.out}: median {dd['throughput_pods_per_s']:.0f} "
+          f"pods/s, {jobs.get('jobs', 0)} jobs "
+          f"({jobs.get('jobs_per_s', 0):.1f}/s), "
+          f"{claims.get('allocated', 0)} claims allocated, "
+          f"{dd['xla_compiles_in_window']['count']} in-window compiles",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -582,6 +582,57 @@ def render_r19_wire(r19):
     return "\n".join(lines)
 
 
+R20_BEGIN = ("<!-- GENERATED:PERF:R20CRD:BEGIN (tools/render_perf_docs.py — "
+             "edit BENCH_r20_CRD.json, not this block) -->")
+R20_END = "<!-- GENERATED:PERF:R20CRD:END -->"
+
+
+def render_r20_crd(r20):
+    """TrainingJobFlow artifact block (BENCH_r20_CRD.json, built by
+    tools/build_r20_crd.py): median+band member-pod and job throughput for
+    the CRD-defined custom workload riding the gang + device-claim path,
+    plus the zero-in-window-compile line."""
+    env = r20["environment"]
+    dd = r20["run"]["detail"]
+    att = dd["attempt_ms"]
+    gang = dd.get("gang") or {}
+    claims = dd.get("dra_claims") or {}
+    jobs = dd.get("trainingjobs") or {}
+    pods = r20["pods_per_s"]
+    jps = r20["jobs_per_s"]
+
+    def band(d, fmt="{:.0f}"):
+        lo, hi = d["band"]
+        return f"{fmt.format(lo)}–{fmt.format(hi)}"
+
+    lines = [
+        R20_BEGIN,
+        "",
+        f"Environment: `{env['backend']}` backend, {env['cpus']} CPU "
+        f"core(s) — {env['note']}",
+        "",
+        f"| metric ({r20['suite']}/{r20['size']}"
+        + (f" ×{r20['scale']}" if r20.get("scale", 1.0) != 1.0 else "")
+        + ") | median | band |",
+        "|---|---|---|",
+        f"| member pods/s | {pods['median']:.1f} | {band(pods)} |",
+        f"| TrainingJobs completed / jobs/s | {jobs.get('jobs', 0)} / "
+        f"{jps['median']:.1f} | {band(jps, '{:.1f}')} |",
+        f"| gangs seated / gangs/s | {gang.get('gangs', 0)} / "
+        f"{gang.get('gangs_per_s', 0.0):.2f} | — |",
+        f"| member claims allocated / claims/s | "
+        f"{claims.get('allocated', 0)} / "
+        f"{claims.get('claims_per_s', 0.0):.1f} | — |",
+        f"| attempt p50 / p99 | {att['p50']:.0f} / {att['p99']:.0f} ms | "
+        "— |",
+        f"| in-window XLA compiles | "
+        f"{int(dd['xla_compiles_in_window']['count'])} | — |",
+        "",
+        R20_END,
+    ]
+    return "\n".join(lines)
+
+
 def splice(path, block, begin=BEGIN, end=END):
     p = os.path.join(REPO, path)
     text = open(p).read()
@@ -655,6 +706,13 @@ def main() -> int:
     if r19 is not None:
         ok &= splice("COMPONENTS.md", render_r19_wire(r19),
                      R19_BEGIN, R19_END)
+    try:
+        r20 = load_bench("BENCH_r20_CRD.json")
+    except (OSError, json.JSONDecodeError):
+        r20 = None  # pre-round-20 trees have no CRD artifact
+    if r20 is not None:
+        ok &= splice("COMPONENTS.md", render_r20_crd(r20),
+                     R20_BEGIN, R20_END)
     return 0 if ok else 1
 
 

@@ -120,6 +120,16 @@ JAX_PLATFORMS=cpu timeout 900 python -m pytest \
 JAX_PLATFORMS=cpu timeout 1200 python -m pytest \
   tests/test_batch_assign.py tests/test_deep_pipeline.py -q -m 'not slow' \
   || { echo "FAILED: affinity-dedup parity gate" >> suites_run.log; exit 1; }
+# multi-tenant API gate (round 20): dynamic CRD kinds must ride the SAME
+# serving paths as built-ins (CRUD+watch+pagination over real HTTP in both
+# codecs, WAL replay minting kinds before CRs decode, crash/storm exactly-
+# once registration) and the RBAC door must hold (401 before 403 before
+# admission, bootstrap envelopes per controller) — the TrainingJobFlow
+# suite below is meaningless if a tenant kind can ghost or a spoofed
+# identity can write
+JAX_PLATFORMS=cpu timeout 900 python -m pytest \
+  tests/test_apiextensions.py tests/test_rbac.py -q -m 'not slow' \
+  || { echo "FAILED: multi-tenant API gate" >> suites_run.log; exit 1; }
 # tracer-overhead gate (round 14): the span tracer rides every suite below
 # (the per-phase attempt-latency blocks come from it) — a disabled-tracer
 # footprint >= 1% of per-pod cost would mean the observability tax leaked
@@ -238,6 +248,12 @@ run GangBasic 5000Nodes
 # compiles like the other coupled suites
 run DeviceClaimGang 5000Nodes
 gate_zero_compiles DeviceClaimGang
+# TrainingJob custom workload (round 20): a tenant-defined CR expanded by
+# a controller into PodGroup + members + claims, gang-scheduled through
+# the identical warm path — the driven-pod window must stay compile-free
+# exactly like DeviceClaimGang above (the CR plane adds zero jit shapes)
+run TrainingJobFlow 5000Nodes
+gate_zero_compiles TrainingJobFlow
 run StatefulChurn 5000Nodes
 run VolumeZoneSpread 5000Nodes
 run Defrag 5000Nodes
